@@ -1,0 +1,29 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of NVIDIA Dynamo
+(reference: /root/reference): OpenAI-compatible frontend, KV-cache-aware
+routing, disaggregated prefill/decode, multi-tier KV block management,
+an SLA-driven autoscaling planner, and a native JAX inference engine with
+paged attention and continuous batching.
+
+Layering (mirrors reference SURVEY.md §1, re-designed TPU-first):
+
+    runtime/    distributed runtime: components, endpoints, request plane,
+                discovery plane, event plane        (ref: lib/runtime)
+    tokens/     token block hashing + radix trees   (ref: lib/tokens, lib/kv-router)
+    llm/        protocols, preprocessor, detokenizer, model cards,
+                migration                           (ref: lib/llm)
+    http/       OpenAI-compatible HTTP frontend     (ref: lib/llm/src/http)
+    router/     KV-aware routing                    (ref: lib/llm/src/kv_router)
+    engines/    mock engine + native JAX engine     (ref: lib/mocker + external vLLM)
+    models/     JAX model definitions (llama, qwen)
+    ops/        pallas kernels (paged attention, block copy)
+    parallel/   mesh/sharding policies, ring attention
+    kvbm/       multi-tier KV block manager         (ref: lib/llm/src/block_manager)
+    planner/    SLA autoscaler                      (ref: components/planner)
+    parsers/    tool-call & reasoning parsers       (ref: lib/parsers)
+"""
+
+from dynamo_tpu._version import __version__
+
+__all__ = ["__version__"]

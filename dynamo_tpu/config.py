@@ -1,0 +1,98 @@
+"""Canonical environment-variable registry.
+
+Reference parity: lib/runtime/src/config/environment_names.rs (the DYN_*
+namespace). All environment knobs used anywhere in dynamo_tpu are declared
+here with defaults and documentation; modules read through ``env_*`` helpers
+so `python -m dynamo_tpu.cli env` can print the full registry.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_REGISTRY: Dict[str, "EnvVar"] = {}
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    doc: str
+
+    def get(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        try:
+            return self.parser(raw)
+        except (ValueError, TypeError):
+            return self.default
+
+
+def _register(name: str, default: Any, parser: Callable[[str], Any], doc: str) -> EnvVar:
+    var = EnvVar(name, default, parser, doc)
+    _REGISTRY[name] = var
+    return var
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_str(name: str, default: str, doc: str = "") -> EnvVar:
+    return _REGISTRY.get(name) or _register(name, default, str, doc)
+
+
+def env_int(name: str, default: int, doc: str = "") -> EnvVar:
+    return _REGISTRY.get(name) or _register(name, default, int, doc)
+
+
+def env_float(name: str, default: float, doc: str = "") -> EnvVar:
+    return _REGISTRY.get(name) or _register(name, default, float, doc)
+
+
+def env_bool(name: str, default: bool, doc: str = "") -> EnvVar:
+    return _REGISTRY.get(name) or _register(name, default, _parse_bool, doc)
+
+
+def registry() -> Dict[str, EnvVar]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Canonical knobs (ref: environment_names.rs). DYN_TPU_* namespace.
+# ---------------------------------------------------------------------------
+
+NAMESPACE = env_str("DYN_TPU_NAMESPACE", "dynamo", "Default namespace for components")
+REQUEST_PLANE = env_str(
+    "DYN_TPU_REQUEST_PLANE", "tcp", "Request plane for cross-process serving: tcp|local"
+)
+DISCOVERY = env_str(
+    "DYN_TPU_DISCOVERY", "memory", "Discovery backend: memory|file|discd (addr via DYN_TPU_DISCOVERY_ADDR)"
+)
+DISCOVERY_ADDR = env_str(
+    "DYN_TPU_DISCOVERY_ADDR", "127.0.0.1:6180", "discd service address or file-backend directory"
+)
+EVENT_PLANE = env_str("DYN_TPU_EVENT_PLANE", "zmq", "Event plane: memory|zmq")
+LEASE_TTL = env_float("DYN_TPU_LEASE_TTL", 10.0, "Discovery lease TTL seconds")
+LOG_LEVEL = env_str("DYN_TPU_LOG", "info", "Log level (trace|debug|info|warn|error)")
+LOG_JSON = env_bool("DYN_TPU_LOG_JSON", False, "Emit JSONL structured logs")
+HTTP_HOST = env_str("DYN_TPU_HTTP_HOST", "0.0.0.0", "Frontend HTTP bind host")
+HTTP_PORT = env_int("DYN_TPU_HTTP_PORT", 8000, "Frontend HTTP bind port")
+SYSTEM_PORT = env_int(
+    "DYN_TPU_SYSTEM_PORT", 9090, "System status server port (/health /live /metrics)"
+)
+KV_BLOCK_SIZE = env_int("DYN_TPU_KV_BLOCK_SIZE", 64, "KV cache block size in tokens")
+ROUTER_TEMPERATURE = env_float(
+    "DYN_TPU_ROUTER_TEMPERATURE", 0.0, "KV router softmax sampling temperature (0 = argmin)"
+)
+ROUTER_OVERLAP_WEIGHT = env_float(
+    "DYN_TPU_ROUTER_OVERLAP_WEIGHT", 1.0, "KV router overlap score weight"
+)
+MIGRATION_LIMIT = env_int(
+    "DYN_TPU_MIGRATION_LIMIT", 3, "Max per-request migrations to new workers on stream death"
+)
+GRACE_PERIOD = env_float("DYN_TPU_GRACE_PERIOD", 30.0, "Graceful-shutdown drain seconds")

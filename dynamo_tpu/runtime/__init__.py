@@ -1,0 +1,48 @@
+"""Distributed runtime public API (ref: dynamo-runtime crate, lib/runtime)."""
+
+from dynamo_tpu.runtime.component import (
+    Client,
+    Component,
+    Endpoint,
+    Instance,
+    Namespace,
+    NoInstancesError,
+    RouterMode,
+    ServedEndpoint,
+)
+from dynamo_tpu.runtime.context import Context, EngineStream, current_context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, LocalRequestPlane
+from dynamo_tpu.runtime.engine import AsyncEngine, as_engine, collect
+from dynamo_tpu.runtime.pipeline import (
+    MapRequestOperator,
+    MapStreamOperator,
+    Operator,
+    PassthroughOperator,
+    build_pipeline,
+)
+from dynamo_tpu.runtime.tasks import TaskTracker
+
+__all__ = [
+    "AsyncEngine",
+    "Client",
+    "Component",
+    "Context",
+    "DistributedRuntime",
+    "Endpoint",
+    "EngineStream",
+    "Instance",
+    "LocalRequestPlane",
+    "MapRequestOperator",
+    "MapStreamOperator",
+    "Namespace",
+    "NoInstancesError",
+    "Operator",
+    "PassthroughOperator",
+    "RouterMode",
+    "ServedEndpoint",
+    "TaskTracker",
+    "as_engine",
+    "build_pipeline",
+    "collect",
+    "current_context",
+]

@@ -1,0 +1,289 @@
+"""Namespaces, components, endpoints, instances, and clients.
+
+Reference parity: lib/runtime/src/component.rs (Namespace :411, Component :141,
+Endpoint :320, Instance/TransportType :70,88) and the PushRouter
+(pipeline/network/egress/push_router.rs:41,76 — RoundRobin/Random/Direct/KV).
+
+Naming: ``{namespace}/{component}/{endpoint}`` addresses a logical service;
+N live *instances* (workers) back it. Serving an endpoint registers an
+instance in the discovery plane under a lease; clients watch the prefix and
+route per-request among live instances.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, AsyncIterator, Dict, List, Optional, TYPE_CHECKING
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import (
+    EventKind,
+    instance_key,
+    instance_prefix,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, as_engine
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Instance:
+    """A live worker behind an endpoint (ref: component.rs:70)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    transport: Dict[str, Any]  # {"kind": "local"|"tcp", ...address info}
+    metadata: Dict[str, Any] = field(default_factory=dict, hash=False)
+
+    @property
+    def key(self) -> str:
+        return instance_key(self.namespace, self.component, self.endpoint, self.instance_id)
+
+    @property
+    def endpoint_path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.endpoint}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "namespace": self.namespace,
+            "component": self.component,
+            "endpoint": self.endpoint,
+            "instance_id": self.instance_id,
+            "transport": self.transport,
+            "metadata": self.metadata,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Instance":
+        return cls(
+            namespace=d["namespace"],
+            component=d["component"],
+            endpoint=d["endpoint"],
+            instance_id=int(d["instance_id"]),
+            transport=dict(d.get("transport", {})),
+            metadata=dict(d.get("metadata", {})),
+        )
+
+
+class RouterMode(str, Enum):
+    ROUND_ROBIN = "round_robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class Namespace:
+    def __init__(self, runtime: "DistributedRuntime", name: str) -> None:
+        self._runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self._runtime, self.name, name)
+
+    @property
+    def runtime(self) -> "DistributedRuntime":
+        return self._runtime
+
+
+class Component:
+    def __init__(self, runtime: "DistributedRuntime", namespace: str, name: str) -> None:
+        self._runtime = runtime
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self._runtime, self.namespace, self.name, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Endpoint:
+    def __init__(
+        self, runtime: "DistributedRuntime", namespace: str, component: str, name: str
+    ) -> None:
+        self._runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.name}"
+
+    async def serve_endpoint(
+        self,
+        handler: Any,
+        *,
+        instance_id: Optional[int] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> "ServedEndpoint":
+        """Expose ``handler`` (an AsyncEngine or async generator function) as a
+        live instance of this endpoint (ref: _core.pyi:153 serve_endpoint)."""
+        engine = as_engine(handler)
+        return await self._runtime._serve(self, engine, instance_id=instance_id, metadata=metadata or {})
+
+    async def client(self, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> "Client":
+        client = Client(self._runtime, self, router_mode)
+        await client.start()
+        return client
+
+
+@dataclass
+class ServedEndpoint:
+    instance: Instance
+    _runtime: "DistributedRuntime"
+    _engine: AsyncEngine
+
+    async def shutdown(self, grace_period: float = 30.0) -> None:
+        await self._runtime._unserve(self, grace_period=grace_period)
+
+
+class Client:
+    """Routes requests to live instances of an endpoint.
+
+    Reference parity: PushRouter (push_router.rs:41) + the client-side
+    instance map fed by discovery watch (distributed.rs:394). KV-mode routing
+    delegates instance selection to an injected picker (router layer).
+    """
+
+    def __init__(
+        self,
+        runtime: "DistributedRuntime",
+        endpoint: Endpoint,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    ) -> None:
+        self._runtime = runtime
+        self._endpoint = endpoint
+        self.router_mode = router_mode
+        self._instances: Dict[int, Instance] = {}
+        self._rr_index = 0
+        self._watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._instances_nonempty = asyncio.Event()
+        self._kv_picker = None  # async (request, instances) -> instance_id
+
+    @property
+    def endpoint_path(self) -> str:
+        return self._endpoint.path
+
+    @property
+    def instance_ids(self) -> List[int]:
+        return sorted(self._instances)
+
+    def set_kv_picker(self, picker) -> None:
+        self._kv_picker = picker
+
+    async def start(self) -> None:
+        prefix = instance_prefix(
+            self._endpoint.namespace, self._endpoint.component, self._endpoint.name
+        )
+        watch = self._runtime.discovery.watch(prefix)
+        self._watch = watch
+
+        async def _run() -> None:
+            async for event in watch:
+                if event.kind == EventKind.PUT and event.value is not None:
+                    inst = Instance.from_dict(event.value)
+                    self._instances[inst.instance_id] = inst
+                    self._instances_nonempty.set()
+                elif event.kind == EventKind.DELETE:
+                    iid = _instance_id_from_key(event.key)
+                    if iid is not None:
+                        self._instances.pop(iid, None)
+                    if not self._instances:
+                        self._instances_nonempty.clear()
+
+        self._watch_task = asyncio.get_running_loop().create_task(
+            _run(), name=f"client-watch:{self.endpoint_path}"
+        )
+        # Give the snapshot a chance to land so the first request can route.
+        snapshot = await self._runtime.discovery.get_prefix(prefix)
+        for value in snapshot.values():
+            inst = Instance.from_dict(value)
+            self._instances[inst.instance_id] = inst
+        if self._instances:
+            self._instances_nonempty.set()
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> List[int]:
+        await asyncio.wait_for(self._instances_nonempty.wait(), timeout=timeout)
+        return self.instance_ids
+
+    async def close(self) -> None:
+        if self._watch is not None:
+            await self._watch.aclose()
+            self._watch = None
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            try:
+                await self._watch_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._watch_task = None
+
+    # -- routing ----------------------------------------------------------
+
+    async def _pick(self, request: Any, instance_id: Optional[int]) -> Instance:
+        if not self._instances:
+            raise NoInstancesError(self.endpoint_path)
+        if instance_id is not None:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise NoInstancesError(
+                    f"{self.endpoint_path} instance {instance_id:#x} not found"
+                )
+            return inst
+        ids = sorted(self._instances)
+        if self.router_mode == RouterMode.RANDOM:
+            return self._instances[random.choice(ids)]
+        if self.router_mode == RouterMode.KV and self._kv_picker is not None:
+            chosen = await self._kv_picker(request, dict(self._instances))
+            if chosen is not None and chosen in self._instances:
+                return self._instances[chosen]
+        # Round-robin default (also KV fallback when picker abstains).
+        self._rr_index = (self._rr_index + 1) % len(ids)
+        return self._instances[ids[self._rr_index]]
+
+    def generate(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        *,
+        instance_id: Optional[int] = None,
+    ) -> AsyncIterator[Any]:
+        ctx = context or Context()
+        return self._generate(request, ctx, instance_id)
+
+    async def _generate(
+        self, request: Any, context: Context, instance_id: Optional[int]
+    ) -> AsyncIterator[Any]:
+        instance = await self._pick(request, instance_id)
+        remote = self._runtime.request_plane_client(instance)
+        async for item in remote.generate(request, context):
+            yield item
+
+    def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):
+        """Route to a specific instance (RouterMode::Direct)."""
+        return self.generate(request, context, instance_id=instance_id)
+
+
+class NoInstancesError(RuntimeError):
+    """No live instances for an endpoint (ref: 'no responders' NATS error —
+    the trigger for migration, migration.rs:24)."""
+
+
+def _instance_id_from_key(key: str) -> Optional[int]:
+    try:
+        return int(key.rsplit("/", 1)[1], 16)
+    except (IndexError, ValueError):
+        return None

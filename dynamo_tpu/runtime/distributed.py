@@ -1,0 +1,245 @@
+"""DistributedRuntime: the root handle tying planes together.
+
+Reference parity: lib/runtime/src/distributed.rs:42 (DistributedRuntime),
+:592 (process-local test mode), :610 (RequestPlaneMode). A runtime owns:
+
+  - the **discovery plane** (instance/model registration + watch, leases),
+  - the **request plane** (request/response streaming to instances),
+  - the **event plane** (pub/sub for KV events and load metrics),
+  - the set of locally served endpoints and their in-flight task trackers.
+
+Modes:
+  - ``DistributedRuntime.process_local(bus=...)`` — everything in-memory; N
+    runtimes in one process sharing a bus emulate a cluster (test backbone,
+    ref: distributed.rs:592 create_test_drt).
+  - ``DistributedRuntime.detached()`` — single process, no sharing.
+  - TCP/file modes are wired by dynamo_tpu.runtime.network (request plane) and
+    runtime.discovery backends (file / discd service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.runtime.component import (
+    Endpoint,
+    Instance,
+    Namespace,
+    ServedEndpoint,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import DiscoveryBackend, Lease, MemoryDiscovery
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.events import EventPlane, MemoryEventPlane
+from dynamo_tpu.runtime.tasks import TaskTracker
+
+from dynamo_tpu import config
+
+logger = logging.getLogger(__name__)
+
+
+class LocalRequestPlane:
+    """In-process request plane: client calls the engine directly.
+
+    Shared per-bus so multiple runtimes in one process reach each other's
+    engines (the process-local analogue of the TCP request plane)."""
+
+    _buses: Dict[str, Dict[str, AsyncEngine]] = {}
+
+    def __init__(self, bus: str = "default") -> None:
+        self.bus = bus
+        self._engines = self._buses.setdefault(bus, {})
+
+    @classmethod
+    def reset(cls, bus: Optional[str] = None) -> None:
+        if bus is None:
+            cls._buses.clear()
+        else:
+            cls._buses.pop(bus, None)
+
+    async def serve(self, instance: Instance, engine: AsyncEngine, tracker: TaskTracker) -> Dict[str, Any]:
+        self._engines[instance.key] = _TrackedEngine(engine, tracker)
+        return {"kind": "local", "bus": self.bus, "key": instance.key}
+
+    async def unserve(self, instance: Instance) -> None:
+        self._engines.pop(instance.key, None)
+
+    def client_for(self, instance: Instance) -> AsyncEngine:
+        engines = self._buses.get(instance.transport.get("bus", self.bus), {})
+        engine = engines.get(instance.transport.get("key", instance.key))
+        if engine is None:
+            from dynamo_tpu.runtime.component import NoInstancesError
+
+            raise NoInstancesError(f"local engine gone: {instance.key}")
+        return engine
+
+    async def close(self) -> None:
+        pass
+
+
+class _TrackedEngine:
+    """Wraps a served engine so in-flight streams register with the tracker
+    (draining support) and refuse new work once draining."""
+
+    def __init__(self, engine: AsyncEngine, tracker: TaskTracker) -> None:
+        self._engine = engine
+        self._tracker = tracker
+
+    async def generate(self, request: Any, context: Context):
+        if self._tracker.draining:
+            from dynamo_tpu.runtime.component import NoInstancesError
+
+            raise NoInstancesError("endpoint draining")
+        with self._tracker.guard():
+            async for item in self._engine.generate(request, context):
+                yield item
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        *,
+        discovery: Optional[DiscoveryBackend] = None,
+        request_plane: Optional[Any] = None,
+        event_plane: Optional[EventPlane] = None,
+        bus: str = "default",
+    ) -> None:
+        self.bus = bus
+        self.discovery: DiscoveryBackend = discovery or MemoryDiscovery.shared(bus)
+        self.request_plane = request_plane or LocalRequestPlane(bus)
+        self.event_plane: EventPlane = event_plane or MemoryEventPlane.shared(bus)
+        self.tracker = TaskTracker("runtime")
+        self._served: Dict[str, ServedEndpoint] = {}
+        self._serve_trackers: Dict[str, TaskTracker] = {}
+        self._lease: Optional[Lease] = None
+        self._shutdown = asyncio.Event()
+        self._extra_planes: list = []
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def process_local(cls, bus: str = "default") -> "DistributedRuntime":
+        return cls(bus=bus)
+
+    @classmethod
+    def detached(cls) -> "DistributedRuntime":
+        bus = f"detached-{random.getrandbits(32):08x}"
+        return cls(
+            discovery=MemoryDiscovery(),
+            request_plane=LocalRequestPlane(bus),
+            event_plane=MemoryEventPlane(),
+            bus=bus,
+        )
+
+    # -- naming ------------------------------------------------------------
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    # -- serving -----------------------------------------------------------
+
+    async def _lease_for_serving(self) -> Lease:
+        if self._lease is None:
+            self._lease = await self.discovery.create_lease(config.LEASE_TTL.get())
+            keep_alive = getattr(self.discovery, "keep_alive", None)
+            if keep_alive is not None:
+                self.tracker.spawn(
+                    self._keep_alive_loop(keep_alive), name="lease-keepalive", critical=True
+                )
+        return self._lease
+
+    async def _keep_alive_loop(self, keep_alive) -> None:
+        assert self._lease is not None
+        interval = max(0.5, self._lease.ttl / 3.0)
+        while not self._shutdown.is_set():
+            await asyncio.sleep(interval)
+            try:
+                await keep_alive(self._lease)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # pragma: no cover - backend hiccups
+                logger.warning("lease keep-alive failed: %r", exc)
+
+    async def _serve(
+        self,
+        endpoint: Endpoint,
+        engine: AsyncEngine,
+        *,
+        instance_id: Optional[int],
+        metadata: Dict[str, Any],
+    ) -> ServedEndpoint:
+        iid = instance_id if instance_id is not None else random.getrandbits(63)
+        instance = Instance(
+            namespace=endpoint.namespace,
+            component=endpoint.component,
+            endpoint=endpoint.name,
+            instance_id=iid,
+            transport={},
+            metadata=metadata,
+        )
+        tracker = TaskTracker(f"endpoint:{endpoint.path}:{iid:x}")
+        transport = await self.request_plane.serve(instance, engine, tracker)
+        instance = Instance(
+            namespace=instance.namespace,
+            component=instance.component,
+            endpoint=instance.endpoint,
+            instance_id=iid,
+            transport=transport,
+            metadata=metadata,
+        )
+        lease = await self._lease_for_serving()
+        await self.discovery.put(instance.key, instance.to_dict(), lease=lease)
+        served = ServedEndpoint(instance=instance, _runtime=self, _engine=engine)
+        self._served[instance.key] = served
+        self._serve_trackers[instance.key] = tracker
+        logger.info("serving %s as instance %x", endpoint.path, iid)
+        return served
+
+    async def _unserve(self, served: ServedEndpoint, grace_period: float = 30.0) -> None:
+        key = served.instance.key
+        # De-register first so routers stop picking us, then drain.
+        await self.discovery.delete(key)
+        tracker = self._serve_trackers.pop(key, None)
+        if tracker is not None:
+            await tracker.drain(grace_period)
+        await self.request_plane.unserve(served.instance)
+        self._served.pop(key, None)
+
+    def request_plane_client(self, instance: Instance) -> AsyncEngine:
+        kind = instance.transport.get("kind", "local")
+        if kind == "local":
+            return self.request_plane.client_for(instance)
+        for plane in self._extra_planes:
+            if plane.kind == kind:
+                return plane.client_for(instance)
+        if kind == "tcp":
+            try:
+                from dynamo_tpu.runtime.network.tcp import TcpRequestPlane
+            except ImportError as exc:
+                raise NotImplementedError(
+                    "tcp request plane not available in this build"
+                ) from exc
+            plane = TcpRequestPlane()
+            self._extra_planes.append(plane)
+            return plane.client_for(instance)
+        raise ValueError(f"unknown transport kind {kind!r} for {instance.key}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def shutdown(self, grace_period: float = 30.0) -> None:
+        """Graceful shutdown: de-register, drain in-flight, release leases
+        (ref: GracefulShutdownTracker lib.rs:58, docs/fault_tolerance/graceful_shutdown.md)."""
+        self._shutdown.set()
+        for served in list(self._served.values()):
+            await self._unserve(served, grace_period=grace_period)
+        if self._lease is not None:
+            await self.discovery.revoke_lease(self._lease)
+            self._lease = None
+        await self.tracker.drain(grace_period)
+        for plane in self._extra_planes:
+            await plane.close()
+        await self.request_plane.close()
+        await self.discovery.close()

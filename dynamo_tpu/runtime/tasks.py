@@ -1,0 +1,121 @@
+"""Task tracking and graceful shutdown.
+
+Reference parity: the graceful-shutdown TaskTracker
+(lib/runtime/src/utils/tasks/tracker.rs) and critical-task supervision
+(utils/tasks/critical.rs). Endpoints register in-flight request tasks here;
+shutdown flips to "draining", stops accepting new work, waits for in-flight
+streams up to a grace period, then cancels stragglers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Coroutine, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+
+class TaskTracker:
+    def __init__(self, name: str = "tracker") -> None:
+        self.name = name
+        self._tasks: Set[asyncio.Task] = set()
+        self._guards = 0
+        self._draining = False
+        self._drained = asyncio.Event()
+        self._drained.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._tasks) + self._guards
+
+    def guard(self) -> "_Guard":
+        """Context manager marking a unit of in-flight work (e.g. a response
+        stream) that drain() must wait for."""
+        if self._draining:
+            raise RuntimeError(f"{self.name}: draining, refusing new work")
+        return _Guard(self)
+
+    def spawn(
+        self,
+        coro: Coroutine[Any, Any, Any],
+        *,
+        name: Optional[str] = None,
+        critical: bool = False,
+        on_failure: Optional[Callable[[BaseException], None]] = None,
+    ) -> asyncio.Task:
+        """Track a task. Critical tasks log at error level when they die
+        unexpectedly and invoke ``on_failure`` (e.g. to trigger shutdown)."""
+        if self._draining:
+            coro.close()
+            raise RuntimeError(f"{self.name}: draining, refusing new task")
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._tasks.add(task)
+        self._drained.clear()
+
+        def _done(t: asyncio.Task) -> None:
+            self._tasks.discard(t)
+            self._maybe_drained()
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                level = logging.ERROR if critical else logging.WARNING
+                logger.log(level, "%s: task %s failed: %r", self.name, t.get_name(), exc)
+                if on_failure is not None:
+                    on_failure(exc)
+
+        task.add_done_callback(_done)
+        return task
+
+    async def drain(self, grace_period: float = 30.0) -> bool:
+        """Stop accepting work; wait for in-flight tasks, cancel stragglers.
+
+        Returns True if everything finished within the grace period."""
+        self._draining = True
+        if not self._tasks and not self._guards:
+            return True
+        try:
+            await asyncio.wait_for(self._drained.wait(), timeout=grace_period)
+            return True
+        except asyncio.TimeoutError:
+            logger.warning(
+                "%s: %d tasks still running after %.1fs grace, cancelling",
+                self.name,
+                len(self._tasks),
+                grace_period,
+            )
+            for t in list(self._tasks):
+                t.cancel()
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+            return False
+
+    def cancel_all(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+
+    def _maybe_drained(self) -> None:
+        if not self._tasks and not self._guards:
+            self._drained.set()
+
+
+class _Guard:
+    def __init__(self, tracker: TaskTracker) -> None:
+        self._tracker = tracker
+        self._active = False
+
+    def __enter__(self) -> "_Guard":
+        self._tracker._guards += 1
+        self._tracker._drained.clear()
+        self._active = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._active:
+            self._active = False
+            self._tracker._guards -= 1
+            self._tracker._maybe_drained()

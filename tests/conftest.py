@@ -1,0 +1,51 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device mesh so sharding logic is exercised
+without TPU hardware (the driver separately dry-runs multi-chip via
+__graft_entry__.dryrun_multichip). Set DYN_TPU_TEST_TPU=1 to run on the real
+chip instead.
+"""
+
+import asyncio
+import functools
+import inspect
+import os
+
+if os.environ.get("DYN_TPU_TEST_TPU") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    # Support plain `async def test_*` without pytest-asyncio (not installed
+    # in this environment): wrap them in asyncio.run.
+    for item in items:
+        if isinstance(item, pytest.Function) and inspect.iscoroutinefunction(item.obj):
+            item.obj = _sync_wrapper(item.obj)
+
+
+def _sync_wrapper(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=120))
+
+    return wrapper
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_local_buses():
+    """Isolate process-local runtime state between tests."""
+    yield
+    from dynamo_tpu.runtime.discovery import MemoryDiscovery
+    from dynamo_tpu.runtime.distributed import LocalRequestPlane
+    from dynamo_tpu.runtime.events import MemoryEventPlane
+
+    MemoryDiscovery.reset()
+    LocalRequestPlane.reset()
+    MemoryEventPlane.reset()

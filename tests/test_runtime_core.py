@@ -1,0 +1,269 @@
+"""Runtime core: contexts, engines, pipelines, components, process-local DRT.
+
+Mirrors the reference's in-process runtime tests (lib/runtime/src/distributed.rs
+create_test_drt_async; component/endpoint round-trips).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    MapStreamOperator,
+    NoInstancesError,
+    PassthroughOperator,
+    RouterMode,
+    TaskTracker,
+    as_engine,
+    build_pipeline,
+    collect,
+)
+
+
+async def echo_handler(request):
+    for token in request["tokens"]:
+        yield {"token": token}
+
+
+async def test_fn_engine_stream():
+    engine = as_engine(echo_handler)
+    out = await collect(engine.generate({"tokens": [1, 2, 3]}, Context()))
+    assert [o["token"] for o in out] == [1, 2, 3]
+
+
+async def test_unary_handler_wrapped():
+    async def unary(request):
+        return {"sum": sum(request["tokens"])}
+
+    engine = as_engine(unary)
+    out = await collect(engine.generate({"tokens": [1, 2, 3]}, Context()))
+    assert out == [{"sum": 6}]
+
+
+async def test_handler_with_context():
+    async def handler(request, context):
+        for t in request["tokens"]:
+            if context.stopped:
+                return
+            yield t
+
+    engine = as_engine(handler)
+    ctx = Context()
+    stream = engine.generate({"tokens": list(range(100))}, ctx)
+    got = []
+    async for t in stream:
+        got.append(t)
+        if len(got) == 3:
+            ctx.stop_generating()
+    assert len(got) == 3
+
+
+def test_context_tree_propagation():
+    async def main():
+        parent = Context()
+        child = parent.child()
+        grandchild = child.child()
+        parent.stop_generating(reason="test")
+        assert child.stopped and grandchild.stopped
+        assert grandchild.stop_reason == "test"
+        assert not child.killed
+        parent.kill()
+        assert grandchild.killed
+
+    asyncio.run(main())
+
+
+def test_child_of_stopped_parent_starts_stopped():
+    async def main():
+        parent = Context()
+        parent.stop_generating()
+        assert parent.child().stopped
+
+    asyncio.run(main())
+
+
+async def test_pipeline_composition():
+    ops = [PassthroughOperator(), MapStreamOperator(lambda x: x * 10)]
+
+    async def inner(request):
+        for t in request["tokens"]:
+            yield t
+
+    pipeline = build_pipeline(ops, inner)
+    out = await collect(pipeline.generate({"tokens": [1, 2]}, Context()))
+    assert out == [10, 20]
+
+
+async def test_serve_and_call_endpoint():
+    drt = DistributedRuntime.detached()
+    endpoint = drt.namespace("test").component("worker").endpoint("generate")
+    await endpoint.serve_endpoint(echo_handler)
+    client = await endpoint.client()
+    await client.wait_for_instances(timeout=2)
+    out = await collect(client.generate({"tokens": [7, 8]}))
+    assert [o["token"] for o in out] == [7, 8]
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_two_runtimes_share_bus():
+    server = DistributedRuntime.process_local(bus="t2")
+    client_rt = DistributedRuntime.process_local(bus="t2")
+    ep = server.namespace("ns").component("w").endpoint("gen")
+    await ep.serve_endpoint(echo_handler)
+    client = await client_rt.namespace("ns").component("w").endpoint("gen").client()
+    await client.wait_for_instances(timeout=2)
+    out = await collect(client.generate({"tokens": [1]}))
+    assert out == [{"token": 1}]
+    await client.close()
+    await server.shutdown(grace_period=1)
+    await client_rt.shutdown(grace_period=1)
+
+
+async def test_round_robin_across_instances():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ns").component("w").endpoint("gen")
+
+    def make_handler(wid):
+        async def handler(request):
+            yield {"worker": wid}
+
+        return handler
+
+    await ep.serve_endpoint(make_handler(0), instance_id=0)
+    await ep.serve_endpoint(make_handler(1), instance_id=1)
+    client = await ep.client(RouterMode.ROUND_ROBIN)
+    await client.wait_for_instances(timeout=2)
+    seen = set()
+    for _ in range(4):
+        out = await collect(client.generate({}))
+        seen.add(out[0]["worker"])
+    assert seen == {0, 1}
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_direct_routing():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ns").component("w").endpoint("gen")
+
+    async def handler(request):
+        yield {"ok": True}
+
+    await ep.serve_endpoint(handler, instance_id=42)
+    client = await ep.client(RouterMode.DIRECT)
+    await client.wait_for_instances(timeout=2)
+    out = await collect(client.generate({}, instance_id=42))
+    assert out == [{"ok": True}]
+    with pytest.raises(NoInstancesError):
+        await collect(client.generate({}, instance_id=99))
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_instance_removed_on_shutdown():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ns").component("w").endpoint("gen")
+
+    async def handler(request):
+        yield 1
+
+    served = await ep.serve_endpoint(handler)
+    client = await ep.client()
+    await client.wait_for_instances(timeout=2)
+    await served.shutdown(grace_period=1)
+    await asyncio.sleep(0.05)
+    assert client.instance_ids == []
+    with pytest.raises(NoInstancesError):
+        await collect(client.generate({}))
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_watch_sees_new_instances():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ns").component("w").endpoint("gen")
+    client = await ep.client()
+    assert client.instance_ids == []
+
+    async def handler(request):
+        yield 1
+
+    await ep.serve_endpoint(handler, instance_id=5)
+    ids = await client.wait_for_instances(timeout=2)
+    assert ids == [5]
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_tracker_drain_waits_for_guards():
+    tracker = TaskTracker("t")
+    release = asyncio.Event()
+    started = asyncio.Event()
+
+    async def work():
+        with tracker.guard():
+            started.set()
+            await release.wait()
+
+    task = asyncio.get_running_loop().create_task(work())
+    await started.wait()
+    assert tracker.in_flight == 1
+    drain_task = asyncio.get_running_loop().create_task(tracker.drain(grace_period=5))
+    await asyncio.sleep(0.01)
+    assert not drain_task.done()
+    release.set()
+    assert await drain_task is True
+    await task
+    with pytest.raises(RuntimeError):
+        tracker.guard()
+
+
+async def test_draining_endpoint_refuses_new_requests():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ns").component("w").endpoint("gen")
+    release = asyncio.Event()
+    entered = asyncio.Event()
+
+    async def handler(request):
+        entered.set()
+        await release.wait()
+        yield {"done": True}
+
+    served = await ep.serve_endpoint(handler)
+    client = await ep.client()
+    await client.wait_for_instances(timeout=2)
+
+    async def consume():
+        return await collect(client.generate({}))
+
+    inflight = asyncio.get_running_loop().create_task(consume())
+    await entered.wait()
+    shutdown = asyncio.get_running_loop().create_task(served.shutdown(grace_period=5))
+    await asyncio.sleep(0.05)
+    release.set()
+    assert await inflight == [{"done": True}]
+    await shutdown
+    await client.close()
+    await drt.shutdown(grace_period=1)
+
+
+async def test_deadline_wakes_waiters():
+    import time
+
+    ctx = Context(deadline=time.monotonic() + 0.05)
+    await asyncio.wait_for(ctx.wait_stopped(), timeout=2)
+    assert ctx.stop_reason == "deadline"
+
+
+async def test_event_plane_pubsub():
+    drt = DistributedRuntime.detached()
+    sub = drt.event_plane.subscribe("kv.>")
+    await drt.event_plane.publish("kv.worker1", {"blocks": [1, 2]})
+    await drt.event_plane.publish("other.topic", {"x": 1})
+    topic, payload = await sub.get(timeout=2)
+    assert topic == "kv.worker1" and payload == {"blocks": [1, 2]}
+    await sub.aclose()
+    await drt.shutdown(grace_period=1)
