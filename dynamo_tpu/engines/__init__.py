@@ -1,0 +1,1 @@
+"""Inference engines: mock (CPU, deterministic) and the native JAX engine."""

@@ -1,0 +1,6 @@
+"""Mock engine (ref: lib/mocker)."""
+
+from dynamo_tpu.engines.mock.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.engines.mock.kv_manager import KvEvent, KvManager
+
+__all__ = ["KvEvent", "KvManager", "MockEngine", "MockEngineArgs"]

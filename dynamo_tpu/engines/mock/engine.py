@@ -1,0 +1,302 @@
+"""The mock engine: a deterministic fake worker for accelerator-free testing.
+
+Reference parity: lib/mocker — continuous-batching Scheduler (scheduler.rs:248),
+KvManager with prefix caching (kv_manager.rs:50), learned timing
+(perf_model.rs), KV-event emission, MockEngineArgs (protocols.rs:88). This is
+the centerpiece that lets router/disagg/planner e2e tests run whole clusters
+on CPU (SURVEY §4).
+
+Semantics:
+  - requests enter a waiting queue; the scheduler admits them when the KV
+    pool fits their prompt blocks (watermark-gated), honoring max_num_seqs;
+  - prefill cost = base + per-token (scaled by speedup_ratio); prefix-cached
+    blocks are skipped, exactly like a real paged engine;
+  - each decode tick appends one token per running sequence with a simulated
+    inter-token latency;
+  - generated tokens are a deterministic PRNG stream seeded by the prompt, so
+    tests can assert reproducibility;
+  - KV events (stored/removed) are emitted for router indexing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from dynamo_tpu.engines.mock.kv_manager import KvEvent, KvManager
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class MockEngineArgs:
+    """(ref: lib/mocker/src/protocols.rs:88 MockEngineArgs)"""
+
+    block_size: int = 16
+    num_kv_blocks: int = 1024
+    max_num_seqs: int = 32
+    watermark: float = 0.01  # fraction of blocks kept free
+    speedup_ratio: float = 1.0  # >1 = faster than the modeled timings
+    dp_size: int = 1
+    vocab_size: int = 512
+    enable_prefix_caching: bool = True
+    # Timing model (seconds), loosely A100-class (ref: perf_model.rs)
+    prefill_base_s: float = 0.02
+    prefill_per_token_s: float = 0.00005
+    decode_itl_s: float = 0.01
+    # Echo mode: emit the prompt tokens back instead of PRNG tokens
+    echo: bool = False
+
+
+@dataclass
+class _Sequence:
+    request: PreprocessedRequest
+    context: Context
+    queue: "asyncio.Queue[Optional[BackendOutput]]"
+    prompt_hashes: List[int]
+    all_tokens: List[int]  # prompt + generated
+    generated: List[int] = field(default_factory=list)
+    held_hashes: List[int] = field(default_factory=list)
+    prefilled: bool = False
+    rng_state: int = 0
+
+
+class MockEngine:
+    """AsyncEngine over a simulated continuous-batching scheduler."""
+
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        *,
+        on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+    ) -> None:
+        self.args = args or MockEngineArgs()
+        self.kv = KvManager(
+            self.args.num_kv_blocks, self.args.block_size, on_event=on_kv_event
+        )
+        self._waiting: "asyncio.Queue[_Sequence]" = asyncio.Queue()
+        self._running: List[_Sequence] = []
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+        self._wake = asyncio.Event()
+        self.steps = 0  # decode iterations executed (test observability)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._scheduler_loop(), name="mock-engine-scheduler"
+            )
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        if self._loop_task is not None:
+            await self._loop_task
+            self._loop_task = None
+
+    # -- AsyncEngine -------------------------------------------------------
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        await self.start()
+        if isinstance(request, dict):
+            request = PreprocessedRequest.from_dict(request)
+        prompt = list(request.token_ids)
+        seq = _Sequence(
+            request=request,
+            context=context,
+            queue=asyncio.Queue(),
+            prompt_hashes=compute_block_hashes(prompt, self.args.block_size)
+            if self.args.enable_prefix_caching
+            else [],
+            all_tokens=prompt,
+            rng_state=int.from_bytes(
+                hashlib.blake2b(
+                    b"".join(t.to_bytes(4, "little") for t in prompt), digest_size=8
+                ).digest(),
+                "little",
+            ),
+        )
+        await self._waiting.put(seq)
+        self._wake.set()
+        while True:
+            out = await seq.queue.get()
+            if out is None:
+                return
+            yield out
+            if out.finish_reason is not None:
+                return
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _sleep_time(self, seconds: float) -> float:
+        return seconds / max(self.args.speedup_ratio, 1e-9)
+
+    def _requeue(self, seq: _Sequence) -> None:
+        requeue: "asyncio.Queue[_Sequence]" = asyncio.Queue()
+        requeue.put_nowait(seq)
+        while not self._waiting.empty():
+            requeue.put_nowait(self._waiting.get_nowait())
+        self._waiting = requeue
+
+    async def _scheduler_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                await self._scheduler_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # never let a bug kill the scheduler
+                logger.exception("mock scheduler tick failed")
+                await asyncio.sleep(self._sleep_time(self.args.decode_itl_s))
+
+        # Drain on stop.
+        for seq in self._running:
+            seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
+        self._running.clear()
+
+    async def _scheduler_tick(self) -> None:
+        args = self.args
+        watermark_blocks = int(args.num_kv_blocks * args.watermark)
+        # Admit waiting sequences (continuous batching admission). The
+        # watermark keeps headroom for decode growth; it is waived when the
+        # engine is idle so an admissible request is never deadlocked.
+        while len(self._running) < args.max_num_seqs and not self._waiting.empty():
+            seq = self._waiting.get_nowait()
+            if seq.context.stopped:
+                seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
+                continue
+            if seq.prompt_hashes:
+                if len(seq.prompt_hashes) > args.num_kv_blocks:
+                    seq.queue.put_nowait(
+                        BackendOutput(
+                            error=(
+                                f"prompt needs {len(seq.prompt_hashes)} KV blocks; "
+                                f"pool has {args.num_kv_blocks}"
+                            ),
+                            finish_reason=FinishReason.ERROR,
+                        )
+                    )
+                    continue
+                headroom = watermark_blocks if self._running else 0
+                if not self.kv.can_allocate(seq.prompt_hashes, extra_blocks=headroom):
+                    self._requeue(seq)
+                    break
+                result = self.kv.allocate(seq.prompt_hashes)
+                if result is None:
+                    self._requeue(seq)
+                    break
+                matched = result
+                seq.held_hashes = list(seq.prompt_hashes)
+            else:
+                matched = 0
+            # Simulate prefill (skipping cached prefix).
+            new_tokens = max(0, len(seq.request.token_ids) - matched * args.block_size)
+            await asyncio.sleep(
+                self._sleep_time(args.prefill_base_s + args.prefill_per_token_s * new_tokens)
+            )
+            seq.prefilled = True
+            self._running.append(seq)
+
+        if not self._running:
+            # Idle (or blocked on KV space): wait for a wake-up or tick.
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+            except asyncio.TimeoutError:
+                pass
+            return
+
+        # One decode tick for the whole batch.
+        await asyncio.sleep(self._sleep_time(args.decode_itl_s))
+        self.steps += 1
+        still_running: List[_Sequence] = []
+        for seq in self._running:
+            finished = self._decode_step(seq)
+            if not finished:
+                still_running.append(seq)
+        self._running = still_running
+
+    def _decode_step(self, seq: _Sequence) -> bool:
+        """Generate one token; returns True when the sequence finished."""
+        if seq.context.stopped:
+            self._finish(seq, FinishReason.CANCELLED)
+            return True
+        token = self._next_token(seq)
+        seq.generated.append(token)
+        seq.all_tokens.append(token)
+
+        # Grow the KV chain when a block boundary is crossed.
+        if (
+            self.args.enable_prefix_caching
+            and len(seq.all_tokens) % self.args.block_size == 0
+        ):
+            new_hashes = compute_block_hashes(
+                seq.all_tokens[-self.args.block_size :],
+                self.args.block_size,
+                parent_hash=seq.held_hashes[-1] if seq.held_hashes else None,
+            )
+            if new_hashes and self.kv.extend(
+                seq.held_hashes[-1] if seq.held_hashes else None, new_hashes[0]
+            ):
+                seq.held_hashes.extend(new_hashes)
+
+        stop = seq.request.stop
+        reason: Optional[FinishReason] = None
+        min_ok = stop.min_tokens is None or len(seq.generated) >= stop.min_tokens
+        if (
+            not stop.ignore_eos
+            and min_ok
+            and token in (seq.request.eos_token_ids or [])
+        ):
+            reason = FinishReason.EOS
+        elif min_ok and token in (stop.stop_token_ids or []):
+            reason = FinishReason.STOP
+        elif stop.max_tokens is not None and len(seq.generated) >= stop.max_tokens:
+            reason = FinishReason.LENGTH
+
+        seq.queue.put_nowait(
+            BackendOutput(
+                token_ids=[token],
+                finish_reason=reason,
+                cumulative_tokens=len(seq.generated),
+            )
+        )
+        if reason is not None:
+            self._finish(seq, reason, emit=False)
+            return True
+        return False
+
+    def _next_token(self, seq: _Sequence) -> int:
+        if self.args.echo:
+            idx = len(seq.generated) % len(seq.request.token_ids)
+            return seq.request.token_ids[idx]
+        # xorshift64* PRNG: deterministic per prompt.
+        x = seq.rng_state or 0x9E3779B97F4A7C15
+        x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 7
+        x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
+        seq.rng_state = x
+        # Avoid emitting special/eos tokens (ids 0..3 in the tiny tokenizer).
+        return 4 + (x % (self.args.vocab_size - 4))
+
+    def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
+        if seq.held_hashes:
+            self.kv.release(seq.held_hashes)
+            seq.held_hashes = []
+        if emit:
+            seq.queue.put_nowait(BackendOutput(finish_reason=reason))

@@ -1,0 +1,181 @@
+"""Block-granular KV manager with prefix caching and LRU eviction.
+
+Reference parity: lib/mocker/src/kv_manager.rs:50 (KvManager) and
+evictor.rs. Blocks live in three states: free, active (pinned by a running
+sequence), or inactive (cached, evictable LRU). Prefix caching matches a new
+request's chained block hashes against active+inactive blocks; matched
+inactive blocks are re-activated without recompute.
+
+Emits KV events (stored/removed) through a callback — the same event stream
+real engines publish for the KV-aware router (ref: kv-event emission in
+mocker + kv_router/publisher.rs).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+
+@dataclass
+class KvEvent:
+    kind: str  # "stored" | "removed" | "cleared"
+    block_hashes: List[int] = field(default_factory=list)
+    parent_hash: Optional[int] = None
+
+
+EventCallback = Callable[[KvEvent], None]
+
+
+@dataclass
+class _Block:
+    block_hash: int
+    parent_hash: Optional[int]
+    ref_count: int = 0
+
+
+class KvManager:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        *,
+        on_event: Optional[EventCallback] = None,
+    ) -> None:
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._on_event = on_event
+        self._blocks: Dict[int, _Block] = {}  # hash → block (active or cached)
+        self._inactive: "OrderedDict[int, _Block]" = OrderedDict()  # LRU order
+        self._used = 0  # count of distinct resident blocks
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return self.num_blocks - self._used + len(self._inactive)
+
+    @property
+    def active_blocks(self) -> int:
+        return self._used - len(self._inactive)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._inactive)
+
+    @property
+    def usage(self) -> float:
+        return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
+
+    # -- prefix matching ---------------------------------------------------
+
+    def match_prefix(self, block_hashes: Sequence[int]) -> int:
+        """Leading blocks already resident (active or cached)."""
+        n = 0
+        for h in block_hashes:
+            if h in self._blocks:
+                n += 1
+            else:
+                break
+        return n
+
+    # -- allocation --------------------------------------------------------
+
+    def _available_for(self, block_hashes: Sequence[int], matched: int) -> int:
+        """Blocks obtainable for NEW allocations given that the matched prefix
+        gets pinned (pinning removes matched-inactive blocks from the
+        evictable set, so they must not be counted as free)."""
+        matched_inactive = sum(1 for h in block_hashes[:matched] if h in self._inactive)
+        return (self.num_blocks - self._used) + (len(self._inactive) - matched_inactive)
+
+    def can_allocate(self, block_hashes: Sequence[int], extra_blocks: int = 0) -> bool:
+        matched = self.match_prefix(block_hashes)
+        needed = len(block_hashes) - matched + extra_blocks
+        return needed <= self._available_for(block_hashes, matched)
+
+    def allocate(self, block_hashes: Sequence[int]) -> Optional[int]:
+        """Pin the chain for a sequence. Returns matched-prefix block count,
+        or None if pool can't fit (caller keeps the request queued)."""
+        matched = self.match_prefix(block_hashes)
+        needed = len(block_hashes) - matched
+        if needed > self._available_for(block_hashes, matched):
+            return None
+        # Reactivate / pin matched prefix.
+        for h in block_hashes[:matched]:
+            block = self._blocks[h]
+            if block.ref_count == 0:
+                self._inactive.pop(h, None)
+            block.ref_count += 1
+        # Allocate the rest, evicting LRU cached blocks as needed.
+        parent = block_hashes[matched - 1] if matched else None
+        new_hashes: List[int] = []
+        for h in block_hashes[matched:]:
+            if self._used >= self.num_blocks:
+                self._evict_one()
+            block = _Block(block_hash=h, parent_hash=parent, ref_count=1)
+            self._blocks[h] = block
+            self._used += 1
+            new_hashes.append(h)
+            parent = h
+        if new_hashes:
+            self._emit(
+                KvEvent(
+                    kind="stored",
+                    block_hashes=new_hashes,
+                    parent_hash=block_hashes[matched - 1] if matched else None,
+                )
+            )
+        return matched
+
+    def extend(self, prev_hash: Optional[int], new_hash: int) -> bool:
+        """Add one decode-grown block to a running sequence."""
+        if new_hash in self._blocks:
+            block = self._blocks[new_hash]
+            if block.ref_count == 0:
+                self._inactive.pop(new_hash, None)
+            block.ref_count += 1
+            return True
+        if self._used >= self.num_blocks:
+            if not self._inactive:
+                return False
+            self._evict_one()
+        self._blocks[new_hash] = _Block(block_hash=new_hash, parent_hash=prev_hash, ref_count=1)
+        self._used += 1
+        self._emit(KvEvent(kind="stored", block_hashes=[new_hash], parent_hash=prev_hash))
+        return True
+
+    def release(self, block_hashes: Sequence[int]) -> None:
+        """Sequence finished: unpin its chain; blocks become cached (LRU)."""
+        for h in block_hashes:
+            block = self._blocks.get(h)
+            if block is None:
+                continue
+            block.ref_count -= 1
+            if block.ref_count <= 0:
+                block.ref_count = 0
+                self._inactive[h] = block
+                self._inactive.move_to_end(h)
+
+    def clear(self) -> None:
+        """Flush the reusable cache (ref: clear_kv_blocks route)."""
+        evicted = list(self._inactive)
+        for h in evicted:
+            del self._blocks[h]
+            self._used -= 1
+        self._inactive.clear()
+        if evicted:
+            self._emit(KvEvent(kind="removed", block_hashes=evicted))
+        self._emit(KvEvent(kind="cleared"))
+
+    def _evict_one(self) -> None:
+        if not self._inactive:
+            raise RuntimeError("KV pool exhausted with no evictable blocks")
+        h, _ = self._inactive.popitem(last=False)
+        del self._blocks[h]
+        self._used -= 1
+        self._emit(KvEvent(kind="removed", block_hashes=[h]))
+
+    def _emit(self, event: KvEvent) -> None:
+        if self._on_event is not None:
+            self._on_event(event)

@@ -1,0 +1,7 @@
+"""OpenAI-compatible HTTP frontend (ref: lib/llm/src/http)."""
+
+from dynamo_tpu.http.metrics import FrontendMetrics
+from dynamo_tpu.http.model_manager import ModelEntry, ModelManager
+from dynamo_tpu.http.service import HttpService
+
+__all__ = ["FrontendMetrics", "HttpService", "ModelEntry", "ModelManager"]

@@ -1,0 +1,113 @@
+"""Frontend request metrics.
+
+Reference parity: lib/llm/src/http/service/metrics.rs (request counters,
+TTFT/ITL/duration histograms, in-flight gauges) with the canonical naming
+scheme of lib/runtime/src/metrics/prometheus_names.rs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_SECONDS_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
+)
+
+
+class FrontendMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_tpu_frontend"
+        self.requests_total = Counter(
+            f"{ns}_requests_total",
+            "HTTP requests by model/endpoint/status",
+            ["model", "endpoint", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{ns}_inflight_requests",
+            "Currently executing requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            f"{ns}_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            buckets=_SECONDS_BUCKETS,
+            registry=self.registry,
+        )
+        self.ttft = Histogram(
+            f"{ns}_time_to_first_token_seconds",
+            "Time to first token (streaming requests)",
+            ["model"],
+            buckets=_SECONDS_BUCKETS,
+            registry=self.registry,
+        )
+        self.itl = Histogram(
+            f"{ns}_inter_token_latency_seconds",
+            "Latency between streamed tokens",
+            ["model"],
+            buckets=_SECONDS_BUCKETS,
+            registry=self.registry,
+        )
+        self.output_tokens = Counter(
+            f"{ns}_output_tokens_total",
+            "Generated tokens",
+            ["model"],
+            registry=self.registry,
+        )
+        self.input_tokens = Counter(
+            f"{ns}_input_tokens_total",
+            "Prompt tokens",
+            ["model"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class RequestTimer:
+    """Per-request observation helper feeding FrontendMetrics."""
+
+    def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str) -> None:
+        self._m = metrics
+        self._model = model
+        self._endpoint = endpoint
+        self._start = time.monotonic()
+        self._last_token: Optional[float] = None
+        self._done = False
+        self._m.inflight.labels(model, endpoint).inc()
+
+    def on_token(self, count: int = 1) -> None:
+        now = time.monotonic()
+        if self._last_token is None:
+            self._m.ttft.labels(self._model).observe(now - self._start)
+        else:
+            self._m.itl.labels(self._model).observe(now - self._last_token)
+        self._last_token = now
+        self._m.output_tokens.labels(self._model).inc(count)
+
+    def on_input_tokens(self, count: int) -> None:
+        self._m.input_tokens.labels(self._model).inc(count)
+
+    def done(self, status: int) -> None:
+        if self._done:  # idempotent: double-finish must not skew gauges
+            return
+        self._done = True
+        self._m.inflight.labels(self._model, self._endpoint).dec()
+        self._m.requests_total.labels(self._model, self._endpoint, str(status)).inc()
+        self._m.request_duration.labels(self._model, self._endpoint).observe(
+            time.monotonic() - self._start
+        )

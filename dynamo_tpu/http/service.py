@@ -1,0 +1,333 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Reference parity: lib/llm/src/http/service/{service_v2.rs,openai.rs} — the
+axum server with /v1/chat/completions (:865), /v1/completions (:327),
+/v1/models (:1530), /v1/embeddings (:641), SSE streaming with disconnect
+handling (disconnect.rs), and the system routes /health /live /metrics
+(runtime/src/system_status_server.rs). aiohttp replaces axum (no fastapi in
+this environment; aiohttp's streaming response maps 1:1 onto SSE).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.protocols.common import FinishReason, PostprocessedOutput
+from dynamo_tpu.llm.protocols.openai import (
+    OpenAIError,
+    chat_chunk,
+    chat_completion,
+    completion_chunk,
+    completion_response,
+    gen_id,
+    model_list,
+    usage_block,
+)
+from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
+from dynamo_tpu.http.model_manager import ModelManager
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.tasks import TaskTracker
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    """The frontend server. Construct, then ``await start()`` / ``run()``."""
+
+    def __init__(
+        self,
+        model_manager: Optional[ModelManager] = None,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        metrics: Optional[FrontendMetrics] = None,
+    ) -> None:
+        self.models = model_manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = metrics or FrontendMetrics()
+        self.tracker = TaskTracker("http")
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat_completions)
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_post("/v1/embeddings", self._embeddings)
+        app.router.add_get("/v1/models", self._models_route)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics_route)
+        return app
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> int:
+        """Bind and serve; returns the bound port (useful with port=0)."""
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        sockets = self._site._server.sockets  # type: ignore[union-attr]
+        self.port = sockets[0].getsockname()[1]
+        logger.info("HTTP frontend listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self, grace_period: float = 30.0) -> None:
+        await self.tracker.drain(grace_period)
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- system routes -----------------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy" if len(self.models) else "no_models", "models": self.models.names()}
+        )
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics_route(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def _models_route(self, request: web.Request) -> web.Response:
+        return web.json_response(model_list(self.models.openai_model_list()))
+
+    # -- OpenAI routes -----------------------------------------------------
+
+    async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_generation(request, kind="chat")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_generation(request, kind="completion")
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        body, err = await self._read_json(request)
+        if err is not None:
+            return err
+        model = body.get("model", "")
+        entry = self.models.get(model)
+        if entry is None or entry.card.model_type != "embedding":
+            return _error_response(
+                OpenAIError(f"model '{model}' does not support embeddings", status=404, err_type="not_found_error")
+            )
+        timer = RequestTimer(self.metrics, model, "embeddings")
+        try:
+            ctx = Context()
+            result = None
+            async for item in entry.engine.generate(body, ctx):
+                result = item
+            timer.done(200)
+            return web.json_response(result)
+        except OpenAIError as exc:
+            timer.done(exc.status)
+            return _error_response(exc)
+        except Exception as exc:  # pragma: no cover
+            logger.exception("embeddings failed")
+            timer.done(500)
+            return _error_response(OpenAIError(str(exc), status=500, err_type="internal_error"))
+
+    async def _read_json(self, request: web.Request):
+        try:
+            return await request.json(), None
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None, _error_response(OpenAIError("invalid JSON body"))
+
+    async def _serve_generation(self, request: web.Request, kind: str) -> web.StreamResponse:
+        body, err = await self._read_json(request)
+        if err is not None:
+            return err
+        if not isinstance(body, dict):
+            return _error_response(OpenAIError("request body must be a JSON object"))
+        model = body.get("model", "")
+        entry = self.models.get(model)
+        if entry is None:
+            return _error_response(
+                OpenAIError(f"model '{model}' not found", status=404, err_type="not_found_error")
+            )
+        stream = bool(body.get("stream", False))
+        endpoint = "chat_completions" if kind == "chat" else "completions"
+        timer = RequestTimer(self.metrics, model, endpoint)
+        ctx = Context(baggage={"model": model})
+        try:
+            with self.tracker.guard():
+                if stream:
+                    return await self._stream_response(request, body, entry, ctx, kind, timer)
+                return await self._unary_response(body, entry, ctx, kind, timer)
+        except OpenAIError as exc:
+            timer.done(exc.status)
+            return _error_response(exc)
+        except asyncio.CancelledError:
+            ctx.kill()
+            timer.done(499)
+            raise
+        except Exception as exc:
+            logger.exception("generation failed")
+            timer.done(500)
+            return _error_response(OpenAIError(str(exc), status=500, err_type="internal_error"))
+
+    # -- unary -------------------------------------------------------------
+
+    async def _unary_response(
+        self, body: Dict[str, Any], entry, ctx: Context, kind: str, timer: RequestTimer
+    ) -> web.Response:
+        rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
+        text_parts = []
+        finish: Optional[FinishReason] = None
+        prompt_tokens = 0
+        completion_tokens = 0
+        async for item in entry.engine.generate(body, ctx):
+            if isinstance(item, dict) and item.get("annotation") == "_prompt_tokens":
+                prompt_tokens = item["value"]
+                timer.on_input_tokens(prompt_tokens)
+                continue
+            if isinstance(item, dict):
+                continue  # other annotations are streaming-only
+            out: PostprocessedOutput = item
+            if out.error:
+                raise OpenAIError(out.error, status=500, err_type="internal_error")
+            if out.text:
+                text_parts.append(out.text)
+            if out.token_ids:
+                timer.on_token(len(out.token_ids))
+            completion_tokens = out.cumulative_tokens or completion_tokens
+            if out.finish_reason is not None:
+                finish = out.finish_reason
+        text = "".join(text_parts)
+        usage = usage_block(prompt_tokens, completion_tokens)
+        finish_str = (finish or FinishReason.EOS).to_openai()
+        if kind == "chat":
+            payload = chat_completion(
+                rid, entry.name, content=text, finish_reason=finish_str, usage=usage
+            )
+        else:
+            payload = completion_response(
+                rid, entry.name, text=text, finish_reason=finish_str, usage=usage
+            )
+        timer.done(200)
+        return web.json_response(payload)
+
+    # -- streaming ---------------------------------------------------------
+
+    async def _stream_response(
+        self,
+        request: web.Request,
+        body: Dict[str, Any],
+        entry,
+        ctx: Context,
+        kind: str,
+        timer: RequestTimer,
+    ) -> web.StreamResponse:
+        rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
+        include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+                "X-Request-Id": ctx.id,
+            },
+        )
+        await response.prepare(request)
+
+        prompt_tokens = 0
+        completion_tokens = 0
+        sent_role = False
+        status = 200
+        try:
+            async for item in entry.engine.generate(body, ctx):
+                if isinstance(item, dict) and "annotation" in item:
+                    if item["annotation"] == "_prompt_tokens":
+                        prompt_tokens = item["value"]
+                        timer.on_input_tokens(prompt_tokens)
+                    else:
+                        # Public annotations ride as SSE comments (ref:
+                        # preprocessor.rs annotations → SSE comment frames).
+                        await _sse_comment(response, item)
+                    continue
+                out: PostprocessedOutput = item
+                if out.error:
+                    await _sse_send(response, {"error": {"message": out.error, "type": "internal_error"}})
+                    status = 500
+                    break
+                completion_tokens = out.cumulative_tokens or completion_tokens
+                if out.token_ids:
+                    timer.on_token(len(out.token_ids))
+                finish_str = out.finish_reason.to_openai() if out.finish_reason else None
+                if kind == "chat":
+                    delta: Dict[str, Any] = {}
+                    if not sent_role:
+                        delta["role"] = "assistant"
+                        sent_role = True
+                    if out.text:
+                        delta["content"] = out.text
+                    chunk = chat_chunk(rid, entry.name, delta=delta, finish_reason=finish_str)
+                else:
+                    chunk = completion_chunk(rid, entry.name, text=out.text, finish_reason=finish_str)
+                await _sse_send(response, chunk)
+            if include_usage and status == 200:
+                usage = usage_block(prompt_tokens, completion_tokens)
+                if kind == "chat":
+                    final = chat_chunk(rid, entry.name, delta={}, usage=usage)
+                    final["choices"] = []
+                else:
+                    final = completion_chunk(rid, entry.name, text="", usage=usage)
+                    final["choices"] = []
+                await _sse_send(response, final)
+            await _sse_done(response)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: kill the context so the engine frees the slot
+            # (ref: http/service/disconnect.rs).
+            ctx.kill()
+            status = 499
+        except Exception as exc:
+            # Headers already sent: report in-band on the SSE stream; a second
+            # HTTP response is impossible at this point.
+            logger.exception("engine failed mid-stream")
+            status = 500
+            with _suppress_conn_errors():
+                await _sse_send(
+                    response,
+                    {"error": {"message": str(exc), "type": "internal_error"}},
+                )
+        finally:
+            timer.done(status)
+        with _suppress_conn_errors():
+            await response.write_eof()
+        return response
+
+
+def _error_response(exc: OpenAIError) -> web.Response:
+    return web.json_response(exc.to_body(), status=exc.status)
+
+
+async def _sse_send(response: web.StreamResponse, payload: Dict[str, Any]) -> None:
+    await response.write(b"data: " + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n")
+
+
+async def _sse_comment(response: web.StreamResponse, payload: Dict[str, Any]) -> None:
+    await response.write(b": " + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n")
+
+
+async def _sse_done(response: web.StreamResponse) -> None:
+    await response.write(b"data: [DONE]\n\n")
+
+
+class _suppress_conn_errors:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return exc_type is not None and issubclass(
+            exc_type, (ConnectionResetError, ConnectionAbortedError, RuntimeError)
+        )

@@ -1,0 +1,47 @@
+"""LLM serving layer (ref: dynamo-llm crate, lib/llm)."""
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.chat_template import ChatTemplate, DEFAULT_CHAT_TEMPLATE
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig, slugify
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    DisaggregatedParams,
+    FinishReason,
+    PostprocessedOutput,
+    PreprocessedRequest,
+    RequestPhase,
+    RequestTiming,
+    SamplingOptions,
+    StopConditions,
+    TokenLogprob,
+)
+from dynamo_tpu.llm.protocols.openai import OpenAIError, parse_chat_request, parse_completion_request
+from dynamo_tpu.llm.tokenizer import DecodeStream, HFTokenizer, Tokenizer, tiny_tokenizer
+
+__all__ = [
+    "Backend",
+    "BackendOutput",
+    "ChatTemplate",
+    "DEFAULT_CHAT_TEMPLATE",
+    "DecodeStream",
+    "DisaggregatedParams",
+    "FinishReason",
+    "HFTokenizer",
+    "ModelDeploymentCard",
+    "OpenAIError",
+    "OpenAIPreprocessor",
+    "PostprocessedOutput",
+    "PreprocessedRequest",
+    "RequestPhase",
+    "RequestTiming",
+    "RuntimeConfig",
+    "SamplingOptions",
+    "StopConditions",
+    "TokenLogprob",
+    "Tokenizer",
+    "parse_chat_request",
+    "parse_completion_request",
+    "slugify",
+    "tiny_tokenizer",
+]

@@ -1,0 +1,99 @@
+"""Chat-template rendering via jinja2.
+
+Reference parity: lib/llm/src/preprocessor/prompt/template/oai.rs (minijinja
+rendering of HF chat templates). Templates come from the model directory's
+tokenizer_config.json (``chat_template``) or fall back to ChatML.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jinja2
+
+# ChatML (Qwen-style) default — the most common open-model convention.
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] + '<|im_end|>' + '\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}{% endif %}"
+)
+
+
+class ChatTemplate:
+    def __init__(self, template: str = DEFAULT_CHAT_TEMPLATE) -> None:
+        self.source = template
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True,
+            lstrip_blocks=True,
+            # HF templates use .items() etc.; keep default but sandbox-free
+            # since templates come from trusted local model dirs.
+        )
+        env.globals["raise_exception"] = _raise_exception
+        env.filters["tojson"] = lambda value, **kw: json.dumps(value, **kw)
+        self._template = env.from_string(template)
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "ChatTemplate":
+        path = os.path.join(model_dir, "tokenizer_config.json")
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    cfg = json.load(f)
+                tpl = cfg.get("chat_template")
+                if isinstance(tpl, list):
+                    # Newer HF format: [{"name": "default", "template": ...}]
+                    for entry in tpl:
+                        if entry.get("name") == "default":
+                            tpl = entry.get("template")
+                            break
+                    else:
+                        tpl = tpl[0].get("template") if tpl else None
+                if isinstance(tpl, str) and tpl:
+                    return cls(tpl)
+            except (OSError, json.JSONDecodeError):
+                pass
+        chat_path = os.path.join(model_dir, "chat_template.jinja")
+        if os.path.exists(chat_path):
+            with open(chat_path) as f:
+                return cls(f.read())
+        return cls()
+
+    def render(
+        self,
+        messages: List[Dict[str, Any]],
+        *,
+        add_generation_prompt: bool = True,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        bos_token: str = "",
+        eos_token: str = "",
+        **extra: Any,
+    ) -> str:
+        # Flatten OpenAI content-part arrays to text (multimodal parts are
+        # handled upstream by the media preprocessor).
+        normalized = []
+        for msg in messages:
+            msg = dict(msg)
+            content = msg.get("content")
+            if isinstance(content, list):
+                msg["content"] = "".join(
+                    part.get("text", "") for part in content if part.get("type") == "text"
+                )
+            elif content is None:
+                msg["content"] = ""
+            normalized.append(msg)
+        return self._template.render(
+            messages=normalized,
+            add_generation_prompt=add_generation_prompt,
+            tools=tools,
+            bos_token=bos_token,
+            eos_token=eos_token,
+            **extra,
+        )
+
+
+def _raise_exception(message: str) -> None:
+    raise jinja2.TemplateError(message)

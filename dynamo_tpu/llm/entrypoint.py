@@ -1,0 +1,53 @@
+"""Pipeline assembly entrypoints.
+
+Reference parity: lib/llm/src/entrypoint/input/common.rs:173
+(build_routed_pipeline: SegmentSource → OpenAIPreprocessor → Backend →
+Migration → Router) and entrypoint.rs EngineConfig. The local variant wires
+an in-process engine; the routed variant (runtime/network + router tasks)
+inserts Migration and a router client between Backend and the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.chat_template import ChatTemplate
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import HFTokenizer, Tokenizer
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.pipeline import Operator, build_pipeline
+
+
+def resolve_tokenizer(card: ModelDeploymentCard) -> Tokenizer:
+    if card.model_path:
+        return HFTokenizer.from_pretrained_dir(card.model_path)
+    from dynamo_tpu.llm.tokenizer import tiny_tokenizer
+
+    return tiny_tokenizer()
+
+
+def resolve_chat_template(card: ModelDeploymentCard) -> ChatTemplate:
+    if card.chat_template_source:
+        return ChatTemplate(card.chat_template_source)
+    if card.model_path:
+        return ChatTemplate.from_model_dir(card.model_path)
+    return ChatTemplate()
+
+
+def build_local_pipeline(
+    card: ModelDeploymentCard,
+    engine: Any,
+    *,
+    tokenizer: Optional[Tokenizer] = None,
+    extra_operators: Optional[List[Operator]] = None,
+) -> AsyncEngine:
+    """OpenAI dict request → preprocess → [extras] → detokenize → engine."""
+    tokenizer = tokenizer or resolve_tokenizer(card)
+    operators: List[Operator] = [
+        OpenAIPreprocessor(card, tokenizer, resolve_chat_template(card)),
+        Backend(tokenizer),
+    ]
+    operators.extend(extra_operators or [])
+    return build_pipeline(operators, engine)

@@ -1,0 +1,1 @@
+"""Wire protocols (ref: lib/llm/src/protocols)."""

@@ -191,29 +191,29 @@ class Client:
         watch = self._runtime.discovery.watch(prefix)
         self._watch = watch
 
+        def _apply(event) -> None:
+            if event.kind == EventKind.PUT and event.value is not None:
+                inst = Instance.from_dict(event.value)
+                self._instances[inst.instance_id] = inst
+                self._instances_nonempty.set()
+            elif event.kind == EventKind.DELETE:
+                iid = _instance_id_from_key(event.key)
+                if iid is not None:
+                    self._instances.pop(iid, None)
+                if not self._instances:
+                    self._instances_nonempty.clear()
+
+        # Apply the snapshot inline so the first request can route immediately.
+        for event in watch.drain_snapshot():
+            _apply(event)
+
         async def _run() -> None:
             async for event in watch:
-                if event.kind == EventKind.PUT and event.value is not None:
-                    inst = Instance.from_dict(event.value)
-                    self._instances[inst.instance_id] = inst
-                    self._instances_nonempty.set()
-                elif event.kind == EventKind.DELETE:
-                    iid = _instance_id_from_key(event.key)
-                    if iid is not None:
-                        self._instances.pop(iid, None)
-                    if not self._instances:
-                        self._instances_nonempty.clear()
+                _apply(event)
 
         self._watch_task = asyncio.get_running_loop().create_task(
             _run(), name=f"client-watch:{self.endpoint_path}"
         )
-        # Give the snapshot a chance to land so the first request can route.
-        snapshot = await self._runtime.discovery.get_prefix(prefix)
-        for value in snapshot.values():
-            inst = Instance.from_dict(value)
-            self._instances[inst.instance_id] = inst
-        if self._instances:
-            self._instances_nonempty.set()
 
     async def wait_for_instances(self, timeout: float = 10.0) -> List[int]:
         await asyncio.wait_for(self._instances_nonempty.wait(), timeout=timeout)

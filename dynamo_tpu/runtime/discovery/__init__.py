@@ -90,6 +90,14 @@ class Watch:
             raise StopAsyncIteration
         return event
 
+    def drain_snapshot(self) -> List[WatchEvent]:
+        """Synchronously take the initial snapshot (pre-existing keys); the
+        iterator then yields only live events. Lets callers apply the snapshot
+        inline without racing the watch task."""
+        snapshot = self._snapshot
+        self._snapshot = []
+        return snapshot
+
     async def aclose(self) -> None:
         if not self._closed:
             self._closed = True

@@ -117,6 +117,7 @@ class DistributedRuntime:
         self._lease: Optional[Lease] = None
         self._shutdown = asyncio.Event()
         self._extra_planes: list = []
+        self._owns_bus = False
 
     # -- constructors ------------------------------------------------------
 
@@ -127,12 +128,14 @@ class DistributedRuntime:
     @classmethod
     def detached(cls) -> "DistributedRuntime":
         bus = f"detached-{random.getrandbits(32):08x}"
-        return cls(
+        runtime = cls(
             discovery=MemoryDiscovery(),
             request_plane=LocalRequestPlane(bus),
             event_plane=MemoryEventPlane(),
             bus=bus,
         )
+        runtime._owns_bus = True
+        return runtime
 
     # -- naming ------------------------------------------------------------
 
@@ -155,7 +158,13 @@ class DistributedRuntime:
         assert self._lease is not None
         interval = max(0.5, self._lease.ttl / 3.0)
         while not self._shutdown.is_set():
-            await asyncio.sleep(interval)
+            try:
+                # Waiting on the shutdown event (not a bare sleep) lets
+                # shutdown() proceed immediately instead of stalling a tick.
+                await asyncio.wait_for(self._shutdown.wait(), timeout=interval)
+                return
+            except asyncio.TimeoutError:
+                pass
             try:
                 await keep_alive(self._lease)
             except asyncio.CancelledError:
@@ -243,3 +252,5 @@ class DistributedRuntime:
             await plane.close()
         await self.request_plane.close()
         await self.discovery.close()
+        if self._owns_bus:
+            LocalRequestPlane.reset(self.bus)

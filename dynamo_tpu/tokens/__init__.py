@@ -1,0 +1,24 @@
+"""Token-block hashing and radix structures (ref: lib/tokens, lib/kv-router).
+
+Token sequences are chunked into fixed-size blocks; each block gets a
+*chained positional hash* — the hash commits to every token before it, so a
+block hash uniquely identifies a prefix of the sequence. Equal hashes ⇒ equal
+prefixes (modulo 64-bit collisions), which is what makes KV-cache-aware
+routing and prefix reuse work (ref: compute_block_hash_for_seq,
+lib/tokens/src/blocks.rs; lib/llm/src/kv_router.rs:50–56).
+"""
+
+from dynamo_tpu.tokens.blocks import (
+    BLOCK_HASH_SEED,
+    compute_block_hash_for_seq,
+    compute_block_hashes,
+)
+from dynamo_tpu.tokens.radix import OverlapScores, RadixTree
+
+__all__ = [
+    "BLOCK_HASH_SEED",
+    "OverlapScores",
+    "RadixTree",
+    "compute_block_hash_for_seq",
+    "compute_block_hashes",
+]

@@ -1,0 +1,57 @@
+"""Chained positional block hashing.
+
+Reference parity: lib/tokens/src/{lib.rs,blocks.rs} — the reference chains
+blake3 over (parent_hash, token_bytes); we chain xxh3_64 (available here,
+similar speed class) over the same structure. A C++ fast path lives in
+native/ (loaded lazily; Python fallback always available).
+
+Only complete blocks are hashed: a sequence of 150 tokens with block_size 64
+yields 2 hashes covering tokens [0,128). Partial tail blocks are not
+routable/reusable (matches the reference block-granular semantics).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import xxhash
+
+# Seed commits the hash space; mixed into the root so different deployments
+# can salt their hash space (ref: KV event salts in kv_router/publisher.rs).
+BLOCK_HASH_SEED = 0xD1A0_0000_0000_0001
+
+
+def _hash_block(parent_hash: int, tokens: Sequence[int], extra_salt: int = 0) -> int:
+    h = xxhash.xxh3_64(seed=(parent_hash ^ extra_salt) & 0xFFFF_FFFF_FFFF_FFFF)
+    # Fixed-width little-endian encoding; tokens are < 2^32 for any real vocab.
+    h.update(b"".join(int(t).to_bytes(4, "little", signed=False) for t in tokens))
+    return h.intdigest()
+
+
+def compute_block_hashes(
+    tokens: Sequence[int],
+    block_size: int,
+    *,
+    salt: int = 0,
+    parent_hash: Optional[int] = None,
+) -> List[int]:
+    """Hashes for every *complete* block of ``tokens``.
+
+    ``parent_hash`` allows incremental extension: pass the last hash of an
+    already-hashed prefix and only the new tokens.
+    """
+    if block_size <= 0:
+        raise ValueError("block_size must be positive")
+    prev = parent_hash if parent_hash is not None else BLOCK_HASH_SEED
+    out: List[int] = []
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        prev = _hash_block(prev, tokens[start : start + block_size], extra_salt=salt)
+        out.append(prev)
+    return out
+
+
+def compute_block_hash_for_seq(
+    tokens: Sequence[int], block_size: int, *, salt: int = 0
+) -> List[int]:
+    """Reference-named alias (kv_router.rs:50) for compute_block_hashes."""
+    return compute_block_hashes(tokens, block_size, salt=salt)

@@ -1,0 +1,190 @@
+"""E2E: HTTP frontend → preprocessor → detokenizer → mock engine over real
+sockets with SSE streaming (ref: the reference's mocker-based serve tests,
+tests/router/test_router_e2e_with_mockers.py — single-worker slice)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+from dynamo_tpu.llm.entrypoint import build_local_pipeline
+
+
+async def start_service():
+    manager = ModelManager()
+    tok = tiny_tokenizer()
+    card = ModelDeploymentCard(name="mock-model", context_length=512)
+    engine = MockEngine(MockEngineArgs(speedup_ratio=200.0, block_size=4, num_kv_blocks=256))
+    pipeline = build_local_pipeline(card, engine, tokenizer=tok)
+    manager.register("mock-model", pipeline, card)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    return service, engine, port
+
+
+async def test_models_and_health():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"http://127.0.0.1:{port}/v1/models") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["data"][0]["id"] == "mock-model"
+            async with session.get(f"http://127.0.0.1:{port}/health") as resp:
+                assert (await resp.json())["status"] == "healthy"
+            async with session.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                assert "dynamo_tpu_frontend" in await resp.text()
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_chat_completion_unary():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello world"}],
+                    "max_tokens": 8,
+                },
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["object"] == "chat.completion"
+        choice = body["choices"][0]
+        assert choice["message"]["role"] == "assistant"
+        assert isinstance(choice["message"]["content"], str)
+        assert choice["finish_reason"] == "length"
+        assert body["usage"]["completion_tokens"] == 8
+        assert body["usage"]["prompt_tokens"] > 0
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_chat_completion_streaming_sse():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                events = []
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(line[len("data: ") :])
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        finishes = [
+            c["choices"][0]["finish_reason"]
+            for c in chunks
+            if c.get("choices") and c["choices"][0]["finish_reason"]
+        ]
+        assert finishes == ["length"]
+        usage_chunks = [c for c in chunks if c.get("usage")]
+        assert usage_chunks and usage_chunks[-1]["usage"]["completion_tokens"] == 6
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_completions_endpoint():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={"model": "mock-model", "prompt": "the quick brown", "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 4
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_validation_errors():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            url = f"http://127.0.0.1:{port}/v1/chat/completions"
+            async with session.post(url, json={"model": "missing", "messages": [{"role": "user", "content": "x"}]}) as resp:
+                assert resp.status == 404
+                assert "not found" in (await resp.json())["error"]["message"]
+            async with session.post(url, json={"model": "mock-model"}) as resp:
+                assert resp.status == 400
+            async with session.post(url, data=b"not json") as resp:
+                assert resp.status == 400
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_client_disconnect_cancels_engine():
+    service, engine, port = await start_service()
+    try:
+        session = aiohttp.ClientSession()
+        resp = await session.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 100000,
+                "nvext": {"ignore_eos": True},
+                "stream": True,
+            },
+        )
+        # Read a couple of chunks then slam the connection shut.
+        count = 0
+        async for _ in resp.content:
+            count += 1
+            if count >= 4:
+                break
+        await session.close()  # hard disconnect
+        await asyncio.sleep(0.3)
+        # The engine must have no running sequences left.
+        assert len(engine._running) == 0
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_annotations_as_sse_comments():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 2,
+                    "stream": True,
+                    "nvext": {"annotations": ["token_ids"]},
+                },
+            ) as resp:
+                raw = await resp.text()
+        assert ': {"annotation":"token_ids"' in raw
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)

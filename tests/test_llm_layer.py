@@ -1,0 +1,246 @@
+"""Preprocessor, tokenizer, detokenizer, protocols (ref: lib/llm unit tests)."""
+
+import pytest
+
+from dynamo_tpu.llm import (
+    Backend,
+    BackendOutput,
+    ChatTemplate,
+    FinishReason,
+    ModelDeploymentCard,
+    OpenAIError,
+    OpenAIPreprocessor,
+    PostprocessedOutput,
+    parse_chat_request,
+    tiny_tokenizer,
+)
+from dynamo_tpu.llm.tokenizer import DecodeStream
+from dynamo_tpu.runtime import Context, build_pipeline, collect
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return tiny_tokenizer()
+
+
+def make_preprocessor(tok):
+    card = ModelDeploymentCard(name="test-model", context_length=512)
+    return OpenAIPreprocessor(card, tok)
+
+
+# -- tokenizer --------------------------------------------------------------
+
+
+def test_roundtrip(tok):
+    text = "hello world this is a test"
+    ids = tok.encode(text)
+    assert len(ids) > 0
+    assert tok.decode(ids) == text
+
+
+def test_decode_stream_matches_full_decode(tok):
+    text = "the quick brown fox jumps over the lazy dog 0123"
+    ids = tok.encode(text)
+    stream = DecodeStream(tok)
+    out = "".join(stream.step([i]) for i in ids) + stream.flush()
+    assert out == text
+
+
+def test_decode_stream_multibyte():
+    tok = tiny_tokenizer()
+    # é etc. fall outside the training corpus → multi-token byte sequences.
+    text = "café 世界"
+    ids = tok.encode(text)
+    stream = DecodeStream(tok)
+    out = "".join(stream.step([i]) for i in ids) + stream.flush()
+    assert out == text
+
+
+# -- chat template ----------------------------------------------------------
+
+
+def test_default_chatml_template():
+    tpl = ChatTemplate()
+    text = tpl.render(
+        [{"role": "user", "content": "hi"}], add_generation_prompt=True
+    )
+    assert text == "<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\n"
+
+
+def test_content_part_arrays_flattened():
+    tpl = ChatTemplate()
+    text = tpl.render(
+        [{"role": "user", "content": [{"type": "text", "text": "a"}, {"type": "text", "text": "b"}]}],
+        add_generation_prompt=False,
+    )
+    assert "ab" in text
+
+
+# -- request validation ------------------------------------------------------
+
+
+def test_parse_chat_request_valid():
+    parsed = parse_chat_request(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "temperature": 0.5,
+            "max_tokens": 10,
+            "stop": ["\n"],
+            "stream": True,
+        }
+    )
+    assert parsed.model == "m"
+    assert parsed.sampling.temperature == 0.5
+    assert parsed.stop.max_tokens == 10
+    assert parsed.stop.stop == ["\n"]
+    assert parsed.stream
+
+
+@pytest.mark.parametrize(
+    "body,fragment",
+    [
+        ({}, "model"),
+        ({"model": "m"}, "messages"),
+        ({"model": "m", "messages": []}, "non-empty"),
+        ({"model": "m", "messages": [{"role": "robot", "content": "x"}]}, "role"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "temperature": 9}, "temperature"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "n": 0}, "'n'"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "max_tokens": 0}, "max_tokens"),
+    ],
+)
+def test_parse_chat_request_invalid(body, fragment):
+    with pytest.raises(OpenAIError) as err:
+        parse_chat_request(body)
+    assert fragment in str(err.value)
+
+
+def test_nvext_annotations_parsed():
+    parsed = parse_chat_request(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "nvext": {"annotations": ["formatted_prompt"], "ignore_eos": True},
+        }
+    )
+    assert parsed.annotations == ["formatted_prompt"]
+    assert parsed.stop.ignore_eos
+
+
+# -- preprocessor ------------------------------------------------------------
+
+
+def test_preprocess_chat(tok):
+    pre = make_preprocessor(tok).preprocess(
+        {"model": "m", "messages": [{"role": "user", "content": "hello world"}]}
+    )
+    assert len(pre.token_ids) > 0
+    assert pre.stop.max_tokens == 512 - len(pre.token_ids)
+    assert pre.sampling.temperature == 1.0
+    assert pre.eos_token_ids == tok.eos_token_ids
+    rendered = tok.decode(pre.token_ids, skip_special_tokens=False)
+    assert "hello world" in rendered
+
+
+def test_preprocess_completion_pretokenized(tok):
+    pre = make_preprocessor(tok).preprocess({"model": "m", "prompt": [1, 2, 3]})
+    assert pre.token_ids == [1, 2, 3]
+
+
+def test_preprocess_context_overflow(tok):
+    long_prompt = "word " * 2000
+    with pytest.raises(OpenAIError) as err:
+        make_preprocessor(tok).preprocess({"model": "m", "prompt": long_prompt})
+    assert "context length" in str(err.value)
+
+
+def test_max_tokens_clamped_to_context(tok):
+    pre = make_preprocessor(tok).preprocess(
+        {"model": "m", "prompt": "hi", "max_tokens": 100000}
+    )
+    assert pre.stop.max_tokens <= 512
+
+
+# -- backend detokenizer -----------------------------------------------------
+
+
+def make_fake_engine(tok, text, chunk=1, finish=FinishReason.EOS):
+    ids = tok.encode(text)
+
+    async def engine(request, context):
+        for i in range(0, len(ids), chunk):
+            batch = ids[i : i + chunk]
+            last = i + chunk >= len(ids)
+            yield BackendOutput(token_ids=batch, finish_reason=finish if last else None)
+
+    return engine
+
+
+async def test_backend_detokenizes(tok):
+    text = "streaming tokens one at a time"
+    pipeline = build_pipeline([Backend(tok)], make_fake_engine(tok, text))
+    pre = make_preprocessor(tok).preprocess({"model": "m", "prompt": "x"})
+    out = await collect(pipeline.generate(pre, Context()))
+    assert "".join(o.text for o in out) == text
+    assert out[-1].finish_reason == FinishReason.EOS
+
+
+async def test_backend_stop_string(tok):
+    text = "hello world STOP more text"
+    pre = make_preprocessor(tok).preprocess(
+        {"model": "m", "prompt": "x", "stop": ["STOP"]}
+    )
+    ctx = Context()
+    pipeline = build_pipeline([Backend(tok)], make_fake_engine(tok, text))
+    out = await collect(pipeline.generate(pre, ctx))
+    joined = "".join(o.text for o in out)
+    assert joined == "hello world "
+    assert out[-1].finish_reason == FinishReason.STOP
+    assert ctx.stopped  # engine told to stop early
+
+
+async def test_backend_stop_string_across_chunks(tok):
+    # Stop string split across many single-token steps must still match once.
+    text = "the quick brown fox jumps"
+    pre = make_preprocessor(tok).preprocess(
+        {"model": "m", "prompt": "x", "stop": ["brown fox"]}
+    )
+    pipeline = build_pipeline([Backend(tok)], make_fake_engine(tok, text))
+    out = await collect(pipeline.generate(pre, Context()))
+    assert "".join(o.text for o in out) == "the quick "
+
+
+async def test_backend_error_propagates(tok):
+    async def engine(request, context):
+        yield BackendOutput(token_ids=[1])
+        yield BackendOutput(error="engine exploded")
+
+    pre = make_preprocessor(tok).preprocess({"model": "m", "prompt": "x"})
+    pipeline = build_pipeline([Backend(tok)], engine)
+    out = await collect(pipeline.generate(pre, Context()))
+    assert out[-1].finish_reason == FinishReason.ERROR
+    assert "exploded" in out[-1].error
+
+
+async def test_preprocessor_annotations_emitted(tok):
+    async def engine(request, context):
+        yield BackendOutput(token_ids=[5], finish_reason=FinishReason.EOS)
+
+    card = ModelDeploymentCard(name="m", context_length=512)
+    pre_op = OpenAIPreprocessor(card, tok)
+    pipeline = build_pipeline([pre_op, Backend(tok)], engine)
+    out = await collect(
+        pipeline.generate(
+            {
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}],
+                "nvext": {"annotations": ["formatted_prompt", "token_ids"]},
+            },
+            Context(),
+        )
+    )
+    annotations = [o for o in out if isinstance(o, dict) and "annotation" in o]
+    public = {a["annotation"] for a in annotations if not a["annotation"].startswith("_")}
+    assert public == {"formatted_prompt", "token_ids"}
+    finals = [o for o in out if isinstance(o, PostprocessedOutput)]
+    assert finals[-1].finish_reason == FinishReason.EOS

@@ -1,0 +1,194 @@
+"""Mock engine: KV manager, scheduler, determinism (ref: lib/mocker tests)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engines.mock import KvManager, MockEngine, MockEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.runtime import Context, collect
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+FAST = MockEngineArgs(speedup_ratio=1000.0, block_size=4, num_kv_blocks=64, vocab_size=128)
+
+
+def make_request(tokens, max_tokens=8, **stop_kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop=StopConditions(max_tokens=max_tokens, **stop_kw),
+    )
+
+
+# -- KV manager -------------------------------------------------------------
+
+
+def test_kv_prefix_match_and_allocate():
+    events = []
+    kv = KvManager(16, 4, on_event=events.append)
+    hashes = compute_block_hashes(list(range(16)), 4)
+    assert kv.allocate(hashes) == 0
+    assert kv.active_blocks == 4
+    kv.release(hashes)
+    assert kv.cached_blocks == 4
+    # Second allocation fully prefix-cached.
+    assert kv.allocate(hashes) == 4
+    assert events[0].kind == "stored" and len(events[0].block_hashes) == 4
+
+
+def test_kv_lru_eviction():
+    events = []
+    kv = KvManager(2, 4, on_event=events.append)
+    h1 = compute_block_hashes(list(range(8)), 4)
+    h2 = compute_block_hashes(list(range(100, 108)), 4)
+    kv.allocate(h1)
+    kv.release(h1)
+    kv.allocate(h2)  # must evict h1's blocks
+    removed = [e for e in events if e.kind == "removed"]
+    assert removed and set(removed[0].block_hashes) <= set(h1)
+    assert kv.match_prefix(h2) == 2
+
+
+def test_kv_pool_exhaustion_refuses():
+    kv = KvManager(2, 4)
+    h = compute_block_hashes(list(range(12)), 4)  # needs 3 blocks
+    assert kv.allocate(h) is None
+
+
+def test_kv_matched_inactive_not_double_counted():
+    # Regression: reactivating a matched inactive block removes it from the
+    # evictable set; allocate must refuse instead of raising mid-way.
+    kv = KvManager(2, 16)
+    h1 = compute_block_hashes(list(range(16)), 16)
+    kv.allocate(h1)
+    kv.release(h1)
+    chain = compute_block_hashes(list(range(48)), 16)
+    assert chain[0] == h1[0]
+    assert kv.allocate(chain) is None  # needs 2 new with only 1 obtainable
+    assert kv.active_blocks == 0  # nothing half-pinned
+
+
+async def test_oversized_prompt_rejected_not_hang():
+    # Regression: a prompt larger than the whole pool must error out, and the
+    # scheduler must keep yielding to the event loop (no busy-spin hang).
+    engine = MockEngine(MockEngineArgs(speedup_ratio=1000.0, block_size=4, num_kv_blocks=2))
+    out = await asyncio.wait_for(
+        collect(engine.generate(make_request(range(40), max_tokens=4), Context())),
+        timeout=5,
+    )
+    assert any(o.error for o in out)
+    # Engine still serves admissible work afterwards.
+    ok = await asyncio.wait_for(
+        collect(engine.generate(make_request(range(4), max_tokens=2), Context())),
+        timeout=5,
+    )
+    assert sum(len(o.token_ids) for o in ok) == 2
+    await engine.stop()
+
+
+# -- engine -----------------------------------------------------------------
+
+
+async def test_generates_max_tokens():
+    engine = MockEngine(FAST)
+    out = await collect(engine.generate(make_request(range(8), max_tokens=5), Context()))
+    tokens = [t for o in out for t in o.token_ids]
+    assert len(tokens) == 5
+    assert out[-1].finish_reason == FinishReason.LENGTH
+    await engine.stop()
+
+
+async def test_deterministic_per_prompt():
+    engine = MockEngine(FAST)
+    req = lambda: make_request(range(8), max_tokens=6)
+    out1 = await collect(engine.generate(req(), Context()))
+    out2 = await collect(engine.generate(req(), Context()))
+    t1 = [t for o in out1 for t in o.token_ids]
+    t2 = [t for o in out2 for t in o.token_ids]
+    assert t1 == t2
+    out3 = await collect(engine.generate(make_request(range(50, 58), max_tokens=6), Context()))
+    assert [t for o in out3 for t in o.token_ids] != t1
+    await engine.stop()
+
+
+async def test_echo_mode():
+    engine = MockEngine(MockEngineArgs(speedup_ratio=1000.0, echo=True))
+    out = await collect(engine.generate(make_request([7, 8, 9], max_tokens=3), Context()))
+    assert [t for o in out for t in o.token_ids] == [7, 8, 9]
+    await engine.stop()
+
+
+async def test_concurrent_requests_batched():
+    engine = MockEngine(FAST)
+    reqs = [make_request(range(i, i + 8), max_tokens=10) for i in range(4)]
+    outs = await asyncio.gather(
+        *(collect(engine.generate(r, Context())) for r in reqs)
+    )
+    for out in outs:
+        assert sum(len(o.token_ids) for o in out) == 10
+    # Batching: 4 concurrent seqs × 10 tokens should take far fewer than 40
+    # serial ticks.
+    assert engine.steps < 40
+    await engine.stop()
+
+
+async def test_cancellation_mid_stream():
+    engine = MockEngine(MockEngineArgs(speedup_ratio=50.0, block_size=4, num_kv_blocks=64))
+    ctx = Context()
+    got = []
+    async for o in engine.generate(make_request(range(8), max_tokens=1000), ctx):
+        if o.token_ids:
+            got.append(o)
+        if len(got) == 3:
+            ctx.stop_generating()
+        if o.finish_reason is not None:
+            assert o.finish_reason == FinishReason.CANCELLED
+            break
+    assert len(got) < 10
+    await engine.stop()
+
+
+async def test_stop_token_ids():
+    engine = MockEngine(MockEngineArgs(speedup_ratio=1000.0, echo=True))
+    req = make_request([5, 6, 7], max_tokens=100, stop_token_ids=[6])
+    out = await collect(engine.generate(req, Context()))
+    assert out[-1].finish_reason == FinishReason.STOP
+    assert [t for o in out for t in o.token_ids] == [5, 6]
+    await engine.stop()
+
+
+async def test_eos_and_ignore_eos():
+    args = MockEngineArgs(speedup_ratio=1000.0, echo=True)
+    engine = MockEngine(args)
+    req = make_request([5, 9, 7], max_tokens=100)
+    req.eos_token_ids = [9]
+    out = await collect(engine.generate(req, Context()))
+    assert out[-1].finish_reason == FinishReason.EOS
+    req2 = make_request([5, 9, 7], max_tokens=6, ignore_eos=True)
+    req2.eos_token_ids = [9]
+    out2 = await collect(engine.generate(req2, Context()))
+    assert out2[-1].finish_reason == FinishReason.LENGTH
+    await engine.stop()
+
+
+async def test_kv_events_emitted_during_generation():
+    events = []
+    engine = MockEngine(FAST, on_kv_event=events.append)
+    await collect(engine.generate(make_request(range(16), max_tokens=8), Context()))
+    stored = [e for e in events if e.kind == "stored"]
+    assert stored  # prompt blocks + decode-grown blocks
+    assert sum(len(e.block_hashes) for e in stored) >= 4
+    await engine.stop()
+
+
+async def test_prefix_cache_hits_speed_up_admission():
+    engine = MockEngine(FAST)
+    req1 = make_request(range(32), max_tokens=2)
+    await collect(engine.generate(req1, Context()))
+    assert engine.kv.cached_blocks > 0
+    matched = engine.kv.match_prefix(compute_block_hashes(list(range(32)), 4))
+    assert matched == 8
+    await engine.stop()

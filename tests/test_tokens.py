@@ -1,0 +1,135 @@
+"""Block hashing + radix tree (ref: lib/tokens tests, radix_tree.rs tests)."""
+
+import pytest
+
+from dynamo_tpu.tokens import (
+    RadixTree,
+    compute_block_hash_for_seq,
+    compute_block_hashes,
+)
+
+W0 = (1, 0)
+W1 = (2, 0)
+
+
+def test_hashes_deterministic_and_chained():
+    tokens = list(range(256))
+    h1 = compute_block_hashes(tokens, 64)
+    h2 = compute_block_hashes(tokens, 64)
+    assert h1 == h2 and len(h1) == 4
+    # Chained: changing an early token changes every later hash.
+    tokens2 = [999] + tokens[1:]
+    h3 = compute_block_hashes(tokens2, 64)
+    assert all(a != b for a, b in zip(h1, h3))
+    # Same prefix ⇒ same leading hashes.
+    h4 = compute_block_hashes(tokens[:128], 64)
+    assert h4 == h1[:2]
+
+
+def test_partial_tail_block_not_hashed():
+    assert len(compute_block_hashes(list(range(150)), 64)) == 2
+    assert compute_block_hashes([1, 2, 3], 64) == []
+
+
+def test_incremental_extension():
+    tokens = list(range(192))
+    full = compute_block_hashes(tokens, 64)
+    prefix = compute_block_hashes(tokens[:64], 64)
+    ext = compute_block_hashes(tokens[64:], 64, parent_hash=prefix[-1])
+    assert prefix + ext == full
+
+
+def test_salt_changes_hashes():
+    tokens = list(range(64))
+    assert compute_block_hashes(tokens, 64) != compute_block_hashes(tokens, 64, salt=7)
+
+
+def test_reference_alias():
+    tokens = list(range(64))
+    assert compute_block_hash_for_seq(tokens, 64) == compute_block_hashes(tokens, 64)
+
+
+def test_block_size_validation():
+    with pytest.raises(ValueError):
+        compute_block_hashes([1], 0)
+
+
+# -- radix tree -------------------------------------------------------------
+
+
+def seq_hashes(n_blocks, block_size=16, start=0):
+    return compute_block_hashes(list(range(start, start + n_blocks * block_size)), block_size)
+
+
+def test_store_and_find():
+    tree = RadixTree()
+    hashes = seq_hashes(4)
+    tree.store(W0, hashes)
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {W0: 4}
+    assert scores.matched_blocks == 4
+
+
+def test_partial_overlap():
+    tree = RadixTree()
+    hashes = seq_hashes(4)
+    tree.store(W0, hashes[:2])
+    tree.store(W1, hashes)
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {W0: 2, W1: 4}
+    assert scores.best() == (W1, 4)
+
+
+def test_no_match_on_divergent_prefix():
+    tree = RadixTree()
+    tree.store(W0, seq_hashes(4, start=0))
+    scores = tree.find_matches(seq_hashes(4, start=10_000))
+    assert scores.scores == {}
+
+
+def test_incremental_store_with_parent():
+    tree = RadixTree()
+    hashes = seq_hashes(4)
+    tree.store(W0, hashes[:2])
+    tree.store(W0, hashes[2:], parent_hash=hashes[1])
+    assert tree.find_matches(hashes).scores == {W0: 4}
+
+
+def test_remove_blocks():
+    tree = RadixTree()
+    hashes = seq_hashes(4)
+    tree.store(W0, hashes)
+    tree.remove(W0, hashes[2:])
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {W0: 2}
+    assert tree.num_blocks == 2  # pruned
+
+
+def test_remove_worker():
+    tree = RadixTree()
+    hashes = seq_hashes(3)
+    tree.store(W0, hashes)
+    tree.store(W1, hashes[:1])
+    tree.remove_worker(W0)
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {W1: 1}
+    assert tree.num_blocks == 1
+    assert tree.workers == [W1]
+
+
+def test_hole_ends_run():
+    tree = RadixTree()
+    hashes = seq_hashes(4)
+    tree.store(W0, hashes)
+    tree.remove(W0, [hashes[1]])  # hole at depth 2
+    scores = tree.find_matches(hashes)
+    assert scores.scores.get(W0) == 1
+
+
+def test_dp_ranks_distinct():
+    tree = RadixTree()
+    hashes = seq_hashes(2)
+    tree.store((5, 0), hashes)
+    tree.store((5, 1), hashes[:1])
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {(5, 0): 2, (5, 1): 1}
