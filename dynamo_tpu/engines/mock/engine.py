@@ -117,6 +117,9 @@ class MockEngine:
         if isinstance(request, dict):
             request = PreprocessedRequest.from_dict(request)
         prompt = list(request.token_ids)
+        if not prompt:
+            yield BackendOutput(error="empty prompt", finish_reason=FinishReason.ERROR)
+            return
         seq = _Sequence(
             request=request,
             context=context,
@@ -164,10 +167,14 @@ class MockEngine:
                 logger.exception("mock scheduler tick failed")
                 await asyncio.sleep(self._sleep_time(self.args.decode_itl_s))
 
-        # Drain on stop.
+        # Drain on stop — running AND still-waiting sequences, so no
+        # generate() caller is left blocked forever.
         for seq in self._running:
             seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
         self._running.clear()
+        while not self._waiting.empty():
+            seq = self._waiting.get_nowait()
+            seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
 
     async def _scheduler_tick(self) -> None:
         args = self.args

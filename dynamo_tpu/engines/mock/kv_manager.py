@@ -107,10 +107,20 @@ class KvManager:
             if block.ref_count == 0:
                 self._inactive.pop(h, None)
             block.ref_count += 1
-        # Allocate the rest, evicting LRU cached blocks as needed.
+        # Allocate the rest, evicting LRU cached blocks as needed. A block
+        # past the matched prefix can still be resident (eviction can punch
+        # holes in a chain: the parent went, the child stayed) — pin it
+        # instead of double-allocating.
         parent = block_hashes[matched - 1] if matched else None
         new_hashes: List[int] = []
         for h in block_hashes[matched:]:
+            existing = self._blocks.get(h)
+            if existing is not None:
+                if existing.ref_count == 0:
+                    self._inactive.pop(h, None)
+                existing.ref_count += 1
+                parent = h
+                continue
             if self._used >= self.num_blocks:
                 self._evict_one()
             block = _Block(block_hash=h, parent_hash=parent, ref_count=1)

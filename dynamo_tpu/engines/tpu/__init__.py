@@ -1,0 +1,16 @@
+"""The native TPU engine: jit-compiled continuous batching over paged KV.
+
+This replaces the reference's external-engine adapters (vLLM/SGLang/TRT-LLM,
+components/src/dynamo/{vllm,sglang,trtllm}) with a first-party JAX engine:
+  - paged KV cache in HBM (block_pool.py: prefix reuse + LRU eviction,
+    physical block ids ↔ chained hashes, KV events for the router),
+  - one compiled forward (models/llama.py forward_paged) serving prefill,
+    chunked prefill, and batched decode,
+  - an asyncio continuous-batching scheduler (engine.py) with the same
+    admission/watermark/preemption semantics as the reference engines.
+"""
+
+from dynamo_tpu.engines.tpu.block_pool import BlockPool
+from dynamo_tpu.engines.tpu.engine import JaxEngine, JaxEngineArgs
+
+__all__ = ["BlockPool", "JaxEngine", "JaxEngineArgs"]

@@ -1,0 +1,150 @@
+"""Physical KV block pool: hash ↔ device-block-id, prefix reuse, LRU.
+
+Reference parity: the G1 (device) pool of KVBM
+(lib/llm/src/block_manager/pool/managed.rs — active/inactive sets, reuse &
+eviction) fused with the mocker's KvManager semantics (kv_manager.rs:50).
+Unlike the mock engine, blocks here name *physical slots* in the HBM cache
+arrays, so the pool is the single source of truth for which device block
+holds which content hash.
+
+States: free (uninitialized/evicted) → active-private (being filled by one
+sequence) → committed (full block, content-hashed, shareable) → inactive
+(committed, refcount 0, LRU-evictable) → free.
+
+Emits the same KvEvent stream as the mock engine for router indexing.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.engines.mock.kv_manager import EventCallback, KvEvent
+
+
+@dataclass
+class _Committed:
+    block_id: int
+    parent_hash: Optional[int]
+    ref_count: int = 0
+
+
+class BlockPool:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        *,
+        on_event: Optional[EventCallback] = None,
+    ) -> None:
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._on_event = on_event
+        self._free: Deque[int] = deque(range(num_blocks))
+        self._by_hash: Dict[int, _Committed] = {}
+        self._lru: "OrderedDict[int, _Committed]" = OrderedDict()  # hash → entry
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._lru)
+
+    @property
+    def active_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+    @property
+    def usage(self) -> float:
+        return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
+
+    # -- prefix reuse ------------------------------------------------------
+
+    def match_prefix(self, block_hashes: Sequence[int]) -> int:
+        n = 0
+        for h in block_hashes:
+            if h in self._by_hash:
+                n += 1
+            else:
+                break
+        return n
+
+    def pin_prefix(self, block_hashes: Sequence[int]) -> Tuple[int, List[int]]:
+        """Pin the longest cached prefix; returns (matched_blocks, their ids)."""
+        matched = self.match_prefix(block_hashes)
+        ids: List[int] = []
+        for h in block_hashes[:matched]:
+            entry = self._by_hash[h]
+            if entry.ref_count == 0:
+                self._lru.pop(h, None)
+            entry.ref_count += 1
+            ids.append(entry.block_id)
+        return matched, ids
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """Take one free physical block (evicting cold cache if needed)."""
+        if self._free:
+            return self._free.popleft()
+        if self._lru:
+            h, entry = self._lru.popitem(last=False)
+            del self._by_hash[h]
+            self._emit(KvEvent(kind="removed", block_hashes=[h]))
+            return entry.block_id
+        return None
+
+    def commit(
+        self, block_id: int, block_hash: int, parent_hash: Optional[int]
+    ) -> None:
+        """A sequence finished filling `block_id`; register it shareable.
+
+        If the hash is already cached (another sequence computed the same
+        content), the physical block stays private to its owner — it is
+        returned to the free list on release instead of double-registering.
+        """
+        if block_hash in self._by_hash:
+            return
+        self._by_hash[block_hash] = _Committed(
+            block_id=block_id, parent_hash=parent_hash, ref_count=1
+        )
+        self._emit(
+            KvEvent(kind="stored", block_hashes=[block_hash], parent_hash=parent_hash)
+        )
+
+    def release(self, block_ids: Sequence[int], block_hashes: Sequence[int]) -> None:
+        """Sequence done. `block_hashes[i]` pairs with `block_ids[i]` for the
+        committed prefix; remaining ids are private/partial blocks → freed."""
+        owned = set()
+        for i, h in enumerate(block_hashes):
+            entry = self._by_hash.get(h)
+            if entry is not None and entry.block_id == block_ids[i]:
+                owned.add(i)
+                entry.ref_count -= 1
+                if entry.ref_count <= 0:
+                    entry.ref_count = 0
+                    self._lru[h] = entry
+                    self._lru.move_to_end(h)
+        for i, bid in enumerate(block_ids):
+            if i not in owned:
+                self._free.append(bid)
+
+    def clear(self) -> None:
+        """Drop all reusable cached blocks (ref: clear_kv_blocks route)."""
+        evicted = list(self._lru)
+        for h in evicted:
+            entry = self._lru.pop(h)
+            del self._by_hash[h]
+            self._free.append(entry.block_id)
+        if evicted:
+            self._emit(KvEvent(kind="removed", block_hashes=evicted))
+        self._emit(KvEvent(kind="cleared"))
+
+    def _emit(self, event: KvEvent) -> None:
+        if self._on_event is not None:
+            self._on_event(event)

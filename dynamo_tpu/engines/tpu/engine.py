@@ -1,0 +1,522 @@
+"""JaxEngine: continuous batching over a jit-compiled paged-KV model.
+
+Reference parity: this is the framework's flagship backend, playing the role
+vLLM plays behind components/src/dynamo/vllm (continuous batching, paged KV,
+prefix caching, KV events, chunked prefill) — but TPU-native:
+
+  - ONE jitted step function (model forward_paged + fused sampling) serves
+    prefill (B=1, C=chunk) and decode (B=max_num_seqs, C=1). Shapes are
+    bucketed (powers of two for chunk length and block-table width) so XLA
+    compiles a handful of programs, then everything is cache hits.
+  - KV cache = two [L, num_blocks, block_size, KH, D] arrays in HBM, donated
+    through every step (XLA updates in place). Physical blocks are leased by
+    block_pool.BlockPool with prefix reuse + LRU eviction and KV events.
+  - All device work runs on a single executor thread so the asyncio serving
+    loop never blocks on compiles or device sync.
+  - Preemption-by-recompute when the pool is exhausted mid-decode (the
+    youngest sequence releases its blocks and re-queues), like vLLM's
+    recompute preemption.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engines.mock.kv_manager import KvEvent
+from dynamo_tpu.engines.tpu.block_pool import BlockPool
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    PreprocessedRequest,
+    TokenLogprob,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
+from dynamo_tpu.parallel.mesh import AxisNames
+from dynamo_tpu.parallel.sharding import ShardingRules, param_shardings, shard_params
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class JaxEngineArgs:
+    """Engine knobs (ref: vllm EngineArgs surface used by
+    components/src/dynamo/vllm/args.py — block size, gpu blocks, max seqs)."""
+
+    config: ModelConfig = field(default_factory=ModelConfig)
+    block_size: int = 16
+    num_kv_blocks: int = 512
+    max_num_seqs: int = 8
+    max_model_len: int = 1024
+    prefill_chunk: int = 512  # max tokens per prefill step (chunked prefill)
+    watermark: float = 0.01
+    enable_prefix_caching: bool = True
+    use_kernel: Optional[bool] = None  # None = auto (pallas on TPU)
+    seed: int = 0
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return math.ceil(self.max_model_len / self.block_size)
+
+
+@dataclass
+class _Sequence:
+    request: PreprocessedRequest
+    context: Context
+    queue: "asyncio.Queue[Optional[BackendOutput]]"
+    prompt: List[int]
+    all_tokens: List[int]  # prompt + generated
+    generated: List[int] = field(default_factory=list)
+    block_ids: List[int] = field(default_factory=list)
+    block_hashes: List[int] = field(default_factory=list)  # committed prefix
+    slot: int = -1
+    next_token: int = 0  # decode input token
+    logprob_pending: Optional[float] = None
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class JaxEngine:
+    """AsyncEngine over the native JAX model."""
+
+    def __init__(
+        self,
+        args: JaxEngineArgs,
+        params: Optional[Any] = None,
+        *,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        rules: Optional[ShardingRules] = None,
+        on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+    ) -> None:
+        self.args = args
+        self.config = args.config
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        backend = jax.default_backend()
+        self._use_kernel = (
+            args.use_kernel if args.use_kernel is not None else backend == "tpu"
+        )
+        self.pool = BlockPool(
+            args.num_kv_blocks, args.block_size, on_event=on_kv_event
+        )
+
+        if params is None:
+            params = llama.init_params(self.config, jax.random.PRNGKey(args.seed))
+        if mesh is not None:
+            params = shard_params(
+                params, llama.param_logical_axes(self.config), self.rules, mesh
+            )
+        self.params = params
+        k_cache, v_cache = llama.init_kv_cache(
+            self.config, args.num_kv_blocks, args.block_size
+        )
+        if mesh is not None:
+            cache_sharding = self.rules.sharding(mesh, *llama.kv_cache_logical_axes())
+            k_cache = jax.device_put(k_cache, cache_sharding)
+            v_cache = jax.device_put(v_cache, cache_sharding)
+        self._k_cache = k_cache
+        self._v_cache = v_cache
+
+        self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
+        self._step_fn = self._build_step_fn()
+
+        S = args.max_num_seqs
+        self._slots: List[Optional[_Sequence]] = [None] * S
+        self._pos = np.zeros(S, dtype=np.int32)  # tokens resident in cache
+        self._block_tables = np.zeros(
+            (S, args.max_blocks_per_seq), dtype=np.int32
+        )
+        self._temp = np.ones(S, dtype=np.float32)
+        self._topk = np.zeros(S, dtype=np.int32)
+        self._topp = np.ones(S, dtype=np.float32)
+
+        self._waiting: "asyncio.Queue[_Sequence]" = asyncio.Queue()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+        self._wake = asyncio.Event()
+        self._executor = ThreadPoolExecutor(1, thread_name_prefix="jax-engine")
+        self.steps = 0  # decode iterations (observability)
+        self.prefill_tokens = 0
+        self.generated_tokens = 0
+
+    # -- jitted step -------------------------------------------------------
+
+    def _build_step_fn(self):
+        cfg = self.config
+        use_kernel = self._use_kernel
+
+        def step(params, k_cache, v_cache, tokens, start_pos, chunk_lens,
+                 block_tables, rng, temp, topk, topp):
+            logits, k_cache, v_cache = llama.forward_paged(
+                params, cfg, tokens, start_pos, chunk_lens, block_tables,
+                k_cache, v_cache, use_kernel=use_kernel,
+            )
+            toks = sample_tokens(logits, rng, temp, topk, topp)
+            logp = compute_logprobs(logits, toks)
+            return toks, logp, k_cache, v_cache
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _run_step(
+        self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Execute one step on the device thread (blocking). Caller passes
+        numpy inputs; returns (sampled tokens, logprobs) as numpy."""
+        self._rng, sub = jax.random.split(self._rng)
+        toks, logp, self._k_cache, self._v_cache = self._step_fn(
+            self.params, self._k_cache, self._v_cache,
+            jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
+            jnp.asarray(block_tables), sub,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+        )
+        return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
+
+    async def _device(self, fn, *a):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *a
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._scheduler_loop(), name="jax-engine-scheduler"
+            )
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        if self._loop_task is not None:
+            await self._loop_task
+            self._loop_task = None
+        self._executor.shutdown(wait=False)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active_seqs": sum(1 for s in self._slots if s is not None),
+            "waiting": self._waiting.qsize(),
+            "kv_usage": self.pool.usage,
+            "free_blocks": self.pool.free_blocks,
+            "cached_blocks": self.pool.cached_blocks,
+            "decode_steps": self.steps,
+            "prefill_tokens": self.prefill_tokens,
+            "generated_tokens": self.generated_tokens,
+        }
+
+    @property
+    def num_total_blocks(self) -> int:
+        return self.args.num_kv_blocks
+
+    # -- AsyncEngine -------------------------------------------------------
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        await self.start()
+        if isinstance(request, dict):
+            request = PreprocessedRequest.from_dict(request)
+        prompt = list(request.token_ids)
+        if not prompt:
+            yield BackendOutput(error="empty prompt", finish_reason=FinishReason.ERROR)
+            return
+        if len(prompt) >= self.args.max_model_len:
+            yield BackendOutput(
+                error=(
+                    f"prompt length {len(prompt)} exceeds max_model_len "
+                    f"{self.args.max_model_len}"
+                ),
+                finish_reason=FinishReason.ERROR,
+            )
+            return
+        seq = _Sequence(
+            request=request,
+            context=context,
+            queue=asyncio.Queue(),
+            prompt=prompt,
+            all_tokens=list(prompt),
+        )
+        await self._waiting.put(seq)
+        self._wake.set()
+        while True:
+            out = await seq.queue.get()
+            if out is None:
+                return
+            yield out
+            if out.finish_reason is not None:
+                return
+
+    # -- scheduler ---------------------------------------------------------
+
+    async def _scheduler_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                admitted = await self._admit_one()
+                active = any(s is not None for s in self._slots)
+                if active:
+                    await self._decode_tick()
+                elif not admitted:
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+                    except asyncio.TimeoutError:
+                        pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("jax engine scheduler tick failed")
+                await asyncio.sleep(0.05)
+        for seq in self._slots:
+            if seq is not None:
+                self._finish(seq, FinishReason.CANCELLED)
+        while not self._waiting.empty():
+            seq = self._waiting.get_nowait()
+            seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    async def _admit_one(self) -> bool:
+        """Admit + prefill at most one waiting sequence (bounds decode stall)."""
+        slot = self._free_slot()
+        if slot is None or self._waiting.empty():
+            return False
+        seq = self._waiting.get_nowait()
+        if seq.context.stopped:
+            seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
+            return True
+        args = self.args
+        prompt = seq.all_tokens  # includes regenerated tokens after preemption
+        n_blocks_prompt = math.ceil(len(prompt) / args.block_size)
+
+        hashes: List[int] = []
+        matched = 0
+        ids: List[int] = []
+        if args.enable_prefix_caching:
+            hashes = compute_block_hashes(prompt, args.block_size)
+            matched, ids = self.pool.pin_prefix(hashes)
+        matched_tokens = min(matched * args.block_size, len(prompt) - 1)
+
+        # Watermark headroom so running decodes can still grow.
+        headroom = (
+            int(args.num_kv_blocks * args.watermark)
+            if any(s is not None for s in self._slots)
+            else 0
+        )
+        need = n_blocks_prompt - len(ids) + 1 + headroom
+        if need > self.pool.free_blocks:
+            self.pool.release(ids, hashes[:matched])
+            self._requeue(seq)
+            return False
+        while len(ids) < n_blocks_prompt:
+            b = self.pool.alloc()
+            if b is None:  # raced below watermark; put everything back
+                self.pool.release(ids, hashes[:matched])
+                self._requeue(seq)
+                return False
+            ids.append(b)
+        seq.block_ids = ids
+        seq.block_hashes = hashes[:matched]
+
+        # Chunked prefill of the non-cached suffix.
+        table = np.zeros((1, args.max_blocks_per_seq), dtype=np.int32)
+        table[0, : len(ids)] = ids
+        nb_bucket = min(_next_pow2(n_blocks_prompt), args.max_blocks_per_seq)
+        sp = self._sampling_of(seq.request)
+        p_temp = np.array([sp[0]], dtype=np.float32)
+        p_topk = np.array([sp[1]], dtype=np.int32)
+        p_topp = np.array([sp[2]], dtype=np.float32)
+        pos = matched_tokens
+        first_token: Optional[int] = None
+        first_logprob = 0.0
+        while pos < len(prompt):
+            chunk = prompt[pos : pos + args.prefill_chunk]
+            c_bucket = min(_next_pow2(len(chunk)), args.prefill_chunk)
+            tok_arr = np.zeros((1, c_bucket), dtype=np.int32)
+            tok_arr[0, : len(chunk)] = chunk
+            toks, logps = await self._device(
+                self._run_step,
+                tok_arr,
+                np.array([pos], dtype=np.int32),
+                np.array([len(chunk)], dtype=np.int32),
+                table[:, :nb_bucket],
+                p_temp, p_topk, p_topp,
+            )
+            self.prefill_tokens += len(chunk)
+            pos += len(chunk)
+            if pos >= len(prompt):
+                first_token = int(toks[0])
+                first_logprob = float(logps[0])
+
+        # Commit freshly-filled full prompt blocks for reuse/routing.
+        if args.enable_prefix_caching:
+            full = len(prompt) // args.block_size
+            for i in range(matched, full):
+                parent = hashes[i - 1] if i else None
+                self.pool.commit(ids[i], hashes[i], parent)
+                seq.block_hashes.append(hashes[i])
+
+        # Install in the decode batch.
+        assert first_token is not None
+        seq.slot = slot
+        self._slots[slot] = seq
+        self._pos[slot] = len(prompt)
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, : len(ids)] = ids
+        self._temp[slot], self._topk[slot], self._topp[slot] = sp
+        self._emit_token(seq, first_token, first_logprob)
+        return True
+
+    def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
+        s = req.sampling
+        temp = s.temperature if s.temperature is not None else 1.0
+        topk = s.top_k if s.top_k is not None and s.top_k > 0 else 0
+        topp = s.top_p if s.top_p is not None else 1.0
+        return float(temp), int(topk), float(topp)
+
+    def _requeue(self, seq: _Sequence) -> None:
+        seq.block_ids = []
+        seq.block_hashes = []
+        requeue: "asyncio.Queue[_Sequence]" = asyncio.Queue()
+        requeue.put_nowait(seq)
+        while not self._waiting.empty():
+            requeue.put_nowait(self._waiting.get_nowait())
+        self._waiting = requeue
+
+    async def _decode_tick(self) -> None:
+        args = self.args
+        # Ensure every active sequence has a block for its next position;
+        # preempt (recompute later) the youngest if the pool is dry.
+        for slot in range(args.max_num_seqs - 1, -1, -1):
+            seq = self._slots[slot]
+            if seq is None:
+                continue
+            if seq.context.stopped:
+                self._finish(seq, FinishReason.CANCELLED)
+                continue
+            pos = int(self._pos[slot])
+            if pos >= args.max_model_len:
+                self._finish(seq, FinishReason.LENGTH)
+                continue
+            need_block = pos // args.block_size
+            if need_block >= len(seq.block_ids):
+                b = self.pool.alloc()
+                if b is None:
+                    self._preempt(seq)
+                    continue
+                seq.block_ids.append(b)
+                self._block_tables[slot, need_block] = b
+
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+
+        tokens = np.zeros((args.max_num_seqs, 1), dtype=np.int32)
+        chunk_lens = np.zeros(args.max_num_seqs, dtype=np.int32)
+        max_blocks = 1
+        for seq in active:
+            tokens[seq.slot, 0] = seq.next_token
+            chunk_lens[seq.slot] = 1
+            max_blocks = max(max_blocks, int(self._pos[seq.slot]) // args.block_size + 1)
+        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
+
+        toks, logps = await self._device(
+            self._run_step,
+            tokens,
+            self._pos.copy(),
+            chunk_lens,
+            self._block_tables[:, :nb_bucket].copy(),
+            self._temp.copy(), self._topk.copy(), self._topp.copy(),
+        )
+        self.steps += 1
+
+        for seq in list(active):
+            if self._slots[seq.slot] is not seq:
+                continue  # finished/preempted above
+            slot = seq.slot
+            self._pos[slot] += 1  # the input token's KV is now resident
+            # Block-boundary: the just-completed block becomes shareable.
+            if args.enable_prefix_caching and int(self._pos[slot]) % args.block_size == 0:
+                bi = int(self._pos[slot]) // args.block_size - 1
+                if bi < len(seq.block_ids) and bi == len(seq.block_hashes):
+                    parent = seq.block_hashes[-1] if seq.block_hashes else None
+                    h = compute_block_hashes(
+                        seq.all_tokens[bi * args.block_size : (bi + 1) * args.block_size],
+                        args.block_size,
+                        parent_hash=parent,
+                    )[0]
+                    self.pool.commit(seq.block_ids[bi], h, parent)
+                    seq.block_hashes.append(h)
+            self._emit_token(seq, int(toks[slot]), float(logps[slot]))
+
+    def _preempt(self, seq: _Sequence) -> None:
+        """Release blocks and requeue for recompute (vLLM-style preemption)."""
+        logger.warning("preempting request %s (KV pool exhausted)", seq.request.request_id)
+        self.pool.release(seq.block_ids, seq.block_hashes)
+        slot = seq.slot
+        self._slots[slot] = None
+        self._pos[slot] = 0
+        seq.slot = -1
+        self._requeue(seq)
+
+    def _emit_token(self, seq: _Sequence, token: int, logprob: float) -> None:
+        """Append a generated token, evaluate stop conditions, stream output."""
+        seq.generated.append(token)
+        seq.all_tokens.append(token)
+        seq.next_token = token
+        self.generated_tokens += 1
+        req = seq.request
+        stop = req.stop
+        n = len(seq.generated)
+        min_ok = stop.min_tokens is None or n >= stop.min_tokens
+        reason: Optional[FinishReason] = None
+        if not stop.ignore_eos and min_ok and token in (req.eos_token_ids or []):
+            reason = FinishReason.EOS
+        elif min_ok and token in (stop.stop_token_ids or []):
+            reason = FinishReason.STOP
+        elif stop.max_tokens is not None and n >= stop.max_tokens:
+            reason = FinishReason.LENGTH
+        elif len(seq.all_tokens) >= self.args.max_model_len:
+            reason = FinishReason.LENGTH
+
+        logprobs = None
+        if req.sampling.logprobs is not None:
+            logprobs = [[TokenLogprob(token_id=token, logprob=logprob)]]
+        seq.queue.put_nowait(
+            BackendOutput(
+                token_ids=[token],
+                finish_reason=reason,
+                cumulative_tokens=n,
+                logprobs=logprobs,
+            )
+        )
+        if reason is not None:
+            self._finish(seq, reason, emit=False)
+
+    def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
+        self.pool.release(seq.block_ids, seq.block_hashes)
+        seq.block_ids = []
+        seq.block_hashes = []
+        if seq.slot >= 0:
+            self._slots[seq.slot] = None
+            self._pos[seq.slot] = 0
+            seq.slot = -1
+        if emit:
+            seq.queue.put_nowait(BackendOutput(finish_reason=reason))

@@ -229,6 +229,20 @@ class HttpService:
     ) -> web.StreamResponse:
         rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
         include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
+
+        # Pull the first stream item BEFORE sending headers: preprocessing
+        # (validation, templating, tokenization) raises on the first item, and
+        # those failures must surface as a proper HTTP 4xx, not an in-band
+        # frame after a 200 (the unary path already behaves this way).
+        stream = entry.engine.generate(body, ctx).__aiter__()
+        try:
+            first_item = await stream.__anext__()
+        except StopAsyncIteration:
+            first_item = None
+        except OpenAIError as exc:
+            timer.done(exc.status)
+            return _error_response(exc)
+
         response = web.StreamResponse(
             status=200,
             headers={
@@ -245,7 +259,7 @@ class HttpService:
         sent_role = False
         status = 200
         try:
-            async for item in entry.engine.generate(body, ctx):
+            async for item in _prepend(first_item, stream):
                 if isinstance(item, dict) and "annotation" in item:
                     if item["annotation"] == "_prompt_tokens":
                         prompt_tokens = item["value"]
@@ -309,6 +323,13 @@ class HttpService:
 
 def _error_response(exc: OpenAIError) -> web.Response:
     return web.json_response(exc.to_body(), status=exc.status)
+
+
+async def _prepend(first, rest):
+    if first is not None:
+        yield first
+    async for item in rest:
+        yield item
 
 
 async def _sse_send(response: web.StreamResponse, payload: Dict[str, Any]) -> None:

@@ -41,7 +41,6 @@ class Backend:
         # len(longest_stop)-1 chars until we know it can't complete a match.
         holdback = max((len(s) for s in stop_strings), default=0) - 1
         decode = DecodeStream(self.tokenizer)
-        emitted_text = ""  # text already sent downstream
         pending = ""  # decoded but held back
         cumulative = 0
 
@@ -88,7 +87,6 @@ class Backend:
             emit = pending[: max(0, len(pending) - holdback)] if holdback > 0 else pending
             pending = pending[len(emit) :]
             if emit or out.token_ids:
-                emitted_text += emit
                 yield PostprocessedOutput(
                     text=emit,
                     token_ids=out.token_ids,

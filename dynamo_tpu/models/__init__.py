@@ -1,0 +1,12 @@
+"""Model zoo: TPU-native (pure-JAX, scan-over-layers, paged-KV) LLMs.
+
+Where the reference adapts external engines (vLLM/SGLang/TRT-LLM) per model
+family, this framework ships its own jit-compiled model implementations. The
+llama module covers the dense decoder family (Llama-2/3, Qwen2/2.5, Mistral —
+differing only in config: GQA ratio, rope theta, qkv bias, tied embeddings).
+"""
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+
+__all__ = ["ModelConfig", "llama"]

@@ -1,0 +1,144 @@
+"""Model architecture config.
+
+One dataclass describes the dense decoder family; `from_hf_config` ingests a
+HuggingFace `config.json` (llama / qwen2 / mistral architectures), which is
+what the reference's ModelDeploymentCard resolves from the hub
+(ref: lib/llm/src/model_card.rs:178, local_model/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: Optional[int] = None  # defaults to d_model // n_heads
+    d_ff: int = 14336
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    max_position_embeddings: int = 8192
+    qkv_bias: bool = False  # Qwen2-style
+    tie_word_embeddings: bool = False
+    eos_token_ids: List[int] = field(default_factory=list)
+    bos_token_id: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+    name: str = "llama"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any], name: str = "") -> "ModelConfig":
+        archs = cfg.get("architectures") or [""]
+        arch = archs[0].lower()
+        eos = cfg.get("eos_token_id")
+        if eos is None:
+            eos_ids: List[int] = []
+        elif isinstance(eos, list):
+            eos_ids = [int(e) for e in eos]
+        else:
+            eos_ids = [int(eos)]
+        return cls(
+            vocab_size=cfg["vocab_size"],
+            d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"],
+            n_heads=cfg["num_attention_heads"],
+            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            d_ff=cfg["intermediate_size"],
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            qkv_bias="qwen2" in arch,
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            eos_token_ids=eos_ids,
+            bos_token_id=cfg.get("bos_token_id"),
+            name=name or cfg.get("model_type", "llama"),
+        )
+
+    @classmethod
+    def from_model_dir(cls, path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f), name=os.path.basename(path.rstrip("/")))
+
+
+# Handy known shapes for tests/benchmarks (no downloads in this environment).
+def tiny_config(**overrides) -> ModelConfig:
+    base = dict(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_position_embeddings=512,
+        eos_token_ids=[2],
+        dtype=jnp.float32,
+        name="tiny-llama",
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def qwen2_500m_config() -> ModelConfig:
+    """Qwen2.5-0.5B shape (SURVEY §7 stage 5 first real model)."""
+    return ModelConfig(
+        vocab_size=151936,
+        d_model=896,
+        n_layers=24,
+        n_heads=14,
+        n_kv_heads=2,
+        d_ff=4864,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        qkv_bias=True,
+        tie_word_embeddings=True,
+        eos_token_ids=[151645],
+        name="qwen2.5-0.5b",
+    )
+
+
+def llama3_8b_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+        eos_token_ids=[128001, 128009],
+        name="llama-3-8b",
+    )
+
+
+def llama3_70b_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=128256,
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=28672,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+        eos_token_ids=[128001, 128009],
+        name="llama-3-70b",
+    )

@@ -1,0 +1,97 @@
+"""Load HuggingFace safetensors checkpoints into the functional param tree.
+
+Reference parity: the reference's LocalModel/hub resolution
+(lib/llm/src/local_model/, model_card.rs:178) hands weights to the engine;
+here the engine is ours so we map HF names → our stacked-layer pytree.
+Zero-egress environment: only local directories are supported; remote hub
+fetch is a gated stub.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+_HF_LAYER_MAP = {
+    # our name -> (hf suffix, transpose)
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+
+def _open_safetensors(model_dir: str):
+    """Yield (name, numpy array) for every tensor in the checkpoint."""
+    from safetensors import safe_open  # lazy: not needed for random-init paths
+
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        files = sorted(set(index["weight_map"].values()))
+    else:
+        files = [
+            f for f in sorted(os.listdir(model_dir)) if f.endswith(".safetensors")
+        ]
+    for fname in files:
+        with safe_open(os.path.join(model_dir, fname), framework="numpy") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_hf_checkpoint(model_dir: str, config: ModelConfig) -> Dict[str, Any]:
+    """Build the param pytree from a local HF model directory."""
+    c = config
+    raw: Dict[str, np.ndarray] = {}
+    for name, tensor in _open_safetensors(model_dir):
+        raw[name] = tensor
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("model.", ""):
+            if prefix + name in raw:
+                return raw[prefix + name]
+        raise KeyError(f"missing tensor {name!r} in {model_dir}")
+
+    def to_jnp(a: np.ndarray, transpose: bool) -> jnp.ndarray:
+        if a.dtype == np.uint16:  # bf16 stored raw
+            a = a.view(np.uint16)
+            out = jnp.asarray(a).view(jnp.bfloat16)
+        else:
+            out = jnp.asarray(a)
+        if transpose:
+            out = out.T
+        return out.astype(c.dtype)
+
+    layer_names = list(_HF_LAYER_MAP)
+    if not c.qkv_bias:
+        layer_names = [n for n in layer_names if not n.startswith("b")]
+    layers: Dict[str, List[jnp.ndarray]] = {n: [] for n in layer_names}
+    for i in range(c.n_layers):
+        for ours, (suffix, transpose) in _HF_LAYER_MAP.items():
+            if ours not in layers:
+                continue
+            layers[ours].append(to_jnp(get(f"layers.{i}.{suffix}"), transpose))
+
+    params: Dict[str, Any] = {
+        "embed": to_jnp(get("embed_tokens.weight"), False),
+        "layers": {n: jnp.stack(v) for n, v in layers.items()},
+        "final_norm": to_jnp(get("norm.weight"), False),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = to_jnp(raw["lm_head.weight"], True)
+    return params

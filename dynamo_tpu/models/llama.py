@@ -1,0 +1,197 @@
+"""Llama-family decoder: pure-JAX, scan-over-layers, paged KV cache.
+
+TPU-first design notes (vs the reference's torch engines):
+  - functional params pytree; layers stacked on a leading axis and consumed
+    by `lax.scan` — one traced layer body regardless of depth (fast compile,
+    XLA pipelines the per-layer HBM traffic).
+  - one `forward_paged` serves prefill, chunked prefill and decode: a chunk
+    of C tokens per sequence starting at `start_pos`, K/V written into the
+    block pool first, then attention over the pages (ops/attention.py).
+  - logical-axis annotations (parallel/sharding.py) drive tp/dp/sp layout;
+    XLA inserts the collectives.
+
+Covers Llama-2/3, Qwen2/2.5 (qkv_bias, tied embeddings), Mistral via
+ModelConfig knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization / logical sharding axes
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Random-init params (He-style scaled normal), layers stacked on axis 0."""
+    c = config
+    hd = c.head_dim_
+    L = c.n_layers
+    keys = jax.random.split(key, 12)
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(c.dtype)
+
+    d, ff, H, KH = c.d_model, c.d_ff, c.n_heads, c.n_kv_heads
+    s_d = d**-0.5
+    s_ff = ff**-0.5
+    layers: Params = {
+        "attn_norm": jnp.ones((L, d), dtype=c.dtype),
+        "wq": norm(keys[0], (L, d, H * hd), s_d),
+        "wk": norm(keys[1], (L, d, KH * hd), s_d),
+        "wv": norm(keys[2], (L, d, KH * hd), s_d),
+        "wo": norm(keys[3], (L, H * hd, d), (H * hd) ** -0.5),
+        "mlp_norm": jnp.ones((L, d), dtype=c.dtype),
+        "w_gate": norm(keys[4], (L, d, ff), s_d),
+        "w_up": norm(keys[5], (L, d, ff), s_d),
+        "w_down": norm(keys[6], (L, ff, d), s_ff),
+    }
+    if c.qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype=c.dtype)
+        layers["bk"] = jnp.zeros((L, KH * hd), dtype=c.dtype)
+        layers["bv"] = jnp.zeros((L, KH * hd), dtype=c.dtype)
+    params: Params = {
+        "embed": norm(keys[7], (c.vocab_size, d), 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype=c.dtype),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = norm(keys[8], (d, c.vocab_size), s_d)
+    return params
+
+
+def param_logical_axes(config: ModelConfig) -> Params:
+    """Logical axis names per param (see parallel/sharding.py rules)."""
+    layers = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "ffn"),
+        "w_up": ("layers", "embed", "ffn"),
+        "w_down": ("layers", "ffn", "embed"),
+    }
+    if config.qkv_bias:
+        layers["bq"] = ("layers", "heads")
+        layers["bk"] = ("layers", "kv_heads")
+        layers["bv"] = ("layers", "kv_heads")
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("embed",),
+    }
+    if not config.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def kv_cache_shape(
+    config: ModelConfig, num_blocks: int, block_size: int
+) -> Tuple[int, ...]:
+    return (config.n_layers, num_blocks, block_size, config.n_kv_heads, config.head_dim_)
+
+
+def init_kv_cache(
+    config: ModelConfig, num_blocks: int, block_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    shape = kv_cache_shape(config, num_blocks, block_size)
+    return jnp.zeros(shape, dtype=config.dtype), jnp.zeros(shape, dtype=config.dtype)
+
+
+def kv_cache_logical_axes() -> Tuple[str, ...]:
+    return ("layers", "kv_blocks", None, "kv_heads", "head_dim")
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def forward_paged(
+    params: Params,
+    config: ModelConfig,
+    tokens: jnp.ndarray,  # [B, C] int32
+    start_pos: jnp.ndarray,  # [B] int32
+    chunk_lens: jnp.ndarray,  # [B] int32
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    k_cache: jnp.ndarray,  # [L, num_blocks, block_size, KH, D]
+    v_cache: jnp.ndarray,
+    *,
+    use_kernel: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
+    v_cache). K/V for the chunk are scattered into the pools before attending,
+    so the same function implements prefill (large C), chunked prefill
+    (start_pos > 0), and decode (C = 1)."""
+    c = config
+    B, C = tokens.shape
+    hd = c.head_dim_
+
+    x = params["embed"][tokens]  # [B, C, d]
+
+    pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]
+
+    def layer_fn(carry, xs):
+        x = carry
+        lp, k_c, v_c = xs
+        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+        q = jnp.einsum("bcd,dh->bch", h, lp["wq"])
+        k = jnp.einsum("bcd,dh->bch", h, lp["wk"])
+        v = jnp.einsum("bcd,dh->bch", h, lp["wv"])
+        if c.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, C, c.n_heads, hd)
+        k = k.reshape(B, C, c.n_kv_heads, hd)
+        v = v.reshape(B, C, c.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_c = write_chunk_to_cache(k_c, k, block_tables, start_pos, chunk_lens)
+        v_c = write_chunk_to_cache(v_c, v, block_tables, start_pos, chunk_lens)
+
+        attn = paged_attention(
+            q, k_c, v_c, block_tables, start_pos, chunk_lens, use_kernel=use_kernel
+        )
+        x = x + attn.reshape(B, C, -1) @ lp["wo"]
+
+        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu(jnp.einsum("bcd,df->bcf", h, lp["w_gate"]))
+        up = jnp.einsum("bcd,df->bcf", h, lp["w_up"])
+        x = x + jnp.einsum("bcf,fd->bcd", gate * up, lp["w_down"])
+        return x, (k_c, v_c)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache)
+    )
+
+    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    # Only the last valid position's logits are needed (sampling).
+    last_idx = jnp.clip(chunk_lens - 1, 0, C - 1)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, d]
+    if c.tie_word_embeddings:
+        logits = x_last @ params["embed"].T
+    else:
+        logits = x_last @ params["lm_head"]
+    return logits.astype(jnp.float32), k_cache, v_cache

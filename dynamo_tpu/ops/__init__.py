@@ -1,0 +1,13 @@
+"""TPU compute ops: attention over paged KV, rope, norms, sampling.
+
+The hot ops the reference implements in CUDA (paged attention inside vLLM,
+block_copy.cu in KVBM — SURVEY §2.1) are implemented here twice: a pure-XLA
+reference path that runs anywhere (CPU tests, correctness oracle) and pallas
+TPU kernels under ops/pallas/ selected automatically on TPU backends.
+"""
+
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+from dynamo_tpu.ops.attention import paged_attention
+from dynamo_tpu.ops.sampling import sample_tokens
+
+__all__ = ["apply_rope", "rope_table", "paged_attention", "sample_tokens"]

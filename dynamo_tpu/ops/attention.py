@@ -1,0 +1,104 @@
+"""Attention over a paged KV cache.
+
+The framework's equivalent of the CUDA paged-attention kernels inside the
+reference's engines. One entrypoint `paged_attention` serves prefill, chunked
+prefill, and decode uniformly: queries are a chunk of C tokens starting at
+`start_pos` within each sequence; keys/values live in a block pool indexed by
+per-sequence block tables.
+
+Two implementations:
+  - XLA path (here): gather pages → dense masked attention. Runs on any
+    backend; the correctness oracle for the pallas kernel.
+  - pallas TPU kernel (ops/pallas/paged_attention.py): streams pages
+    HBM→VMEM with double buffering, flash-style online softmax; selected via
+    `use_kernel=True` (engine enables it on TPU backends).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_attention(
+    q: jnp.ndarray,  # [B, C, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32 (entries beyond seq = any)
+    start_pos: jnp.ndarray,  # [B] int32 — tokens already in cache before chunk
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid query tokens in the chunk
+    *,
+    sm_scale: Optional[float] = None,
+    use_kernel: bool = False,
+) -> jnp.ndarray:
+    """Returns [B, C, n_heads, head_dim].
+
+    The chunk's own K/V must already be written into the cache (the model
+    writes the chunk before attending); causality is enforced by masking key
+    position t to t <= start_pos + c for query offset c.
+    """
+    if use_kernel:
+        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+        return paged_attention_kernel(
+            q, k_cache, v_cache, block_tables, start_pos, chunk_lens, sm_scale=sm_scale
+        )
+    return _paged_attention_xla(
+        q, k_cache, v_cache, block_tables, start_pos, chunk_lens, sm_scale=sm_scale
+    )
+
+
+@partial(jax.jit, static_argnames=("sm_scale",))
+def _paged_attention_xla(q, k_cache, v_cache, block_tables, start_pos, chunk_lens, *, sm_scale=None):
+    B, C, n_heads, head_dim = q.shape
+    num_blocks, block_size, n_kv_heads, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    T = max_blocks * block_size
+    q_per_kv = n_heads // n_kv_heads
+    scale = sm_scale if sm_scale is not None else head_dim**-0.5
+
+    # Gather pages: [B, max_blocks, block_size, KH, D] → [B, T, KH, D]
+    k = k_cache[block_tables].reshape(B, T, n_kv_heads, head_dim)
+    v = v_cache[block_tables].reshape(B, T, n_kv_heads, head_dim)
+
+    # [B, C, KH, q_per_kv, D]
+    qg = q.reshape(B, C, n_kv_heads, q_per_kv, head_dim).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("bcghd,btgd->bcght", qg, kf) * scale  # [B,C,KH,G,T]
+
+    t_pos = jax.lax.broadcasted_iota(jnp.int32, (B, C, T), 2)
+    c_pos = jax.lax.broadcasted_iota(jnp.int32, (B, C, T), 1)
+    limit = start_pos[:, None, None] + c_pos  # key t visible iff t <= start+c
+    mask = t_pos <= limit  # [B, C, T]
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bcght,btgd->bcghd", probs, v.astype(jnp.float32))
+    return out.reshape(B, C, n_heads, head_dim).astype(q.dtype)
+
+
+def write_chunk_to_cache(
+    cache: jnp.ndarray,  # [num_blocks, block_size, KH, D]
+    chunk: jnp.ndarray,  # [B, C, KH, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    start_pos: jnp.ndarray,  # [B]
+    chunk_lens: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Scatter a chunk of K or V into its pages. Padding positions are dropped
+    (out-of-range block index + scatter mode='drop')."""
+    B, C = chunk.shape[:2]
+    num_blocks, block_size = cache.shape[:2]
+    c_off = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    pos = start_pos[:, None] + c_off  # [B, C]
+    valid = c_off < chunk_lens[:, None]
+    block_idx = jnp.take_along_axis(
+        block_tables, jnp.clip(pos // block_size, 0, block_tables.shape[1] - 1), axis=1
+    )
+    block_idx = jnp.where(valid, block_idx, num_blocks)  # OOB → dropped
+    slot = pos % block_size
+    return cache.at[block_idx, slot].set(chunk, mode="drop")

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (non-interleaved / HF "rotate_half" layout)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(positions: jnp.ndarray, head_dim: int, theta: float):
+    """cos/sin tables for integer positions.
+
+    positions: [...], returns (cos, sin) each [..., head_dim].
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    # rotate_half layout: duplicate for both halves
+    return (
+        jnp.concatenate([cos, cos], axis=-1),
+        jnp.concatenate([sin, sin], axis=-1),
+    )
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [..., n_heads, head_dim]; cos/sin: [..., head_dim] (broadcast over heads)."""
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    out = x.astype(jnp.float32) * cos + _rotate_half(x.astype(jnp.float32)) * sin
+    return out.astype(x.dtype)

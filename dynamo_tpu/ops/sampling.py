@@ -1,0 +1,63 @@
+"""Batched token sampling under jit.
+
+Greedy / temperature / top-k / top-p with per-sequence parameters so one
+compiled decode step serves a continuous batch of heterogeneous requests
+(the reference delegates this to vLLM's sampler; here it is part of the
+engine's fused decode step).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float
+    rng: jax.Array,  # single PRNG key
+    temperature: jnp.ndarray,  # [B] float; <=0 means greedy
+    top_k: jnp.ndarray,  # [B] int; <=0 means off
+    top_p: jnp.ndarray,  # [B] float; >=1 means off
+) -> jnp.ndarray:
+    """Returns sampled token ids [B]. Fully vectorized, no data-dependent
+    shapes: filters are applied as masks over the sorted vocab."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Sort once (descending); apply top-k and top-p masks in sorted space.
+    sort_idx = jnp.argsort(-scaled, axis=-1)  # [B, V]
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    ranks = jax.lax.broadcasted_iota(jnp.int32, (B, V), 1)
+    k = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep_k = ranks < k
+
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens while the cumulative mass *before* them is < top_p
+    # (always keeps the first token).
+    keep_p = (cum - probs) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+
+    keep = keep_k & keep_p
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
+    gumbel = jax.random.gumbel(rng, (B, V), dtype=jnp.float32)
+    choice_rank = jnp.argmax(masked + gumbel, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(sort_idx, choice_rank[:, None], axis=-1)[:, 0]
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def compute_logprobs(
+    logits: jnp.ndarray,  # [B, V]
+    token_ids: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Log-probability of the chosen tokens (for logprobs=N support)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]

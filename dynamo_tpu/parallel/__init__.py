@@ -1,0 +1,38 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+TPU-first design: scaling is expressed as a `jax.sharding.Mesh` with named
+axes plus `NamedSharding` annotations on params/caches/activations; XLA
+inserts the collectives (psum/all-gather/reduce-scatter) that the reference
+delegates to NCCL inside its engines (SURVEY §2.4, §2.5).
+
+Axes:
+  dp — data parallel (batch replicas inside one engine step)
+  tp — tensor parallel (attention heads / MLP hidden)
+  sp — sequence parallel (long-context prefill: shard the sequence axis)
+  ep — expert parallel (MoE experts)
+  pp — pipeline parallel (layer stages; engine-level, round 2+)
+"""
+
+from dynamo_tpu.parallel.mesh import (
+    AxisNames,
+    MeshConfig,
+    make_mesh,
+    local_mesh,
+)
+from dynamo_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_physical,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "AxisNames",
+    "MeshConfig",
+    "make_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "logical_to_physical",
+    "param_shardings",
+    "shard_params",
+]

@@ -2,8 +2,7 @@
 
 Reference parity: lib/tokens/src/{lib.rs,blocks.rs} — the reference chains
 blake3 over (parent_hash, token_bytes); we chain xxh3_64 (available here,
-similar speed class) over the same structure. A C++ fast path lives in
-native/ (loaded lazily; Python fallback always available).
+similar speed class) over the same structure.
 
 Only complete blocks are hashed: a sequence of 150 tokens with block_size 64
 yields 2 hashes covering tokens [0,128). Partial tail blocks are not

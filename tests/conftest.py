@@ -12,12 +12,18 @@ import inspect
 import os
 
 if os.environ.get("DYN_TPU_TEST_TPU") != "1":
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The environment pre-imports jax (sitecustomize) with JAX_PLATFORMS
+    # pointing at the TPU plugin, so a plain env override is too late —
+    # use the config API before any backend initializes.
+    os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

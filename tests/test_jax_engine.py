@@ -1,0 +1,187 @@
+"""JaxEngine integration tests: continuous batching, prefix cache, KV events,
+cancellation, preemption — mirroring the reference's mocker-based suites
+(SURVEY §4) but against the real compiled engine on CPU."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engines.tpu import BlockPool, JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+
+
+def make_engine(**over):
+    defaults = dict(
+        config=tiny_config(),
+        block_size=4,
+        num_kv_blocks=64,
+        max_num_seqs=4,
+        max_model_len=128,
+        prefill_chunk=32,
+    )
+    defaults.update(over)
+    events = []
+    engine = JaxEngine(JaxEngineArgs(**defaults), on_kv_event=events.append)
+    return engine, events
+
+
+def req(tokens, max_tokens=8, **kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        request_id="r",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens),
+        **kw,
+    )
+
+
+async def run_one(engine, request):
+    return await collect(engine.generate(request, Context()))
+
+
+async def test_generates_tokens_greedy_deterministic():
+    engine, _ = make_engine()
+    try:
+        out1 = await run_one(engine, req(range(10, 22), max_tokens=6))
+        toks1 = [t for o in out1 for t in o.token_ids]
+        assert len(toks1) == 6
+        assert out1[-1].finish_reason == FinishReason.LENGTH
+        # prefix cache cleared between runs shouldn't change greedy output
+        out2 = await run_one(engine, req(range(10, 22), max_tokens=6))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert toks1 == toks2
+    finally:
+        await engine.stop()
+
+
+async def test_concurrent_requests_continuous_batching():
+    engine, _ = make_engine()
+    try:
+        reqs = [req(range(5 + i, 15 + i), max_tokens=5) for i in range(6)]
+        outs = await asyncio.gather(*(run_one(engine, r) for r in reqs))
+        for out in outs:
+            toks = [t for o in out for t in o.token_ids]
+            assert len(toks) == 5
+        assert engine.steps > 0
+    finally:
+        await engine.stop()
+
+
+async def test_prefix_cache_reuse_skips_prefill():
+    engine, events = make_engine()
+    try:
+        prompt = list(range(20, 36))  # 16 tokens = 4 full blocks
+        await run_one(engine, req(prompt, max_tokens=2))
+        prefill_after_first = engine.prefill_tokens
+        assert engine.pool.cached_blocks > 0
+        await run_one(engine, req(prompt, max_tokens=2))
+        # Second run prefills only the non-cached suffix (< full prompt).
+        assert engine.prefill_tokens - prefill_after_first < len(prompt)
+        stored = [e for e in events if e.kind == "stored"]
+        assert stored  # KV events emitted for router indexing
+    finally:
+        await engine.stop()
+
+
+async def test_eos_stops_generation():
+    engine, _ = make_engine()
+    try:
+        # Find which token greedy decoding emits first, then use it as EOS.
+        out = await run_one(engine, req(range(30, 40), max_tokens=3))
+        first = out[0].token_ids[0]
+        out2 = await run_one(
+            engine,
+            PreprocessedRequest(
+                token_ids=list(range(30, 40)),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=50),
+                eos_token_ids=[first],
+            ),
+        )
+        assert out2[-1].finish_reason == FinishReason.EOS
+        assert len([t for o in out2 for t in o.token_ids]) == 1
+    finally:
+        await engine.stop()
+
+
+async def test_cancellation_mid_stream():
+    engine, _ = make_engine()
+    try:
+        ctx = Context()
+        got = []
+
+        async def consume():
+            async for o in engine.generate(req(range(40, 50), max_tokens=100), ctx):
+                got.append(o)
+                if len(got) == 2:
+                    ctx.stop_generating()
+
+        await asyncio.wait_for(consume(), timeout=30)
+        assert len(got) < 100
+        assert engine.pool.active_blocks == 0  # blocks released
+    finally:
+        await engine.stop()
+
+
+async def test_pool_exhaustion_queues_then_completes():
+    # Pool fits roughly one sequence at a time; all must still complete.
+    engine, _ = make_engine(num_kv_blocks=10, max_num_seqs=2, max_model_len=40)
+    try:
+        reqs = [req(range(i * 7, i * 7 + 20), max_tokens=4) for i in range(3)]
+        outs = await asyncio.gather(*(run_one(engine, r) for r in reqs))
+        for out in outs:
+            assert len([t for o in out for t in o.token_ids]) == 4
+    finally:
+        await engine.stop()
+
+
+async def test_prompt_too_long_rejected():
+    engine, _ = make_engine(max_model_len=16)
+    try:
+        out = await run_one(engine, req(range(100), max_tokens=4))
+        assert out[-1].finish_reason == FinishReason.ERROR
+    finally:
+        await engine.stop()
+
+
+async def test_logprobs_returned():
+    engine, _ = make_engine()
+    try:
+        r = req(range(10, 20), max_tokens=3)
+        r.sampling.logprobs = 1
+        out = await run_one(engine, r)
+        steps = [o for o in out if o.token_ids]
+        assert all(o.logprobs and o.logprobs[0][0].logprob <= 0.0 for o in steps)
+    finally:
+        await engine.stop()
+
+
+def test_block_pool_reuse_and_eviction():
+    events = []
+    pool = BlockPool(4, 4, on_event=events.append)
+    b0 = pool.alloc()
+    b1 = pool.alloc()
+    pool.commit(b0, 111, None)
+    pool.commit(b1, 222, 111)
+    assert pool.match_prefix([111, 222]) == 2
+    pool.release([b0, b1], [111, 222])
+    assert pool.cached_blocks == 2
+    # Re-pin from cache
+    matched, ids = pool.pin_prefix([111, 222, 333])
+    assert matched == 2 and ids == [b0, b1]
+    pool.release(ids, [111, 222])
+    # Exhaust the pool: cached blocks get evicted LRU-first
+    got = [pool.alloc() for _ in range(4)]
+    assert None not in got
+    assert pool.alloc() is None
+    removed = [e for e in events if e.kind == "removed"]
+    assert removed and removed[0].block_hashes == [111]

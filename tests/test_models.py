@@ -1,0 +1,248 @@
+"""Model-layer tests: paged forward correctness, rope, sampling, sharding.
+
+The paged forward is checked against a dense oracle (full-context attention
+computed directly with jnp) — the same role the reference's Rust unit tests
+play for its kernels (SURVEY §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig, tiny_config
+from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.parallel import MeshConfig, ShardingRules, make_mesh, shard_params
+
+
+def dense_reference(params, config, tokens):
+    """Straight-line causal transformer forward (oracle). tokens: [S]."""
+    c = config
+    S = tokens.shape[0]
+    hd = c.head_dim_
+    x = params["embed"][tokens][None]  # [1, S, d]
+    pos = jnp.arange(S)[None]
+    cos, sin = rope_table(pos, hd, c.rope_theta)
+
+    def rms(x, w):
+        xf = x.astype(jnp.float32)
+        return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + c.rms_norm_eps)).astype(x.dtype) * w
+
+    lp_all = params["layers"]
+    for l in range(c.n_layers):
+        lp = {k: v[l] for k, v in lp_all.items()}
+        h = rms(x, lp["attn_norm"])
+        q = (h @ lp["wq"]).reshape(1, S, c.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(1, S, c.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(1, S, c.n_kv_heads, hd)
+        if c.qkv_bias:
+            q = q + lp["bq"].reshape(c.n_heads, hd)
+            k = k + lp["bk"].reshape(c.n_kv_heads, hd)
+            v = v + lp["bv"].reshape(c.n_kv_heads, hd)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        # GQA expand
+        rep = c.n_heads // c.n_kv_heads
+        kx = jnp.repeat(k, rep, axis=2).astype(jnp.float32)
+        vx = jnp.repeat(v, rep, axis=2).astype(jnp.float32)
+        scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), kx) * hd**-0.5
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        attn = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(scores, -1), vx)
+        x = x + attn.reshape(1, S, -1).astype(x.dtype) @ lp["wo"]
+        h = rms(x, lp["mlp_norm"])
+        x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    x = rms(x, params["final_norm"])
+    head = params["embed"].T if c.tie_word_embeddings else params["lm_head"]
+    return (x[0] @ head).astype(jnp.float32)  # [S, V]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _paged_setup(cfg, num_blocks=32, block_size=4):
+    k, v = llama.init_kv_cache(cfg, num_blocks, block_size)
+    return k, v, block_size
+
+
+def test_paged_prefill_matches_dense(tiny):
+    cfg, params = tiny
+    S = 11
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (S,), 4, cfg.vocab_size)
+    oracle = dense_reference(params, cfg, tokens)  # [S, V]
+
+    k_c, v_c, bs = _paged_setup(cfg)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :4] = [3, 5, 7, 9]
+    logits, k_c, v_c = llama.forward_paged(
+        params, cfg, tokens[None], jnp.array([0]), jnp.array([S]),
+        jnp.asarray(table), k_c, v_c,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(oracle[-1]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_chunked_prefill_and_decode_match_dense(tiny):
+    """Prefill in chunks, then decode token-by-token — every step's logits
+    must match the dense forward over the growing sequence."""
+    cfg, params = tiny
+    S = 10
+    tokens = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(2), (S,), 4, cfg.vocab_size)
+    )
+    k_c, v_c, bs = _paged_setup(cfg)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :8] = np.arange(1, 9)
+
+    # chunked prefill: 6 + 4
+    for start, n in ((0, 6), (6, 4)):
+        chunk = np.zeros((1, 8), dtype=np.int32)
+        chunk[0, :n] = tokens[start : start + n]
+        logits, k_c, v_c = llama.forward_paged(
+            params, cfg, jnp.asarray(chunk), jnp.array([start]), jnp.array([n]),
+            jnp.asarray(table), k_c, v_c,
+        )
+    oracle = dense_reference(params, cfg, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(oracle[-1]), rtol=2e-4, atol=2e-4)
+
+    # decode three tokens greedily, verifying each against the oracle
+    seq = list(tokens)
+    for _ in range(3):
+        nxt = int(np.argmax(np.asarray(logits[0])))
+        seq.append(nxt)
+        logits, k_c, v_c = llama.forward_paged(
+            params, cfg, jnp.array([[nxt]]), jnp.array([len(seq) - 1]),
+            jnp.array([1]), jnp.asarray(table), k_c, v_c,
+        )
+        oracle = dense_reference(params, cfg, jnp.asarray(np.array(seq)))
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(oracle[-1]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_batched_decode_isolated_per_sequence(tiny):
+    """Two sequences decoding in one batch must not leak KV across block
+    tables; inactive padding slots must not corrupt the cache."""
+    cfg, params = tiny
+    k_c, v_c, bs = _paged_setup(cfg)
+    t1 = np.asarray(jax.random.randint(jax.random.PRNGKey(3), (7,), 4, cfg.vocab_size))
+    t2 = np.asarray(jax.random.randint(jax.random.PRNGKey(4), (5,), 4, cfg.vocab_size))
+
+    table = np.zeros((3, 4), dtype=np.int32)
+    table[0, :2] = [1, 2]
+    table[1, :2] = [3, 4]
+    for i, toks in ((0, t1), (1, t2)):
+        pad = np.zeros((1, 8), dtype=np.int32)
+        pad[0, : len(toks)] = toks
+        _, k_c, v_c = llama.forward_paged(
+            params, cfg, jnp.asarray(pad), jnp.array([0]), jnp.array([len(toks)]),
+            jnp.asarray(table[i : i + 1]), k_c, v_c,
+        )
+    # batched decode: seq0 at pos 7, seq1 at pos 5, slot 2 inactive
+    nxt = np.array([[t1[-1]], [t2[-1]], [0]], dtype=np.int32)
+    # (re-do last token as a decode step: rewrite same KV, harmless)
+    logits, k_c, v_c = llama.forward_paged(
+        params, cfg, jnp.asarray(nxt), jnp.array([6, 4, 0]), jnp.array([1, 1, 0]),
+        jnp.asarray(table), k_c, v_c,
+    )
+    o1 = dense_reference(params, cfg, jnp.asarray(t1))
+    o2 = dense_reference(params, cfg, jnp.asarray(t2))
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(o1[-1]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(logits[1]), np.asarray(o2[-1]), rtol=2e-4, atol=2e-4)
+
+
+def test_prefix_cache_skip_matches_full(tiny):
+    """start_pos > 0 with a pre-populated cache (prefix hit) must equal the
+    full recompute."""
+    cfg, params = tiny
+    S = 8
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(5), (S,), 4, cfg.vocab_size))
+    table = np.zeros((1, 4), dtype=np.int32)
+    table[0, :2] = [1, 2]
+
+    k_c, v_c, bs = _paged_setup(cfg)  # bs=4
+    full = np.zeros((1, 8), dtype=np.int32)
+    full[0] = tokens
+    llogits_full, k_full, v_full = llama.forward_paged(
+        params, cfg, jnp.asarray(full), jnp.array([0]), jnp.array([S]),
+        jnp.asarray(table), k_c, v_c,
+    )
+    # Now simulate: first block (4 tokens) cached; prefill only the suffix.
+    suffix = np.zeros((1, 4), dtype=np.int32)
+    suffix[0] = tokens[4:]
+    logits_suffix, _, _ = llama.forward_paged(
+        params, cfg, jnp.asarray(suffix), jnp.array([4]), jnp.array([4]),
+        jnp.asarray(table), k_full, v_full,  # cache already holds block 0
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_suffix[0]), np.asarray(llogits_full[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_sampling_modes():
+    rng = jax.random.PRNGKey(0)
+    logits = jnp.array([[0.0, 5.0, 1.0, -2.0]] * 3)
+    greedy = sample_tokens(
+        logits, rng,
+        jnp.array([0.0, 0.0, 0.0]), jnp.array([0, 0, 0]), jnp.array([1.0, 1.0, 1.0]),
+    )
+    assert list(np.asarray(greedy)) == [1, 1, 1]
+    # top_k=1 forces the argmax even at high temperature
+    topk1 = sample_tokens(
+        logits, rng,
+        jnp.array([5.0, 5.0, 5.0]), jnp.array([1, 1, 1]), jnp.array([1.0, 1.0, 1.0]),
+    )
+    assert list(np.asarray(topk1)) == [1, 1, 1]
+    # tiny top_p keeps only the head of the distribution
+    topp = sample_tokens(
+        logits, rng,
+        jnp.array([1.0, 1.0, 1.0]), jnp.array([0, 0, 0]), jnp.array([1e-6, 1e-6, 1e-6]),
+    )
+    assert list(np.asarray(topp)) == [1, 1, 1]
+
+
+def test_sampled_distribution_respects_temperature():
+    rng = jax.random.PRNGKey(7)
+    logits = jnp.tile(jnp.array([[2.0, 1.0, 0.0, -1.0]]), (512, 1))
+    toks = sample_tokens(
+        logits, rng,
+        jnp.full((512,), 1.0), jnp.zeros((512,), jnp.int32), jnp.ones((512,)),
+    )
+    counts = np.bincount(np.asarray(toks), minlength=4)
+    assert counts[0] > counts[2] > 0  # monotone-ish with logit order
+
+
+def test_sharded_forward_on_mesh(tiny):
+    """Paged forward under tp=2 × dp=2 mesh (virtual CPU devices) must
+    compile, run, and match the unsharded result. tp is capped by
+    n_kv_heads=2 in the tiny config (KV cache shards over kv_heads)."""
+    cfg, params = tiny
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    rules = ShardingRules()
+    sharded = shard_params(params, llama.param_logical_axes(cfg), rules, mesh)
+    k_c, v_c, _ = _paged_setup(cfg)
+    cache_sh = rules.sharding(mesh, *llama.kv_cache_logical_axes())
+    k_s = jax.device_put(k_c, cache_sh)
+    v_s = jax.device_put(v_c, cache_sh)
+
+    tokens = np.zeros((2, 8), dtype=np.int32)
+    tokens[0, :6] = [5, 6, 7, 8, 9, 10]
+    tokens[1, :4] = [11, 12, 13, 14]
+    table = np.zeros((2, 4), dtype=np.int32)
+    table[0, :2] = [1, 2]
+    table[1, :2] = [3, 4]
+    args = (
+        jnp.asarray(tokens), jnp.array([0, 0]), jnp.array([6, 4]), jnp.asarray(table),
+    )
+    ref_logits, _, _ = llama.forward_paged(params, cfg, *args, k_c, v_c)
+    sh_logits, _, _ = llama.forward_paged(sharded, cfg, *args, k_s, v_s)
+    np.testing.assert_allclose(
+        np.asarray(sh_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
