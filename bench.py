@@ -1,0 +1,130 @@
+"""Benchmark: aggregated serving throughput of the native JAX engine.
+
+Runs on whatever chip JAX sees (the driver provides one real TPU). AIPerf-
+style fixed ISL/OSL/concurrency workload (BASELINE.md measurement plan,
+config 1: Qwen2.5-0.5B-shape aggregated worker, random weights — weights
+don't affect throughput).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+supporting fields. vs_baseline compares tokens/sec/chip against an assumed
+A100-vLLM anchor for a 0.5B-class model (BASELINE.md north star: ≥ A100-vLLM
+tokens/sec/chip); the anchor is an estimate recorded here, not a measured
+number from the reference tree (it publishes none for this shape).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+
+# Persistent XLA compilation cache: first bench run pays the compiles,
+# subsequent runs (and driver re-runs) hit the cache.
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# A100 + vLLM, 0.5B-class model, moderate concurrency: ~5k decode tok/s/GPU
+# (estimate; the reference repo publishes no in-tree number for this shape).
+BASELINE_TOKS_PER_SEC_PER_CHIP = 5000.0
+
+ISL = 128
+OSL = 64
+CONCURRENCY = 16
+REQUESTS = 32
+
+
+async def run_bench():
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = qwen2_500m_config()
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=cfg,
+            block_size=16,
+            num_kv_blocks=1024,
+            max_num_seqs=CONCURRENCY,
+            max_model_len=512,
+            prefill_chunk=128,
+            enable_prefix_caching=True,
+            decode_steps=16,
+        )
+    )
+
+    rng = np.random.default_rng(0)
+
+    def make_req(i: int) -> PreprocessedRequest:
+        return PreprocessedRequest(
+            token_ids=rng.integers(10, cfg.vocab_size - 10, size=ISL).tolist(),
+            request_id=f"bench-{i}",
+            sampling=SamplingOptions(temperature=1.0, top_p=0.95),
+            stop=StopConditions(max_tokens=OSL, ignore_eos=True),
+        )
+
+    async def run_one(req):
+        t0 = time.monotonic()
+        ttft = None
+        n = 0
+        async for out in engine.generate(req, Context()):
+            if out.token_ids:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                n += len(out.token_ids)
+        return n, ttft, time.monotonic() - t0
+
+    async def run_wave(count, offset):
+        sem = asyncio.Semaphore(CONCURRENCY)
+
+        async def limited(i):
+            async with sem:
+                return await run_one(make_req(offset + i))
+
+        return await asyncio.gather(*(limited(i) for i in range(count)))
+
+    # Warmup wave triggers all jit compiles (prefill buckets + decode buckets).
+    await run_wave(CONCURRENCY, offset=10_000)
+
+    t0 = time.monotonic()
+    results = await run_wave(REQUESTS, offset=0)
+    wall = time.monotonic() - t0
+    await engine.stop()
+
+    total_tokens = sum(r[0] for r in results)
+    ttfts = sorted(r[1] for r in results if r[1] is not None)
+    itls = sorted(
+        (r[2] - r[1]) / max(r[0] - 1, 1) for r in results if r[1] is not None
+    )
+    toks_per_sec = total_tokens / wall
+    n_chips = jax.device_count()
+    value = toks_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "aggregated decode throughput (qwen2.5-0.5b-shape, ISL=128, OSL=64)",
+                "value": round(value, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(value / BASELINE_TOKS_PER_SEC_PER_CHIP, 4),
+                "total_tokens": total_tokens,
+                "wall_s": round(wall, 2),
+                "p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+                "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
+                "n_chips": n_chips,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(run_bench())

@@ -77,6 +77,14 @@ DISCOVERY_ADDR = env_str(
     "DYN_TPU_DISCOVERY_ADDR", "127.0.0.1:6180", "discd service address or file-backend directory"
 )
 EVENT_PLANE = env_str("DYN_TPU_EVENT_PLANE", "zmq", "Event plane: memory|zmq")
+EVENT_PLANE_ADDR = env_str(
+    "DYN_TPU_EVENT_PLANE_ADDR",
+    "127.0.0.1:6181:6182",
+    "ZMQ event broker address host:xsub_port:xpub_port",
+)
+TCP_HOST = env_str(
+    "DYN_TPU_TCP_HOST", "127.0.0.1", "Advertised host for the TCP request plane"
+)
 LEASE_TTL = env_float("DYN_TPU_LEASE_TTL", 10.0, "Discovery lease TTL seconds")
 LOG_LEVEL = env_str("DYN_TPU_LOG", "info", "Log level (trace|debug|info|warn|error)")
 LOG_JSON = env_bool("DYN_TPU_LOG_JSON", False, "Emit JSONL structured logs")

@@ -1,0 +1,30 @@
+"""Disaggregated prefill/decode serving.
+
+Reference parity: lib/llm/src/kv_router/prefill_router.rs (+ disagg_serving
+design doc): a prefill worker computes the prompt KV + first token; the
+decode worker receives the KV and continues generation. The reference moves
+KV with NIXL GPUDirect RDMA; TPU-native equivalent (SURVEY §2.5 note) is
+content-addressed block transfer: blocks are keyed by chained hash, exported
+from the prefill engine's HBM, shipped host-staged over the request plane
+(DCN path), and imported into the decode engine's pool as cached blocks —
+after which ordinary prefix-cached admission reuses them, and the partial
+tail block is recomputed locally (cheap).
+"""
+
+from dynamo_tpu.disagg.handlers import (
+    DecodeHandler,
+    KvTransferHandler,
+    PrefillHandler,
+    pack_array,
+    unpack_array,
+)
+from dynamo_tpu.disagg.prefill_router import PrefillRouter
+
+__all__ = [
+    "DecodeHandler",
+    "KvTransferHandler",
+    "PrefillHandler",
+    "PrefillRouter",
+    "pack_array",
+    "unpack_array",
+]

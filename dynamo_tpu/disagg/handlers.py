@@ -1,0 +1,168 @@
+"""Worker-side disaggregation handlers.
+
+Reference parity: components/src/dynamo/vllm/handlers.py
+(PrefillWorkerHandler :1469, DecodeWorkerHandler :1254) re-designed around
+content-addressed KV blocks instead of NIXL descriptors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    DisaggregatedParams,
+    FinishReason,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def pack_array(a) -> Dict[str, Any]:
+    arr = np.asarray(a)
+    return {"b": arr.tobytes(), "shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+
+def unpack_array(d: Dict[str, Any]) -> np.ndarray:
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+
+    return np.frombuffer(d["b"], dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+
+
+class PrefillHandler:
+    """Serve a prefill worker: compute prompt KV + first token, return
+    bootstrap metadata (ref: PrefillWorkerHandler.generate handlers.py:1498)."""
+
+    def __init__(self, engine: Any, worker_id: int) -> None:
+        self._engine = engine
+        self.worker_id = worker_id
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(dict(request))
+        )
+        prompt = list(req.token_ids)
+        block_size = self._engine.args.block_size
+        hashes = compute_block_hashes(prompt, block_size)
+        prefill_req = PreprocessedRequest.from_dict(req.to_dict())
+        prefill_req.stop.max_tokens = 1
+        prefill_req.stop.min_tokens = None
+        prefill_req.stop.ignore_eos = True
+
+        first: Optional[BackendOutput] = None
+        async for out in self._engine.generate(prefill_req, context):
+            if out.error:
+                yield out
+                return
+            if out.token_ids:
+                first = out
+                break
+        if first is None:
+            yield BackendOutput(
+                error="prefill produced no token", finish_reason=FinishReason.ERROR
+            )
+            return
+        yield BackendOutput(
+            token_ids=first.token_ids,
+            logprobs=first.logprobs,
+            cumulative_tokens=1,
+            disaggregated_params=DisaggregatedParams(
+                worker_id=self.worker_id,
+                prefilled_tokens=len(prompt),
+                kv_transfer={
+                    "block_hashes": hashes,
+                    "block_size": block_size,
+                    "first_token": first.token_ids[0],
+                },
+            ),
+            finish_reason=FinishReason.LENGTH,
+        )
+
+
+class KvTransferHandler:
+    """Serve content-addressed KV block export (the 'kv' side-channel
+    endpoint; plays the role of the NIXL read target)."""
+
+    def __init__(self, engine: Any) -> None:
+        self._engine = engine
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        hashes: List[int] = list(request.get("block_hashes") or [])
+        found, k, v = await self._engine.export_blocks_async(hashes)
+        if not found:
+            yield {"found": [], "k": None, "v": None}
+            return
+        yield {"found": found, "k": pack_array(k), "v": pack_array(v)}
+
+
+class DecodeHandler:
+    """Serve a decode worker: import transferred KV (if the request carries
+    disaggregated_params), then generate normally — prefix-cached admission
+    picks up the imported blocks (ref: DecodeWorkerHandler handlers.py:1254)."""
+
+    def __init__(self, engine: Any, kv_client_factory=None) -> None:
+        self._engine = engine
+        # async () -> Client for the prefill component's "kv" endpoint
+        self._kv_client_factory = kv_client_factory
+        self._kv_client = None
+
+    async def _pull_blocks(self, dp: DisaggregatedParams) -> int:
+        info = dp.kv_transfer or {}
+        hashes = list(info.get("block_hashes") or [])
+        if not hashes or self._kv_client_factory is None:
+            return 0
+        # Skip blocks already resident (earlier transfer or shared prefix).
+        missing_from = 0
+        pool = self._engine.pool
+        for i, h in enumerate(hashes):
+            if h not in pool._by_hash:
+                missing_from = i
+                break
+        else:
+            return 0
+        want = hashes[missing_from:]
+        if self._kv_client is None:
+            self._kv_client = await self._kv_client_factory()
+        try:
+            async for reply in self._kv_client.direct(
+                {"op": "export", "block_hashes": want}, dp.worker_id
+            ):
+                if not reply.get("found"):
+                    return 0
+                k = unpack_array(reply["k"])
+                v = unpack_array(reply["v"])
+                return await self._engine.import_blocks_async(reply["found"], k, v)
+        except Exception:
+            logger.exception(
+                "KV pull from prefill worker %s failed; decoding with local prefill",
+                dp.worker_id,
+            )
+        return 0
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_dict(dict(request))
+        )
+        if req.disaggregated_params is not None:
+            pulled = await self._pull_blocks(req.disaggregated_params)
+            if pulled:
+                logger.info(
+                    "imported %d KV blocks from prefill worker %s",
+                    pulled, req.disaggregated_params.worker_id,
+                )
+        async for out in self._engine.generate(req, context):
+            yield out

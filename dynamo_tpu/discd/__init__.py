@@ -1,0 +1,7 @@
+"""Control-plane services entrypoint (discd discovery + ZMQ event broker).
+
+The single-process stand-in for the reference's etcd + nats-server pair
+(tests/conftest.py in the reference boots both per session — SURVEY §4).
+
+    python -m dynamo_tpu.discd --port 6180 --xsub 6181 --xpub 6182
+"""

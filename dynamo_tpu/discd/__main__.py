@@ -1,0 +1,41 @@
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.runtime.discovery.discd import DiscdServer
+from dynamo_tpu.runtime.events.zmq_plane import EventBroker
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu control plane services")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6180, help="discd KV port")
+    parser.add_argument("--xsub", type=int, default=6181, help="event broker XSUB port")
+    parser.add_argument("--xpub", type=int, default=6182, help="event broker XPUB port")
+    parser.add_argument("--no-events", action="store_true", help="discovery only")
+    args = parser.parse_args()
+
+    configure_logging()
+    server = DiscdServer(args.host, args.port)
+    await server.start()
+    broker = None
+    if not args.no_events:
+        broker = EventBroker(args.host, args.xsub, args.xpub)
+        broker.start()
+    print(
+        f"discd ready: discovery {args.host}:{server.bound_port}"
+        + (f", events {broker.address}" if broker else ""),
+        flush=True,
+    )
+    try:
+        await asyncio.Event().wait()
+    finally:
+        if broker:
+            await broker.close()
+        await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -21,6 +21,7 @@ prefix caching, KV events, chunked prefill) — but TPU-native:
 from __future__ import annotations
 
 import asyncio
+import functools
 import math
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -65,6 +66,10 @@ class JaxEngineArgs:
     enable_prefix_caching: bool = True
     use_kernel: Optional[bool] = None  # None = auto (pallas on TPU)
     seed: int = 0
+    # Fused decode iterations per dispatch (llama.decode_multi). Dispatch
+    # latency dominates small-model decode on TPU; stop conditions are
+    # evaluated host-side at this granularity (overshoot discarded).
+    decode_steps: int = 8
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -88,6 +93,12 @@ class _Sequence:
 
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(cache, idx, blocks):
+    """cache [L, NB, BS, KH, D] ← blocks [L, n, BS, KH, D] at idx [n]."""
+    return cache.at[:, idx].set(blocks)
 
 
 class JaxEngine:
@@ -133,6 +144,7 @@ class JaxEngine:
 
         self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
         self._step_fn = self._build_step_fn()
+        self._decode_fn = self._build_decode_fn()
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -170,6 +182,35 @@ class JaxEngine:
             return toks, logp, k_cache, v_cache
 
         return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_decode_fn(self):
+        cfg = self.config
+        use_kernel = self._use_kernel
+        num_steps = self.args.decode_steps
+
+        def step(params, k_cache, v_cache, tokens, start_pos, active,
+                 block_tables, rng, temp, topk, topp):
+            return llama.decode_multi(
+                params, cfg, tokens, start_pos, active, block_tables,
+                k_cache, v_cache, rng, temp, topk, topp,
+                num_steps=num_steps, use_kernel=use_kernel,
+            )
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _run_decode(
+        self, tokens, start_pos, active, block_tables, temp, topk, topp
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Multi-step decode on the device thread. Returns ([B, K] tokens,
+        [B, K] logprobs)."""
+        self._rng, sub = jax.random.split(self._rng)
+        toks, logp, self._k_cache, self._v_cache = self._decode_fn(
+            self.params, self._k_cache, self._v_cache,
+            jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
+            jnp.asarray(block_tables), sub,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+        )
+        return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp
@@ -402,8 +443,9 @@ class JaxEngine:
 
     async def _decode_tick(self) -> None:
         args = self.args
-        # Ensure every active sequence has a block for its next position;
-        # preempt (recompute later) the youngest if the pool is dry.
+        K = args.decode_steps
+        # Ensure every active sequence has blocks covering the next K
+        # positions; preempt (recompute later) the youngest if the pool is dry.
         for slot in range(args.max_num_seqs - 1, -1, -1):
             seq = self._slots[slot]
             if seq is None:
@@ -415,56 +457,73 @@ class JaxEngine:
             if pos >= args.max_model_len:
                 self._finish(seq, FinishReason.LENGTH)
                 continue
-            need_block = pos // args.block_size
-            if need_block >= len(seq.block_ids):
+            last_pos = min(pos + K - 1, args.max_blocks_per_seq * args.block_size - 1)
+            need_blocks = last_pos // args.block_size + 1
+            ok = True
+            while len(seq.block_ids) < need_blocks:
                 b = self.pool.alloc()
                 if b is None:
                     self._preempt(seq)
-                    continue
+                    ok = False
+                    break
+                self._block_tables[slot, len(seq.block_ids)] = b
                 seq.block_ids.append(b)
-                self._block_tables[slot, need_block] = b
+            if not ok:
+                continue
 
         active = [s for s in self._slots if s is not None]
         if not active:
             return
 
-        tokens = np.zeros((args.max_num_seqs, 1), dtype=np.int32)
-        chunk_lens = np.zeros(args.max_num_seqs, dtype=np.int32)
+        tokens = np.zeros(args.max_num_seqs, dtype=np.int32)
+        active_mask = np.zeros(args.max_num_seqs, dtype=np.int32)
         max_blocks = 1
         for seq in active:
-            tokens[seq.slot, 0] = seq.next_token
-            chunk_lens[seq.slot] = 1
-            max_blocks = max(max_blocks, int(self._pos[seq.slot]) // args.block_size + 1)
+            tokens[seq.slot] = seq.next_token
+            active_mask[seq.slot] = 1
+            max_blocks = max(
+                max_blocks,
+                (int(self._pos[seq.slot]) + K - 1) // args.block_size + 1,
+            )
         nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
 
         toks, logps = await self._device(
-            self._run_step,
+            self._run_decode,
             tokens,
             self._pos.copy(),
-            chunk_lens,
+            active_mask,
             self._block_tables[:, :nb_bucket].copy(),
             self._temp.copy(), self._topk.copy(), self._topp.copy(),
         )
         self.steps += 1
 
         for seq in list(active):
-            if self._slots[seq.slot] is not seq:
-                continue  # finished/preempted above
             slot = seq.slot
-            self._pos[slot] += 1  # the input token's KV is now resident
-            # Block-boundary: the just-completed block becomes shareable.
-            if args.enable_prefix_caching and int(self._pos[slot]) % args.block_size == 0:
-                bi = int(self._pos[slot]) // args.block_size - 1
-                if bi < len(seq.block_ids) and bi == len(seq.block_hashes):
-                    parent = seq.block_hashes[-1] if seq.block_hashes else None
-                    h = compute_block_hashes(
-                        seq.all_tokens[bi * args.block_size : (bi + 1) * args.block_size],
-                        args.block_size,
-                        parent_hash=parent,
-                    )[0]
-                    self.pool.commit(seq.block_ids[bi], h, parent)
-                    seq.block_hashes.append(h)
-            self._emit_token(seq, int(toks[slot]), float(logps[slot]))
+            for k in range(K):
+                if self._slots[slot] is not seq:
+                    break  # finished mid-burst; discard overshoot tokens
+                self._pos[slot] += 1  # the input token's KV is now resident
+                self._maybe_commit_block(seq, slot)
+                self._emit_token(seq, int(toks[slot, k]), float(logps[slot, k]))
+
+    def _maybe_commit_block(self, seq: _Sequence, slot: int) -> None:
+        """At a block boundary the just-completed block becomes shareable."""
+        args = self.args
+        if not args.enable_prefix_caching:
+            return
+        pos = int(self._pos[slot])
+        if pos % args.block_size != 0:
+            return
+        bi = pos // args.block_size - 1
+        if bi < len(seq.block_ids) and bi == len(seq.block_hashes):
+            parent = seq.block_hashes[-1] if seq.block_hashes else None
+            h = compute_block_hashes(
+                seq.all_tokens[bi * args.block_size : (bi + 1) * args.block_size],
+                args.block_size,
+                parent_hash=parent,
+            )[0]
+            self.pool.commit(seq.block_ids[bi], h, parent)
+            seq.block_hashes.append(h)
 
     def _preempt(self, seq: _Sequence) -> None:
         """Release blocks and requeue for recompute (vLLM-style preemption)."""
@@ -509,6 +568,90 @@ class JaxEngine:
         )
         if reason is not None:
             self._finish(seq, reason, emit=False)
+
+    # -- KV block export/import (disaggregation + tiered offload) ----------
+    #
+    # Threading contract: BlockPool (and its KV-event callback, which touches
+    # asyncio state) is only ever mutated on the event-loop thread; ONLY the
+    # device array work runs on the executor thread, which also serializes it
+    # with decode steps (the caches are donated through every step).
+
+    async def export_blocks_async(self, block_hashes: List[int]):
+        """Copy committed blocks out of HBM, addressed by content hash.
+
+        Returns (found_hashes, k_blocks, v_blocks) with arrays shaped
+        [n, L, block_size, KH, D]. The prefill side of disaggregated P/D
+        (ref: kv_router/prefill_router.rs bootstrap → NIXL read; here the
+        transfer is host-staged DCN, SURVEY §2.5 TPU-equivalent note).
+        Stops at the first miss: only a leading run of the chain is useful.
+        Found blocks are pinned across the device copy so eviction can't
+        recycle them mid-gather.
+        """
+        ids: List[int] = []
+        found: List[int] = []
+        matched, pinned_ids = self.pool.pin_prefix(block_hashes)
+        try:
+            ids = pinned_ids
+            found = list(block_hashes[:matched])
+            if not ids:
+                return [], None, None
+
+            def gather():
+                idx = jnp.asarray(np.array(ids, dtype=np.int32))
+                # [L, n, BS, KH, D] → [n, L, BS, KH, D]
+                k = np.asarray(jax.device_get(self._k_cache[:, idx].swapaxes(0, 1)))
+                v = np.asarray(jax.device_get(self._v_cache[:, idx].swapaxes(0, 1)))
+                return k, v
+
+            k, v = await self._device(gather)
+            return found, k, v
+        finally:
+            if pinned_ids:
+                self.pool.release(pinned_ids, block_hashes[: len(pinned_ids)])
+
+    async def import_blocks_async(self, block_hashes: List[int], k_blocks, v_blocks) -> int:
+        """Insert transferred blocks into the pool as cached (committed)
+        content, so normal prefix-cached admission reuses them. Returns how
+        many were installed (stops when the pool is dry)."""
+        ids: List[int] = []
+        sel: List[int] = []
+        parents: List[Optional[int]] = []
+        parent: Optional[int] = None
+        for i, h in enumerate(block_hashes):
+            if h in self.pool._by_hash:
+                parent = h
+                continue
+            b = self.pool.alloc()
+            if b is None:
+                break
+            # Allocated but NOT committed yet: private to us, so nobody can
+            # pin the hash and attend over unwritten data.
+            ids.append(b)
+            sel.append(i)
+            parents.append(parent)
+            parent = h
+        if not ids:
+            return 0
+
+        def scatter():
+            idx = jnp.asarray(np.array(ids, dtype=np.int32))
+            k_sel = jnp.asarray(k_blocks[sel]).swapaxes(0, 1).astype(self.config.dtype)
+            v_sel = jnp.asarray(v_blocks[sel]).swapaxes(0, 1).astype(self.config.dtype)
+            self._k_cache = _scatter_blocks(self._k_cache, idx, k_sel)
+            self._v_cache = _scatter_blocks(self._v_cache, idx, v_sel)
+
+        try:
+            await self._device(scatter)
+        except Exception:
+            for b in ids:
+                self.pool.release([b], [])  # data never landed; just free
+            raise
+        for b, i, par in zip(ids, sel, parents):
+            h = block_hashes[i]
+            self.pool.commit(b, h, par)
+            # imported blocks start unreferenced (cached): release our pin
+            self.pool.release([b], [h])
+        return len(ids)
 
     def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
         self.pool.release(seq.block_ids, seq.block_hashes)

@@ -1,0 +1,5 @@
+"""OpenAI HTTP frontend component (python -m dynamo_tpu.frontend).
+
+Reference parity: components/src/dynamo/frontend/main.py — one process
+running the OpenAI server + discovery watcher + preprocessor + router.
+"""

@@ -1,0 +1,68 @@
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu import config
+from dynamo_tpu.http.model_manager import ModelManager
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.llm.discovery import ModelWatcher
+from dynamo_tpu.router import KvRouterConfig
+from dynamo_tpu.runtime.component import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser(
+        "dynamo-tpu frontend",
+        description="OpenAI-compatible HTTP server with dynamic model discovery",
+    )
+    parser.add_argument("--host", default=config.HTTP_HOST.get())
+    parser.add_argument("--http-port", type=int, default=config.HTTP_PORT.get())
+    parser.add_argument(
+        "--router-mode",
+        choices=["kv", "round-robin", "random"],
+        default="kv",
+        help="worker selection policy (ref: RouterMode, push_router.rs:76)",
+    )
+    parser.add_argument(
+        "--kv-overlap-score-weight", type=float,
+        default=config.ROUTER_OVERLAP_WEIGHT.get(),
+    )
+    parser.add_argument(
+        "--router-temperature", type=float, default=config.ROUTER_TEMPERATURE.get()
+    )
+    args = parser.parse_args()
+
+    configure_logging()
+    runtime = DistributedRuntime.from_settings()
+    manager = ModelManager()
+    mode = {
+        "kv": RouterMode.KV,
+        "round-robin": RouterMode.ROUND_ROBIN,
+        "random": RouterMode.RANDOM,
+    }[args.router_mode]
+    watcher = ModelWatcher(
+        runtime,
+        manager,
+        router_mode=mode,
+        kv_router_config=KvRouterConfig(
+            overlap_score_weight=args.kv_overlap_score_weight,
+            router_temperature=args.router_temperature,
+        ),
+    )
+    await watcher.start()
+    service = HttpService(manager, host=args.host, port=args.http_port)
+    port = await service.start()
+    print(f"frontend listening on {args.host}:{port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service.stop(grace_period=config.GRACE_PERIOD.get())
+        await watcher.stop()
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

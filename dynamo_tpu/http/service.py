@@ -47,7 +47,9 @@ class HttpService:
         port: int = 8000,
         metrics: Optional[FrontendMetrics] = None,
     ) -> None:
-        self.models = model_manager or ModelManager()
+        # NOT `or`: an empty ModelManager is falsy (__len__ == 0) and would be
+        # silently replaced, detaching the caller's manager from the server.
+        self.models = model_manager if model_manager is not None else ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()

@@ -1,0 +1,212 @@
+"""Dynamic model discovery: register_llm + ModelWatcher.
+
+Reference parity: lib/bindings rust/lib.rs:232 (register_llm — publish a
+ModelDeploymentCard to the discovery plane under the worker's lease) and
+lib/llm/src/discovery/watcher.rs:57,112 (ModelWatcher — watch the models/
+prefix; on add, assemble a routed pipeline and hand it to the frontend's
+ModelManager; on delete, tear it down when the last instance goes).
+
+The assembled chain matches entrypoint/input/common.rs:173:
+    OpenAIPreprocessor → Backend → Migration → Client[KV-routed]
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.entrypoint import resolve_chat_template, resolve_tokenizer
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.router import KvRouter, KvRouterConfig
+from dynamo_tpu.runtime.component import Endpoint, RouterMode
+from dynamo_tpu.runtime.discovery import MODELS_PREFIX, model_key
+from dynamo_tpu.runtime.pipeline import build_pipeline
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def register_llm(
+    runtime: Any,
+    card: ModelDeploymentCard,
+    endpoint: Endpoint,
+    instance_id: int,
+) -> str:
+    """Publish the model card for a served endpoint instance. Returns the
+    discovery key. The card rides the runtime's serving lease, so it vanishes
+    with the worker (liveness, ref: watcher.rs delete handling)."""
+    key = model_key(endpoint.namespace, card.slug, instance_id)
+    doc = {
+        "card": card.to_dict(),
+        "endpoint": {
+            "namespace": endpoint.namespace,
+            "component": endpoint.component,
+            "endpoint": endpoint.name,
+        },
+        "instance_id": instance_id,
+    }
+    lease = await runtime._lease_for_serving()
+    await runtime.discovery.put(key, doc, lease=lease)
+    logger.info("registered model %s at %s", card.name, key)
+    return key
+
+
+class ModelWatcher:
+    """Feeds a ModelManager from the discovery plane."""
+
+    def __init__(
+        self,
+        runtime: Any,
+        model_manager: Any,
+        *,
+        router_mode: RouterMode = RouterMode.KV,
+        kv_router_config: Optional[KvRouterConfig] = None,
+        enable_disagg: bool = True,
+        prefill_component: str = "prefill",
+        disagg_threshold_tokens: int = 32,
+    ) -> None:
+        self._runtime = runtime
+        self._manager = model_manager
+        self.router_mode = router_mode
+        self._kv_config = kv_router_config
+        self.enable_disagg = enable_disagg
+        self.prefill_component = prefill_component
+        self.disagg_threshold_tokens = disagg_threshold_tokens
+        # model slug → state
+        self._models: Dict[str, Dict[str, Any]] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._watch = None
+        self._ready = asyncio.Event()
+
+    async def start(self) -> None:
+        self._watch = self._runtime.discovery.watch(MODELS_PREFIX)
+        for event in self._watch.drain_snapshot():
+            await self._apply(event)
+        self._ready.set()
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name="model-watcher"
+        )
+
+    async def stop(self) -> None:
+        if self._watch is not None:
+            await self._watch.aclose()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for slug in list(self._models):
+            await self._remove_model(slug)
+
+    async def wait_for_model(self, name: str, timeout: float = 10.0) -> None:
+        async def poll() -> None:
+            while self._manager.get(name) is None:
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(poll(), timeout)
+
+    async def _run(self) -> None:
+        async for event in self._watch:
+            try:
+                await self._apply(event)
+            except Exception:
+                logger.exception("model watch event failed")
+
+    async def _apply(self, event) -> None:
+        # key: models/{namespace}/{slug}/{instance_id}
+        parts = event.key.split("/")
+        if len(parts) != 4:
+            return
+        _, namespace, slug, iid_hex = parts
+        from dynamo_tpu.runtime.discovery import EventKind
+
+        if event.kind == EventKind.PUT and event.value is not None:
+            await self._add_instance(slug, event.value)
+        elif event.kind == EventKind.DELETE:
+            await self._drop_instance(slug, iid_hex)
+
+    async def _add_instance(self, slug: str, doc: Dict[str, Any]) -> None:
+        state = self._models.get(slug)
+        if state is not None:
+            state["instances"].add(doc["instance_id"])
+            return
+        card = ModelDeploymentCard.from_dict(doc["card"])
+        ep_info = doc["endpoint"]
+        endpoint = (
+            self._runtime.namespace(ep_info["namespace"])
+            .component(ep_info["component"])
+            .endpoint(ep_info["endpoint"])
+        )
+        client = await endpoint.client(self.router_mode)
+        router = None
+        if self.router_mode == RouterMode.KV:
+            router = KvRouter(
+                self._runtime,
+                ep_info["namespace"],
+                ep_info["component"],
+                block_size=card.kv_block_size,
+                config=self._kv_config,
+            )
+            await router.start()
+            router.attach(client)
+        tokenizer = resolve_tokenizer(card)
+        operators = [
+            OpenAIPreprocessor(card, tokenizer, resolve_chat_template(card)),
+            Backend(tokenizer),
+            Migration(card.migration_limit),
+        ]
+        if self.enable_disagg:
+            from dynamo_tpu.disagg import PrefillRouter
+
+            ns = ep_info["namespace"]
+
+            async def prefill_client():
+                return await (
+                    self._runtime.namespace(ns)
+                    .component(self.prefill_component)
+                    .endpoint("generate")
+                    .client()
+                )
+
+            operators.append(
+                PrefillRouter(
+                    prefill_client, threshold_tokens=self.disagg_threshold_tokens
+                )
+            )
+        pipeline = build_pipeline(operators, client)
+        self._models[slug] = {
+            "card": card,
+            "client": client,
+            "router": router,
+            "instances": {doc["instance_id"]},
+        }
+        self._manager.register(card.name, pipeline, card)
+        logger.info("model %s online (instance %x)", card.name, doc["instance_id"])
+
+    async def _drop_instance(self, slug: str, iid_hex: str) -> None:
+        state = self._models.get(slug)
+        if state is None:
+            return
+        try:
+            iid = int(iid_hex, 16)
+        except ValueError:
+            iid = None
+        state["instances"].discard(iid)
+        if state["router"] is not None and iid is not None:
+            state["router"].remove_worker((iid, 0))
+        if not state["instances"]:
+            await self._remove_model(slug)
+
+    async def _remove_model(self, slug: str) -> None:
+        state = self._models.pop(slug, None)
+        if state is None:
+            return
+        self._manager.unregister(state["card"].name)
+        if state["router"] is not None:
+            await state["router"].stop()
+        await state["client"].close()
+        logger.info("model %s offline", state["card"].name)

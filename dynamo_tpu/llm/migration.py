@@ -1,0 +1,118 @@
+"""Migration operator: re-dispatch a live request when its worker dies.
+
+Reference parity: lib/llm/src/migration.rs:24 (Migration) + docs/
+fault_tolerance/request_migration.md — when the response stream dies mid-
+generation (worker crash, connection loss, no instances), rebuild the
+PreprocessedRequest with the tokens accumulated so far appended to the
+prompt, and send it to another worker, up to ``migration_limit`` times. The
+new worker's prefix cache makes the re-prefill cheap; the client stream never
+observes the failure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, List, Union
+
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.component import NoInstancesError
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+try:
+    from dynamo_tpu.runtime.network.tcp import StreamDisconnectedError
+except ImportError:  # pragma: no cover
+
+    class StreamDisconnectedError(ConnectionError):  # type: ignore[no-redef]
+        pass
+
+
+MIGRATABLE = (StreamDisconnectedError, NoInstancesError, ConnectionError)
+
+
+class Migration:
+    def __init__(self, migration_limit: int = 3) -> None:
+        self.migration_limit = migration_limit
+
+    async def generate(
+        self, request: Any, context: Context, next: AsyncEngine
+    ) -> AsyncIterator[Union[BackendOutput, dict]]:
+        if isinstance(request, PreprocessedRequest):
+            req = request
+        else:
+            req = PreprocessedRequest.from_dict(dict(request))
+        generated: List[int] = []
+        migrations = 0
+
+        while True:
+            finished = False
+            try:
+                async for item in next.generate(_as_wire(request, req), context):
+                    tokens = _tokens_of(item)
+                    if tokens:
+                        generated.extend(tokens)
+                    yield item
+                    if _finish_reason_of(item) is not None:
+                        finished = True
+                return
+            except MIGRATABLE as exc:
+                if finished or context.stopped:
+                    return
+                migrations += 1
+                if migrations > self.migration_limit:
+                    logger.error(
+                        "request %s exceeded migration limit (%d): %r",
+                        req.request_id, self.migration_limit, exc,
+                    )
+                    yield BackendOutput(
+                        error=f"stream failed after {self.migration_limit} migrations: {exc}",
+                        finish_reason=FinishReason.ERROR,
+                    )
+                    return
+                logger.warning(
+                    "migrating request %s (attempt %d/%d) after %r with %d tokens carried",
+                    req.request_id, migrations, self.migration_limit, exc, len(generated),
+                )
+                req = _carry_tokens(req, generated)
+                generated = []  # now embedded in the prompt; don't carry twice
+                request = req  # from now on send the rebuilt request
+
+    # Streams that end without any finish reason (worker vanished without an
+    # exception) are NOT retried here: the transport layer is responsible for
+    # surfacing disconnects as exceptions (tcp.py StreamDisconnectedError).
+
+
+def _carry_tokens(req: PreprocessedRequest, generated: List[int]) -> PreprocessedRequest:
+    """New request whose prompt embeds everything generated so far
+    (ref: migration.rs retained-token re-dispatch)."""
+    d = req.to_dict()
+    d["token_ids"] = list(req.token_ids) + list(generated)
+    new = PreprocessedRequest.from_dict(d)
+    if new.stop.max_tokens is not None:
+        new.stop.max_tokens = max(new.stop.max_tokens - len(generated), 1)
+    if new.stop.min_tokens is not None:
+        new.stop.min_tokens = max(new.stop.min_tokens - len(generated), 0)
+    return new
+
+
+def _as_wire(original: Any, req: PreprocessedRequest) -> Any:
+    """Preserve the caller's representation (dict over the wire, object locally)."""
+    return req.to_dict() if isinstance(original, dict) else req
+
+
+def _tokens_of(item: Any) -> List[int]:
+    if isinstance(item, dict):
+        return item.get("token_ids") or []
+    return getattr(item, "token_ids", None) or []
+
+
+def _finish_reason_of(item: Any):
+    if isinstance(item, dict):
+        return item.get("finish_reason")
+    return getattr(item, "finish_reason", None)

@@ -1,0 +1,6 @@
+"""Mock-engine worker component (python -m dynamo_tpu.mocker).
+
+Reference parity: components/src/dynamo/mocker (CLI over the Rust mocker
+engine, lib/mocker) — a deterministic fake worker so router/disagg/planner
+e2e runs need no accelerator (SURVEY §4 'centerpiece').
+"""

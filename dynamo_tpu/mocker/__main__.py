@@ -1,0 +1,100 @@
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+
+from dynamo_tpu import config
+from dynamo_tpu.engines.mock.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig
+from dynamo_tpu.router import KvEventPublisher, LoadPublisher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def serve_mocker(args) -> None:
+    runtime = DistributedRuntime.from_settings()
+    served = []
+    cleanup = []
+    for rank in range(args.num_workers):
+        instance_id = random.getrandbits(63)
+        kv_pub = KvEventPublisher(
+            runtime.event_plane, args.namespace, args.component, instance_id
+        )
+        engine = MockEngine(
+            MockEngineArgs(
+                block_size=args.block_size,
+                num_kv_blocks=args.num_kv_blocks,
+                max_num_seqs=args.max_num_seqs,
+                speedup_ratio=args.speedup_ratio,
+                dp_size=1,
+            ),
+            on_kv_event=kv_pub.on_kv_event,
+        )
+        load_pub = LoadPublisher(
+            runtime.event_plane, args.namespace, args.component, instance_id,
+            lambda e=engine: {
+                "active_seqs": len(e._running),
+                "waiting": e._waiting.qsize(),
+                "free_blocks": e.kv.free_blocks,
+                "total_blocks": e.args.num_kv_blocks,
+            },
+            total_blocks=args.num_kv_blocks,
+        )
+        card = ModelDeploymentCard(
+            name=args.model_name,
+            context_length=args.max_model_len,
+            kv_block_size=args.block_size,
+            runtime_config=RuntimeConfig(
+                total_kv_blocks=args.num_kv_blocks,
+                kv_block_size=args.block_size,
+                max_num_seqs=args.max_num_seqs,
+                max_context_len=args.max_model_len,
+            ),
+        )
+        endpoint = (
+            runtime.namespace(args.namespace)
+            .component(args.component)
+            .endpoint(args.endpoint)
+        )
+        served.append(
+            await endpoint.serve_endpoint(engine.generate, instance_id=instance_id)
+        )
+        await register_llm(runtime, card, endpoint, instance_id)
+        load_pub.start()
+        await engine.start()
+        cleanup.extend([load_pub.close, kv_pub.close, engine.stop])
+        print(
+            f"mocker serving {args.model_name} instance {instance_id:#x}", flush=True
+        )
+    try:
+        await asyncio.Event().wait()
+    finally:
+        for s in served:
+            await s.shutdown(grace_period=5)
+        for fn in cleanup:
+            await fn()
+        await runtime.shutdown(grace_period=5)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu mocker worker")
+    parser.add_argument("--model-name", default="mock-model")
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--endpoint", default="generate")
+    parser.add_argument("--num-workers", type=int, default=1,
+                        help="mock engine instances in this process")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-kv-blocks", type=int, default=1024)
+    parser.add_argument("--max-num-seqs", type=int, default=32)
+    parser.add_argument("--max-model-len", type=int, default=4096)
+    parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    args = parser.parse_args()
+    configure_logging()
+    asyncio.run(serve_mocker(args))
+
+
+if __name__ == "__main__":
+    main()

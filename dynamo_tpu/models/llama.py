@@ -195,3 +195,50 @@ def forward_paged(
     else:
         logits = x_last @ params["lm_head"]
     return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def decode_multi(
+    params: Params,
+    config: ModelConfig,
+    tokens: jnp.ndarray,  # [B] int32 — current input token per slot
+    start_pos: jnp.ndarray,  # [B] int32
+    active: jnp.ndarray,  # [B] int32 0/1
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    *,
+    num_steps: int,
+    use_kernel: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
+    single-token forward+sample steps). Minimizes host↔device round trips —
+    the decisive factor on TPU where dispatch latency dwarfs a small model's
+    step compute. Host-side stop conditions are applied afterwards at
+    num_steps granularity (overshoot tokens are discarded; their KV writes
+    beyond the table capacity are dropped by write_chunk_to_cache).
+
+    Returns (tokens [B, num_steps], logprobs [B, num_steps], k_cache, v_cache).
+    """
+    from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
+
+    def one(carry, step_rng):
+        toks, pos, k_c, v_c = carry
+        logits, k_c, v_c = forward_paged(
+            params, config, toks[:, None], pos, active, block_tables, k_c, v_c,
+            use_kernel=use_kernel,
+        )
+        nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p)
+        nxt = jnp.where(active > 0, nxt, toks)
+        logp = compute_logprobs(logits, nxt)
+        pos = pos + active
+        return (nxt, pos, k_c, v_c), (nxt, logp)
+
+    rngs = jax.random.split(rng, num_steps)
+    (_, _, k_cache, v_cache), (toks, logps) = jax.lax.scan(
+        one, (tokens, start_pos, k_cache, v_cache), rngs
+    )
+    return toks.T, logps.T, k_cache, v_cache

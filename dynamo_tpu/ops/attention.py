@@ -89,13 +89,15 @@ def write_chunk_to_cache(
     start_pos: jnp.ndarray,  # [B]
     chunk_lens: jnp.ndarray,  # [B]
 ) -> jnp.ndarray:
-    """Scatter a chunk of K or V into its pages. Padding positions are dropped
-    (out-of-range block index + scatter mode='drop')."""
+    """Scatter a chunk of K or V into its pages. Padding positions and
+    positions beyond the block table's capacity (multi-step decode overshoot
+    past a stop condition) are dropped (out-of-range index + mode='drop')."""
     B, C = chunk.shape[:2]
     num_blocks, block_size = cache.shape[:2]
+    capacity = block_tables.shape[1] * block_size
     c_off = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     pos = start_pos[:, None] + c_off  # [B, C]
-    valid = c_off < chunk_lens[:, None]
+    valid = (c_off < chunk_lens[:, None]) & (pos < capacity)
     block_idx = jnp.take_along_axis(
         block_tables, jnp.clip(pos // block_size, 0, block_tables.shape[1] - 1), axis=1
     )

@@ -1,0 +1,77 @@
+"""Router wire protocols: KV events and load snapshots.
+
+Reference parity: lib/kv-router/src/protocols.rs (RouterEvent, WorkerId,
+DpRank, OverlapScores) and the load metrics the scheduler consumes
+(kv_router/scheduler.rs ProcessedEndpoints). Everything is a plain dict on
+the wire (msgpack/json-able).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+WorkerKey = Tuple[int, int]  # (worker_id, dp_rank)
+
+KV_EVENTS_TOPIC = "kv_events"
+LOAD_TOPIC = "load"
+
+
+def kv_events_topic(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.{KV_EVENTS_TOPIC}"
+
+
+def load_topic(namespace: str, component: str) -> str:
+    return f"{namespace}.{component}.{LOAD_TOPIC}"
+
+
+@dataclass
+class RouterEvent:
+    """One KV-cache mutation at a worker (ref: protocols.rs RouterEvent)."""
+
+    worker_id: int
+    kind: str  # "stored" | "removed" | "cleared"
+    block_hashes: List[int] = field(default_factory=list)
+    parent_hash: Optional[int] = None
+    dp_rank: int = 0
+    event_id: int = 0  # per-worker monotonic, for ordering diagnostics
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RouterEvent":
+        return cls(**d)
+
+    @property
+    def worker(self) -> WorkerKey:
+        return (self.worker_id, self.dp_rank)
+
+
+@dataclass
+class LoadSnapshot:
+    """Periodic worker load report (ref: ForwardPassMetrics / load publishing
+    in kv_router/publisher.rs and worker_monitor.rs)."""
+
+    worker_id: int
+    dp_rank: int = 0
+    active_seqs: int = 0
+    waiting: int = 0
+    active_blocks: int = 0
+    total_blocks: int = 0
+    generated_tokens: int = 0  # cumulative, for throughput estimation
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LoadSnapshot":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+    @property
+    def worker(self) -> WorkerKey:
+        return (self.worker_id, self.dp_rank)
+
+    @property
+    def kv_usage(self) -> float:
+        return self.active_blocks / self.total_blocks if self.total_blocks else 0.0

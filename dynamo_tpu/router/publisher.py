@@ -1,0 +1,150 @@
+"""Worker-side publishers: KV events and load snapshots onto the event plane.
+
+Reference parity: lib/llm/src/kv_router/publisher.rs (KvEventPublisher :112 —
+engine events → event plane) and the load/stat publishing the scheduler
+consumes. Engines call a synchronous callback per KV event; the publisher
+queues and ships them from an asyncio task (events survive bursts; order is
+preserved per worker).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.engines.mock.kv_manager import KvEvent
+from dynamo_tpu.router.protocols import (
+    LoadSnapshot,
+    RouterEvent,
+    kv_events_topic,
+    load_topic,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class KvEventPublisher:
+    """Bridge engine KV events → event plane topic."""
+
+    def __init__(
+        self,
+        event_plane: Any,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        *,
+        dp_rank: int = 0,
+    ) -> None:
+        self._plane = event_plane
+        self._topic = kv_events_topic(namespace, component)
+        self.worker_id = worker_id
+        self.dp_rank = dp_rank
+        self._queue: "asyncio.Queue[Optional[RouterEvent]]" = asyncio.Queue()
+        self._event_id = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def on_kv_event(self, event: KvEvent) -> None:
+        """Engine callback (synchronous, loop thread)."""
+        self._event_id += 1
+        self._queue.put_nowait(
+            RouterEvent(
+                worker_id=self.worker_id,
+                dp_rank=self.dp_rank,
+                kind=event.kind,
+                block_hashes=list(event.block_hashes),
+                parent_hash=event.parent_hash,
+                event_id=self._event_id,
+            )
+        )
+        self._ensure_task()
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(
+                self._pump(), name=f"kv-event-pub:{self.worker_id:#x}"
+            )
+
+    async def _pump(self) -> None:
+        while True:
+            ev = await self._queue.get()
+            if ev is None:
+                return
+            try:
+                await self._plane.publish(self._topic, ev.to_dict())
+            except Exception:
+                logger.exception("failed to publish KV event")
+
+    async def close(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._queue.put_nowait(None)
+            await self._task
+        self._task = None
+
+
+class LoadPublisher:
+    """Periodic load snapshots (ref: worker stat publishing feeding
+    scheduler.rs ProcessedEndpoints)."""
+
+    def __init__(
+        self,
+        event_plane: Any,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        stats_fn: Callable[[], dict],
+        *,
+        dp_rank: int = 0,
+        total_blocks: int = 0,
+        interval_s: float = 1.0,
+    ) -> None:
+        self._plane = event_plane
+        self._topic = load_topic(namespace, component)
+        self.worker_id = worker_id
+        self.dp_rank = dp_rank
+        self._stats_fn = stats_fn
+        self._total_blocks = total_blocks
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    def snapshot(self) -> LoadSnapshot:
+        s = self._stats_fn()
+        total = self._total_blocks or s.get("total_blocks", 0)
+        free = s.get("free_blocks", 0)
+        return LoadSnapshot(
+            worker_id=self.worker_id,
+            dp_rank=self.dp_rank,
+            active_seqs=s.get("active_seqs", 0),
+            waiting=s.get("waiting", 0),
+            active_blocks=max(total - free, 0),
+            total_blocks=total,
+            generated_tokens=s.get("generated_tokens", 0),
+        )
+
+    async def publish_once(self) -> None:
+        await self._plane.publish(self._topic, self.snapshot().to_dict())
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stop.clear()
+            self._task = asyncio.get_event_loop().create_task(
+                self._run(), name=f"load-pub:{self.worker_id:#x}"
+            )
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.publish_once()
+            except Exception:
+                logger.exception("failed to publish load snapshot")
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def close(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None

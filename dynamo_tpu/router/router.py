@@ -1,0 +1,143 @@
+"""KvRouter: ties the indexer + scheduler to the event plane and the Client.
+
+Reference parity: lib/llm/src/kv_router.rs (KvRouter :320, find_best_match
+:501, AsyncEngine impl :720) and subscriber.rs (event plane → indexer pump).
+
+Usage (frontend side):
+
+    client = await endpoint.client(RouterMode.KV)
+    router = KvRouter(runtime, namespace, component, block_size=16)
+    await router.start()
+    router.attach(client)          # installs the kv picker
+    ... client.generate(preprocessed_request) now routes KV-aware ...
+
+Workers run KvEventPublisher/LoadPublisher (publisher.py) so the router sees
+their cache contents and load.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.protocols import (
+    LoadSnapshot,
+    RouterEvent,
+    WorkerKey,
+    kv_events_topic,
+    load_topic,
+)
+from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class KvRouter:
+    def __init__(
+        self,
+        runtime: Any,
+        namespace: str,
+        component: str,
+        *,
+        block_size: int = 16,
+        config: Optional[KvRouterConfig] = None,
+    ) -> None:
+        self._runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size)
+        self.scheduler = KvScheduler(config)
+        self._tasks: list = []
+        self._subs: list = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        plane = self._runtime.event_plane
+        kv_sub = plane.subscribe(kv_events_topic(self.namespace, self.component))
+        load_sub = plane.subscribe(load_topic(self.namespace, self.component))
+        self._subs = [kv_sub, load_sub]
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._pump_kv(kv_sub), name="kv-router-events"),
+            loop.create_task(self._pump_load(load_sub), name="kv-router-load"),
+        ]
+
+    async def stop(self) -> None:
+        for sub in self._subs:
+            await sub.aclose()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        self._subs = []
+
+    async def _pump_kv(self, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                self.indexer.apply(RouterEvent.from_dict(payload))
+            except Exception:
+                logger.exception("bad KV event payload")
+
+    async def _pump_load(self, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                self.scheduler.update_load(LoadSnapshot.from_dict(payload))
+            except Exception:
+                logger.exception("bad load payload")
+
+    def remove_worker(self, worker: WorkerKey) -> None:
+        self.indexer.remove_worker(worker)
+        self.scheduler.remove_worker(worker)
+
+    # -- selection ---------------------------------------------------------
+
+    def find_best_match(
+        self,
+        token_ids: Sequence[int],
+        candidates: Optional[Sequence[WorkerKey]] = None,
+    ) -> Tuple[Optional[WorkerKey], int]:
+        """Returns (worker, overlap_blocks) — ref: kv_router.rs:501."""
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes)
+        request_blocks = max(len(hashes), 1)
+        worker = self.scheduler.select_worker(request_blocks, overlaps, candidates)
+        overlap = overlaps.scores.get(worker, 0) if worker is not None else 0
+        return worker, overlap
+
+    def attach(self, client: Any) -> None:
+        """Install this router as the Client's KV-mode instance picker."""
+
+        async def picker(request: Any, instances: Dict[int, Any]) -> Optional[int]:
+            token_ids = _token_ids_of(request)
+            if token_ids is None:
+                return None  # not a preprocessed request; fall back
+            candidates = [(iid, 0) for iid in instances]
+            worker, overlap = self.find_best_match(token_ids, candidates)
+            if worker is None:
+                return None
+            if isinstance(request, dict):
+                request["estimated_prefix_hit_blocks"] = overlap
+            else:
+                try:
+                    request.estimated_prefix_hit_blocks = overlap
+                except AttributeError:
+                    pass
+            return worker[0]
+
+        client.set_kv_picker(picker)
+
+
+def _token_ids_of(request: Any) -> Optional[Sequence[int]]:
+    if isinstance(request, dict):
+        ids = request.get("token_ids")
+        return ids if isinstance(ids, (list, tuple)) else None
+    return getattr(request, "token_ids", None)

@@ -1,0 +1,140 @@
+"""KvScheduler: the worker-selection cost model.
+
+Reference parity: lib/llm/src/kv_router/scheduler.rs — the engine-agnostic
+algorithm (scheduler.rs:497–566): for each candidate worker
+
+    potential_prefill_blocks = request_blocks − overlap_blocks(worker)
+    potential_decode_blocks  = current active blocks (reported + in-flight)
+    logit = overlap_weight × potential_prefill_blocks + potential_decode_blocks
+
+then pick the minimum, or softmax-sample over −logit/temperature when
+``router_temperature > 0`` (scheduler.rs softmax_sample :426). In-flight
+requests routed between load reports are tracked locally (sequence.rs's
+active-sequence prediction, simplified to block deltas with TTL decay).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.router.protocols import LoadSnapshot, WorkerKey
+from dynamo_tpu.tokens.radix import OverlapScores
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class KvRouterConfig:
+    """(ref: scheduler.rs:137 KvRouterConfig)"""
+
+    overlap_score_weight: float = 1.0
+    router_temperature: float = 0.0
+    # Forget in-flight load predictions after this long without a report.
+    inflight_ttl_s: float = 30.0
+    # Soft-skip workers above this KV usage unless all are (busy gating).
+    busy_kv_usage: float = 0.95
+
+
+@dataclass
+class WorkerState:
+    snapshot: Optional[LoadSnapshot] = None
+    # Blocks routed here since the last snapshot (prediction, decays).
+    inflight_blocks: int = 0
+    inflight_at: float = 0.0
+
+    def decode_blocks(self, ttl: float) -> int:
+        base = self.snapshot.active_blocks if self.snapshot else 0
+        if self.inflight_blocks and time.monotonic() - self.inflight_at < ttl:
+            base += self.inflight_blocks
+        return base
+
+    def kv_usage(self) -> float:
+        return self.snapshot.kv_usage if self.snapshot else 0.0
+
+
+class KvScheduler:
+    def __init__(self, config: Optional[KvRouterConfig] = None, *, seed: Optional[int] = None) -> None:
+        self.config = config or KvRouterConfig()
+        self._workers: Dict[WorkerKey, WorkerState] = {}
+        self._rand = random.Random(seed)
+
+    # -- state maintenance -------------------------------------------------
+
+    def update_load(self, snapshot: LoadSnapshot) -> None:
+        state = self._workers.setdefault(snapshot.worker, WorkerState())
+        state.snapshot = snapshot
+        state.inflight_blocks = 0  # report supersedes the prediction
+
+    def add_worker(self, worker: WorkerKey) -> None:
+        self._workers.setdefault(worker, WorkerState())
+
+    def remove_worker(self, worker: WorkerKey) -> None:
+        self._workers.pop(worker, None)
+
+    def workers(self) -> List[WorkerKey]:
+        return sorted(self._workers)
+
+    # -- selection ---------------------------------------------------------
+
+    def select_worker(
+        self,
+        request_blocks: int,
+        overlaps: OverlapScores,
+        candidates: Optional[Sequence[WorkerKey]] = None,
+    ) -> Optional[WorkerKey]:
+        """Pick the worker with the lowest predicted cost. ``candidates``
+        restricts the choice to live instances (router-side instance map)."""
+        cfg = self.config
+        pool: List[WorkerKey] = list(candidates) if candidates is not None else self.workers()
+        if not pool:
+            return None
+        for w in pool:
+            self.add_worker(w)
+
+        not_busy = [
+            w for w in pool if self._workers[w].kv_usage() < cfg.busy_kv_usage
+        ]
+        if not_busy:
+            pool = not_busy
+
+        logits: List[Tuple[WorkerKey, float]] = []
+        for w in pool:
+            overlap = overlaps.scores.get(w, 0)
+            prefill = max(request_blocks - overlap, 0)
+            decode = self._workers[w].decode_blocks(cfg.inflight_ttl_s)
+            logit = cfg.overlap_score_weight * prefill + decode
+            logits.append((w, logit))
+
+        chosen = self._sample(logits, cfg.router_temperature)
+        # Predict the routed request's load until the next report lands.
+        state = self._workers[chosen]
+        state.inflight_blocks += max(
+            request_blocks - overlaps.scores.get(chosen, 0), 0
+        )
+        state.inflight_at = time.monotonic()
+        return chosen
+
+    def _sample(
+        self, logits: List[Tuple[WorkerKey, float]], temperature: float
+    ) -> WorkerKey:
+        if temperature <= 0.0 or len(logits) == 1:
+            best = min(l for _, l in logits)
+            ties = [w for w, l in logits if l == best]
+            return self._rand.choice(ties)
+        # softmax over −logit/T (lower cost → higher probability)
+        scaled = [-l / temperature for _, l in logits]
+        m = max(scaled)
+        exps = [math.exp(s - m) for s in scaled]
+        total = sum(exps)
+        r = self._rand.random() * total
+        acc = 0.0
+        for (w, _), e in zip(logits, exps):
+            acc += e
+            if r <= acc:
+                return w
+        return logits[-1][0]

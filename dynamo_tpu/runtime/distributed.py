@@ -137,6 +137,39 @@ class DistributedRuntime:
         runtime._owns_bus = True
         return runtime
 
+    @classmethod
+    def from_settings(cls) -> "DistributedRuntime":
+        """Cross-process runtime wired from the DYN_TPU_* env registry
+        (ref: distributed.rs:536 from_settings; environment_names.rs)."""
+        discovery_kind = config.DISCOVERY.get()
+        if discovery_kind == "file":
+            from dynamo_tpu.runtime.discovery.file import FileDiscovery
+
+            discovery = FileDiscovery(config.DISCOVERY_ADDR.get())
+        elif discovery_kind == "discd":
+            from dynamo_tpu.runtime.discovery.discd import DiscdDiscovery
+
+            discovery = DiscdDiscovery(config.DISCOVERY_ADDR.get())
+        else:
+            discovery = MemoryDiscovery.shared("default")
+
+        if config.REQUEST_PLANE.get() == "tcp":
+            from dynamo_tpu.runtime.network.tcp import TcpRequestPlane
+
+            request_plane = TcpRequestPlane(host=config.TCP_HOST.get())
+        else:
+            request_plane = LocalRequestPlane("default")
+
+        if config.EVENT_PLANE.get() == "zmq":
+            from dynamo_tpu.runtime.events.zmq_plane import ZmqEventPlane
+
+            event_plane = ZmqEventPlane(config.EVENT_PLANE_ADDR.get())
+        else:
+            event_plane = MemoryEventPlane.shared("default")
+        return cls(
+            discovery=discovery, request_plane=request_plane, event_plane=event_plane
+        )
+
     # -- naming ------------------------------------------------------------
 
     def namespace(self, name: str) -> Namespace:
@@ -219,6 +252,10 @@ class DistributedRuntime:
 
     def request_plane_client(self, instance: Instance) -> AsyncEngine:
         kind = instance.transport.get("kind", "local")
+        # The runtime's own plane serves matching transports (a from_settings
+        # TCP runtime reuses its plane's connection pool for egress too).
+        if getattr(self.request_plane, "kind", "local") == kind:
+            return self.request_plane.client_for(instance)
         if kind == "local":
             return self.request_plane.client_for(instance)
         for plane in self._extra_planes:

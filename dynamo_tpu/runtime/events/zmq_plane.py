@@ -1,0 +1,144 @@
+"""ZMQ event plane: cross-process pub/sub through an XPUB/XSUB proxy.
+
+Reference parity: lib/runtime/src/transports/event_plane/ — NATS is the
+reference default with a brokerless ZMQ alternative (zmq_transport.rs,
+"Harmony pattern"). NATS isn't available here, so the cross-process plane is
+ZMQ with a tiny forwarder: publishers PUB→XSUB, subscribers SUB←XPUB.
+Messages are ``topic-utf8 | msgpack payload`` two-frame multipart.
+
+The broker runs standalone (python -m dynamo_tpu.discd --events) or embedded
+in any process via ``EventBroker``. ZMQ prefix subscriptions over-match our
+NATS-style patterns (``a.>``), so deliveries are re-checked with
+``topic_matches`` client-side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+import zmq
+import zmq.asyncio
+
+from dynamo_tpu.runtime.events import Subscription, _SUB_CLOSED, topic_matches
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class EventBroker:
+    """XSUB/XPUB forwarder (the 'nats-server' of this framework)."""
+
+    def __init__(self, host: str = "127.0.0.1", xsub_port: int = 0, xpub_port: int = 0) -> None:
+        self.host = host
+        self._ctx = zmq.asyncio.Context.instance()
+        self._xsub = self._ctx.socket(zmq.XSUB)
+        self._xpub = self._ctx.socket(zmq.XPUB)
+        self.xsub_port = xsub_port or self._bind_ephemeral(self._xsub, xsub_port)
+        self.xpub_port = xpub_port or self._bind_ephemeral(self._xpub, xpub_port)
+        if xsub_port:
+            self._xsub.bind(f"tcp://{host}:{xsub_port}")
+        if xpub_port:
+            self._xpub.bind(f"tcp://{host}:{xpub_port}")
+        self._task: Optional[asyncio.Task] = None
+
+    def _bind_ephemeral(self, sock: zmq.Socket, port: int) -> int:
+        return sock.bind_to_random_port(f"tcp://{self.host}")
+
+    @property
+    def address(self) -> str:
+        """Connection string clients take: host:xsub:xpub."""
+        return f"{self.host}:{self.xsub_port}:{self.xpub_port}"
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._forward(), name="event-broker"
+            )
+            logger.info("event broker on %s", self.address)
+
+    async def _forward(self) -> None:
+        # Bidirectional proxy: data XSUB→XPUB, subscriptions XPUB→XSUB.
+        poller = zmq.asyncio.Poller()
+        poller.register(self._xsub, zmq.POLLIN)
+        poller.register(self._xpub, zmq.POLLIN)
+        while True:
+            events = dict(await poller.poll())
+            if self._xsub in events:
+                await self._xpub.send_multipart(await self._xsub.recv_multipart())
+            if self._xpub in events:
+                await self._xsub.send_multipart(await self._xpub.recv_multipart())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._xsub.close(0)
+        self._xpub.close(0)
+
+
+class ZmqEventPlane:
+    """EventPlane over a broker at ``host:xsub_port:xpub_port``."""
+
+    def __init__(self, address: str) -> None:
+        host, xsub, xpub = address.rsplit(":", 2)
+        self._ctx = zmq.asyncio.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        self._pub.connect(f"tcp://{host}:{xsub}")
+        self._sub_addr = f"tcp://{host}:{xpub}"
+        self._subs: List[Tuple[str, Subscription, zmq.Socket, asyncio.Task]] = []
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        await self._pub.send_multipart(
+            [topic.encode(), msgpack.packb(payload, use_bin_type=True)]
+        )
+
+    def subscribe(self, topic: str) -> Subscription:
+        sock = self._ctx.socket(zmq.SUB)
+        sock.connect(self._sub_addr)
+        prefix = topic[:-1] if topic.endswith(".>") else topic
+        sock.setsockopt(zmq.SUBSCRIBE, prefix.encode())
+        queue: asyncio.Queue = asyncio.Queue()
+
+        sub = Subscription(topic, queue, on_close=lambda s: self._close_sub(s))
+
+        async def pump() -> None:
+            try:
+                while True:
+                    raw_topic, raw_payload = await sock.recv_multipart()
+                    t = raw_topic.decode()
+                    if topic_matches(topic, t):
+                        queue.put_nowait((t, msgpack.unpackb(raw_payload, raw=False)))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("zmq subscription pump died (%s)", topic)
+                queue.put_nowait(_SUB_CLOSED)
+
+        task = asyncio.get_running_loop().create_task(pump(), name=f"zmq-sub:{topic}")
+        self._subs.append((topic, sub, sock, task))
+        return sub
+
+    def _close_sub(self, sub: Subscription) -> None:
+        for i, (topic, s, sock, task) in enumerate(self._subs):
+            if s is sub:
+                task.cancel()
+                sock.close(0)
+                sub._queue.put_nowait(_SUB_CLOSED)
+                del self._subs[i]
+                return
+
+    async def close(self) -> None:
+        for _, sub, sock, task in list(self._subs):
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            sock.close(0)
+        self._subs.clear()
+        self._pub.close(0)

@@ -1,0 +1,18 @@
+"""Cross-process request plane transports.
+
+Reference parity: lib/runtime/src/pipeline/network/ — the default raw-TCP
+request plane (tcp/{server,client}.rs) with the two-part msgpack codec
+(codec/two_part.rs) and a per-process shared ingress listener
+(ingress/shared_tcp_endpoint.rs). HTTP/2 and NATS request planes are
+alternatives in the reference; here TCP is the cross-process default and the
+in-process LocalRequestPlane covers process-local mode.
+"""
+
+from dynamo_tpu.runtime.network.codec import (
+    FrameReader,
+    FrameWriter,
+    pack_frame,
+)
+from dynamo_tpu.runtime.network.tcp import TcpRequestPlane
+
+__all__ = ["FrameReader", "FrameWriter", "pack_frame", "TcpRequestPlane"]

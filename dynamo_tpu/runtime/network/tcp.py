@@ -1,0 +1,322 @@
+"""TCP request plane: multiplexed request/response streaming.
+
+Reference parity: lib/runtime/src/pipeline/network/tcp/{server,client}.rs +
+ingress/shared_tcp_endpoint.rs (one listener per process shared by every
+served endpoint) + egress/push_router.rs client side. Frames use the
+two-part codec; one connection multiplexes many request streams.
+
+Frame headers:
+  {"type": "req",    "stream": id, "key": instance_key, "ctx": {...}}  payload=request
+  {"type": "cancel", "stream": id}                                     (client→server)
+  {"type": "item",   "stream": id}  payload=response item              (server→client)
+  {"type": "end",    "stream": id}                                     stream done
+  {"type": "err",    "stream": id, "message": str}                     stream failed
+
+A dropped connection cancels every stream riding it — on the client side this
+surfaces as StreamDisconnectedError, the trigger for request migration
+(ref: migration.rs no-responder handling).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
+from dynamo_tpu.runtime.tasks import TaskTracker
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+CANCEL_GRACE_S = 2.0  # cooperative-cancel window before hard task cancel
+
+
+class StreamDisconnectedError(ConnectionError):
+    """Worker connection died mid-stream (migration trigger)."""
+
+
+class TcpRequestPlane:
+    kind = "tcp"
+
+    def __init__(self, host: Optional[str] = None, port: int = 0) -> None:
+        self.host = host or os.environ.get("DYN_TCP_HOST", "127.0.0.1")
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._engines: Dict[str, Tuple[AsyncEngine, TaskTracker]] = {}
+        self._bound_port: Optional[int] = None
+        self._conns: Dict[Tuple[str, int], "_ClientConn"] = {}
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._ingress_writers: set = set()  # live server-side connections
+
+    # -- server side -------------------------------------------------------
+
+    async def serve(
+        self, instance: Any, engine: AsyncEngine, tracker: TaskTracker
+    ) -> Dict[str, Any]:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, host=self.host, port=self.port
+            )
+            self._bound_port = self._server.sockets[0].getsockname()[1]
+            logger.info("tcp request plane listening on %s:%s", self.host, self._bound_port)
+        self._engines[instance.key] = (engine, tracker)
+        return {
+            "kind": "tcp",
+            "host": self.host,
+            "port": self._bound_port,
+            "key": instance.key,
+        }
+
+    async def unserve(self, instance: Any) -> None:
+        self._engines.pop(instance.key, None)
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        fr = FrameReader(reader)
+        fw = FrameWriter(writer)
+        self._ingress_writers.add(writer)
+        loop = asyncio.get_running_loop()
+        streams: Dict[int, Tuple[asyncio.Task, Context]] = {}
+        try:
+            while True:
+                frame = await fr.recv()
+                if frame is None:
+                    break
+                header, payload = frame
+                ftype = header.get("type")
+                sid = header.get("stream")
+                if ftype == "req":
+                    ctx_info = header.get("ctx") or {}
+                    ctx = Context(
+                        id=ctx_info.get("id"), baggage=ctx_info.get("baggage") or {}
+                    )
+                    task = loop.create_task(
+                        self._run_stream(fw, sid, header, payload, ctx),
+                        name=f"tcp-ingress:{sid}",
+                    )
+                    streams[sid] = (task, ctx)
+                    task.add_done_callback(lambda t, s=sid: streams.pop(s, None))
+                elif ftype == "cancel":
+                    entry = streams.get(sid)
+                    if entry is not None:
+                        task, ctx = entry
+                        # Cooperative first (engines check ctx between decode
+                        # steps); hard-cancel as a backstop for stuck handlers.
+                        ctx.stop_generating(reason="client-cancelled")
+                        loop.call_later(
+                            CANCEL_GRACE_S,
+                            lambda t=task: t.cancel() if not t.done() else None,
+                        )
+                else:
+                    logger.warning("unknown frame type %r", ftype)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for task, ctx in list(streams.values()):
+                ctx.stop_generating(reason="connection-closed")
+                task.cancel()
+            for task, _ in list(streams.values()):
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            fw.close()
+            self._ingress_writers.discard(writer)
+
+    async def _run_stream(
+        self,
+        fw: FrameWriter,
+        sid: int,
+        header: Dict[str, Any],
+        request: Any,
+        ctx: Context,
+    ) -> None:
+        key = header.get("key", "")
+        entry = self._engines.get(key)
+        if entry is None:
+            await fw.send({"type": "err", "stream": sid,
+                           "message": f"no such endpoint instance: {key}"})
+            return
+        engine, tracker = entry
+        try:
+            if tracker.draining:
+                await fw.send({"type": "err", "stream": sid, "message": "draining"})
+                return
+            with tracker.guard():
+                async for item in engine.generate(request, ctx):
+                    await fw.send({"type": "item", "stream": sid}, item)
+            await fw.send({"type": "end", "stream": sid})
+        except asyncio.CancelledError:
+            ctx.stop_generating(reason="client-cancelled")
+            raise
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.stop_generating(reason="connection-lost")
+        except Exception as exc:
+            logger.exception("stream %s handler failed", sid)
+            with _suppress_conn():
+                await fw.send({"type": "err", "stream": sid, "message": repr(exc)})
+
+    # -- client side -------------------------------------------------------
+
+    def client_for(self, instance: Any) -> AsyncEngine:
+        host = instance.transport["host"]
+        port = instance.transport["port"]
+        key = instance.transport.get("key", instance.key)
+        return _TcpClientEngine(self, (host, port), key)
+
+    async def _conn(self, addr: Tuple[str, int]) -> "_ClientConn":
+        # Serialized: concurrent first requests must not each open a
+        # connection (the loser's socket + pump task would leak).
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            conn = self._conns.get(addr)
+            if conn is None or conn.closed:
+                conn = _ClientConn(addr)
+                await conn.connect()
+                self._conns[addr] = conn
+            return conn
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # wait_closed() (3.12 semantics) waits for every live connection,
+            # not just the accept loop — close established ingress
+            # connections or it never returns.
+            for writer in list(self._ingress_writers):
+                writer.close()
+            await self._server.wait_closed()
+            self._server = None
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()
+
+
+class _ClientConn:
+    """One pooled connection; demuxes response frames to stream queues."""
+
+    def __init__(self, addr: Tuple[str, int]) -> None:
+        self.addr = addr
+        self._ids = itertools.count(1)
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self._fw: Optional[FrameWriter] = None
+        self._pump: Optional[asyncio.Task] = None
+        self.closed = False
+
+    async def connect(self) -> None:
+        reader, writer = await asyncio.open_connection(*self.addr)
+        self._fw = FrameWriter(writer)
+        fr = FrameReader(reader)
+
+        async def pump() -> None:
+            try:
+                while True:
+                    frame = await fr.recv()
+                    if frame is None:
+                        break
+                    header, payload = frame
+                    q = self._queues.get(header.get("stream"))
+                    if q is None:
+                        continue
+                    ftype = header.get("type")
+                    if ftype == "item":
+                        q.put_nowait(("item", payload))
+                    elif ftype == "end":
+                        q.put_nowait(("end", None))
+                    elif ftype == "err":
+                        q.put_nowait(("err", header.get("message", "remote error")))
+            finally:
+                self.closed = True
+                for q in self._queues.values():
+                    q.put_nowait(("disconnect", None))
+
+        self._pump = asyncio.get_running_loop().create_task(
+            pump(), name=f"tcp-client-pump:{self.addr}"
+        )
+
+    def open_stream(self) -> Tuple[int, asyncio.Queue]:
+        sid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[sid] = q
+        return sid, q
+
+    def close_stream(self, sid: int) -> None:
+        self._queues.pop(sid, None)
+
+    async def send(self, header: Any, payload: Any = None) -> None:
+        assert self._fw is not None
+        await self._fw.send(header, payload)
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._fw is not None:
+            self._fw.close()
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class _TcpClientEngine:
+    """AsyncEngine view of a remote instance over the TCP plane."""
+
+    def __init__(self, plane: TcpRequestPlane, addr: Tuple[str, int], key: str) -> None:
+        self._plane = plane
+        self._addr = addr
+        self._key = key
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        try:
+            conn = await self._plane._conn(self._addr)
+        except OSError as exc:
+            raise StreamDisconnectedError(f"connect {self._addr}: {exc}") from exc
+        sid, q = conn.open_stream()
+        await conn.send(
+            {
+                "type": "req",
+                "stream": sid,
+                "key": self._key,
+                "ctx": {"id": context.id, "baggage": context.baggage},
+            },
+            request,
+        )
+
+        async def watch_cancel() -> None:
+            await context.wait_stopped()
+            with _suppress_conn():
+                await conn.send({"type": "cancel", "stream": sid})
+
+        cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
+        try:
+            while True:
+                kind, payload = await q.get()
+                if kind == "item":
+                    yield payload
+                elif kind == "end":
+                    return
+                elif kind == "err":
+                    raise RuntimeError(payload)
+                elif kind == "disconnect":
+                    raise StreamDisconnectedError(
+                        f"worker connection lost: {self._addr}"
+                    )
+        finally:
+            cancel_task.cancel()
+            conn.close_stream(sid)
+
+
+class _suppress_conn:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        return et is not None and issubclass(
+            et, (ConnectionError, BrokenPipeError, RuntimeError, AssertionError)
+        )

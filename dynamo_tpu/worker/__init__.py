@@ -1,0 +1,7 @@
+"""Native JAX engine worker component (python -m dynamo_tpu.worker).
+
+Reference parity: components/src/dynamo/vllm/main.py — the engine worker
+process: boot the engine, register the model card, serve the endpoint,
+publish KV events and load stats. The engine here is the first-party JAX
+engine instead of vLLM.
+"""

@@ -1,0 +1,165 @@
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+
+import jax
+
+from dynamo_tpu import config
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig
+from dynamo_tpu.models.config import (
+    ModelConfig,
+    llama3_8b_config,
+    llama3_70b_config,
+    qwen2_500m_config,
+    tiny_config,
+)
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.router import KvEventPublisher, LoadPublisher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+BUILTIN_CONFIGS = {
+    "tiny": tiny_config,
+    "qwen2.5-0.5b": qwen2_500m_config,
+    "llama-3-8b": llama3_8b_config,
+    "llama-3-70b": llama3_70b_config,
+}
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu worker (native JAX engine)")
+    parser.add_argument(
+        "--model",
+        default="tiny",
+        help="HF model directory, or a builtin config name "
+        f"({', '.join(BUILTIN_CONFIGS)}) with random weights",
+    )
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--endpoint", default="generate")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-kv-blocks", type=int, default=2048)
+    parser.add_argument("--max-num-seqs", type=int, default=16)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--prefill-chunk", type=int, default=512)
+    parser.add_argument("--tensor-parallel-size", "--tp", type=int, default=1)
+    parser.add_argument("--no-prefix-caching", action="store_true")
+    parser.add_argument(
+        "--is-prefill-worker", action="store_true",
+        help="serve disaggregated prefill (ref: vllm/args.py --is-prefill-worker)",
+    )
+    parser.add_argument(
+        "--prefill-component", default="prefill",
+        help="component name prefill workers register under",
+    )
+    args = parser.parse_args()
+    if args.is_prefill_worker and args.component == "backend":
+        args.component = args.prefill_component
+
+    configure_logging()
+    runtime = DistributedRuntime.from_settings()
+
+    model_path = None
+    if args.model in BUILTIN_CONFIGS:
+        model_config = BUILTIN_CONFIGS[args.model]()
+        params = None  # random init inside the engine
+    else:
+        model_path = args.model
+        model_config = ModelConfig.from_model_dir(args.model)
+        from dynamo_tpu.models.hf_loader import load_hf_checkpoint
+
+        params = load_hf_checkpoint(args.model, model_config)
+
+    mesh = None
+    if args.tensor_parallel_size > 1:
+        mesh = make_mesh(
+            MeshConfig(tp=args.tensor_parallel_size), jax.devices()
+        )
+
+    name = args.served_model_name or model_config.name
+    instance_id = random.getrandbits(63)
+    kv_pub = KvEventPublisher(
+        runtime.event_plane, args.namespace, args.component, instance_id
+    )
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=model_config,
+            block_size=args.block_size,
+            num_kv_blocks=args.num_kv_blocks,
+            max_num_seqs=args.max_num_seqs,
+            max_model_len=args.max_model_len,
+            prefill_chunk=args.prefill_chunk,
+            enable_prefix_caching=not args.no_prefix_caching,
+        ),
+        params,
+        mesh=mesh,
+        on_kv_event=kv_pub.on_kv_event,
+    )
+    load_pub = LoadPublisher(
+        runtime.event_plane, args.namespace, args.component, instance_id,
+        engine.stats, total_blocks=args.num_kv_blocks,
+    )
+
+    card = ModelDeploymentCard(
+        name=name,
+        model_path=model_path,
+        context_length=args.max_model_len,
+        kv_block_size=args.block_size,
+        eos_token_ids=list(model_config.eos_token_ids),
+        runtime_config=RuntimeConfig(
+            total_kv_blocks=args.num_kv_blocks,
+            kv_block_size=args.block_size,
+            max_num_seqs=args.max_num_seqs,
+            max_context_len=args.max_model_len,
+        ),
+    )
+    from dynamo_tpu.disagg import DecodeHandler, KvTransferHandler, PrefillHandler
+
+    component = runtime.namespace(args.namespace).component(args.component)
+    endpoint = component.endpoint(args.endpoint)
+    kv_endpoint = component.endpoint("kv")
+    served_kv = await kv_endpoint.serve_endpoint(
+        KvTransferHandler(engine).generate, instance_id=instance_id
+    )
+    if args.is_prefill_worker:
+        handler = PrefillHandler(engine, instance_id)
+        served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
+        # Prefill workers are found via their component endpoint, not the
+        # model registry (ref: prefill_router.rs activate).
+    else:
+        async def _kv_client():
+            return await (
+                runtime.namespace(args.namespace)
+                .component(args.prefill_component)
+                .endpoint("kv")
+                .client()
+            )
+
+        handler = DecodeHandler(engine, kv_client_factory=_kv_client)
+        served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
+        await register_llm(runtime, card, endpoint, instance_id)
+    load_pub.start()
+    await engine.start()
+    print(
+        f"worker serving {name} as {args.namespace}/{args.component}/"
+        f"{args.endpoint} instance {instance_id:#x}",
+        flush=True,
+    )
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await load_pub.close()
+        await kv_pub.close()
+        await served.shutdown(grace_period=config.GRACE_PERIOD.get())
+        await served_kv.shutdown(grace_period=5)
+        await engine.stop()
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,307 @@
+"""Cross-process plane tests: two-part codec, TCP request plane (streaming,
+cancellation, disconnect), file discovery with lease expiry, discd service,
+ZMQ event plane — the reference's transports test surface (SURVEY §2.5)
+against real sockets on localhost."""
+
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from dynamo_tpu.llm.protocols.common import BackendOutput, FinishReason
+from dynamo_tpu.runtime.component import RouterMode
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import EventKind, MemoryDiscovery
+from dynamo_tpu.runtime.discovery.discd import DiscdDiscovery, DiscdServer
+from dynamo_tpu.runtime.discovery.file import FileDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import collect
+from dynamo_tpu.runtime.events.zmq_plane import EventBroker, ZmqEventPlane
+from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter, pack_frame
+from dynamo_tpu.runtime.network.tcp import StreamDisconnectedError, TcpRequestPlane
+
+
+# -- codec -------------------------------------------------------------------
+
+
+async def test_codec_roundtrip():
+    server_frames = []
+    done = asyncio.Event()
+
+    async def handle(reader, writer):
+        fr = FrameReader(reader)
+        while True:
+            frame = await fr.recv()
+            if frame is None:
+                break
+            server_frames.append(frame)
+        writer.close()  # else 3.12 server.wait_closed() below never returns
+        done.set()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    fw = FrameWriter(writer)
+    await fw.send({"type": "req", "stream": 1}, {"token_ids": [1, 2, 3]})
+    # Dataclasses with to_dict serialize transparently.
+    await fw.send({"type": "item"}, BackendOutput(token_ids=[7], finish_reason=FinishReason.EOS))
+    await fw.send({"empty": True}, None)
+    fw.close()
+    await asyncio.wait_for(done.wait(), 5)
+    server.close()
+    await server.wait_closed()
+
+    assert server_frames[0] == ({"type": "req", "stream": 1}, {"token_ids": [1, 2, 3]})
+    assert server_frames[1][1]["token_ids"] == [7]
+    assert server_frames[1][1]["finish_reason"] == "eos"
+    assert server_frames[2] == ({"empty": True}, None)
+
+
+# -- TCP request plane -------------------------------------------------------
+
+
+async def _tcp_pair():
+    """Two runtimes sharing a memory discovery bus but talking over real TCP."""
+    disco = MemoryDiscovery()
+    worker_rt = DistributedRuntime(
+        discovery=disco, request_plane=TcpRequestPlane(), bus="tcp-test"
+    )
+    frontend_rt = DistributedRuntime(
+        discovery=disco, request_plane=TcpRequestPlane(), bus="tcp-test"
+    )
+    return worker_rt, frontend_rt
+
+
+async def test_tcp_streaming_end_to_end():
+    worker_rt, frontend_rt = await _tcp_pair()
+
+    async def handler(request, context):
+        for i in range(int(request["n"])):
+            yield {"i": i}
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        out = await collect(client.generate({"n": 5}))
+        assert [o["i"] for o in out] == list(range(5))
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_tcp_cancellation_reaches_worker():
+    worker_rt, frontend_rt = await _tcp_pair()
+    worker_saw_cancel = asyncio.Event()
+
+    async def handler(request, context):
+        i = 0
+        while True:
+            if context.stopped:
+                worker_saw_cancel.set()
+                return
+            yield {"i": i}
+            i += 1
+            await asyncio.sleep(0.01)
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        ctx = Context()
+        got = []
+        async for item in client.generate({}, ctx):
+            got.append(item)
+            if len(got) == 3:
+                ctx.stop_generating()
+                break
+        await asyncio.wait_for(worker_saw_cancel.wait(), 5)
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_tcp_worker_death_surfaces_disconnect():
+    worker_rt, frontend_rt = await _tcp_pair()
+
+    async def handler(request, context):
+        yield {"i": 0}
+        await asyncio.sleep(30)
+        yield {"i": 1}
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        with pytest.raises(StreamDisconnectedError):
+            async for item in client.generate({}):
+                # Kill the worker's plane mid-stream (simulates worker crash).
+                await worker_rt.request_plane.close()
+    finally:
+        await client.close()
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+# -- file discovery ----------------------------------------------------------
+
+
+async def test_file_discovery_put_get_watch(tmp_path):
+    d1 = FileDiscovery(str(tmp_path), poll_interval=0.05)
+    d2 = FileDiscovery(str(tmp_path), poll_interval=0.05)
+    try:
+        await d1.put("instances/ns/c/e/0001", {"x": 1})
+        assert await d2.get("instances/ns/c/e/0001") == {"x": 1}
+
+        watch = d2.watch("instances/ns/")
+        snap = watch.drain_snapshot()
+        assert len(snap) == 1 and snap[0].value == {"x": 1}
+
+        await d1.put("instances/ns/c/e/0002", {"x": 2})
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.kind == EventKind.PUT and ev.value == {"x": 2}
+
+        await d1.delete("instances/ns/c/e/0001")
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.kind == EventKind.DELETE
+        await watch.aclose()
+    finally:
+        await d1.close()
+        await d2.close()
+
+
+async def test_file_discovery_lease_expiry(tmp_path):
+    d1 = FileDiscovery(str(tmp_path), poll_interval=0.05)
+    d2 = FileDiscovery(str(tmp_path), poll_interval=0.05)
+    try:
+        lease = await d1.create_lease(ttl=0.3)
+        await d1.put("instances/ns/c/e/0001", {"x": 1}, lease=lease)
+        assert await d2.get("instances/ns/c/e/0001") == {"x": 1}
+        watch = d2.watch("instances/")
+        watch.drain_snapshot()
+        # No keep-alive → expiry → watchers see DELETE (worker-death signal).
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.kind == EventKind.DELETE
+        assert await d2.get("instances/ns/c/e/0001") is None
+        await watch.aclose()
+    finally:
+        await d1.close()
+        await d2.close()
+
+
+# -- discd -------------------------------------------------------------------
+
+
+async def test_discd_end_to_end():
+    server = DiscdServer()
+    port = await server.start()
+    c1 = DiscdDiscovery(f"127.0.0.1:{port}")
+    c2 = DiscdDiscovery(f"127.0.0.1:{port}")
+    try:
+        await c1.put("instances/ns/c/e/01", {"host": "a"})
+        assert await c2.get("instances/ns/c/e/01") == {"host": "a"}
+        assert "instances/ns/c/e/01" in await c2.get_prefix("instances/")
+
+        watch = c2.watch("instances/")
+        ev = await asyncio.wait_for(watch.__anext__(), 5)  # snapshot PUT
+        assert ev.kind == EventKind.PUT and ev.key == "instances/ns/c/e/01"
+
+        await c1.put("instances/ns/c/e/02", {"host": "b"})
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.value == {"host": "b"}
+
+        # Lease expiry deletes keys and notifies watchers.
+        lease = await c1.create_lease(ttl=0.6)
+        await c1.put("instances/ns/c/e/03", {"host": "c"}, lease=lease)
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.key.endswith("/03")
+        ev = await asyncio.wait_for(watch.__anext__(), 5)
+        assert ev.kind == EventKind.DELETE and ev.key.endswith("/03")
+
+        # keep_alive holds a second lease open past its TTL.
+        lease2 = await c1.create_lease(ttl=0.6)
+        await c1.put("instances/ns/c/e/04", {"host": "d"}, lease=lease2)
+        for _ in range(4):
+            await asyncio.sleep(0.3)
+            await c1.keep_alive(lease2)
+        assert await c2.get("instances/ns/c/e/04") == {"host": "d"}
+        await watch.aclose()
+    finally:
+        await c1.close()
+        await c2.close()
+        await server.stop()
+
+
+# -- zmq event plane ---------------------------------------------------------
+
+
+async def test_zmq_event_plane_pub_sub():
+    broker = EventBroker()
+    broker.start()
+    p1 = ZmqEventPlane(broker.address)
+    p2 = ZmqEventPlane(broker.address)
+    try:
+        sub = p2.subscribe("ns.comp.kv_events")
+        wild = p2.subscribe("ns.>")
+        await asyncio.sleep(0.3)  # let SUB connections propagate
+        await p1.publish("ns.comp.kv_events", {"k": 1})
+        topic, payload = await asyncio.wait_for(sub.get(), 5)
+        assert topic == "ns.comp.kv_events" and payload == {"k": 1}
+        topic, payload = await asyncio.wait_for(wild.get(), 5)
+        assert payload == {"k": 1}
+
+        await p1.publish("other.topic", {"k": 2})
+        await p1.publish("ns.comp.load", {"k": 3})
+        topic, payload = await asyncio.wait_for(wild.get(), 5)
+        assert topic == "ns.comp.load"  # non-matching topic filtered out
+        await sub.aclose()
+        await wild.aclose()
+    finally:
+        await p1.close()
+        await p2.close()
+        await broker.close()
+
+
+# -- full cross-process-style stack -----------------------------------------
+
+
+async def test_runtime_over_discd_tcp_zmq(tmp_path):
+    """Worker and frontend runtimes wired like separate processes: discd
+    discovery, TCP request plane, ZMQ events (the from_settings topology)."""
+    server = DiscdServer()
+    port = await server.start()
+    broker = EventBroker()
+    broker.start()
+
+    worker_rt = DistributedRuntime(
+        discovery=DiscdDiscovery(f"127.0.0.1:{port}"),
+        request_plane=TcpRequestPlane(),
+        event_plane=ZmqEventPlane(broker.address),
+    )
+    front_rt = DistributedRuntime(
+        discovery=DiscdDiscovery(f"127.0.0.1:{port}"),
+        request_plane=TcpRequestPlane(),
+        event_plane=ZmqEventPlane(broker.address),
+    )
+
+    async def handler(request, context):
+        yield {"echo": request["msg"]}
+
+    served = await worker_rt.namespace("ns").component("w").endpoint("g").serve_endpoint(handler)
+    client = await front_rt.namespace("ns").component("w").endpoint("g").client()
+    try:
+        await client.wait_for_instances(timeout=5)
+        out = await collect(client.generate({"msg": "hi"}))
+        assert out == [{"echo": "hi"}]
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await front_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+        await broker.close()
+        await server.stop()

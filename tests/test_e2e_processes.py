@@ -1,0 +1,155 @@
+"""Whole-cluster e2e: real subprocesses (discd control plane, mocker worker,
+HTTP frontend) wired over TCP/ZMQ — the reference's serve-test shape
+(tests/serve/*, managed_process.py) on localhost with no accelerator."""
+
+import asyncio
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Proc:
+    def __init__(self, args, env, name):
+        self.name = name
+        self.proc = subprocess.Popen(
+            args,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+        )
+
+    def wait_for_line(self, needle: str, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        lines = []
+        while time.time() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{self.name} exited {self.proc.returncode}: {''.join(lines)}"
+                    )
+                time.sleep(0.05)
+                continue
+            lines.append(line)
+            if needle in line:
+                return
+        raise TimeoutError(f"{self.name}: {needle!r} not seen in: {''.join(lines)}")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGINT)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+
+
+@pytest.fixture
+def cluster_env():
+    disc_port = _free_port()
+    xsub, xpub = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "DYN_TPU_DISCOVERY": "discd",
+            "DYN_TPU_DISCOVERY_ADDR": f"127.0.0.1:{disc_port}",
+            "DYN_TPU_EVENT_PLANE": "zmq",
+            "DYN_TPU_EVENT_PLANE_ADDR": f"127.0.0.1:{xsub}:{xpub}",
+            "DYN_TPU_REQUEST_PLANE": "tcp",
+            # Generous: the 1-core CI box can starve keep-alive loops; a
+            # mid-request lease expiry makes the worker vanish and the
+            # stream die (that's a separate, fault-tolerance test's job).
+            "DYN_TPU_LEASE_TTL": "30",
+            "PYTHONUNBUFFERED": "1",
+        }
+    )
+    return env, disc_port, xsub, xpub
+
+
+def test_cluster_serves_openai_http(cluster_env):
+    env, disc_port, xsub, xpub = cluster_env
+    http_port = _free_port()
+    procs = []
+    try:
+        discd = Proc(
+            [sys.executable, "-m", "dynamo_tpu.discd", "--port", str(disc_port),
+             "--xsub", str(xsub), "--xpub", str(xpub)],
+            env, "discd",
+        )
+        procs.append(discd)
+        discd.wait_for_line("discd ready", 30)
+
+        mocker = Proc(
+            [sys.executable, "-m", "dynamo_tpu.mocker", "--model-name", "mock-1",
+             "--block-size", "8", "--speedup-ratio", "10"],
+            env, "mocker",
+        )
+        procs.append(mocker)
+        mocker.wait_for_line("mocker serving", 60)
+
+        frontend = Proc(
+            [sys.executable, "-m", "dynamo_tpu.frontend", "--host", "127.0.0.1",
+             "--http-port", str(http_port)],
+            env, "frontend",
+        )
+        procs.append(frontend)
+        frontend.wait_for_line("frontend listening", 60)
+
+        async def drive():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                # model appears via discovery
+                deadline = time.time() + 30
+                while True:
+                    r = await s.get(f"http://127.0.0.1:{http_port}/v1/models")
+                    models = [m["id"] for m in (await r.json())["data"]]
+                    if "mock-1" in models:
+                        break
+                    assert time.time() < deadline, f"model never appeared: {models}"
+                    await asyncio.sleep(0.25)
+
+                r = await s.post(
+                    f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                    json={
+                        "model": "mock-1",
+                        "messages": [{"role": "user", "content": "hello across processes"}],
+                        "max_tokens": 8,
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200, await r.text()
+                chunks = []
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                finishes = [
+                    c["choices"][0].get("finish_reason")
+                    for c in chunks if c.get("choices")
+                ]
+                assert "length" in finishes, chunks
+
+        asyncio.run(asyncio.wait_for(drive(), 60))
+    finally:
+        for p in reversed(procs):
+            p.stop()
