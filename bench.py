@@ -32,10 +32,11 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # (estimate; the reference repo publishes no in-tree number for this shape).
 BASELINE_TOKS_PER_SEC_PER_CHIP = 5000.0
 
-ISL = 128
-OSL = 64
-CONCURRENCY = 16
-REQUESTS = 32
+ISL = int(os.environ.get("BENCH_ISL", 128))
+OSL = int(os.environ.get("BENCH_OSL", 64))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", 64))
+REQUESTS = int(os.environ.get("BENCH_REQUESTS", 128))
+VERBOSE = os.environ.get("BENCH_VERBOSE") == "1"
 
 
 async def run_bench():
@@ -53,12 +54,12 @@ async def run_bench():
         JaxEngineArgs(
             config=cfg,
             block_size=16,
-            num_kv_blocks=1024,
+            num_kv_blocks=2048,
             max_num_seqs=CONCURRENCY,
             max_model_len=512,
             prefill_chunk=128,
             enable_prefix_caching=True,
-            decode_steps=16,
+            decode_steps=32,
         )
     )
 
@@ -93,7 +94,12 @@ async def run_bench():
         return await asyncio.gather(*(limited(i) for i in range(count)))
 
     # Warmup wave triggers all jit compiles (prefill buckets + decode buckets).
+    if VERBOSE:
+        print("warmup wave...", flush=True)
+    t0 = time.monotonic()
     await run_wave(CONCURRENCY, offset=10_000)
+    if VERBOSE:
+        print(f"warmup done in {time.monotonic()-t0:.1f}s; stats={engine.stats()}", flush=True)
 
     t0 = time.monotonic()
     results = await run_wave(REQUESTS, offset=0)

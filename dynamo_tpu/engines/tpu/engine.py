@@ -156,6 +156,7 @@ class JaxEngine:
         self._topk = np.zeros(S, dtype=np.int32)
         self._topp = np.ones(S, dtype=np.float32)
 
+        self.kvbm: Optional[Any] = None  # TieredKvManager (kvbm/manager.py)
         self._waiting: "asyncio.Queue[_Sequence]" = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
@@ -248,16 +249,20 @@ class JaxEngine:
         self._executor.shutdown(wait=False)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "active_seqs": sum(1 for s in self._slots if s is not None),
             "waiting": self._waiting.qsize(),
             "kv_usage": self.pool.usage,
             "free_blocks": self.pool.free_blocks,
             "cached_blocks": self.pool.cached_blocks,
+            "total_blocks": self.args.num_kv_blocks,
             "decode_steps": self.steps,
             "prefill_tokens": self.prefill_tokens,
             "generated_tokens": self.generated_tokens,
         }
+        if self.kvbm is not None:
+            out["kvbm"] = self.kvbm.stats()
+        return out
 
     @property
     def num_total_blocks(self) -> int:
@@ -306,7 +311,14 @@ class JaxEngine:
     async def _scheduler_loop(self) -> None:
         while not self._stopped.is_set():
             try:
-                admitted = await self._admit_one()
+                admitted = False
+                # Admit a few sequences per tick: one keeps TTFT of a burst
+                # linear in decode-tick latency; unbounded starves decode
+                # (chunked-prefill fairness, like the reference schedulers).
+                for _ in range(4):
+                    if not await self._admit_one():
+                        break
+                    admitted = True
                 active = any(s is not None for s in self._slots)
                 if active:
                     await self._decode_tick()
@@ -352,6 +364,15 @@ class JaxEngine:
         ids: List[int] = []
         if args.enable_prefix_caching:
             hashes = compute_block_hashes(prompt, args.block_size)
+            # Onboard from the lower tiers (G2/G3) anything that extends the
+            # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
+            if self.kvbm is not None and hashes:
+                n_dev = self.pool.match_prefix(hashes)
+                if n_dev < len(hashes):
+                    try:
+                        await self.kvbm.onboard(hashes)
+                    except Exception:
+                        logger.exception("KV onboard failed; prefilling locally")
             matched, ids = self.pool.pin_prefix(hashes)
         matched_tokens = min(matched * args.block_size, len(prompt) - 1)
 
@@ -413,6 +434,8 @@ class JaxEngine:
                 parent = hashes[i - 1] if i else None
                 self.pool.commit(ids[i], hashes[i], parent)
                 seq.block_hashes.append(hashes[i])
+                if self.kvbm is not None:
+                    self.kvbm.notify_commit(hashes[i], i + 1)
 
         # Install in the decode batch.
         assert first_token is not None
@@ -524,6 +547,8 @@ class JaxEngine:
             )[0]
             self.pool.commit(seq.block_ids[bi], h, parent)
             seq.block_hashes.append(h)
+            if self.kvbm is not None:
+                self.kvbm.notify_commit(h, bi + 1)
 
     def _preempt(self, seq: _Sequence) -> None:
         """Release blocks and requeue for recompute (vLLM-style preemption)."""

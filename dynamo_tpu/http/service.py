@@ -208,8 +208,21 @@ class HttpService:
         usage = usage_block(prompt_tokens, completion_tokens)
         finish_str = (finish or FinishReason.EOS).to_openai()
         if kind == "chat":
+            # Post-parse the complete message: reasoning tags and tool-call
+            # dialects (ref: lib/parsers; jail.rs does this for streams).
+            from dynamo_tpu.parsers import detect_and_parse_tool_calls, split_reasoning
+
+            reasoning, content = split_reasoning(text)
+            tool_calls = None
+            if body.get("tools"):
+                calls, content = detect_and_parse_tool_calls(content)
+                if calls:
+                    tool_calls = [c.to_openai() for c in calls]
+                    finish_str = "tool_calls"
             payload = chat_completion(
-                rid, entry.name, content=text, finish_reason=finish_str, usage=usage
+                rid, entry.name, content=content, finish_reason=finish_str,
+                usage=usage, tool_calls=tool_calls,
+                reasoning_content=reasoning or None,
             )
         else:
             payload = completion_response(
@@ -256,10 +269,13 @@ class HttpService:
         )
         await response.prepare(request)
 
+        from dynamo_tpu.parsers import ReasoningParser
+
         prompt_tokens = 0
         completion_tokens = 0
         sent_role = False
         status = 200
+        reasoning_parser = ReasoningParser()
         try:
             async for item in _prepend(first_item, stream):
                 if isinstance(item, dict) and "annotation" in item:
@@ -285,8 +301,23 @@ class HttpService:
                     if not sent_role:
                         delta["role"] = "assistant"
                         sent_role = True
-                    if out.text:
-                        delta["content"] = out.text
+                    text = out.text
+                    if out.finish_reason is not None:
+                        reasoning, content = reasoning_parser.feed(text or "")
+                        r_tail, c_tail = reasoning_parser.flush()
+                        reasoning += r_tail
+                        content += c_tail
+                    elif text:
+                        reasoning, content = reasoning_parser.feed(text)
+                    else:
+                        reasoning = content = ""
+                    if reasoning:
+                        # Streamed reasoning rides the nonstandard-but-common
+                        # reasoning_content delta field (ref: jail.rs stream
+                        # rewriting for <think> sections).
+                        delta["reasoning_content"] = reasoning
+                    if content:
+                        delta["content"] = content
                     chunk = chat_chunk(rid, entry.name, delta=delta, finish_reason=finish_str)
                 else:
                     chunk = completion_chunk(rid, entry.name, text=out.text, finish_reason=finish_str)

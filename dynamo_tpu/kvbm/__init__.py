@@ -1,0 +1,21 @@
+"""KVBM-equivalent: multi-tier KV cache (HBM → host RAM → disk → remote).
+
+Reference parity: lib/llm/src/block_manager* (SURVEY §2.1 KVBM row) —
+CacheLevel G1 device / G2 pinned host / G3 local disk / G4 remote
+(block_manager.rs:62–75), pools with reuse & eviction (pool/managed.rs),
+async offload/onboard engine with filters (offload.rs, offload/filter.rs).
+
+TPU-first redesign: every tier is content-addressed by the same chained
+block hash the router and disagg layers use. G1 is the engine's BlockPool in
+HBM; G2/G3 live here; G4 is any peer engine reachable over the request plane
+(disagg/handlers.py KvTransferHandler — same protocol). Offload is
+write-through on block commit (device gather batched on the engine's device
+thread); onboard runs at admission, extending the device prefix match before
+prefill. The reference's block_copy.cu becomes a donated-buffer jit scatter
+(engines/tpu/engine.py _scatter_blocks).
+"""
+
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, TierStats
+from dynamo_tpu.kvbm.manager import OffloadFilter, TieredKvManager
+
+__all__ = ["DiskTier", "HostTier", "TierStats", "OffloadFilter", "TieredKvManager"]

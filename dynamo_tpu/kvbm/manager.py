@@ -1,0 +1,156 @@
+"""TieredKvManager: the offload/onboard engine over the storage tiers.
+
+Reference parity: lib/llm/src/block_manager/offload.rs (async offload engine
+with bounded queues + filters) and the onboard path (matched blocks brought
+device-side before prefill, SURVEY §3.4). Write-through: blocks are queued
+for offload when they commit on-device, so device eviction never loses
+content; onboarding extends the device prefix match at admission time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from dynamo_tpu.kvbm.tiers import HostTier
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class OffloadFilter:
+    """Which committed blocks get offloaded (ref: offload/filter.rs).
+
+    ``min_chain_depth`` skips shallow chains (short prompts rarely reused);
+    ``max_per_burst`` bounds the per-wakeup device→host traffic.
+    """
+
+    min_chain_depth: int = 0
+    max_per_burst: int = 32
+
+    def admit(self, chain_depth: int) -> bool:
+        return chain_depth >= self.min_chain_depth
+
+
+class TieredKvManager:
+    def __init__(
+        self,
+        top_tier: HostTier,
+        *,
+        filter: Optional[OffloadFilter] = None,
+    ) -> None:
+        self.tier = top_tier
+        self.filter = filter or OffloadFilter()
+        # hash → chain depth, queued for offload
+        self._pending: "asyncio.Queue[Tuple[int, int]]" = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._engine: Optional[Any] = None
+        self.offloaded = 0
+        self.onboarded = 0
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, engine: Any) -> None:
+        """Attach to a JaxEngine: the engine calls notify_commit() for every
+        committed block; onboarding hooks into admission via
+        engine.kvbm = self (see engines/tpu/engine.py)."""
+        self._engine = engine
+        engine.kvbm = self
+
+    def notify_commit(self, block_hash: int, chain_depth: int) -> None:
+        if self.filter.admit(chain_depth) and not self.tier.contains(block_hash):
+            self._pending.put_nowait((block_hash, chain_depth))
+            self._ensure_task()
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(
+                self._offload_loop(), name="kvbm-offload"
+            )
+
+    # -- offload (G1 → G2) ---------------------------------------------------
+
+    async def _offload_loop(self) -> None:
+        while True:
+            burst: List[int] = []
+            h, _ = await self._pending.get()
+            burst.append(h)
+            while len(burst) < self.filter.max_per_burst and not self._pending.empty():
+                burst.append(self._pending.get_nowait()[0])
+            try:
+                await self._offload_burst(burst)
+            except Exception:
+                logger.exception("KV offload burst failed")
+            if self._pending.empty():
+                return  # re-spawned on next commit
+
+    async def _offload_burst(self, hashes: List[int]) -> None:
+        assert self._engine is not None
+        todo = [h for h in hashes if not self.tier.contains(h)]
+        if not todo:
+            return
+        # export_blocks_async stops at the first device miss; exporting one
+        # by one keeps it simple and each block is a single chain element.
+        for h in todo:
+            found, k, v = await self._engine.export_blocks_async([h])
+            if not found:
+                continue  # evicted before we got to it; write-through missed
+            self.tier.put(h, k[0], v[0])
+            self.offloaded += 1
+
+    # -- onboard (G2/G3 → G1) ------------------------------------------------
+
+    def match_chain(self, block_hashes: List[int]) -> int:
+        """Leading blocks available in the tiers."""
+        n = 0
+        for h in block_hashes:
+            if not self.tier.contains(h) and (
+                self.tier.next_tier is None or not self.tier.next_tier.contains(h)
+            ):
+                break
+            n += 1
+        return n
+
+    async def onboard(self, block_hashes: List[int]) -> int:
+        """Bring a leading run of blocks onto the device (before prefill).
+        Returns how many blocks were installed."""
+        assert self._engine is not None
+        ks, vs, run = [], [], []
+        for h in block_hashes:
+            blk = self.tier.get(h)
+            if blk is None:
+                break
+            run.append(h)
+            ks.append(blk[0])
+            vs.append(blk[1])
+        if not run:
+            return 0
+        import numpy as np
+
+        installed = await self._engine.import_blocks_async(
+            run, np.stack(ks), np.stack(vs)
+        )
+        self.onboarded += installed
+        return installed
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "offloaded": self.offloaded,
+            "onboarded": self.onboarded,
+            "host": self.tier.stats.to_dict(),
+            "host_blocks": len(self.tier),
+        }
+        if self.tier.next_tier is not None:
+            out["disk"] = self.tier.next_tier.stats.to_dict()
+            out["disk_blocks"] = len(self.tier.next_tier)
+        return out
+
+    async def close(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass

@@ -1,0 +1,163 @@
+"""Storage tiers: host RAM (G2) and local disk (G3).
+
+Reference parity: lib/llm/src/block_manager/storage/{mod,disk}.rs + the
+pinned-host pool. Blocks are content-addressed (chained hash → (k, v) numpy
+arrays of shape [L, block_size, KH, D]); each tier is LRU-bounded and spills
+evictions down to the next tier when one is attached.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+Block = Tuple[np.ndarray, np.ndarray]  # (k, v)
+
+
+@dataclass
+class TierStats:
+    hits: int = 0
+    misses: int = 0
+    stored: int = 0
+    evicted: int = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "stored": self.stored, "evicted": self.evicted}
+
+
+class HostTier:
+    """G2: host-RAM block store, LRU-bounded by block count."""
+
+    name = "host"
+
+    def __init__(self, capacity_blocks: int, *, next_tier: Optional["DiskTier"] = None) -> None:
+        self.capacity = capacity_blocks
+        self.next_tier = next_tier
+        self._blocks: "OrderedDict[int, Block]" = OrderedDict()
+        self.stats = TierStats()
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def contains(self, block_hash: int) -> bool:
+        return block_hash in self._blocks
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        if block_hash in self._blocks:
+            self._blocks.move_to_end(block_hash)
+            return
+        self._blocks[block_hash] = (np.asarray(k), np.asarray(v))
+        self.stats.stored += 1
+        while len(self._blocks) > self.capacity:
+            h, blk = self._blocks.popitem(last=False)
+            self.stats.evicted += 1
+            if self.next_tier is not None:
+                self.next_tier.put(h, blk[0], blk[1])  # G2 → G3 spill
+
+    def get(self, block_hash: int) -> Optional[Block]:
+        blk = self._blocks.get(block_hash)
+        if blk is not None:
+            self._blocks.move_to_end(block_hash)
+            self.stats.hits += 1
+            return blk
+        self.stats.misses += 1
+        if self.next_tier is not None:
+            lower = self.next_tier.get(block_hash)
+            if lower is not None:
+                self.put(block_hash, lower[0], lower[1])  # promote G3 → G2
+                return lower
+        return None
+
+    def clear(self) -> None:
+        self._blocks.clear()
+
+
+class DiskTier:
+    """G3: one .npz file per block under a spool directory, LRU-bounded."""
+
+    name = "disk"
+
+    def __init__(self, root: str, capacity_blocks: int = 4096) -> None:
+        self.root = root
+        self.capacity = capacity_blocks
+        os.makedirs(root, exist_ok=True)
+        self._lru: "OrderedDict[int, str]" = OrderedDict()
+        self.stats = TierStats()
+        # Recover existing spool contents (checkpoint/resume of the cache).
+        for fname in sorted(os.listdir(root)):
+            if fname.endswith(".npz"):
+                try:
+                    self._lru[int(fname[:-4], 16)] = os.path.join(root, fname)
+                except ValueError:
+                    continue
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def _path(self, block_hash: int) -> str:
+        return os.path.join(self.root, f"{block_hash:016x}.npz")
+
+    def contains(self, block_hash: int) -> bool:
+        return block_hash in self._lru
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        if block_hash in self._lru:
+            self._lru.move_to_end(block_hash)
+            return
+        path = self._path(block_hash)
+        # bf16 lacks npz support → view as uint16 and remember the dtype.
+        kk, vv = np.asarray(k), np.asarray(v)
+        np.savez(
+            path,
+            k=kk.view(np.uint16) if kk.dtype.str == "<V2" or "bfloat16" in str(kk.dtype) else kk,
+            v=vv.view(np.uint16) if vv.dtype.str == "<V2" or "bfloat16" in str(vv.dtype) else vv,
+            dtype=str(kk.dtype),
+        )
+        self._lru[block_hash] = path
+        self.stats.stored += 1
+        while len(self._lru) > self.capacity:
+            h, p = self._lru.popitem(last=False)
+            self.stats.evicted += 1
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+
+    def get(self, block_hash: int) -> Optional[Block]:
+        path = self._lru.get(block_hash)
+        if path is None:
+            self.stats.misses += 1
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                dtype = str(z["dtype"])
+                k, v = z["k"], z["v"]
+                if "bfloat16" in dtype:
+                    import ml_dtypes
+
+                    k = k.view(ml_dtypes.bfloat16)
+                    v = v.view(ml_dtypes.bfloat16)
+        except (FileNotFoundError, OSError, KeyError):
+            self._lru.pop(block_hash, None)
+            self.stats.misses += 1
+            return None
+        self._lru.move_to_end(block_hash)
+        self.stats.hits += 1
+        return k, v
+
+    def clear(self) -> None:
+        for _, path in self._lru.items():
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        self._lru.clear()

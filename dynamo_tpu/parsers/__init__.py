@@ -1,0 +1,18 @@
+"""Tool-call and reasoning-content parsers.
+
+Reference parity: lib/parsers (SURVEY §2.1 dynamo-parsers row) — tool-call
+dialects (JSON / hermes-XML / mistral / pythonic, src/tool_calling/) and
+reasoning extraction (<think> family, src/reasoning/). Parsers are pure
+functions over text plus small streaming state machines so the frontend can
+rewrite SSE deltas (the reference's chat_completions "jail").
+"""
+
+from dynamo_tpu.parsers.reasoning import ReasoningParser, split_reasoning
+from dynamo_tpu.parsers.tool_calling import ToolCall, detect_and_parse_tool_calls
+
+__all__ = [
+    "ReasoningParser",
+    "split_reasoning",
+    "ToolCall",
+    "detect_and_parse_tool_calls",
+]

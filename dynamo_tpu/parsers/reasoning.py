@@ -1,0 +1,93 @@
+"""Reasoning-content extraction (<think> ... </think> and friends).
+
+Reference parity: lib/parsers/src/reasoning/{base_parser,gpt_oss_parser,
+granite_parser}.rs — split generated text into `reasoning_content` and
+`content`. The streaming parser is a small state machine that survives tags
+straddling delta boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+KNOWN_TAGS = {
+    "think": ("<think>", "</think>"),
+    "reasoning": ("<reasoning>", "</reasoning>"),
+    "seed": ("<seed:think>", "</seed:think>"),
+}
+
+
+def split_reasoning(text: str, style: str = "think") -> Tuple[str, str]:
+    """One-shot split of a complete response → (reasoning, content)."""
+    open_tag, close_tag = KNOWN_TAGS[style]
+    start = text.find(open_tag)
+    if start == -1:
+        # Some models emit the close tag only (reasoning-first templates).
+        end_only = text.find(close_tag)
+        if end_only != -1:
+            return text[:end_only].strip(), text[end_only + len(close_tag):].lstrip("\n")
+        return "", text
+    end = text.find(close_tag, start)
+    if end == -1:
+        return text[start + len(open_tag):].strip(), ""
+    reasoning = text[start + len(open_tag): end].strip()
+    content = (text[:start] + text[end + len(close_tag):]).lstrip("\n")
+    return reasoning, content
+
+
+@dataclass
+class _State:
+    mode: str = "content"  # content | reasoning
+    buffer: str = ""  # held-back text that may be a partial tag
+
+
+class ReasoningParser:
+    """Streaming splitter: feed text deltas, get (reasoning_delta,
+    content_delta) pairs. Holds back a suffix that could be a partial tag."""
+
+    def __init__(self, style: str = "think", starts_in_reasoning: bool = False) -> None:
+        self.open_tag, self.close_tag = KNOWN_TAGS[style]
+        self._s = _State(mode="reasoning" if starts_in_reasoning else "content")
+
+    def _active_tag(self) -> str:
+        return self.close_tag if self._s.mode == "reasoning" else self.open_tag
+
+    def feed(self, delta: str) -> Tuple[str, str]:
+        reasoning_out = []
+        content_out = []
+        text = self._s.buffer + delta
+        self._s.buffer = ""
+        while text:
+            tag = self._active_tag()
+            idx = text.find(tag)
+            if idx != -1:
+                emitted, text = text[:idx], text[idx + len(tag):]
+                if self._s.mode == "reasoning":
+                    reasoning_out.append(emitted)
+                    self._s.mode = "content"
+                else:
+                    content_out.append(emitted)
+                    self._s.mode = "reasoning"
+                continue
+            # No full tag: hold back the longest suffix that is a prefix of
+            # the tag we're looking for.
+            hold = 0
+            for n in range(min(len(tag) - 1, len(text)), 0, -1):
+                if tag.startswith(text[-n:]):
+                    hold = n
+                    break
+            emit, self._s.buffer = (text[:-hold], text[-hold:]) if hold else (text, "")
+            (reasoning_out if self._s.mode == "reasoning" else content_out).append(emit)
+            break
+        return "".join(reasoning_out), "".join(content_out)
+
+    def flush(self) -> Tuple[str, str]:
+        """End of stream: release any held-back partial tag as-is."""
+        tail = self._s.buffer
+        self._s.buffer = ""
+        if not tail:
+            return "", ""
+        if self._s.mode == "reasoning":
+            return tail, ""
+        return "", tail

@@ -1,0 +1,146 @@
+"""Tool-call parsing across model dialects.
+
+Reference parity: lib/parsers/src/tool_calling/{json,pythonic,xml,…} —
+normalize whatever the model emitted into OpenAI tool_calls entries.
+Dialects:
+  json     — bare {"name": ..., "arguments"|"parameters": {...}} or a list
+  hermes   — <tool_call>{json}</tool_call> (Qwen/Hermes templates)
+  mistral  — [TOOL_CALLS]{json list}
+  pythonic — [fn(a=1, b="x"), ...] python-literal calls (llama-3.2 style)
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class ToolCall:
+    name: str
+    arguments: Dict[str, Any] = field(default_factory=dict)
+    call_id: str = ""
+
+    def to_openai(self) -> Dict[str, Any]:
+        return {
+            "id": self.call_id or f"call-{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {
+                "name": self.name,
+                "arguments": json.dumps(self.arguments, separators=(",", ":")),
+            },
+        }
+
+
+def _normalize(obj: Any) -> Optional[ToolCall]:
+    if not isinstance(obj, dict):
+        return None
+    name = obj.get("name")
+    if not name and isinstance(obj.get("function"), dict):
+        inner = obj["function"]
+        name = inner.get("name")
+        obj = inner
+    if not isinstance(name, str) or not name:
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    if isinstance(args, str):
+        try:
+            args = json.loads(args)
+        except json.JSONDecodeError:
+            args = {"__raw__": args}
+    if not isinstance(args, dict):
+        args = {"value": args}
+    return ToolCall(name=name, arguments=args)
+
+
+def _parse_json_calls(text: str) -> List[ToolCall]:
+    text = text.strip()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return []
+    items = obj if isinstance(obj, list) else [obj]
+    calls = [c for c in (_normalize(i) for i in items) if c is not None]
+    return calls
+
+
+_HERMES_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+_MISTRAL_RE = re.compile(r"\[TOOL_CALLS\]\s*(\[.*\]|\{.*\})", re.DOTALL)
+
+
+def _parse_hermes(text: str) -> Tuple[List[ToolCall], str]:
+    calls: List[ToolCall] = []
+    for m in _HERMES_RE.finditer(text):
+        calls.extend(_parse_json_calls(m.group(1)))
+    remainder = _HERMES_RE.sub("", text).strip()
+    return calls, remainder
+
+
+def _parse_mistral(text: str) -> Tuple[List[ToolCall], str]:
+    m = _MISTRAL_RE.search(text)
+    if not m:
+        return [], text
+    calls = _parse_json_calls(m.group(1))
+    remainder = (text[: m.start()] + text[m.end():]).strip()
+    return calls, remainder
+
+
+def _parse_pythonic(text: str) -> List[ToolCall]:
+    text = text.strip()
+    if not (text.startswith("[") and text.endswith("]")):
+        return []
+    try:
+        tree = ast.parse(text, mode="eval")
+    except SyntaxError:
+        return []
+    if not isinstance(tree.body, ast.List):
+        return []
+    calls: List[ToolCall] = []
+    for node in tree.body.elts:
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            return []
+        args: Dict[str, Any] = {}
+        try:
+            for kw in node.keywords:
+                if kw.arg is None:
+                    return []
+                args[kw.arg] = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            return []
+        calls.append(ToolCall(name=node.func.id, arguments=args))
+    return calls
+
+
+def detect_and_parse_tool_calls(
+    text: str, dialect: Optional[str] = None
+) -> Tuple[List[ToolCall], str]:
+    """Returns (tool_calls, remaining_content). ``dialect`` pins a format;
+    None auto-detects (hermes → mistral → json → pythonic)."""
+    if dialect == "hermes":
+        return _parse_hermes(text)
+    if dialect == "mistral":
+        return _parse_mistral(text)
+    if dialect == "json":
+        calls = _parse_json_calls(text)
+        return calls, "" if calls else text
+    if dialect == "pythonic":
+        calls = _parse_pythonic(text)
+        return calls, "" if calls else text
+
+    calls, remainder = _parse_hermes(text)
+    if calls:
+        return calls, remainder
+    calls, remainder = _parse_mistral(text)
+    if calls:
+        return calls, remainder
+    calls = _parse_json_calls(text)
+    if calls:
+        return calls, ""
+    calls = _parse_pythonic(text)
+    if calls:
+        return calls, ""
+    return [], text

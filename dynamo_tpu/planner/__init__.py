@@ -1,0 +1,42 @@
+"""SLA-driven autoscaler (the Planner).
+
+Reference parity: components/src/dynamo/planner — BasePlanner
+(utils/planner_core.py:258, plan_adjustment :631, run :703), load predictors
+(utils/load_predictor.py:97–320), perf interpolation from profiler sweeps
+(utils/perf_interpolation.py:37,102), scaling connectors (kubernetes /
+virtual). Here the TPU deployment unit is a worker process on a slice;
+the virtual connector drives process-level scaling for tests and single-host
+deployments, the k8s connector patches CRs (deploy/ round 2+).
+"""
+
+from dynamo_tpu.planner.load_predictor import (
+    ConstantPredictor,
+    KalmanPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import (
+    MetricsSnapshot,
+    Planner,
+    PlannerConfig,
+    ReplicaPlan,
+)
+from dynamo_tpu.planner.connectors import VirtualConnector
+
+__all__ = [
+    "ConstantPredictor",
+    "KalmanPredictor",
+    "MovingAveragePredictor",
+    "make_predictor",
+    "DecodeInterpolator",
+    "PrefillInterpolator",
+    "MetricsSnapshot",
+    "Planner",
+    "PlannerConfig",
+    "ReplicaPlan",
+    "VirtualConnector",
+]

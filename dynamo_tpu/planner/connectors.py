@@ -1,0 +1,42 @@
+"""Scaling connectors: apply a ReplicaPlan to the world.
+
+Reference parity: components/src/dynamo/planner/{kubernetes_connector.py,
+virtual_connector.py}. The virtual connector publishes the desired counts
+to the discovery plane (key ``planner/{namespace}/desired``) where tests,
+a process supervisor, or the k8s operator equivalent picks them up — the
+same decoupling the reference gets from patching DynamoGraphDeployment
+replicas and letting the operator reconcile.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def planner_key(namespace: str) -> str:
+    return f"planner/{namespace}/desired"
+
+
+class VirtualConnector:
+    def __init__(self, discovery: Any, namespace: str) -> None:
+        self.discovery = discovery
+        self.namespace = namespace
+        self.applied: Optional[Dict[str, int]] = None
+
+    async def apply(self, plan) -> None:
+        doc = {
+            "prefill": int(plan.prefill),
+            "decode": int(plan.decode),
+            "reason": plan.reason,
+            "ts": time.time(),
+        }
+        await self.discovery.put(planner_key(self.namespace), doc)
+        self.applied = doc
+
+    async def read_desired(self) -> Optional[Dict[str, Any]]:
+        return await self.discovery.get(planner_key(self.namespace))

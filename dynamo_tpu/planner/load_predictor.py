@@ -1,0 +1,120 @@
+"""Load predictors: forecast the next interval's request rate / ISL / OSL.
+
+Reference parity: components/src/dynamo/planner/utils/load_predictor.py
+(:97 ConstantPredictor, ARIMA :150, Prophet :230, Kalman :320). ARIMA/Prophet
+pull heavyweight deps the environment doesn't ship, so the trend-capable
+middle ground is a double-exponential (Holt) moving average; the Kalman
+filter is implemented directly (it's 20 lines of numpy).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+
+class BasePredictor:
+    def __init__(self, window: int = 50) -> None:
+        self.window = window
+        self.data: Deque[float] = deque(maxlen=window)
+
+    def add_data_point(self, value: float) -> None:
+        if value is not None and not np.isnan(value):
+            self.data.append(float(value))
+
+    def get_last(self) -> Optional[float]:
+        return self.data[-1] if self.data else None
+
+    def predict_next(self) -> Optional[float]:
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    """Next = last observed (ref: load_predictor.py:97)."""
+
+    def predict_next(self) -> Optional[float]:
+        return self.get_last()
+
+
+class MovingAveragePredictor(BasePredictor):
+    """Holt double-exponential smoothing: tracks level + trend — the
+    dependency-free stand-in for the reference's ARIMA predictor."""
+
+    def __init__(self, window: int = 50, alpha: float = 0.5, beta: float = 0.2) -> None:
+        super().__init__(window)
+        self.alpha = alpha
+        self.beta = beta
+        self._level: Optional[float] = None
+        self._trend: float = 0.0
+
+    def add_data_point(self, value: float) -> None:
+        super().add_data_point(value)
+        v = float(value)
+        if self._level is None:
+            self._level = v
+            return
+        prev_level = self._level
+        self._level = self.alpha * v + (1 - self.alpha) * (self._level + self._trend)
+        self._trend = self.beta * (self._level - prev_level) + (1 - self.beta) * self._trend
+
+    def predict_next(self) -> Optional[float]:
+        if self._level is None:
+            return None
+        return max(self._level + self._trend, 0.0)
+
+
+class KalmanPredictor(BasePredictor):
+    """1-D constant-velocity Kalman filter over the load series
+    (ref: load_predictor.py:320)."""
+
+    def __init__(self, window: int = 50, process_var: float = 1.0, obs_var: float = 10.0) -> None:
+        super().__init__(window)
+        self.q = process_var
+        self.r = obs_var
+        self.x = np.zeros(2)  # [level, velocity]
+        self.P = np.eye(2) * 100.0
+        self._initialized = False
+
+    def add_data_point(self, value: float) -> None:
+        super().add_data_point(value)
+        z = float(value)
+        if not self._initialized:
+            self.x = np.array([z, 0.0])
+            self._initialized = True
+            return
+        F = np.array([[1.0, 1.0], [0.0, 1.0]])
+        H = np.array([[1.0, 0.0]])
+        Q = np.eye(2) * self.q
+        # predict
+        self.x = F @ self.x
+        self.P = F @ self.P @ F.T + Q
+        # update
+        y = z - (H @ self.x)[0]
+        S = (H @ self.P @ H.T)[0, 0] + self.r
+        K = (self.P @ H.T)[:, 0] / S
+        self.x = self.x + K * y
+        self.P = (np.eye(2) - np.outer(K, H[0])) @ self.P
+
+    def predict_next(self) -> Optional[float]:
+        if not self._initialized:
+            return None
+        return max(self.x[0] + self.x[1], 0.0)
+
+
+_PREDICTORS = {
+    "constant": ConstantPredictor,
+    "moving-average": MovingAveragePredictor,
+    "arima": MovingAveragePredictor,  # reference name → Holt stand-in
+    "kalman": KalmanPredictor,
+}
+
+
+def make_predictor(kind: str, **kwargs) -> BasePredictor:
+    try:
+        return _PREDICTORS[kind](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown predictor {kind!r}; choose from {sorted(_PREDICTORS)}"
+        ) from None

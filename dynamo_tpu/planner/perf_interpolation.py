@@ -1,0 +1,98 @@
+"""Performance interpolation over profiler sweep data.
+
+Reference parity: components/src/dynamo/planner/utils/perf_interpolation.py
+(PrefillInterpolator :37 — TTFT(isl) and prefill throughput(isl);
+DecodeInterpolator :102 — ITL(context, concurrency) and per-seq decode
+throughput). Sweep points come from the profiler (dynamo_tpu.profiler) as a
+JSON dict; interpolation is piecewise-linear with edge clamping (numpy
+interp / bilinear on the sorted grid).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+class PrefillInterpolator:
+    """TTFT and throughput as a function of input sequence length."""
+
+    def __init__(self, isl: Sequence[float], ttft_s: Sequence[float],
+                 tokens_per_s: Sequence[float]) -> None:
+        order = np.argsort(isl)
+        self.isl = np.asarray(isl, dtype=float)[order]
+        self.ttft_s = np.asarray(ttft_s, dtype=float)[order]
+        self.tokens_per_s = np.asarray(tokens_per_s, dtype=float)[order]
+
+    def interpolate_ttft(self, isl: float) -> float:
+        return float(np.interp(isl, self.isl, self.ttft_s))
+
+    def interpolate_throughput(self, isl: float) -> float:
+        """Prefill tokens/sec/worker at this ISL."""
+        return float(np.interp(isl, self.isl, self.tokens_per_s))
+
+    @classmethod
+    def from_points(cls, points: List[Dict[str, float]]) -> "PrefillInterpolator":
+        return cls(
+            [p["isl"] for p in points],
+            [p["ttft_s"] for p in points],
+            [p["tokens_per_s"] for p in points],
+        )
+
+
+class DecodeInterpolator:
+    """ITL and per-sequence decode throughput vs batch concurrency.
+
+    The reference interpolates over (context_length, active_kv_usage); the
+    dominant axis for a fixed-shape deployment is concurrency, so the sweep
+    is (concurrency → itl_s, tokens_per_s_total)."""
+
+    def __init__(self, concurrency: Sequence[float], itl_s: Sequence[float],
+                 tokens_per_s: Sequence[float]) -> None:
+        order = np.argsort(concurrency)
+        self.concurrency = np.asarray(concurrency, dtype=float)[order]
+        self.itl_s = np.asarray(itl_s, dtype=float)[order]
+        self.tokens_per_s = np.asarray(tokens_per_s, dtype=float)[order]
+
+    def interpolate_itl(self, concurrency: float) -> float:
+        return float(np.interp(concurrency, self.concurrency, self.itl_s))
+
+    def interpolate_throughput(self, concurrency: float) -> float:
+        """Total decode tokens/sec/worker at this concurrency."""
+        return float(np.interp(concurrency, self.concurrency, self.tokens_per_s))
+
+    def max_concurrency_for_itl(self, itl_target_s: float) -> float:
+        """Highest concurrency whose interpolated ITL still meets the SLA."""
+        ok = self.itl_s <= itl_target_s
+        if not ok.any():
+            return float(self.concurrency[0])  # nothing meets it; be minimal
+        if ok.all():
+            return float(self.concurrency[-1])
+        # Find the crossing between the last ok point and the first bad one.
+        idx = int(np.argmax(~ok)) - 1
+        lo_c, hi_c = self.concurrency[idx], self.concurrency[idx + 1]
+        lo_i, hi_i = self.itl_s[idx], self.itl_s[idx + 1]
+        if hi_i == lo_i:
+            return float(hi_c)
+        frac = (itl_target_s - lo_i) / (hi_i - lo_i)
+        return float(lo_c + frac * (hi_c - lo_c))
+
+    @classmethod
+    def from_points(cls, points: List[Dict[str, float]]) -> "DecodeInterpolator":
+        return cls(
+            [p["concurrency"] for p in points],
+            [p["itl_s"] for p in points],
+            [p["tokens_per_s"] for p in points],
+        )
+
+
+def load_profile(path: str):
+    """Load a profiler sweep file → (PrefillInterpolator, DecodeInterpolator)."""
+    with open(path) as f:
+        doc = json.load(f)
+    return (
+        PrefillInterpolator.from_points(doc["prefill"]),
+        DecodeInterpolator.from_points(doc["decode"]),
+    )

@@ -1,0 +1,189 @@
+"""The planner core: observe → predict → size → apply.
+
+Reference parity: components/src/dynamo/planner/utils/planner_core.py —
+BasePlanner (:258), observe_metrics (:511), update predictors (:607),
+_compute_replica_requirements (:668/:775/:823), plan_adjustment (:631) with
+chip-budget clamping (:132,:180), run loop (:703). Prefill and decode pools
+are sized independently (disaggregated deployments); aggregated deployments
+size only the decode pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.planner.load_predictor import BasePredictor, make_predictor
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 30.0
+    ttft_target_s: float = 0.5  # SLA targets (ref: planner_sla args)
+    itl_target_s: float = 0.02
+    predictor: str = "moving-average"
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Chip budget clamp (ref: planner_core.py:132 GPU budget)
+    chips_per_prefill_worker: int = 1
+    chips_per_decode_worker: int = 1
+    total_chip_budget: int = 8
+    osl_default: float = 128.0  # fallback when no OSL metric yet
+
+
+@dataclass
+class MetricsSnapshot:
+    """One observation interval (ref: observe_metrics :511)."""
+
+    request_rate: float = 0.0  # requests/sec
+    mean_isl: float = 0.0  # input tokens/request
+    mean_osl: float = 0.0  # output tokens/request
+    p50_ttft_s: Optional[float] = None
+    p50_itl_s: Optional[float] = None
+
+
+@dataclass
+class ReplicaPlan:
+    prefill: int
+    decode: int
+    reason: str = ""
+
+
+class Planner:
+    def __init__(
+        self,
+        config: PlannerConfig,
+        prefill_interp: PrefillInterpolator,
+        decode_interp: DecodeInterpolator,
+        connector: Any,
+        metrics_source: Any,  # async () -> MetricsSnapshot
+        *,
+        disagg: bool = True,
+    ) -> None:
+        self.config = config
+        self.prefill_interp = prefill_interp
+        self.decode_interp = decode_interp
+        self.connector = connector
+        self.metrics_source = metrics_source
+        self.disagg = disagg
+        self.rate_pred: BasePredictor = make_predictor(config.predictor)
+        self.isl_pred: BasePredictor = make_predictor(config.predictor)
+        self.osl_pred: BasePredictor = make_predictor(config.predictor)
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.last_plan: Optional[ReplicaPlan] = None
+
+    # -- sizing math (ref: _compute_replica_requirements) -------------------
+
+    def compute_plan(self) -> Optional[ReplicaPlan]:
+        rate = self.rate_pred.predict_next()
+        isl = self.isl_pred.predict_next()
+        osl = self.osl_pred.predict_next() or self.config.osl_default
+        if rate is None or isl is None:
+            return None
+        cfg = self.config
+
+        # Prefill pool: needed prefill token throughput / per-worker
+        # throughput at the SLA'd ISL.
+        prefill_tokens_per_s = rate * isl
+        per_worker_prefill = max(self.prefill_interp.interpolate_throughput(isl), 1e-6)
+        ttft = self.prefill_interp.interpolate_ttft(isl)
+        prefill_n = math.ceil(prefill_tokens_per_s / per_worker_prefill)
+        if ttft > cfg.ttft_target_s:
+            # A single prefill can't meet TTFT at this ISL — chunking across
+            # workers doesn't help; flag it but keep the throughput sizing.
+            logger.warning(
+                "TTFT SLA %.3fs unattainable at ISL %.0f (model TTFT %.3fs)",
+                cfg.ttft_target_s, isl, ttft,
+            )
+
+        # Decode pool: steady-state concurrency = rate × generation time;
+        # cap per-worker concurrency at the ITL SLA crossing.
+        max_conc = max(self.decode_interp.max_concurrency_for_itl(cfg.itl_target_s), 1.0)
+        per_seq_decode = self.decode_interp.interpolate_throughput(max_conc) / max_conc
+        gen_time_s = osl / max(per_seq_decode, 1e-6)
+        concurrency = rate * gen_time_s
+        decode_n = math.ceil(concurrency / max_conc)
+
+        prefill_n = min(max(prefill_n, cfg.min_replicas), cfg.max_replicas)
+        decode_n = min(max(decode_n, cfg.min_replicas), cfg.max_replicas)
+        if not self.disagg:
+            prefill_n = 0
+
+        # Chip budget clamp (ref: planner_core.py:132): shrink the larger
+        # pool first until the budget fits.
+        def chips(p: int, d: int) -> int:
+            return p * cfg.chips_per_prefill_worker + d * cfg.chips_per_decode_worker
+
+        while chips(prefill_n, decode_n) > cfg.total_chip_budget:
+            if prefill_n >= decode_n and prefill_n > cfg.min_replicas:
+                prefill_n -= 1
+            elif decode_n > cfg.min_replicas:
+                decode_n -= 1
+            else:
+                break
+        return ReplicaPlan(
+            prefill=prefill_n,
+            decode=decode_n,
+            reason=(
+                f"rate={rate:.2f}req/s isl={isl:.0f} osl={osl:.0f} "
+                f"conc={concurrency:.1f}/{max_conc:.1f}per-worker"
+            ),
+        )
+
+    # -- loop ---------------------------------------------------------------
+
+    async def observe_once(self) -> MetricsSnapshot:
+        snap: MetricsSnapshot = await self.metrics_source()
+        self.rate_pred.add_data_point(snap.request_rate)
+        if snap.mean_isl:
+            self.isl_pred.add_data_point(snap.mean_isl)
+        if snap.mean_osl:
+            self.osl_pred.add_data_point(snap.mean_osl)
+        return snap
+
+    async def step(self) -> Optional[ReplicaPlan]:
+        await self.observe_once()
+        plan = self.compute_plan()
+        if plan is not None:
+            self.last_plan = plan
+            logger.info(
+                "plan: prefill=%d decode=%d (%s)", plan.prefill, plan.decode, plan.reason
+            )
+            await self.connector.apply(plan)
+        return plan
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stop.clear()
+            self._task = asyncio.get_event_loop().create_task(
+                self._run(), name="planner"
+            )
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("planner step failed")
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.config.adjustment_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None

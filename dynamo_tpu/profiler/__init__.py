@@ -1,0 +1,116 @@
+"""Profiler: sweep an engine to produce planner interpolation tables.
+
+Reference parity: the SLA profiler sweeps behind
+DynamoGraphDeploymentRequest + planner/utils/pre_swept_results (SURVEY §2.2
+planner row; tests/profiler/). Measures, on the live engine:
+
+  prefill: per-ISL time-to-first-token and prefill tokens/sec
+  decode:  per-concurrency inter-token latency and total decode tokens/sec
+
+Output JSON: {"prefill": [{isl, ttft_s, tokens_per_s}...],
+              "decode": [{concurrency, itl_s, tokens_per_s}...]}
+(consumed by planner.perf_interpolation.load_profile).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def _run_request(engine, tokens, max_tokens):
+    req = PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(temperature=1.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    t0 = time.monotonic()
+    ttft = None
+    n = 0
+    async for out in engine.generate(req, Context()):
+        if out.token_ids:
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            n += len(out.token_ids)
+    return n, ttft, time.monotonic() - t0
+
+
+async def profile_prefill(
+    engine, isl_values: Sequence[int], *, repeats: int = 3, vocab: int = 256
+) -> List[Dict[str, float]]:
+    rng = np.random.default_rng(0)
+    points = []
+    for isl in isl_values:
+        ttfts = []
+        for r in range(repeats):
+            tokens = rng.integers(4, vocab, size=isl).tolist()
+            _, ttft, _ = await _run_request(engine, tokens, max_tokens=1)
+            if ttft is not None:
+                ttfts.append(ttft)
+        ttft_s = float(np.median(ttfts)) if ttfts else float("nan")
+        points.append(
+            {"isl": float(isl), "ttft_s": ttft_s, "tokens_per_s": isl / ttft_s if ttft_s else 0.0}
+        )
+        logger.info("prefill sweep isl=%d ttft=%.4fs", isl, ttft_s)
+    return points
+
+
+async def profile_decode(
+    engine,
+    concurrency_values: Sequence[int],
+    *,
+    isl: int = 64,
+    osl: int = 32,
+    vocab: int = 256,
+) -> List[Dict[str, float]]:
+    rng = np.random.default_rng(1)
+    points = []
+    for conc in concurrency_values:
+        prompts = [rng.integers(4, vocab, size=isl).tolist() for _ in range(conc)]
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *(_run_request(engine, p, max_tokens=osl) for p in prompts)
+        )
+        wall = time.monotonic() - t0
+        total = sum(r[0] for r in results)
+        itls = [
+            (r[2] - r[1]) / max(r[0] - 1, 1) for r in results if r[1] is not None
+        ]
+        itl_s = float(np.median(itls)) if itls else float("nan")
+        points.append(
+            {
+                "concurrency": float(conc),
+                "itl_s": itl_s,
+                "tokens_per_s": total / wall if wall > 0 else 0.0,
+            }
+        )
+        logger.info("decode sweep conc=%d itl=%.4fs tput=%.1f", conc, itl_s, total / wall)
+    return points
+
+
+async def profile_engine(
+    engine,
+    *,
+    isl_values: Sequence[int] = (64, 128, 256, 512),
+    concurrency_values: Sequence[int] = (1, 2, 4, 8),
+    osl: int = 32,
+    vocab: int = 256,
+) -> Dict[str, Any]:
+    prefill = await profile_prefill(engine, isl_values, vocab=vocab)
+    decode = await profile_decode(
+        engine, concurrency_values, isl=min(isl_values), osl=osl, vocab=vocab
+    )
+    return {"prefill": prefill, "decode": decode}

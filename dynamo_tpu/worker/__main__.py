@@ -57,6 +57,17 @@ async def main() -> None:
         "--prefill-component", default="prefill",
         help="component name prefill workers register under",
     )
+    parser.add_argument(
+        "--kv-offload-blocks", type=int, default=0,
+        help="host-RAM KV tier capacity in blocks (0 = offload disabled; "
+        "ref: KVBM G2 tier)",
+    )
+    parser.add_argument(
+        "--kv-offload-dir", default=None,
+        help="disk KV tier spool directory (KVBM G3; requires --kv-offload-blocks)",
+    )
+    parser.add_argument("--decode-steps", type=int, default=8,
+                        help="fused decode iterations per device dispatch")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -95,11 +106,19 @@ async def main() -> None:
             max_model_len=args.max_model_len,
             prefill_chunk=args.prefill_chunk,
             enable_prefix_caching=not args.no_prefix_caching,
+            decode_steps=args.decode_steps,
         ),
         params,
         mesh=mesh,
         on_kv_event=kv_pub.on_kv_event,
     )
+    kvbm = None
+    if args.kv_offload_blocks > 0:
+        from dynamo_tpu.kvbm import DiskTier, HostTier, TieredKvManager
+
+        disk = DiskTier(args.kv_offload_dir) if args.kv_offload_dir else None
+        kvbm = TieredKvManager(HostTier(args.kv_offload_blocks, next_tier=disk))
+        kvbm.attach(engine)
     load_pub = LoadPublisher(
         runtime.event_plane, args.namespace, args.component, instance_id,
         engine.stats, total_blocks=args.num_kv_blocks,
@@ -153,6 +172,8 @@ async def main() -> None:
     try:
         await asyncio.Event().wait()
     finally:
+        if kvbm is not None:
+            await kvbm.close()
         await load_pub.close()
         await kv_pub.close()
         await served.shutdown(grace_period=config.GRACE_PERIOD.get())

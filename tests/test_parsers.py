@@ -1,0 +1,114 @@
+"""Parser tests: reasoning extraction (one-shot + streaming with tags split
+across deltas) and tool-call dialects (ref: lib/parsers test coverage)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    ReasoningParser,
+    ToolCall,
+    detect_and_parse_tool_calls,
+    split_reasoning,
+)
+
+
+class TestReasoning:
+    def test_one_shot_split(self):
+        r, c = split_reasoning("<think>plan things</think>The answer is 4.")
+        assert r == "plan things"
+        assert c == "The answer is 4."
+
+    def test_no_tags_passthrough(self):
+        r, c = split_reasoning("just an answer")
+        assert r == "" and c == "just an answer"
+
+    def test_close_tag_only(self):
+        r, c = split_reasoning("thinking...</think>done")
+        assert r == "thinking..." and c == "done"
+
+    def test_unclosed_reasoning(self):
+        r, c = split_reasoning("<think>never stopped")
+        assert r == "never stopped" and c == ""
+
+    def test_streaming_tag_across_deltas(self):
+        p = ReasoningParser()
+        chunks = ["<th", "ink>deep ", "thought</th", "ink>and the", " answer"]
+        reasoning, content = "", ""
+        for ch in chunks:
+            r, c = p.feed(ch)
+            reasoning += r
+            content += c
+        r, c = p.flush()
+        reasoning += r
+        content += c
+        assert reasoning == "deep thought"
+        assert content == "and the answer"
+
+    def test_streaming_no_tags(self):
+        p = ReasoningParser()
+        r, c = p.feed("hello world")
+        assert (r, c) == ("", "hello world")
+
+    def test_flush_releases_partial_tag(self):
+        p = ReasoningParser()
+        r, c = p.feed("abc<thi")
+        assert c == "abc"
+        r2, c2 = p.flush()
+        assert c2 == "<thi"  # not a real tag; returned verbatim
+
+
+class TestToolCalls:
+    def test_json_dialect(self):
+        calls, rest = detect_and_parse_tool_calls(
+            '{"name": "get_weather", "arguments": {"city": "Paris"}}'
+        )
+        assert len(calls) == 1
+        assert calls[0].name == "get_weather"
+        assert calls[0].arguments == {"city": "Paris"}
+        assert rest == ""
+
+    def test_json_list(self):
+        calls, _ = detect_and_parse_tool_calls(
+            '[{"name": "a", "arguments": {}}, {"name": "b", "parameters": {"x": 1}}]'
+        )
+        assert [c.name for c in calls] == ["a", "b"]
+        assert calls[1].arguments == {"x": 1}
+
+    def test_hermes_dialect(self):
+        text = (
+            'Let me check.\n<tool_call>\n{"name": "search", "arguments": '
+            '{"q": "tpu"}}\n</tool_call>'
+        )
+        calls, rest = detect_and_parse_tool_calls(text)
+        assert calls[0].name == "search"
+        assert rest == "Let me check."
+
+    def test_mistral_dialect(self):
+        calls, rest = detect_and_parse_tool_calls(
+            '[TOOL_CALLS][{"name": "add", "arguments": {"a": 1, "b": 2}}]'
+        )
+        assert calls[0].name == "add" and calls[0].arguments == {"a": 1, "b": 2}
+        assert rest == ""
+
+    def test_pythonic_dialect(self):
+        calls, _ = detect_and_parse_tool_calls('[get_time(tz="UTC"), ping()]')
+        assert [c.name for c in calls] == ["get_time", "ping"]
+        assert calls[0].arguments == {"tz": "UTC"}
+
+    def test_plain_text_no_calls(self):
+        calls, rest = detect_and_parse_tool_calls("The answer is 42.")
+        assert calls == [] and rest == "The answer is 42."
+
+    def test_openai_wire_format(self):
+        call = ToolCall(name="f", arguments={"x": 1})
+        wire = call.to_openai()
+        assert wire["type"] == "function"
+        assert json.loads(wire["function"]["arguments"]) == {"x": 1}
+        assert wire["id"].startswith("call-")
+
+    def test_string_arguments_parsed(self):
+        calls, _ = detect_and_parse_tool_calls(
+            '{"name": "f", "arguments": "{\\"x\\": 2}"}'
+        )
+        assert calls[0].arguments == {"x": 2}

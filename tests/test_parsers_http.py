@@ -1,0 +1,91 @@
+"""Parsers wired into the HTTP frontend: reasoning_content extraction (unary
++ streaming deltas) and tool_calls in chat completions, driven by a scripted
+pipeline engine emitting known text (ref: jail.rs stream rewriting)."""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.llm import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.common import FinishReason, PostprocessedOutput
+
+
+class ScriptedPipeline:
+    """Emits a fixed sequence of text deltas as a served pipeline would."""
+
+    def __init__(self, deltas):
+        self.deltas = deltas
+
+    async def generate(self, request, context):
+        yield {"annotation": "_prompt_tokens", "value": 3}
+        for i, text in enumerate(self.deltas):
+            last = i == len(self.deltas) - 1
+            yield PostprocessedOutput(
+                text=text,
+                token_ids=[i],
+                cumulative_tokens=i + 1,
+                finish_reason=FinishReason.EOS if last else None,
+            )
+
+
+async def start(deltas):
+    manager = ModelManager()
+    card = ModelDeploymentCard(name="scripted", context_length=512)
+    manager.register("scripted", ScriptedPipeline(deltas), card)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    return service, port
+
+
+async def test_unary_reasoning_and_tool_calls():
+    service, port = await start(
+        ["<think>check the weather API</think>",
+         '<tool_call>{"name": "get_weather", "arguments": {"city": "Paris"}}</tool_call>']
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "weather?"}],
+                    "tools": [{"type": "function", "function": {"name": "get_weather"}}],
+                },
+            )
+            body = await r.json()
+        msg = body["choices"][0]["message"]
+        assert msg["reasoning_content"] == "check the weather API"
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+        assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {"city": "Paris"}
+        assert body["choices"][0]["finish_reason"] == "tool_calls"
+    finally:
+        await service.stop(grace_period=1)
+
+
+async def test_streaming_reasoning_deltas():
+    service, port = await start(
+        ["<th", "ink>deep ", "thought</think>", "the answer ", "is 4"]
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "hm"}],
+                    "stream": True,
+                },
+            )
+            reasoning, content = "", ""
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    delta = json.loads(line[6:])["choices"][0]["delta"]
+                    reasoning += delta.get("reasoning_content", "")
+                    content += delta.get("content", "")
+        assert reasoning == "deep thought"
+        assert content == "the answer is 4"
+    finally:
+        await service.stop(grace_period=1)

@@ -1,0 +1,153 @@
+"""Planner suite: predictors, interpolators, sizing math, virtual connector,
+profiler sweep against the mock engine (ref: tests/planner/ + planner unit
+tests in components/src/dynamo/planner/tests)."""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engines.mock.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.planner import (
+    ConstantPredictor,
+    DecodeInterpolator,
+    KalmanPredictor,
+    MetricsSnapshot,
+    MovingAveragePredictor,
+    Planner,
+    PlannerConfig,
+    PrefillInterpolator,
+    VirtualConnector,
+    make_predictor,
+)
+from dynamo_tpu.profiler import profile_engine
+from dynamo_tpu.runtime.discovery import MemoryDiscovery
+
+
+class TestPredictors:
+    def test_constant(self):
+        p = ConstantPredictor()
+        for v in (1.0, 5.0, 3.0):
+            p.add_data_point(v)
+        assert p.predict_next() == 3.0
+
+    def test_moving_average_tracks_trend(self):
+        p = MovingAveragePredictor(alpha=0.6, beta=0.3)
+        for v in range(10):  # steadily rising load
+            p.add_data_point(float(v))
+        pred = p.predict_next()
+        assert pred > 7.0  # extrapolates the trend, not just the mean
+
+    def test_kalman_smooths_noise(self):
+        rng = np.random.default_rng(0)
+        p = KalmanPredictor(process_var=0.01, obs_var=4.0)
+        for _ in range(100):
+            p.add_data_point(10.0 + rng.normal(0, 1.0))
+        assert abs(p.predict_next() - 10.0) < 1.5
+
+    def test_factory_rejects_unknown(self):
+        with pytest.raises(ValueError):
+            make_predictor("prophet-deluxe")
+
+
+class TestInterpolators:
+    def test_prefill_interp(self):
+        interp = PrefillInterpolator(
+            isl=[128, 512, 1024],
+            ttft_s=[0.1, 0.4, 0.9],
+            tokens_per_s=[1280, 1280, 1137],
+        )
+        assert 0.1 < interp.interpolate_ttft(256) < 0.4
+        assert interp.interpolate_ttft(2048) == 0.9  # clamped at the edge
+
+    def test_decode_interp_sla_crossing(self):
+        interp = DecodeInterpolator(
+            concurrency=[1, 4, 8, 16],
+            itl_s=[0.005, 0.010, 0.020, 0.045],
+            tokens_per_s=[200, 400, 400, 355],
+        )
+        c = interp.max_concurrency_for_itl(0.020)
+        assert math.isclose(c, 8.0)
+        c = interp.max_concurrency_for_itl(0.0325)
+        assert 8 < c < 16
+        assert interp.max_concurrency_for_itl(0.001) == 1.0
+        assert interp.max_concurrency_for_itl(1.0) == 16.0
+
+
+def make_planner(connector, metrics, **cfg_over):
+    cfg_kwargs = dict(
+        adjustment_interval_s=0.05,
+        itl_target_s=0.02,
+        ttft_target_s=0.5,
+        max_replicas=16,
+        total_chip_budget=32,
+    )
+    cfg_kwargs.update(cfg_over)
+    cfg = PlannerConfig(**cfg_kwargs)
+    prefill = PrefillInterpolator(
+        isl=[128, 512, 1024], ttft_s=[0.1, 0.4, 0.9], tokens_per_s=[1280, 1280, 1137]
+    )
+    decode = DecodeInterpolator(
+        concurrency=[1, 4, 8, 16],
+        itl_s=[0.005, 0.010, 0.020, 0.045],
+        tokens_per_s=[200, 400, 400, 355],
+    )
+    return Planner(cfg, prefill, decode, connector, metrics)
+
+
+async def test_planner_scales_with_load():
+    disco = MemoryDiscovery()
+    connector = VirtualConnector(disco, "ns")
+    load = {"rate": 1.0}
+
+    async def metrics():
+        return MetricsSnapshot(request_rate=load["rate"], mean_isl=512, mean_osl=64)
+
+    planner = make_planner(connector, metrics)
+    for _ in range(3):
+        plan_low = await planner.step()
+    assert plan_low is not None
+    load["rate"] = 50.0
+    for _ in range(10):
+        plan_high = await planner.step()
+    assert plan_high.decode > plan_low.decode  # more load → more decode workers
+    assert plan_high.prefill >= plan_low.prefill
+    # connector published the desired counts to the discovery plane
+    desired = await connector.read_desired()
+    assert desired["decode"] == plan_high.decode
+
+
+async def test_planner_respects_chip_budget():
+    disco = MemoryDiscovery()
+    connector = VirtualConnector(disco, "ns")
+
+    async def metrics():
+        return MetricsSnapshot(request_rate=500.0, mean_isl=1024, mean_osl=256)
+
+    planner = make_planner(connector, metrics, total_chip_budget=6)
+    for _ in range(5):
+        plan = await planner.step()
+    assert plan.prefill + plan.decode <= 6
+
+
+async def test_profiler_sweep_mock_engine():
+    engine = MockEngine(
+        MockEngineArgs(
+            block_size=8, num_kv_blocks=256,
+            prefill_base_s=0.005, prefill_per_token_s=0.002, decode_itl_s=0.005,
+        )
+    )
+    try:
+        profile = await profile_engine(
+            engine, isl_values=(16, 96), concurrency_values=(1, 4), osl=8
+        )
+        assert len(profile["prefill"]) == 2
+        # longer prompts take longer to prefill
+        assert profile["prefill"][1]["ttft_s"] > profile["prefill"][0]["ttft_s"]
+        assert all(p["tokens_per_s"] > 0 for p in profile["decode"])
+        # interpolators accept the profiler's output format directly
+        PrefillInterpolator.from_points(profile["prefill"])
+        DecodeInterpolator.from_points(profile["decode"])
+    finally:
+        await engine.stop()
