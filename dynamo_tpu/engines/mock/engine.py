@@ -21,6 +21,7 @@ Semantics:
 from __future__ import annotations
 
 import asyncio
+import collections
 import hashlib
 import logging
 import time
@@ -86,7 +87,9 @@ class MockEngine:
         self.kv = KvManager(
             self.args.num_kv_blocks, self.args.block_size, on_event=on_kv_event
         )
-        self._waiting: "asyncio.Queue[_Sequence]" = asyncio.Queue()
+        # Deque, not asyncio.Queue: preempted sequences go back to the FRONT
+        # without the queue-swap race the round-1 version had.
+        self._waiting: "collections.deque[_Sequence]" = collections.deque()
         self._running: List[_Sequence] = []
         self._loop_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
@@ -135,7 +138,7 @@ class MockEngine:
                 "little",
             ),
         )
-        await self._waiting.put(seq)
+        self._waiting.append(seq)
         self._wake.set()
         while True:
             out = await seq.queue.get()
@@ -151,11 +154,7 @@ class MockEngine:
         return seconds / max(self.args.speedup_ratio, 1e-9)
 
     def _requeue(self, seq: _Sequence) -> None:
-        requeue: "asyncio.Queue[_Sequence]" = asyncio.Queue()
-        requeue.put_nowait(seq)
-        while not self._waiting.empty():
-            requeue.put_nowait(self._waiting.get_nowait())
-        self._waiting = requeue
+        self._waiting.appendleft(seq)
 
     async def _scheduler_loop(self) -> None:
         while not self._stopped.is_set():
@@ -172,8 +171,8 @@ class MockEngine:
         for seq in self._running:
             seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
         self._running.clear()
-        while not self._waiting.empty():
-            seq = self._waiting.get_nowait()
+        while self._waiting:
+            seq = self._waiting.popleft()
             seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
 
     async def _scheduler_tick(self) -> None:
@@ -182,8 +181,8 @@ class MockEngine:
         # Admit waiting sequences (continuous batching admission). The
         # watermark keeps headroom for decode growth; it is waived when the
         # engine is idle so an admissible request is never deadlocked.
-        while len(self._running) < args.max_num_seqs and not self._waiting.empty():
-            seq = self._waiting.get_nowait()
+        while len(self._running) < args.max_num_seqs and self._waiting:
+            seq = self._waiting.popleft()
             if seq.context.stopped:
                 seq.queue.put_nowait(BackendOutput(finish_reason=FinishReason.CANCELLED))
                 continue

@@ -36,7 +36,7 @@ async def serve_mocker(args) -> None:
             runtime.event_plane, args.namespace, args.component, instance_id,
             lambda e=engine: {
                 "active_seqs": len(e._running),
-                "waiting": e._waiting.qsize(),
+                "waiting": len(e._waiting),
                 "free_blocks": e.kv.free_blocks,
                 "total_blocks": e.args.num_kv_blocks,
             },

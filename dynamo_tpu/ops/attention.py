@@ -16,13 +16,39 @@ Two implementations:
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger(__name__)
+
 NEG_INF = -1e30
+
+_kernel_fn = None
+_kernel_load_failed = False
+
+
+def _load_kernel():
+    """Resolve the pallas kernel once; on any failure fall back to the XLA
+    path with a loud warning instead of letting the engine crash-loop
+    (round-1 failure mode: ModuleNotFoundError retried forever)."""
+    global _kernel_fn, _kernel_load_failed
+    if _kernel_fn is not None or _kernel_load_failed:
+        return _kernel_fn
+    try:
+        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+        _kernel_fn = paged_attention_kernel
+    except Exception:
+        _kernel_load_failed = True
+        logger.exception(
+            "pallas paged-attention kernel unavailable; falling back to the "
+            "XLA gather path (expect much lower decode throughput)"
+        )
+    return _kernel_fn
 
 
 def paged_attention(
@@ -43,11 +69,12 @@ def paged_attention(
     position t to t <= start_pos + c for query offset c.
     """
     if use_kernel:
-        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_kernel
-
-        return paged_attention_kernel(
-            q, k_cache, v_cache, block_tables, start_pos, chunk_lens, sm_scale=sm_scale
-        )
+        kernel = _load_kernel()
+        if kernel is not None:
+            return kernel(
+                q, k_cache, v_cache, block_tables, start_pos, chunk_lens,
+                sm_scale=sm_scale,
+            )
     return _paged_attention_xla(
         q, k_cache, v_cache, block_tables, start_pos, chunk_lens, sm_scale=sm_scale
     )

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the hot serving ops.
+
+The TPU-native replacement for the CUDA kernels the reference ships in its
+engines (and its one in-tree kernel, lib/llm/src/kernels/block_copy.cu):
+
+  - paged_attention:  flash-style attention over the paged KV pool, pages
+    streamed HBM->VMEM by the pallas pipeline via scalar-prefetched block
+    tables (no dense gather materialized in HBM, unlike the XLA oracle path).
+  - block_copy:       batched gather/scatter of KV blocks between the pool
+    and staging buffers (disagg export/import, tier offload).
+"""
+
+from dynamo_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+__all__ = ["paged_attention_kernel"]

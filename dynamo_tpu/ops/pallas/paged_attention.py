@@ -1,0 +1,198 @@
+"""Pallas TPU paged-attention kernel.
+
+The performance-critical op of the native engine: attention of a C-token
+query chunk against a paged KV cache, serving decode (C=1), chunked prefill,
+and full prefill uniformly (same contract as ops/attention.py's XLA oracle).
+
+Reference parity: plays the role of the paged-attention CUDA kernels inside
+the reference's engines (vLLM/TRT-LLM) that Dynamo orchestrates around; the
+reference's own in-tree kernel is lib/llm/src/kernels/block_copy.cu (block
+movement), covered here by ops/pallas/block_copy.py.
+
+TPU-first design (not a CUDA translation):
+  - The grid is (batch, page). The per-sequence block table is a
+    scalar-prefetch operand; the K/V page for each grid step is selected by
+    the BlockSpec index_map reading the table, so the pallas pipeline
+    double-buffers the scattered HBM->VMEM page streams automatically --
+    pages never materialize as a dense [B, T, KH, D] gather in HBM (the XLA
+    oracle's O(padded-context) HBM-traffic problem).
+  - Each page DMA carries ALL kv heads (one [bs, KH, D] transfer, not KH
+    small ones -- Mosaic wants the last two block dims full anyway); the
+    small static KH loop is unrolled in the kernel body.
+  - Flash-style online softmax: running max / normalizer / weighted
+    accumulator live in VMEM scratch across the page axis (the innermost,
+    sequentially-iterated grid dimension); the output block is written once
+    on the last page.
+  - Pages past a sequence's valid length skip all compute via pl.when (their
+    DMA is pipelined and their masked contributions would be zero anyway).
+  - All dots run on the MXU in float32 via preferred_element_type; the cache
+    stays bfloat16 in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, P] int32 (SMEM)
+    start_pos_ref,  # [B] int32
+    chunk_lens_ref,  # [B] int32
+    # VMEM blocks
+    q_ref,  # [1, KH, C*G, D] (host pre-transposed: rows are (c, g), c-major)
+    k_ref,  # [1, bs, KH, D]
+    v_ref,  # [1, bs, KH, D]
+    o_ref,  # [1, KH, C*G, D]
+    # scratch
+    m_ref,  # [KH, C*G, 1] f32
+    l_ref,  # [KH, C*G, 1] f32
+    acc_ref,  # [KH, C*G, D] f32
+    *,
+    sm_scale: float,
+    block_size: int,
+    n_groups: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+
+    KH = q_ref.shape[1]
+    CG = q_ref.shape[2]
+    D = q_ref.shape[3]
+    G = n_groups
+
+    start = start_pos_ref[b]
+    clen = chunk_lens_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Highest key position any valid query in this sequence can see is
+    # start + clen - 1 (the chunk's own K/V are already in the cache).
+    last_needed_page = jnp.maximum(start + clen - 1, 0) // block_size
+
+    @pl.when(p <= last_needed_page)
+    def _compute():
+        # Causal mask, shared by every head: key position t visible to query
+        # offset c iff t <= start + c. Rows are (c, g) pairs, c-major.
+        c_idx = jax.lax.broadcasted_iota(jnp.int32, (CG, block_size), 0) // G
+        t_idx = p * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (CG, block_size), 1
+        )
+        visible = t_idx <= start + c_idx
+
+        for h in range(KH):  # static unroll; KH is small (2-8)
+            q = q_ref[0, h].astype(jnp.float32)  # [CG, D]
+            k = k_ref[0, :, h, :].astype(jnp.float32)  # [bs, D]
+            v = v_ref[0, :, h, :].astype(jnp.float32)  # [bs, D]
+
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * sm_scale
+            )  # [CG, bs]
+            s = jnp.where(visible, s, NEG_INF)
+
+            m_prev = m_ref[h]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(s - m_new)
+            l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                probs, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[h] = m_new
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        # Every query row sees at least key t=0 (0 <= start + c always), so
+        # l is strictly positive for rows that matter.
+        for h in range(KH):
+            out = acc_ref[h] / jnp.maximum(l_ref[h], 1e-30)
+            o_ref[0, h] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def paged_attention_kernel(
+    q: jnp.ndarray,  # [B, C, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    start_pos: jnp.ndarray,  # [B] int32
+    chunk_lens: jnp.ndarray,  # [B] int32
+    *,
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Returns [B, C, n_heads, head_dim]; same contract as the XLA oracle
+    (ops/attention.py::_paged_attention_xla)."""
+    B, C, n_heads, head_dim = q.shape
+    num_blocks, block_size, n_kv_heads, _ = k_cache.shape
+    P = block_tables.shape[1]
+    G = n_heads // n_kv_heads
+    scale = sm_scale if sm_scale is not None else head_dim**-0.5
+
+    # [B, C, H, D] -> [B, KH, C*G, D]: per-head row blocks, (c, g) c-major.
+    # The transpose runs in XLA outside the kernel (fused, cheap) and lets
+    # the kernel body index one head with zero in-kernel shape casts (Mosaic
+    # rejects (C, G, D) -> (C*G, D) vector reshapes for C > 1).
+    q5 = q.reshape(B, C, n_kv_heads, G, head_dim).transpose(0, 2, 1, 3, 4)
+    q5 = q5.reshape(B, n_kv_heads, C * G, head_dim)
+
+    def q_map(b, p, bt, sp, cl):
+        return (b, 0, 0, 0)
+
+    def kv_map(b, p, bt, sp, cl):
+        return (bt[b, p], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map),
+            pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map),
+            pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv_heads, C * G, 1), jnp.float32),
+            pltpu.VMEM((n_kv_heads, C * G, 1), jnp.float32),
+            pltpu.VMEM((n_kv_heads, C * G, head_dim), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(
+        _kernel, sm_scale=scale, block_size=block_size, n_groups=G
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (B, n_kv_heads, C * G, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        start_pos.astype(jnp.int32),
+        chunk_lens.astype(jnp.int32),
+        q5,
+        k_cache,
+        v_cache,
+    )
+    out = out.reshape(B, n_kv_heads, C, G, head_dim).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, C, n_heads, head_dim)

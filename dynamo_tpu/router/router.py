@@ -53,6 +53,16 @@ class KvRouter:
         self.scheduler = KvScheduler(config)
         self._tasks: list = []
         self._subs: list = []
+        # request identity -> stack of (worker, charged blocks, report gen);
+        # one entry popped per stream end. A stack (not a single slot) keeps
+        # the accounting balanced when a caller passes the SAME request
+        # object to concurrent generate() calls (hedging/retries): pairing
+        # may momentarily cross over, but every charge is released exactly
+        # once.
+        self._inflight: Dict[int, list] = {}
+        # Notified after each applied KV event so tests (and operators) can
+        # await "indexer has seen N events" instead of sleeping.
+        self._events_cond: Optional[asyncio.Condition] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -86,6 +96,22 @@ class KvRouter:
                 self.indexer.apply(RouterEvent.from_dict(payload))
             except Exception:
                 logger.exception("bad KV event payload")
+            if self._events_cond is not None:
+                async with self._events_cond:
+                    self._events_cond.notify_all()
+
+    async def wait_for_events(self, count: int, timeout: float = 5.0) -> None:
+        """Block until at least ``count`` KV events have been applied to the
+        indexer (deterministic alternative to sleeping in tests)."""
+        if self._events_cond is None:
+            self._events_cond = asyncio.Condition()
+        async with self._events_cond:
+            await asyncio.wait_for(
+                self._events_cond.wait_for(
+                    lambda: self.indexer.events_applied >= count
+                ),
+                timeout,
+            )
 
     async def _pump_load(self, sub) -> None:
         async for _topic, payload in sub:
@@ -113,6 +139,12 @@ class KvRouter:
         overlap = overlaps.scores.get(worker, 0) if worker is not None else 0
         return worker, overlap
 
+    def release(
+        self, worker: WorkerKey, charged_blocks: int, report_gen: Optional[int] = None
+    ) -> None:
+        """Release the in-flight prediction for a finished stream."""
+        self.scheduler.complete_request(worker, charged_blocks, report_gen)
+
     def attach(self, client: Any) -> None:
         """Install this router as the Client's KV-mode instance picker."""
 
@@ -124,6 +156,14 @@ class KvRouter:
             worker, overlap = self.find_best_match(token_ids, candidates)
             if worker is None:
                 return None
+            n_blocks = max(len(token_ids) // self.block_size, 1)
+            self._inflight.setdefault(id(request), []).append(
+                (
+                    worker,
+                    max(n_blocks - overlap, 0),
+                    self.scheduler.report_generation(worker),
+                )
+            )
             if isinstance(request, dict):
                 request["estimated_prefix_hit_blocks"] = overlap
             else:
@@ -133,7 +173,15 @@ class KvRouter:
                     pass
             return worker[0]
 
+        def on_done(instance_id: Optional[int], request: Any) -> None:
+            entries = self._inflight.get(id(request))
+            if entries:
+                self.release(*entries.pop())
+                if not entries:
+                    del self._inflight[id(request)]
+
         client.set_kv_picker(picker)
+        client.set_stream_done_callback(on_done)
 
 
 def _token_ids_of(request: Any) -> Optional[Sequence[int]]:

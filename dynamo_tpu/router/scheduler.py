@@ -46,6 +46,9 @@ class WorkerState:
     # Blocks routed here since the last snapshot (prediction, decays).
     inflight_blocks: int = 0
     inflight_at: float = 0.0
+    # Bumped on every load report; stale in-flight releases (charged before
+    # the report that already absorbed them) are dropped by comparing this.
+    report_gen: int = 0
 
     def decode_blocks(self, ttl: float) -> int:
         base = self.snapshot.active_blocks if self.snapshot else 0
@@ -69,6 +72,11 @@ class KvScheduler:
         state = self._workers.setdefault(snapshot.worker, WorkerState())
         state.snapshot = snapshot
         state.inflight_blocks = 0  # report supersedes the prediction
+        state.report_gen += 1
+
+    def report_generation(self, worker: WorkerKey) -> int:
+        state = self._workers.get(worker)
+        return state.report_gen if state is not None else 0
 
     def add_worker(self, worker: WorkerKey) -> None:
         self._workers.setdefault(worker, WorkerState())
@@ -102,13 +110,13 @@ class KvScheduler:
         if not_busy:
             pool = not_busy
 
-        logits: List[Tuple[WorkerKey, float]] = []
+        logits: List[Tuple[WorkerKey, float, int]] = []
         for w in pool:
             overlap = overlaps.scores.get(w, 0)
             prefill = max(request_blocks - overlap, 0)
             decode = self._workers[w].decode_blocks(cfg.inflight_ttl_s)
             logit = cfg.overlap_score_weight * prefill + decode
-            logits.append((w, logit))
+            logits.append((w, logit, overlap))
 
         chosen = self._sample(logits, cfg.router_temperature)
         # Predict the routed request's load until the next report lands.
@@ -119,21 +127,44 @@ class KvScheduler:
         state.inflight_at = time.monotonic()
         return chosen
 
+    def complete_request(
+        self,
+        worker: WorkerKey,
+        charged_blocks: int,
+        report_gen: Optional[int] = None,
+    ) -> None:
+        """Release the in-flight prediction when a routed stream finishes
+        (ref: sequence.rs active-sequence removal on completion). Without
+        this, a fully-cached worker keeps looking as loaded as a cold one
+        until the next load report, mis-routing cache hits.
+
+        ``report_gen`` (from report_generation() at routing time) guards
+        against double-release: if a load report landed after the charge, the
+        report already absorbed it, and releasing again would debit charges
+        belonging to later requests."""
+        state = self._workers.get(worker)
+        if state is None:
+            return
+        if report_gen is not None and report_gen != state.report_gen:
+            return
+        state.inflight_blocks = max(state.inflight_blocks - charged_blocks, 0)
+
     def _sample(
-        self, logits: List[Tuple[WorkerKey, float]], temperature: float
+        self, logits: List[Tuple[WorkerKey, float, int]], temperature: float
     ) -> WorkerKey:
         if temperature <= 0.0 or len(logits) == 1:
-            best = min(l for _, l in logits)
-            ties = [w for w, l in logits if l == best]
-            return self._rand.choice(ties)
+            # Deterministic at temperature 0: break cost ties by preferring
+            # the higher prefix overlap (routes to the warm cache), then by
+            # worker key for stability across runs.
+            return min(logits, key=lambda e: (e[1], -e[2], e[0]))[0]
         # softmax over −logit/T (lower cost → higher probability)
-        scaled = [-l / temperature for _, l in logits]
+        scaled = [-l / temperature for _, l, _ in logits]
         m = max(scaled)
         exps = [math.exp(s - m) for s in scaled]
         total = sum(exps)
         r = self._rand.random() * total
         acc = 0.0
-        for (w, _), e in zip(logits, exps):
+        for (w, _, _), e in zip(logits, exps):
             acc += e
             if r <= acc:
                 return w

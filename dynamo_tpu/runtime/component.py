@@ -172,6 +172,7 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._instances_nonempty = asyncio.Event()
         self._kv_picker = None  # async (request, instances) -> instance_id
+        self._on_stream_done = None  # (instance_id, request) -> None
 
     @property
     def endpoint_path(self) -> str:
@@ -183,6 +184,12 @@ class Client:
 
     def set_kv_picker(self, picker) -> None:
         self._kv_picker = picker
+
+    def set_stream_done_callback(self, callback) -> None:
+        """``callback(instance_id, request)`` fires when a routed stream ends
+        (normally or not) — lets a KV router release its in-flight load
+        prediction (ref: kv_router sequence.rs free on completion)."""
+        self._on_stream_done = callback
 
     async def start(self) -> None:
         prefix = instance_prefix(
@@ -267,10 +274,24 @@ class Client:
     async def _generate(
         self, request: Any, context: Context, instance_id: Optional[int]
     ) -> AsyncIterator[Any]:
-        instance = await self._pick(request, instance_id)
-        remote = self._runtime.request_plane_client(instance)
-        async for item in remote.generate(request, context):
-            yield item
+        instance = None
+        try:
+            instance = await self._pick(request, instance_id)
+            remote = self._runtime.request_plane_client(instance)
+            async for item in remote.generate(request, context):
+                yield item
+        finally:
+            # Fires even when _pick itself fails after the KV picker charged
+            # the scheduler (the instance may have raced away) — otherwise
+            # the router's in-flight accounting leaks.
+            if self._on_stream_done is not None:
+                try:
+                    self._on_stream_done(
+                        instance.instance_id if instance is not None else None,
+                        request,
+                    )
+                except Exception:
+                    logger.exception("stream-done callback failed")
 
     def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):
         """Route to a specific instance (RouterMode::Direct)."""

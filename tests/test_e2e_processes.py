@@ -75,10 +75,11 @@ def cluster_env():
             "DYN_TPU_EVENT_PLANE": "zmq",
             "DYN_TPU_EVENT_PLANE_ADDR": f"127.0.0.1:{xsub}:{xpub}",
             "DYN_TPU_REQUEST_PLANE": "tcp",
-            # Generous: the 1-core CI box can starve keep-alive loops; a
-            # mid-request lease expiry makes the worker vanish and the
-            # stream die (that's a separate, fault-tolerance test's job).
-            "DYN_TPU_LEASE_TTL": "30",
+            # Generous: the 1-core CI box can starve keep-alive loops for
+            # tens of seconds in full-suite runs; a mid-request lease expiry
+            # makes the worker vanish and the stream die (that's a separate,
+            # fault-tolerance test's job).
+            "DYN_TPU_LEASE_TTL": "120",
             "PYTHONUNBUFFERED": "1",
         }
     )
@@ -128,21 +129,31 @@ def test_cluster_serves_openai_http(cluster_env):
                     assert time.time() < deadline, f"model never appeared: {models}"
                     await asyncio.sleep(0.25)
 
-                r = await s.post(
-                    f"http://127.0.0.1:{http_port}/v1/chat/completions",
-                    json={
-                        "model": "mock-1",
-                        "messages": [{"role": "user", "content": "hello across processes"}],
-                        "max_tokens": 8,
-                        "stream": True,
-                    },
-                )
-                assert r.status == 200, await r.text()
-                chunks = []
-                async for line in r.content:
-                    line = line.decode().strip()
-                    if line.startswith("data: ") and line != "data: [DONE]":
-                        chunks.append(json.loads(line[6:]))
+                async def stream_once():
+                    r = await s.post(
+                        f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                        json={
+                            "model": "mock-1",
+                            "messages": [{"role": "user", "content": "hello across processes"}],
+                            "max_tokens": 8,
+                            "stream": True,
+                        },
+                    )
+                    assert r.status == 200, await r.text()
+                    chunks = []
+                    async for line in r.content:
+                        line = line.decode().strip()
+                        if line.startswith("data: ") and line != "data: [DONE]":
+                            chunks.append(json.loads(line[6:]))
+                    return chunks
+
+                chunks = await stream_once()
+                if any("error" in c for c in chunks):
+                    # One retry: under full-suite CPU starvation the worker's
+                    # lease can expire mid-stream and migration exhaust; a
+                    # fresh request after re-registration must succeed.
+                    await asyncio.sleep(2.0)
+                    chunks = await stream_once()
                 finishes = [
                     c["choices"][0].get("finish_reason")
                     for c in chunks if c.get("choices")

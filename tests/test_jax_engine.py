@@ -185,3 +185,47 @@ def test_block_pool_reuse_and_eviction():
     assert pool.alloc() is None
     removed = [e for e in events if e.kind == "removed"]
     assert removed and removed[0].block_hashes == [111]
+
+
+async def test_poisoned_request_contained_engine_survives():
+    """A request that deterministically fails admission gets an error stream;
+    the engine keeps serving other requests (round-2 breaker semantics)."""
+    engine, _ = make_engine()
+    try:
+        real = engine._run_step
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic admission failure")
+
+        engine._run_step = boom
+        out = await run_one(engine, req(range(10, 20), max_tokens=4))
+        assert out[-1].finish_reason == FinishReason.ERROR
+        assert "admission failed" in (out[-1].error or "")
+        assert engine._failure is None  # engine not bricked
+
+        engine._run_step = real
+        engine._admission_failure_streak = 0
+        out2 = await run_one(engine, req(range(10, 20), max_tokens=4))
+        assert out2[-1].finish_reason == FinishReason.LENGTH
+    finally:
+        await engine.stop()
+
+
+async def test_systemic_admission_failure_goes_terminal():
+    """Every admission failing (broken program) must fail the engine fast —
+    not retry forever (round-1 bench hang regression)."""
+    engine, _ = make_engine()
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("systemic failure")
+
+        engine._run_step = boom
+        for _ in range(3):
+            out = await run_one(engine, req(range(10, 20), max_tokens=4))
+            assert out[-1].finish_reason == FinishReason.ERROR
+        assert engine._failure is not None
+        # new requests refused immediately
+        out = await run_one(engine, req(range(10, 20), max_tokens=4))
+        assert "engine failed" in (out[-1].error or "")
+    finally:
+        await engine.stop()

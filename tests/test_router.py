@@ -171,8 +171,7 @@ async def test_kv_router_e2e_with_mock_workers():
         prefix = list(range(100, 116))  # 4 full blocks
         out1 = await collect(client.generate(_req(prefix + [1, 2, 3])))
         assert any(getattr(o, "token_ids", None) for o in out1)
-        await asyncio.sleep(0.05)  # let KV events propagate
-        assert router.indexer.events_applied > 0
+        await router.wait_for_events(1)  # deterministic: no sleep races
 
         # A second request with the same prefix must go to the same worker.
         hashes = compute_block_hashes(prefix, block)
