@@ -19,7 +19,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import collect
 
 
-def make_engine(**over):
+def make_engine(mesh=None, rules=None, **over):
     defaults = dict(
         config=tiny_config(),
         block_size=4,
@@ -30,7 +30,10 @@ def make_engine(**over):
     )
     defaults.update(over)
     events = []
-    engine = JaxEngine(JaxEngineArgs(**defaults), on_kv_event=events.append)
+    engine = JaxEngine(
+        JaxEngineArgs(**defaults), mesh=mesh, rules=rules,
+        on_kv_event=events.append,
+    )
     return engine, events
 
 
@@ -233,20 +236,7 @@ async def test_engine_under_dp_tp_mesh_matches_unsharded():
         await engine.stop()
 
     mesh = make_mesh(MeshConfig(dp=2, tp=2))
-    events = []
-    sharded = JaxEngine(
-        JaxEngineArgs(
-            config=tiny_config(),
-            block_size=4,
-            num_kv_blocks=64,
-            max_num_seqs=4,
-            max_model_len=128,
-            prefill_chunk=32,
-        ),
-        mesh=mesh,
-        rules=ShardingRules(),
-        on_kv_event=events.append,
-    )
+    sharded, events = make_engine(mesh=mesh, rules=ShardingRules())
     try:
         outs = await asyncio.gather(
             *(run_one(sharded, req(p, max_tokens=5)) for p in prompts)
