@@ -26,8 +26,13 @@ from dynamo_tpu.planner.planner_core import (
     ReplicaPlan,
 )
 from dynamo_tpu.planner.connectors import VirtualConnector
+from dynamo_tpu.planner.metrics_source import FrontendScrapeSource
+from dynamo_tpu.planner.process_connector import ProcessConnector, RoleSpec
 
 __all__ = [
+    "FrontendScrapeSource",
+    "ProcessConnector",
+    "RoleSpec",
     "ConstantPredictor",
     "KalmanPredictor",
     "MovingAveragePredictor",

@@ -1,0 +1,119 @@
+"""Planner service entrypoint.
+
+Reference parity: components/src/dynamo/planner/planner_sla.py (the SLA
+planner component: scrape metrics → predict → size pools → apply via a
+connector). Modes:
+
+  --connector virtual   write desired counts to the discovery plane
+                        (tests / operator equivalent picks them up)
+  --connector process   spawn/retire worker subprocesses directly
+                        (single-host deployments; see process_connector.py)
+
+Usage:
+  python -m dynamo_tpu.planner --metrics-url http://127.0.0.1:8080/metrics \
+      --profile profile.json --connector process \
+      --decode-cmd "python -m dynamo_tpu.worker --model tiny"
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+import sys
+
+from dynamo_tpu import config
+from dynamo_tpu.planner.connectors import VirtualConnector
+from dynamo_tpu.planner.metrics_source import FrontendScrapeSource
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    load_profile,
+)
+from dynamo_tpu.planner.planner_core import Planner, PlannerConfig
+from dynamo_tpu.planner.process_connector import ProcessConnector, RoleSpec
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_interpolators():
+    """Conservative single-point fallbacks when no profile is given."""
+    prefill = PrefillInterpolator([512.0], [0.2], [4000.0])
+    decode = DecodeInterpolator([1.0, 8.0], [0.01, 0.03], [100.0, 500.0])
+    return prefill, decode
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu planner")
+    parser.add_argument("--metrics-url", action="append", required=True,
+                        help="frontend /metrics URL (repeatable)")
+    parser.add_argument("--model", default=None, help="restrict to one model")
+    parser.add_argument("--profile", default=None,
+                        help="profiler sweep JSON (see dynamo_tpu.profiler)")
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--adjustment-interval", type=float, default=30.0)
+    parser.add_argument("--ttft-target", type=float, default=0.5)
+    parser.add_argument("--itl-target", type=float, default=0.02)
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=8)
+    parser.add_argument("--total-chip-budget", type=int, default=8)
+    parser.add_argument("--predictor", default="moving-average")
+    parser.add_argument("--no-disagg", action="store_true",
+                        help="aggregated deployment: size only the decode pool")
+    parser.add_argument("--connector", choices=("virtual", "process"),
+                        default="virtual")
+    parser.add_argument("--decode-cmd", default=None,
+                        help="worker launch command (process connector)")
+    parser.add_argument("--prefill-cmd", default=None)
+    args = parser.parse_args()
+
+    configure_logging()
+    if args.profile:
+        prefill_interp, decode_interp = load_profile(args.profile)
+    else:
+        logger.warning("no --profile given; using conservative defaults")
+        prefill_interp, decode_interp = _default_interpolators()
+
+    connector: object
+    if args.connector == "process":
+        if not args.decode_cmd:
+            parser.error("--connector process requires --decode-cmd")
+        roles = {"decode": RoleSpec(command=shlex.split(args.decode_cmd))}
+        if args.prefill_cmd:
+            roles["prefill"] = RoleSpec(command=shlex.split(args.prefill_cmd))
+        connector = ProcessConnector(roles, stdout=sys.stderr)
+    else:
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        runtime = DistributedRuntime.from_settings()
+        connector = VirtualConnector(runtime.discovery, args.namespace)
+
+    planner = Planner(
+        PlannerConfig(
+            adjustment_interval_s=args.adjustment_interval,
+            ttft_target_s=args.ttft_target,
+            itl_target_s=args.itl_target,
+            predictor=args.predictor,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            total_chip_budget=args.total_chip_budget,
+        ),
+        prefill_interp,
+        decode_interp,
+        connector,
+        FrontendScrapeSource(args.metrics_url, model=args.model),
+        disagg=not args.no_disagg,
+    )
+    planner.start()
+    print("planner running", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await planner.stop()
+        if isinstance(connector, ProcessConnector):
+            await connector.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

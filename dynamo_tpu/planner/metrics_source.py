@@ -1,0 +1,194 @@
+"""Live metrics for the planner: scrape the frontend's /metrics endpoint.
+
+Reference parity: components/src/dynamo/planner/utils/prometheus.py
+(PrometheusAPIClient issuing `increase(..._sum[i])/increase(..._count[i])`
+PromQL against a Prometheus server). This environment runs no Prometheus
+server, so the TPU-native design scrapes the frontend's Prometheus text
+exposition directly and computes the interval deltas client-side — same
+inputs to the planner (request rate, mean ISL/OSL, TTFT/ITL) without the
+extra hop. Multiple frontends can be scraped; series are summed.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from dynamo_tpu.planner.planner_core import MetricsSnapshot
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PREFIX = "dynamo_tpu_frontend"
+
+# (series name, sorted label items) -> value
+Sample = Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]
+
+
+def parse_prometheus_text(text: str) -> Sample:
+    """Parse Prometheus text exposition into a flat sample dict.
+
+    Handles counters/gauges/histogram series with labels; ignores comments,
+    timestamps, and malformed lines (scrape robustness over strictness).
+    """
+    out: Sample = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labels_raw, tail = rest.rsplit("}", 1)
+                labels = []
+                for part in _split_labels(labels_raw):
+                    k, v = part.split("=", 1)
+                    labels.append((k, v.strip('"')))
+                value = float(tail.split()[0])
+                out[(name, tuple(sorted(labels)))] = value
+            else:
+                parts = line.split()
+                out[(parts[0], ())] = float(parts[1])
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def _split_labels(raw: str) -> List[str]:
+    """Split label pairs on commas outside quotes."""
+    parts: List[str] = []
+    buf = ""
+    in_q = False
+    for ch in raw:
+        if ch == '"':
+            in_q = not in_q
+        if ch == "," and not in_q:
+            if buf:
+                parts.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    if buf:
+        parts.append(buf)
+    return parts
+
+
+def _sum_series(sample: Sample, name: str, where: Mapping[str, str] = {}) -> float:
+    total = 0.0
+    for (n, labels), v in sample.items():
+        if n != name:
+            continue
+        d = dict(labels)
+        if all(d.get(k) == val for k, val in where.items()):
+            total += v
+    return total
+
+
+def _bucket_deltas(
+    prev: Sample, cur: Sample, name: str
+) -> List[Tuple[float, float]]:
+    """[(le, count_delta)] for a histogram, ascending by bound."""
+    acc: Dict[float, float] = {}
+    for (n, labels), v in cur.items():
+        if n != f"{name}_bucket":
+            continue
+        d = dict(labels)
+        le = float("inf") if d.get("le") == "+Inf" else float(d.get("le", "inf"))
+        acc[le] = acc.get(le, 0.0) + (v - prev.get((n, labels), 0.0))
+    return sorted(acc.items())
+
+
+def _histogram_quantile(deltas: List[Tuple[float, float]], q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile over cumulative bucket deltas."""
+    if not deltas:
+        return None
+    total = deltas[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    lo_bound, lo_count = 0.0, 0.0
+    for le, count in deltas:
+        if count >= target:
+            if le == float("inf"):
+                return lo_bound
+            span = count - lo_count
+            frac = (target - lo_count) / span if span > 0 else 1.0
+            return lo_bound + (le - lo_bound) * frac
+        lo_bound, lo_count = le, count
+    return lo_bound
+
+
+@dataclass
+class _Scrape:
+    ts: float
+    sample: Sample
+
+
+class FrontendScrapeSource:
+    """Async callable yielding a MetricsSnapshot per adjustment interval.
+
+    First call primes the baseline and reports zeros; subsequent calls report
+    deltas since the previous call (the reference's `increase(m[interval])`).
+    """
+
+    def __init__(
+        self, urls: Iterable[str], *, model: Optional[str] = None, timeout_s: float = 5.0
+    ) -> None:
+        self.urls = list(urls)
+        self.model = model
+        self.timeout_s = timeout_s
+        self._prev: Optional[_Scrape] = None
+
+    async def _fetch(self) -> Sample:
+        import aiohttp
+
+        merged: Sample = {}
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+        ) as session:
+            for url in self.urls:
+                try:
+                    async with session.get(url) as resp:
+                        text = await resp.text()
+                except Exception as exc:
+                    logger.warning("metrics scrape of %s failed: %s", url, exc)
+                    continue
+                for key, v in parse_prometheus_text(text).items():
+                    merged[key] = merged.get(key, 0.0) + v
+        return merged
+
+    def snapshot_from(self, prev: Sample, cur: Sample, dt: float) -> MetricsSnapshot:
+        where = {"model": self.model} if self.model else {}
+        name = f"{PREFIX}_requests_total"
+        # completed requests across endpoints/statuses
+        req_delta = _sum_series(cur, name, where) - _sum_series(prev, name, where)
+        in_delta = _sum_series(cur, f"{PREFIX}_input_tokens_total", where) - _sum_series(
+            prev, f"{PREFIX}_input_tokens_total", where
+        )
+        out_delta = _sum_series(cur, f"{PREFIX}_output_tokens_total", where) - _sum_series(
+            prev, f"{PREFIX}_output_tokens_total", where
+        )
+        ttft = _histogram_quantile(
+            _bucket_deltas(prev, cur, f"{PREFIX}_time_to_first_token_seconds"), 0.5
+        )
+        itl = _histogram_quantile(
+            _bucket_deltas(prev, cur, f"{PREFIX}_inter_token_latency_seconds"), 0.5
+        )
+        rate = req_delta / dt if dt > 0 else 0.0
+        return MetricsSnapshot(
+            request_rate=max(rate, 0.0),
+            mean_isl=in_delta / req_delta if req_delta > 0 else 0.0,
+            mean_osl=out_delta / req_delta if req_delta > 0 else 0.0,
+            p50_ttft_s=ttft,
+            p50_itl_s=itl,
+        )
+
+    async def __call__(self) -> MetricsSnapshot:
+        now = time.monotonic()
+        cur = await self._fetch()
+        prev = self._prev
+        self._prev = _Scrape(now, cur)
+        if prev is None:
+            return MetricsSnapshot()
+        return self.snapshot_from(prev.sample, cur, now - prev.ts)

@@ -151,3 +151,191 @@ async def test_profiler_sweep_mock_engine():
         DecodeInterpolator.from_points(profile["decode"])
     finally:
         await engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# Live loop: scrape source + process connector (VERDICT #5)
+# ---------------------------------------------------------------------------
+
+from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
+from dynamo_tpu.planner import FrontendScrapeSource, ProcessConnector, RoleSpec
+from dynamo_tpu.planner.metrics_source import (
+    _histogram_quantile,
+    parse_prometheus_text,
+)
+from dynamo_tpu.planner.planner_core import ReplicaPlan
+
+
+class TestScrapeSource:
+    def _sample(self, n_requests: int, isl: int, osl: int):
+        m = FrontendMetrics()
+        for _ in range(n_requests):
+            t = RequestTimer(m, "m1", "completions")
+            t.on_input_tokens(isl)
+            for _ in range(osl):
+                t.on_token()
+            t.done(200)
+        return parse_prometheus_text(m.render().decode())
+
+    def test_parse_prometheus_text(self):
+        sample = self._sample(3, isl=10, osl=4)
+        key = (
+            "dynamo_tpu_frontend_requests_total",
+            (("endpoint", "completions"), ("model", "m1"), ("status", "200")),
+        )
+        assert sample[key] == 3.0
+        assert (
+            sample[("dynamo_tpu_frontend_input_tokens_total", (("model", "m1"),))]
+            == 30.0
+        )
+
+    def test_snapshot_deltas(self):
+        src = FrontendScrapeSource([], model="m1")
+        prev = self._sample(2, isl=8, osl=4)
+        cur = self._sample(12, isl=8, osl=4)  # +10 requests over 5s
+        snap = src.snapshot_from(prev, cur, dt=5.0)
+        assert snap.request_rate == pytest.approx(2.0)
+        assert snap.mean_isl == pytest.approx(8.0)
+        assert snap.mean_osl == pytest.approx(4.0)
+        assert snap.p50_itl_s is not None and snap.p50_itl_s >= 0.0
+
+    def test_histogram_quantile_interpolates(self):
+        deltas = [(0.1, 0.0), (0.5, 8.0), (1.0, 10.0), (float("inf"), 10.0)]
+        q50 = _histogram_quantile(deltas, 0.5)
+        assert 0.1 < q50 <= 0.5
+        assert _histogram_quantile([], 0.5) is None
+        assert _histogram_quantile([(1.0, 0.0), (float("inf"), 0.0)], 0.5) is None
+
+    async def test_scrape_over_http(self):
+        from aiohttp import web
+
+        m = FrontendMetrics()
+        t = RequestTimer(m, "m1", "completions")
+        t.on_input_tokens(5)
+        t.on_token()
+        t.done(200)
+
+        app = web.Application()
+        app.router.add_get(
+            "/metrics",
+            lambda req: web.Response(body=m.render(), content_type="text/plain"),
+        )
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            src = FrontendScrapeSource([f"http://127.0.0.1:{port}/metrics"])
+            first = await src()  # primes the baseline
+            assert first.request_rate == 0.0
+            t2 = RequestTimer(m, "m1", "completions")
+            t2.on_input_tokens(5)
+            t2.on_token()
+            t2.done(200)
+            snap = await src()
+            assert snap.mean_isl == pytest.approx(5.0)
+            assert snap.request_rate > 0.0
+        finally:
+            await runner.cleanup()
+
+
+class TestProcessConnector:
+    async def test_scale_up_down(self):
+        import sys
+
+        conn = ProcessConnector(
+            {"decode": RoleSpec(command=[sys.executable, "-c",
+                                         "import time; time.sleep(60)"],
+                                grace_period_s=5.0)}
+        )
+        try:
+            await conn.apply(ReplicaPlan(prefill=0, decode=2, reason="up"))
+            assert conn.counts()["decode"] == 2
+            pids = [m.proc.pid for m in conn.alive("decode")]
+            await conn.apply(ReplicaPlan(prefill=0, decode=1, reason="down"))
+            assert conn.counts()["decode"] == 1
+            # oldest survives (newest-first retirement)
+            assert conn.alive("decode")[0].proc.pid == pids[0]
+        finally:
+            await conn.close()
+        assert conn.counts()["decode"] == 0
+
+    async def test_reaps_self_exited(self):
+        import sys
+
+        conn = ProcessConnector(
+            {"decode": RoleSpec(command=[sys.executable, "-c", "pass"])}
+        )
+        try:
+            await conn.apply(ReplicaPlan(prefill=0, decode=1))
+            for _ in range(100):
+                if conn.counts()["decode"] == 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert conn.counts()["decode"] == 0
+            # next apply respawns
+            await conn.apply(ReplicaPlan(prefill=0, decode=1))
+            assert len(conn._procs["decode"]) == 1
+        finally:
+            await conn.close()
+
+
+async def test_planner_closes_loop_scrape_to_processes():
+    """Rising scraped load scales decode subprocesses 1 → 2 (VERDICT #5)."""
+    import sys
+
+    from aiohttp import web
+
+    m = FrontendMetrics()
+    app = web.Application()
+    app.router.add_get(
+        "/metrics",
+        lambda req: web.Response(body=m.render(), content_type="text/plain"),
+    )
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+
+    conn = ProcessConnector(
+        {"decode": RoleSpec(command=[sys.executable, "-c",
+                                     "import time; time.sleep(60)"],
+                            grace_period_s=5.0)}
+    )
+    # One worker handles 1 concurrent stream at the ITL SLA.
+    planner = Planner(
+        PlannerConfig(itl_target_s=0.02, min_replicas=1, max_replicas=4,
+                      adjustment_interval_s=0.1),
+        PrefillInterpolator([8.0, 64.0], [0.05, 0.1], [4000.0, 4000.0]),
+        DecodeInterpolator([1.0, 2.0], [0.02, 0.05], [50.0, 60.0]),
+        conn,
+        FrontendScrapeSource([f"http://127.0.0.1:{port}/metrics"]),
+        disagg=False,
+    )
+
+    def burst(n):
+        for _ in range(n):
+            t = RequestTimer(m, "m1", "completions")
+            t.on_input_tokens(8)
+            for _ in range(50):
+                t.on_token()
+            t.done(200)
+
+    try:
+        await planner.step()  # primes scrape baseline (no plan yet)
+        burst(1)  # light: ~1 req/s × 1s gen time ⇒ concurrency ≈ 1
+        await asyncio.sleep(1.0)
+        plan = await planner.step()
+        assert plan is not None and plan.decode == 1
+        assert conn.counts()["decode"] == 1
+
+        burst(20)  # heavy: rate × gen_time ≫ 1 worker's concurrency
+        await asyncio.sleep(0.5)
+        plan = await planner.step()
+        assert plan is not None and plan.decode >= 2
+        assert conn.counts()["decode"] == plan.decode
+    finally:
+        await conn.close()
+        await runner.cleanup()
