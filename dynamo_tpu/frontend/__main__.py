@@ -33,6 +33,13 @@ async def main() -> None:
     parser.add_argument(
         "--router-temperature", type=float, default=config.ROUTER_TEMPERATURE.get()
     )
+    parser.add_argument(
+        "--enable-canary", action="store_true",
+        help="active canary health checks per worker "
+        "(ref: lib/runtime/src/health_check.rs)",
+    )
+    parser.add_argument("--canary-interval", type=float, default=5.0)
+    parser.add_argument("--canary-timeout", type=float, default=10.0)
     args = parser.parse_args()
 
     configure_logging()
@@ -51,6 +58,9 @@ async def main() -> None:
             overlap_score_weight=args.kv_overlap_score_weight,
             router_temperature=args.router_temperature,
         ),
+        enable_canary=args.enable_canary,
+        canary_interval_s=args.canary_interval,
+        canary_timeout_s=args.canary_timeout,
     )
     await watcher.start()
     service = HttpService(manager, host=args.host, port=args.http_port)

@@ -23,14 +23,27 @@ class ModelEntry:
     engine: AsyncEngine  # full pipeline: OpenAI dict request in
     card: ModelDeploymentCard
     registered_at: float = field(default_factory=time.time)
+    # optional per-model operational attachments (worker_monitor.py / health.py)
+    monitor: Optional[Any] = None  # WorkerLoadMonitor
+    health: Optional[Any] = None  # CanaryHealthChecker
 
 
 class ModelManager:
     def __init__(self) -> None:
         self._models: Dict[str, ModelEntry] = {}
 
-    def register(self, name: str, engine: AsyncEngine, card: ModelDeploymentCard) -> None:
-        self._models[name] = ModelEntry(name=name, engine=engine, card=card)
+    def register(
+        self,
+        name: str,
+        engine: AsyncEngine,
+        card: ModelDeploymentCard,
+        *,
+        monitor: Optional[Any] = None,
+        health: Optional[Any] = None,
+    ) -> None:
+        self._models[name] = ModelEntry(
+            name=name, engine=engine, card=card, monitor=monitor, health=health
+        )
 
     def unregister(self, name: str) -> None:
         self._models.pop(name, None)

@@ -30,6 +30,7 @@ from dynamo_tpu.llm.protocols.openai import (
 )
 from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
 from dynamo_tpu.http.model_manager import ModelManager
+from dynamo_tpu.http.worker_monitor import BusyThresholds
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.tasks import TaskTracker
 
@@ -54,6 +55,9 @@ class HttpService:
         self.port = port
         self.metrics = metrics or FrontendMetrics()
         self.tracker = TaskTracker("http")
+        # model name → busy thresholds (ref: busy_threshold.rs; checked
+        # against the model's WorkerLoadMonitor when one is attached)
+        self.busy_thresholds: Dict[str, BusyThresholds] = {}
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
         self.app = self._build_app()
@@ -67,6 +71,8 @@ class HttpService:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics_route)
+        app.router.add_get("/busy_threshold", self._busy_threshold_list)
+        app.router.add_post("/busy_threshold", self._busy_threshold_route)
         return app
 
     # -- lifecycle ---------------------------------------------------------
@@ -103,6 +109,45 @@ class HttpService:
 
     async def _models_route(self, request: web.Request) -> web.Response:
         return web.json_response(model_list(self.models.openai_model_list()))
+
+    async def _busy_threshold_list(self, request: web.Request) -> web.Response:
+        """(ref: busy_threshold.rs GET — list configured thresholds)"""
+        return web.json_response(
+            {
+                "thresholds": [
+                    {"model": m, **t.to_dict()}
+                    for m, t in sorted(self.busy_thresholds.items())
+                ]
+            }
+        )
+
+    async def _busy_threshold_route(self, request: web.Request) -> web.Response:
+        """Get or set one model's thresholds (ref: busy_threshold.rs POST)."""
+        body, err = await self._read_json(request)
+        if err is not None:
+            return err
+        model = body.get("model")
+        if not model:
+            return _error_response(OpenAIError("'model' is required"))
+        has_values = (
+            "active_decode_blocks_threshold" in body
+            or "waiting_requests_threshold" in body
+        )
+        if has_values:
+            self.busy_thresholds[model] = BusyThresholds(
+                active_decode_blocks_threshold=body.get(
+                    "active_decode_blocks_threshold"
+                ),
+                waiting_requests_threshold=body.get("waiting_requests_threshold"),
+            )
+        th = self.busy_thresholds.get(model, BusyThresholds())
+        return web.json_response({"model": model, **th.to_dict()})
+
+    def _model_busy(self, model: str, entry) -> bool:
+        th = self.busy_thresholds.get(model)
+        if th is None or entry.monitor is None:
+            return False
+        return entry.monitor.all_busy(th)
 
     # -- OpenAI routes -----------------------------------------------------
 
@@ -158,6 +203,18 @@ class HttpService:
             )
         stream = bool(body.get("stream", False))
         endpoint = "chat_completions" if kind == "chat" else "completions"
+        if self._model_busy(model, entry):
+            # All workers over threshold: shed before any work is queued
+            # (ref: busy_threshold.rs middleware → 503).
+            resp = _error_response(
+                OpenAIError(
+                    f"all workers for model '{model}' are busy; retry later",
+                    status=503,
+                    err_type="service_unavailable",
+                )
+            )
+            resp.headers["Retry-After"] = "1"
+            return resp
         timer = RequestTimer(self.metrics, model, endpoint)
         ctx = Context(baggage={"model": model})
         try:

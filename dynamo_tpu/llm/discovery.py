@@ -67,6 +67,10 @@ class ModelWatcher:
         enable_disagg: bool = True,
         prefill_component: str = "prefill",
         disagg_threshold_tokens: int = 32,
+        enable_busy_monitor: bool = True,
+        enable_canary: bool = False,
+        canary_interval_s: float = 5.0,
+        canary_timeout_s: float = 10.0,
     ) -> None:
         self._runtime = runtime
         self._manager = model_manager
@@ -75,6 +79,10 @@ class ModelWatcher:
         self.enable_disagg = enable_disagg
         self.prefill_component = prefill_component
         self.disagg_threshold_tokens = disagg_threshold_tokens
+        self.enable_busy_monitor = enable_busy_monitor
+        self.enable_canary = enable_canary
+        self.canary_interval_s = canary_interval_s
+        self.canary_timeout_s = canary_timeout_s
         # model slug → state
         self._models: Dict[str, Dict[str, Any]] = {}
         self._task: Optional[asyncio.Task] = None
@@ -178,13 +186,33 @@ class ModelWatcher:
                 )
             )
         pipeline = build_pipeline(operators, client)
+        monitor = None
+        if self.enable_busy_monitor:
+            from dynamo_tpu.http.worker_monitor import WorkerLoadMonitor
+
+            monitor = WorkerLoadMonitor(
+                self._runtime.event_plane, ep_info["namespace"], ep_info["component"]
+            )
+            await monitor.start()
+        health = None
+        if self.enable_canary:
+            from dynamo_tpu.runtime.health import CanaryHealthChecker
+
+            health = CanaryHealthChecker(
+                client,
+                interval_s=self.canary_interval_s,
+                timeout_s=self.canary_timeout_s,
+            )
+            health.start()
         self._models[slug] = {
             "card": card,
             "client": client,
             "router": router,
+            "monitor": monitor,
+            "health": health,
             "instances": {doc["instance_id"]},
         }
-        self._manager.register(card.name, pipeline, card)
+        self._manager.register(card.name, pipeline, card, monitor=monitor, health=health)
         logger.info("model %s online (instance %x)", card.name, doc["instance_id"])
 
     async def _drop_instance(self, slug: str, iid_hex: str) -> None:
@@ -198,6 +226,8 @@ class ModelWatcher:
         state["instances"].discard(iid)
         if state["router"] is not None and iid is not None:
             state["router"].remove_worker((iid, 0))
+        if state.get("monitor") is not None and iid is not None:
+            state["monitor"].drop_worker(iid)
         if not state["instances"]:
             await self._remove_model(slug)
 
@@ -206,6 +236,10 @@ class ModelWatcher:
         if state is None:
             return
         self._manager.unregister(state["card"].name)
+        if state.get("health") is not None:
+            await state["health"].stop()
+        if state.get("monitor") is not None:
+            await state["monitor"].stop()
         if state["router"] is not None:
             await state["router"].stop()
         await state["client"].close()

@@ -173,6 +173,7 @@ class Client:
         self._instances_nonempty = asyncio.Event()
         self._kv_picker = None  # async (request, instances) -> instance_id
         self._on_stream_done = None  # (instance_id, request) -> None
+        self._instance_filter = None  # (instance_id) -> bool (health gating)
 
     @property
     def endpoint_path(self) -> str:
@@ -184,6 +185,12 @@ class Client:
 
     def set_kv_picker(self, picker) -> None:
         self._kv_picker = picker
+
+    def set_instance_filter(self, predicate) -> None:
+        """``predicate(instance_id) -> bool``; False excludes the instance
+        from routing (ref: worker_monitor.rs eviction of unhealthy workers).
+        Direct routing (explicit instance_id) bypasses the filter."""
+        self._instance_filter = predicate
 
     def set_stream_done_callback(self, callback) -> None:
         """``callback(instance_id, request)`` fires when a routed stream ends
@@ -250,16 +257,27 @@ class Client:
                     f"{self.endpoint_path} instance {instance_id:#x} not found"
                 )
             return inst
-        ids = sorted(self._instances)
+        eligible = self._instances
+        if self._instance_filter is not None:
+            eligible = {
+                iid: inst
+                for iid, inst in self._instances.items()
+                if self._instance_filter(iid)
+            }
+            if not eligible:
+                raise NoInstancesError(
+                    f"{self.endpoint_path}: all instances excluded (unhealthy)"
+                )
+        ids = sorted(eligible)
         if self.router_mode == RouterMode.RANDOM:
-            return self._instances[random.choice(ids)]
+            return eligible[random.choice(ids)]
         if self.router_mode == RouterMode.KV and self._kv_picker is not None:
-            chosen = await self._kv_picker(request, dict(self._instances))
-            if chosen is not None and chosen in self._instances:
-                return self._instances[chosen]
+            chosen = await self._kv_picker(request, dict(eligible))
+            if chosen is not None and chosen in eligible:
+                return eligible[chosen]
         # Round-robin default (also KV fallback when picker abstains).
         self._rr_index = (self._rr_index + 1) % len(ids)
-        return self._instances[ids[self._rr_index]]
+        return eligible[ids[self._rr_index]]
 
     def generate(
         self,

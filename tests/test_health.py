@@ -1,0 +1,238 @@
+"""Active health checking + busy-threshold load shedding (VERDICT #6).
+
+Reference parity: lib/runtime/src/health_check.rs (canary tasks, recovery),
+lib/llm/src/discovery/worker_monitor.rs (routing eviction),
+lib/llm/src/http/service/busy_threshold.rs (503 when all workers busy).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.http.metrics import FrontendMetrics
+from dynamo_tpu.http.model_manager import ModelManager
+from dynamo_tpu.http.service import HttpService
+from dynamo_tpu.http.worker_monitor import BusyThresholds, WorkerLoadMonitor
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.router.protocols import LoadSnapshot, load_topic
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    NoInstancesError,
+    collect,
+)
+from dynamo_tpu.runtime.health import CanaryHealthChecker
+
+
+def make_worker(hung: asyncio.Event):
+    """A worker that serves normally until `hung` is set, then stalls."""
+
+    async def handler(request, context):
+        if hung.is_set():
+            await asyncio.sleep(3600)
+        yield {"token_ids": [1], "finish_reason": "stop"}
+
+    return handler
+
+
+class TestCanary:
+    async def test_hung_worker_evicted_and_recovers(self):
+        drt = DistributedRuntime.detached()
+        ep = drt.namespace("health").component("backend").endpoint("generate")
+        hang0 = asyncio.Event()
+        hang1 = asyncio.Event()
+        await ep.serve_endpoint(make_worker(hang0), instance_id=0)
+        await ep.serve_endpoint(make_worker(hang1), instance_id=1)
+        client = await ep.client()
+        await client.wait_for_instances()
+
+        checker = CanaryHealthChecker(
+            client, interval_s=0.1, timeout_s=0.2, failure_threshold=2,
+            canary_wait_time_s=0.0,
+        )
+        await checker.check_all()
+        assert checker.unhealthy_ids() == set()
+
+        hang1.set()  # worker 1 hangs but its lease stays alive
+        await checker.check_all()  # strike 1
+        await checker.check_all()  # strike 2 → unhealthy
+        assert checker.unhealthy_ids() == {1}
+
+        # routing excludes the hung worker: 8 requests all land on worker 0
+        for _ in range(8):
+            out = await collect(client.generate({"x": 1}, Context()))
+            assert out[0]["token_ids"] == [1]
+
+        hang1.clear()  # worker recovers
+        await checker.check_all()
+        assert checker.unhealthy_ids() == set()
+
+    async def test_all_unhealthy_raises_no_instances(self):
+        drt = DistributedRuntime.detached()
+        ep = drt.namespace("health2").component("backend").endpoint("generate")
+        hang = asyncio.Event()
+        await ep.serve_endpoint(make_worker(hang), instance_id=0)
+        client = await ep.client()
+        await client.wait_for_instances()
+        checker = CanaryHealthChecker(
+            client, interval_s=0.1, timeout_s=0.2, failure_threshold=1,
+            canary_wait_time_s=0.0,
+        )
+        hang.set()
+        await checker.check_all()
+        assert checker.unhealthy_ids() == {0}
+        with pytest.raises(NoInstancesError):
+            await collect(client.generate({"x": 1}, Context()))
+        # direct routing bypasses the health filter (migration/debug path)
+        hang.clear()
+        out = await collect(client.direct({"x": 1}, 0))
+        assert out[0]["finish_reason"] == "stop"
+
+    async def test_worker_metadata_payload_preferred(self):
+        drt = DistributedRuntime.detached()
+        ep = drt.namespace("health3").component("backend").endpoint("generate")
+        seen = []
+
+        async def handler(request, context):
+            seen.append(request)
+            yield {"ok": True}
+
+        await ep.serve_endpoint(
+            handler, instance_id=0,
+            metadata={"health_payload": {"canary": "custom"}},
+        )
+        client = await ep.client()
+        await client.wait_for_instances()
+        checker = CanaryHealthChecker(client, canary_wait_time_s=0.0)
+        await checker.check_all()
+        assert seen and seen[-1] == {"canary": "custom"}
+
+    async def test_background_loop_marks_unhealthy(self):
+        """The VERDICT done-criterion: a hung (not dead) worker stops
+        receiving requests within the canary interval."""
+        drt = DistributedRuntime.detached()
+        ep = drt.namespace("health4").component("backend").endpoint("generate")
+        hang = asyncio.Event()
+        served = []
+
+        async def healthy_handler(request, context):
+            served.append(request)
+            yield {"from": "healthy"}
+
+        await ep.serve_endpoint(make_worker(hang), instance_id=0)
+        await ep.serve_endpoint(healthy_handler, instance_id=1)
+        client = await ep.client()
+        await client.wait_for_instances()
+        checker = CanaryHealthChecker(
+            client, interval_s=0.05, timeout_s=0.1, failure_threshold=2,
+            canary_wait_time_s=0.0,
+        )
+        checker.start()
+        try:
+            hang.set()
+            for _ in range(100):
+                if checker.unhealthy_ids() == {0}:
+                    break
+                await asyncio.sleep(0.05)
+            assert checker.unhealthy_ids() == {0}
+            out = await collect(client.generate({"q": 1}, Context()))
+            assert out[0]["from"] == "healthy"
+        finally:
+            await checker.stop()
+
+
+class TestBusyThreshold:
+    def _snap(self, worker, active, total, waiting=0):
+        return LoadSnapshot(
+            worker_id=worker, active_blocks=active, total_blocks=total,
+            waiting=waiting,
+        )
+
+    async def test_monitor_all_busy(self):
+        drt = DistributedRuntime.detached()
+        mon = WorkerLoadMonitor(drt.event_plane, "ns", "backend")
+        await mon.start()
+        topic = load_topic("ns", "backend")
+        th = BusyThresholds(active_decode_blocks_threshold=0.8)
+        try:
+            assert not mon.all_busy(th)  # no data → don't shed
+            await drt.event_plane.publish(topic, self._snap(1, 90, 100).to_dict())
+            await drt.event_plane.publish(topic, self._snap(2, 10, 100).to_dict())
+            await asyncio.sleep(0.1)
+            assert not mon.all_busy(th)  # one worker still has room
+            await drt.event_plane.publish(topic, self._snap(2, 85, 100).to_dict())
+            await asyncio.sleep(0.1)
+            assert mon.all_busy(th)
+            assert not mon.all_busy(BusyThresholds())  # unconfigured → never
+            mon.drop_worker(1)
+            mon.drop_worker(2)
+            assert not mon.all_busy(th)
+        finally:
+            await mon.stop()
+
+    async def test_waiting_threshold(self):
+        drt = DistributedRuntime.detached()
+        mon = WorkerLoadMonitor(drt.event_plane, "ns2", "backend")
+        await mon.start()
+        topic = load_topic("ns2", "backend")
+        th = BusyThresholds(waiting_requests_threshold=4)
+        try:
+            await drt.event_plane.publish(
+                topic, self._snap(1, 0, 100, waiting=6).to_dict()
+            )
+            await asyncio.sleep(0.1)
+            assert mon.all_busy(th)
+        finally:
+            await mon.stop()
+
+    async def test_http_503_when_all_busy(self):
+        from aiohttp import ClientSession
+
+        class FakeMonitor:
+            busy = False
+
+            def all_busy(self, th):
+                return self.busy
+
+        async def engine(request, context):
+            yield {"ok": True}
+
+        manager = ModelManager()
+        from dynamo_tpu.runtime.engine import as_engine
+
+        monitor = FakeMonitor()
+        manager.register(
+            "m", as_engine(engine),
+            ModelDeploymentCard(name="m"), monitor=monitor,
+        )
+        service = HttpService(manager, host="127.0.0.1", port=0,
+                              metrics=FrontendMetrics())
+        port = await service.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with ClientSession() as http:
+                # set thresholds via the admin route
+                r = await http.post(
+                    f"{base}/busy_threshold",
+                    json={"model": "m", "active_decode_blocks_threshold": 0.9},
+                )
+                assert (await r.json())["active_decode_blocks_threshold"] == 0.9
+                r = await http.get(f"{base}/busy_threshold")
+                assert (await r.json())["thresholds"][0]["model"] == "m"
+
+                monitor.busy = True
+                r = await http.post(
+                    f"{base}/v1/completions",
+                    json={"model": "m", "prompt": "hi"},
+                )
+                assert r.status == 503
+                assert r.headers.get("Retry-After") == "1"
+
+                monitor.busy = False
+                r = await http.post(
+                    f"{base}/v1/completions",
+                    json={"model": "m", "prompt": "hi"},
+                )
+                assert r.status != 503
+        finally:
+            await service.stop(grace_period=1)
