@@ -1,0 +1,16 @@
+"""gRPC KServe (Open Inference Protocol v2) frontend.
+
+Reference parity: lib/llm/src/grpc/service/kserve.rs — the second frontend
+class next to HTTP: ServerLive/ServerReady/ModelReady/ModelMetadata,
+ModelInfer (unary) and ModelStreamInfer (streaming) speaking the public
+KServe v2 protocol, backed by the same ModelManager pipelines the HTTP
+frontend serves.
+
+The protobuf gencode (kserve_v2_pb2.py) is committed; regenerate with:
+    protoc --python_out=dynamo_tpu/grpc -I dynamo_tpu/grpc/protos \
+        dynamo_tpu/grpc/protos/kserve_v2.proto
+"""
+
+from dynamo_tpu.grpc.service import KserveGrpcService
+
+__all__ = ["KserveGrpcService"]

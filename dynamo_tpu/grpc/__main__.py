@@ -1,0 +1,57 @@
+"""gRPC KServe frontend entrypoint.
+
+Mirrors the HTTP frontend (frontend/__main__.py) but serves the KServe v2
+protocol (ref: the reference's `dynamo-run` http+grpc listener split,
+lib/llm/src/grpc/service/kserve.rs). Models arrive via the same discovery
+watcher; one process can serve both frontends off one ModelManager.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu import config
+from dynamo_tpu.grpc.service import KserveGrpcService
+from dynamo_tpu.http.model_manager import ModelManager
+from dynamo_tpu.llm.discovery import ModelWatcher
+from dynamo_tpu.router import KvRouterConfig
+from dynamo_tpu.runtime.component import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu grpc frontend (KServe v2)")
+    parser.add_argument("--host", default=config.HTTP_HOST.get())
+    parser.add_argument("--grpc-port", type=int, default=8787)
+    parser.add_argument(
+        "--router-mode", choices=["kv", "round-robin", "random"], default="kv"
+    )
+    args = parser.parse_args()
+
+    configure_logging()
+    runtime = DistributedRuntime.from_settings()
+    manager = ModelManager()
+    mode = {
+        "kv": RouterMode.KV,
+        "round-robin": RouterMode.ROUND_ROBIN,
+        "random": RouterMode.RANDOM,
+    }[args.router_mode]
+    watcher = ModelWatcher(
+        runtime, manager, router_mode=mode, kv_router_config=KvRouterConfig()
+    )
+    await watcher.start()
+    service = KserveGrpcService(manager, host=args.host, port=args.grpc_port)
+    port = await service.start()
+    print(f"grpc frontend listening on {args.host}:{port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service.stop(grace_period=config.GRACE_PERIOD.get())
+        await watcher.stop()
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

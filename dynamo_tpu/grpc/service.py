@@ -1,0 +1,289 @@
+"""KServe v2 gRPC inference service.
+
+Reference parity: lib/llm/src/grpc/service/kserve.rs (request/response
+mapping, unary rejects streaming=true :356, temperature/max_tokens
+defaulting :367-371, streaming demux note :407) and tensor.rs (BYTES
+raw-contents codec :402). The text-generation convention matches the
+reference (and Triton's TensorRT-LLM frontends):
+
+  inputs:  text_input (BYTES [1]) — the prompt
+           streaming (BOOL [1], optional) — only legal on ModelStreamInfer
+  request parameters: temperature, max_tokens, top_p, top_k, seed,
+           stop_words, ignore_eos (InferParameter map)
+  outputs: text_output (BYTES [1]) — generated text (delta when streaming)
+           finish_reason (BYTES [1]) — set on the final response
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import grpc
+
+from dynamo_tpu.grpc import kserve_v2_pb2 as pb
+from dynamo_tpu.llm.protocols.common import PostprocessedOutput
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SERVICE_NAME = "inference.GRPCInferenceService"
+
+
+# -- tensor codec -----------------------------------------------------------
+
+
+def _bytes_tensor(name: str, values: List[bytes]) -> "pb.ModelInferResponse.InferOutputTensor":
+    t = pb.ModelInferResponse.InferOutputTensor(
+        name=name, datatype="BYTES", shape=[len(values)]
+    )
+    t.contents.bytes_contents.extend(values)
+    return t
+
+
+def _decode_raw_bytes(raw: bytes) -> List[bytes]:
+    """KServe raw_input_contents codec for BYTES tensors: each element is a
+    4-byte little-endian length followed by the payload (tensor.rs :402)."""
+    out: List[bytes] = []
+    off = 0
+    while off + 4 <= len(raw):
+        (n,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        out.append(raw[off : off + n])
+        off += n
+    return out
+
+
+def _param_value(p: "pb.InferParameter") -> Any:
+    kind = p.WhichOneof("parameter_choice")
+    return getattr(p, kind) if kind else None
+
+
+def _input_tensor_values(
+    request: "pb.ModelInferRequest", name: str
+) -> Optional[List[Any]]:
+    for i, tensor in enumerate(request.inputs):
+        if tensor.name != name:
+            continue
+        c = tensor.contents
+        for field in ("bytes_contents", "bool_contents", "int_contents",
+                      "int64_contents", "fp32_contents", "fp64_contents",
+                      "uint_contents", "uint64_contents"):
+            vals = list(getattr(c, field))
+            if vals:
+                return vals
+        # contents empty: the tensor may ride raw_input_contents (positional)
+        if i < len(request.raw_input_contents):
+            raw = request.raw_input_contents[i]
+            if tensor.datatype == "BYTES":
+                return _decode_raw_bytes(raw)
+            if tensor.datatype == "BOOL":
+                return [b != 0 for b in raw]
+        return None
+    return None
+
+
+def request_to_openai(request: "pb.ModelInferRequest") -> Tuple[Dict[str, Any], bool]:
+    """ModelInferRequest → (OpenAI completion dict, streaming flag)."""
+    text_vals = _input_tensor_values(request, "text_input")
+    if not text_vals:
+        raise ValueError("missing required input tensor 'text_input'")
+    prompt = text_vals[0]
+    if isinstance(prompt, bytes):
+        prompt = prompt.decode("utf-8", errors="replace")
+    stream_vals = _input_tensor_values(request, "streaming")
+    streaming = bool(stream_vals[0]) if stream_vals else False
+
+    body: Dict[str, Any] = {
+        "model": request.model_name,
+        "prompt": prompt,
+        "stream": streaming,
+    }
+    if request.id:
+        body["request_id"] = request.id
+    params = {k: _param_value(v) for k, v in request.parameters.items()}
+    for key in ("temperature", "top_p", "frequency_penalty", "presence_penalty"):
+        if key in params:
+            body[key] = float(params[key])
+    for key in ("max_tokens", "top_k", "seed", "min_tokens"):
+        if key in params:
+            body[key] = int(params[key])
+    if "ignore_eos" in params:
+        body["ignore_eos"] = bool(params["ignore_eos"])
+    if "stop_words" in params and params["stop_words"]:
+        body["stop"] = str(params["stop_words"]).split(",")
+    return body, streaming
+
+
+def response_from(
+    model: str, request_id: str, text: str, finish_reason: Optional[str]
+) -> "pb.ModelInferResponse":
+    resp = pb.ModelInferResponse(model_name=model, id=request_id)
+    resp.outputs.append(_bytes_tensor("text_output", [text.encode()]))
+    if finish_reason is not None:
+        resp.outputs.append(_bytes_tensor("finish_reason", [finish_reason.encode()]))
+    return resp
+
+
+# -- service ----------------------------------------------------------------
+
+
+class KserveGrpcService:
+    """The gRPC frontend server; shares a ModelManager with the HTTP one."""
+
+    def __init__(self, model_manager: Any, *, host: str = "0.0.0.0", port: int = 8787) -> None:
+        self.models = model_manager
+        self.host = host
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _server_live(self, request, context) -> "pb.ServerLiveResponse":
+        return pb.ServerLiveResponse(live=True)
+
+    async def _server_ready(self, request, context) -> "pb.ServerReadyResponse":
+        return pb.ServerReadyResponse(ready=len(self.models) > 0)
+
+    async def _model_ready(self, request, context) -> "pb.ModelReadyResponse":
+        return pb.ModelReadyResponse(ready=self.models.get(request.name) is not None)
+
+    async def _server_metadata(self, request, context) -> "pb.ServerMetadataResponse":
+        from dynamo_tpu._version import __version__
+
+        return pb.ServerMetadataResponse(
+            name="dynamo_tpu", version=__version__, extensions=[]
+        )
+
+    async def _model_metadata(self, request, context) -> "pb.ModelMetadataResponse":
+        entry = self.models.get(request.name)
+        if entry is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model '{request.name}' not found"
+            )
+        resp = pb.ModelMetadataResponse(
+            name=entry.name, versions=["1"], platform="dynamo_tpu"
+        )
+        TM = pb.ModelMetadataResponse.TensorMetadata
+        resp.inputs.append(TM(name="text_input", datatype="BYTES", shape=[1]))
+        resp.inputs.append(TM(name="streaming", datatype="BOOL", shape=[1]))
+        resp.outputs.append(TM(name="text_output", datatype="BYTES", shape=[1]))
+        resp.outputs.append(TM(name="finish_reason", datatype="BYTES", shape=[1]))
+        return resp
+
+    async def _generate(
+        self, body: Dict[str, Any], entry: Any, ctx: Context
+    ) -> AsyncIterator[PostprocessedOutput]:
+        async for item in entry.engine.generate(body, ctx):
+            if isinstance(item, dict):
+                continue  # annotations are HTTP/SSE concerns
+            yield item
+
+    async def _model_infer(self, request, context) -> "pb.ModelInferResponse":
+        try:
+            body, streaming = request_to_openai(request)
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        if streaming:
+            # (ref: kserve.rs :356) unary infer cannot stream
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "streaming=true requires ModelStreamInfer",
+            )
+        entry = self.models.get(request.model_name)
+        if entry is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"model '{request.model_name}' not found",
+            )
+        ctx = Context(baggage={"model": request.model_name})
+        parts: List[str] = []
+        finish: Optional[str] = None
+        try:
+            async for out in self._generate(body, entry, ctx):
+                if out.error:
+                    await context.abort(grpc.StatusCode.INTERNAL, out.error)
+                parts.append(out.text)
+                if out.finish_reason is not None:
+                    finish = out.finish_reason.value
+        except asyncio.CancelledError:
+            ctx.kill()
+            raise
+        return response_from(request.model_name, request.id, "".join(parts), finish)
+
+    async def _model_stream_infer(self, request_iterator, context):
+        """Bidi stream: requests are served sequentially, each producing a
+        stream of delta responses (ref: kserve.rs ModelStreamInfer; errors
+        travel in-band via error_message per the protocol)."""
+        async for request in request_iterator:
+            entry = self.models.get(request.model_name)
+            if entry is None:
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"model '{request.model_name}' not found"
+                )
+                continue
+            try:
+                body, _streaming = request_to_openai(request)
+            except ValueError as exc:
+                yield pb.ModelStreamInferResponse(error_message=str(exc))
+                continue
+            body["stream"] = True
+            ctx = Context(baggage={"model": request.model_name})
+            try:
+                async for out in self._generate(body, entry, ctx):
+                    if out.error:
+                        yield pb.ModelStreamInferResponse(error_message=out.error)
+                        break
+                    finish = (
+                        out.finish_reason.value
+                        if out.finish_reason is not None
+                        else None
+                    )
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=response_from(
+                            request.model_name, request.id, out.text, finish
+                        )
+                    )
+            except asyncio.CancelledError:
+                ctx.kill()
+                raise
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def unary(fn, req_cls, _resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        handlers = {
+            "ServerLive": unary(self._server_live, pb.ServerLiveRequest, pb.ServerLiveResponse),
+            "ServerReady": unary(self._server_ready, pb.ServerReadyRequest, pb.ServerReadyResponse),
+            "ModelReady": unary(self._model_ready, pb.ModelReadyRequest, pb.ModelReadyResponse),
+            "ServerMetadata": unary(self._server_metadata, pb.ServerMetadataRequest, pb.ServerMetadataResponse),
+            "ModelMetadata": unary(self._model_metadata, pb.ModelMetadataRequest, pb.ModelMetadataResponse),
+            "ModelInfer": unary(self._model_infer, pb.ModelInferRequest, pb.ModelInferResponse),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self._model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("gRPC KServe frontend listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self, grace_period: float = 30.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace_period)
+            self._server = None

@@ -1,0 +1,216 @@
+"""gRPC KServe v2 frontend e2e: real grpc.aio client ↔ server over a socket,
+backed by the mock engine pipeline (VERDICT #7; ref: kserve.rs +
+tests/serve kserve coverage)."""
+
+import asyncio
+import struct
+
+import grpc
+import pytest
+
+from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+from dynamo_tpu.grpc import KserveGrpcService
+from dynamo_tpu.grpc import kserve_v2_pb2 as pb
+from dynamo_tpu.grpc.service import SERVICE_NAME, request_to_openai
+from dynamo_tpu.http import ModelManager
+from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+from dynamo_tpu.llm.entrypoint import build_local_pipeline
+
+
+async def start_service():
+    manager = ModelManager()
+    card = ModelDeploymentCard(name="mock-model", context_length=512)
+    engine = MockEngine(
+        MockEngineArgs(speedup_ratio=200.0, block_size=4, num_kv_blocks=256)
+    )
+    pipeline = build_local_pipeline(card, engine, tokenizer=tiny_tokenizer())
+    manager.register("mock-model", pipeline, card)
+    service = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    return service, engine, port
+
+
+def _channel_methods(port):
+    chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+
+    def unary(name, req_cls, resp_cls):
+        return chan.unary_unary(
+            f"/{SERVICE_NAME}/{name}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+
+    return chan, unary
+
+
+def infer_request(prompt: str, *, streaming=False, max_tokens=8, raw=False, **params):
+    req = pb.ModelInferRequest(model_name="mock-model", id="req-1")
+    t = req.inputs.add()
+    t.name = "text_input"
+    t.datatype = "BYTES"
+    t.shape.extend([1])
+    if raw:
+        data = prompt.encode()
+        req.raw_input_contents.append(struct.pack("<I", len(data)) + data)
+    else:
+        t.contents.bytes_contents.append(prompt.encode())
+    if streaming:
+        s = req.inputs.add()
+        s.name = "streaming"
+        s.datatype = "BOOL"
+        s.shape.extend([1])
+        s.contents.bool_contents.append(True)
+    req.parameters["max_tokens"].int64_param = max_tokens
+    req.parameters["temperature"].double_param = 0.0
+    for k, v in params.items():
+        if isinstance(v, bool):
+            req.parameters[k].bool_param = v
+        elif isinstance(v, int):
+            req.parameters[k].int64_param = v
+        elif isinstance(v, float):
+            req.parameters[k].double_param = v
+        else:
+            req.parameters[k].string_param = str(v)
+    return req
+
+
+def _text_output(resp: pb.ModelInferResponse) -> str:
+    for t in resp.outputs:
+        if t.name == "text_output":
+            return t.contents.bytes_contents[0].decode()
+    return ""
+
+
+def _finish_reason(resp: pb.ModelInferResponse):
+    for t in resp.outputs:
+        if t.name == "finish_reason":
+            return t.contents.bytes_contents[0].decode()
+    return None
+
+
+def test_request_mapping():
+    req = infer_request("hello", max_tokens=5, top_k=3, ignore_eos=True)
+    body, streaming = request_to_openai(req)
+    assert body["prompt"] == "hello"
+    assert body["max_tokens"] == 5
+    assert body["top_k"] == 3
+    assert body["ignore_eos"] is True
+    assert not streaming
+
+
+async def test_liveness_metadata_and_unary_infer():
+    service, engine, port = await start_service()
+    chan, unary = _channel_methods(port)
+    try:
+        live = await unary("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse)(
+            pb.ServerLiveRequest()
+        )
+        assert live.live
+        ready = await unary(
+            "ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse
+        )(pb.ServerReadyRequest())
+        assert ready.ready
+        mready = await unary(
+            "ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse
+        )(pb.ModelReadyRequest(name="mock-model"))
+        assert mready.ready
+        meta = await unary(
+            "ModelMetadata", pb.ModelMetadataRequest, pb.ModelMetadataResponse
+        )(pb.ModelMetadataRequest(name="mock-model"))
+        assert meta.platform == "dynamo_tpu"
+        assert [t.name for t in meta.inputs] == ["text_input", "streaming"]
+
+        resp = await unary("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)(
+            infer_request("the quick brown fox", max_tokens=6)
+        )
+        assert resp.model_name == "mock-model" and resp.id == "req-1"
+        assert isinstance(_text_output(resp), str)
+        assert _finish_reason(resp) == "length"
+    finally:
+        await chan.close()
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_unary_rejects_streaming_and_unknown_model():
+    service, engine, port = await start_service()
+    chan, unary = _channel_methods(port)
+    infer = unary("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)
+    try:
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await infer(infer_request("hi", streaming=True))
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        bad = infer_request("hi")
+        bad.model_name = "nope"
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await infer(bad)
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await chan.close()
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_stream_infer_deltas():
+    service, engine, port = await start_service()
+    chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    stream_infer = chan.stream_stream(
+        f"/{SERVICE_NAME}/ModelStreamInfer",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.ModelStreamInferResponse.FromString,
+    )
+    try:
+        call = stream_infer()
+        await call.write(infer_request("hello stream", streaming=True, max_tokens=6))
+        await call.done_writing()
+        deltas = []
+        finish = None
+        async for resp in call:
+            assert not resp.error_message
+            deltas.append(_text_output(resp.infer_response))
+            fr = _finish_reason(resp.infer_response)
+            if fr:
+                finish = fr
+        assert len(deltas) >= 2  # streamed, not aggregated
+        assert finish == "length"
+    finally:
+        await chan.close()
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_stream_infer_error_in_band():
+    service, engine, port = await start_service()
+    chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    stream_infer = chan.stream_stream(
+        f"/{SERVICE_NAME}/ModelStreamInfer",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.ModelStreamInferResponse.FromString,
+    )
+    try:
+        call = stream_infer()
+        bad = infer_request("hi", streaming=True)
+        bad.model_name = "ghost"
+        await call.write(bad)
+        await call.done_writing()
+        msgs = [resp async for resp in call]
+        assert len(msgs) == 1 and "not found" in msgs[0].error_message
+    finally:
+        await chan.close()
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_raw_input_contents():
+    service, engine, port = await start_service()
+    chan, unary = _channel_methods(port)
+    try:
+        resp = await unary("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)(
+            infer_request("raw bytes prompt", max_tokens=4, raw=True)
+        )
+        assert _finish_reason(resp) == "length"
+    finally:
+        await chan.close()
+        await engine.stop()
+        await service.stop(grace_period=1)
