@@ -46,7 +46,7 @@ from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
 from dynamo_tpu.parallel.mesh import AxisNames
 from dynamo_tpu.parallel.sharding import ShardingRules, param_shardings, shard_params
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -67,6 +67,10 @@ class JaxEngineArgs:
     enable_prefix_caching: bool = True
     use_kernel: Optional[bool] = None  # None = auto (pallas on TPU)
     seed: int = 0
+    # Multi-LoRA: directory of PEFT adapters (lora/source.py layout). All
+    # adapters are stacked and served from one compiled program
+    # (ops/lora.py); requests select theirs via PreprocessedRequest.lora_name.
+    lora_dir: Optional[str] = None
     # Fused decode iterations per dispatch (llama.decode_multi). Dispatch
     # latency dominates small-model decode on TPU; stop conditions are
     # evaluated host-side at this granularity (overshoot discarded).
@@ -144,6 +148,13 @@ class JaxEngine:
         self._k_cache = k_cache
         self._v_cache = v_cache
 
+        # Multi-LoRA state: adapter name → index into the stacked arrays
+        # (index 0 is the zero "no adapter" slot).
+        self._lora: Optional[Dict[str, Any]] = None
+        self._lora_index: Dict[str, int] = {}
+        if args.lora_dir:
+            self._load_loras(args.lora_dir)
+
         self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
         self._step_fn = self._build_step_fn()
         self._decode_fn = self._build_decode_fn()
@@ -157,6 +168,7 @@ class JaxEngine:
         self._temp = np.ones(S, dtype=np.float32)
         self._topk = np.zeros(S, dtype=np.int32)
         self._topp = np.ones(S, dtype=np.float32)
+        self._adapter_ids = np.zeros(S, dtype=np.int32)
 
         self.kvbm: Optional[Any] = None  # TieredKvManager (kvbm/manager.py)
         # Plain deque (+ wake event), NOT an asyncio.Queue: _requeue must
@@ -179,64 +191,102 @@ class JaxEngine:
         self.prefill_tokens = 0
         self.generated_tokens = 0
 
+    # -- multi-LoRA --------------------------------------------------------
+
+    def _load_loras(self, lora_dir: str) -> None:
+        """Load every adapter under ``lora_dir`` and stack them layer-major
+        for the scan-over-layers forward (lora/loader.py)."""
+        from dynamo_tpu.lora import LocalLoRASource, load_lora_adapter
+        from dynamo_tpu.lora.loader import stack_adapters
+
+        source = LocalLoRASource(lora_dir)
+        names = source.list_adapters()
+        if not names:
+            logger.warning("lora_dir %s contains no adapters", lora_dir)
+            return
+        adapters = [
+            load_lora_adapter(source.fetch(n, lora_dir), self.config, name=n)
+            for n in names
+        ]
+        targets = sorted({t for a in adapters for t in a.targets})
+        stacked = stack_adapters(adapters, self.config, targets)
+        # [N+1, L, ...] → layer-major [L, N+1, ...] for lax.scan xs.
+        self._lora = {
+            t: (A.swapaxes(0, 1), B.swapaxes(0, 1)) for t, (A, B) in stacked.items()
+        }
+        self._lora_index = {a.name: i for i, a in enumerate(adapters, start=1)}
+        logger.info(
+            "loaded %d LoRA adapter(s): %s (targets: %s)",
+            len(adapters), names, targets,
+        )
+
+    def lora_names(self) -> List[str]:
+        return sorted(self._lora_index)
+
     # -- jitted step -------------------------------------------------------
 
     def _build_step_fn(self):
         cfg = self.config
         use_kernel = self._use_kernel
 
-        def step(params, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, rng, temp, topk, topp):
+        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
+                 block_tables, rng, temp, topk, topp, adapter_ids):
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids,
             )
             toks = sample_tokens(logits, rng, temp, topk, topp)
             logp = compute_logprobs(logits, toks)
             return toks, logp, k_cache, v_cache
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(2, 3))
 
     def _build_decode_fn(self):
         cfg = self.config
         use_kernel = self._use_kernel
         num_steps = self.args.decode_steps
 
-        def step(params, k_cache, v_cache, tokens, start_pos, active,
-                 block_tables, rng, temp, topk, topp):
+        def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
+                 block_tables, rng, temp, topk, topp, adapter_ids):
             return llama.decode_multi(
                 params, cfg, tokens, start_pos, active, block_tables,
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids,
             )
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(2, 3))
 
     def _run_decode(
-        self, tokens, start_pos, active, block_tables, temp, topk, topp
+        self, tokens, start_pos, active, block_tables, temp, topk, topp,
+        adapter_ids,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
         [B, K] logprobs)."""
         self._rng, sub = jax.random.split(self._rng)
         toks, logp, self._k_cache, self._v_cache = self._decode_fn(
-            self.params, self._k_cache, self._v_cache,
+            self.params, self._lora, self._k_cache, self._v_cache,
             jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
             jnp.asarray(block_tables), sub,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+            jnp.asarray(adapter_ids),
         )
         return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
     def _run_step(
-        self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp
+        self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
+        adapter_ids,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Execute one step on the device thread (blocking). Caller passes
         numpy inputs; returns (sampled tokens, logprobs) as numpy."""
         self._rng, sub = jax.random.split(self._rng)
         toks, logp, self._k_cache, self._v_cache = self._step_fn(
-            self.params, self._k_cache, self._v_cache,
+            self.params, self._lora, self._k_cache, self._v_cache,
             jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
             jnp.asarray(block_tables), sub,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+            jnp.asarray(adapter_ids),
         )
         return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
@@ -305,6 +355,15 @@ class JaxEngine:
         if self._failure is not None:
             yield BackendOutput(
                 error=f"engine failed: {self._failure}",
+                finish_reason=FinishReason.ERROR,
+            )
+            return
+        if request.lora_name and request.lora_name not in self._lora_index:
+            yield BackendOutput(
+                error=(
+                    f"unknown LoRA adapter {request.lora_name!r} "
+                    f"(loaded: {self.lora_names()})"
+                ),
                 finish_reason=FinishReason.ERROR,
             )
             return
@@ -463,7 +522,12 @@ class JaxEngine:
         matched = 0
         ids: List[int] = []
         if args.enable_prefix_caching:
-            hashes = compute_block_hashes(prompt, args.block_size)
+            # Adapter-salted: LoRA K/V is not interchangeable with base K/V
+            # (tokens/blocks.py adapter_salt).
+            hashes = compute_block_hashes(
+                prompt, args.block_size,
+                salt=adapter_salt(seq.request.lora_name),
+            )
             # Onboard from the lower tiers (G2/G3) anything that extends the
             # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
             if self.kvbm is not None and hashes:
@@ -505,6 +569,8 @@ class JaxEngine:
         p_temp = np.array([sp[0]], dtype=np.float32)
         p_topk = np.array([sp[1]], dtype=np.int32)
         p_topp = np.array([sp[2]], dtype=np.float32)
+        adapter_id = self._lora_index.get(seq.request.lora_name or "", 0)
+        p_adapter = np.array([adapter_id], dtype=np.int32)
         pos = matched_tokens
         first_token: Optional[int] = None
         first_logprob = 0.0
@@ -519,7 +585,7 @@ class JaxEngine:
                 np.array([pos], dtype=np.int32),
                 np.array([len(chunk)], dtype=np.int32),
                 table[:, :nb_bucket],
-                p_temp, p_topk, p_topp,
+                p_temp, p_topk, p_topp, p_adapter,
             )
             self.prefill_tokens += len(chunk)
             pos += len(chunk)
@@ -545,6 +611,7 @@ class JaxEngine:
         self._block_tables[slot, :] = 0
         self._block_tables[slot, : len(ids)] = ids
         self._temp[slot], self._topk[slot], self._topp[slot] = sp
+        self._adapter_ids[slot] = adapter_id
         self._emit_token(seq, first_token, first_logprob)
         return True
 
@@ -613,6 +680,7 @@ class JaxEngine:
             active_mask,
             self._block_tables[:, :nb_bucket].copy(),
             self._temp.copy(), self._topk.copy(), self._topp.copy(),
+            self._adapter_ids.copy(),
         )
         self.steps += 1
 
@@ -640,6 +708,7 @@ class JaxEngine:
                 seq.all_tokens[bi * args.block_size : (bi + 1) * args.block_size],
                 args.block_size,
                 parent_hash=parent,
+                salt=adapter_salt(seq.request.lora_name),
             )[0]
             self.pool.commit(seq.block_ids[bi], h, parent)
             seq.block_hashes.append(h)

@@ -1,0 +1,30 @@
+"""LoRA adapter serving: sources, cache, placement, batched TPU compute.
+
+Reference parity: lib/llm/src/lora.rs — downloader/cache (adapter artifact
+management), routing (RendezvousHasher HRW placement + LoraRoutingTable),
+load_estimator (per-adapter demand → replica counts). The compute side is
+TPU-native instead of punica-style CUDA kernels: adapters are stacked on a
+leading axis and applied as batched einsums under jit (ops/lora.py), so one
+compiled step serves a continuous batch mixing adapters.
+"""
+
+from dynamo_tpu.lora.cache import LoRACache
+from dynamo_tpu.lora.load_estimator import LoadEstimator, LoadEstimatorConfig
+from dynamo_tpu.lora.loader import LoRAAdapter, load_lora_adapter
+from dynamo_tpu.lora.routing import (
+    LoraRoutingTable,
+    RendezvousHasher,
+)
+from dynamo_tpu.lora.source import LocalLoRASource, LoRASource
+
+__all__ = [
+    "LoRACache",
+    "LoRAAdapter",
+    "load_lora_adapter",
+    "LoadEstimator",
+    "LoadEstimatorConfig",
+    "LoraRoutingTable",
+    "RendezvousHasher",
+    "LoRASource",
+    "LocalLoRASource",
+]

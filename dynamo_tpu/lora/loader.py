@@ -1,0 +1,142 @@
+"""Load PEFT-format LoRA adapters into stacked-layer JAX pytrees.
+
+Reference parity: the reference hands adapter artifacts to vLLM and lets it
+ingest PEFT checkpoints; here the engine is ours, so the mapping from
+``base_model.model.model.layers.{i}.<module>.lora_{A,B}.weight`` to our
+scan-stacked layout lives here. Per target module the adapter becomes
+(A: [L, d_in, r], B: [L, r, d_out]) so ``lax.scan`` over layers consumes it
+alongside the base weights; layers the adapter doesn't touch get zeros
+(mathematically absent, shape-uniform for jit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+# PEFT module name → (our param name, in_dim attr, out_dim fn)
+_TARGET_MAP = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+@dataclass
+class LoRAAdapter:
+    name: str
+    rank: int
+    scaling: float  # lora_alpha / r
+    # our param name → (A [L, d_in, r], B [L, r, d_out])
+    weights: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = field(default_factory=dict)
+
+    @property
+    def targets(self) -> List[str]:
+        return sorted(self.weights)
+
+
+def _module_dims(config: ModelConfig, ours: str) -> Tuple[int, int]:
+    d, ff = config.d_model, config.d_ff
+    hd = config.head_dim_
+    dims = {
+        "wq": (d, config.n_heads * hd),
+        "wk": (d, config.n_kv_heads * hd),
+        "wv": (d, config.n_kv_heads * hd),
+        "wo": (config.n_heads * hd, d),
+        "w_gate": (d, ff),
+        "w_up": (d, ff),
+        "w_down": (ff, d),
+    }
+    return dims[ours]
+
+
+def load_lora_adapter(
+    adapter_dir: str, config: ModelConfig, *, name: Optional[str] = None
+) -> LoRAAdapter:
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    rank = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", rank))
+    adapter = LoRAAdapter(
+        name=name or os.path.basename(adapter_dir.rstrip("/")),
+        rank=rank,
+        scaling=alpha / rank,
+    )
+
+    from safetensors import safe_open
+
+    weights_path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    raw: Dict[str, np.ndarray] = {}
+    with safe_open(weights_path, framework="numpy") as f:
+        for key in f.keys():
+            raw[key] = f.get_tensor(key)
+
+    L = config.n_layers
+    # group by target module
+    per_target: Dict[str, Dict[int, Dict[str, np.ndarray]]] = {}
+    for key, tensor in raw.items():
+        # ...model.layers.{i}.self_attn.q_proj.lora_A.weight
+        parts = key.split(".")
+        try:
+            li = parts.index("layers")
+            layer = int(parts[li + 1])
+        except (ValueError, IndexError):
+            continue
+        module = next((p for p in parts if p in _TARGET_MAP), None)
+        ab = "A" if "lora_A" in key else "B" if "lora_B" in key else None
+        if module is None or ab is None:
+            continue
+        per_target.setdefault(module, {}).setdefault(layer, {})[ab] = tensor
+
+    for module, layers in per_target.items():
+        ours = _TARGET_MAP[module]
+        d_in, d_out = _module_dims(config, ours)
+        A = np.zeros((L, d_in, rank), dtype=np.float32)
+        B = np.zeros((L, rank, d_out), dtype=np.float32)
+        for layer, ab in layers.items():
+            if "A" in ab:
+                A[layer] = ab["A"].T.astype(np.float32)  # PEFT stores [r, d_in]
+            if "B" in ab:
+                B[layer] = ab["B"].T.astype(np.float32)  # PEFT stores [d_out, r]
+        adapter.weights[ours] = (
+            jnp.asarray(A, dtype=config.dtype),
+            jnp.asarray(B, dtype=config.dtype),
+        )
+    return adapter
+
+
+def stack_adapters(
+    adapters: List[LoRAAdapter], config: ModelConfig, targets: List[str]
+) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Stack N adapters (plus a zero 'no adapter' slot 0) per target:
+    A: [N+1, L, d_in, r_max], B: [N+1, L, r_max, d_out]. Scaling is folded
+    into B so the batched compute needs no per-adapter scalar."""
+    L = config.n_layers
+    r_max = max([a.rank for a in adapters], default=1)
+    out: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+    for target in targets:
+        d_in, d_out = _module_dims(config, target)
+        A = np.zeros((len(adapters) + 1, L, d_in, r_max), dtype=np.float32)
+        B = np.zeros((len(adapters) + 1, L, r_max, d_out), dtype=np.float32)
+        for i, a in enumerate(adapters, start=1):
+            if target not in a.weights:
+                continue
+            Aa, Ba = a.weights[target]
+            A[i, :, :, : a.rank] = np.asarray(Aa, dtype=np.float32)
+            B[i, :, : a.rank, :] = np.asarray(Ba, dtype=np.float32) * a.scaling
+        out[target] = (
+            jnp.asarray(A, dtype=config.dtype),
+            jnp.asarray(B, dtype=config.dtype),
+        )
+    return out

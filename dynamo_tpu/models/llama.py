@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
+from dynamo_tpu.ops.lora import lora_delta
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
 Params = Dict[str, Any]
@@ -137,11 +138,17 @@ def forward_paged(
     v_cache: jnp.ndarray,
     *,
     use_kernel: bool = False,
+    lora: Optional[Dict[str, Any]] = None,  # target → (A [L,N,d,r], B [L,N,r,h])
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32, 0 = no adapter
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
     v_cache). K/V for the chunk are scattered into the pools before attending,
     so the same function implements prefill (large C), chunked prefill
-    (start_pos > 0), and decode (C = 1)."""
+    (start_pos > 0), and decode (C = 1).
+
+    Multi-LoRA: ``lora`` carries layer-major stacked adapters (ops/lora.py);
+    each sequence's ``adapter_ids`` entry selects its adapter per einsum —
+    one compiled program for any adapter mix (punica-role, TPU-style)."""
     c = config
     B, C = tokens.shape
     hd = c.head_dim_
@@ -153,11 +160,11 @@ def forward_paged(
 
     def layer_fn(carry, xs):
         x = carry
-        lp, k_c, v_c = xs
+        lp, k_c, v_c, ll = xs
         h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
-        q = jnp.einsum("bcd,dh->bch", h, lp["wq"])
-        k = jnp.einsum("bcd,dh->bch", h, lp["wk"])
-        v = jnp.einsum("bcd,dh->bch", h, lp["wv"])
+        q = jnp.einsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
+        k = jnp.einsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
+        v = jnp.einsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
         if c.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -173,17 +180,27 @@ def forward_paged(
 
         attn = paged_attention(
             q, k_c, v_c, block_tables, start_pos, chunk_lens, use_kernel=use_kernel
-        )
-        x = x + attn.reshape(B, C, -1) @ lp["wo"]
+        ).reshape(B, C, -1)
+        x = x + attn @ lp["wo"] + lora_delta(ll, "wo", attn, adapter_ids)
 
         h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu(jnp.einsum("bcd,df->bcf", h, lp["w_gate"]))
-        up = jnp.einsum("bcd,df->bcf", h, lp["w_up"])
-        x = x + jnp.einsum("bcf,fd->bcd", gate * up, lp["w_down"])
+        gate = jax.nn.silu(
+            jnp.einsum("bcd,df->bcf", h, lp["w_gate"])
+            + lora_delta(ll, "w_gate", h, adapter_ids)
+        )
+        up = jnp.einsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
+            ll, "w_up", h, adapter_ids
+        )
+        gu = gate * up
+        x = (
+            x
+            + jnp.einsum("bcf,fd->bcd", gu, lp["w_down"])
+            + lora_delta(ll, "w_down", gu, adapter_ids)
+        )
         return x, (k_c, v_c)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache)
+        layer_fn, x, (params["layers"], k_cache, v_cache, lora or {})
     )
 
     x = _rms_norm(x, params["final_norm"], c.rms_norm_eps)
@@ -213,6 +230,8 @@ def decode_multi(
     *,
     num_steps: int,
     use_kernel: bool = False,
+    lora: Optional[Dict[str, Any]] = None,
+    adapter_ids: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
     single-token forward+sample steps). Minimizes host↔device round trips —
@@ -229,7 +248,7 @@ def decode_multi(
         toks, pos, k_c, v_c = carry
         logits, k_c, v_c = forward_paged(
             params, config, toks[:, None], pos, active, block_tables, k_c, v_c,
-            use_kernel=use_kernel,
+            use_kernel=use_kernel, lora=lora, adapter_ids=adapter_ids,
         )
         nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p)
         nxt = jnp.where(active > 0, nxt, toks)

@@ -1,0 +1,44 @@
+"""Batched multi-LoRA application under jit.
+
+The CUDA world does this with punica/SGMV kernels (grouped GEMM over
+per-request adapters); the TPU-native formulation is a gather + two batched
+einsums, which XLA fuses and tiles onto the MXU: every sequence in the
+continuous batch carries an adapter index (0 = no adapter, zero weights),
+adapters live stacked on a leading axis, and one compiled step serves any
+mix of adapters. Scaling (alpha/r) is pre-folded into B at stack time
+(lora/loader.py), so the hot path is exactly two einsums per target.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+# per-layer stacked adapter weights for one target module:
+#   A: [N_adapters+1, d_in, r],  B: [N_adapters+1, r, d_out]
+LoraLayer = Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def apply_lora(
+    x: jnp.ndarray,  # [B, C, d_in] (normed layer input / attn output)
+    ab: Tuple[jnp.ndarray, jnp.ndarray],
+    adapter_ids: jnp.ndarray,  # [B] int32, 0 = none
+) -> jnp.ndarray:
+    """x @ A[ids] @ B[ids] — the low-rank delta, [B, C, d_out]."""
+    A, B = ab
+    Ax = jnp.einsum("bcd,bdr->bcr", x, A[adapter_ids])
+    return jnp.einsum("bcr,brh->bch", Ax, B[adapter_ids])
+
+
+def lora_delta(
+    lora: Optional[LoraLayer],
+    target: str,
+    x: jnp.ndarray,
+    adapter_ids: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """Delta for ``target`` or 0.0 when the adapter set doesn't touch it
+    (compiles away entirely when lora is None/empty)."""
+    if not lora or target not in lora or adapter_ids is None:
+        return jnp.zeros((), dtype=x.dtype)
+    return apply_lora(x, lora[target], adapter_ids)

@@ -29,7 +29,7 @@ from dynamo_tpu.router.protocols import (
     load_topic,
 )
 from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
-from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -130,9 +130,16 @@ class KvRouter:
         self,
         token_ids: Sequence[int],
         candidates: Optional[Sequence[WorkerKey]] = None,
+        *,
+        lora_name: Optional[str] = None,
     ) -> Tuple[Optional[WorkerKey], int]:
-        """Returns (worker, overlap_blocks) — ref: kv_router.rs:501."""
-        hashes = compute_block_hashes(token_ids, self.block_size)
+        """Returns (worker, overlap_blocks) — ref: kv_router.rs:501.
+        ``lora_name`` salts the hash space the same way the engine does
+        (tokens/blocks.py adapter_salt) so overlap is only predicted against
+        same-adapter blocks."""
+        hashes = compute_block_hashes(
+            token_ids, self.block_size, salt=adapter_salt(lora_name)
+        )
         overlaps = self.indexer.find_matches(hashes)
         request_blocks = max(len(hashes), 1)
         worker = self.scheduler.select_worker(request_blocks, overlaps, candidates)
@@ -153,7 +160,14 @@ class KvRouter:
             if token_ids is None:
                 return None  # not a preprocessed request; fall back
             candidates = [(iid, 0) for iid in instances]
-            worker, overlap = self.find_best_match(token_ids, candidates)
+            lora = (
+                request.get("lora_name")
+                if isinstance(request, dict)
+                else getattr(request, "lora_name", None)
+            )
+            worker, overlap = self.find_best_match(
+                token_ids, candidates, lora_name=lora
+            )
             if worker is None:
                 return None
             n_blocks = max(len(token_ids) // self.block_size, 1)

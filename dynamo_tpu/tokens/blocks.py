@@ -54,3 +54,13 @@ def compute_block_hash_for_seq(
 ) -> List[int]:
     """Reference-named alias (kv_router.rs:50) for compute_block_hashes."""
     return compute_block_hashes(tokens, block_size, salt=salt)
+
+
+def adapter_salt(lora_name: Optional[str]) -> int:
+    """Hash-space salt for LoRA requests: K/V computed under an adapter are
+    not interchangeable with base-model K/V (wk/wv deltas), so the block
+    chain is salted per adapter — same prompt, different adapter, disjoint
+    hashes (the role vLLM's extra_keys plays in its prefix cache)."""
+    if not lora_name:
+        return 0
+    return xxhash.xxh3_64(lora_name.encode(), seed=0x10A).intdigest()

@@ -68,6 +68,9 @@ async def main() -> None:
     )
     parser.add_argument("--decode-steps", type=int, default=8,
                         help="fused decode iterations per device dispatch")
+    parser.add_argument("--lora-dir", default=None,
+                        help="directory of PEFT LoRA adapters to serve "
+                        "(ref: lib/llm/src/lora.rs)")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -107,6 +110,7 @@ async def main() -> None:
             prefill_chunk=args.prefill_chunk,
             enable_prefix_caching=not args.no_prefix_caching,
             decode_steps=args.decode_steps,
+            lora_dir=args.lora_dir,
         ),
         params,
         mesh=mesh,

@@ -1,0 +1,268 @@
+"""LoRA subsystem: sources/cache, HRW placement, load estimation, and the
+batched multi-adapter compute path through the real engine (VERDICT #8;
+ref: lib/llm/src/lora.rs + lora/{cache,routing,load_estimator}).
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.lora import (
+    LoadEstimator,
+    LoadEstimatorConfig,
+    LoRACache,
+    LocalLoRASource,
+    LoraRoutingTable,
+    RendezvousHasher,
+    load_lora_adapter,
+)
+from dynamo_tpu.lora.routing import LoraReplicaConfig
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+
+# ---------------------------------------------------------------------------
+# fixtures: PEFT-format adapters on disk
+# ---------------------------------------------------------------------------
+
+CONFIG = tiny_config()
+
+
+def write_adapter(root, name: str, *, rank=4, alpha=8.0, seed=0, targets=("q_proj", "v_proj")):
+    """A real PEFT-format adapter dir: adapter_config.json + safetensors."""
+    from safetensors.numpy import save_file
+
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "adapter_config.json"), "w") as f:
+        json.dump(
+            {"r": rank, "lora_alpha": alpha, "target_modules": list(targets)}, f
+        )
+    rng = np.random.default_rng(seed)
+    hd = CONFIG.head_dim_
+    dims = {
+        "q_proj": (CONFIG.d_model, CONFIG.n_heads * hd),
+        "v_proj": (CONFIG.d_model, CONFIG.n_kv_heads * hd),
+        "gate_proj": (CONFIG.d_model, CONFIG.d_ff),
+    }
+    tensors = {}
+    for layer in range(CONFIG.n_layers):
+        for t in targets:
+            d_in, d_out = dims[t]
+            prefix = f"base_model.model.model.layers.{layer}.self_attn.{t}"
+            if t == "gate_proj":
+                prefix = f"base_model.model.model.layers.{layer}.mlp.{t}"
+            # PEFT layout: lora_A [r, d_in], lora_B [d_out, r]
+            tensors[f"{prefix}.lora_A.weight"] = (
+                rng.standard_normal((rank, d_in)).astype(np.float32) * 0.3
+            )
+            tensors[f"{prefix}.lora_B.weight"] = (
+                rng.standard_normal((d_out, rank)).astype(np.float32) * 0.3
+            )
+    save_file(tensors, os.path.join(d, "adapter_model.safetensors"))
+    return d
+
+
+@pytest.fixture
+def lora_root(tmp_path):
+    root = str(tmp_path / "adapters")
+    write_adapter(root, "adapter-a", seed=1)
+    write_adapter(root, "adapter-b", seed=2, rank=2, alpha=4.0)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# routing / cache / estimator units
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    WORKERS = [(10, 0), (11, 0), (12, 0), (13, 1)]
+
+    def test_hrw_deterministic_and_distinct(self):
+        r1 = RendezvousHasher.rank_workers("adapter-a", self.WORKERS)
+        r2 = RendezvousHasher.rank_workers("adapter-a", self.WORKERS)
+        assert r1 == r2
+        assert set(r1) == set(self.WORKERS)
+
+    def test_hrw_minimal_disruption(self):
+        """Removing a worker only moves adapters placed on it."""
+        names = [f"lora-{i}" for i in range(40)]
+        before = {n: RendezvousHasher.allocate(n, self.WORKERS, 1)[0] for n in names}
+        shrunk = [w for w in self.WORKERS if w != (11, 0)]
+        after = {n: RendezvousHasher.allocate(n, shrunk, 1)[0] for n in names}
+        for n in names:
+            if before[n] != (11, 0):
+                assert after[n] == before[n]
+
+    def test_table_reallocate(self):
+        table = LoraRoutingTable()
+        table.update_allocation("a", LoraReplicaConfig(n_desired=2))
+        table.update_allocation("b", LoraReplicaConfig(n_desired=1))
+        table.reallocate(self.WORKERS)
+        assert len(table.get_replica_set("a")) == 2
+        assert len(table.get_replica_set("b")) == 1
+        assert table.list_loras() == ["a", "b"]
+        table.reallocate(self.WORKERS, desired={"b": 3})
+        assert len(table.get_replica_set("b")) == 3
+        assert table.remove_lora("a") is not None
+        assert table.get_replica_set("a") is None
+
+
+class TestCacheAndSource:
+    def test_local_source_and_cache(self, lora_root):
+        source = LocalLoRASource(lora_root)
+        assert source.list_adapters() == ["adapter-a", "adapter-b"]
+        cache = LoRACache(source, max_adapters=1)
+        p = cache.get("adapter-a")
+        assert os.path.exists(os.path.join(p, "adapter_config.json"))
+        assert cache.get("adapter-a") == p  # hit
+        assert cache.stats()["hits"] == 1
+        cache.get("adapter-b")  # evicts adapter-a (max_adapters=1)
+        assert cache.list_cached() == ["adapter-b"]
+        with pytest.raises(FileNotFoundError):
+            cache.get("ghost")
+
+
+class TestLoadEstimator:
+    def test_desired_replicas_track_peak(self):
+        est = LoadEstimator(LoadEstimatorConfig(per_replica_capacity=2.0))
+        for _ in range(5):
+            est.increment("a")
+        est.increment("b")
+        assert est.current_load() == {"a": 5, "b": 1}
+        want = est.desired_replicas()
+        assert want["a"] == 3  # ceil(5/2)
+        assert want["b"] == 1
+        for _ in range(5):
+            est.decrement("a")
+        assert "a" not in est.current_load()
+        # peak-window sizing still remembers the burst
+        assert est.desired_replicas()["a"] == 3
+
+
+# ---------------------------------------------------------------------------
+# compute: adapters through the real engine
+# ---------------------------------------------------------------------------
+
+
+def make_engine(lora_root):
+    return JaxEngine(
+        JaxEngineArgs(
+            config=CONFIG, block_size=4, num_kv_blocks=128, max_num_seqs=4,
+            max_model_len=128, prefill_chunk=32, lora_dir=lora_root,
+        )
+    )
+
+
+def req(tokens, lora_name=None, max_tokens=6, rid="r"):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        request_id=rid,
+        lora_name=lora_name,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+
+
+async def run_one(engine, request):
+    outs = await collect(engine.generate(request, Context()))
+    errs = [o.error for o in outs if o.error]
+    assert not errs, errs
+    return [t for o in outs for t in o.token_ids]
+
+
+def test_loader_shapes(lora_root):
+    a = load_lora_adapter(os.path.join(lora_root, "adapter-a"), CONFIG)
+    assert a.rank == 4 and a.scaling == pytest.approx(2.0)
+    A, B = a.weights["wq"]
+    hd = CONFIG.head_dim_
+    assert A.shape == (CONFIG.n_layers, CONFIG.d_model, 4)
+    assert B.shape == (CONFIG.n_layers, 4, CONFIG.n_heads * hd)
+
+
+async def test_adapter_changes_output_and_base_unchanged(lora_root):
+    engine = make_engine(lora_root)
+    prompt = list(range(20, 34))
+    try:
+        base = await run_one(engine, req(prompt))
+        tuned = await run_one(engine, req(prompt, lora_name="adapter-a"))
+        assert base != tuned  # the adapter actually steers generation
+        base2 = await run_one(engine, req(prompt))
+        assert base2 == base  # no-adapter slot stays pristine
+    finally:
+        await engine.stop()
+
+
+async def test_lora_matches_merged_weights(lora_root):
+    """Batched low-rank path == explicitly merged dense weights (the
+    correctness oracle for the punica-role einsums)."""
+    from dynamo_tpu.models import llama
+
+    adapter = load_lora_adapter(os.path.join(lora_root, "adapter-a"), CONFIG)
+    engine = make_engine(lora_root)
+    prompt = list(range(40, 52))
+    try:
+        tuned = await run_one(engine, req(prompt, lora_name="adapter-a"))
+    finally:
+        await engine.stop()
+
+    # merge: W' = W + A @ B * scaling, per layer
+    merged_engine = JaxEngine(
+        JaxEngineArgs(
+            config=CONFIG, block_size=4, num_kv_blocks=128, max_num_seqs=4,
+            max_model_len=128, prefill_chunk=32,
+        )
+    )
+    params = merged_engine.params
+    for target, (A, B) in adapter.weights.items():
+        delta = jnp.einsum("ldr,lrh->ldh", A, B) * adapter.scaling
+        params["layers"][target] = params["layers"][target] + delta
+    try:
+        merged = await run_one(merged_engine, req(prompt))
+    finally:
+        await merged_engine.stop()
+    assert tuned == merged
+
+
+async def test_two_adapters_batched_concurrently(lora_root):
+    """Concurrent requests on different adapters in ONE continuous batch
+    produce the same tokens as each adapter running alone."""
+    engine = make_engine(lora_root)
+    p1 = list(range(10, 24))
+    p2 = list(range(60, 72))
+    try:
+        solo_a = await run_one(engine, req(p1, lora_name="adapter-a", rid="a"))
+        solo_b = await run_one(engine, req(p2, lora_name="adapter-b", rid="b"))
+        both = await asyncio.gather(
+            run_one(engine, req(p1, lora_name="adapter-a", rid="a2")),
+            run_one(engine, req(p2, lora_name="adapter-b", rid="b2")),
+        )
+        assert both[0] == solo_a
+        assert both[1] == solo_b
+    finally:
+        await engine.stop()
+
+
+async def test_unknown_adapter_rejected(lora_root):
+    engine = make_engine(lora_root)
+    try:
+        outs = await collect(
+            engine.generate(req([1, 2, 3], lora_name="ghost"), Context())
+        )
+        assert outs[-1].finish_reason == FinishReason.ERROR
+        assert "unknown LoRA adapter" in outs[-1].error
+    finally:
+        await engine.stop()
