@@ -30,6 +30,13 @@ class ModelConfig:
     max_position_embeddings: int = 8192
     qkv_bias: bool = False  # Qwen2-style
     tie_word_embeddings: bool = False
+    # MoE knobs (0 experts = dense). Covers Mixtral/Qwen-MoE/DeepSeek-lite
+    # shapes: every layer's FFN becomes top-k routed experts (ops/moe.py).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    moe_d_ff: Optional[int] = None  # expert hidden dim (default: d_ff)
+    norm_topk_prob: bool = True
+    moe_capacity_factor: float = 2.0
     eos_token_ids: List[int] = field(default_factory=list)
     bos_token_id: Optional[int] = None
     dtype: Any = jnp.bfloat16
@@ -43,6 +50,14 @@ class ModelConfig:
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
 
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
+    def moe_d_ff_(self) -> int:
+        return self.moe_d_ff if self.moe_d_ff is not None else self.d_ff
+
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any], name: str = "") -> "ModelConfig":
         archs = cfg.get("architectures") or [""]
@@ -54,6 +69,9 @@ class ModelConfig:
             eos_ids = [int(e) for e in eos]
         else:
             eos_ids = [int(eos)]
+        # MoE fields across HF dialects: Mixtral (num_local_experts),
+        # Qwen-MoE (num_experts + moe_intermediate_size + norm_topk_prob)
+        n_experts = cfg.get("num_local_experts") or cfg.get("num_experts") or 0
         return cls(
             vocab_size=cfg["vocab_size"],
             d_model=cfg["hidden_size"],
@@ -62,6 +80,10 @@ class ModelConfig:
             n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
             head_dim=cfg.get("head_dim"),
             d_ff=cfg["intermediate_size"],
+            n_experts=int(n_experts),
+            n_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),
+            moe_d_ff=cfg.get("moe_intermediate_size"),
+            norm_topk_prob=bool(cfg.get("norm_topk_prob", True)),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             rope_theta=cfg.get("rope_theta", 10000.0),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
@@ -94,6 +116,35 @@ def tiny_config(**overrides) -> ModelConfig:
     )
     base.update(overrides)
     return ModelConfig(**base)
+
+
+def tiny_moe_config(**overrides) -> ModelConfig:
+    base = dict(
+        n_experts=4,
+        n_experts_per_tok=2,
+        moe_d_ff=128,
+        name="tiny-moe",
+    )
+    base.update(overrides)
+    return tiny_config(**base)
+
+
+def mixtral_8x7b_config() -> ModelConfig:
+    """Mixtral-8x7B shape (BASELINE MoE class; ref: recipes/ MoE configs)."""
+    return ModelConfig(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        n_experts=8,
+        n_experts_per_tok=2,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        eos_token_ids=[2],
+        name="mixtral-8x7b",
+    )
 
 
 def qwen2_500m_config() -> ModelConfig:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
 from dynamo_tpu.ops.lora import lora_delta
+from dynamo_tpu.ops.moe import moe_ffn
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
 Params = Dict[str, Any]
@@ -54,10 +55,18 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         "wv": norm(keys[2], (L, d, KH * hd), s_d),
         "wo": norm(keys[3], (L, H * hd, d), (H * hd) ** -0.5),
         "mlp_norm": jnp.ones((L, d), dtype=c.dtype),
-        "w_gate": norm(keys[4], (L, d, ff), s_d),
-        "w_up": norm(keys[5], (L, d, ff), s_d),
-        "w_down": norm(keys[6], (L, ff, d), s_ff),
     }
+    if c.is_moe:
+        E, eff = c.n_experts, c.moe_d_ff_
+        s_eff = eff**-0.5
+        layers["router_w"] = norm(keys[9], (L, d, E), s_d)
+        layers["we_gate"] = norm(keys[4], (L, E, d, eff), s_d)
+        layers["we_up"] = norm(keys[5], (L, E, d, eff), s_d)
+        layers["we_down"] = norm(keys[6], (L, E, eff, d), s_eff)
+    else:
+        layers["w_gate"] = norm(keys[4], (L, d, ff), s_d)
+        layers["w_up"] = norm(keys[5], (L, d, ff), s_d)
+        layers["w_down"] = norm(keys[6], (L, ff, d), s_ff)
     if c.qkv_bias:
         layers["bq"] = jnp.zeros((L, H * hd), dtype=c.dtype)
         layers["bk"] = jnp.zeros((L, KH * hd), dtype=c.dtype)
@@ -81,10 +90,16 @@ def param_logical_axes(config: ModelConfig) -> Params:
         "wv": ("layers", "embed", "kv_heads"),
         "wo": ("layers", "heads", "embed"),
         "mlp_norm": ("layers", "embed"),
-        "w_gate": ("layers", "embed", "ffn"),
-        "w_up": ("layers", "embed", "ffn"),
-        "w_down": ("layers", "ffn", "embed"),
     }
+    if config.is_moe:
+        layers["router_w"] = ("layers", "embed", None)
+        layers["we_gate"] = ("layers", "experts", "embed", "ffn")
+        layers["we_up"] = ("layers", "experts", "embed", "ffn")
+        layers["we_down"] = ("layers", "experts", "ffn", "embed")
+    else:
+        layers["w_gate"] = ("layers", "embed", "ffn")
+        layers["w_up"] = ("layers", "embed", "ffn")
+        layers["w_down"] = ("layers", "ffn", "embed")
     if config.qkv_bias:
         layers["bq"] = ("layers", "heads")
         layers["bk"] = ("layers", "kv_heads")
@@ -184,19 +199,27 @@ def forward_paged(
         x = x + attn @ lp["wo"] + lora_delta(ll, "wo", attn, adapter_ids)
 
         h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu(
-            jnp.einsum("bcd,df->bcf", h, lp["w_gate"])
-            + lora_delta(ll, "w_gate", h, adapter_ids)
-        )
-        up = jnp.einsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
-            ll, "w_up", h, adapter_ids
-        )
-        gu = gate * up
-        x = (
-            x
-            + jnp.einsum("bcf,fd->bcd", gu, lp["w_down"])
-            + lora_delta(ll, "w_down", gu, adapter_ids)
-        )
+        if c.is_moe:
+            x = x + moe_ffn(
+                h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
+                top_k=c.n_experts_per_tok,
+                capacity_factor=c.moe_capacity_factor,
+                norm_topk_prob=c.norm_topk_prob,
+            )
+        else:
+            gate = jax.nn.silu(
+                jnp.einsum("bcd,df->bcf", h, lp["w_gate"])
+                + lora_delta(ll, "w_gate", h, adapter_ids)
+            )
+            up = jnp.einsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
+                ll, "w_up", h, adapter_ids
+            )
+            gu = gate * up
+            x = (
+                x
+                + jnp.einsum("bcf,fd->bcd", gu, lp["w_down"])
+                + lora_delta(ll, "w_down", gu, adapter_ids)
+            )
         return x, (k_c, v_c)
 
     x, (k_cache, v_cache) = jax.lax.scan(

@@ -1,0 +1,170 @@
+"""MoE: routing math, expert-parallel sharding, engine serving (VERDICT #9;
+ref: the reference's MoE model class, recipes/deepseek-r1 + Qwen3-MoE —
+here GShard-style einsum dispatch, ops/moe.py)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig, tiny_moe_config
+from dynamo_tpu.ops.moe import moe_capacity, moe_ffn
+from dynamo_tpu.parallel import MeshConfig, ShardingRules, make_mesh, shard_params
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+
+
+def reference_moe(x, router_w, we_gate, we_up, we_down, top_k, norm_topk):
+    """Per-token loop oracle (no capacity drops)."""
+    B, C, d = x.shape
+    E = router_w.shape[-1]
+    out = np.zeros((B, C, d), dtype=np.float64)
+    probs = np.asarray(jax.nn.softmax(x.astype(jnp.float32) @ router_w, axis=-1))
+    for b in range(B):
+        for c in range(C):
+            order = np.argsort(-probs[b, c])[:top_k]
+            w = probs[b, c, order]
+            if norm_topk:
+                w = w / w.sum()
+            for e, we in zip(order, w):
+                h = np.asarray(x[b, c], dtype=np.float64)
+                gate = np.asarray(jax.nn.silu(jnp.asarray(h @ np.asarray(we_gate[e], dtype=np.float64))))
+                up = h @ np.asarray(we_up[e], dtype=np.float64)
+                out[b, c] += we * ((gate * up) @ np.asarray(we_down[e], dtype=np.float64))
+    return out
+
+
+def test_moe_ffn_matches_reference_loop():
+    rng = np.random.default_rng(0)
+    B, C, d, E, f, K = 2, 3, 8, 4, 16, 2
+    x = jnp.asarray(rng.standard_normal((B, C, d)), dtype=jnp.float32)
+    router_w = jnp.asarray(rng.standard_normal((d, E)), dtype=jnp.float32)
+    we_gate = jnp.asarray(rng.standard_normal((E, d, f)) * 0.2, dtype=jnp.float32)
+    we_up = jnp.asarray(rng.standard_normal((E, d, f)) * 0.2, dtype=jnp.float32)
+    we_down = jnp.asarray(rng.standard_normal((E, f, d)) * 0.2, dtype=jnp.float32)
+    # generous capacity: no drops, so the loop oracle applies exactly
+    y = moe_ffn(
+        x, router_w, we_gate, we_up, we_down, top_k=K, capacity=B * C,
+    )
+    ref = reference_moe(x, router_w, we_gate, we_up, we_down, K, True)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_capacity_drops_tokens_not_crash():
+    """With capacity 1 most assignments drop; output stays finite and
+    dropped tokens contribute zero (residual path carries them)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((1, 8, 8)), dtype=jnp.float32)
+    router_w = jnp.zeros((8, 2), dtype=jnp.float32)  # all tokens tie → expert 0
+    we = jnp.asarray(rng.standard_normal((2, 8, 8)) * 0.2, dtype=jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((2, 8, 8)) * 0.2, dtype=jnp.float32)
+    y = moe_ffn(x, router_w, we, we, wd, top_k=1, capacity=1)
+    arr = np.asarray(y)
+    assert np.isfinite(arr).all()
+    nonzero_tokens = (np.abs(arr[0]).max(axis=-1) > 1e-9).sum()
+    assert nonzero_tokens == 1  # only the first assignment fit
+
+
+def test_moe_capacity_formula():
+    assert moe_capacity(64, 8, 2, 2.0) == 32
+    assert moe_capacity(1, 8, 1, 1.0) == 1
+
+
+def test_moe_forward_ep_sharded_matches_unsharded():
+    cfg = tiny_moe_config()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    k, v = llama.init_kv_cache(cfg, 16, 4)
+    toks = jnp.asarray([[5, 6, 7, 8], [9, 10, 11, 12]], dtype=jnp.int32)
+    table = jnp.asarray(np.arange(16, dtype=np.int32).reshape(2, 8))
+    start = jnp.zeros(2, jnp.int32)
+    lens = jnp.full((2,), 4, jnp.int32)
+
+    base, _, _ = llama.forward_paged(params, cfg, toks, start, lens, table, k, v)
+
+    mesh = make_mesh(MeshConfig(ep=2, tp=2, dp=2))
+    rules = ShardingRules()
+    sp = shard_params(params, llama.param_logical_axes(cfg), rules, mesh)
+    k2 = jax.device_put(k, rules.sharding(mesh, *llama.kv_cache_logical_axes()))
+    v2 = jax.device_put(v, rules.sharding(mesh, *llama.kv_cache_logical_axes()))
+    sharded, _, _ = jax.jit(
+        lambda p, kc, vc: llama.forward_paged(
+            p, cfg, toks, start, lens, table, kc, vc
+        )
+    )(sp, k2, v2)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(sharded), rtol=2e-4, atol=2e-4
+    )
+
+
+async def test_engine_serves_moe_model():
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=tiny_moe_config(), block_size=4, num_kv_blocks=64,
+            max_num_seqs=4, max_model_len=128, prefill_chunk=32,
+        )
+    )
+
+    def req(tokens, rid):
+        return PreprocessedRequest(
+            token_ids=list(tokens), request_id=rid,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=5, ignore_eos=True),
+        )
+
+    try:
+        solo = await collect(engine.generate(req(range(10, 22), "a"), Context()))
+        toks_solo = [t for o in solo for t in o.token_ids]
+        assert len(toks_solo) == 5
+        outs = await asyncio.gather(
+            *(
+                collect(engine.generate(req(range(5 + i, 17 + i), f"r{i}"), Context()))
+                for i in range(3)
+            )
+        )
+        for out in outs:
+            assert not any(o.error for o in out)
+            assert len([t for o in out for t in o.token_ids]) == 5
+    finally:
+        await engine.stop()
+
+
+def test_hf_config_ingestion_moe():
+    cfg = ModelConfig.from_hf_config(
+        {
+            "architectures": ["Qwen3MoeForCausalLM"],
+            "vocab_size": 1024,
+            "hidden_size": 64,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "intermediate_size": 128,
+            "num_experts": 8,
+            "num_experts_per_tok": 2,
+            "moe_intermediate_size": 32,
+            "norm_topk_prob": True,
+            "eos_token_id": 3,
+        }
+    )
+    assert cfg.is_moe and cfg.n_experts == 8 and cfg.moe_d_ff_ == 32
+    mix = ModelConfig.from_hf_config(
+        {
+            "architectures": ["MixtralForCausalLM"],
+            "vocab_size": 1024,
+            "hidden_size": 64,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "intermediate_size": 128,
+            "num_local_experts": 8,
+            "num_experts_per_tok": 2,
+        }
+    )
+    assert mix.n_experts == 8
