@@ -95,6 +95,7 @@ class _Sequence:
     next_token: int = 0  # decode input token
     logprob_pending: Optional[float] = None
     admission_failures: int = 0  # deterministic per-request errors (poisoned)
+    hash_salt: int = 0  # adapter ⊕ multimodal content salt (prefix cache)
 
 
 def _next_pow2(n: int) -> int:
@@ -230,11 +231,13 @@ class JaxEngine:
         use_kernel = self._use_kernel
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, rng, temp, topk, topp, adapter_ids):
+                 block_tables, rng, temp, topk, topp, adapter_ids,
+                 mm_embeds, mm_slot):
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
+                mm_embeds=mm_embeds, mm_slot=mm_slot,
             )
             toks = sample_tokens(logits, rng, temp, topk, topp)
             logp = compute_logprobs(logits, toks)
@@ -276,7 +279,7 @@ class JaxEngine:
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
-        adapter_ids,
+        adapter_ids, mm_embeds=None, mm_slot=None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Execute one step on the device thread (blocking). Caller passes
         numpy inputs; returns (sampled tokens, logprobs) as numpy."""
@@ -287,6 +290,8 @@ class JaxEngine:
             jnp.asarray(block_tables), sub,
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
             jnp.asarray(adapter_ids),
+            None if mm_embeds is None else jnp.asarray(mm_embeds),
+            None if mm_slot is None else jnp.asarray(mm_slot),
         )
         return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
@@ -518,15 +523,38 @@ class JaxEngine:
         prompt = seq.all_tokens  # includes regenerated tokens after preemption
         n_blocks_prompt = math.ceil(len(prompt) / args.block_size)
 
+        # Multimodal splice inputs (multimodal/handlers.py): packed patch
+        # embeddings + a prompt-position → embedding-row map.
+        mm_embeds: Optional[np.ndarray] = None
+        mm_slot_of: Optional[np.ndarray] = None
+        mm = seq.request.extra or {}
+        if "mm_embeds" in mm:
+            from dynamo_tpu.disagg.handlers import unpack_array
+
+            mm_embeds = unpack_array(mm["mm_embeds"]).astype(np.float32)
+            per_image = int(mm.get("mm_tokens_per_image", 0))
+            mm_slot_of = np.full(len(prompt), -1, dtype=np.int32)
+            row = 0
+            for start in mm.get("mm_positions", []):
+                for j in range(per_image):
+                    if start + j < len(prompt):
+                        mm_slot_of[start + j] = row
+                    row += 1
+
+        # Salted hashing: adapter ⊕ image content — neither LoRA K/V nor
+        # image-conditioned K/V may cross-pollinate the base prefix cache.
+        seq.hash_salt = adapter_salt(seq.request.lora_name)
+        if mm_embeds is not None:
+            import xxhash
+
+            seq.hash_salt ^= xxhash.xxh3_64(mm_embeds.tobytes()).intdigest()
+
         hashes: List[int] = []
         matched = 0
         ids: List[int] = []
         if args.enable_prefix_caching:
-            # Adapter-salted: LoRA K/V is not interchangeable with base K/V
-            # (tokens/blocks.py adapter_salt).
             hashes = compute_block_hashes(
-                prompt, args.block_size,
-                salt=adapter_salt(seq.request.lora_name),
+                prompt, args.block_size, salt=seq.hash_salt
             )
             # Onboard from the lower tiers (G2/G3) anything that extends the
             # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
@@ -579,6 +607,10 @@ class JaxEngine:
             c_bucket = min(_next_pow2(len(chunk)), args.prefill_chunk)
             tok_arr = np.zeros((1, c_bucket), dtype=np.int32)
             tok_arr[0, : len(chunk)] = chunk
+            mm_slot_chunk = None
+            if mm_slot_of is not None:
+                mm_slot_chunk = np.full((1, c_bucket), -1, dtype=np.int32)
+                mm_slot_chunk[0, : len(chunk)] = mm_slot_of[pos : pos + len(chunk)]
             toks, logps = await self._device(
                 self._run_step,
                 tok_arr,
@@ -586,6 +618,7 @@ class JaxEngine:
                 np.array([len(chunk)], dtype=np.int32),
                 table[:, :nb_bucket],
                 p_temp, p_topk, p_topp, p_adapter,
+                mm_embeds, mm_slot_chunk,
             )
             self.prefill_tokens += len(chunk)
             pos += len(chunk)
@@ -708,7 +741,7 @@ class JaxEngine:
                 seq.all_tokens[bi * args.block_size : (bi + 1) * args.block_size],
                 args.block_size,
                 parent_hash=parent,
-                salt=adapter_salt(seq.request.lora_name),
+                salt=seq.hash_salt,
             )[0]
             self.pool.commit(seq.block_ids[bi], h, parent)
             seq.block_hashes.append(h)

@@ -51,9 +51,18 @@ class OpenAIPreprocessor:
 
     def preprocess(self, request: Union[Dict[str, Any], ParsedRequest]) -> PreprocessedRequest:
         parsed = self._parse(request)
+        media_urls: list = []
         if parsed.kind == "chat":
+            messages = parsed.messages
+            if any(isinstance(m.get("content"), list) for m in messages):
+                # Content-parts form: extract image URLs for the encode
+                # stage (ref: preprocessor/media extraction); the template
+                # renders the text-only rewrite.
+                from dynamo_tpu.multimodal.handlers import extract_image_parts
+
+                messages, media_urls = extract_image_parts(messages)
             prompt = self.chat_template.render(
-                parsed.messages,
+                messages,
                 add_generation_prompt=True,
                 tools=parsed.tools,
             )
@@ -92,6 +101,8 @@ class OpenAIPreprocessor:
         )
         if ANNOTATION_FORMATTED_PROMPT in parsed.annotations:
             pre.extra[ANNOTATION_FORMATTED_PROMPT] = prompt
+        if media_urls:
+            pre.extra["_mm_media"] = media_urls
         return pre
 
     def _parse(self, request: Union[Dict[str, Any], ParsedRequest]) -> ParsedRequest:

@@ -155,6 +155,8 @@ def forward_paged(
     use_kernel: bool = False,
     lora: Optional[Dict[str, Any]] = None,  # target → (A [L,N,d,r], B [L,N,r,h])
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32, 0 = no adapter
+    mm_embeds: Optional[jnp.ndarray] = None,  # [M, d] image patch embeddings
+    mm_slot: Optional[jnp.ndarray] = None,  # [B, C] int32 row into mm_embeds, -1=text
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
     v_cache). K/V for the chunk are scattered into the pools before attending,
@@ -169,6 +171,11 @@ def forward_paged(
     hd = c.head_dim_
 
     x = params["embed"][tokens]  # [B, C, d]
+    if mm_embeds is not None and mm_slot is not None:
+        # Multimodal splice: placeholder positions take precomputed image
+        # embeddings instead of the token table (multimodal/handlers.py).
+        rows = mm_embeds[jnp.clip(mm_slot, 0, mm_embeds.shape[0] - 1)]
+        x = jnp.where((mm_slot >= 0)[..., None], rows.astype(x.dtype), x)
 
     pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]

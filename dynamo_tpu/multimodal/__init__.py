@@ -1,0 +1,29 @@
+"""Multimodal serving: media decode, vision encode workers, E/P/D flow.
+
+Reference parity: lib/llm/src/preprocessor/media/ (fetch+decode) and
+components/src/dynamo/vllm/multimodal_handlers/ (EncodeWorkerHandler →
+PD workers consuming precomputed embeddings). TPU-native: the vision
+encoder is a jitted ViT (patch-embed matmul + small transformer) and
+image embeddings splice into the LLM prefill via an embedding-override
+path in forward_paged — no torch, no CUDA preprocessing.
+"""
+
+from dynamo_tpu.multimodal.encoder import (
+    VisionEncoderConfig,
+    encode_images,
+    init_vision_params,
+)
+from dynamo_tpu.multimodal.handlers import (
+    EncodeWorkerHandler,
+    MultimodalPreprocessor,
+)
+from dynamo_tpu.multimodal.media import fetch_media
+
+__all__ = [
+    "VisionEncoderConfig",
+    "encode_images",
+    "init_vision_params",
+    "EncodeWorkerHandler",
+    "MultimodalPreprocessor",
+    "fetch_media",
+]

@@ -1,0 +1,173 @@
+"""E/P/D staged multimodal flow.
+
+Reference parity: components/src/dynamo/vllm/multimodal_handlers/
+(EncodeWorkerHandler :52 — vision tower as its own component;
+PreprocessedHandler/worker_handler — P/D workers consuming precomputed
+embeddings instead of raw media). The flow here:
+
+  frontend → MultimodalPreprocessor operator
+      extracts image parts from chat content,
+      calls the encode component (EncodeWorkerHandler) over the runtime,
+      replaces each image with `n_patches` placeholder tokens and attaches
+      packed embeddings + positions to PreprocessedRequest.extra
+  → P/D workers: JaxEngine splices the embeddings over the placeholder
+      positions during prefill (models/llama.py embedding override).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dynamo_tpu.disagg.handlers import pack_array, unpack_array
+from dynamo_tpu.multimodal.encoder import (
+    VisionEncoderConfig,
+    encode_images,
+    init_vision_params,
+)
+from dynamo_tpu.multimodal.media import MediaError, fetch_media
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Placeholder token id spliced into prompts where image embeddings land.
+# Real VLM checkpoints define their own (e.g. <image>); engines only ever
+# see positions, so any in-vocab id works for random-init serving.
+DEFAULT_IMAGE_TOKEN_ID = 0
+
+
+class EncodeWorkerHandler:
+    """The E stage: media URLs in, packed embeddings out.
+
+    Serves ``{"media": [url, ...]}`` → one response
+    ``{"embeddings": packed [N, n_patches, out_dim], "n_tokens": int}``.
+    """
+
+    def __init__(
+        self,
+        config: Optional[VisionEncoderConfig] = None,
+        *,
+        params: Optional[Any] = None,
+        seed: int = 0,
+    ) -> None:
+        self.config = config or VisionEncoderConfig()
+        self.params = (
+            params
+            if params is not None
+            else init_vision_params(self.config, jax.random.PRNGKey(seed))
+        )
+        self.encoded_images = 0
+
+    async def generate(self, request: Any, context: Any) -> AsyncIterator[Dict[str, Any]]:
+        urls: List[str] = list(request.get("media", []))
+        if not urls:
+            yield {"error": "no media in request"}
+            return
+        try:
+            images = np.stack(
+                [fetch_media(u, image_size=self.config.image_size) for u in urls]
+            )
+        except MediaError as exc:
+            yield {"error": str(exc)}
+            return
+        embeds = encode_images(self.params, images, self.config)
+        self.encoded_images += len(urls)
+        yield {
+            "embeddings": pack_array(np.asarray(embeds, dtype=np.float32)),
+            "n_tokens": self.config.n_patches,
+        }
+
+
+def extract_image_parts(messages: List[Dict[str, Any]]) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Split OpenAI chat messages into (text-only messages, image URLs).
+
+    Handles the standard content-parts form:
+    ``{"type": "image_url", "image_url": {"url": ...}}`` mixed with text
+    parts (ref: preprocessor media extraction).
+    """
+    urls: List[str] = []
+    out: List[Dict[str, Any]] = []
+    for msg in messages:
+        content = msg.get("content")
+        if not isinstance(content, list):
+            out.append(msg)
+            continue
+        texts: List[str] = []
+        for part in content:
+            kind = part.get("type")
+            if kind == "image_url":
+                url = (part.get("image_url") or {}).get("url", "")
+                urls.append(url)
+                texts.append("<image>")
+            elif kind == "text":
+                texts.append(part.get("text", ""))
+        out.append({**msg, "content": " ".join(texts)})
+    return out, urls
+
+
+class MultimodalPreprocessor:
+    """Pipeline operator in front of OpenAIPreprocessor's output: encodes
+    images via the encode component and splices placeholders + embeddings
+    into the preprocessed request (the ECProcessor role)."""
+
+    def __init__(
+        self,
+        encode_client_factory,  # async () -> Client for the encode endpoint
+        *,
+        image_token_id: int = DEFAULT_IMAGE_TOKEN_ID,
+    ) -> None:
+        self._factory = encode_client_factory
+        self._client = None
+        self.image_token_id = image_token_id
+
+    async def _encode(self, urls: List[str]) -> Tuple[np.ndarray, int]:
+        if self._client is None:
+            self._client = await self._factory()
+        result: Optional[Dict[str, Any]] = None
+        async for item in self._client.generate({"media": urls}):
+            result = item
+        if not result or result.get("error"):
+            raise RuntimeError(
+                f"encode worker failed: {(result or {}).get('error', 'no response')}"
+            )
+        return unpack_array(result["embeddings"]), int(result["n_tokens"])
+
+    async def generate(self, request: Any, context: Any, next: Any):
+        """Operator protocol: enrich, then delegate downstream. Sits after
+        the OpenAIPreprocessor (which extracts media URLs into extra)."""
+        if isinstance(request, dict):
+            urls = request.pop("_mm_media", None) or (
+                request.get("extra", {}).pop("_mm_media", None)
+            )
+        else:
+            urls = request.extra.pop("_mm_media", None) if request.extra else None
+        if urls:
+            embeds, n_tokens = await self._encode(list(urls))
+            token_ids = (
+                request["token_ids"] if isinstance(request, dict) else request.token_ids
+            )
+            # Append one placeholder run per image ahead of the text prompt
+            # (simplest canonical layout; real VLM templates position them).
+            positions = []
+            prefix: List[int] = []
+            for i in range(embeds.shape[0]):
+                positions.append(len(prefix))
+                prefix.extend([self.image_token_id] * n_tokens)
+            new_ids = prefix + list(token_ids)
+            extra = {
+                "mm_embeds": pack_array(
+                    embeds.reshape(-1, embeds.shape[-1]).astype(np.float32)
+                ),
+                "mm_positions": positions,
+                "mm_tokens_per_image": n_tokens,
+            }
+            if isinstance(request, dict):
+                request["token_ids"] = new_ids
+                request.setdefault("extra", {}).update(extra)
+            else:
+                request.token_ids = new_ids
+                request.extra.update(extra)
+        async for item in next.generate(request, context):
+            yield item

@@ -1,0 +1,66 @@
+"""Media fetch + decode → fixed-size RGB arrays.
+
+Reference parity: lib/llm/src/preprocessor/media/{loader.rs,decoders} —
+the reference fetches http(s)/data-URI media and decodes to tensors.
+Zero-egress environment: data URIs and local paths are functional; http(s)
+raises with guidance (deployments with egress can override the fetcher).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import io
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+class MediaError(ValueError):
+    """Bad media reference or undecodable payload."""
+
+
+def fetch_media(url: str, *, image_size: int = 224) -> np.ndarray:
+    """Resolve ``url`` to an RGB uint8 array [image_size, image_size, 3].
+
+    Supports ``data:image/*;base64,...`` URIs and local file paths
+    (``file://...`` or bare paths).
+    """
+    if url.startswith("data:"):
+        try:
+            _, b64 = url.split(",", 1)
+            raw = base64.b64decode(b64, validate=True)
+        except (ValueError, binascii.Error) as exc:
+            raise MediaError(f"bad data URI: {exc}") from exc
+        return _decode_image(raw, image_size)
+    if url.startswith(("http://", "https://")):
+        raise MediaError(
+            "remote media fetch requires network egress; pass a data: URI "
+            "or a local file path"
+        )
+    path = url[len("file://"):] if url.startswith("file://") else url
+    if not os.path.exists(path):
+        raise MediaError(f"no such media file: {path}")
+    with open(path, "rb") as f:
+        return _decode_image(f.read(), image_size)
+
+
+def _decode_image(raw: bytes, image_size: int) -> np.ndarray:
+    from PIL import Image, UnidentifiedImageError
+
+    try:
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+    except UnidentifiedImageError as exc:
+        raise MediaError(f"undecodable image payload: {exc}") from exc
+    img = img.resize((image_size, image_size))
+    return np.asarray(img, dtype=np.uint8)
+
+
+def encode_image_data_uri(array: np.ndarray) -> str:
+    """Inverse helper (tests/tools): RGB array → PNG data URI."""
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(array).save(buf, format="PNG")
+    return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()

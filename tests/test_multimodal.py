@@ -1,0 +1,175 @@
+"""Multimodal E/P/D flow: media codec, vision encoder, encode worker over
+the runtime, and image-embedding splice through the real engine
+(VERDICT #9 second half; ref: multimodal_handlers/ + preprocessor/media)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.multimodal import (
+    EncodeWorkerHandler,
+    MultimodalPreprocessor,
+    VisionEncoderConfig,
+    encode_images,
+    init_vision_params,
+)
+from dynamo_tpu.multimodal.media import (
+    MediaError,
+    encode_image_data_uri,
+    fetch_media,
+)
+from dynamo_tpu.runtime import Context, DistributedRuntime, build_pipeline, collect
+
+CFG = tiny_config()
+VCFG = VisionEncoderConfig(
+    image_size=64, patch_size=16, d_model=32, n_layers=1, n_heads=2,
+    d_ff=64, out_dim=CFG.d_model,
+)
+
+
+def make_image(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, size=(64, 64, 3), dtype=np.uint8)
+
+
+class TestMedia:
+    def test_data_uri_roundtrip(self):
+        img = make_image(0)
+        uri = encode_image_data_uri(img)
+        out = fetch_media(uri, image_size=64)
+        np.testing.assert_array_equal(out, img)  # PNG is lossless
+
+    def test_local_file(self, tmp_path):
+        from PIL import Image
+
+        p = tmp_path / "x.png"
+        Image.fromarray(make_image(1)).save(str(p))
+        out = fetch_media(str(p), image_size=32)
+        assert out.shape == (32, 32, 3)
+
+    def test_errors(self):
+        with pytest.raises(MediaError):
+            fetch_media("data:image/png;base64,!!!notb64!!!")
+        with pytest.raises(MediaError):
+            fetch_media("https://example.com/cat.png")
+        with pytest.raises(MediaError):
+            fetch_media("/no/such/file.png")
+
+
+class TestEncoder:
+    def test_shapes_and_determinism(self):
+        import jax
+
+        params = init_vision_params(VCFG, jax.random.PRNGKey(0))
+        imgs = np.stack([make_image(0), make_image(1)])
+        e1 = encode_images(params, imgs, VCFG)
+        e2 = encode_images(params, imgs, VCFG)
+        assert e1.shape == (2, VCFG.n_patches, CFG.d_model)
+        np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+        # different images produce different embeddings
+        assert float(np.abs(np.asarray(e1[0] - e1[1])).max()) > 1e-3
+
+
+async def test_encode_worker_over_runtime():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("mm").component("encoder").endpoint("encode")
+    handler = EncodeWorkerHandler(VCFG)
+    await ep.serve_endpoint(handler.generate)
+    client = await ep.client()
+    uri = encode_image_data_uri(make_image(3))
+    out = await collect(client.generate({"media": [uri]}, Context()))
+    assert out[-1].get("error") is None
+    assert out[-1]["n_tokens"] == VCFG.n_patches
+    from dynamo_tpu.disagg.handlers import unpack_array
+
+    embeds = unpack_array(out[-1]["embeddings"])
+    assert embeds.shape == (1, VCFG.n_patches, CFG.d_model)
+    # bad media comes back in-band
+    bad = await collect(client.generate({"media": ["https://x/y.png"]}, Context()))
+    assert "egress" in bad[-1]["error"]
+
+
+async def _mm_pipeline():
+    """Full staged flow: encode worker + preprocessor + engine."""
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("mm2").component("encoder").endpoint("encode")
+    handler = EncodeWorkerHandler(VCFG)
+    await ep.serve_endpoint(handler.generate)
+
+    async def factory():
+        return await ep.client()
+
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=CFG, block_size=4, num_kv_blocks=256, max_num_seqs=4,
+            max_model_len=256, prefill_chunk=16,  # chunk < n_patches: splice spans chunks
+        )
+    )
+    tok = tiny_tokenizer()
+    card = ModelDeploymentCard(name="mm-model", context_length=256)
+    pipeline = build_pipeline(
+        [
+            OpenAIPreprocessor(card, tok),
+            Backend(tok),
+            MultimodalPreprocessor(factory),
+        ],
+        engine,
+    )
+    return pipeline, engine, handler
+
+
+def chat_with_image(uri, text="describe this"):
+    return {
+        "model": "mm-model",
+        "messages": [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "image_url", "image_url": {"url": uri}},
+                    {"type": "text", "text": text},
+                ],
+            }
+        ],
+        "max_tokens": 6,
+        "temperature": 0.0,
+        "ignore_eos": True,
+    }
+
+
+async def test_image_steers_generation_e2e():
+    pipeline, engine, handler = await _mm_pipeline()
+    uri_a = encode_image_data_uri(make_image(10))
+    uri_b = encode_image_data_uri(make_image(20))
+    try:
+        async def run(body):
+            outs = await collect(pipeline.generate(body, Context()))
+            deltas = [o for o in outs if not isinstance(o, dict)]
+            assert not any(o.error for o in deltas), [o.error for o in deltas]
+            return [t for o in deltas for t in o.token_ids]
+
+        out_a = await run(chat_with_image(uri_a))
+        out_b = await run(chat_with_image(uri_b))
+        out_text = await run(
+            {
+                "model": "mm-model",
+                "messages": [{"role": "user", "content": "describe this"}],
+                "max_tokens": 6,
+                "temperature": 0.0,
+                "ignore_eos": True,
+            }
+        )
+        assert handler.encoded_images == 2
+        assert out_a != out_text  # the image changed the generation
+        assert out_a != out_b  # different images, different generations
+        # same image again: deterministic AND the prefix cache (salted by
+        # image content) must serve the same result
+        out_a2 = await run(chat_with_image(uri_a))
+        assert out_a2 == out_a
+    finally:
+        await engine.stop()
