@@ -1,0 +1,11 @@
+"""Unified CLI (the ``dynamo-run`` role).
+
+Reference parity: launch/dynamo-run/src/opt.rs (``dynamo-run in=X out=Y``
+input/output matrix) and lib/llm/src/entrypoint/input.rs:31 (Text / Stdin /
+Batch / Http inputs over an engine). Subcommands:
+
+  run       drive a local engine: --input text|stdin|batch:FILE|http
+  env       print the DYN_* environment-variable registry
+  frontend / worker / mocker / discd / planner / grpc
+            dispatch to the corresponding service entrypoints
+"""

@@ -1,0 +1,71 @@
+"""``python -m dynamo_tpu.cli`` — the unified entrypoint.
+
+Reference parity: launch/dynamo-run/src/opt.rs (one binary fronting every
+input/output pairing) plus the service launchers under components/. Service
+subcommands re-exec the dedicated module mains so flags stay in one place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from dynamo_tpu import config
+from dynamo_tpu.cli.run import add_run_args, main_run
+
+_SERVICES = {
+    "frontend": "dynamo_tpu.frontend",
+    "worker": "dynamo_tpu.worker",
+    "mocker": "dynamo_tpu.mocker",
+    "discd": "dynamo_tpu.discd",
+    "planner": "dynamo_tpu.planner",
+    "grpc": "dynamo_tpu.grpc",
+}
+
+
+def cmd_env() -> None:
+    """Print the DYN_* registry (config.py advertises this command)."""
+    import os
+
+    rows = sorted(config.registry().items())
+    width = max(len(n) for n, _ in rows)
+    for name, var in rows:
+        current = os.environ.get(name)
+        state = f" [set: {current}]" if current is not None else ""
+        print(f"{name:<{width}}  default={var.default!r}{state}")
+        if var.doc:
+            print(f"{'':<{width}}  {var.doc}")
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in _SERVICES:
+        # Delegate: `dynamo_tpu.cli worker --model tiny` ≡
+        # `python -m dynamo_tpu.worker --model tiny`.
+        module = _SERVICES[argv[0]]
+        sys.argv = [f"{module}"] + argv[1:]
+        import runpy
+
+        runpy.run_module(module, run_name="__main__")
+        return
+
+    parser = argparse.ArgumentParser(
+        "dynamo-tpu",
+        description="unified CLI: run engines locally, inspect config, "
+        f"or launch services ({', '.join(_SERVICES)})",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    run_p = sub.add_parser("run", help="drive a local engine (text/stdin/batch/http)")
+    add_run_args(run_p)
+    sub.add_parser("env", help="print the environment-variable registry")
+    args = parser.parse_args(argv)
+
+    if args.command == "env":
+        cmd_env()
+    elif args.command == "run":
+        asyncio.run(main_run(args))
+
+
+if __name__ == "__main__":
+    main()

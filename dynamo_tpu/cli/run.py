@@ -1,0 +1,220 @@
+"""``dynamo_tpu.cli run``: drive an engine without a cluster.
+
+Reference parity: lib/llm/src/entrypoint/input.rs (Input::Text :31 —
+interactive REPL; Input::Stdin — one prompt per line; Input::Batch — JSONL
+file in, JSONL out with latency stats; Input::Http — OpenAI server over the
+local pipeline). The engine is in-process: the mocker, a builtin random-init
+config, or a local HF checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Any, Optional, Tuple
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger(__name__)
+
+
+def add_run_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--input", default="text",
+        help="text (REPL) | stdin | batch:FILE.jsonl | http",
+    )
+    parser.add_argument(
+        "--model", default="mock",
+        help="'mock', a builtin config name (tiny, qwen2.5-0.5b, ...), or a "
+        "local HF model directory",
+    )
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--http-port", type=int, default=8080)
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-kv-blocks", type=int, default=512)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--out", default=None,
+                        help="batch mode: output JSONL path (default stdout)")
+
+
+def build_engine_and_card(args) -> Tuple[Any, ModelDeploymentCard, Any]:
+    """Returns (engine, card, tokenizer)."""
+    from dynamo_tpu.llm.tokenizer import tiny_tokenizer
+
+    name = args.served_model_name or args.model
+    if args.model == "mock":
+        from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+
+        engine = MockEngine(MockEngineArgs(speedup_ratio=10.0))
+        card = ModelDeploymentCard(name=name, context_length=args.max_model_len)
+        return engine, card, tiny_tokenizer()
+
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.worker.__main__ import BUILTIN_CONFIGS
+
+    model_path = None
+    if args.model in BUILTIN_CONFIGS:
+        config = BUILTIN_CONFIGS[args.model]()
+        params = None
+        tokenizer = tiny_tokenizer()
+    else:
+        from dynamo_tpu.llm.tokenizer import HFTokenizer
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.models.hf_loader import load_hf_checkpoint
+
+        model_path = args.model
+        config = ModelConfig.from_model_dir(args.model)
+        params = load_hf_checkpoint(args.model, config)
+        tokenizer = HFTokenizer.from_pretrained_dir(args.model)
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=config,
+            block_size=args.block_size,
+            num_kv_blocks=args.num_kv_blocks,
+            max_model_len=args.max_model_len,
+        ),
+        params,
+    )
+    card = ModelDeploymentCard(
+        name=name, model_path=model_path, context_length=args.max_model_len,
+        kv_block_size=args.block_size,
+        eos_token_ids=list(config.eos_token_ids),
+    )
+    return engine, card, tokenizer
+
+
+async def _generate_text(pipeline, model: str, prompt: str, args) -> Tuple[str, int, float]:
+    """One completion through the pipeline; returns (text, tokens, seconds)."""
+    body = {
+        "model": model,
+        "prompt": prompt,
+        "max_tokens": args.max_tokens,
+        "temperature": args.temperature,
+        "stream": True,
+    }
+    start = time.monotonic()
+    parts = []
+    n = 0
+    async for item in pipeline.generate(body, Context()):
+        if isinstance(item, dict):
+            continue  # annotations
+        if item.error:
+            raise RuntimeError(item.error)
+        parts.append(item.text)
+        n += len(item.token_ids)
+    return "".join(parts), n, time.monotonic() - start
+
+
+async def run_text(pipeline, model: str, args) -> None:
+    """Interactive REPL (ref: Input::Text)."""
+    print(f"dynamo-tpu REPL — model {model}; Ctrl-D to exit", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, input, "> ")
+        except EOFError:
+            break
+        if not line.strip():
+            continue
+        try:
+            text, n, dt = await _generate_text(pipeline, model, line, args)
+        except RuntimeError as exc:
+            print(f"error: {exc}", file=sys.stderr, flush=True)
+            continue
+        print(text, flush=True)
+        print(f"  [{n} tokens in {dt:.2f}s]", file=sys.stderr, flush=True)
+
+
+async def run_stdin(pipeline, model: str, args) -> None:
+    """One prompt per stdin line, completion per line out (ref: Input::Stdin)."""
+    for line in sys.stdin:
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        text, _, _ = await _generate_text(pipeline, model, line, args)
+        print(text, flush=True)
+
+
+async def run_batch(pipeline, model: str, args, batch_path: str) -> None:
+    """JSONL in ({'text': ...} or {'prompt': ...}), JSONL out with stats
+    (ref: Input::Batch)."""
+    out_f = open(args.out, "w") if args.out else sys.stdout
+    total_tokens = 0
+    start = time.monotonic()
+    n_requests = 0
+    try:
+        with open(batch_path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                prompt = doc.get("text") or doc.get("prompt") or ""
+                text, n, dt = await _generate_text(pipeline, model, prompt, args)
+                total_tokens += n
+                n_requests += 1
+                out_f.write(
+                    json.dumps(
+                        {"prompt": prompt, "text": text, "tokens": n,
+                         "latency_s": round(dt, 4)}
+                    )
+                    + "\n"
+                )
+                out_f.flush()
+    finally:
+        if args.out:
+            out_f.close()
+    wall = time.monotonic() - start
+    print(
+        f"batch done: {n_requests} requests, {total_tokens} tokens in "
+        f"{wall:.2f}s ({total_tokens / max(wall, 1e-9):.1f} tok/s)",
+        file=sys.stderr, flush=True,
+    )
+
+
+async def run_http(pipeline, card: ModelDeploymentCard, args) -> None:
+    """Single-process OpenAI server over the local pipeline (in=http)."""
+    from dynamo_tpu.http import HttpService, ModelManager
+
+    manager = ModelManager()
+    manager.register(card.name, pipeline, card)
+    service = HttpService(manager, host="0.0.0.0", port=args.http_port)
+    port = await service.start()
+    print(f"http server on :{port} serving {card.name}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service.stop(grace_period=5)
+
+
+async def main_run(args) -> None:
+    configure_logging()
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+
+    engine, card, tokenizer = build_engine_and_card(args)
+    pipeline = build_local_pipeline(card, engine, tokenizer=tokenizer)
+    mode = args.input
+    try:
+        if mode == "text":
+            await run_text(pipeline, card.name, args)
+        elif mode == "stdin":
+            await run_stdin(pipeline, card.name, args)
+        elif mode.startswith("batch:"):
+            await run_batch(pipeline, card.name, args, mode.split(":", 1)[1])
+        elif mode == "http":
+            await run_http(pipeline, card, args)
+        else:
+            raise SystemExit(
+                f"unknown --input {mode!r} (text | stdin | batch:FILE | http)"
+            )
+    finally:
+        stop = getattr(engine, "stop", None)
+        if stop is not None:
+            await stop()

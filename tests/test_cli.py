@@ -1,0 +1,81 @@
+"""Unified CLI (VERDICT #10; ref: launch/dynamo-run/src/opt.rs +
+entrypoint/input.rs batch/stdin/text inputs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1"}
+
+
+def run_cli(args, input_text=None, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", *args],
+        input=input_text,
+        capture_output=True,
+        text=True,
+        env=ENV,
+        timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def test_env_command_prints_registry():
+    res = run_cli(["env"])
+    assert res.returncode == 0
+    assert "DYN_TPU_DISCOVERY" in res.stdout
+    assert "default=" in res.stdout
+
+
+def test_batch_mode_writes_jsonl(tmp_path):
+    batch = tmp_path / "in.jsonl"
+    out = tmp_path / "out.jsonl"
+    batch.write_text('{"text": "hello"}\n{"prompt": "world"}\n')
+    res = run_cli(
+        ["run", "--input", f"batch:{batch}", "--model", "mock",
+         "--max-tokens", "4", "--out", str(out)]
+    )
+    assert res.returncode == 0, res.stderr
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["prompt"] == "hello"
+    assert all(l["tokens"] == 4 for l in lines)
+    assert "batch done: 2 requests" in res.stderr
+
+
+def test_stdin_mode():
+    res = run_cli(
+        ["run", "--input", "stdin", "--model", "mock", "--max-tokens", "3"],
+        input_text="one\ntwo\n",
+    )
+    assert res.returncode == 0, res.stderr
+    assert len(res.stdout.splitlines()) == 2
+
+
+def test_batch_mode_real_engine(tmp_path):
+    """The tiny JaxEngine path (builtin config, random weights)."""
+    batch = tmp_path / "in.jsonl"
+    batch.write_text('{"text": "the quick brown fox"}\n')
+    res = run_cli(
+        ["run", "--input", f"batch:{batch}", "--model", "tiny",
+         "--max-tokens", "3", "--num-kv-blocks", "64"],
+        timeout=420,
+    )
+    assert res.returncode == 0, res.stderr
+    doc = json.loads(res.stdout.splitlines()[0])
+    assert doc["tokens"] == 3
+
+
+def test_unknown_input_rejected():
+    res = run_cli(["run", "--input", "carrier-pigeon", "--model", "mock"])
+    assert res.returncode != 0
+    assert "unknown --input" in res.stderr
+
+
+def test_service_delegation_help():
+    res = run_cli(["mocker", "--help"])
+    assert res.returncode == 0
+    assert "--model-name" in res.stdout
