@@ -1,0 +1,28 @@
+import asyncio, time, os, json
+os.environ.setdefault("BENCH_REQUESTS", "128")
+import numpy as np
+import jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+import bench as B
+from dynamo_tpu.engines.tpu import engine as eng_mod
+
+times = {"decode": 0.0, "prefill": 0.0, "decode_n": 0, "prefill_n": 0}
+orig_rd = eng_mod.JaxEngine._run_decode
+orig_rs = eng_mod.JaxEngine._run_step
+def rd(self, *a, **k):
+    t0 = time.perf_counter(); r = orig_rd(self, *a, **k)
+    times["decode"] += time.perf_counter()-t0; times["decode_n"] += 1
+    return r
+def rs(self, *a, **k):
+    t0 = time.perf_counter(); r = orig_rs(self, *a, **k)
+    times["prefill"] += time.perf_counter()-t0; times["prefill_n"] += 1
+    return r
+eng_mod.JaxEngine._run_decode = rd
+eng_mod.JaxEngine._run_step = rs
+
+t0 = time.perf_counter()
+asyncio.run(B.run_bench())
+wall = time.perf_counter()-t0
+print(json.dumps({**times, "total_wall_incl_warmup": round(wall,2),
+                  "decode_ms_per_dispatch": round(times["decode"]/max(times["decode_n"],1)*1000,1),
+                  "prefill_ms_per_dispatch": round(times["prefill"]/max(times["prefill_n"],1)*1000,1)}))

@@ -50,16 +50,17 @@ async def run_bench():
     from dynamo_tpu.runtime.context import Context
 
     cfg = qwen2_500m_config()
+    block_size = int(os.environ.get("BENCH_BLOCK_SIZE", 32))
     engine = JaxEngine(
         JaxEngineArgs(
             config=cfg,
-            block_size=16,
-            num_kv_blocks=2048,
+            block_size=block_size,
+            num_kv_blocks=int(os.environ.get("BENCH_KV_BLOCKS", 65536 // block_size)),
             max_num_seqs=CONCURRENCY,
             max_model_len=512,
-            prefill_chunk=128,
+            prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
             enable_prefix_caching=True,
-            decode_steps=32,
+            decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", 32)),
         )
     )
 

@@ -69,7 +69,7 @@ class JaxEngineArgs:
     # (measured: B=8 costs only ~1.4× B=1 on a v5e) and serial admission was
     # the round-2 bench's bottleneck (64-slot engine ramping 4 seqs/tick).
     prefill_batch: int = 8
-    admit_batches_per_tick: int = 4  # bounds decode stall per scheduler tick
+    admit_batches_per_tick: int = 8  # bounds decode stall per scheduler tick
     enable_prefix_caching: bool = True
     use_kernel: Optional[bool] = None  # None = auto (pallas on TPU)
     seed: int = 0
@@ -178,7 +178,11 @@ class JaxEngine:
 
         self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
         self._step_fn = self._build_step_fn()
-        self._decode_fn = self._build_decode_fn()
+        # Two decode programs: the logprob-free one skips a full-vocab
+        # log-softmax per fused step (the common case); the other serves
+        # batches where any request asked for logprobs.
+        self._decode_fn = self._build_decode_fn(want_logprobs=False)
+        self._decode_fn_logprobs = self._build_decode_fn(want_logprobs=True)
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -265,7 +269,7 @@ class JaxEngine:
 
         return jax.jit(step, donate_argnums=(2, 3))
 
-    def _build_decode_fn(self):
+    def _build_decode_fn(self, want_logprobs: bool = False):
         cfg = self.config
         use_kernel = self._use_kernel
         num_steps = self.args.decode_steps
@@ -277,18 +281,20 @@ class JaxEngine:
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
+                want_logprobs=want_logprobs,
             )
 
         return jax.jit(step, donate_argnums=(2, 3))
 
     def _run_decode(
         self, tokens, start_pos, active, block_tables, temp, topk, topp,
-        adapter_ids,
+        adapter_ids, want_logprobs=False,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
         [B, K] logprobs)."""
+        fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
         self._rng, sub = jax.random.split(self._rng)
-        toks, logp, self._k_cache, self._v_cache = self._decode_fn(
+        toks, logp, self._k_cache, self._v_cache = fn(
             self.params, self._lora, self._k_cache, self._v_cache,
             jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
             jnp.asarray(block_tables), sub,
@@ -807,6 +813,9 @@ class JaxEngine:
             )
         nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
 
+        want_logprobs = any(
+            s.request.sampling.logprobs is not None for s in active
+        )
         toks, logps = await self._device(
             self._run_decode,
             tokens,
@@ -815,17 +824,62 @@ class JaxEngine:
             self._block_tables[:, :nb_bucket].copy(),
             self._temp.copy(), self._topk.copy(), self._topp.copy(),
             self._adapter_ids.copy(),
+            want_logprobs,
         )
         self.steps += 1
 
         for seq in list(active):
-            slot = seq.slot
-            for k in range(K):
-                if self._slots[slot] is not seq:
-                    break  # finished mid-burst; discard overshoot tokens
-                self._pos[slot] += 1  # the input token's KV is now resident
-                self._maybe_commit_block(seq, slot)
-                self._emit_token(seq, int(toks[slot, k]), float(logps[slot, k]))
+            self._emit_burst(seq, toks[seq.slot], logps[seq.slot])
+
+    def _emit_burst(self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray) -> None:
+        """Consume one fused burst for a sequence: apply stop conditions
+        per token but stream ONE BackendOutput for the whole burst — the
+        asyncio queue/wakeup cost per token dominated decode throughput
+        when emission was per-token (2048 puts per 64×32 tick)."""
+        slot = seq.slot
+        req = seq.request
+        stop = req.stop
+        emitted: List[int] = []
+        emitted_logps: List[float] = []
+        reason: Optional[FinishReason] = None
+        for k in range(len(toks)):
+            token = int(toks[k])
+            seq.generated.append(token)
+            seq.all_tokens.append(token)
+            seq.next_token = token
+            self.generated_tokens += 1
+            self._pos[slot] += 1  # the input token's KV is now resident
+            self._maybe_commit_block(seq, slot)
+            emitted.append(token)
+            emitted_logps.append(float(logps[k]))
+            n = len(seq.generated)
+            min_ok = stop.min_tokens is None or n >= stop.min_tokens
+            if not stop.ignore_eos and min_ok and token in (req.eos_token_ids or []):
+                reason = FinishReason.EOS
+            elif min_ok and token in (stop.stop_token_ids or []):
+                reason = FinishReason.STOP
+            elif stop.max_tokens is not None and n >= stop.max_tokens:
+                reason = FinishReason.LENGTH
+            elif len(seq.all_tokens) >= self.args.max_model_len:
+                reason = FinishReason.LENGTH
+            if reason is not None:
+                break  # overshoot tokens beyond the stop are discarded
+        logprobs = None
+        if req.sampling.logprobs is not None:
+            logprobs = [
+                [TokenLogprob(token_id=t, logprob=lp)]
+                for t, lp in zip(emitted, emitted_logps)
+            ]
+        seq.queue.put_nowait(
+            BackendOutput(
+                token_ids=emitted,
+                finish_reason=reason,
+                cumulative_tokens=len(seq.generated),
+                logprobs=logprobs,
+            )
+        )
+        if reason is not None:
+            self._finish(seq, reason, emit=False)
 
     def _maybe_commit_block(self, seq: _Sequence, slot: int) -> None:
         """At a block boundary the just-completed block becomes shareable."""

@@ -203,6 +203,9 @@ class HttpService:
             )
         stream = bool(body.get("stream", False))
         endpoint = "chat_completions" if kind == "chat" else "completions"
+        # W3C trace propagation (ref: logging.rs:72): an incoming
+        # traceparent joins the caller's trace; spans flow via baggage.
+        traceparent = request.headers.get("traceparent")
         if self._model_busy(model, entry):
             # All workers over threshold: shed before any work is queued
             # (ref: busy_threshold.rs middleware → 503).
@@ -216,9 +219,16 @@ class HttpService:
             resp.headers["Retry-After"] = "1"
             return resp
         timer = RequestTimer(self.metrics, model, endpoint)
-        ctx = Context(baggage={"model": model})
+        baggage: Dict[str, Any] = {"model": model}
+        if traceparent:
+            baggage["traceparent"] = traceparent
+        ctx = Context(baggage=baggage)
+        from dynamo_tpu.utils.tracing import span
+
         try:
-            with self.tracker.guard():
+            with self.tracker.guard(), span(
+                f"http.{endpoint}", ctx, model=model, stream=stream
+            ):
                 if stream:
                     return await self._stream_response(request, body, entry, ctx, kind, timer)
                 return await self._unary_response(body, entry, ctx, kind, timer)

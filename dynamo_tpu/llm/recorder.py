@@ -1,0 +1,150 @@
+"""Stream recording + replay.
+
+Reference parity: lib/llm/src/recorder.rs:26 — tee request/response streams
+to disk and replay them later. Invaluable for debugging disagg/migration
+flows: capture a misbehaving stream in production, replay it into a test.
+
+Format: JSONL, one event per line:
+  {"kind": "request", "rid", "ts", "payload"}
+  {"kind": "item",    "rid", "ts", "payload"}
+  {"kind": "end",     "rid", "ts"}            (normal end)
+  {"kind": "error",   "rid", "ts", "message"} (stream raised)
+Payloads must be JSON-serializable (dataclasses with to_dict are handled).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _jsonable(obj: Any) -> Any:
+    if hasattr(obj, "to_dict"):
+        return obj.to_dict()
+    if hasattr(obj, "__dataclass_fields__"):
+        import dataclasses
+
+        return dataclasses.asdict(obj)
+    return obj
+
+
+class StreamRecorder:
+    """Pipeline operator: tees every request and response item to a JSONL
+    file while passing them through untouched."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.recorded_streams = 0
+        self._lock = asyncio.Lock()
+
+    async def _write(self, doc: Dict[str, Any]) -> None:
+        line = json.dumps(doc, default=str) + "\n"
+        async with self._lock:
+            # Append synchronously: lines are small and interleaving-safe
+            # under the lock; a failure disables recording, never the stream.
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line)
+            except OSError:
+                logger.exception("stream recorder write failed; disabling")
+                self.path = ""
+
+    async def generate(self, request: Any, context: Context, next: Any):
+        if not self.path:
+            async for item in next.generate(request, context):
+                yield item
+            return
+        rid = context.id
+        await self._write(
+            {"kind": "request", "rid": rid, "ts": time.time(),
+             "payload": _jsonable(request)}
+        )
+        self.recorded_streams += 1
+        try:
+            async for item in next.generate(request, context):
+                await self._write(
+                    {"kind": "item", "rid": rid, "ts": time.time(),
+                     "payload": _jsonable(item)}
+                )
+                yield item
+        except Exception as exc:
+            await self._write(
+                {"kind": "error", "rid": rid, "ts": time.time(),
+                 "message": f"{type(exc).__name__}: {exc}"}
+            )
+            raise
+        await self._write({"kind": "end", "rid": rid, "ts": time.time()})
+
+
+@dataclass
+class RecordedStream:
+    request: Any
+    items: List[Any] = field(default_factory=list)
+    # seconds after the request each item arrived (replay pacing)
+    offsets_s: List[float] = field(default_factory=list)
+    error: Optional[str] = None
+    rid: str = ""
+
+
+def load_recording(path: str) -> List[RecordedStream]:
+    """Parse a recorder JSONL file into per-request streams (wire order)."""
+    streams: Dict[str, RecordedStream] = {}
+    order: List[str] = []
+    t0: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            rid = doc.get("rid", "")
+            kind = doc.get("kind")
+            if kind == "request":
+                streams[rid] = RecordedStream(request=doc.get("payload"), rid=rid)
+                order.append(rid)
+                t0[rid] = doc.get("ts", 0.0)
+            elif kind == "item" and rid in streams:
+                streams[rid].items.append(doc.get("payload"))
+                streams[rid].offsets_s.append(
+                    max(doc.get("ts", 0.0) - t0.get(rid, 0.0), 0.0)
+                )
+            elif kind == "error" and rid in streams:
+                streams[rid].error = doc.get("message")
+    return [streams[r] for r in order]
+
+
+class ReplayEngine:
+    """AsyncEngine that replays recorded streams.
+
+    Requests are matched FIFO against the recording (the reference replays a
+    capture in order); pass ``paced=True`` to reproduce original timing.
+    """
+
+    def __init__(self, recording: List[RecordedStream], *, paced: bool = False) -> None:
+        self._streams = list(recording)
+        self._next = 0
+        self.paced = paced
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        if self._next >= len(self._streams):
+            raise RuntimeError("replay exhausted: no more recorded streams")
+        stream = self._streams[self._next]
+        self._next += 1
+        last = 0.0
+        for item, off in zip(stream.items, stream.offsets_s or [0.0] * len(stream.items)):
+            if self.paced and off > last:
+                await asyncio.sleep(off - last)
+                last = off
+            if context.stopped:
+                return
+            yield item
+        if stream.error:
+            raise RuntimeError(f"recorded stream ended in error: {stream.error}")

@@ -262,6 +262,7 @@ def decode_multi(
     use_kernel: bool = False,
     lora: Optional[Dict[str, Any]] = None,
     adapter_ids: Optional[jnp.ndarray] = None,
+    want_logprobs: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
     single-token forward+sample steps). Minimizes host↔device round trips —
@@ -282,7 +283,12 @@ def decode_multi(
         )
         nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p)
         nxt = jnp.where(active > 0, nxt, toks)
-        logp = compute_logprobs(logits, nxt)
+        if want_logprobs:
+            logp = compute_logprobs(logits, nxt)
+        else:
+            # Full-vocab log-softmax each step is pure waste when no active
+            # request asked for logprobs (the common case).
+            logp = jnp.zeros_like(nxt, dtype=jnp.float32)
         pos = pos + active
         return (nxt, pos, k_c, v_c), (nxt, logp)
 

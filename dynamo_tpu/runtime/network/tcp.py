@@ -147,9 +147,14 @@ class TcpRequestPlane:
             if tracker.draining:
                 await fw.send({"type": "err", "stream": sid, "message": "draining"})
                 return
-            with tracker.guard():
+            from dynamo_tpu.utils.tracing import span
+
+            with tracker.guard(), span("endpoint.serve", ctx, endpoint=key) as sp:
+                n_items = 0
                 async for item in engine.generate(request, ctx):
                     await fw.send({"type": "item", "stream": sid}, item)
+                    n_items += 1
+                sp.attributes["items"] = n_items
             await fw.send({"type": "end", "stream": sid})
         except asyncio.CancelledError:
             ctx.stop_generating(reason="client-cancelled")

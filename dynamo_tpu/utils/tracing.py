@@ -1,0 +1,172 @@
+"""Distributed tracing: W3C traceparent propagation + span export.
+
+Reference parity: lib/runtime/src/logging.rs:72-97 (traceparent parse /
+propagate so distributed request flows correlate across frontend → router →
+worker) and the OTel span layer the reference hangs off tracing-subscriber.
+Dependency-free by design (no otel SDK in the image): spans are recorded to
+an in-process ring + optional JSONL file (``DYN_TPU_TRACE_FILE``), one JSON
+object per span — the OTLP-friendly shape an exporter can ship later.
+
+Propagation rides Context baggage (runtime/context.py), which the request
+plane already serializes: the HTTP/gRPC frontends extract ``traceparent``
+into baggage; every hop's spans join the same trace; workers see the parent
+span id of the frontend span that dispatched to them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import secrets
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+from dynamo_tpu import config
+
+TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+TRACE_FILE = config.env_str(
+    "DYN_TPU_TRACE_FILE", "",
+    "Append finished spans as JSONL to this path ('' disables file export)",
+)
+
+
+@dataclass
+class TraceContext:
+    trace_id: str  # 32 hex
+    span_id: str  # 16 hex — the CURRENT span (parent of children)
+    sampled: bool = True
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """(ref: logging.rs:72 parse_traceparent)"""
+    if not header:
+        return None
+    m = TRACEPARENT_RE.match(header.strip().lower())
+    if not m:
+        return None
+    _, trace_id, span_id, flags = m.groups()
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, span_id, sampled=flags != "00")
+
+
+def new_trace_context() -> TraceContext:
+    return TraceContext(secrets.token_hex(16), secrets.token_hex(8))
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str]
+    start_s: float
+    end_s: float = 0.0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "ok"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "start_unix_s": round(self.start_s, 6),
+            "duration_ms": round((self.end_s - self.start_s) * 1000, 3),
+            "attributes": self.attributes,
+            "events": self.events,
+            "status": self.status,
+        }
+
+
+class Tracer:
+    """Process-wide span recorder (ring buffer + optional JSONL file)."""
+
+    def __init__(self, *, max_spans: int = 2048, path: Optional[str] = None) -> None:
+        self._ring: Deque[Span] = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._path = path if path is not None else (TRACE_FILE.get() or None)
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+            if self._path:
+                try:
+                    with open(self._path, "a") as f:
+                        f.write(json.dumps(span.to_dict()) + "\n")
+                except OSError:
+                    self._path = None  # disable after first failure
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        context: Any = None,  # runtime Context (baggage carrier) or None
+        **attributes: Any,
+    ):
+        """Start a child span of the context's trace (creating a fresh trace
+        when none is active) and advance the context's traceparent so
+        downstream hops parent under this span."""
+        parent = None
+        if context is not None:
+            parent = parse_traceparent(context.baggage.get("traceparent"))
+        if parent is None:
+            parent = new_trace_context()
+            parent_span_id: Optional[str] = None
+        else:
+            parent_span_id = parent.span_id
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id,
+            span_id=secrets.token_hex(8),
+            parent_span_id=parent_span_id,
+            start_s=time.time(),
+            attributes=dict(attributes),
+        )
+        if context is not None:
+            context.baggage["traceparent"] = TraceContext(
+                span.trace_id, span.span_id, parent.sampled
+            ).to_traceparent()
+        try:
+            yield span
+        except BaseException as exc:
+            span.status = f"error: {type(exc).__name__}"
+            raise
+        finally:
+            span.end_s = time.time()
+            self.export(span)
+
+
+_GLOBAL: Optional[Tracer] = None
+
+
+def global_tracer() -> Tracer:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = Tracer()
+    return _GLOBAL
+
+
+def span(name: str, context: Any = None, **attributes: Any):
+    """Convenience: a span on the process-global tracer."""
+    return global_tracer().span(name, context, **attributes)

@@ -1,0 +1,150 @@
+"""Tracing (traceparent propagation, span export) + stream recorder/replay
+(VERDICT missing #9; ref: logging.rs:72-97, recorder.rs:26)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.recorder import ReplayEngine, StreamRecorder, load_recording
+from dynamo_tpu.runtime import Context, DistributedRuntime, build_pipeline, collect
+from dynamo_tpu.utils.tracing import (
+    Tracer,
+    new_trace_context,
+    parse_traceparent,
+)
+
+
+class TestTraceparent:
+    def test_parse_roundtrip(self):
+        tc = new_trace_context()
+        parsed = parse_traceparent(tc.to_traceparent())
+        assert parsed is not None
+        assert parsed.trace_id == tc.trace_id
+        assert parsed.span_id == tc.span_id
+        assert parsed.sampled
+
+    def test_parse_rejects_garbage(self):
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert parse_traceparent("not-a-traceparent") is None
+        assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+        assert (
+            parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-00").sampled
+            is False
+        )
+
+
+class TestSpans:
+    def test_span_parenting_via_context(self):
+        tracer = Tracer(path="")
+        ctx = Context(baggage={})
+        with tracer.span("outer", ctx, kind="server") as outer:
+            inner_parent = parse_traceparent(ctx.baggage["traceparent"])
+            assert inner_parent.span_id == outer.span_id
+            with tracer.span("inner", ctx) as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+        spans = tracer.finished_spans()
+        assert [s.name for s in spans] == ["inner", "outer"]
+        assert spans[1].attributes["kind"] == "server"
+        assert all(s.status == "ok" for s in spans)
+
+    def test_span_joins_incoming_traceparent(self):
+        tracer = Tracer(path="")
+        incoming = new_trace_context()
+        ctx = Context(baggage={"traceparent": incoming.to_traceparent()})
+        with tracer.span("handler", ctx) as sp:
+            pass
+        assert sp.trace_id == incoming.trace_id
+        assert sp.parent_span_id == incoming.span_id
+
+    def test_error_status(self):
+        tracer = Tracer(path="")
+        with pytest.raises(ValueError):
+            with tracer.span("boom"):
+                raise ValueError("x")
+        assert tracer.finished_spans()[0].status == "error: ValueError"
+
+    def test_file_export(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        tracer = Tracer(path=str(path))
+        with tracer.span("a"):
+            pass
+        doc = json.loads(path.read_text().splitlines()[0])
+        assert doc["name"] == "a" and doc["duration_ms"] >= 0
+
+
+async def test_trace_propagates_across_request_plane():
+    """frontend-ish span → runtime client → worker span: one trace."""
+    tracer = Tracer(path="")
+    seen = []
+
+    async def handler(request, context):
+        with tracer.span("worker.step", context):
+            seen.append(context.baggage.get("traceparent"))
+        yield {"ok": True}
+
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("trace").component("backend").endpoint("generate")
+    await ep.serve_endpoint(handler)
+    client = await ep.client()
+
+    ctx = Context(baggage={})
+    with tracer.span("frontend.request", ctx) as root:
+        await collect(client.generate({"x": 1}, ctx))
+    spans = {s.name: s for s in tracer.finished_spans()}
+    assert spans["worker.step"].trace_id == root.trace_id
+    assert spans["worker.step"].parent_span_id == root.span_id
+    assert seen and parse_traceparent(seen[0]).trace_id == root.trace_id
+
+
+# ---------------------------------------------------------------------------
+# recorder / replay
+# ---------------------------------------------------------------------------
+
+
+async def echo(request, context):
+    for t in request["tokens"]:
+        yield {"token": t}
+
+
+async def test_record_then_replay(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    rec = StreamRecorder(path)
+    pipeline = build_pipeline([rec], echo)
+    out1 = await collect(pipeline.generate({"tokens": [1, 2, 3]}, Context()))
+    out2 = await collect(pipeline.generate({"tokens": [7]}, Context()))
+    assert rec.recorded_streams == 2
+
+    recording = load_recording(path)
+    assert len(recording) == 2
+    assert recording[0].request == {"tokens": [1, 2, 3]}
+    assert recording[0].items == out1
+    assert recording[1].items == out2
+
+    replay = ReplayEngine(recording)
+    r1 = await collect(replay.generate({"anything": True}, Context()))
+    r2 = await collect(replay.generate({}, Context()))
+    assert r1 == out1 and r2 == out2
+    with pytest.raises(RuntimeError, match="exhausted"):
+        await collect(replay.generate({}, Context()))
+
+
+async def test_recorder_captures_errors(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+
+    async def flaky(request, context):
+        yield {"token": 1}
+        raise RuntimeError("engine exploded")
+
+    pipeline = build_pipeline([StreamRecorder(path)], flaky)
+    with pytest.raises(RuntimeError):
+        await collect(pipeline.generate({}, Context()))
+    rec = load_recording(path)[0]
+    assert rec.items == [{"token": 1}]
+    assert "engine exploded" in rec.error
+    # replaying a failed stream re-raises at the same point
+    replay = ReplayEngine(load_recording(path))
+    with pytest.raises(RuntimeError, match="recorded stream ended in error"):
+        await collect(replay.generate({}, Context()))
