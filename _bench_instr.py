@@ -1,28 +1,30 @@
 import asyncio, time, os, json
-os.environ.setdefault("BENCH_REQUESTS", "128")
-import numpy as np
+os.environ.setdefault("BENCH_CONCURRENCY", "128")
+os.environ.setdefault("BENCH_REQUESTS", "256")
 import jax
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 import bench as B
 from dynamo_tpu.engines.tpu import engine as eng_mod
 
-times = {"decode": 0.0, "prefill": 0.0, "decode_n": 0, "prefill_n": 0}
+events = []
 orig_rd = eng_mod.JaxEngine._run_decode
 orig_rs = eng_mod.JaxEngine._run_step
 def rd(self, *a, **k):
     t0 = time.perf_counter(); r = orig_rd(self, *a, **k)
-    times["decode"] += time.perf_counter()-t0; times["decode_n"] += 1
-    return r
+    events.append(("decode", t0, time.perf_counter()-t0)); return r
 def rs(self, *a, **k):
     t0 = time.perf_counter(); r = orig_rs(self, *a, **k)
-    times["prefill"] += time.perf_counter()-t0; times["prefill_n"] += 1
-    return r
+    events.append(("prefill", t0, time.perf_counter()-t0)); return r
 eng_mod.JaxEngine._run_decode = rd
 eng_mod.JaxEngine._run_step = rs
-
-t0 = time.perf_counter()
 asyncio.run(B.run_bench())
-wall = time.perf_counter()-t0
-print(json.dumps({**times, "total_wall_incl_warmup": round(wall,2),
-                  "decode_ms_per_dispatch": round(times["decode"]/max(times["decode_n"],1)*1000,1),
-                  "prefill_ms_per_dispatch": round(times["prefill"]/max(times["prefill_n"],1)*1000,1)}))
+# steady state = events in the last 60% of the timeline
+t_lo = events[0][1] + 0.4*(events[-1][1]-events[0][1])
+for kind in ("decode", "prefill"):
+    sel = [d for k,t,d in events if k==kind and t>=t_lo]
+    if sel:
+        print(f"{kind}: n={len(sel)} avg={sum(sel)/len(sel)*1000:.1f}ms max={max(sel)*1000:.1f}ms")
+# device-busy fraction over steady window
+busy = sum(d for k,t,d in events if t>=t_lo)
+span = events[-1][1]+events[-1][2]-t_lo
+print(f"device-dispatch busy: {busy:.2f}s of {span:.2f}s ({busy/span*100:.0f}%)")

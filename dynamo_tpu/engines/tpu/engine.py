@@ -362,6 +362,13 @@ class JaxEngine:
     def num_total_blocks(self) -> int:
         return self.args.num_kv_blocks
 
+    def clear_kv_blocks(self) -> int:
+        """Flush the reusable prefix cache (ref: clear_kv_blocks.rs route).
+        In-flight sequences keep their pinned blocks."""
+        n = self.pool.cached_blocks
+        self.pool.clear()
+        return n
+
     # -- AsyncEngine -------------------------------------------------------
 
     async def generate(

@@ -26,6 +26,8 @@ class ModelEntry:
     # optional per-model operational attachments (worker_monitor.py / health.py)
     monitor: Optional[Any] = None  # WorkerLoadMonitor
     health: Optional[Any] = None  # CanaryHealthChecker
+    # admin hooks, e.g. {"clear_kv": async () -> int} (clear_kv_blocks route)
+    admin: Dict[str, Any] = field(default_factory=dict)
 
 
 class ModelManager:
@@ -40,9 +42,11 @@ class ModelManager:
         *,
         monitor: Optional[Any] = None,
         health: Optional[Any] = None,
+        admin: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._models[name] = ModelEntry(
-            name=name, engine=engine, card=card, monitor=monitor, health=health
+            name=name, engine=engine, card=card, monitor=monitor, health=health,
+            admin=dict(admin or {}),
         )
 
     def unregister(self, name: str) -> None:

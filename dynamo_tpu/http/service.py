@@ -73,6 +73,9 @@ class HttpService:
         app.router.add_get("/metrics", self._metrics_route)
         app.router.add_get("/busy_threshold", self._busy_threshold_list)
         app.router.add_post("/busy_threshold", self._busy_threshold_route)
+        app.router.add_post("/v1/responses", self._responses)
+        app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
+        app.router.add_get("/openapi.json", self._openapi)
         return app
 
     # -- lifecycle ---------------------------------------------------------
@@ -142,6 +145,179 @@ class HttpService:
             )
         th = self.busy_thresholds.get(model, BusyThresholds())
         return web.json_response({"model": model, **th.to_dict()})
+
+    async def _clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Flush worker prefix caches (ref: clear_kv_blocks.rs). Body may
+        scope to one model: {"model": "..."}; default = every model."""
+        body = {}
+        if request.can_read_body:
+            body, err = await self._read_json(request)
+            if err is not None:
+                return err
+        model = (body or {}).get("model")
+        names = [model] if model else self.models.names()
+        results: Dict[str, Any] = {}
+        for name in names:
+            entry = self.models.get(name)
+            if entry is None:
+                results[name] = {"error": "model not found"}
+                continue
+            clear = entry.admin.get("clear_kv")
+            if clear is None:
+                results[name] = {"error": "no clear_kv hook (local pipeline)"}
+                continue
+            try:
+                results[name] = {"cleared_blocks": await clear()}
+            except Exception as exc:
+                logger.exception("clear_kv_blocks for %s failed", name)
+                results[name] = {"error": str(exc)}
+        return web.json_response({"results": results})
+
+    async def _responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API over the chat pipeline (ref: openai.rs:1179
+        — the reference also converts Responses → chat internally;
+        text-only input, unsupported fields rejected 501)."""
+        body, err = await self._read_json(request)
+        if err is not None:
+            return err
+        for field in ("tools", "previous_response_id", "reasoning"):
+            if body.get(field):
+                return _error_response(
+                    OpenAIError(
+                        f"'{field}' is not supported on /v1/responses",
+                        status=501, err_type="not_implemented",
+                    )
+                )
+        inp = body.get("input")
+        if isinstance(inp, str):
+            messages = [{"role": "user", "content": inp}]
+        elif isinstance(inp, list) and all(
+            isinstance(m, dict) and isinstance(m.get("content"), str) for m in inp
+        ):
+            messages = [
+                {"role": m.get("role", "user"), "content": m["content"]} for m in inp
+            ]
+        else:
+            return _error_response(
+                OpenAIError(
+                    "'input' must be a string or a list of text messages "
+                    "(non-text input is not supported)",
+                    status=501, err_type="not_implemented",
+                )
+            )
+        chat_body: Dict[str, Any] = {
+            "model": body.get("model", ""),
+            "messages": messages,
+            "stream": False,
+        }
+        if body.get("max_output_tokens") is not None:
+            chat_body["max_tokens"] = body["max_output_tokens"]
+        for k in ("temperature", "top_p"):
+            if body.get(k) is not None:
+                chat_body[k] = body[k]
+        model = chat_body["model"]
+        entry = self.models.get(model)
+        if entry is None:
+            return _error_response(
+                OpenAIError(f"model '{model}' not found", status=404,
+                            err_type="not_found_error")
+            )
+        timer = RequestTimer(self.metrics, model, "responses")
+        ctx = Context(baggage={"model": model})
+        try:
+            with self.tracker.guard():
+                text_parts: list = []
+                prompt_tokens = 0
+                completion_tokens = 0
+                async for item in entry.engine.generate(chat_body, ctx):
+                    if isinstance(item, dict):
+                        if item.get("annotation") == "_prompt_tokens":
+                            prompt_tokens = item["value"]
+                            timer.on_input_tokens(prompt_tokens)
+                        continue
+                    out: PostprocessedOutput = item
+                    if out.error:
+                        raise OpenAIError(out.error, status=500,
+                                          err_type="internal_error")
+                    if out.text:
+                        text_parts.append(out.text)
+                    if out.token_ids:
+                        completion_tokens += len(out.token_ids)
+                        timer.on_token(len(out.token_ids))
+                timer.done(200)
+                return web.json_response(
+                    {
+                        "id": gen_id("resp"),
+                        "object": "response",
+                        "status": "completed",
+                        "model": model,
+                        "output": [
+                            {
+                                "type": "message",
+                                "role": "assistant",
+                                "content": [
+                                    {
+                                        "type": "output_text",
+                                        "text": "".join(text_parts),
+                                    }
+                                ],
+                            }
+                        ],
+                        "usage": {
+                            "input_tokens": prompt_tokens,
+                            "output_tokens": completion_tokens,
+                            "total_tokens": prompt_tokens + completion_tokens,
+                        },
+                    }
+                )
+        except OpenAIError as exc:
+            timer.done(exc.status)
+            return _error_response(exc)
+        except asyncio.CancelledError:
+            ctx.kill()
+            timer.done(499)
+            raise
+        except Exception as exc:
+            logger.exception("responses failed")
+            timer.done(500)
+            return _error_response(OpenAIError(str(exc), status=500,
+                                               err_type="internal_error"))
+
+    async def _openapi(self, request: web.Request) -> web.Response:
+        """Minimal OpenAPI description of the served routes (ref: the
+        reference's RouteDoc/OpenAPI surface)."""
+        from dynamo_tpu._version import __version__
+
+        def op(summary, *, body=False):
+            doc: Dict[str, Any] = {"summary": summary, "responses": {"200": {"description": "OK"}}}
+            if body:
+                doc["requestBody"] = {
+                    "content": {"application/json": {"schema": {"type": "object"}}}
+                }
+            return doc
+
+        paths = {
+            "/v1/chat/completions": {"post": op("OpenAI chat completions (SSE streaming via stream=true)", body=True)},
+            "/v1/completions": {"post": op("OpenAI text completions", body=True)},
+            "/v1/responses": {"post": op("OpenAI Responses API (text-only)", body=True)},
+            "/v1/embeddings": {"post": op("Embeddings", body=True)},
+            "/v1/models": {"get": op("List served models")},
+            "/health": {"get": op("Readiness: healthy when ≥1 model is served")},
+            "/live": {"get": op("Liveness")},
+            "/metrics": {"get": op("Prometheus metrics")},
+            "/busy_threshold": {
+                "get": op("List busy thresholds"),
+                "post": op("Get/set one model's busy thresholds", body=True),
+            },
+            "/clear_kv_blocks": {"post": op("Flush worker KV prefix caches", body=True)},
+        }
+        return web.json_response(
+            {
+                "openapi": "3.0.0",
+                "info": {"title": "dynamo_tpu frontend", "version": __version__},
+                "paths": paths,
+            }
+        )
 
     def _model_busy(self, model: str, entry) -> bool:
         th = self.busy_thresholds.get(model)

@@ -35,14 +35,30 @@ class TierStats:
 
 
 class HostTier:
-    """G2: host-RAM block store, LRU-bounded by block count."""
+    """G2: host-RAM block store, LRU-bounded by block count.
+
+    With ``arena_bytes`` set, block payloads live in a preallocated Arena
+    (runtime/memory.py, the dynamo-memory role): a hard byte cap and zero
+    per-block allocator churn. Blocks the arena cannot fit (fragmentation)
+    spill straight to the next tier."""
 
     name = "host"
 
-    def __init__(self, capacity_blocks: int, *, next_tier: Optional["DiskTier"] = None) -> None:
+    def __init__(
+        self,
+        capacity_blocks: int,
+        *,
+        next_tier: Optional["DiskTier"] = None,
+        arena_bytes: Optional[int] = None,
+    ) -> None:
         self.capacity = capacity_blocks
         self.next_tier = next_tier
-        self._blocks: "OrderedDict[int, Block]" = OrderedDict()
+        self._blocks: "OrderedDict[int, Optional[Block]]" = OrderedDict()
+        self._staging = None
+        if arena_bytes:
+            from dynamo_tpu.runtime.memory import BlockStagingPool
+
+            self._staging = BlockStagingPool(arena_bytes)
         self.stats = TierStats()
 
     def __len__(self) -> int:
@@ -55,20 +71,40 @@ class HostTier:
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
             return
-        self._blocks[block_hash] = (np.asarray(k), np.asarray(v))
+        k, v = np.asarray(k), np.asarray(v)
+        if self._staging is not None:
+            if not self._staging.put(block_hash, k, v):
+                # Arena full: skip G2, spill straight down.
+                self.stats.evicted += 1
+                if self.next_tier is not None:
+                    self.next_tier.put(block_hash, k, v)
+                return
+            self._blocks[block_hash] = None  # payload lives in the arena
+        else:
+            self._blocks[block_hash] = (k, v)
         self.stats.stored += 1
         while len(self._blocks) > self.capacity:
             h, blk = self._blocks.popitem(last=False)
+            if self._staging is not None:
+                blk = self._staging.get(h)
+                spill = None if blk is None else (np.array(blk[0]), np.array(blk[1]))
+                self._staging.pop(h)
+                blk = spill
             self.stats.evicted += 1
-            if self.next_tier is not None:
+            if self.next_tier is not None and blk is not None:
                 self.next_tier.put(h, blk[0], blk[1])  # G2 → G3 spill
 
     def get(self, block_hash: int) -> Optional[Block]:
-        blk = self._blocks.get(block_hash)
-        if blk is not None:
+        if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
-            self.stats.hits += 1
-            return blk
+            blk = (
+                self._staging.get(block_hash)
+                if self._staging is not None
+                else self._blocks[block_hash]
+            )
+            if blk is not None:
+                self.stats.hits += 1
+                return blk
         self.stats.misses += 1
         if self.next_tier is not None:
             lower = self.next_tier.get(block_hash)
@@ -78,6 +114,9 @@ class HostTier:
         return None
 
     def clear(self) -> None:
+        if self._staging is not None:
+            for h in list(self._blocks):
+                self._staging.pop(h)
         self._blocks.clear()
 
 

@@ -204,6 +204,28 @@ class ModelWatcher:
                 timeout_s=self.canary_timeout_s,
             )
             health.start()
+        ns, comp = ep_info["namespace"], ep_info["component"]
+
+        async def clear_kv() -> int:
+            """Fan clear_kv_blocks out to every live worker instance
+            (ref: clear_kv_blocks.rs)."""
+            from dynamo_tpu.runtime.engine import collect
+
+            ctl = await (
+                self._runtime.namespace(ns).component(comp).endpoint("control").client()
+            )
+            cleared = 0
+            try:
+                for iid in list(ctl.instance_ids):
+                    try:
+                        out = await collect(ctl.direct({"op": "clear_kv_blocks"}, iid))
+                        cleared += int(out[-1].get("cleared", 0)) if out else 0
+                    except Exception:
+                        logger.exception("clear_kv_blocks on %#x failed", iid)
+            finally:
+                await ctl.close()
+            return cleared
+
         self._models[slug] = {
             "card": card,
             "client": client,
@@ -212,7 +234,10 @@ class ModelWatcher:
             "health": health,
             "instances": {doc["instance_id"]},
         }
-        self._manager.register(card.name, pipeline, card, monitor=monitor, health=health)
+        self._manager.register(
+            card.name, pipeline, card, monitor=monitor, health=health,
+            admin={"clear_kv": clear_kv},
+        )
         logger.info("model %s online (instance %x)", card.name, doc["instance_id"])
 
     async def _drop_instance(self, slug: str, iid_hex: str) -> None:

@@ -1,0 +1,142 @@
+"""Sharded-weight cache for fast worker restart.
+
+Reference parity: the GPU Memory Service + chrek role
+(lib/gpu_memory_service/README.md, deploy/chrek/) — the reference keeps
+weights resident across worker restarts so a respawned process skips the
+slow load path. The TPU-native equivalent: after the first checkpoint
+ingest (HF name-mapping, transposes, dtype casts — the expensive part),
+the engine-ready pytree is persisted as raw memory-mappable .npy leaves +
+a manifest. A respawned worker mmaps straight into device transfer — no
+safetensors walk, no per-tensor transform.
+
+Cache key = (checkpoint dir identity, config fingerprint), so a changed
+checkpoint or config never serves stale weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/dynamo_tpu/weights")
+
+
+def _fingerprint(model_dir: str, config: ModelConfig) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(os.path.abspath(model_dir).encode())
+    try:
+        for name in sorted(os.listdir(model_dir)):
+            if name.endswith((".safetensors", ".json")):
+                st = os.stat(os.path.join(model_dir, name))
+                h.update(f"{name}:{st.st_size}:{int(st.st_mtime)}".encode())
+    except OSError:
+        pass
+    cfg = {k: str(v) for k, v in sorted(vars(config).items())}
+    h.update(json.dumps(cfg, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_params(cache_dir: str, key: str, params: Any) -> str:
+    """Persist a param pytree as raw .npy leaves + manifest. Returns path."""
+    root = os.path.join(cache_dir, key)
+    tmp = root + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    manifest: Dict[str, Any] = {"leaves": {}}
+    for name, leaf in _flatten(params).items():
+        arr = np.asarray(leaf)
+        dtype = str(arr.dtype)
+        if dtype == "bfloat16":  # raw bytes; np.save handles ml_dtypes fine,
+            arr = arr.view(np.uint16)  # but raw u16 keeps loads dependency-lean
+        fname = name.replace("/", "_") + ".npy"
+        np.save(os.path.join(tmp, fname), arr, allow_pickle=False)
+        manifest["leaves"][name] = {"file": fname, "dtype": dtype,
+                                    "shape": list(np.asarray(leaf).shape)}
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    # Atomic publish: a crashed writer never leaves a half cache.
+    if os.path.exists(root):
+        import shutil
+
+        shutil.rmtree(root)
+    os.replace(tmp, root)
+    logger.info("weight cache written: %s (%d leaves)", root, len(manifest["leaves"]))
+    return root
+
+
+def load_params(cache_dir: str, key: str) -> Optional[Dict[str, Any]]:
+    """mmap-load a cached pytree; None if absent/corrupt."""
+    root = os.path.join(cache_dir, key)
+    manifest_path = os.path.join(root, "manifest.json")
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        flat: Dict[str, Any] = {}
+        for name, meta in manifest["leaves"].items():
+            arr = np.load(os.path.join(root, meta["file"]), mmap_mode="r",
+                          allow_pickle=False)
+            if meta["dtype"] == "bfloat16":
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            flat[name] = jax.numpy.asarray(arr)
+        return _unflatten(flat)
+    except (OSError, KeyError, ValueError) as exc:
+        logger.warning("weight cache %s unreadable (%s); ignoring", root, exc)
+        return None
+
+
+def load_checkpoint_cached(
+    model_dir: str,
+    config: ModelConfig,
+    *,
+    cache_dir: str = DEFAULT_CACHE_DIR,
+) -> Tuple[Dict[str, Any], bool]:
+    """HF checkpoint → engine pytree, through the restart cache.
+
+    Returns (params, was_cache_hit)."""
+    key = _fingerprint(model_dir, config)
+    cached = load_params(cache_dir, key)
+    if cached is not None:
+        logger.info("weight cache hit for %s", model_dir)
+        return cached, True
+    from dynamo_tpu.models.hf_loader import load_hf_checkpoint
+
+    params = load_hf_checkpoint(model_dir, config)
+    try:
+        save_params(cache_dir, key, params)
+    except OSError:
+        logger.exception("weight cache write failed; serving uncached")
+    return params, False

@@ -1,0 +1,118 @@
+"""ctypes wrapper for the C++ radix index (radix_index.cpp).
+
+Same surface as tokens/radix.py::RadixTree; ``make_radix_tree()`` returns
+the native tree when the extension is available, the Python one otherwise.
+Worker keys (worker_id, dp_rank) are interned to dense uint32 handles on
+the Python side (C++ sees opaque worker handles, matching the reference's
+WorkerId indirection).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from dynamo_tpu.native import load_radix_lib
+from dynamo_tpu.tokens.radix import OverlapScores, RadixTree, WorkerKey
+
+_MASK64 = (1 << 64) - 1
+_MAX_WORKERS_OUT = 4096
+
+
+def _hash_array(hashes: Sequence[int]):
+    n = len(hashes)
+    arr = (ctypes.c_uint64 * n)(*[h & _MASK64 for h in hashes])
+    return arr, n
+
+
+class NativeRadixTree:
+    def __init__(self, lib) -> None:
+        self._lib = lib
+        self._tree = lib.radix_new()
+        self._intern: Dict[WorkerKey, int] = {}
+        self._rev: List[WorkerKey] = []
+
+    def __del__(self):
+        tree = getattr(self, "_tree", None)
+        if tree:
+            self._lib.radix_free(tree)
+            self._tree = None
+
+    def _wid(self, worker: WorkerKey) -> int:
+        wid = self._intern.get(worker)
+        if wid is None:
+            wid = len(self._rev)
+            self._intern[worker] = wid
+            self._rev.append(worker)
+        return wid
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def num_blocks(self) -> int:
+        return int(self._lib.radix_num_blocks(self._tree))
+
+    @property
+    def workers(self) -> List[WorkerKey]:
+        return sorted(
+            w for w in self._intern if self.worker_block_count(w) > 0 or True
+        )
+
+    def worker_block_count(self, worker: WorkerKey) -> int:
+        wid = self._intern.get(worker)
+        if wid is None:
+            return 0
+        return int(self._lib.radix_worker_block_count(self._tree, wid))
+
+    # -- updates -----------------------------------------------------------
+
+    def store(
+        self,
+        worker: WorkerKey,
+        block_hashes: Sequence[int],
+        parent_hash: Optional[int] = None,
+    ) -> None:
+        arr, n = _hash_array(block_hashes)
+        self._lib.radix_store(
+            self._tree, self._wid(worker),
+            (parent_hash or 0) & _MASK64, int(parent_hash is not None),
+            arr, n,
+        )
+
+    def remove(self, worker: WorkerKey, block_hashes: Iterable[int]) -> None:
+        arr, n = _hash_array(list(block_hashes))
+        self._lib.radix_remove(self._tree, self._wid(worker), arr, n)
+
+    def remove_worker(self, worker: WorkerKey) -> None:
+        wid = self._intern.get(worker)
+        if wid is not None:
+            self._lib.radix_remove_worker(self._tree, wid)
+
+    def clear_worker(self, worker: WorkerKey) -> None:
+        self.remove_worker(worker)
+        self._wid(worker)  # stays known, holding nothing (radix.py parity)
+
+    # -- lookup ------------------------------------------------------------
+
+    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
+        arr, n = _hash_array(block_hashes)
+        out_w = (ctypes.c_uint32 * _MAX_WORKERS_OUT)()
+        out_s = (ctypes.c_uint32 * _MAX_WORKERS_OUT)()
+        matched = ctypes.c_uint32(0)
+        count = self._lib.radix_find_matches(
+            self._tree, arr, n, out_w, out_s, _MAX_WORKERS_OUT,
+            ctypes.byref(matched),
+        )
+        result = OverlapScores()
+        for i in range(count):
+            result.scores[self._rev[out_w[i]]] = int(out_s[i])
+        result.matched_blocks = int(matched.value)
+        return result
+
+
+def make_radix_tree():
+    """Native tree when available, Python RadixTree otherwise."""
+    lib = load_radix_lib()
+    if lib is not None:
+        return NativeRadixTree(lib)
+    return RadixTree()

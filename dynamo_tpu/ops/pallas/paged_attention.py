@@ -10,21 +10,25 @@ reference's own in-tree kernel is lib/llm/src/kernels/block_copy.cu (block
 movement), covered here by ops/pallas/block_copy.py.
 
 TPU-first design (not a CUDA translation):
-  - The grid is (batch, page). The per-sequence block table is a
-    scalar-prefetch operand; the K/V page for each grid step is selected by
-    the BlockSpec index_map reading the table, so the pallas pipeline
-    double-buffers the scattered HBM->VMEM page streams automatically --
-    pages never materialize as a dense [B, T, KH, D] gather in HBM (the XLA
-    oracle's O(padded-context) HBM-traffic problem).
-  - Each page DMA carries ALL kv heads (one [bs, KH, D] transfer, not KH
-    small ones -- Mosaic wants the last two block dims full anyway); the
-    small static KH loop is unrolled in the kernel body.
+  - The grid is (batch, page-group). The per-sequence block table is a
+    scalar-prefetch operand; each grid step DMAs ``pages_per_step`` K/V
+    pages selected by BlockSpec index_maps reading the table, so the pallas
+    pipeline double-buffers the scattered HBM→VMEM page streams
+    automatically — pages never materialize as a dense [B, T, KH, D] gather
+    in HBM (the XLA oracle's O(padded-context) HBM-traffic problem).
+  - Multiple pages per grid step matter on TPU: the grid is sequential, so
+    per-iteration overhead × (B × P) dominated decode at large batch; the
+    in-kernel concat builds one [S·bs, D] key block per head and runs ONE
+    MXU dot per head per step instead of S skinny ones.
+  - Each page DMA carries ALL kv heads (one [bs, KH, D] transfer — Mosaic
+    wants the last two block dims full anyway); the small static KH loop is
+    unrolled in the kernel body.
   - Flash-style online softmax: running max / normalizer / weighted
-    accumulator live in VMEM scratch across the page axis (the innermost,
-    sequentially-iterated grid dimension); the output block is written once
-    on the last page.
-  - Pages past a sequence's valid length skip all compute via pl.when (their
-    DMA is pipelined and their masked contributions would be zero anyway).
+    accumulator live in VMEM scratch across the page-group axis (the
+    innermost, sequentially-iterated grid dimension); the output block is
+    written once on the last step.
+  - Page groups wholly past a sequence's valid length skip all compute via
+    pl.when; partially-valid groups are handled by the causal mask.
   - All dots run on the MXU in float32 via preferred_element_type; the cache
     stays bfloat16 in HBM.
 """
@@ -44,31 +48,30 @@ NEG_INF = -1e30
 
 def _kernel(
     # scalar prefetch
-    block_tables_ref,  # [B, P] int32 (SMEM)
+    block_tables_ref,  # [B, P_pad] int32 (SMEM)
     start_pos_ref,  # [B] int32
     chunk_lens_ref,  # [B] int32
-    # VMEM blocks
+    # VMEM blocks: q, then S (k, v) page pairs
     q_ref,  # [1, KH, C*G, D] (host pre-transposed: rows are (c, g), c-major)
-    k_ref,  # [1, bs, KH, D]
-    v_ref,  # [1, bs, KH, D]
-    o_ref,  # [1, KH, C*G, D]
-    # scratch
-    m_ref,  # [KH, C*G, 1] f32
-    l_ref,  # [KH, C*G, 1] f32
-    acc_ref,  # [KH, C*G, D] f32
-    *,
+    *refs,  # k_0, v_0, ..., k_{S-1}, v_{S-1}, o_ref, m, l, acc
     sm_scale: float,
     block_size: int,
     n_groups: int,
+    pages_per_step: int,
 ):
+    S = pages_per_step
+    kv_refs = refs[: 2 * S]
+    o_ref = refs[2 * S]
+    m_ref, l_ref, acc_ref = refs[2 * S + 1 :]
+
     b = pl.program_id(0)
     p = pl.program_id(1)
-    num_pages = pl.num_programs(1)
+    num_steps = pl.num_programs(1)
 
     KH = q_ref.shape[1]
     CG = q_ref.shape[2]
-    D = q_ref.shape[3]
     G = n_groups
+    W = S * block_size  # keys visited per grid step
 
     start = start_pos_ref[b]
     clen = chunk_lens_ref[b]
@@ -83,34 +86,37 @@ def _kernel(
     # start + clen - 1 (the chunk's own K/V are already in the cache).
     last_needed_page = jnp.maximum(start + clen - 1, 0) // block_size
 
-    @pl.when(p <= last_needed_page)
+    @pl.when(p * S <= last_needed_page)
     def _compute():
-        # Causal mask, shared by every head: key position t visible to query
-        # offset c iff t <= start + c. Rows are (c, g) pairs, c-major.
-        c_idx = jax.lax.broadcasted_iota(jnp.int32, (CG, block_size), 0) // G
-        t_idx = p * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (CG, block_size), 1
-        )
+        # Causal mask across the whole page group, shared by every head:
+        # key position t visible to query offset c iff t <= start + c.
+        # Rows are (c, g) pairs, c-major.
+        c_idx = jax.lax.broadcasted_iota(jnp.int32, (CG, W), 0) // G
+        t_idx = p * W + jax.lax.broadcasted_iota(jnp.int32, (CG, W), 1)
         visible = t_idx <= start + c_idx
 
         for h in range(KH):  # static unroll; KH is small (2-8)
             q = q_ref[0, h].astype(jnp.float32)  # [CG, D]
-            k = k_ref[0, :, h, :].astype(jnp.float32)  # [bs, D]
-            v = v_ref[0, :, h, :].astype(jnp.float32)  # [bs, D]
+            k = jnp.concatenate(
+                [kv_refs[2 * s][0, :, h, :] for s in range(S)], axis=0
+            ).astype(jnp.float32)  # [W, D]
+            v = jnp.concatenate(
+                [kv_refs[2 * s + 1][0, :, h, :] for s in range(S)], axis=0
+            ).astype(jnp.float32)  # [W, D]
 
-            s = (
+            s_mat = (
                 jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
                 * sm_scale
-            )  # [CG, bs]
-            s = jnp.where(visible, s, NEG_INF)
+            )  # [CG, W]
+            s_mat = jnp.where(visible, s_mat, NEG_INF)
 
             m_prev = m_ref[h]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            m_new = jnp.maximum(m_prev, jnp.max(s_mat, axis=-1, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
-            probs = jnp.exp(s - m_new)
+            probs = jnp.exp(s_mat - m_new)
             l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
             acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
                 probs, v, (((1,), (0,)), ((), ())),
@@ -118,7 +124,7 @@ def _kernel(
             )
             m_ref[h] = m_new
 
-    @pl.when(p == num_pages - 1)
+    @pl.when(p == num_steps - 1)
     def _finalize():
         # Every query row sees at least key t=0 (0 <= start + c always), so
         # l is strictly positive for rows that matter.
@@ -127,7 +133,9 @@ def _kernel(
             o_ref[0, h] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_step")
+)
 def paged_attention_kernel(
     q: jnp.ndarray,  # [B, C, n_heads, head_dim]
     k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
@@ -138,6 +146,10 @@ def paged_attention_kernel(
     *,
     sm_scale: Optional[float] = None,
     interpret: bool = False,
+    # Measured on v5e: 1 page/step wins — Mosaic lowers the in-kernel concat
+    # to VMEM copies that cost more than the per-iteration overhead saved.
+    # The knob stays for future Mosaic versions / other topologies.
+    pages_per_step: int = 1,
 ) -> jnp.ndarray:
     """Returns [B, C, n_heads, head_dim]; same contract as the XLA oracle
     (ops/attention.py::_paged_attention_xla)."""
@@ -146,6 +158,13 @@ def paged_attention_kernel(
     P = block_tables.shape[1]
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
+    S = max(min(pages_per_step, P), 1)
+
+    # Pad the table width to a multiple of S; padded entries point at page 0
+    # whose keys land beyond every sequence's causal limit (masked).
+    P_pad = ((P + S - 1) // S) * S
+    if P_pad != P:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, P_pad - P)))
 
     # [B, C, H, D] -> [B, KH, C*G, D]: per-head row blocks, (c, g) c-major.
     # The transpose runs in XLA outside the kernel (fused, cheap) and lets
@@ -157,17 +176,25 @@ def paged_attention_kernel(
     def q_map(b, p, bt, sp, cl):
         return (b, 0, 0, 0)
 
-    def kv_map(b, p, bt, sp, cl):
-        return (bt[b, p], 0, 0, 0)
+    def kv_map_for(s):
+        def kv_map(b, p, bt, sp, cl):
+            return (bt[b, p * S + s], 0, 0, 0)
+
+        return kv_map
+
+    kv_spec = lambda s: pl.BlockSpec(  # noqa: E731
+        (1, block_size, n_kv_heads, head_dim), kv_map_for(s)
+    )
+    in_specs = [pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map)]
+    kv_args = []
+    for s in range(S):
+        in_specs.extend([kv_spec(s), kv_spec(s)])
+        kv_args.extend([k_cache, v_cache])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, P),
-        in_specs=[
-            pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map),
-            pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map),
-            pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map),
-        ],
+        grid=(B, P_pad // S),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map),
         scratch_shapes=[
             pltpu.VMEM((n_kv_heads, C * G, 1), jnp.float32),
@@ -177,7 +204,8 @@ def paged_attention_kernel(
     )
 
     kernel = functools.partial(
-        _kernel, sm_scale=scale, block_size=block_size, n_groups=G
+        _kernel, sm_scale=scale, block_size=block_size, n_groups=G,
+        pages_per_step=S,
     )
     out = pl.pallas_call(
         kernel,
@@ -191,8 +219,7 @@ def paged_attention_kernel(
         start_pos.astype(jnp.int32),
         chunk_lens.astype(jnp.int32),
         q5,
-        k_cache,
-        v_cache,
+        *kv_args,
     )
     out = out.reshape(B, n_kv_heads, C, G, head_dim).transpose(0, 2, 1, 3, 4)
     return out.reshape(B, C, n_heads, head_dim)

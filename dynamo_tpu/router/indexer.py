@@ -20,7 +20,10 @@ logger = get_logger(__name__)
 class KvIndexer:
     def __init__(self, block_size: int) -> None:
         self.block_size = block_size
-        self.tree = RadixTree()
+        # C++ tree when buildable (native/radix.py), Python tree otherwise.
+        from dynamo_tpu.native.radix import make_radix_tree
+
+        self.tree = make_radix_tree()
         self._events_applied = 0
         self._last_event_id: Dict[WorkerKey, int] = {}
 

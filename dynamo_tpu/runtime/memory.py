@@ -1,0 +1,171 @@
+"""Host memory arena: preallocated, region-based staging buffers.
+
+Reference parity: the dynamo-memory crate (lib/memory — arena/pinned-pool
+abstractions under KVBM and the NIXL staging paths). On TPU hosts there is
+no cudaHostAlloc; the analogous win is *bounded, reusable* staging memory:
+one up-front allocation, O(1) region alloc/free, zero per-block allocator
+churn for KV offload and disagg transfers — and a hard cap so a busy host
+tier cannot OOM the process.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ArenaExhausted(MemoryError):
+    """No region large enough (capacity or fragmentation)."""
+
+
+@dataclass
+class Region:
+    """A leased slice of the arena."""
+
+    offset: int
+    nbytes: int
+    _freed: bool = False
+
+
+class Arena:
+    """First-fit region allocator over one preallocated buffer.
+
+    Free regions are kept sorted by offset and coalesced on free. Designed
+    for few, large, similarly-sized regions (KV blocks), where first-fit's
+    fragmentation behavior is excellent and allocation is O(#free regions).
+    Thread-safe: device/staging threads allocate while the loop frees.
+    """
+
+    def __init__(self, capacity_bytes: int) -> None:
+        self.capacity = int(capacity_bytes)
+        self._buf = np.zeros(self.capacity, dtype=np.uint8)
+        self._free: List[List[int]] = [[0, self.capacity]]  # [offset, size]
+        self._lock = threading.Lock()
+        self.allocated_bytes = 0
+        self.peak_bytes = 0
+
+    def alloc(self, nbytes: int) -> Region:
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            raise ValueError("nbytes must be positive")
+        # 64-byte alignment: keeps numpy views cache/DMA friendly.
+        nbytes = (nbytes + 63) & ~63
+        with self._lock:
+            for i, (off, size) in enumerate(self._free):
+                if size >= nbytes:
+                    if size == nbytes:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = [off + nbytes, size - nbytes]
+                    self.allocated_bytes += nbytes
+                    self.peak_bytes = max(self.peak_bytes, self.allocated_bytes)
+                    return Region(offset=off, nbytes=nbytes)
+        raise ArenaExhausted(
+            f"arena cannot satisfy {nbytes}B "
+            f"(capacity {self.capacity}B, allocated {self.allocated_bytes}B)"
+        )
+
+    def free(self, region: Region) -> None:
+        with self._lock:
+            if region._freed:
+                return
+            region._freed = True
+            self.allocated_bytes -= region.nbytes
+            # Insert sorted by offset, then coalesce neighbors.
+            entry = [region.offset, region.nbytes]
+            lo, hi = 0, len(self._free)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self._free[mid][0] < entry[0]:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            self._free.insert(lo, entry)
+            # coalesce with next
+            if lo + 1 < len(self._free) and entry[0] + entry[1] == self._free[lo + 1][0]:
+                entry[1] += self._free[lo + 1][1]
+                self._free.pop(lo + 1)
+            # coalesce with prev
+            if lo > 0 and self._free[lo - 1][0] + self._free[lo - 1][1] == entry[0]:
+                self._free[lo - 1][1] += entry[1]
+                self._free.pop(lo)
+
+    def view(self, region: Region, dtype=np.uint8, shape=None) -> np.ndarray:
+        """Zero-copy numpy view of a region."""
+        if region._freed:
+            raise ValueError("region already freed")
+        raw = self._buf[region.offset : region.offset + region.nbytes]
+        out = raw.view(dtype)
+        if shape is not None:
+            n = int(np.prod(shape))
+            out = out[:n].reshape(shape)
+        return out
+
+    def store(self, array: np.ndarray) -> Region:
+        """Copy an array into a fresh region (view(r, dt, shape) reads it)."""
+        a = np.ascontiguousarray(array)
+        region = self.alloc(a.nbytes)
+        self.view(region, a.dtype, a.shape)[...] = a
+        return region
+
+    @property
+    def free_bytes(self) -> int:
+        with self._lock:
+            return sum(size for _, size in self._free)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "allocated": self.allocated_bytes,
+                "peak": self.peak_bytes,
+                "free_regions": len(self._free),
+            }
+
+
+class BlockStagingPool:
+    """Arena-backed (k, v) block store for the KVBM host tier.
+
+    Bounds the host tier's memory to exactly ``capacity_bytes`` no matter
+    how many blocks pass through, replacing per-block numpy allocations."""
+
+    def __init__(self, capacity_bytes: int) -> None:
+        self.arena = Arena(capacity_bytes)
+        self._meta: Dict[int, tuple] = {}  # hash → (kr, vr, dtype, shape)
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+        if block_hash in self._meta:
+            return True
+        try:
+            kr = self.arena.store(k)
+        except ArenaExhausted:
+            return False
+        try:
+            vr = self.arena.store(v)
+        except ArenaExhausted:
+            self.arena.free(kr)
+            return False
+        self._meta[block_hash] = (kr, vr, k.dtype, k.shape)
+        return True
+
+    def get(self, block_hash: int):
+        meta = self._meta.get(block_hash)
+        if meta is None:
+            return None
+        kr, vr, dtype, shape = meta
+        return self.arena.view(kr, dtype, shape), self.arena.view(vr, dtype, shape)
+
+    def pop(self, block_hash: int) -> None:
+        meta = self._meta.pop(block_hash, None)
+        if meta is not None:
+            self.arena.free(meta[0])
+            self.arena.free(meta[1])
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._meta
+
+    def __len__(self) -> int:
+        return len(self._meta)

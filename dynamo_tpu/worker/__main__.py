@@ -71,6 +71,9 @@ async def main() -> None:
     parser.add_argument("--lora-dir", default=None,
                         help="directory of PEFT LoRA adapters to serve "
                         "(ref: lib/llm/src/lora.rs)")
+    parser.add_argument("--weight-cache-dir", default=None,
+                        help="fast-restart weight cache (GMS-role, "
+                        "models/weight_cache.py); default ~/.cache/dynamo_tpu")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -85,9 +88,16 @@ async def main() -> None:
     else:
         model_path = args.model
         model_config = ModelConfig.from_model_dir(args.model)
-        from dynamo_tpu.models.hf_loader import load_hf_checkpoint
+        from dynamo_tpu.models.weight_cache import (
+            DEFAULT_CACHE_DIR,
+            load_checkpoint_cached,
+        )
 
-        params = load_hf_checkpoint(args.model, model_config)
+        params, cache_hit = load_checkpoint_cached(
+            args.model, model_config,
+            cache_dir=args.weight_cache_dir or DEFAULT_CACHE_DIR,
+        )
+        print(f"weights loaded (cache {'hit' if cache_hit else 'miss'})", flush=True)
 
     mesh = None
     if args.tensor_parallel_size > 1:
@@ -149,6 +159,20 @@ async def main() -> None:
     served_kv = await kv_endpoint.serve_endpoint(
         KvTransferHandler(engine).generate, instance_id=instance_id
     )
+
+    async def control(request, context):
+        """Admin ops (ref: clear_kv_blocks.rs; fanned out by the frontend)."""
+        op = request.get("op") if isinstance(request, dict) else None
+        if op == "clear_kv_blocks":
+            yield {"cleared": engine.clear_kv_blocks()}
+        elif op == "stats":
+            yield engine.stats()
+        else:
+            yield {"error": f"unknown control op {op!r}"}
+
+    served_ctl = await component.endpoint("control").serve_endpoint(
+        control, instance_id=instance_id
+    )
     if args.is_prefill_worker:
         handler = PrefillHandler(engine, instance_id)
         served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
@@ -181,6 +205,7 @@ async def main() -> None:
         await load_pub.close()
         await kv_pub.close()
         await served.shutdown(grace_period=config.GRACE_PERIOD.get())
+        await served_ctl.shutdown(grace_period=5)
         await served_kv.shutdown(grace_period=5)
         await engine.stop()
         await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())

@@ -188,3 +188,77 @@ async def test_annotations_as_sse_comments():
     finally:
         await engine.stop()
         await service.stop(grace_period=1)
+
+
+async def test_responses_api():
+    """OpenAI Responses API over the chat pipeline (ref: openai.rs:1179)."""
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/responses",
+                json={"model": "mock-model", "input": "hello there",
+                      "max_output_tokens": 6},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "response"
+            assert body["status"] == "completed"
+            msg = body["output"][0]
+            assert msg["role"] == "assistant"
+            assert isinstance(msg["content"][0]["text"], str)
+            assert body["usage"]["output_tokens"] == 6
+
+            # message-list input form
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/responses",
+                json={"model": "mock-model",
+                      "input": [{"role": "user", "content": "hi"}],
+                      "max_output_tokens": 3},
+            )
+            assert r.status == 200
+
+            # unsupported field → 501
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/responses",
+                json={"model": "mock-model", "input": "x",
+                      "tools": [{"type": "function"}]},
+            )
+            assert r.status == 501
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_openapi_and_clear_kv_routes():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            r = await session.get(f"http://127.0.0.1:{port}/openapi.json")
+            doc = await r.json()
+            assert "/v1/chat/completions" in doc["paths"]
+            assert "/clear_kv_blocks" in doc["paths"]
+
+            # local pipeline has no clear hook: reported per model, not a 500
+            r = await session.post(f"http://127.0.0.1:{port}/clear_kv_blocks")
+            assert r.status == 200
+            body = await r.json()
+            assert "no clear_kv hook" in body["results"]["mock-model"]["error"]
+
+            called = []
+
+            async def fake_clear():
+                called.append(1)
+                return 7
+
+            service.models.get("mock-model").admin["clear_kv"] = fake_clear
+            r = await session.post(
+                f"http://127.0.0.1:{port}/clear_kv_blocks",
+                json={"model": "mock-model"},
+            )
+            body = await r.json()
+            assert body["results"]["mock-model"]["cleared_blocks"] == 7
+            assert called
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)

@@ -1,0 +1,163 @@
+"""Memory arena (dynamo-memory role) + fast-restart weight cache (GMS role)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.kvbm.tiers import HostTier
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.memory import (
+    Arena,
+    ArenaExhausted,
+    BlockStagingPool,
+    Region,
+)
+
+
+class TestArena:
+    def test_alloc_view_free_roundtrip(self):
+        a = Arena(1 << 16)
+        r = a.alloc(1000)
+        assert r.nbytes == 1024  # 64-aligned
+        view = a.view(r, np.float32, (256,))
+        view[:] = np.arange(256, dtype=np.float32)
+        np.testing.assert_array_equal(
+            a.view(r, np.float32, (256,)), np.arange(256, dtype=np.float32)
+        )
+        a.free(r)
+        assert a.allocated_bytes == 0
+        with pytest.raises(ValueError):
+            a.view(r)
+
+    def test_exhaustion_and_reuse(self):
+        a = Arena(4096)
+        regions = [a.alloc(1024) for _ in range(4)]
+        with pytest.raises(ArenaExhausted):
+            a.alloc(64)
+        a.free(regions[1])
+        r = a.alloc(512)  # fits in the hole
+        assert r.offset == regions[1].offset
+
+    def test_coalescing(self):
+        a = Arena(4096)
+        rs = [a.alloc(1024) for _ in range(4)]
+        for r in rs:
+            a.free(r)
+        # fully coalesced: one region able to hold everything again
+        big = a.alloc(4096)
+        assert big.offset == 0
+
+    def test_double_free_is_noop(self):
+        a = Arena(1024)
+        r = a.alloc(64)
+        a.free(r)
+        a.free(r)
+        assert a.free_bytes == 1024
+
+    def test_store_helper(self):
+        a = Arena(1 << 14)
+        arr = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+        r = a.store(arr)
+        np.testing.assert_array_equal(a.view(r, arr.dtype, arr.shape), arr)
+
+
+class TestStagingPool:
+    def test_put_get_pop(self):
+        pool = BlockStagingPool(1 << 16)
+        k = np.ones((2, 4, 2, 8), np.float32)
+        v = np.full((2, 4, 2, 8), 2.0, np.float32)
+        assert pool.put(7, k, v)
+        kk, vv = pool.get(7)
+        np.testing.assert_array_equal(kk, k)
+        np.testing.assert_array_equal(vv, v)
+        pool.pop(7)
+        assert pool.get(7) is None
+        assert pool.arena.allocated_bytes == 0
+
+    def test_rejects_when_full(self):
+        pool = BlockStagingPool(1024)
+        big = np.zeros(4096, np.uint8)
+        assert not pool.put(1, big, big)
+        assert pool.arena.allocated_bytes == 0  # no leak from half-stores
+
+
+class TestHostTierArena:
+    def test_arena_backed_tier_roundtrip_and_spill(self, tmp_path):
+        from dynamo_tpu.kvbm.tiers import DiskTier
+
+        disk = DiskTier(str(tmp_path / "spool"))
+        tier = HostTier(2, next_tier=disk, arena_bytes=1 << 20)
+        mk = lambda x: np.full((2, 4, 2, 8), float(x), np.float32)  # noqa: E731
+        for h in (1, 2, 3):
+            tier.put(h, mk(h), mk(h * 10))
+        # capacity 2: block 1 spilled to disk
+        assert len(tier) == 2
+        assert disk.contains(1)
+        k, v = tier.get(2)
+        np.testing.assert_array_equal(k, mk(2))
+        # promote from disk through the arena path
+        k, v = tier.get(1)
+        np.testing.assert_array_equal(v, mk(10))
+        tier.clear()
+        assert tier._staging.arena.allocated_bytes == 0
+
+
+class TestWeightCache:
+    def _model_dir(self, tmp_path):
+        import torch
+        import transformers
+
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64,
+        )
+        model = transformers.LlamaForCausalLM(cfg).eval().to(torch.float32)
+        d = tmp_path / "model"
+        model.save_pretrained(str(d), safe_serialization=True)
+        return str(d)
+
+    def test_cache_hit_identical_params(self, tmp_path):
+        pytest.importorskip("transformers")
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.models.weight_cache import load_checkpoint_cached
+
+        model_dir = self._model_dir(tmp_path)
+        config = dataclasses.replace(
+            ModelConfig.from_model_dir(model_dir), dtype=jnp.float32
+        )
+        cache = str(tmp_path / "wcache")
+        p1, hit1 = load_checkpoint_cached(model_dir, config, cache_dir=cache)
+        assert not hit1
+        p2, hit2 = load_checkpoint_cached(model_dir, config, cache_dir=cache)
+        assert hit2
+        import jax
+
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_config_change_invalidates(self, tmp_path):
+        pytest.importorskip("transformers")
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.models.weight_cache import _fingerprint
+
+        model_dir = self._model_dir(tmp_path)
+        c1 = ModelConfig.from_model_dir(model_dir)
+        c2 = dataclasses.replace(c1, rope_theta=123.0)
+        assert _fingerprint(model_dir, c1) != _fingerprint(model_dir, c2)
+
+    def test_bf16_roundtrip(self, tmp_path):
+        from dynamo_tpu.models.weight_cache import load_params, save_params
+
+        params = {"layers": {"w": jnp.ones((4, 8), jnp.bfloat16) * 1.5},
+                  "embed": jnp.zeros((8,), jnp.float32)}
+        save_params(str(tmp_path), "k1", params)
+        loaded = load_params(str(tmp_path), "k1")
+        assert loaded["layers"]["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"]["w"], dtype=np.float32),
+            np.full((4, 8), 1.5, np.float32),
+        )
+        assert load_params(str(tmp_path), "missing") is None
