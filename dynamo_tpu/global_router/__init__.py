@@ -1,0 +1,24 @@
+"""Hierarchical (global) routing across worker pools.
+
+Reference parity: components/src/dynamo/global_router — a service that
+registers as a worker from the frontend's perspective but internally
+forwards each request to one of several *pools* (namespaces with their own
+workers/local routers), picked by a grid strategy over (ISL, TTFT target)
+for prefill-bound traffic and (context length, ITL target) for decode.
+Hierarchical routing is how deployments mix heterogeneous pools (different
+slice sizes, different models-of-the-same-family, spot vs reserved).
+"""
+
+from dynamo_tpu.global_router.pools import (
+    GlobalRouterConfig,
+    GridStrategy,
+    PoolSpec,
+)
+from dynamo_tpu.global_router.handler import GlobalRouterHandler
+
+__all__ = [
+    "GlobalRouterConfig",
+    "GridStrategy",
+    "PoolSpec",
+    "GlobalRouterHandler",
+]

@@ -1,0 +1,60 @@
+"""Global router service entrypoint.
+
+Reference parity: components/src/dynamo/global_router/__main__.py — register
+as a worker for the model, forward into per-pool namespaces.
+
+Usage:
+  python -m dynamo_tpu.global_router --config pools.json --model-name m \
+      --namespace edge
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+
+from dynamo_tpu import config
+from dynamo_tpu.global_router.handler import GlobalRouterHandler
+from dynamo_tpu.global_router.pools import GlobalRouterConfig
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu global router")
+    parser.add_argument("--config", required=True, help="pool config JSON")
+    parser.add_argument("--model-name", required=True)
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--context-length", type=int, default=8192)
+    args = parser.parse_args()
+
+    configure_logging()
+    runtime = DistributedRuntime.from_settings()
+    handler = GlobalRouterHandler(runtime, GlobalRouterConfig.from_file(args.config))
+    instance_id = random.getrandbits(63)
+    endpoint = (
+        runtime.namespace(args.namespace)
+        .component(args.component)
+        .endpoint("generate")
+    )
+    served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
+    card = ModelDeploymentCard(
+        name=args.model_name, context_length=args.context_length
+    )
+    await register_llm(runtime, card, endpoint, instance_id)
+    print(f"global router serving {args.model_name} over "
+          f"{len(handler.config.pools)} pools", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await handler.close()
+        await served.shutdown(grace_period=config.GRACE_PERIOD.get())
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

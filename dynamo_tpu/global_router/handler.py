@@ -1,0 +1,118 @@
+"""GlobalRouterHandler: a worker-shaped bridge into per-pool namespaces.
+
+Reference parity: global_router/handler.py (GlobalRouterHandler — registers
+via register_llm like any worker, then forwards each request to the local
+router/workers of the selected pool's namespace). Pool clients are created
+lazily and cached; a pool with no live instances falls through to the next
+best pool instead of failing the request.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.global_router.pools import GlobalRouterConfig
+from dynamo_tpu.runtime.component import NoInstancesError, RouterMode
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class GlobalRouterHandler:
+    def __init__(
+        self,
+        runtime: Any,
+        config: GlobalRouterConfig,
+        *,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    ) -> None:
+        config.validate()
+        self.runtime = runtime
+        self.config = config
+        self.router_mode = router_mode
+        self._clients: Dict[int, Any] = {}
+        # observability: per-pool forwarded request counts
+        self.pool_requests: Dict[int, int] = {}
+
+    async def _client(self, pool_idx: int) -> Any:
+        client = self._clients.get(pool_idx)
+        if client is None:
+            spec = self.config.pools[pool_idx]
+            client = await (
+                self.runtime.namespace(spec.namespace)
+                .component(spec.component)
+                .endpoint(spec.endpoint)
+                .client(self.router_mode)
+            )
+            self._clients[pool_idx] = client
+        return client
+
+    def select_pool(self, request: Any) -> int:
+        """(ISL, TTFT target) through the prefill grid; decode-only
+        continuations (disaggregated_params present) use the decode grid
+        keyed by context length."""
+        token_ids = (
+            request.get("token_ids")
+            if isinstance(request, dict)
+            else getattr(request, "token_ids", None)
+        ) or []
+        isl = len(token_ids)
+        extra = (
+            request.get("extra")
+            if isinstance(request, dict)
+            else getattr(request, "extra", None)
+        ) or {}
+        ttft_target = extra.get("ttft_target_ms")
+        itl_target = extra.get("itl_target_ms")
+        disagg = (
+            request.get("disaggregated_params")
+            if isinstance(request, dict)
+            else getattr(request, "disaggregated_params", None)
+        )
+        if disagg is not None and self.config.decode_strategy is not None:
+            return self.config.decode_strategy.select(isl, itl_target)
+        if self.config.prefill_strategy is not None:
+            return self.config.prefill_strategy.select(isl, ttft_target)
+        return 0
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        pool_idx = self.select_pool(request)
+        order = [pool_idx] + [
+            i for i in range(len(self.config.pools)) if i != pool_idx
+        ]
+        last_error: Optional[Exception] = None
+        for idx in order:
+            client = await self._client(idx)
+            try:
+                child = Context(parent=context, baggage=dict(context.baggage))
+                stream = client.generate(request, child)
+                first = await stream.__anext__()
+            except (NoInstancesError, StopAsyncIteration) as exc:
+                # Pool empty/dead: fall through to the next (ref: the
+                # global router's resilience goal — a drained pool must not
+                # fail traffic that another pool can serve).
+                logger.warning("pool %d unavailable (%s); trying next", idx, exc)
+                last_error = exc if isinstance(exc, Exception) else None
+                continue
+            self.pool_requests[idx] = self.pool_requests.get(idx, 0) + 1
+            if idx != pool_idx:
+                logger.info("request diverted from pool %d to %d", pool_idx, idx)
+            yield first
+            async for item in stream:
+                yield item
+            return
+        raise NoInstancesError(
+            f"no pool could serve the request (last error: {last_error})"
+        )
+
+    def get_pool_info(self) -> Dict[str, Any]:
+        return {
+            "pools": [vars(p) for p in self.config.pools],
+            "requests_per_pool": dict(self.pool_requests),
+        }
+
+    async def close(self) -> None:
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()

@@ -1,0 +1,113 @@
+"""Pool specs + grid-based selection strategies.
+
+Reference parity: global_router/pool_selection.py (PrefillPoolSelectionStrategy
+/ DecodePoolSelectionStrategy — an (x, y) grid of pool indices with clamped
+lookup). One generic GridStrategy covers both axes pairs here; the JSON
+config shape mirrors the reference's global_router_config.json.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class PoolSpec:
+    """One routable pool: a namespace (its own workers + optional local
+    router), with the component/endpoint the pool serves on."""
+
+    namespace: str
+    component: str = "backend"
+    endpoint: str = "generate"
+
+
+@dataclass
+class GridStrategy:
+    """pool = grid[x_idx][y_idx], indices clamped to the grid bounds.
+
+    x is the request property (ISL or context length), y the SLA target
+    (TTFT or ITL); ``select`` falls back to the y-range midpoint when the
+    request carries no target (ref: pool_selection.py select_pool)."""
+
+    x_min: float
+    x_max: float
+    y_min: float
+    y_max: float
+    mapping: List[List[int]]  # [x_resolution][y_resolution] → pool index
+
+    @property
+    def x_resolution(self) -> int:
+        return len(self.mapping)
+
+    @property
+    def y_resolution(self) -> int:
+        return len(self.mapping[0]) if self.mapping else 0
+
+    def _idx(self, value: float, lo: float, hi: float, resolution: int) -> int:
+        if resolution <= 1 or hi <= lo:
+            return 0
+        step = (hi - lo) / resolution
+        return max(0, min(int((value - lo) / step), resolution - 1))
+
+    def select(self, x: float, y: Optional[float] = None) -> int:
+        if y is None:
+            y = (self.y_min + self.y_max) / 2
+        xi = self._idx(x, self.x_min, self.x_max, self.x_resolution)
+        yi = self._idx(y, self.y_min, self.y_max, self.y_resolution)
+        return self.mapping[xi][yi]
+
+
+@dataclass
+class GlobalRouterConfig:
+    pools: List[PoolSpec] = field(default_factory=list)
+    # (ISL, TTFT target ms) → pool, used for new requests
+    prefill_strategy: Optional[GridStrategy] = None
+    # (context length, ITL target ms) → pool
+    decode_strategy: Optional[GridStrategy] = None
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "GlobalRouterConfig":
+        pools = [
+            PoolSpec(**p) if isinstance(p, dict) else PoolSpec(namespace=p)
+            for p in doc.get("pools", [])
+        ]
+
+        def grid(key: str) -> Optional[GridStrategy]:
+            g = doc.get(key)
+            if not g:
+                return None
+            return GridStrategy(
+                x_min=g["x_min"], x_max=g["x_max"],
+                y_min=g.get("y_min", 0.0), y_max=g.get("y_max", 1.0),
+                mapping=g["mapping"],
+            )
+
+        return cls(
+            pools=pools,
+            prefill_strategy=grid("prefill_strategy"),
+            decode_strategy=grid("decode_strategy"),
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "GlobalRouterConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def validate(self) -> None:
+        n = len(self.pools)
+        if n == 0:
+            raise ValueError("global router needs at least one pool")
+        for name, strat in (
+            ("prefill_strategy", self.prefill_strategy),
+            ("decode_strategy", self.decode_strategy),
+        ):
+            if strat is None:
+                continue
+            for row in strat.mapping:
+                for idx in row:
+                    if not 0 <= idx < n:
+                        raise ValueError(
+                            f"{name} maps to pool {idx}, but only {n} pools exist"
+                        )
