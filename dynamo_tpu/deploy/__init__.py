@@ -1,0 +1,22 @@
+"""Deployment control plane: graph specs + a reconciling controller.
+
+Reference parity: deploy/operator (the Go Kubernetes operator reconciling
+DynamoGraphDeployment CRDs into pods) re-designed for this framework's
+deployment unit — OS processes on TPU hosts. The same spec shape
+(services → replicas/command/env, restart policy) drives:
+
+  - ProcessBackend: subprocess supervision on one host (functional here),
+  - the k8s manifests under deploy/k8s/ for cluster deployments (the CRD
+    and an example CR, applied by any kubectl — the operator pattern
+    documented for clusters this environment can't reach).
+
+The controller also closes the operator↔planner loop: the planner's
+VirtualConnector publishes desired worker counts to the discovery plane,
+and the controller folds them into its reconcile pass — exactly the
+reference flow (planner patches the CRD, operator reconciles pods).
+"""
+
+from dynamo_tpu.deploy.spec import GraphDeployment, ServiceSpec
+from dynamo_tpu.deploy.controller import GraphController
+
+__all__ = ["GraphDeployment", "ServiceSpec", "GraphController"]

@@ -1,0 +1,70 @@
+"""Deploy CLI: run a GraphDeployment on this host.
+
+Reference parity: the operator's reconcile loop as a foreground process
+(`kubectl apply` → here `python -m dynamo_tpu.deploy apply -f graph.yaml`).
+
+  apply -f graph.yaml     reconcile the deployment until interrupted
+  validate -f graph.yaml  parse + validate only
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dynamo_tpu.deploy.controller import GraphController
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def run_apply(args) -> None:
+    deployment = GraphDeployment.from_file(args.file)
+    discovery = None
+    if args.planner_loop:
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        discovery = DistributedRuntime.from_settings().discovery
+    controller = GraphController(
+        deployment, discovery=discovery, stdout=sys.stderr
+    )
+    controller.start()
+    print(f"controller running: {deployment.name} "
+          f"({len(deployment.services)} services)", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(10)
+            print(json.dumps(controller.status()), flush=True)
+    finally:
+        await controller.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu deploy")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("apply", "validate"):
+        p = sub.add_parser(name)
+        p.add_argument("-f", "--file", required=True)
+        if name == "apply":
+            p.add_argument(
+                "--planner-loop", action="store_true",
+                help="fold planner desired counts from discovery into "
+                "planner_scaled services",
+            )
+    args = parser.parse_args()
+    configure_logging()
+    if args.command == "validate":
+        dep = GraphDeployment.from_file(args.file)
+        print(json.dumps({
+            "name": dep.name,
+            "namespace": dep.namespace,
+            "services": {n: s.replicas for n, s in dep.services.items()},
+            "valid": True,
+        }))
+        return
+    asyncio.run(run_apply(args))
+
+
+if __name__ == "__main__":
+    main()

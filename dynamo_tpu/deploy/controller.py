@@ -1,0 +1,136 @@
+"""GraphController: reconcile a GraphDeployment onto OS processes.
+
+Reference parity: deploy/operator/internal/controller/
+dynamographdeployment_controller.go:110 (Reconcile — drive observed state
+to spec: create/scale/restart components, fold in planner-driven replica
+changes). The deployment unit here is a supervised subprocess per replica
+(ProcessConnector supervision primitives); each reconcile pass:
+
+  1. re-reads planner desired counts from the discovery plane for
+     planner_scaled services (the planner→operator loop),
+  2. respawns crashed replicas / applies replica changes,
+  3. applies restart_id changes as a rolling restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.planner.connectors import planner_key
+from dynamo_tpu.planner.process_connector import ProcessConnector, RoleSpec
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class GraphController:
+    def __init__(
+        self,
+        deployment: GraphDeployment,
+        *,
+        discovery: Optional[Any] = None,  # planner desired-count source
+        reconcile_interval_s: float = 2.0,
+        stdout=None,
+    ) -> None:
+        self.deployment = deployment
+        self.discovery = discovery
+        self.reconcile_interval_s = reconcile_interval_s
+        env = {**os.environ, **deployment.envs}
+        self._connector = ProcessConnector(
+            {
+                name: RoleSpec(
+                    command=svc.resolved_command(),
+                    env={**env, **svc.env},
+                    grace_period_s=svc.grace_period_s,
+                )
+                for name, svc in deployment.services.items()
+            },
+            stdout=stdout,
+        )
+        self._applied_restart_id = deployment.restart_id
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.reconciles = 0
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def desired_counts(self) -> Dict[str, int]:
+        counts = {
+            name: svc.replicas for name, svc in self.deployment.services.items()
+        }
+        if self.discovery is not None:
+            try:
+                doc = await self.discovery.get(planner_key(self.deployment.namespace))
+            except Exception:
+                logger.exception("planner desired-count read failed")
+                doc = None
+            if doc:
+                for name, svc in self.deployment.services.items():
+                    if svc.planner_scaled and svc.planner_role in doc:
+                        counts[name] = int(doc[svc.planner_role])
+        return counts
+
+    async def reconcile_once(self) -> Dict[str, int]:
+        if self.deployment.restart_id != self._applied_restart_id:
+            logger.info(
+                "restart id changed (%r → %r): rolling restart",
+                self._applied_restart_id, self.deployment.restart_id,
+            )
+            await self._connector.apply_counts(
+                {name: 0 for name in self.deployment.services}, reason="restart"
+            )
+            self._applied_restart_id = self.deployment.restart_id
+        counts = await self.desired_counts()
+        await self._connector.apply_counts(counts, reason="reconcile")
+        self.reconciles += 1
+        return counts
+
+    def status(self) -> Dict[str, Any]:
+        """(ref: DynamoGraphDeploymentStatus replicas accounting)"""
+        live = self._connector.counts()
+        return {
+            "name": self.deployment.name,
+            "namespace": self.deployment.namespace,
+            "services": {
+                name: {
+                    "desired": svc.replicas,
+                    "ready": live.get(name, 0),
+                    "planner_scaled": svc.planner_scaled,
+                }
+                for name, svc in self.deployment.services.items()
+            },
+            "reconciles": self.reconciles,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stop.clear()
+            self._task = asyncio.get_event_loop().create_task(
+                self._run(), name=f"graph-controller:{self.deployment.name}"
+            )
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:
+                logger.exception("reconcile failed")
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.reconcile_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self, *, teardown: bool = True) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        if teardown:
+            await self._connector.close()

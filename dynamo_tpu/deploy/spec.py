@@ -1,0 +1,122 @@
+"""GraphDeployment spec: the CRD shape as a Python/YAML document.
+
+Reference parity: deploy/operator/api/v1alpha1/dynamographdeployment_types.go
+(DynamoGraphDeploymentSpec — services map with shared component spec,
+global envs, restart policy). Service kinds map to this framework's
+builtin service modules; explicit commands cover anything else.
+
+YAML example:
+
+    name: my-deployment
+    namespace: prod
+    envs:
+      DYN_TPU_DISCOVERY: discd
+    services:
+      discd:
+        kind: discd
+        replicas: 1
+      backend:
+        kind: worker
+        replicas: 2
+        args: ["--model", "tiny", "--max-num-seqs", "16"]
+        planner_scaled: true      # planner desired counts override replicas
+      frontend:
+        kind: frontend
+        replicas: 1
+        args: ["--http-port", "8080"]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# service kind → module (the builtin components a graph can deploy)
+KIND_MODULES = {
+    "frontend": "dynamo_tpu.frontend",
+    "worker": "dynamo_tpu.worker",
+    "mocker": "dynamo_tpu.mocker",
+    "discd": "dynamo_tpu.discd",
+    "planner": "dynamo_tpu.planner",
+    "grpc": "dynamo_tpu.grpc",
+    "global_router": "dynamo_tpu.global_router",
+}
+
+
+@dataclass
+class ServiceSpec:
+    """(ref: DynamoComponentDeploymentSharedSpec — replicas/envs/args)"""
+
+    kind: str = ""  # one of KIND_MODULES, or "" with an explicit command
+    replicas: int = 1
+    args: List[str] = field(default_factory=list)
+    command: Optional[List[str]] = None  # overrides kind
+    env: Dict[str, str] = field(default_factory=dict)
+    # planner-managed pool: desired counts from the planner override replicas
+    # (ref: the planner patching CRD replicas for the operator to reconcile)
+    planner_scaled: bool = False
+    planner_role: str = "decode"  # which count of the plan applies
+    grace_period_s: float = 10.0
+
+    def resolved_command(self) -> List[str]:
+        if self.command:
+            return list(self.command)
+        module = KIND_MODULES.get(self.kind)
+        if module is None:
+            raise ValueError(
+                f"service kind {self.kind!r} unknown "
+                f"(builtin: {sorted(KIND_MODULES)}) and no command given"
+            )
+        return [sys.executable, "-m", module, *self.args]
+
+
+@dataclass
+class GraphDeployment:
+    """(ref: DynamoGraphDeploymentSpec)"""
+
+    name: str
+    namespace: str = "dynamo"
+    services: Dict[str, ServiceSpec] = field(default_factory=dict)
+    envs: Dict[str, str] = field(default_factory=dict)
+    # restart.id change triggers a rolling restart (ref: Restart.ID)
+    restart_id: str = ""
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "GraphDeployment":
+        services = {}
+        for name, s in (doc.get("services") or {}).items():
+            services[name] = ServiceSpec(
+                kind=s.get("kind", ""),
+                replicas=int(s.get("replicas", 1)),
+                args=[str(a) for a in s.get("args", [])],
+                command=s.get("command"),
+                env={k: str(v) for k, v in (s.get("env") or {}).items()},
+                planner_scaled=bool(s.get("planner_scaled", False)),
+                planner_role=s.get("planner_role", "decode"),
+                grace_period_s=float(s.get("grace_period_s", 10.0)),
+            )
+        dep = cls(
+            name=doc.get("name", "deployment"),
+            namespace=doc.get("namespace", "dynamo"),
+            services=services,
+            envs={k: str(v) for k, v in (doc.get("envs") or {}).items()},
+            restart_id=str(doc.get("restart", {}).get("id", "")) if doc.get("restart") else "",
+        )
+        dep.validate()
+        return dep
+
+    @classmethod
+    def from_file(cls, path: str) -> "GraphDeployment":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def validate(self) -> None:
+        if not self.services:
+            raise ValueError("deployment has no services")
+        for name, svc in self.services.items():
+            svc.resolved_command()  # raises on unknown kind
+            if svc.replicas < 0:
+                raise ValueError(f"service {name}: negative replicas")

@@ -70,7 +70,13 @@ class ProcessConnector:
         return {role: len(self.alive(role)) for role in self.roles}
 
     async def apply(self, plan) -> None:
-        desired = {"decode": int(plan.decode), "prefill": int(plan.prefill)}
+        await self.apply_counts(
+            {"decode": int(plan.decode), "prefill": int(plan.prefill)},
+            reason=plan.reason,
+        )
+
+    async def apply_counts(self, desired: Dict[str, int], *, reason: str = "") -> None:
+        """Reconcile arbitrary per-role counts (the deploy controller path)."""
         for role, spec in self.roles.items():
             want = max(desired.get(role, 0), self.min_alive)
             live = self.alive(role)  # the same list _spawn appends into
@@ -80,7 +86,7 @@ class ProcessConnector:
                 await self._retire(live[want:], spec)
                 del live[want:]
         self.applied = {r: len(v) for r, v in self._procs.items()}
-        logger.info("process connector applied: %s (%s)", self.applied, plan.reason)
+        logger.info("process connector applied: %s (%s)", self.applied, reason)
 
     def _spawn(self, role: str, spec: RoleSpec) -> _Managed:
         proc = subprocess.Popen(
