@@ -59,7 +59,7 @@ async def run_bench():
             max_num_seqs=CONCURRENCY,
             max_model_len=512,
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
-            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 32)),
+            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 64)),
             enable_prefix_caching=True,
             decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", 64)),
         )

@@ -40,6 +40,9 @@ async def main() -> None:
     )
     parser.add_argument("--canary-interval", type=float, default=5.0)
     parser.add_argument("--canary-timeout", type=float, default=10.0)
+    parser.add_argument("--tls-cert", default=None,
+                        help="PEM certificate chain (enables TLS with --tls-key)")
+    parser.add_argument("--tls-key", default=None, help="PEM private key")
     args = parser.parse_args()
 
     configure_logging()
@@ -63,7 +66,10 @@ async def main() -> None:
         canary_timeout_s=args.canary_timeout,
     )
     await watcher.start()
-    service = HttpService(manager, host=args.host, port=args.http_port)
+    service = HttpService(
+        manager, host=args.host, port=args.http_port,
+        tls_cert=args.tls_cert, tls_key=args.tls_key,
+    )
     port = await service.start()
     print(f"frontend listening on {args.host}:{port}", flush=True)
     try:

@@ -47,7 +47,16 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8000,
         metrics: Optional[FrontendMetrics] = None,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ) -> None:
+        # TLS termination (ref: service_v2.rs enable_tls + rustls config).
+        self._ssl_context = None
+        if tls_cert and tls_key:
+            import ssl
+
+            self._ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_context.load_cert_chain(tls_cert, tls_key)
         # NOT `or`: an empty ModelManager is falsy (__len__ == 0) and would be
         # silently replaced, detaching the caller's manager from the server.
         self.models = model_manager if model_manager is not None else ModelManager()
@@ -58,6 +67,10 @@ class HttpService:
         # model name → busy thresholds (ref: busy_threshold.rs; checked
         # against the model's WorkerLoadMonitor when one is attached)
         self.busy_thresholds: Dict[str, BusyThresholds] = {}
+        from dynamo_tpu.http.audit import AuditBus
+
+        # Request auditing (ref: lib/llm/src/audit): DYN_TPU_AUDIT policy.
+        self.audit = AuditBus.from_env()
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
         self.app = self._build_app()
@@ -84,7 +97,9 @@ class HttpService:
         """Bind and serve; returns the bound port (useful with port=0)."""
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        self._site = web.TCPSite(self._runner, self.host, self.port)
+        self._site = web.TCPSite(
+            self._runner, self.host, self.port, ssl_context=self._ssl_context
+        )
         await self._site.start()
         sockets = self._site._server.sockets  # type: ignore[union-attr]
         self.port = sockets[0].getsockname()[1]
@@ -472,6 +487,16 @@ class HttpService:
                 rid, entry.name, text=text, finish_reason=finish_str, usage=usage
             )
         timer.done(200)
+        if self.audit.enabled:
+            from dynamo_tpu.http.audit import AuditRecord
+
+            self.audit.publish(
+                AuditRecord(
+                    request_id=ctx.id, model=entry.name, endpoint=kind,
+                    requested_streaming=False, request=body,
+                    response_text=text, finish_reason=finish_str, status=200,
+                )
+            )
         return web.json_response(payload)
 
     # -- streaming ---------------------------------------------------------
@@ -518,6 +543,8 @@ class HttpService:
         completion_tokens = 0
         sent_role = False
         status = 200
+        finish_seen: Optional[str] = None
+        audit_parts: Optional[list] = [] if self.audit.enabled else None
         reasoning_parser = ReasoningParser()
         try:
             async for item in _prepend(first_item, stream):
@@ -538,7 +565,11 @@ class HttpService:
                 completion_tokens = out.cumulative_tokens or completion_tokens
                 if out.token_ids:
                     timer.on_token(len(out.token_ids))
+                if audit_parts is not None and out.text:
+                    audit_parts.append(out.text)
                 finish_str = out.finish_reason.to_openai() if out.finish_reason else None
+                if finish_str:
+                    finish_seen = finish_str
                 if kind == "chat":
                     delta: Dict[str, Any] = {}
                     if not sent_role:
@@ -592,6 +623,17 @@ class HttpService:
                 )
         finally:
             timer.done(status)
+            if audit_parts is not None:
+                from dynamo_tpu.http.audit import AuditRecord
+
+                self.audit.publish(
+                    AuditRecord(
+                        request_id=ctx.id, model=entry.name, endpoint=kind,
+                        requested_streaming=True, request=body,
+                        response_text="".join(audit_parts),
+                        finish_reason=finish_seen, status=status,
+                    )
+                )
         with _suppress_conn_errors():
             await response.write_eof()
         return response

@@ -262,3 +262,82 @@ async def test_openapi_and_clear_kv_routes():
     finally:
         await engine.stop()
         await service.stop(grace_period=1)
+
+
+async def test_audit_captures_unary_and_stream():
+    """Audit records carry the full request + assembled response text
+    (ref: lib/llm/src/audit)."""
+    from dynamo_tpu.http.audit import MemorySink
+
+    service, engine, port = await start_service()
+    sink = MemorySink()
+    service.audit.sinks.append(sink)
+    try:
+        async with aiohttp.ClientSession() as session:
+            await session.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={"model": "mock-model", "prompt": "audit me",
+                      "max_tokens": 4},
+            )
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={"model": "mock-model",
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 4, "stream": True},
+            ) as resp:
+                async for _ in resp.content:
+                    pass
+        assert len(sink.records) == 2
+        unary, streamed = sink.records
+        assert not unary.requested_streaming
+        assert unary.request["prompt"] == "audit me"
+        assert isinstance(unary.response_text, str)
+        assert unary.finish_reason == "length"
+        assert streamed.requested_streaming
+        assert streamed.status == 200
+        assert streamed.finish_reason == "length"
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_tls_serving(tmp_path):
+    """TLS termination with a self-signed cert (ref: service_v2.rs TLS)."""
+    import ssl
+    import subprocess
+
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    gen = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1", "-subj", "/CN=localhost"],
+        capture_output=True,
+    )
+    if gen.returncode != 0:
+        pytest.skip("openssl unavailable")
+
+    from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+    from dynamo_tpu.http import HttpService, ModelManager
+    from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+    from dynamo_tpu.llm.entrypoint import build_local_pipeline
+
+    manager = ModelManager()
+    card = ModelDeploymentCard(name="mock-model", context_length=512)
+    engine = MockEngine(MockEngineArgs(speedup_ratio=200.0))
+    manager.register(
+        "mock-model", build_local_pipeline(card, engine, tokenizer=tiny_tokenizer()), card
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0,
+                          tls_cert=cert, tls_key=key)
+    port = await service.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"https://127.0.0.1:{port}/health", ssl=ctx
+            ) as resp:
+                assert resp.status == 200
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
