@@ -1,12 +1,17 @@
 """Tool-call parsing across model dialects.
 
-Reference parity: lib/parsers/src/tool_calling/{json,pythonic,xml,…} —
-normalize whatever the model emitted into OpenAI tool_calls entries.
+Reference parity: lib/parsers/src/tool_calling/{json,pythonic,xml,harmony,
+dsml} — normalize whatever the model emitted into OpenAI tool_calls entries.
 Dialects:
   json     — bare {"name": ..., "arguments"|"parameters": {...}} or a list
   hermes   — <tool_call>{json}</tool_call> (Qwen/Hermes templates)
   mistral  — [TOOL_CALLS]{json list}
   pythonic — [fn(a=1, b="x"), ...] python-literal calls (llama-3.2 style)
+  harmony  — gpt-oss channel format: <|channel|>commentary
+             to=functions.NAME <|constrain|>json<|message|>{...}
+  dsml     — DeepSeek markup: <｜DSML｜invoke name=...> with typed
+             <｜DSML｜parameter> children
+  xml      — <tool_call><function=NAME><parameter=K>V</parameter>... form
 """
 
 from __future__ import annotations
@@ -115,6 +120,109 @@ def _parse_pythonic(text: str) -> List[ToolCall]:
     return calls
 
 
+_HARMONY_CALL_RE = re.compile(
+    r"<\|channel\|>commentary\s+to=functions\.([\w.-]+)\s*"
+    r"(?:<\|constrain\|>\w+)?<\|message\|>(.*?)(?=<\|call\|>|<\|end\|>|<\|channel\|>|<\|start\|>|$)",
+    re.DOTALL,
+)
+_HARMONY_ANALYSIS_RE = re.compile(
+    r"<\|channel\|>analysis<\|message\|>(.*?)(?=<\|end\|>|<\|channel\|>|<\|start\|>|$)",
+    re.DOTALL,
+)
+_HARMONY_FINAL_RE = re.compile(
+    r"<\|channel\|>final<\|message\|>(.*?)(?=<\|end\|>|<\|channel\|>|<\|start\|>|$)",
+    re.DOTALL,
+)
+
+
+def _parse_harmony(text: str) -> Tuple[List[ToolCall], str]:
+    """gpt-oss harmony channels (ref: harmony/harmony_parser.rs:33-86):
+    tool calls ride the commentary channel addressed to functions.*; user
+    text rides the final channel (analysis is reasoning, dropped here)."""
+    if "<|channel|>" not in text:
+        return [], text
+    calls: List[ToolCall] = []
+    for m in _HARMONY_CALL_RE.finditer(text):
+        name, payload = m.group(1), m.group(2).strip()
+        try:
+            args = json.loads(payload)
+        except json.JSONDecodeError:
+            args = {"__raw__": payload}
+        if not isinstance(args, dict):
+            args = {"value": args}
+        calls.append(ToolCall(name=name, arguments=args))
+    finals = _HARMONY_FINAL_RE.findall(text)
+    remainder = "".join(f.strip() for f in finals)
+    return calls, remainder
+
+
+_DSML_MARK = "<｜DSML｜"  # fullwidth vertical bars (DeepSeek tokens)
+_DSML_INVOKE_RE = re.compile(
+    r"<｜DSML｜invoke\s+name=\"([^\"]+)\">(.*?)</｜DSML｜invoke>",
+    re.DOTALL,
+)
+_DSML_PARAM_RE = re.compile(
+    r"<｜DSML｜parameter\s+name=\"([^\"]+)\"(?:\s+string=\"(true|false)\")?\s*>"
+    r"(.*?)</｜DSML｜parameter>",
+    re.DOTALL,
+)
+_DSML_BLOCK_RE = re.compile(
+    r"<｜DSML｜function_calls>.*?</｜DSML｜function_calls>",
+    re.DOTALL,
+)
+
+
+def _parse_dsml(text: str) -> Tuple[List[ToolCall], str]:
+    """DeepSeek DSML (ref: dsml/parser.rs:13-21). Non-string parameter
+    values are JSON-decoded (string="false" marks typed values)."""
+    if _DSML_MARK not in text:
+        return [], text
+    calls: List[ToolCall] = []
+    for m in _DSML_INVOKE_RE.finditer(text):
+        name, body = m.group(1), m.group(2)
+        args: Dict[str, Any] = {}
+        for pm in _DSML_PARAM_RE.finditer(body):
+            pname, is_string, value = pm.group(1), pm.group(2), pm.group(3).strip()
+            if is_string == "false":
+                try:
+                    args[pname] = json.loads(value)
+                except json.JSONDecodeError:
+                    args[pname] = value
+            else:
+                args[pname] = value
+        calls.append(ToolCall(name=name, arguments=args))
+    remainder = _DSML_BLOCK_RE.sub("", text)
+    # strip orphan DSML fragments outside a complete block
+    remainder = re.sub(r"<｜DSML｜[^>]*>", "", remainder).strip()
+    return calls, remainder
+
+
+_XML_FN_RE = re.compile(
+    r"<tool_call>\s*<function=([\w.-]+)>(.*?)</function>\s*</tool_call>",
+    re.DOTALL,
+)
+_XML_PARAM_RE = re.compile(
+    r"<parameter=([\w.-]+)>(.*?)</parameter>", re.DOTALL
+)
+
+
+def _parse_xml(text: str) -> Tuple[List[ToolCall], str]:
+    """<tool_call><function=NAME><parameter=K>V</parameter>... form
+    (ref: xml/parser.rs:30)."""
+    calls: List[ToolCall] = []
+    for m in _XML_FN_RE.finditer(text):
+        args: Dict[str, Any] = {}
+        for pm in _XML_PARAM_RE.finditer(m.group(2)):
+            value = pm.group(2).strip()
+            try:
+                args[pm.group(1)] = json.loads(value)
+            except json.JSONDecodeError:
+                args[pm.group(1)] = value
+        calls.append(ToolCall(name=m.group(1), arguments=args))
+    remainder = _XML_FN_RE.sub("", text).strip()
+    return calls, remainder
+
+
 def detect_and_parse_tool_calls(
     text: str, dialect: Optional[str] = None
 ) -> Tuple[List[ToolCall], str]:
@@ -130,13 +238,18 @@ def detect_and_parse_tool_calls(
     if dialect == "pythonic":
         calls = _parse_pythonic(text)
         return calls, "" if calls else text
+    if dialect == "harmony":
+        return _parse_harmony(text)
+    if dialect == "dsml":
+        return _parse_dsml(text)
+    if dialect == "xml":
+        return _parse_xml(text)
 
-    calls, remainder = _parse_hermes(text)
-    if calls:
-        return calls, remainder
-    calls, remainder = _parse_mistral(text)
-    if calls:
-        return calls, remainder
+    for parser in (_parse_harmony, _parse_dsml, _parse_xml, _parse_hermes,
+                   _parse_mistral):
+        calls, remainder = parser(text)
+        if calls:
+            return calls, remainder
     calls = _parse_json_calls(text)
     if calls:
         return calls, ""

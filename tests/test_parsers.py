@@ -112,3 +112,65 @@ class TestToolCalls:
             '{"name": "f", "arguments": "{\\"x\\": 2}"}'
         )
         assert calls[0].arguments == {"x": 2}
+
+
+class TestHarmonyDialect:
+    """gpt-oss harmony channels (ref: harmony/harmony_parser.rs)."""
+
+    def test_commentary_tool_call(self):
+        text = ('<|channel|>commentary to=functions.get_current_weather '
+                '<|constrain|>json<|message|>'
+                '{"format":"celsius","location":"San Francisco"}')
+        calls, rest = detect_and_parse_tool_calls(text, dialect="harmony")
+        assert len(calls) == 1
+        assert calls[0].name == "get_current_weather"
+        assert calls[0].arguments["location"] == "San Francisco"
+        assert rest == ""
+
+    def test_analysis_then_call_then_final(self):
+        text = ("<|channel|>analysis<|message|>thinking about weather<|end|>"
+                "<|start|>assistant<|channel|>commentary to=functions.w "
+                "<|constrain|>json<|message|>{\"city\":\"SF\"}<|call|>"
+                "<|channel|>final<|message|>Here you go!<|end|>")
+        calls, rest = detect_and_parse_tool_calls(text)  # auto-detect
+        assert [c.name for c in calls] == ["w"]
+        assert rest == "Here you go!"
+
+    def test_plain_text_untouched(self):
+        calls, rest = detect_and_parse_tool_calls("no channels here",
+                                                  dialect="harmony")
+        assert calls == [] and rest == "no channels here"
+
+
+class TestDsmlDialect:
+    """DeepSeek DSML (ref: dsml/parser.rs)."""
+
+    TEXT = ("before <｜DSML｜function_calls>"
+            "<｜DSML｜invoke name=\"search\">"
+            "<｜DSML｜parameter name=\"query\" string=\"true\">cats</｜DSML｜parameter>"
+            "<｜DSML｜parameter name=\"limit\" string=\"false\">5</｜DSML｜parameter>"
+            "</｜DSML｜invoke>"
+            "</｜DSML｜function_calls> after")
+
+    def test_invoke_with_typed_params(self):
+        calls, rest = detect_and_parse_tool_calls(self.TEXT, dialect="dsml")
+        assert len(calls) == 1
+        assert calls[0].name == "search"
+        assert calls[0].arguments == {"query": "cats", "limit": 5}
+        assert rest == "before  after"
+
+    def test_autodetect(self):
+        calls, _ = detect_and_parse_tool_calls(self.TEXT)
+        assert calls and calls[0].name == "search"
+
+
+class TestXmlDialect:
+    def test_function_parameter_form(self):
+        text = ("<tool_call><function=lookup>"
+                "<parameter=key>abc</parameter>"
+                "<parameter=count>3</parameter>"
+                "</function></tool_call> trailing")
+        calls, rest = detect_and_parse_tool_calls(text, dialect="xml")
+        assert calls[0].name == "lookup"
+        assert calls[0].arguments == {"key": "abc", "count": 3}
+        assert rest == "trailing"
