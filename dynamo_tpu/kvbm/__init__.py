@@ -17,5 +17,9 @@ prefill. The reference's block_copy.cu becomes a donated-buffer jit scatter
 
 from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, TierStats
 from dynamo_tpu.kvbm.manager import OffloadFilter, TieredKvManager
+from dynamo_tpu.kvbm.remote import KvStoreHandler, RemoteTier
 
-__all__ = ["DiskTier", "HostTier", "TierStats", "OffloadFilter", "TieredKvManager"]
+__all__ = [
+    "DiskTier", "HostTier", "TierStats", "OffloadFilter", "TieredKvManager",
+    "KvStoreHandler", "RemoteTier",
+]

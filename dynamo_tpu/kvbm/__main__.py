@@ -1,0 +1,49 @@
+"""Standalone shared KV block store service (the G4 tier's server side).
+
+Reference parity: the remote end of KVBM's G4 tier. Workers point their
+TieredKvManager at this endpoint (kvbm/remote.py RemoteTier) to share
+offloaded KV across a pool.
+
+Usage:
+  python -m dynamo_tpu.kvbm --namespace prod --capacity-blocks 65536
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu import config
+from dynamo_tpu.kvbm.remote import KvStoreHandler
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu kvstore (shared KV tier)")
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--component", default="kvstore")
+    parser.add_argument("--endpoint", default="blocks")
+    parser.add_argument("--capacity-blocks", type=int, default=65536)
+    args = parser.parse_args()
+
+    configure_logging()
+    runtime = DistributedRuntime.from_settings()
+    handler = KvStoreHandler(capacity_blocks=args.capacity_blocks)
+    endpoint = (
+        runtime.namespace(args.namespace)
+        .component(args.component)
+        .endpoint(args.endpoint)
+    )
+    served = await endpoint.serve_endpoint(handler.generate)
+    print(f"kvstore serving {args.namespace}/{args.component}/{args.endpoint}",
+          flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await served.shutdown(grace_period=config.GRACE_PERIOD.get())
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

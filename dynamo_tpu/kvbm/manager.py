@@ -40,8 +40,10 @@ class TieredKvManager:
         top_tier: HostTier,
         *,
         filter: Optional[OffloadFilter] = None,
+        remote: Optional[Any] = None,  # G4 RemoteTier (kvbm/remote.py)
     ) -> None:
         self.tier = top_tier
+        self.remote = remote
         self.filter = filter or OffloadFilter()
         # hash → chain depth, queued for offload
         self._pending: "asyncio.Queue[Tuple[int, int]]" = asyncio.Queue()
@@ -98,6 +100,9 @@ class TieredKvManager:
             if not found:
                 continue  # evicted before we got to it; write-through missed
             self.tier.put(h, k[0], v[0])
+            if self.remote is not None:
+                # G4 write-behind: the shared store absorbs it asynchronously.
+                self.remote.put(h, k[0], v[0])
             self.offloaded += 1
 
     # -- onboard (G2/G3 → G1) ------------------------------------------------
@@ -120,6 +125,12 @@ class TieredKvManager:
         ks, vs, run = [], [], []
         for h in block_hashes:
             blk = self.tier.get(h)
+            if blk is None and self.remote is not None:
+                # G4 fallback: a shared-store hit extends the run (and lands
+                # in the host tier for next time).
+                blk = await self.remote.get_async(h)
+                if blk is not None:
+                    self.tier.put(h, blk[0], blk[1])
             if blk is None:
                 break
             run.append(h)
@@ -145,6 +156,8 @@ class TieredKvManager:
         if self.tier.next_tier is not None:
             out["disk"] = self.tier.next_tier.stats.to_dict()
             out["disk_blocks"] = len(self.tier.next_tier)
+        if self.remote is not None:
+            out["remote"] = self.remote.stats.to_dict()
         return out
 
     async def close(self) -> None:
@@ -154,3 +167,5 @@ class TieredKvManager:
                 await self._task
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.remote is not None:
+            await self.remote.close()

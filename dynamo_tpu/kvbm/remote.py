@@ -1,0 +1,160 @@
+"""G4: remote KV block store — a shared cache service over the runtime.
+
+Reference parity: KVBM's G4 remote tier (block_manager storage backends
+reaching object/remote stores via NIXL). TPU-native shape: a standalone
+``kvstore`` component any worker can mount under its disk tier; transfers
+ride the existing request plane (msgpack + pack_array), so one deployment
+flag turns a pool of workers into shared-cache peers.
+
+  KvStoreHandler  — the service side (bounded LRU of content-hashed blocks)
+  RemoteTier      — the client side, implementing the tier protocol
+                    (contains/put/get) under HostTier/DiskTier chaining.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.disagg.handlers import pack_array, unpack_array
+from dynamo_tpu.kvbm.tiers import TierStats
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+Block = Tuple[np.ndarray, np.ndarray]
+
+
+class KvStoreHandler:
+    """Serve a shared KV block store endpoint.
+
+    Ops (one request → one response item):
+      {"op": "put", "hash": h, "k": packed, "v": packed}   → {"ok": true}
+      {"op": "get", "hash": h}       → {"k": packed, "v": packed} | {"miss": true}
+      {"op": "contains", "hash": h}  → {"present": bool}
+      {"op": "stats"}                → counters
+    """
+
+    def __init__(self, capacity_blocks: int = 65536) -> None:
+        self.capacity = capacity_blocks
+        self._blocks: "OrderedDict[int, Block]" = OrderedDict()
+        self.stats = TierStats()
+
+    async def generate(self, request: Any, context: Any) -> AsyncIterator[Dict[str, Any]]:
+        op = request.get("op")
+        if op == "put":
+            h = int(request["hash"])
+            if h not in self._blocks:
+                self._blocks[h] = (
+                    unpack_array(request["k"]).copy(),
+                    unpack_array(request["v"]).copy(),
+                )
+                self.stats.stored += 1
+                while len(self._blocks) > self.capacity:
+                    self._blocks.popitem(last=False)
+                    self.stats.evicted += 1
+            else:
+                self._blocks.move_to_end(h)
+            yield {"ok": True}
+        elif op == "get":
+            blk = self._blocks.get(int(request["hash"]))
+            if blk is None:
+                self.stats.misses += 1
+                yield {"miss": True}
+            else:
+                self._blocks.move_to_end(int(request["hash"]))
+                self.stats.hits += 1
+                yield {"k": pack_array(blk[0]), "v": pack_array(blk[1])}
+        elif op == "contains":
+            yield {"present": int(request["hash"]) in self._blocks}
+        elif op == "stats":
+            yield {"blocks": len(self._blocks), **self.stats.to_dict()}
+        else:
+            yield {"error": f"unknown kvstore op {op!r}"}
+
+
+class RemoteTier:
+    """Tier-protocol client for a KvStoreHandler endpoint.
+
+    The tier protocol is synchronous (HostTier/DiskTier call it from the
+    event loop), so the client schedules network ops on the running loop and
+    blocks only where the protocol demands a value (get/contains); puts are
+    fire-and-forget tasks (write-behind, like the G3 spill path).
+    """
+
+    name = "remote"
+
+    def __init__(self, client_factory, *, timeout_s: float = 10.0) -> None:
+        self._factory = client_factory  # async () -> runtime Client
+        self._client = None
+        self.timeout_s = timeout_s
+        self.stats = TierStats()
+        self._pending: set = set()
+
+    async def _ensure(self):
+        if self._client is None:
+            self._client = await self._factory()
+        return self._client
+
+    async def _call(self, request: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        client = await self._ensure()
+        from dynamo_tpu.runtime.context import Context
+        from dynamo_tpu.runtime.engine import collect
+
+        out = await asyncio.wait_for(
+            collect(client.generate(request, Context())), timeout=self.timeout_s
+        )
+        return out[-1] if out else None
+
+    # -- tier protocol (loop-thread callers) --------------------------------
+
+    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        async def _put():
+            try:
+                await self._call(
+                    {"op": "put", "hash": block_hash,
+                     "k": pack_array(k), "v": pack_array(v)}
+                )
+                self.stats.stored += 1
+            except Exception:
+                logger.exception("remote tier put failed")
+
+        task = asyncio.get_event_loop().create_task(_put())
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+
+    def contains(self, block_hash: int) -> bool:
+        # Synchronous protocol + async transport: only answerable when
+        # called from outside the loop; tier chaining uses get() directly.
+        return False
+
+    def get(self, block_hash: int) -> Optional[Block]:
+        """Blocking fetch — must NOT be called from the event loop thread
+        (the async path is get_async; DiskTier chains via that)."""
+        raise RuntimeError("RemoteTier.get is async-only; use get_async")
+
+    async def get_async(self, block_hash: int) -> Optional[Block]:
+        try:
+            out = await self._call({"op": "get", "hash": block_hash})
+        except Exception:
+            logger.exception("remote tier get failed")
+            return None
+        if not out or out.get("miss") or out.get("error"):
+            self.stats.misses += 1
+            return None
+        self.stats.hits += 1
+        return unpack_array(out["k"]), unpack_array(out["v"])
+
+    async def flush(self) -> None:
+        """Wait for write-behind puts (tests/shutdown)."""
+        if self._pending:
+            await asyncio.gather(*list(self._pending), return_exceptions=True)
+
+    async def close(self) -> None:
+        await self.flush()
+        if self._client is not None:
+            await self._client.close()
+            self._client = None

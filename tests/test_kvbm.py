@@ -121,3 +121,95 @@ async def test_offload_filter_depth():
     finally:
         await kvbm.close()
         await engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# G4: remote shared store (kvbm/remote.py)
+# ---------------------------------------------------------------------------
+
+
+async def _kvstore_endpoint(ns="kvstore-test"):
+    from dynamo_tpu.kvbm import KvStoreHandler
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    drt = DistributedRuntime.detached()
+    handler = KvStoreHandler(capacity_blocks=8)
+    ep = drt.namespace(ns).component("kvstore").endpoint("blocks")
+    await ep.serve_endpoint(handler.generate)
+    return ep, handler
+
+
+async def test_kvstore_put_get_lru():
+    from dynamo_tpu.disagg.handlers import pack_array, unpack_array
+    from dynamo_tpu.runtime import Context, collect
+
+    ep, handler = await _kvstore_endpoint("kvstore-a")
+    client = await ep.client()
+
+    async def call(req):
+        out = await collect(client.generate(req, Context()))
+        return out[-1]
+
+    k, v = blk(1), blk(2)
+    assert (await call({"op": "put", "hash": 5, "k": pack_array(k),
+                        "v": pack_array(v)}))["ok"]
+    assert (await call({"op": "contains", "hash": 5}))["present"]
+    got = await call({"op": "get", "hash": 5})
+    np.testing.assert_array_equal(unpack_array(got["k"]), k)
+    assert (await call({"op": "get", "hash": 99})).get("miss")
+    # LRU bound
+    for h in range(100, 110):
+        await call({"op": "put", "hash": h, "k": pack_array(k),
+                    "v": pack_array(v)})
+    stats = await call({"op": "stats"})
+    assert stats["blocks"] == 8 and stats["evicted"] >= 2
+
+
+async def test_remote_tier_write_behind_and_onboard_fallback():
+    """G4 end to end: worker A offloads through the remote store; worker B
+    (cold local tiers) onboards from it before prefill."""
+    from dynamo_tpu.kvbm import HostTier, RemoteTier, TieredKvManager
+
+    ep, handler = await _kvstore_endpoint("kvstore-b")
+
+    async def factory():
+        return await ep.client()
+
+    # Worker A: serve a prompt so blocks commit + offload (host + remote).
+    engine_a = make_engine()
+    kvbm_a = TieredKvManager(HostTier(64), remote=RemoteTier(factory))
+    kvbm_a.attach(engine_a)
+    prompt = list(range(30, 46))  # 4 full blocks of 4
+    try:
+        from dynamo_tpu.runtime.engine import collect as _collect
+
+        out = await _collect(engine_a.generate(req(prompt), Context()))
+        assert not any(o.error for o in out)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if kvbm_a.offloaded >= 4:
+                break
+        await kvbm_a.remote.flush()
+        assert handler.stats.stored >= 4  # write-behind landed remotely
+    finally:
+        await kvbm_a.close()
+        await engine_a.stop()
+
+    # Worker B: same prompt, empty local tiers → onboard via G4.
+    from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+    engine_b = make_engine()
+    kvbm_b = TieredKvManager(HostTier(64), remote=RemoteTier(factory))
+    kvbm_b.attach(engine_b)
+    try:
+        hashes = compute_block_hashes(prompt, engine_b.args.block_size)
+        installed = await kvbm_b.onboard(hashes)
+        assert installed == len(hashes)
+        assert kvbm_b.remote.stats.hits == len(hashes)
+        # the onboarded blocks now serve prefix-cached admission
+        matched, ids = engine_b.pool.pin_prefix(hashes)
+        assert matched == len(hashes)
+        engine_b.pool.release(ids, hashes[:matched])
+    finally:
+        await kvbm_b.close()
+        await engine_b.stop()
