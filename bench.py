@@ -34,8 +34,8 @@ BASELINE_TOKS_PER_SEC_PER_CHIP = 5000.0
 
 ISL = int(os.environ.get("BENCH_ISL", 128))
 OSL = int(os.environ.get("BENCH_OSL", 64))
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", 128))
-REQUESTS = int(os.environ.get("BENCH_REQUESTS", 256))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", 256))
+REQUESTS = int(os.environ.get("BENCH_REQUESTS", 512))
 VERBOSE = os.environ.get("BENCH_VERBOSE") == "1"
 
 
@@ -59,7 +59,7 @@ async def run_bench():
             max_num_seqs=CONCURRENCY,
             max_model_len=512,
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
-            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 64)),
+            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 128)),
             enable_prefix_caching=True,
             decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", 64)),
         )
