@@ -1,0 +1,108 @@
+"""Embedding engine: OpenAI /v1/embeddings over the native model family.
+
+Reference parity: the reference serves embedding models through its engines
+behind the same frontend route (http/service openai embeddings + model_type
+"embedding" cards). Here a jitted encode (models/llama.py::encode —
+mean-pooled final hidden states) serves batches of texts; shapes bucket to
+powers of two for a bounded compile set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class EmbeddingEngine:
+    """AsyncEngine for OpenAI embeddings requests (dict in, dict out)."""
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        tokenizer: Any,
+        *,
+        params: Optional[Any] = None,
+        max_batch: int = 32,
+        max_length: int = 512,
+        normalize: bool = True,
+        seed: int = 0,
+    ) -> None:
+        self.config = config
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.max_length = max_length
+        self.normalize = normalize
+        self.params = (
+            params
+            if params is not None
+            else llama.init_params(config, jax.random.PRNGKey(seed))
+        )
+        self._encode = jax.jit(functools.partial(llama.encode, config=config))
+        self.embedded_texts = 0
+
+    def _embed_batch(self, token_lists: List[List[int]]) -> np.ndarray:
+        B = _next_pow2(len(token_lists))
+        T = min(
+            _next_pow2(max(len(t) for t in token_lists)), self.max_length
+        )
+        toks = np.zeros((B, T), dtype=np.int32)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, ids in enumerate(token_lists):
+            ids = ids[:T]
+            toks[i, : len(ids)] = ids
+            lens[i] = len(ids)
+        out = self._encode(
+            self.params, tokens=jnp.asarray(toks), lengths=jnp.asarray(lens)
+        )
+        vecs = np.asarray(out)[: len(token_lists)]
+        if self.normalize:
+            norms = np.linalg.norm(vecs, axis=-1, keepdims=True)
+            vecs = vecs / np.maximum(norms, 1e-9)
+        return vecs
+
+    async def generate(self, request: Any, context: Any) -> AsyncIterator[Dict[str, Any]]:
+        inputs = request.get("input")
+        if isinstance(inputs, str):
+            texts = [inputs]
+        elif isinstance(inputs, list) and all(isinstance(t, str) for t in inputs):
+            texts = inputs
+        else:
+            yield {"error": {"message": "'input' must be a string or list of strings",
+                             "type": "invalid_request_error"}}
+            return
+        token_lists = [self.tokenizer.encode(t) or [0] for t in texts]
+        data = []
+        total_tokens = 0
+        for off in range(0, len(token_lists), self.max_batch):
+            chunk = token_lists[off : off + self.max_batch]
+            vecs = self._embed_batch(chunk)
+            for i, vec in enumerate(vecs):
+                data.append(
+                    {
+                        "object": "embedding",
+                        "index": off + i,
+                        "embedding": [float(x) for x in vec],
+                    }
+                )
+            total_tokens += sum(len(t) for t in chunk)
+        self.embedded_texts += len(texts)
+        yield {
+            "object": "list",
+            "model": request.get("model", self.config.name),
+            "data": data,
+            "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
+        }

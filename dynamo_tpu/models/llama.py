@@ -244,6 +244,69 @@ def forward_paged(
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
+def encode(
+    params: Params,
+    config: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 (right-padded)
+    lengths: jnp.ndarray,  # [B] int32 valid lengths
+) -> jnp.ndarray:
+    """Mean-pooled final hidden states [B, d] — the embedding-model forward
+    (bidirectional is unnecessary for decoder-embedding models; pooling over
+    the causal states matches the common last/mean-pool recipes)."""
+    c = config
+    B, T = tokens.shape
+    hd = c.head_dim_
+    x = params["embed"][tokens]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
+    cos, sin = rope_table(pos, hd, c.rope_theta)
+
+    def layer_fn(carry, lp):
+        x = carry
+        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+        q = jnp.einsum("btd,dh->bth", h, lp["wq"])
+        k = jnp.einsum("btd,dh->bth", h, lp["wk"])
+        v = jnp.einsum("btd,dh->bth", h, lp["wv"])
+        if c.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = apply_rope(q.reshape(B, T, c.n_heads, hd), cos, sin)
+        k = apply_rope(k.reshape(B, T, c.n_kv_heads, hd), cos, sin)
+        v = v.reshape(B, T, c.n_kv_heads, hd)
+        G = c.q_per_kv
+        qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)
+        kf = jnp.repeat(k.astype(jnp.float32).transpose(0, 2, 1, 3), G, axis=1)
+        vf = jnp.repeat(v.astype(jnp.float32).transpose(0, 2, 1, 3), G, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * hd**-0.5
+        t_q = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        t_k = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        causal = t_q >= t_k
+        valid = t_k[None] < lengths[:, None, None]  # padded keys masked
+        s = jnp.where(causal[None, None] & valid[:, None], s, -1e30)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vf)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1).astype(x.dtype)
+        x = x + attn @ lp["wo"]
+        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+        if c.is_moe:
+            x = x + moe_ffn(
+                h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
+                top_k=c.n_experts_per_tok,
+                capacity_factor=c.moe_capacity_factor,
+                norm_topk_prob=c.norm_topk_prob,
+            )
+        else:
+            gate = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"]))
+            up = jnp.einsum("btd,df->btf", h, lp["w_up"])
+            x = x + jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps).astype(jnp.float32)
+    mask = (jax.lax.broadcasted_iota(jnp.int32, (B, T), 1) < lengths[:, None])
+    pooled = (x * mask[..., None]).sum(1) / jnp.maximum(
+        lengths[:, None].astype(jnp.float32), 1.0
+    )
+    return pooled
+
+
 def decode_multi(
     params: Params,
     config: ModelConfig,
