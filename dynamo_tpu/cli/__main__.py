@@ -21,6 +21,10 @@ _SERVICES = {
     "discd": "dynamo_tpu.discd",
     "planner": "dynamo_tpu.planner",
     "grpc": "dynamo_tpu.grpc",
+    "kvstore": "dynamo_tpu.kvbm",
+    "encoder": "dynamo_tpu.multimodal",
+    "global-router": "dynamo_tpu.global_router",
+    "deploy": "dynamo_tpu.deploy",
 }
 
 

@@ -41,6 +41,8 @@ KIND_MODULES = {
     "planner": "dynamo_tpu.planner",
     "grpc": "dynamo_tpu.grpc",
     "global_router": "dynamo_tpu.global_router",
+    "kvstore": "dynamo_tpu.kvbm",
+    "encoder": "dynamo_tpu.multimodal",
 }
 
 

@@ -66,6 +66,15 @@ async def main() -> None:
         "--kv-offload-dir", default=None,
         help="disk KV tier spool directory (KVBM G3; requires --kv-offload-blocks)",
     )
+    parser.add_argument(
+        "--kv-remote", default=None, metavar="NS/COMPONENT/ENDPOINT",
+        help="shared KV store endpoint (KVBM G4; run python -m dynamo_tpu.kvbm)",
+    )
+    parser.add_argument(
+        "--kv-host-arena-mb", type=int, default=0,
+        help="back the host KV tier with a preallocated arena of this many "
+        "MB (0 = plain numpy blocks)",
+    )
     parser.add_argument("--decode-steps", type=int, default=8,
                         help="fused decode iterations per device dispatch")
     parser.add_argument("--lora-dir", default=None,
@@ -128,10 +137,26 @@ async def main() -> None:
     )
     kvbm = None
     if args.kv_offload_blocks > 0:
-        from dynamo_tpu.kvbm import DiskTier, HostTier, TieredKvManager
+        from dynamo_tpu.kvbm import DiskTier, HostTier, RemoteTier, TieredKvManager
 
         disk = DiskTier(args.kv_offload_dir) if args.kv_offload_dir else None
-        kvbm = TieredKvManager(HostTier(args.kv_offload_blocks, next_tier=disk))
+        remote = None
+        if args.kv_remote:
+            ns, comp, ep_name = args.kv_remote.split("/")
+
+            async def _kv_client():
+                return await (
+                    runtime.namespace(ns).component(comp).endpoint(ep_name).client()
+                )
+
+            remote = RemoteTier(_kv_client)
+        kvbm = TieredKvManager(
+            HostTier(
+                args.kv_offload_blocks, next_tier=disk,
+                arena_bytes=args.kv_host_arena_mb * (1 << 20) or None,
+            ),
+            remote=remote,
+        )
         kvbm.attach(engine)
     load_pub = LoadPublisher(
         runtime.event_plane, args.namespace, args.component, instance_id,
