@@ -14,16 +14,13 @@ import sys
 from dynamo_tpu import config
 from dynamo_tpu.cli.run import add_run_args, main_run
 
+# One source of truth for service kinds (deploy specs use the same table);
+# the CLI adds hyphen aliases and the deploy controller itself.
+from dynamo_tpu.deploy.spec import KIND_MODULES
+
 _SERVICES = {
-    "frontend": "dynamo_tpu.frontend",
-    "worker": "dynamo_tpu.worker",
-    "mocker": "dynamo_tpu.mocker",
-    "discd": "dynamo_tpu.discd",
-    "planner": "dynamo_tpu.planner",
-    "grpc": "dynamo_tpu.grpc",
-    "kvstore": "dynamo_tpu.kvbm",
-    "encoder": "dynamo_tpu.multimodal",
-    "global-router": "dynamo_tpu.global_router",
+    **KIND_MODULES,
+    "global-router": KIND_MODULES["global_router"],
     "deploy": "dynamo_tpu.deploy",
 }
 

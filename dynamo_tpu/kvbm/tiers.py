@@ -97,11 +97,15 @@ class HostTier:
     def get(self, block_hash: int) -> Optional[Block]:
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
-            blk = (
-                self._staging.get(block_hash)
-                if self._staging is not None
-                else self._blocks[block_hash]
-            )
+            if self._staging is not None:
+                blk = self._staging.get(block_hash)
+                if blk is not None:
+                    # Copies, not views: a later put() on this tier can evict
+                    # the block and recycle its arena region while the caller
+                    # still holds the arrays (onboard chains do exactly this).
+                    blk = (np.array(blk[0]), np.array(blk[1]))
+            else:
+                blk = self._blocks[block_hash]
             if blk is not None:
                 self.stats.hits += 1
                 return blk

@@ -32,6 +32,17 @@ async def main() -> None:
     parser.add_argument("--llm-d-model", type=int, required=True,
                         help="target LLM hidden size (embedding projection)")
     args = parser.parse_args()
+    if args.image_size % args.patch_size != 0:
+        parser.error(
+            f"--image-size {args.image_size} must be divisible by "
+            f"--patch-size {args.patch_size}"
+        )
+    n_heads = VisionEncoderConfig.n_heads
+    if args.vit_d_model % n_heads != 0:
+        parser.error(
+            f"--vit-d-model {args.vit_d_model} must be divisible by "
+            f"n_heads={n_heads}"
+        )
 
     configure_logging()
     runtime = DistributedRuntime.from_settings()

@@ -86,6 +86,19 @@ async def main() -> None:
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
+    if args.kv_offload_blocks <= 0 and (
+        args.kv_remote or args.kv_host_arena_mb or args.kv_offload_dir
+    ):
+        parser.error(
+            "--kv-remote/--kv-host-arena-mb/--kv-offload-dir require "
+            "--kv-offload-blocks > 0 (they configure the offload tier stack)"
+        )
+    if args.kv_remote:
+        kv_remote_parts = args.kv_remote.split("/")
+        if len(kv_remote_parts) != 3 or not all(kv_remote_parts):
+            parser.error(
+                f"--kv-remote must be NS/COMPONENT/ENDPOINT, got {args.kv_remote!r}"
+            )
 
     configure_logging()
     runtime = DistributedRuntime.from_settings()
@@ -142,7 +155,7 @@ async def main() -> None:
         disk = DiskTier(args.kv_offload_dir) if args.kv_offload_dir else None
         remote = None
         if args.kv_remote:
-            ns, comp, ep_name = args.kv_remote.split("/")
+            ns, comp, ep_name = kv_remote_parts
 
             async def _kv_client():
                 return await (

@@ -161,3 +161,20 @@ class TestWeightCache:
             np.full((4, 8), 1.5, np.float32),
         )
         assert load_params(str(tmp_path), "missing") is None
+
+
+class TestArenaTierStability:
+    def test_get_survives_eviction_of_source_region(self):
+        """Regression: HostTier.get must return stable arrays — a later put
+        can evict the block and recycle its arena region while the caller
+        still holds the data (the onboard-chain pattern)."""
+        tier = HostTier(2, arena_bytes=1 << 16)
+        mk = lambda x: np.full((2, 4, 2, 8), float(x), np.float32)  # noqa: E731
+        tier.put(1, mk(1), mk(-1))
+        tier.put(2, mk(2), mk(-2))
+        k1, v1 = tier.get(1)
+        # These puts evict block 1 and recycle its region.
+        tier.put(3, mk(3), mk(-3))
+        tier.put(4, mk(4), mk(-4))
+        np.testing.assert_array_equal(k1, mk(1))
+        np.testing.assert_array_equal(v1, mk(-1))
