@@ -109,6 +109,20 @@ def _next_pow2(n: int) -> int:
 
 
 @dataclass
+class _ProcPrep:
+    """Per-request logits-processor parameters (ops/logits_process.py).
+    Present only when the request actually uses a processor — absence keeps
+    the engine on the processor-free compiled programs."""
+
+    minp: float
+    rep: float
+    pres: float
+    freq: float
+    bias_ids: np.ndarray  # [MAX_BIAS_SLOTS] int32
+    bias_vals: np.ndarray  # [MAX_BIAS_SLOTS] float32
+
+
+@dataclass
 class _Prep:
     """Admission bookkeeping produced by _prepare_admission."""
 
@@ -120,6 +134,7 @@ class _Prep:
     adapter_id: int
     mm_embeds: Optional[np.ndarray]
     mm_slot_of: Optional[np.ndarray]
+    procs: Optional[_ProcPrep] = None
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -183,6 +198,12 @@ class JaxEngine:
         # batches where any request asked for logprobs.
         self._decode_fn = self._build_decode_fn(want_logprobs=False)
         self._decode_fn_logprobs = self._build_decode_fn(want_logprobs=True)
+        # Logits-processor program variants (penalties/bias/min-p) compile
+        # lazily on the first request that uses one — the common no-processor
+        # path never pays for the [S, V] bookkeeping or the extra HBM reads.
+        self._decode_procs_fns: Dict[bool, Any] = {}
+        self._step_fn_procs: Optional[Any] = None
+        self._proc_state: Optional[Any] = None  # logits_process.ProcState
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -194,6 +215,16 @@ class JaxEngine:
         self._topk = np.zeros(S, dtype=np.int32)
         self._topp = np.ones(S, dtype=np.float32)
         self._adapter_ids = np.zeros(S, dtype=np.int32)
+        # Per-slot logits-processor params (neutral unless the occupant asks).
+        from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS
+
+        self._minp = np.zeros(S, dtype=np.float32)
+        self._rep = np.ones(S, dtype=np.float32)
+        self._pres = np.zeros(S, dtype=np.float32)
+        self._freq = np.zeros(S, dtype=np.float32)
+        self._bias_ids = np.full((S, MAX_BIAS_SLOTS), -1, dtype=np.int32)
+        self._bias_vals = np.zeros((S, MAX_BIAS_SLOTS), dtype=np.float32)
+        self._uses_procs = np.zeros(S, dtype=bool)
 
         self.kvbm: Optional[Any] = None  # TieredKvManager (kvbm/manager.py)
         # Plain deque (+ wake event), NOT an asyncio.Queue: _requeue must
@@ -250,75 +281,162 @@ class JaxEngine:
 
     # -- jitted step -------------------------------------------------------
 
-    def _build_step_fn(self):
+    def _build_step_fn(self, want_procs: bool = False):
         cfg = self.config
         use_kernel = self._use_kernel
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
                  block_tables, rng, temp, topk, topp, adapter_ids,
-                 mm_embeds, mm_slot):
+                 mm_embeds, mm_slot,
+                 minp=None, rep=None, pres=None, freq=None,
+                 bias_ids=None, bias_vals=None, pmask=None):
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
                 mm_embeds=mm_embeds, mm_slot=mm_slot,
             )
-            toks = sample_tokens(logits, rng, temp, topk, topp)
+            if want_procs:
+                from dynamo_tpu.ops import logits_process as lp
+
+                # At the first sampled token only the prompt has been seen.
+                pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
+                                   bias_ids=bias_ids, bias_vals=bias_vals)
+                logits = lp.apply_prompt_only(logits, pmask, pp)
+                toks = sample_tokens(logits, rng, temp, topk, topp, minp)
+            else:
+                toks = sample_tokens(logits, rng, temp, topk, topp)
             logp = compute_logprobs(logits, toks)
             return toks, logp, k_cache, v_cache
 
         return jax.jit(step, donate_argnums=(2, 3))
 
-    def _build_decode_fn(self, want_logprobs: bool = False):
+    def _build_decode_fn(self, want_logprobs: bool = False,
+                         want_procs: bool = False):
         cfg = self.config
         use_kernel = self._use_kernel
         num_steps = self.args.decode_steps
 
-        def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                 block_tables, rng, temp, topk, topp, adapter_ids):
-            return llama.decode_multi(
+        if not want_procs:
+            def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
+                     block_tables, rng, temp, topk, topp, adapter_ids):
+                return llama.decode_multi(
+                    params, cfg, tokens, start_pos, active, block_tables,
+                    k_cache, v_cache, rng, temp, topk, topp,
+                    num_steps=num_steps, use_kernel=use_kernel,
+                    lora=lora, adapter_ids=adapter_ids,
+                    want_logprobs=want_logprobs,
+                )
+
+            return jax.jit(step, donate_argnums=(2, 3))
+
+        from dynamo_tpu.ops import logits_process as lp
+
+        def step_p(params, lora, k_cache, v_cache, tokens, start_pos, active,
+                   block_tables, rng, temp, topk, topp, adapter_ids,
+                   minp, rep, pres, freq, bias_ids, bias_vals, counts, pmask):
+            pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
+                               bias_ids=bias_ids, bias_vals=bias_vals)
+            st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
+            toks, logp, k_cache, v_cache, st = llama.decode_multi(
                 params, cfg, tokens, start_pos, active, block_tables,
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
                 want_logprobs=want_logprobs,
+                min_p=minp, proc_params=pp, proc_state=st,
             )
+            return toks, logp, k_cache, v_cache, st.out_counts
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        # donate caches + the token-count array (functionally threaded).
+        return jax.jit(step_p, donate_argnums=(2, 3, 19))
+
+    def _ensure_proc_state(self):
+        if self._proc_state is None:
+            from dynamo_tpu.ops import logits_process as lp
+
+            self._proc_state = lp.init_state(
+                self.args.max_num_seqs, self.config.vocab_size
+            )
+        return self._proc_state
 
     def _run_decode(
         self, tokens, start_pos, active, block_tables, temp, topk, topp,
-        adapter_ids, want_logprobs=False,
+        adapter_ids, want_logprobs=False, want_procs=False,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
         [B, K] logprobs)."""
-        fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
         self._rng, sub = jax.random.split(self._rng)
-        toks, logp, self._k_cache, self._v_cache = fn(
-            self.params, self._lora, self._k_cache, self._v_cache,
-            jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
-            jnp.asarray(block_tables), sub,
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-            jnp.asarray(adapter_ids),
-        )
+        if want_procs:
+            from dynamo_tpu.ops import logits_process as lp
+
+            fn = self._decode_procs_fns.get(want_logprobs)
+            if fn is None:
+                fn = self._build_decode_fn(want_logprobs, want_procs=True)
+                self._decode_procs_fns[want_logprobs] = fn
+            st = self._ensure_proc_state()
+            toks, logp, self._k_cache, self._v_cache, counts = fn(
+                self.params, self._lora, self._k_cache, self._v_cache,
+                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
+                jnp.asarray(block_tables), sub,
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                jnp.asarray(adapter_ids),
+                jnp.asarray(self._minp), jnp.asarray(self._rep),
+                jnp.asarray(self._pres), jnp.asarray(self._freq),
+                jnp.asarray(self._bias_ids), jnp.asarray(self._bias_vals),
+                st.out_counts, st.prompt_mask,
+            )
+            self._proc_state = lp.ProcState(
+                out_counts=counts, prompt_mask=st.prompt_mask
+            )
+        else:
+            fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
+            toks, logp, self._k_cache, self._v_cache = fn(
+                self.params, self._lora, self._k_cache, self._v_cache,
+                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
+                jnp.asarray(block_tables), sub,
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                jnp.asarray(adapter_ids),
+            )
         return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
-        adapter_ids, mm_embeds=None, mm_slot=None,
+        adapter_ids, mm_embeds=None, mm_slot=None, procs=None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Execute one step on the device thread (blocking). Caller passes
-        numpy inputs; returns (sampled tokens, logprobs) as numpy."""
+        numpy inputs; returns (sampled tokens, logprobs) as numpy.
+
+        ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
+        prompt_mask) per-row arrays — routes through the logits-processor
+        prefill program."""
         self._rng, sub = jax.random.split(self._rng)
-        toks, logp, self._k_cache, self._v_cache = self._step_fn(
-            self.params, self._lora, self._k_cache, self._v_cache,
-            jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
-            jnp.asarray(block_tables), sub,
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-            jnp.asarray(adapter_ids),
-            None if mm_embeds is None else jnp.asarray(mm_embeds),
-            None if mm_slot is None else jnp.asarray(mm_slot),
-        )
+        if procs is not None:
+            if self._step_fn_procs is None:
+                self._step_fn_procs = self._build_step_fn(want_procs=True)
+            minp, rep, pres, freq, bias_ids, bias_vals, pmask = procs
+            toks, logp, self._k_cache, self._v_cache = self._step_fn_procs(
+                self.params, self._lora, self._k_cache, self._v_cache,
+                jnp.asarray(tokens), jnp.asarray(start_pos),
+                jnp.asarray(chunk_lens), jnp.asarray(block_tables), sub,
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                jnp.asarray(adapter_ids),
+                None if mm_embeds is None else jnp.asarray(mm_embeds),
+                None if mm_slot is None else jnp.asarray(mm_slot),
+                jnp.asarray(minp), jnp.asarray(rep), jnp.asarray(pres),
+                jnp.asarray(freq), jnp.asarray(bias_ids),
+                jnp.asarray(bias_vals), jnp.asarray(pmask),
+            )
+        else:
+            toks, logp, self._k_cache, self._v_cache = self._step_fn(
+                self.params, self._lora, self._k_cache, self._v_cache,
+                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
+                jnp.asarray(block_tables), sub,
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                jnp.asarray(adapter_ids),
+                None if mm_embeds is None else jnp.asarray(mm_embeds),
+                None if mm_slot is None else jnp.asarray(mm_slot),
+            )
         return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
 
     async def _device(self, fn, *a):
@@ -671,6 +789,7 @@ class JaxEngine:
             adapter_id=self._lora_index.get(seq.request.lora_name or "", 0),
             mm_embeds=mm_embeds,
             mm_slot_of=mm_slot_of,
+            procs=self._procs_of(seq.request),
         )
 
     async def _prefill_batch(
@@ -697,6 +816,31 @@ class JaxEngine:
             tables[r, : len(prep.ids)] = prep.ids
             temp[r], topk[r], topp[r] = prep.sp
             adapter[r] = prep.adapter_id
+        procs = None
+        if any(prep.procs is not None for _, prep in batch):
+            from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS, prompt_hot
+
+            V = self.config.vocab_size
+            minp = np.zeros(Bp, dtype=np.float32)
+            rep = np.ones(Bp, dtype=np.float32)
+            pres = np.zeros(Bp, dtype=np.float32)
+            freq = np.zeros(Bp, dtype=np.float32)
+            bias_ids = np.full((Bp, MAX_BIAS_SLOTS), -1, dtype=np.int32)
+            bias_vals = np.zeros((Bp, MAX_BIAS_SLOTS), dtype=np.float32)
+            pmask = np.zeros((Bp, V), dtype=np.bool_)
+            for r, (seq_r, prep) in enumerate(batch):
+                if prep.procs is None:
+                    continue
+                p = prep.procs
+                minp[r], rep[r], pres[r], freq[r] = p.minp, p.rep, p.pres, p.freq
+                bias_ids[r] = p.bias_ids
+                bias_vals[r] = p.bias_vals
+                # all_tokens (not just the prompt): for preempted re-prefills
+                # the repetition penalty must keep covering already-generated
+                # tokens. (pres/freq at this single re-sample are approximated
+                # as zero; exact history is restored at _install.)
+                pmask[r] = prompt_hot(seq_r.all_tokens, V)
+            procs = (minp, rep, pres, freq, bias_ids, bias_vals, pmask)
         # Multimodal rows run solo (rows == 1), so row 0's arrays suffice.
         mm_embeds = batch[0][1].mm_embeds if rows == 1 else None
         mm_slot_of = batch[0][1].mm_slot_of if rows == 1 else None
@@ -725,7 +869,7 @@ class JaxEngine:
                 self._run_step,
                 tok_arr, start, lens, tables,
                 temp, topk, topp, adapter,
-                mm_embeds, mm_chunk,
+                mm_embeds, mm_chunk, procs,
             )
             for r in range(rows):
                 n = int(lens[r])
@@ -760,6 +904,34 @@ class JaxEngine:
         self._block_tables[slot, : len(prep.ids)] = prep.ids
         self._temp[slot], self._topk[slot], self._topp[slot] = prep.sp
         self._adapter_ids[slot] = prep.adapter_id
+        # Logits-processor slot state: neutral unless this occupant asks —
+        # stale device bookkeeping from a previous occupant is harmless
+        # under neutral params (identity transform).
+        p = prep.procs
+        self._uses_procs[slot] = p is not None
+        if p is None:
+            self._minp[slot] = 0.0
+            self._rep[slot] = 1.0
+            self._pres[slot] = 0.0
+            self._freq[slot] = 0.0
+            self._bias_ids[slot, :] = -1
+            self._bias_vals[slot, :] = 0.0
+        else:
+            from dynamo_tpu.ops import logits_process as lp
+
+            self._minp[slot] = p.minp
+            self._rep[slot] = p.rep
+            self._pres[slot] = p.pres
+            self._freq[slot] = p.freq
+            self._bias_ids[slot] = p.bias_ids
+            self._bias_vals[slot] = p.bias_vals
+            st = self._ensure_proc_state()
+            # Original prompt only in the mask; prior generated tokens (a
+            # preempted sequence being re-admitted) restore output counts.
+            st = lp.reset_slot(
+                st, slot, seq.request.token_ids, seq.generated
+            )
+            self._proc_state = lp.count_token(st, slot, first_token)
         self._emit_token(seq, first_token, first_logprob)
 
     def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
@@ -768,6 +940,25 @@ class JaxEngine:
         topk = s.top_k if s.top_k is not None and s.top_k > 0 else 0
         topp = s.top_p if s.top_p is not None else 1.0
         return float(temp), int(topk), float(topp)
+
+    def _procs_of(self, req: PreprocessedRequest) -> Optional[_ProcPrep]:
+        """Logits-processor params, or None when the request uses none —
+        None keeps the batch on the processor-free compiled programs."""
+        s = req.sampling
+        rep = float(s.repetition_penalty) if s.repetition_penalty else 1.0
+        pres = float(s.presence_penalty) if s.presence_penalty else 0.0
+        freq = float(s.frequency_penalty) if s.frequency_penalty else 0.0
+        minp = float(s.min_p) if s.min_p else 0.0
+        bias = s.logit_bias
+        if rep == 1.0 and pres == 0.0 and freq == 0.0 and minp <= 0.0 and not bias:
+            return None
+        from dynamo_tpu.ops.logits_process import pack_bias
+
+        ids, vals = pack_bias(bias, self.config.vocab_size)
+        return _ProcPrep(
+            minp=minp, rep=rep, pres=pres, freq=freq,
+            bias_ids=ids, bias_vals=vals,
+        )
 
     def _requeue(self, seq: _Sequence) -> None:
         seq.block_ids = []
@@ -823,6 +1014,7 @@ class JaxEngine:
         want_logprobs = any(
             s.request.sampling.logprobs is not None for s in active
         )
+        want_procs = any(self._uses_procs[s.slot] for s in active)
         toks, logps = await self._device(
             self._run_decode,
             tokens,
@@ -832,6 +1024,7 @@ class JaxEngine:
             self._temp.copy(), self._topk.copy(), self._topp.copy(),
             self._adapter_ids.copy(),
             want_logprobs,
+            want_procs,
         )
         self.steps += 1
 

@@ -470,7 +470,9 @@ class HttpService:
             # dialects (ref: lib/parsers; jail.rs does this for streams).
             from dynamo_tpu.parsers import detect_and_parse_tool_calls, split_reasoning
 
-            reasoning, content = split_reasoning(text)
+            reasoning, content = split_reasoning(
+                text, style=entry.card.reasoning_style
+            )
             tool_calls = None
             if body.get("tools"):
                 calls, content = detect_and_parse_tool_calls(content)
@@ -545,7 +547,7 @@ class HttpService:
         status = 200
         finish_seen: Optional[str] = None
         audit_parts: Optional[list] = [] if self.audit.enabled else None
-        reasoning_parser = ReasoningParser()
+        reasoning_parser = ReasoningParser(style=entry.card.reasoning_style)
         try:
             async for item in _prepend(first_item, stream):
                 if isinstance(item, dict) and "annotation" in item:

@@ -41,8 +41,20 @@ class ModelDeploymentCard:
     migration_limit: int = 3
     eos_token_ids: List[int] = field(default_factory=list)
     chat_template_source: Optional[str] = None  # inline template override
+    # Reasoning-content marker style (parsers/reasoning.py KNOWN_MARKERS):
+    # think | reasoning | seed | granite.
+    reasoning_style: str = "think"
     runtime_config: RuntimeConfig = field(default_factory=RuntimeConfig)
     user_data: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        from dynamo_tpu.parsers.reasoning import KNOWN_MARKERS
+
+        if self.reasoning_style not in KNOWN_MARKERS:
+            raise ValueError(
+                f"unknown reasoning_style {self.reasoning_style!r}; "
+                f"known: {sorted(KNOWN_MARKERS)}"
+            )
 
     @property
     def slug(self) -> str:

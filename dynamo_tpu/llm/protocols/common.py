@@ -55,6 +55,8 @@ class SamplingOptions:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    min_p: Optional[float] = None  # drop candidates below min_p × max-prob
+    logit_bias: Optional[Dict[int, float]] = None  # token id → additive bias
     seed: Optional[int] = None
     logprobs: Optional[int] = None  # top-N logprobs to return, None = off
 

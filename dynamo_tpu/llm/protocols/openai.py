@@ -130,12 +130,27 @@ def _parse_shared(req: Dict[str, Any], parsed: ParsedRequest) -> ParsedRequest:
         top_p=_opt_number(req, "top_p", 0.0, 1.0),
         frequency_penalty=_opt_number(req, "frequency_penalty", -2.0, 2.0),
         presence_penalty=_opt_number(req, "presence_penalty", -2.0, 2.0),
+        repetition_penalty=_opt_number(req, "repetition_penalty", 0.001, 10.0),
+        min_p=_opt_number(req, "min_p", 0.0, 1.0),
         seed=req.get("seed"),
     )
     top_k = req.get("top_k")
     if top_k is not None:
         _require(isinstance(top_k, int) and top_k >= -1, "'top_k' must be an integer >= -1")
         sampling.top_k = top_k
+    logit_bias = req.get("logit_bias")
+    if logit_bias is not None:
+        _require(
+            isinstance(logit_bias, dict)
+            and all(
+                isinstance(k, (str, int)) and str(k).lstrip("-").isdigit()
+                and isinstance(v, (int, float))
+                for k, v in logit_bias.items()
+            ),
+            "'logit_bias' must map token ids to numbers",
+        )
+        _require(len(logit_bias) <= 300, "'logit_bias' supports at most 300 entries")
+        sampling.logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
     logprobs = req.get("logprobs")
     if parsed.kind == "chat":
         if logprobs:

@@ -326,7 +326,10 @@ def decode_multi(
     lora: Optional[Dict[str, Any]] = None,
     adapter_ids: Optional[jnp.ndarray] = None,
     want_logprobs: bool = True,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    min_p: Optional[jnp.ndarray] = None,  # [B]
+    proc_params: Optional[Any] = None,  # logits_process.ProcParams
+    proc_state: Optional[Any] = None,  # logits_process.ProcState
+) -> Tuple[jnp.ndarray, ...]:
     """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
     single-token forward+sample steps). Minimizes host↔device round trips —
     the decisive factor on TPU where dispatch latency dwarfs a small model's
@@ -334,17 +337,29 @@ def decode_multi(
     num_steps granularity (overshoot tokens are discarded; their KV writes
     beyond the table capacity are dropped by write_chunk_to_cache).
 
-    Returns (tokens [B, num_steps], logprobs [B, num_steps], k_cache, v_cache).
+    When ``proc_params``/``proc_state`` are given (ops/logits_process.py),
+    penalties/bias are applied before sampling and generated-token counts
+    are carried through the scan.
+
+    Returns (tokens [B, num_steps], logprobs [B, num_steps], k_cache,
+    v_cache[, proc_state]).
     """
+    from dynamo_tpu.ops import logits_process as lp
     from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
 
     def one(carry, step_rng):
-        toks, pos, k_c, v_c = carry
+        if proc_state is not None:
+            toks, pos, k_c, v_c, st = carry
+        else:
+            toks, pos, k_c, v_c = carry
+            st = None
         logits, k_c, v_c = forward_paged(
             params, config, toks[:, None], pos, active, block_tables, k_c, v_c,
             use_kernel=use_kernel, lora=lora, adapter_ids=adapter_ids,
         )
-        nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p)
+        if proc_params is not None:
+            logits = lp.apply(logits, proc_params, st)
+        nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p, min_p)
         nxt = jnp.where(active > 0, nxt, toks)
         if want_logprobs:
             logp = compute_logprobs(logits, nxt)
@@ -352,10 +367,19 @@ def decode_multi(
             # Full-vocab log-softmax each step is pure waste when no active
             # request asked for logprobs (the common case).
             logp = jnp.zeros_like(nxt, dtype=jnp.float32)
+        if st is not None:
+            st = lp.record_tokens(st, nxt, active)
         pos = pos + active
+        if st is not None:
+            return (nxt, pos, k_c, v_c, st), (nxt, logp)
         return (nxt, pos, k_c, v_c), (nxt, logp)
 
     rngs = jax.random.split(rng, num_steps)
+    if proc_state is not None:
+        (_, _, k_cache, v_cache, proc_state), (toks, logps) = jax.lax.scan(
+            one, (tokens, start_pos, k_cache, v_cache, proc_state), rngs
+        )
+        return toks.T, logps.T, k_cache, v_cache, proc_state
     (_, _, k_cache, v_cache), (toks, logps) = jax.lax.scan(
         one, (tokens, start_pos, k_cache, v_cache), rngs
     )

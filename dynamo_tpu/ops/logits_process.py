@@ -1,0 +1,210 @@
+"""Jittable logits processors (penalties, bias, bans) for the native engine.
+
+Reference parity: the reference exposes pluggable logits processing to
+engines via its Python bindings (`dynamo.logits_processing` — see reference
+lib/bindings/python, `src/dynamo/logits_processing`) and relies on the
+engine's sampler (vLLM) for presence/frequency/repetition penalties and
+logit bias. Here the engine IS native, so the processors are part of the
+fused sampling step.
+
+TPU-first design notes:
+  - All processors are batched and gated by per-sequence parameters, so ONE
+    compiled program serves a heterogeneous continuous batch: sequences
+    that didn't ask for a processor carry neutral parameters (rep=1,
+    pres=freq=0, empty bias) that make the transform an identity for their
+    row. No per-request recompilation, no dynamic shapes.
+  - Token bookkeeping ([B, V] output counts + prompt-membership mask) lives
+    on device and is updated inside the decode scan; the engine only pays
+    for it when some active request actually uses a penalty (the engine
+    compiles a separate program variant, see engines/tpu/engine.py).
+  - `logit_bias` is a fixed number of (token, bias) slots per row
+    (MAX_BIAS_SLOTS), applied with a dropped-out-of-bounds scatter — static
+    shapes, no host round trip. Banned tokens are just bias slots with
+    BAN_BIAS.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Matches the OpenAI API contract (300 logit_bias entries max), so the
+# protocol-level validation and the engine capacity agree exactly.
+MAX_BIAS_SLOTS = 300
+BAN_BIAS = -1e9  # effectively -inf but safe in fp32 arithmetic
+
+
+class ProcParams(NamedTuple):
+    """Per-sequence processor parameters ([B]-shaped unless noted)."""
+
+    rep: jnp.ndarray  # repetition penalty; 1.0 = off
+    pres: jnp.ndarray  # presence penalty; 0.0 = off
+    freq: jnp.ndarray  # frequency penalty; 0.0 = off
+    bias_ids: jnp.ndarray  # [B, MAX_BIAS_SLOTS] int32; -1 = empty slot
+    bias_vals: jnp.ndarray  # [B, MAX_BIAS_SLOTS] float32
+
+
+class ProcState(NamedTuple):
+    """Per-sequence device bookkeeping for penalties."""
+
+    out_counts: jnp.ndarray  # [B, V] int32 — generated-token counts
+    prompt_mask: jnp.ndarray  # [B, V] bool — token appears in the prompt
+
+
+def neutral_params(batch: int) -> ProcParams:
+    return ProcParams(
+        rep=jnp.ones((batch,), jnp.float32),
+        pres=jnp.zeros((batch,), jnp.float32),
+        freq=jnp.zeros((batch,), jnp.float32),
+        bias_ids=jnp.full((batch, MAX_BIAS_SLOTS), -1, jnp.int32),
+        bias_vals=jnp.zeros((batch, MAX_BIAS_SLOTS), jnp.float32),
+    )
+
+
+def init_state(batch: int, vocab: int) -> ProcState:
+    return ProcState(
+        out_counts=jnp.zeros((batch, vocab), jnp.int32),
+        prompt_mask=jnp.zeros((batch, vocab), jnp.bool_),
+    )
+
+
+def apply(
+    logits: jnp.ndarray,  # [B, V] float
+    params: ProcParams,
+    state: Optional[ProcState],
+) -> jnp.ndarray:
+    """Apply penalties then bias. Neutral params → identity per row."""
+    logits = logits.astype(jnp.float32)
+    if state is not None:
+        counts = state.out_counts.astype(jnp.float32)
+        seen = (state.out_counts > 0) | state.prompt_mask
+        # Repetition penalty (HF semantics: prompt ∪ output tokens).
+        rp = params.rep[:, None]
+        logits = jnp.where(
+            seen,
+            jnp.where(logits > 0, logits / rp, logits * rp),
+            logits,
+        )
+        # OpenAI-style additive penalties (output tokens only).
+        logits = logits - params.freq[:, None] * counts
+        logits = logits - params.pres[:, None] * (state.out_counts > 0)
+    return _add_bias(logits, params)
+
+
+def apply_prompt_only(
+    logits: jnp.ndarray,  # [B, V] float
+    prompt_mask: jnp.ndarray,  # [B, V] bool
+    params: ProcParams,
+) -> jnp.ndarray:
+    """Prefill-time variant: at the first sampled token no output tokens
+    exist yet, so presence/frequency penalties are identically zero — only
+    the repetition penalty (over the prompt) and the bias apply."""
+    logits = logits.astype(jnp.float32)
+    rp = params.rep[:, None]
+    logits = jnp.where(
+        prompt_mask,
+        jnp.where(logits > 0, logits / rp, logits * rp),
+        logits,
+    )
+    return _add_bias(logits, params)
+
+
+def _add_bias(logits: jnp.ndarray, params: ProcParams) -> jnp.ndarray:
+    # Sparse per-row logit bias; -1 slots fall outside [0, V) and are
+    # dropped by the scatter.
+    B = logits.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    safe_vals = jnp.where(params.bias_ids >= 0, params.bias_vals, 0.0)
+    return logits.at[rows, params.bias_ids].add(
+        safe_vals, mode="drop", indices_are_sorted=False
+    )
+
+
+def record_tokens(
+    state: ProcState,
+    tokens: jnp.ndarray,  # [B] int32 just-sampled tokens
+    active: jnp.ndarray,  # [B] int/bool — 1 where the row really generated
+) -> ProcState:
+    """Count one generated token per active row (inside the decode scan)."""
+    B = tokens.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    counts = state.out_counts.at[rows, tokens].add(
+        active.astype(jnp.int32), mode="drop"
+    )
+    return state._replace(out_counts=counts)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _reset_row(state: ProcState, slot: jnp.ndarray, hot: jnp.ndarray,
+               counts_row: jnp.ndarray):
+    counts = state.out_counts.at[slot].set(counts_row)
+    mask = state.prompt_mask.at[slot].set(hot)
+    return ProcState(out_counts=counts, prompt_mask=mask)
+
+
+def prompt_hot(tokens, vocab: int) -> np.ndarray:
+    """[V] bool membership mask for a token list (ids clamped to vocab)."""
+    hot = np.zeros((vocab,), dtype=np.bool_)
+    toks = np.asarray(tokens, dtype=np.int64)
+    toks = toks[(toks >= 0) & (toks < vocab)]
+    hot[toks] = True
+    return hot
+
+
+def reset_slot(
+    state: ProcState, slot: int, prompt_tokens, generated_tokens=()
+) -> ProcState:
+    """Host-side: initialize a slot's bookkeeping at admission.
+
+    ``generated_tokens`` restores output-token counts for preempted
+    sequences being re-admitted (recompute keeps their generation history —
+    presence/frequency penalties must keep applying to it)."""
+    vocab = state.prompt_mask.shape[1]
+    hot = prompt_hot(prompt_tokens, vocab)
+    gen = np.asarray(generated_tokens, dtype=np.int64)
+    gen = gen[(gen >= 0) & (gen < vocab)]
+    counts = np.bincount(gen, minlength=vocab).astype(np.int32)
+    return _reset_row(state, jnp.int32(slot), jnp.asarray(hot), jnp.asarray(counts))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _count_one(state: ProcState, slot: jnp.ndarray, token: jnp.ndarray):
+    counts = state.out_counts.at[slot, token].add(1, mode="drop")
+    return state._replace(out_counts=counts)
+
+
+def count_token(state: ProcState, slot: int, token: int) -> ProcState:
+    """Host-side: count a single generated token (the prefill-sampled one)."""
+    return _count_one(state, jnp.int32(slot), jnp.int32(token))
+
+
+def pack_bias(logit_bias, vocab: int):
+    """OpenAI `logit_bias` dict → fixed (ids, vals) slot arrays (numpy).
+
+    Entries beyond MAX_BIAS_SLOTS are dropped, most-extreme-bias first kept
+    (bans and strong steering survive truncation).
+    """
+    ids = np.full((MAX_BIAS_SLOTS,), -1, dtype=np.int32)
+    vals = np.zeros((MAX_BIAS_SLOTS,), dtype=np.float32)
+    if not logit_bias:
+        return ids, vals
+    items = []
+    for k, v in logit_bias.items():
+        t = int(k)
+        if 0 <= t < vocab:
+            b = float(v)
+            # OpenAI semantics: ±100 means ban/force; map to BAN_BIAS scale.
+            if b <= -100.0:
+                b = BAN_BIAS
+            elif b >= 100.0:
+                b = -BAN_BIAS
+            items.append((t, b))
+    items.sort(key=lambda tv: -abs(tv[1]))
+    for i, (t, b) in enumerate(items[:MAX_BIAS_SLOTS]):
+        ids[i] = t
+        vals[i] = b
+    return ids, vals

@@ -27,6 +27,7 @@ def sample_tokens(
     temperature: jnp.ndarray,  # [B] float; <=0 means greedy
     top_k: jnp.ndarray,  # [B] int; <=0 means off
     top_p: jnp.ndarray,  # [B] float; >=1 means off
+    min_p: jnp.ndarray = None,  # [B] float; <=0/None means off
 ) -> jnp.ndarray:
     """Returns sampled token ids [B]. Fully vectorized, static shapes."""
     B, V = logits.shape
@@ -59,6 +60,11 @@ def sample_tokens(
     keep_p = (cum - probs) < jnp.clip(top_p, 0.0, 1.0)[:, None]
 
     keep = keep_k & keep_p
+    if min_p is not None:
+        # min-p: drop candidates with prob < min_p × max-prob. probs is
+        # descending, so column 0 is the max. Neutral at min_p <= 0.
+        keep_mp = probs >= jnp.clip(min_p, 0.0, 1.0)[:, None] * probs[:, :1]
+        keep = keep & keep_mp
     masked = jnp.where(keep, top_logits, NEG_INF)
     gumbel = jax.random.gumbel(rng, (B, W), dtype=jnp.float32)
     choice_rank = jnp.argmax(masked + gumbel, axis=-1)  # [B]

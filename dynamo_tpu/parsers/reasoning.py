@@ -3,36 +3,63 @@
 Reference parity: lib/parsers/src/reasoning/{base_parser,gpt_oss_parser,
 granite_parser}.rs — split generated text into `reasoning_content` and
 `content`. The streaming parser is a small state machine that survives tags
-straddling delta boundaries.
+straddling delta boundaries. Styles may have several equivalent marker
+spellings (granite emits prose markers in two variants each).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Sequence, Tuple
 
-KNOWN_TAGS = {
-    "think": ("<think>", "</think>"),
-    "reasoning": ("<reasoning>", "</reasoning>"),
-    "seed": ("<seed:think>", "</seed:think>"),
+# style → (open-tag variants, close-tag variants). The first variant is the
+# canonical spelling; all variants are recognized on input.
+KNOWN_MARKERS = {
+    "think": (("<think>",), ("</think>",)),
+    "reasoning": (("<reasoning>",), ("</reasoning>",)),
+    "seed": (("<seed:think>",), ("</seed:think>",)),
+    # ref: granite_parser.rs:19-23 — prose markers, two spellings each.
+    "granite": (
+        ("Here's my thought process:", "Here is my thought process:"),
+        ("Here's my response:", "Here is my response:"),
+    ),
 }
+
+# Backwards-compatible view for single-tag styles.
+KNOWN_TAGS = {
+    style: (opens[0], closes[0])
+    for style, (opens, closes) in KNOWN_MARKERS.items()
+}
+
+
+def _find_first(text: str, tags: Sequence[str], start: int = 0):
+    """Earliest occurrence of any tag variant → (index, tag) or (-1, '')."""
+    best, best_tag = -1, ""
+    for tag in tags:
+        i = text.find(tag, start)
+        if i != -1 and (best == -1 or i < best):
+            best, best_tag = i, tag
+    return best, best_tag
 
 
 def split_reasoning(text: str, style: str = "think") -> Tuple[str, str]:
     """One-shot split of a complete response → (reasoning, content)."""
-    open_tag, close_tag = KNOWN_TAGS[style]
-    start = text.find(open_tag)
+    opens, closes = KNOWN_MARKERS[style]
+    start, open_tag = _find_first(text, opens)
     if start == -1:
         # Some models emit the close tag only (reasoning-first templates).
-        end_only = text.find(close_tag)
+        end_only, close_tag = _find_first(text, closes)
         if end_only != -1:
-            return text[:end_only].strip(), text[end_only + len(close_tag):].lstrip("\n")
+            return (
+                text[:end_only].strip(),
+                text[end_only + len(close_tag):].lstrip(),
+            )
         return "", text
-    end = text.find(close_tag, start)
+    end, close_tag = _find_first(text, closes, start)
     if end == -1:
         return text[start + len(open_tag):].strip(), ""
     reasoning = text[start + len(open_tag): end].strip()
-    content = (text[:start] + text[end + len(close_tag):]).lstrip("\n")
+    content = (text[:start] + text[end + len(close_tag):]).lstrip()
     return reasoning, content
 
 
@@ -47,11 +74,11 @@ class ReasoningParser:
     content_delta) pairs. Holds back a suffix that could be a partial tag."""
 
     def __init__(self, style: str = "think", starts_in_reasoning: bool = False) -> None:
-        self.open_tag, self.close_tag = KNOWN_TAGS[style]
+        self.open_tags, self.close_tags = KNOWN_MARKERS[style]
         self._s = _State(mode="reasoning" if starts_in_reasoning else "content")
 
-    def _active_tag(self) -> str:
-        return self.close_tag if self._s.mode == "reasoning" else self.open_tag
+    def _active_tags(self) -> Sequence[str]:
+        return self.close_tags if self._s.mode == "reasoning" else self.open_tags
 
     def feed(self, delta: str) -> Tuple[str, str]:
         reasoning_out = []
@@ -59,8 +86,8 @@ class ReasoningParser:
         text = self._s.buffer + delta
         self._s.buffer = ""
         while text:
-            tag = self._active_tag()
-            idx = text.find(tag)
+            tags = self._active_tags()
+            idx, tag = _find_first(text, tags)
             if idx != -1:
                 emitted, text = text[:idx], text[idx + len(tag):]
                 if self._s.mode == "reasoning":
@@ -71,10 +98,11 @@ class ReasoningParser:
                     self._s.mode = "reasoning"
                 continue
             # No full tag: hold back the longest suffix that is a prefix of
-            # the tag we're looking for.
+            # any tag variant we're looking for.
             hold = 0
-            for n in range(min(len(tag) - 1, len(text)), 0, -1):
-                if tag.startswith(text[-n:]):
+            max_n = min(max(len(t) for t in tags) - 1, len(text))
+            for n in range(max_n, 0, -1):
+                if any(t.startswith(text[-n:]) for t in tags):
                     hold = n
                     break
             emit, self._s.buffer = (text[:-hold], text[-hold:]) if hold else (text, "")

@@ -44,12 +44,22 @@ class KvRouter:
         *,
         block_size: int = 16,
         config: Optional[KvRouterConfig] = None,
+        use_kv_events: bool = True,
+        prune_config: Optional[Any] = None,
     ) -> None:
         self._runtime = runtime
         self.namespace = namespace
         self.component = component
         self.block_size = block_size
-        self.indexer = KvIndexer(block_size)
+        self.use_kv_events = use_kv_events
+        if use_kv_events:
+            self.indexer = KvIndexer(block_size)
+        else:
+            # Approximate mode (ref: kv_router.rs:359): no event feed — the
+            # router's own routing decisions seed the index, TTL-pruned.
+            from dynamo_tpu.router.approx import ApproxKvIndexer
+
+            self.indexer = ApproxKvIndexer(block_size, prune_config)
         self.scheduler = KvScheduler(config)
         self._tasks: list = []
         self._subs: list = []
@@ -68,14 +78,20 @@ class KvRouter:
 
     async def start(self) -> None:
         plane = self._runtime.event_plane
-        kv_sub = plane.subscribe(kv_events_topic(self.namespace, self.component))
         load_sub = plane.subscribe(load_topic(self.namespace, self.component))
-        self._subs = [kv_sub, load_sub]
+        self._subs = [load_sub]
         loop = asyncio.get_running_loop()
         self._tasks = [
-            loop.create_task(self._pump_kv(kv_sub), name="kv-router-events"),
             loop.create_task(self._pump_load(load_sub), name="kv-router-load"),
         ]
+        if self.use_kv_events:
+            kv_sub = plane.subscribe(
+                kv_events_topic(self.namespace, self.component)
+            )
+            self._subs.append(kv_sub)
+            self._tasks.append(
+                loop.create_task(self._pump_kv(kv_sub), name="kv-router-events")
+            )
 
     async def stop(self) -> None:
         for sub in self._subs:
@@ -144,6 +160,10 @@ class KvRouter:
         request_blocks = max(len(hashes), 1)
         worker = self.scheduler.select_worker(request_blocks, overlaps, candidates)
         overlap = overlaps.scores.get(worker, 0) if worker is not None else 0
+        if not self.use_kv_events and worker is not None:
+            # Approximate mode: assume the chosen worker will cache these
+            # blocks (ref: kv_router.rs:937 routing-decision recording).
+            self.indexer.process_routing_decision(hashes, worker)
         return worker, overlap
 
     def release(

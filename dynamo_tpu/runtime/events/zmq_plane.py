@@ -112,7 +112,9 @@ class ZmqEventPlane:
                     raw_topic, raw_payload = await sock.recv_multipart()
                     t = raw_topic.decode()
                     if topic_matches(topic, t):
-                        queue.put_nowait((t, msgpack.unpackb(raw_payload, raw=False)))
+                        queue.put_nowait((t, msgpack.unpackb(
+                            raw_payload, raw=False, strict_map_key=False
+                        )))
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -66,5 +66,12 @@ class FrameReader:
             raise ValueError(f"frame too large: {hlen}+{plen}")
         body = await self._reader.readexactly(hlen + plen)
         header = msgpack.unpackb(body[:hlen], raw=False)
-        payload = msgpack.unpackb(body[hlen:], raw=False) if plen else None
+        # strict_map_key=False: request payloads legitimately carry int-keyed
+        # maps (OpenAI logit_bias is token-id → bias) between our own
+        # processes; the strict default exists for untrusted internet input.
+        payload = (
+            msgpack.unpackb(body[hlen:], raw=False, strict_map_key=False)
+            if plen
+            else None
+        )
         return header, payload

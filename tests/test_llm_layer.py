@@ -97,11 +97,28 @@ def test_parse_chat_request_valid():
     assert parsed.stream
 
 
+def test_parse_sampling_extensions():
+    parsed = parse_chat_request(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "repetition_penalty": 1.2,
+            "min_p": 0.05,
+            "logit_bias": {"42": -100, "7": 1.5},
+        }
+    )
+    assert parsed.sampling.repetition_penalty == 1.2
+    assert parsed.sampling.min_p == 0.05
+    assert parsed.sampling.logit_bias == {42: -100.0, 7: 1.5}
+
+
 @pytest.mark.parametrize(
     "body,fragment",
     [
         ({}, "model"),
         ({"model": "m"}, "messages"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {"x": 1}}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "min_p": 2}, "min_p"),
         ({"model": "m", "messages": []}, "non-empty"),
         ({"model": "m", "messages": [{"role": "robot", "content": "x"}]}, "role"),
         ({"model": "m", "messages": [{"role": "user", "content": "x"}], "temperature": 9}, "temperature"),

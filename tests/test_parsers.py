@@ -58,6 +58,56 @@ class TestReasoning:
         assert c2 == "<thi"  # not a real tag; returned verbatim
 
 
+class TestGraniteReasoning:
+    """ref: lib/parsers/src/reasoning/granite_parser.rs — prose markers in
+    two spellings each."""
+
+    def test_one_shot(self):
+        r, c = split_reasoning(
+            "Here's my thought process: I need to think about this. "
+            "Here's my response: The answer is 42.",
+            style="granite",
+        )
+        assert r == "I need to think about this."
+        assert c == "The answer is 42."
+
+    def test_alternate_spellings(self):
+        r, c = split_reasoning(
+            "Here is my thought process: hmm. Here is my response: ok.",
+            style="granite",
+        )
+        assert r == "hmm." and c == "ok."
+
+    def test_mixed_spellings(self):
+        r, c = split_reasoning(
+            "Here is my thought process: hmm. Here's my response: ok.",
+            style="granite",
+        )
+        assert r == "hmm." and c == "ok."
+
+    def test_no_markers_passthrough(self):
+        r, c = split_reasoning("plain answer", style="granite")
+        assert r == "" and c == "plain answer"
+
+    def test_streaming_markers_across_deltas(self):
+        p = ReasoningParser(style="granite")
+        chunks = [
+            "Here's my thought pro",
+            "cess: deep thought. Here is my res",
+            "ponse: the answer.",
+        ]
+        reasoning, content = "", ""
+        for ch in chunks:
+            r, c = p.feed(ch)
+            reasoning += r
+            content += c
+        r, c = p.flush()
+        reasoning += r
+        content += c
+        assert reasoning.strip() == "deep thought."
+        assert content.strip() == "the answer."
+
+
 class TestToolCalls:
     def test_json_dialect(self):
         calls, rest = detect_and_parse_tool_calls(

@@ -1,0 +1,158 @@
+"""Approximate KV indexer (router/approx.py): PruneManager TTL/size
+behavior, routing-decision recording, and KvRouter integration in
+use_kv_events=False mode (ref: lib/kv-router/src/approx.rs,
+kv_router.rs:359,937)."""
+
+from dynamo_tpu.router.approx import (
+    ApproxKvIndexer,
+    PruneConfig,
+    PruneManager,
+)
+from dynamo_tpu.router.router import KvRouter
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class TestPruneManager:
+    def test_ttl_expiry(self):
+        clock = FakeClock()
+        pm = PruneManager(PruneConfig(ttl=10.0), clock=clock)
+        pm.insert(["a", "b"], [0, 1])
+        assert pm.pop_expired() == []
+        clock.now += 11
+        assert sorted(pm.pop_expired()) == ["a", "b"]
+        assert len(pm) == 0
+
+    def test_refresh_extends_ttl(self):
+        clock = FakeClock()
+        pm = PruneManager(PruneConfig(ttl=10.0), clock=clock)
+        pm.insert(["a"], [0])
+        clock.now += 8
+        pm.insert(["a"], [0])  # refresh
+        clock.now += 5  # 13s after first insert, 5s after refresh
+        assert pm.pop_expired() == []  # stale heap entry skipped
+        clock.now += 6
+        assert pm.pop_expired() == ["a"]
+
+    def test_prune_evicts_soonest_expiring_deepest_first(self):
+        clock = FakeClock()
+        pm = PruneManager(
+            PruneConfig(ttl=100.0, max_tree_size=4, prune_target_ratio=0.5),
+            clock=clock,
+        )
+        # Same expiry — depth breaks the tie, deepest evicted first.
+        pm.insert(["d0", "d1", "d2", "d3"], [0, 1, 2, 3])
+        evicted = pm.prune(current_size=5)
+        assert len(pm) == 2
+        assert evicted == ["d0", "d1"]  # heap pops smallest (expiry, depth)
+        # Reference semantics: evicts by earliest expiry; within one insert
+        # batch every key shares an expiry so lowest depth pops first —
+        # but across batches the OLDER batch always goes first:
+        pm2 = PruneManager(
+            PruneConfig(ttl=100.0, max_tree_size=2, prune_target_ratio=0.5),
+            clock=clock,
+        )
+        pm2.insert(["old"], [5])
+        clock.now += 1
+        pm2.insert(["new"], [0])
+        assert pm2.prune(current_size=3) == ["old"]
+
+    def test_under_limit_no_prune(self):
+        pm = PruneManager(PruneConfig(max_tree_size=10))
+        pm.insert(["a"], [0])
+        assert pm.prune(current_size=5) == []
+
+
+class TestApproxIndexer:
+    def test_decision_creates_matches(self):
+        idx = ApproxKvIndexer(block_size=4)
+        hashes = compute_block_hashes(list(range(16)), 4)
+        idx.process_routing_decision(hashes, (1, 0))
+        scores = idx.find_matches(hashes)
+        assert scores.scores.get((1, 0)) == len(hashes)
+
+    def test_ttl_ages_out_knowledge(self):
+        clock = FakeClock()
+        idx = ApproxKvIndexer(4, PruneConfig(ttl=30.0), clock=clock)
+        hashes = compute_block_hashes(list(range(16)), 4)
+        idx.process_routing_decision(hashes, (1, 0))
+        clock.now += 31
+        scores = idx.find_matches(hashes)
+        assert scores.scores.get((1, 0), 0) == 0
+        assert idx.stats.expired == len(hashes)
+
+    def test_size_prune_bounds_tree(self):
+        idx = ApproxKvIndexer(
+            4, PruneConfig(ttl=1e9, max_tree_size=8, prune_target_ratio=0.5)
+        )
+        for i in range(6):
+            hashes = compute_block_hashes(
+                [100 * i + j for j in range(12)], 4
+            )
+            idx.process_routing_decision(hashes, (i, 0))
+        assert idx.tree.num_blocks <= 8
+
+    def test_remove_worker(self):
+        idx = ApproxKvIndexer(4)
+        hashes = compute_block_hashes(list(range(8)), 4)
+        idx.process_routing_decision(hashes, (7, 0))
+        idx.remove_worker((7, 0))
+        assert idx.find_matches(hashes).scores.get((7, 0), 0) == 0
+
+
+class _FakeRuntime:
+    class _Plane:
+        def subscribe(self, topic):
+            raise AssertionError(f"approx mode must not subscribe to {topic}")
+
+    event_plane = _Plane()
+
+
+async def test_router_approx_mode_prefers_prior_worker():
+    """Second identical request must route to the worker the first one
+    chose — the decision record IS the index in approximate mode."""
+
+    class _LoadOnlyPlane:
+        def __init__(self):
+            self.topics = []
+
+        def subscribe(self, topic):
+            self.topics.append(topic)
+
+            class _Sub:
+                async def aclose(self):
+                    pass
+
+                def __aiter__(self):
+                    return self
+
+                async def __anext__(self):
+                    import asyncio
+
+                    await asyncio.Event().wait()  # never yields
+
+            return _Sub()
+
+    class _RT:
+        event_plane = _LoadOnlyPlane()
+
+    router = KvRouter(_RT(), "ns", "backend", block_size=4, use_kv_events=False)
+    await router.start()
+    try:
+        assert all("kv" not in t for t in _RT.event_plane.topics)
+        tokens = list(range(32))
+        w1, overlap1 = router.find_best_match(tokens, [(1, 0), (2, 0)])
+        assert overlap1 == 0
+        router.release(w1, 8)
+        w2, overlap2 = router.find_best_match(tokens, [(1, 0), (2, 0)])
+        assert w2 == w1
+        assert overlap2 == len(compute_block_hashes(tokens, 4))
+    finally:
+        await router.stop()
