@@ -68,7 +68,8 @@ def registry() -> Dict[str, EnvVar]:
 
 NAMESPACE = env_str("DYN_TPU_NAMESPACE", "dynamo", "Default namespace for components")
 REQUEST_PLANE = env_str(
-    "DYN_TPU_REQUEST_PLANE", "tcp", "Request plane for cross-process serving: tcp|local"
+    "DYN_TPU_REQUEST_PLANE", "tcp",
+    "Request plane for cross-process serving: tcp|http|local"
 )
 DISCOVERY = env_str(
     "DYN_TPU_DISCOVERY", "memory", "Discovery backend: memory|file|discd (addr via DYN_TPU_DISCOVERY_ADDR)"

@@ -143,6 +143,16 @@ def _scatter_blocks(cache, idx, blocks):
     return cache.at[:, idx].set(blocks)
 
 
+def _adapter_to_host(adapter):
+    """Keep retained adapters as host numpy: only the STACKED arrays belong
+    in HBM — retaining per-adapter device copies for restacking would
+    double LoRA device memory."""
+    adapter.weights = {
+        t: (np.asarray(A), np.asarray(B)) for t, (A, B) in adapter.weights.items()
+    }
+    return adapter
+
+
 class JaxEngine:
     """AsyncEngine over the native JAX model."""
 
@@ -174,20 +184,21 @@ class JaxEngine:
                 params, llama.param_logical_axes(self.config), self.rules, mesh
             )
         self.params = params
-        k_cache, v_cache = llama.init_kv_cache(
-            self.config, args.num_kv_blocks, args.block_size
-        )
-        if mesh is not None:
-            cache_sharding = self.rules.sharding(mesh, *llama.kv_cache_logical_axes())
-            k_cache = jax.device_put(k_cache, cache_sharding)
-            v_cache = jax.device_put(v_cache, cache_sharding)
-        self._k_cache = k_cache
-        self._v_cache = v_cache
+        self._k_cache, self._v_cache = self._alloc_kv_cache()
+        # Sleep/wake (ref: vllm handlers.py sleep :286 / wake_up :317 — RL
+        # weight-sync workflows park the engine to free accelerator memory).
+        # 0 = awake; 1 = KV cache freed; 2 = weights offloaded to host too.
+        self._sleep_level = 0
+        self._sleep_requested: Optional[int] = None
+        self._sleep_inflight = False
+        self._sleep_event = asyncio.Event()
+        self._host_params: Optional[Any] = None
 
         # Multi-LoRA state: adapter name → index into the stacked arrays
         # (index 0 is the zero "no adapter" slot).
         self._lora: Optional[Dict[str, Any]] = None
         self._lora_index: Dict[str, int] = {}
+        self._adapter_list: List[Optional[Any]] = []  # slot i ↔ stacked index i+1
         if args.lora_dir:
             self._load_loras(args.lora_dir)
 
@@ -249,32 +260,96 @@ class JaxEngine:
 
     # -- multi-LoRA --------------------------------------------------------
 
+    def _alloc_kv_cache(self):
+        k_cache, v_cache = llama.init_kv_cache(
+            self.config, self.args.num_kv_blocks, self.args.block_size
+        )
+        if self.mesh is not None:
+            cache_sharding = self.rules.sharding(
+                self.mesh, *llama.kv_cache_logical_axes()
+            )
+            k_cache = jax.device_put(k_cache, cache_sharding)
+            v_cache = jax.device_put(v_cache, cache_sharding)
+        return k_cache, v_cache
+
     def _load_loras(self, lora_dir: str) -> None:
         """Load every adapter under ``lora_dir`` and stack them layer-major
         for the scan-over-layers forward (lora/loader.py)."""
         from dynamo_tpu.lora import LocalLoRASource, load_lora_adapter
-        from dynamo_tpu.lora.loader import stack_adapters
 
         source = LocalLoRASource(lora_dir)
         names = source.list_adapters()
         if not names:
             logger.warning("lora_dir %s contains no adapters", lora_dir)
             return
-        adapters = [
-            load_lora_adapter(source.fetch(n, lora_dir), self.config, name=n)
+        self._adapter_list = [
+            _adapter_to_host(
+                load_lora_adapter(source.fetch(n, lora_dir), self.config, name=n)
+            )
             for n in names
         ]
-        targets = sorted({t for a in adapters for t in a.targets})
-        stacked = stack_adapters(adapters, self.config, targets)
+        self._restack_loras()
+
+    def _restack_loras(self) -> None:
+        """Rebuild the stacked LoRA arrays from ``_adapter_list`` (None
+        entries are freed slots that keep later indices stable — in-flight
+        sequences hold adapter ids by position)."""
+        from dynamo_tpu.lora.loader import LoRAAdapter, stack_adapters
+
+        real = [a for a in self._adapter_list if a is not None]
+        if not real:
+            self._lora = None
+            self._lora_index = {}
+            return
+        padded = [
+            a if a is not None
+            else LoRAAdapter(name=f"__free_{i}", rank=1, scaling=0.0)
+            for i, a in enumerate(self._adapter_list)
+        ]
+        targets = sorted({t for a in real for t in a.targets})
+        stacked = stack_adapters(padded, self.config, targets)
         # [N+1, L, ...] → layer-major [L, N+1, ...] for lax.scan xs.
         self._lora = {
             t: (A.swapaxes(0, 1), B.swapaxes(0, 1)) for t, (A, B) in stacked.items()
         }
-        self._lora_index = {a.name: i for i, a in enumerate(adapters, start=1)}
+        self._lora_index = {
+            a.name: i
+            for i, a in enumerate(self._adapter_list, start=1)
+            if a is not None
+        }
         logger.info(
-            "loaded %d LoRA adapter(s): %s (targets: %s)",
-            len(adapters), names, targets,
+            "LoRA stack: %d slot(s), adapters %s (targets: %s)",
+            len(self._adapter_list), sorted(self._lora_index), targets,
         )
+
+    def load_lora(self, name: str, adapter_dir: str) -> None:
+        """Load one adapter at runtime (ref: vllm handlers.py LoRA load
+        :453). Changing the stack shape recompiles the decode program on the
+        next step — acceptable for an administrative operation."""
+        if name in self._lora_index:
+            raise ValueError(f"LoRA adapter {name!r} already loaded")
+        from dynamo_tpu.lora import load_lora_adapter
+
+        adapter = _adapter_to_host(
+            load_lora_adapter(adapter_dir, self.config, name=name)
+        )
+        adapter.name = name
+        for i, slot in enumerate(self._adapter_list):
+            if slot is None:
+                self._adapter_list[i] = adapter
+                break
+        else:
+            self._adapter_list.append(adapter)
+        self._restack_loras()
+
+    def unload_lora(self, name: str) -> None:
+        """Unload an adapter; its slot is zeroed (kept) so other adapters'
+        indices — captured by in-flight sequences — stay valid."""
+        idx = self._lora_index.get(name)
+        if idx is None:
+            raise KeyError(f"LoRA adapter {name!r} not loaded")
+        self._adapter_list[idx - 1] = None
+        self._restack_loras()
 
     def lora_names(self) -> List[str]:
         return sorted(self._lora_index)
@@ -471,6 +546,7 @@ class JaxEngine:
             "decode_steps": self.steps,
             "prefill_tokens": self.prefill_tokens,
             "generated_tokens": self.generated_tokens,
+            "sleep_level": self._sleep_level,
         }
         if self.kvbm is not None:
             out["kvbm"] = self.kvbm.stats()
@@ -486,6 +562,75 @@ class JaxEngine:
         n = self.pool.cached_blocks
         self.pool.clear()
         return n
+
+    # -- sleep / wake ------------------------------------------------------
+
+    @property
+    def sleep_level(self) -> int:
+        return self._sleep_level
+
+    async def sleep(self, level: int = 1) -> None:
+        """Park the engine to free device memory (ref: vllm handlers.py
+        sleep :286). Level 1 frees the KV cache; level 2 also offloads the
+        weights to host RAM. Active sequences drain first; queued requests
+        wait until wake()."""
+        if self._sleep_level > 0:
+            return
+        await self.start()
+        if self._failure is not None or (
+            self._loop_task is None or self._loop_task.done()
+        ):
+            raise RuntimeError(
+                "engine scheduler is not running; cannot sleep "
+                f"(failure: {self._failure})"
+            )
+        self._sleep_requested = max(1, min(2, int(level)))
+        self._sleep_event.clear()
+        self._wake.set()
+        await self._sleep_event.wait()
+
+    async def wake(self) -> None:
+        """Restore device state after sleep (ref: vllm wake_up :317)."""
+        if (
+            self._sleep_level == 0
+            and self._sleep_requested is None
+            and not self._sleep_inflight
+        ):
+            return
+        self._sleep_requested = None
+        await self._device(self._do_wake)
+        # Release a sleep() caller whose request we just cancelled.
+        self._sleep_event.set()
+        self._wake.set()
+
+    def _do_sleep(self, level: int) -> None:
+        # Device frees only — BlockPool (and its KV-event callback, which
+        # touches asyncio state) is cleared on the event-loop thread in
+        # _sleep_tick, per the engine's threading contract.
+        self._k_cache = None
+        self._v_cache = None
+        if level >= 2:
+            self._host_params = jax.device_get(self.params)
+            self.params = None
+        self._sleep_level = level
+        logger.info("engine asleep at level %d", level)
+
+    def _do_wake(self) -> None:
+        if self._sleep_level >= 2 and self._host_params is not None:
+            params = self._host_params
+            self._host_params = None
+            if self.mesh is not None:
+                params = shard_params(
+                    params, llama.param_logical_axes(self.config),
+                    self.rules, self.mesh,
+                )
+            else:
+                params = jax.tree_util.tree_map(jnp.asarray, params)
+            self.params = params
+        if self._k_cache is None:
+            self._k_cache, self._v_cache = self._alloc_kv_cache()
+        self._sleep_level = 0
+        logger.info("engine awake")
 
     # -- AsyncEngine -------------------------------------------------------
 
@@ -545,6 +690,9 @@ class JaxEngine:
     async def _scheduler_loop(self) -> None:
         while not self._stopped.is_set():
             try:
+                if self._sleep_requested is not None or self._sleep_level > 0:
+                    if await self._sleep_tick():
+                        continue
                 admitted = False
                 # Admit in batched prefill dispatches; a per-tick batch cap
                 # bounds how long running decodes stall behind prefill
@@ -964,6 +1112,37 @@ class JaxEngine:
         seq.block_ids = []
         seq.block_hashes = []
         self._waiting.appendleft(seq)
+
+    async def _sleep_tick(self) -> bool:
+        """Handle a pending sleep request / asleep state. Returns True when
+        this tick is consumed (the main loop should ``continue``)."""
+        if self._sleep_level > 0:  # asleep: idle until wake() or stop()
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+            except asyncio.TimeoutError:
+                pass
+            return True
+        # Sleep requested but not yet asleep: drain active sequences first
+        # (no new admissions), then release device memory.
+        if any(s is not None for s in self._slots):
+            await self._decode_tick()
+            return True
+        level = self._sleep_requested
+        if level is None:  # wake() cancelled the request mid-drain
+            return True
+        self._sleep_requested = None
+        self.pool.clear()  # on the loop thread: emits 'cleared' to routers
+        # _sleep_inflight closes the window where a concurrent wake() sees
+        # "not sleeping, nothing requested" while _do_sleep is in flight —
+        # it must queue its _do_wake behind us on the device executor.
+        self._sleep_inflight = True
+        try:
+            await self._device(self._do_sleep, level)
+        finally:
+            self._sleep_inflight = False
+        self._sleep_event.set()
+        return True
 
     async def _decode_tick(self) -> None:
         args = self.args

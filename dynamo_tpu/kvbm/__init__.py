@@ -18,8 +18,9 @@ prefill. The reference's block_copy.cu becomes a donated-buffer jit scatter
 from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, TierStats
 from dynamo_tpu.kvbm.manager import OffloadFilter, TieredKvManager
 from dynamo_tpu.kvbm.remote import KvStoreHandler, RemoteTier
+from dynamo_tpu.kvbm.connector import KvConnectorLeader, KvConnectorWorker
 
 __all__ = [
     "DiskTier", "HostTier", "TierStats", "OffloadFilter", "TieredKvManager",
-    "KvStoreHandler", "RemoteTier",
+    "KvStoreHandler", "RemoteTier", "KvConnectorLeader", "KvConnectorWorker",
 ]

@@ -1,0 +1,216 @@
+"""KVBM connector API: the tiered KV store for EXTERNAL engines.
+
+Reference parity: lib/bindings/kvbm python vllm_integration —
+connector_leader.py (scheduler-side: get_num_new_matched_tokens :116,
+update_state_after_alloc :144, build_connector_meta :152,
+request_finished :228) and connector_worker.py (per-rank:
+register_kv_caches :61, bind_connector_metadata :128, start_load_kv :148,
+save_kv_layer :165, get_finished :187).
+
+The native JaxEngine integrates with TieredKvManager directly
+(kvbm/manager.py); this module is the arms-length API for engines the
+framework does NOT own: the engine's scheduler asks the leader what the
+KVBM can supply beyond its own cache, the leader emits transfer
+instructions as opaque metadata, and the engine's per-rank worker executes
+them against device memory through two engine-supplied callbacks. TPU
+note: the callbacks hand over numpy arrays — the engine decides how they
+map to device HBM (jax.device_put into its paged cache, a pallas gather,
+whatever fits its layout); the connector never touches device state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# put_block(engine_block_id, k, v) — write one block into the engine cache
+PutBlockFn = Callable[[int, np.ndarray, np.ndarray], None]
+# get_block(engine_block_id) -> (k, v) — read one block out of the engine
+GetBlockFn = Callable[[int], Tuple[np.ndarray, np.ndarray]]
+
+
+@dataclass
+class _RequestSlot:
+    """Leader-side per-request transfer state (ref: _create_slot :250)."""
+
+    token_hashes: List[int]
+    matched: int = 0  # blocks the KVBM can supply
+    engine_matched: int = 0  # blocks the engine already had
+    block_ids: List[int] = field(default_factory=list)  # engine block ids
+
+
+class KvConnectorLeader:
+    """Scheduler-side half: match decisions + transfer-instruction builder."""
+
+    def __init__(self, tier: Any, block_size: int) -> None:
+        self.tier = tier  # HostTier-compatible: contains/get/put
+        self.block_size = block_size
+        self._slots: Dict[str, _RequestSlot] = {}
+        self._pending_saves: Dict[str, List[Tuple[int, int]]] = {}
+
+    def get_num_new_matched_tokens(
+        self,
+        request_id: str,
+        token_hashes: List[int],
+        num_engine_matched_tokens: int = 0,
+    ) -> Tuple[int, bool]:
+        """How many MORE tokens the KVBM can supply beyond the engine's own
+        prefix-cache hit. Returns (num_new_tokens, load_is_async) — matching
+        the reference's contract (:116)."""
+        engine_blocks = num_engine_matched_tokens // self.block_size
+        matched = engine_blocks
+        while matched < len(token_hashes) and self.tier.contains(
+            token_hashes[matched]
+        ):
+            matched += 1
+        slot = _RequestSlot(
+            token_hashes=list(token_hashes),
+            matched=matched,
+            engine_matched=engine_blocks,
+        )
+        self._slots[request_id] = slot
+        new_tokens = (matched - engine_blocks) * self.block_size
+        return new_tokens, new_tokens > 0
+
+    def update_state_after_alloc(
+        self, request_id: str, block_ids: List[int]
+    ) -> None:
+        """The engine allocated device blocks for the request; remember the
+        hash→engine-block pairing for the transfer (:144)."""
+        slot = self._slots.get(request_id)
+        if slot is None:
+            raise KeyError(f"no connector slot for request {request_id!r}")
+        slot.block_ids = list(block_ids)
+
+    def build_connector_meta(self) -> bytes:
+        """Serialize this scheduling step's transfer instructions (:152).
+        Consumed exactly once by bind_connector_metadata on the worker."""
+        loads = []
+        for rid, slot in self._slots.items():
+            if not slot.block_ids or slot.matched <= slot.engine_matched:
+                continue
+            for i in range(slot.engine_matched, slot.matched):
+                if i < len(slot.block_ids):
+                    loads.append(
+                        (rid, slot.token_hashes[i], slot.block_ids[i])
+                    )
+            # Mark consumed: later scheduling steps for a long-running
+            # request must not re-emit (and re-transfer) the same loads.
+            slot.engine_matched = slot.matched
+        saves = []
+        for rid, pairs in self._pending_saves.items():
+            for h, bid in pairs:
+                saves.append((rid, h, bid))
+        self._pending_saves.clear()
+        return msgpack.packb(
+            {"loads": loads, "saves": saves}, use_bin_type=True
+        )
+
+    def request_finished(
+        self, request_id: str, block_hashes_and_ids: List[Tuple[int, int]]
+    ) -> bool:
+        """Request done: queue write-back of its committed blocks that the
+        KVBM doesn't hold yet (:228). Returns True when an async save was
+        scheduled (the engine must keep the blocks alive until the worker
+        reports the save finished)."""
+        self._slots.pop(request_id, None)
+        to_save = [
+            (h, bid)
+            for h, bid in block_hashes_and_ids
+            if not self.tier.contains(h)
+        ]
+        if to_save:
+            self._pending_saves[request_id] = to_save
+        return bool(to_save)
+
+
+class KvConnectorWorker:
+    """Per-rank half: executes the leader's transfer instructions against
+    engine memory via the registered callbacks."""
+
+    def __init__(self, tier: Any) -> None:
+        self.tier = tier
+        self._put: Optional[PutBlockFn] = None
+        self._get: Optional[GetBlockFn] = None
+        self._meta: Optional[Dict[str, Any]] = None
+        self._finished_loads: Set[str] = set()
+        self._finished_saves: Set[str] = set()
+        self._failed_loads: Dict[str, List[int]] = {}
+
+    def register_kv_caches(self, put_block: PutBlockFn, get_block: GetBlockFn) -> None:
+        """The engine's device-memory accessors (ref: register_kv_caches
+        :61 — there a dict of torch tensors; here two callbacks so the
+        engine owns its TPU cache layout)."""
+        self._put = put_block
+        self._get = get_block
+
+    def bind_connector_metadata(self, blob: bytes) -> None:
+        self._meta = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+
+    def clear_connector_metadata(self) -> None:
+        self._meta = None
+
+    def start_load_kv(self) -> int:
+        """Onboard every instructed block tier→engine (:148). Returns the
+        number of blocks loaded. A block evicted between match and load is
+        reported via get_failed_loads() — the engine MUST recompute those
+        token positions (the match promise is revoked); such a request is
+        never reported load-finished."""
+        if self._put is None:
+            raise RuntimeError("register_kv_caches must be called first")
+        meta = self._meta or {}
+        n = 0
+        touched: Set[str] = set()
+        for rid, block_hash, engine_block_id in meta.get("loads", ()):
+            touched.add(rid)
+            blk = self.tier.get(block_hash)
+            if blk is None:
+                logger.warning(
+                    "KV block %x vanished before load (request %s): "
+                    "engine must recompute", block_hash, rid,
+                )
+                self._failed_loads.setdefault(rid, []).append(block_hash)
+                continue
+            self._put(engine_block_id, blk[0], blk[1])
+            n += 1
+        for rid in touched:
+            if rid not in self._failed_loads:
+                self._finished_loads.add(rid)
+        return n
+
+    def get_failed_loads(self) -> Dict[str, List[int]]:
+        """request id → block hashes whose load failed since the last call.
+        The engine must re-prefill those positions instead of trusting the
+        leader's earlier match."""
+        failed = self._failed_loads
+        self._failed_loads = {}
+        return failed
+
+    def save_kv_blocks(self) -> int:
+        """Offload every instructed block engine→tier (:165). Returns the
+        number of blocks saved."""
+        if self._get is None:
+            raise RuntimeError("register_kv_caches must be called first")
+        meta = self._meta or {}
+        n = 0
+        for rid, block_hash, engine_block_id in meta.get("saves", ()):
+            k, v = self._get(engine_block_id)
+            self.tier.put(block_hash, np.asarray(k), np.asarray(v))
+            n += 1
+            self._finished_saves.add(rid)
+        return n
+
+    def get_finished(self) -> Tuple[Set[str], Set[str]]:
+        """(finished_loading, finished_saving) request ids since the last
+        call (:187) — the engine uses the save set to release blocks it
+        kept alive for write-back."""
+        loads, saves = self._finished_loads, self._finished_saves
+        self._finished_loads, self._finished_saves = set(), set()
+        return loads, saves

@@ -1,0 +1,152 @@
+"""Planner dry-run simulator: replay a load trace through the sizing math.
+
+Reference parity: components/src/dynamo/planner/utils/dryrun.py — before
+deploying an autoscaling policy, replay a (synthetic or recorded) load
+trace against the planner's predictors + interpolators and report what it
+WOULD have done: the replica timeline, scale events, peak chip usage, and
+predicted SLA violations. No connectors, no clock — pure arithmetic.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import (
+    MetricsSnapshot,
+    Planner,
+    PlannerConfig,
+    ReplicaPlan,
+)
+
+
+@dataclass
+class TracePoint:
+    t: float  # seconds since trace start
+    request_rate: float  # requests/sec
+    mean_isl: float
+    mean_osl: float
+
+
+def synth_trace(
+    kind: str = "ramp",
+    *,
+    duration_s: float = 600.0,
+    interval_s: float = 30.0,
+    base_rate: float = 1.0,
+    peak_rate: float = 10.0,
+    isl: float = 512.0,
+    osl: float = 128.0,
+) -> List[TracePoint]:
+    """Synthetic load shapes: ramp (linear up), step (sudden jump at the
+    midpoint), sine (one full period), spike (peak for one interval)."""
+    points = []
+    n = max(int(duration_s / interval_s), 1)
+    for i in range(n):
+        t = i * interval_s
+        frac = i / max(n - 1, 1)
+        if kind == "ramp":
+            rate = base_rate + (peak_rate - base_rate) * frac
+        elif kind == "step":
+            rate = base_rate if frac < 0.5 else peak_rate
+        elif kind == "sine":
+            rate = base_rate + (peak_rate - base_rate) * 0.5 * (
+                1 - math.cos(2 * math.pi * frac)
+            )
+        elif kind == "spike":
+            rate = peak_rate if i == n // 2 else base_rate
+        else:
+            raise ValueError(f"unknown trace kind {kind!r}")
+        points.append(TracePoint(t=t, request_rate=rate, mean_isl=isl, mean_osl=osl))
+    return points
+
+
+@dataclass
+class ScaleEvent:
+    t: float
+    prefill: int
+    decode: int
+    reason: str
+
+
+@dataclass
+class DryRunReport:
+    timeline: List[ScaleEvent] = field(default_factory=list)
+    scale_events: int = 0  # plan changes (what a connector would execute)
+    peak_chips: int = 0
+    peak_prefill: int = 0
+    peak_decode: int = 0
+    ttft_violations: int = 0  # intervals where the model can't meet TTFT
+    final_plan: Optional[ReplicaPlan] = None
+
+    def summary(self) -> str:
+        return (
+            f"{self.scale_events} scale events, peak {self.peak_prefill}P/"
+            f"{self.peak_decode}D ({self.peak_chips} chips), "
+            f"{self.ttft_violations} TTFT-infeasible intervals"
+        )
+
+
+class DryRunner:
+    """Feed a trace through the real Planner sizing math, synchronously."""
+
+    def __init__(
+        self,
+        config: PlannerConfig,
+        prefill_interp: PrefillInterpolator,
+        decode_interp: DecodeInterpolator,
+        *,
+        disagg: bool = True,
+    ) -> None:
+        self._planner = Planner(
+            config,
+            prefill_interp,
+            decode_interp,
+            connector=None,
+            metrics_source=None,
+            disagg=disagg,
+        )
+        self.config = config
+
+    def run(self, trace: Sequence[TracePoint]) -> DryRunReport:
+        planner = self._planner
+        cfg = self.config
+        report = DryRunReport()
+        last: Optional[ReplicaPlan] = None
+        for pt in trace:
+            snap = MetricsSnapshot(
+                request_rate=pt.request_rate,
+                mean_isl=pt.mean_isl,
+                mean_osl=pt.mean_osl,
+            )
+            planner.rate_pred.add_data_point(snap.request_rate)
+            planner.isl_pred.add_data_point(snap.mean_isl)
+            planner.osl_pred.add_data_point(snap.mean_osl)
+            plan = planner.compute_plan()
+            if plan is None:
+                continue
+            if planner.prefill_interp.interpolate_ttft(pt.mean_isl) > cfg.ttft_target_s:
+                report.ttft_violations += 1
+            chips = (
+                plan.prefill * cfg.chips_per_prefill_worker
+                + plan.decode * cfg.chips_per_decode_worker
+            )
+            report.peak_chips = max(report.peak_chips, chips)
+            report.peak_prefill = max(report.peak_prefill, plan.prefill)
+            report.peak_decode = max(report.peak_decode, plan.decode)
+            if last is None or (plan.prefill, plan.decode) != (last.prefill, last.decode):
+                report.scale_events += 1
+                report.timeline.append(
+                    ScaleEvent(
+                        t=pt.t, prefill=plan.prefill, decode=plan.decode,
+                        reason=plan.reason,
+                    )
+                )
+            last = plan
+        report.final_plan = last
+        return report

@@ -1,0 +1,167 @@
+"""SLA-driven deployment recommendation (the DGDR profiling role).
+
+Reference parity: the reference's SLA-profiling flow — a
+DynamoGraphDeploymentRequest triggers profiling sweeps across parallelism
+configs, then recommends the deployment that meets TTFT/ITL targets with
+the best goodput per accelerator (profiler + planner pre_swept_results).
+
+Here: given per-config profile sweeps (from profiler.profile_engine on
+real hardware, or loaded tables), ``recommend`` picks the config that
+meets the SLA at the target workload with the fewest chips, and sizes the
+worker pools for the expected request rate using the planner's own math.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+
+
+@dataclass
+class SlaTargets:
+    ttft_s: float = 0.5
+    itl_s: float = 0.02
+
+
+@dataclass
+class Workload:
+    request_rate: float  # requests/sec to provision for
+    isl: float = 512.0
+    osl: float = 128.0
+
+
+@dataclass
+class ConfigProfile:
+    """One parallelism config's measured profile."""
+
+    name: str  # e.g. "tp1", "tp4"
+    chips_per_worker: int
+    prefill_points: List[Dict[str, float]]  # profiler prefill sweep rows
+    decode_points: List[Dict[str, float]]  # profiler decode sweep rows
+
+
+@dataclass
+class Recommendation:
+    config_name: str
+    chips_per_worker: int
+    prefill_workers: int
+    decode_workers: int
+    total_chips: int
+    ttft_s: float  # predicted at the workload ISL
+    itl_s: float  # predicted at the chosen concurrency
+    goodput_per_chip: float  # output tokens/sec/chip at the SLA point
+    reason: str = ""
+
+
+@dataclass
+class SlaReport:
+    chosen: Optional[Recommendation]
+    rejected: Dict[str, str] = field(default_factory=dict)  # config → why
+
+    def summary(self) -> str:
+        if self.chosen is None:
+            return f"no config meets the SLA ({len(self.rejected)} rejected)"
+        c = self.chosen
+        return (
+            f"{c.config_name}: {c.prefill_workers}P+{c.decode_workers}D × "
+            f"{c.chips_per_worker} chip(s) = {c.total_chips} chips, "
+            f"TTFT {c.ttft_s * 1e3:.0f}ms, ITL {c.itl_s * 1e3:.1f}ms, "
+            f"{c.goodput_per_chip:.0f} tok/s/chip"
+        )
+
+
+def _size_config(
+    profile: ConfigProfile, targets: SlaTargets, workload: Workload
+) -> Recommendation:
+    """Planner sizing math for one config (raises ValueError if SLA-infeasible)."""
+    pre = PrefillInterpolator.from_points(profile.prefill_points)
+    dec = DecodeInterpolator.from_points(profile.decode_points)
+
+    ttft = pre.interpolate_ttft(workload.isl)
+    if ttft > targets.ttft_s:
+        raise ValueError(
+            f"TTFT {ttft * 1e3:.0f}ms > target {targets.ttft_s * 1e3:.0f}ms "
+            f"at ISL {workload.isl:.0f}"
+        )
+    max_conc = dec.max_concurrency_for_itl(targets.itl_s)
+    if max_conc < 1.0:
+        itl1 = dec.interpolate_itl(1.0)
+        raise ValueError(
+            f"ITL {itl1 * 1e3:.1f}ms > target {targets.itl_s * 1e3:.1f}ms "
+            "even at concurrency 1"
+        )
+
+    # Prefill pool sized by token throughput; decode pool by concurrency.
+    prefill_tput = max(pre.interpolate_throughput(workload.isl), 1e-6)
+    prefill_n = max(math.ceil(workload.request_rate * workload.isl / prefill_tput), 1)
+
+    decode_tput = dec.interpolate_throughput(max_conc)
+    per_seq = decode_tput / max_conc
+    gen_time_s = workload.osl / max(per_seq, 1e-6)
+    concurrency = workload.request_rate * gen_time_s
+    decode_n = max(math.ceil(concurrency / max_conc), 1)
+
+    total_chips = (prefill_n + decode_n) * profile.chips_per_worker
+    return Recommendation(
+        config_name=profile.name,
+        chips_per_worker=profile.chips_per_worker,
+        prefill_workers=prefill_n,
+        decode_workers=decode_n,
+        total_chips=total_chips,
+        ttft_s=ttft,
+        itl_s=dec.interpolate_itl(max_conc),
+        goodput_per_chip=decode_tput / profile.chips_per_worker,
+        reason=(
+            f"conc {concurrency:.1f} / {max_conc:.1f} per worker, "
+            f"prefill {workload.request_rate * workload.isl:.0f} tok/s"
+        ),
+    )
+
+
+def recommend(
+    profiles: List[ConfigProfile], targets: SlaTargets, workload: Workload
+) -> SlaReport:
+    """Pick the SLA-feasible config with the fewest total chips (goodput per
+    chip breaks ties)."""
+    report = SlaReport(chosen=None)
+    candidates: List[Recommendation] = []
+    for profile in profiles:
+        try:
+            candidates.append(_size_config(profile, targets, workload))
+        except ValueError as exc:
+            report.rejected[profile.name] = str(exc)
+    if candidates:
+        report.chosen = min(
+            candidates, key=lambda r: (r.total_chips, -r.goodput_per_chip)
+        )
+    return report
+
+
+async def profile_and_recommend(
+    engines: Dict[str, tuple],  # name → (engine, chips_per_worker)
+    targets: SlaTargets,
+    workload: Workload,
+    **sweep_kwargs,
+) -> SlaReport:
+    """Sweep each live engine config then recommend (the end-to-end DGDR
+    flow; sweeps run sequentially to keep the device to one config)."""
+    from dynamo_tpu.profiler import profile_engine
+
+    profiles = []
+    for name, (engine, chips) in engines.items():
+        prof = await profile_engine(engine, **sweep_kwargs)
+        profiles.append(
+            ConfigProfile(
+                name=name,
+                chips_per_worker=chips,
+                prefill_points=prof["prefill"],
+                decode_points=prof["decode"],
+            )
+        )
+    return recommend(profiles, targets, workload)

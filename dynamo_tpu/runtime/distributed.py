@@ -157,6 +157,10 @@ class DistributedRuntime:
             from dynamo_tpu.runtime.network.tcp import TcpRequestPlane
 
             request_plane = TcpRequestPlane(host=config.TCP_HOST.get())
+        elif config.REQUEST_PLANE.get() == "http":
+            from dynamo_tpu.runtime.network.http_plane import HttpRequestPlane
+
+            request_plane = HttpRequestPlane(host=config.TCP_HOST.get())
         else:
             request_plane = LocalRequestPlane("default")
 
@@ -269,6 +273,12 @@ class DistributedRuntime:
                     "tcp request plane not available in this build"
                 ) from exc
             plane = TcpRequestPlane()
+            self._extra_planes.append(plane)
+            return plane.client_for(instance)
+        if kind == "http":
+            from dynamo_tpu.runtime.network.http_plane import HttpRequestPlane
+
+            plane = HttpRequestPlane()
             self._extra_planes.append(plane)
             return plane.client_for(instance)
         raise ValueError(f"unknown transport kind {kind!r} for {instance.key}")

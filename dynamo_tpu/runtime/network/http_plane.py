@@ -1,0 +1,267 @@
+"""HTTP request plane: alternative transport for router→worker streaming.
+
+Reference parity: lib/runtime/src/pipeline/network/egress/http_router.rs —
+the reference offers an HTTP/2 egress next to the default raw-TCP plane for
+environments where plain sockets don't traverse (service meshes, L7-only
+networks). Here: aiohttp chunked streaming; one POST per request stream.
+
+Wire format: POST /stream, headers carry the instance key and context id,
+the body is the msgpack request; the response is a chunked stream of
+length-prefixed msgpack frames `(kind, payload)` with kind ∈
+{"item", "end", "err"}. Cancellation is connection close (the HTTP-native
+signal — ref disconnect.rs), which the server maps to context cancellation
+exactly like the TCP plane's cancel frame.
+
+Select with DYN_TPU_REQUEST_PLANE=http.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+import msgpack
+import aiohttp
+from aiohttp import ClientSession, ClientTimeout, web
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.network.codec import _default as _msgpack_default
+from dynamo_tpu.runtime.network.tcp import StreamDisconnectedError
+from dynamo_tpu.runtime.tasks import TaskTracker
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_LEN = struct.Struct("!I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def _pack_frame(kind: str, payload: Any) -> bytes:
+    body = msgpack.packb(
+        (kind, payload), default=_msgpack_default, use_bin_type=True
+    )
+    return _LEN.pack(len(body)) + body
+
+
+class HttpRequestPlane:
+    kind = "http"
+
+    def __init__(self, host: Optional[str] = None, port: int = 0) -> None:
+        self.host = host or os.environ.get("DYN_TCP_HOST", "127.0.0.1")
+        self.port = port
+        self._engines: Dict[str, Tuple[AsyncEngine, TaskTracker]] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._bound_port: Optional[int] = None
+        self._session: Optional[ClientSession] = None
+
+    # -- server side -------------------------------------------------------
+
+    async def serve(
+        self, instance: Any, engine: AsyncEngine, tracker: TaskTracker
+    ) -> Dict[str, Any]:
+        if self._runner is None:
+            app = web.Application(client_max_size=MAX_FRAME)
+            app.router.add_post("/stream", self._handle)
+            # handler_cancellation: client disconnect cancels the handler —
+            # the HTTP cancel signal must reach the engine promptly, not on
+            # the next failed write (ref: disconnect.rs). shutdown_timeout
+            # is short because graceful draining is the TaskTracker's job
+            # (endpoint shutdown grace), not the transport's.
+            self._runner = web.AppRunner(
+                app, access_log=None, handler_cancellation=True,
+                shutdown_timeout=0.25,
+            )
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            server = site._server  # noqa: SLF001
+            self._bound_port = server.sockets[0].getsockname()[1]
+            logger.info(
+                "http request plane listening on %s:%s", self.host, self._bound_port
+            )
+        self._engines[instance.key] = (engine, tracker)
+        return {
+            "kind": "http",
+            "host": self.host,
+            "port": self._bound_port,
+            "key": instance.key,
+        }
+
+    async def unserve(self, instance: Any) -> None:
+        self._engines.pop(instance.key, None)
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        key = request.headers.get("X-Dynamo-Key", "")
+        entry = self._engines.get(key)
+        body = await request.read()
+        payload = (
+            msgpack.unpackb(body, raw=False, strict_map_key=False) if body else None
+        )
+        response = web.StreamResponse(
+            headers={"Content-Type": "application/x-dynamo-stream"}
+        )
+        response.enable_chunked_encoding()
+        await response.prepare(request)
+        if entry is None:
+            await response.write(
+                _pack_frame("err", f"no such endpoint instance: {key}")
+            )
+            return response
+        engine, tracker = entry
+        ctx = Context(
+            id=request.headers.get("X-Request-Id") or None,
+            baggage=_baggage_from(request.headers),
+        )
+        try:
+            if tracker.draining:
+                await response.write(_pack_frame("err", "draining"))
+                return response
+            from dynamo_tpu.utils.tracing import span
+
+            with tracker.guard(), span("endpoint.serve", ctx, endpoint=key) as sp:
+                n_items = 0
+                async for item in engine.generate(payload, ctx):
+                    await response.write(_pack_frame("item", item))
+                    n_items += 1
+                sp.attributes["items"] = n_items
+            await response.write(_pack_frame("end", None))
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler on client disconnect — the
+            # HTTP-native cancellation signal.
+            ctx.stop_generating(reason="client-disconnected")
+            raise
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.stop_generating(reason="connection-lost")
+        except Exception as exc:
+            logger.exception("http stream handler failed")
+            try:
+                await response.write(_pack_frame("err", repr(exc)))
+            except (ConnectionError, RuntimeError):
+                pass
+        return response
+
+    # -- client side -------------------------------------------------------
+
+    def client_for(self, instance: Any) -> AsyncEngine:
+        host = instance.transport["host"]
+        port = instance.transport["port"]
+        key = instance.transport.get("key", instance.key)
+        return _HttpClientEngine(self, f"http://{host}:{port}/stream", key)
+
+    def _client_session(self) -> ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = ClientSession(
+                timeout=ClientTimeout(total=None, sock_connect=10)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def _baggage_from(headers) -> Dict[str, str]:
+    out = {}
+    raw = headers.get("X-Dynamo-Baggage")
+    if raw:
+        for part in raw.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k.strip()] = v.strip()
+    return out
+
+
+class _HttpClientEngine:
+    """AsyncEngine view of a remote instance over the HTTP plane."""
+
+    def __init__(self, plane: HttpRequestPlane, url: str, key: str) -> None:
+        self._plane = plane
+        self._url = url
+        self._key = key
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        session = self._plane._client_session()
+        headers = {"X-Dynamo-Key": self._key}
+        if context.id:
+            headers["X-Request-Id"] = context.id
+        if context.baggage:
+            headers["X-Dynamo-Baggage"] = ",".join(
+                f"{k}={v}" for k, v in context.baggage.items()
+            )
+        body = msgpack.packb(request, default=_msgpack_default, use_bin_type=True)
+        try:
+            resp = await session.post(self._url, data=body, headers=headers)
+        except (OSError, aiohttp.ClientError) as exc:
+            raise StreamDisconnectedError(f"connect {self._url}: {exc}") from exc
+        if resp.status != 200:
+            # Our stream handler always answers 200 (errors ride in frames);
+            # a non-200 is an aiohttp-level failure. 5xx = the worker is in
+            # trouble → disconnect semantics (migration trigger); 4xx = this
+            # request can never succeed anywhere.
+            text = (await resp.text())[:200]
+            resp.close()
+            if resp.status >= 500:
+                raise StreamDisconnectedError(
+                    f"worker http error {resp.status}: {text}"
+                )
+            raise RuntimeError(f"http plane rejected request {resp.status}: {text}")
+
+        async def watch_cancel() -> None:
+            await context.wait_stopped()
+            resp.close()  # connection close IS the cancel signal
+
+        cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
+        buf = b""
+        clean_end = False
+        try:
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while len(buf) >= _LEN.size:
+                    (n,) = _LEN.unpack(buf[: _LEN.size])
+                    if n > MAX_FRAME:
+                        raise ValueError(f"frame too large: {n}")
+                    if len(buf) < _LEN.size + n:
+                        break
+                    frame = buf[_LEN.size : _LEN.size + n]
+                    buf = buf[_LEN.size + n :]
+                    kind, payload = msgpack.unpackb(
+                        frame, raw=False, strict_map_key=False
+                    )
+                    if kind == "item":
+                        yield payload
+                    elif kind == "end":
+                        clean_end = True
+                        return
+                    elif kind == "err":
+                        raise RuntimeError(payload)
+            # Stream ended without an "end" frame: the worker vanished.
+            if not context.stopped:
+                raise StreamDisconnectedError(
+                    f"worker connection lost: {self._url}"
+                )
+        except (
+            ConnectionError, asyncio.IncompleteReadError, aiohttp.ClientError
+        ) as exc:
+            if isinstance(exc, StreamDisconnectedError):
+                raise
+            if context.stopped:
+                return  # we closed the connection ourselves (cancel)
+            raise StreamDisconnectedError(
+                f"worker connection lost: {self._url}: {exc}"
+            ) from exc
+        finally:
+            cancel_task.cancel()
+            if clean_end:
+                # Release the connection back to the session pool for
+                # keep-alive reuse (the stream is fully consumed up to the
+                # chunked terminator); close() would force a fresh TCP
+                # connect per request.
+                resp.release()
+            else:
+                resp.close()

@@ -1,0 +1,244 @@
+"""Per-process system HTTP server: health, metrics, engine admin, LoRAs.
+
+Reference parity: lib/runtime/src/system_status_server.rs — every worker
+process exposes a small HTTP surface for orchestration:
+  GET  /health             aggregated health (registered sources)
+  GET  /live               liveness (the process event loop turns)
+  GET  /metrics            Prometheus text (registered collectors)
+  ANY  /engine/{path}      registered engine callbacks (sleep/wake/stats/…)
+  GET  /v1/loras           list loaded adapters
+  POST /v1/loras           {"name": ..., "path": ...} load an adapter
+  DELETE /v1/loras/{name}  unload an adapter
+
+This is the TPU build's analog of the reference's axum system server; the
+engine registers its callbacks via ``attach_engine`` (the reference's
+engine-routes registry, system_status_server.rs /engine/{*path} handler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# handler(body: dict) -> (status, payload)
+EngineRoute = Callable[[Dict[str, Any]], Awaitable[Tuple[int, Any]]]
+
+
+class SystemStatusServer:
+    def __init__(self, *, host: str = "0.0.0.0", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self._engine_routes: Dict[str, EngineRoute] = {}
+        self._health_sources: Dict[str, Callable[[], Tuple[bool, Any]]] = {}
+        self._metrics_sources: List[Callable[[], str]] = []
+        self._lora_list: Optional[Callable[[], List[str]]] = None
+        self._lora_load: Optional[Callable[[str, str], Awaitable[None]]] = None
+        self._lora_unload: Optional[Callable[[str], Awaitable[None]]] = None
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- registration ------------------------------------------------------
+
+    def register_engine_route(self, path: str, handler: EngineRoute) -> None:
+        self._engine_routes[path.strip("/")] = handler
+
+    def register_health(
+        self, name: str, fn: Callable[[], Tuple[bool, Any]]
+    ) -> None:
+        self._health_sources[name] = fn
+
+    def register_metrics(self, fn: Callable[[], str]) -> None:
+        """fn returns Prometheus exposition-format text."""
+        self._metrics_sources.append(fn)
+
+    def register_loras(self, list_fn, load_fn, unload_fn) -> None:
+        self._lora_list = list_fn
+        self._lora_load = load_fn
+        self._lora_unload = unload_fn
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_route("*", "/engine/{path:.*}", self._engine)
+        app.router.add_get("/v1/loras", self._loras_list)
+        app.router.add_post("/v1/loras", self._loras_load)
+        app.router.add_delete("/v1/loras/{name}", self._loras_unload)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # Resolve the ephemeral port for port=0.
+        server = site._server  # noqa: SLF001 - aiohttp exposes no accessor
+        if server and server.sockets:
+            self.port = server.sockets[0].getsockname()[1]
+        logger.info("system status server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        details: Dict[str, Any] = {}
+        healthy = True
+        for name, fn in self._health_sources.items():
+            try:
+                ok, detail = fn()
+            except Exception as exc:  # a broken source is an unhealthy one
+                ok, detail = False, f"health source error: {exc}"
+            details[name] = detail
+            healthy = healthy and ok
+        status = 200 if healthy else 503
+        return web.json_response(
+            {"status": "healthy" if healthy else "unhealthy", "details": details},
+            status=status,
+        )
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        parts = []
+        for fn in self._metrics_sources:
+            try:
+                parts.append(fn())
+            except Exception:
+                logger.exception("metrics source failed")
+        return web.Response(
+            text="\n".join(parts) + "\n",
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def _engine(self, request: web.Request) -> web.Response:
+        path = request.match_info["path"].strip("/")
+        handler = self._engine_routes.get(path)
+        if handler is None:
+            return web.json_response(
+                {"error": f"no engine route {path!r}",
+                 "routes": sorted(self._engine_routes)},
+                status=404,
+            )
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        try:
+            status, payload = await handler(body if isinstance(body, dict) else {})
+        except Exception as exc:
+            logger.exception("engine route %s failed", path)
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(payload, status=status)
+
+    async def _loras_list(self, request: web.Request) -> web.Response:
+        if self._lora_list is None:
+            return web.json_response({"error": "LoRA not enabled"}, status=404)
+        return web.json_response({"loras": self._lora_list()})
+
+    async def _loras_load(self, request: web.Request) -> web.Response:
+        if self._lora_load is None:
+            return web.json_response({"error": "LoRA not enabled"}, status=404)
+        try:
+            body = await request.json()
+            name, path = body["name"], body["path"]
+        except Exception:
+            return web.json_response(
+                {"error": "body must be {'name': ..., 'path': ...}"}, status=400
+            )
+        try:
+            await self._lora_load(name, path)
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:
+            logger.exception("LoRA load failed")
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response({"loaded": name}, status=201)
+
+    async def _loras_unload(self, request: web.Request) -> web.Response:
+        if self._lora_unload is None:
+            return web.json_response({"error": "LoRA not enabled"}, status=404)
+        name = request.match_info["name"]
+        try:
+            await self._lora_unload(name)
+        except KeyError as exc:
+            return web.json_response({"error": str(exc)}, status=404)
+        return web.json_response({"unloaded": name})
+
+
+def engine_stats_prometheus(stats: Dict[str, Any]) -> str:
+    """Engine stats dict → Prometheus gauges with canonical names
+    (ref: metrics/prometheus_names.rs — a single place defines the names)."""
+    lines = []
+    for key, value in stats.items():
+        if isinstance(value, dict):
+            continue  # nested (kvbm) stats get their own exporter if needed
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        name = f"dynamo_tpu_engine_{key}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value)}")
+    return "\n".join(lines)
+
+
+def attach_engine(server: SystemStatusServer, engine: Any) -> None:
+    """Register the native engine's admin surface on the system server
+    (ref: the engine-routes registry in system_status_server.rs plus vllm
+    handlers sleep/wake and LoRA load/unload)."""
+
+    async def _stats(body: Dict[str, Any]):
+        return 200, engine.stats()
+
+    async def _sleep(body: Dict[str, Any]):
+        await engine.sleep(int(body.get("level", 1)))
+        return 200, {"sleeping": True, "level": engine.sleep_level}
+
+    async def _wake(body: Dict[str, Any]):
+        await engine.wake()
+        return 200, {"sleeping": False}
+
+    async def _clear(body: Dict[str, Any]):
+        return 200, {"cleared_blocks": engine.clear_kv_blocks()}
+
+    server.register_engine_route("stats", _stats)
+    server.register_engine_route("sleep", _sleep)
+    server.register_engine_route("wake", _wake)
+    server.register_engine_route("clear_kv_blocks", _clear)
+
+    def _engine_health():
+        failure = getattr(engine, "_failure", None)
+        if failure is not None:
+            return False, f"engine failed: {failure}"
+        if engine.sleep_level > 0:
+            return True, f"asleep (level {engine.sleep_level})"
+        return True, "serving"
+
+    server.register_health("engine", _engine_health)
+    server.register_metrics(lambda: engine_stats_prometheus(engine.stats()))
+
+    async def _load(name: str, path: str) -> None:
+        # Disk I/O + stacking + host→device transfer off the event loop —
+        # a multi-second inline load would stall token streaming and the
+        # discovery lease keep-alive.
+        device = getattr(engine, "_device", None)
+        if device is not None:
+            await device(engine.load_lora, name, path)
+        else:
+            await asyncio.get_running_loop().run_in_executor(
+                None, engine.load_lora, name, path
+            )
+
+    async def _unload(name: str) -> None:
+        engine.unload_lora(name)
+
+    server.register_loras(engine.lora_names, _load, _unload)

@@ -83,6 +83,10 @@ async def main() -> None:
     parser.add_argument("--weight-cache-dir", default=None,
                         help="fast-restart weight cache (GMS-role, "
                         "models/weight_cache.py); default ~/.cache/dynamo_tpu")
+    parser.add_argument("--system-port", type=int, default=None,
+                        help="per-worker system HTTP server port "
+                        "(health/metrics/engine admin/LoRAs; 0 = ephemeral; "
+                        "ref: system_status_server.rs)")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -230,6 +234,17 @@ async def main() -> None:
         await register_llm(runtime, card, endpoint, instance_id)
     load_pub.start()
     await engine.start()
+    system_server = None
+    if args.system_port is not None:
+        from dynamo_tpu.runtime.system_server import (
+            SystemStatusServer,
+            attach_engine,
+        )
+
+        system_server = SystemStatusServer(port=args.system_port)
+        attach_engine(system_server, engine)
+        await system_server.start()
+        print(f"system server on :{system_server.port}", flush=True)
     print(
         f"worker serving {name} as {args.namespace}/{args.component}/"
         f"{args.endpoint} instance {instance_id:#x}",
@@ -238,6 +253,8 @@ async def main() -> None:
     try:
         await asyncio.Event().wait()
     finally:
+        if system_server is not None:
+            await system_server.stop()
         if kvbm is not None:
             await kvbm.close()
         await load_pub.close()

@@ -1,0 +1,164 @@
+"""HTTP request plane (runtime/network/http_plane.py): streaming, errors,
+cancellation-by-disconnect, worker-death disconnect surfacing — the same
+contract the TCP plane satisfies (ref: egress/http_router.rs)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import MemoryDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import collect
+from dynamo_tpu.runtime.network.http_plane import HttpRequestPlane
+from dynamo_tpu.runtime.network.tcp import StreamDisconnectedError
+
+
+async def _http_pair():
+    disco = MemoryDiscovery()
+    worker_rt = DistributedRuntime(
+        discovery=disco, request_plane=HttpRequestPlane(), bus="http-test"
+    )
+    frontend_rt = DistributedRuntime(
+        discovery=disco, request_plane=HttpRequestPlane(), bus="http-test"
+    )
+    return worker_rt, frontend_rt
+
+
+async def test_http_streaming_end_to_end():
+    worker_rt, frontend_rt = await _http_pair()
+
+    from dynamo_tpu.llm.protocols.common import BackendOutput, FinishReason
+
+    async def handler(request, context):
+        for i in range(int(request["n"])):
+            yield {"i": i}
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        out = await collect(client.generate({"n": 5}))
+        assert [o["i"] for o in out] == list(range(5))
+        # int-keyed maps survive the wire (logit_bias shape)
+        out = await collect(client.generate({"n": 1, "bias": {7: -1.5}}))
+        assert out == [{"i": 0}]
+        # dataclasses with to_dict serialize transparently (the request
+        # path carries PreprocessedRequest objects)
+        out = await collect(
+            client.generate({"n": 0, "obj": BackendOutput(token_ids=[7])})
+        )
+        assert out == []
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_http_handler_error_propagates():
+    worker_rt, frontend_rt = await _http_pair()
+
+    async def handler(request, context):
+        yield {"i": 0}
+        raise RuntimeError("engine exploded")
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        with pytest.raises(RuntimeError, match="engine exploded"):
+            await collect(client.generate({}))
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_http_cancellation_reaches_worker():
+    worker_rt, frontend_rt = await _http_pair()
+    worker_saw_cancel = asyncio.Event()
+
+    async def handler(request, context):
+        i = 0
+        try:
+            while True:
+                if context.stopped:
+                    worker_saw_cancel.set()
+                    return
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.01)
+        except asyncio.CancelledError:
+            # disconnect-cancel may hard-cancel the generator instead
+            worker_saw_cancel.set()
+            raise
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        ctx = Context()
+        got = []
+        # Closing the connection IS the HTTP cancel signal: after
+        # stop_generating the stream ends cleanly on the client side and
+        # the worker's handler observes the cancellation.
+        async for item in client.generate({}, ctx):
+            got.append(item)
+            if len(got) == 3:
+                ctx.stop_generating()
+        assert len(got) >= 3
+        await asyncio.wait_for(worker_saw_cancel.wait(), 5)
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_http_worker_death_surfaces_disconnect():
+    worker_rt, frontend_rt = await _http_pair()
+
+    async def handler(request, context):
+        yield {"i": 0}
+        await asyncio.sleep(30)
+        yield {"i": 1}
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        with pytest.raises(StreamDisconnectedError):
+            async for item in client.generate({}):
+                await worker_rt.request_plane.close()
+    finally:
+        await client.close()
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)
+
+
+async def test_http_unknown_key_errors():
+    worker_rt, frontend_rt = await _http_pair()
+
+    async def handler(request, context):
+        yield {}
+
+    ep = worker_rt.namespace("n").component("c").endpoint("gen")
+    served = await ep.serve_endpoint(handler)
+    client = await frontend_rt.namespace("n").component("c").endpoint("gen").client()
+    try:
+        # Forge a client at the right address with a wrong key.
+        from dynamo_tpu.runtime.network.http_plane import _HttpClientEngine
+
+        plane = frontend_rt.request_plane
+        transport = served.instance.transport if hasattr(served, "instance") else None
+        url = f"http://127.0.0.1:{worker_rt.request_plane._bound_port}/stream"
+        bad = _HttpClientEngine(plane, url, "nope/nothing")
+        with pytest.raises(RuntimeError, match="no such endpoint"):
+            await collect(bad.generate({}, Context()))
+    finally:
+        await client.close()
+        await served.shutdown(grace_period=1)
+        await frontend_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)

@@ -1,0 +1,132 @@
+"""KVBM external-engine connector (kvbm/connector.py): a toy external
+engine with its own block cache uses the leader/worker API to onboard
+prefix blocks from the tiered store and write back fresh ones (ref:
+lib/bindings/kvbm vllm_integration connector_{leader,worker}.py)."""
+
+import numpy as np
+
+from dynamo_tpu.kvbm import HostTier, KvConnectorLeader, KvConnectorWorker
+
+BLOCK = 4  # tokens per block
+SHAPE = (2, BLOCK, 2, 8)  # [L, BS, KH, D]
+
+
+def mk(x):
+    return np.full(SHAPE, float(x), np.float32), np.full(SHAPE, -float(x), np.float32)
+
+
+class ToyEngine:
+    """External engine stand-in: a flat block cache keyed by block id."""
+
+    def __init__(self, n_blocks=32):
+        self.blocks = {}
+        self._next = 0
+
+    def alloc(self, n):
+        ids = list(range(self._next, self._next + n))
+        self._next += n
+        return ids
+
+    def put_block(self, bid, k, v):
+        self.blocks[bid] = (k.copy(), v.copy())
+
+    def get_block(self, bid):
+        return self.blocks[bid]
+
+
+def _wire(tier):
+    leader = KvConnectorLeader(tier, block_size=BLOCK)
+    worker = KvConnectorWorker(tier)
+    return leader, worker
+
+
+class TestConnectorFlow:
+    def test_cold_store_matches_nothing(self):
+        tier = HostTier(16)
+        leader, _ = _wire(tier)
+        n, is_async = leader.get_num_new_matched_tokens("r1", [11, 22, 33])
+        assert n == 0 and not is_async
+
+    def test_onboard_then_writeback_roundtrip(self):
+        tier = HostTier(16)
+        # Seed the store with two blocks (a previous request's write-back).
+        for h, x in [(101, 1), (102, 2)]:
+            tier.put(h, *mk(x))
+
+        engine = ToyEngine()
+        leader, worker = _wire(tier)
+        worker.register_kv_caches(engine.put_block, engine.get_block)
+
+        hashes = [101, 102, 103]  # 2 cached + 1 novel
+        n, is_async = leader.get_num_new_matched_tokens("req-a", hashes)
+        assert n == 2 * BLOCK and is_async
+
+        ids = engine.alloc(3)
+        leader.update_state_after_alloc("req-a", ids)
+        worker.bind_connector_metadata(leader.build_connector_meta())
+        assert worker.start_load_kv() == 2
+        np.testing.assert_array_equal(engine.blocks[ids[0]][0], mk(1)[0])
+        np.testing.assert_array_equal(engine.blocks[ids[1]][1], mk(2)[1])
+        loads, _ = worker.get_finished()
+        assert loads == {"req-a"}
+
+        # The engine computes block 103 and finishes the request → the
+        # leader schedules write-back of only the novel block.
+        engine.put_block(ids[2], *mk(3))
+        pending = leader.request_finished("req-a", list(zip(hashes, ids)))
+        assert pending
+        worker.bind_connector_metadata(leader.build_connector_meta())
+        assert worker.save_kv_blocks() == 1
+        assert tier.contains(103)
+        _, saves = worker.get_finished()
+        assert saves == {"req-a"}
+
+        # A second request over the same prefix now fully matches.
+        n, _ = leader.get_num_new_matched_tokens("req-b", hashes)
+        assert n == 3 * BLOCK
+
+    def test_engine_prefix_hit_reduces_connector_supply(self):
+        tier = HostTier(16)
+        for h, x in [(7, 1), (8, 2), (9, 3)]:
+            tier.put(h, *mk(x))
+        leader, _ = _wire(tier)
+        # The engine already holds the first 2 blocks (8 tokens).
+        n, _ = leader.get_num_new_matched_tokens(
+            "r", [7, 8, 9], num_engine_matched_tokens=2 * BLOCK
+        )
+        assert n == 1 * BLOCK
+
+    def test_vanished_block_degrades_gracefully(self):
+        tier = HostTier(2)
+        tier.put(1, *mk(1))
+        engine = ToyEngine()
+        leader, worker = _wire(tier)
+        worker.register_kv_caches(engine.put_block, engine.get_block)
+        n, _ = leader.get_num_new_matched_tokens("r", [1])
+        assert n == BLOCK
+        ids = engine.alloc(1)
+        leader.update_state_after_alloc("r", ids)
+        meta = leader.build_connector_meta()
+        # Evict the block between match and load.
+        tier.put(2, *mk(2))
+        tier.put(3, *mk(3))
+        assert not tier.contains(1)
+        worker.bind_connector_metadata(meta)
+        assert worker.start_load_kv() == 0  # skipped, engine recomputes
+
+    def test_request_finished_nothing_to_save(self):
+        tier = HostTier(16)
+        tier.put(5, *mk(5))
+        leader, _ = _wire(tier)
+        leader.get_num_new_matched_tokens("r", [5])
+        assert leader.request_finished("r", [(5, 0)]) is False
+
+    def test_unknown_request_alloc_raises(self):
+        tier = HostTier(4)
+        leader, _ = _wire(tier)
+        try:
+            leader.update_state_after_alloc("ghost", [1])
+        except KeyError:
+            pass
+        else:
+            raise AssertionError("expected KeyError")

@@ -1,0 +1,141 @@
+"""Planner dry-run simulator + SLA recommendation (ref: planner
+utils/dryrun.py and the DGDR SLA-profiling flow)."""
+
+import pytest
+
+from dynamo_tpu.planner.dryrun import DryRunner, synth_trace
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import PlannerConfig
+from dynamo_tpu.profiler.sla import (
+    ConfigProfile,
+    SlaTargets,
+    Workload,
+    recommend,
+)
+
+
+def _prefill_points(scale=1.0):
+    # ttft grows linearly with isl; throughput flat-ish.
+    return [
+        {"isl": 64.0, "ttft_s": 0.02 / scale, "tokens_per_s": 3200.0 * scale},
+        {"isl": 512.0, "ttft_s": 0.16 / scale, "tokens_per_s": 3200.0 * scale},
+        {"isl": 2048.0, "ttft_s": 0.64 / scale, "tokens_per_s": 3200.0 * scale},
+    ]
+
+
+def _decode_points(scale=1.0):
+    return [
+        {"concurrency": 1.0, "itl_s": 0.008 / scale, "tokens_per_s": 125.0 * scale},
+        {"concurrency": 8.0, "itl_s": 0.012 / scale, "tokens_per_s": 666.0 * scale},
+        {"concurrency": 32.0, "itl_s": 0.030 / scale, "tokens_per_s": 1066.0 * scale},
+    ]
+
+
+def _interps(scale=1.0):
+    return (
+        PrefillInterpolator.from_points(_prefill_points(scale)),
+        DecodeInterpolator.from_points(_decode_points(scale)),
+    )
+
+
+class TestSynthTrace:
+    @pytest.mark.parametrize("kind", ["ramp", "step", "sine", "spike"])
+    def test_shapes(self, kind):
+        tr = synth_trace(kind, duration_s=300, interval_s=30,
+                         base_rate=1, peak_rate=9)
+        assert len(tr) == 10
+        rates = [p.request_rate for p in tr]
+        assert min(rates) >= 1 and max(rates) <= 9 + 1e-9
+        if kind == "ramp":
+            assert rates == sorted(rates)
+        if kind == "spike":
+            assert sorted(rates)[-1] == 9 and sorted(rates)[-2] == 1
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError):
+            synth_trace("sawtooth")
+
+
+class TestDryRunner:
+    def test_ramp_scales_up(self):
+        pre, dec = _interps()
+        cfg = PlannerConfig(
+            ttft_target_s=1.0, itl_target_s=0.02,
+            max_replicas=16, total_chip_budget=32,
+        )
+        runner = DryRunner(cfg, pre, dec)
+        report = runner.run(
+            synth_trace("ramp", duration_s=600, interval_s=30,
+                        base_rate=0.5, peak_rate=20, isl=512, osl=128)
+        )
+        assert report.final_plan is not None
+        assert report.scale_events >= 2  # it actually reacted to the ramp
+        first, last = report.timeline[0], report.timeline[-1]
+        assert last.decode > first.decode
+        assert report.peak_chips <= cfg.total_chip_budget
+        assert "scale events" in report.summary()
+
+    def test_flat_load_is_stable(self):
+        pre, dec = _interps()
+        cfg = PlannerConfig(ttft_target_s=1.0, itl_target_s=0.02,
+                            max_replicas=16)
+        runner = DryRunner(cfg, pre, dec)
+        report = runner.run(
+            synth_trace("step", duration_s=600, interval_s=30,
+                        base_rate=2.0, peak_rate=2.0)
+        )
+        # Constant load → exactly one "scale" (the initial plan).
+        assert report.scale_events == 1
+
+    def test_ttft_violations_flagged(self):
+        pre, dec = _interps()
+        cfg = PlannerConfig(ttft_target_s=0.05, itl_target_s=0.02,
+                            max_replicas=16)
+        runner = DryRunner(cfg, pre, dec)
+        report = runner.run(
+            synth_trace("step", duration_s=120, interval_s=30,
+                        base_rate=1, peak_rate=1, isl=2048)
+        )
+        assert report.ttft_violations > 0
+
+
+class TestSlaRecommend:
+    def test_picks_cheapest_feasible(self):
+        profiles = [
+            ConfigProfile("tp1", 1, _prefill_points(1.0), _decode_points(1.0)),
+            ConfigProfile("tp4", 4, _prefill_points(4.0), _decode_points(4.0)),
+        ]
+        targets = SlaTargets(ttft_s=0.3, itl_s=0.02)
+        report = recommend(profiles, targets, Workload(request_rate=2.0, isl=512))
+        assert report.chosen is not None
+        # tp1 meets the relaxed SLA with fewer chips.
+        assert report.chosen.config_name == "tp1"
+        assert report.chosen.total_chips <= 8
+        assert "tok/s/chip" in report.summary()
+
+    def test_tight_ttft_forces_bigger_config(self):
+        profiles = [
+            ConfigProfile("tp1", 1, _prefill_points(1.0), _decode_points(1.0)),
+            ConfigProfile("tp4", 4, _prefill_points(4.0), _decode_points(4.0)),
+        ]
+        # tp1 TTFT at isl 512 is 160ms; demand 50ms → only tp4 (40ms) fits.
+        targets = SlaTargets(ttft_s=0.05, itl_s=0.02)
+        report = recommend(profiles, targets, Workload(request_rate=2.0, isl=512))
+        assert report.chosen is not None
+        assert report.chosen.config_name == "tp4"
+        assert "tp1" in report.rejected
+        assert "TTFT" in report.rejected["tp1"]
+
+    def test_infeasible_everywhere(self):
+        profiles = [
+            ConfigProfile("tp1", 1, _prefill_points(1.0), _decode_points(1.0)),
+        ]
+        report = recommend(
+            profiles, SlaTargets(ttft_s=0.001, itl_s=0.0001),
+            Workload(request_rate=1.0),
+        )
+        assert report.chosen is None
+        assert "no config meets" in report.summary()

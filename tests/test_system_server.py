@@ -1,0 +1,194 @@
+"""Per-worker system status server + engine sleep/wake + runtime LoRA
+load/unload (ref: lib/runtime/src/system_status_server.rs; vllm handlers.py
+sleep :286 / wake_up :317 / LoRA load :453)."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.system_server import (
+    SystemStatusServer,
+    attach_engine,
+    engine_stats_prometheus,
+)
+
+from tests.test_jax_engine import make_engine, req, run_one
+from tests.test_lora import write_adapter
+
+
+async def _get(port, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, await r.json()
+
+
+async def _post(port, path, body=None):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://127.0.0.1:{port}{path}", json=body or {}) as r:
+            return r.status, await r.json()
+
+
+async def _delete(port, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.delete(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, await r.json()
+
+
+async def test_engine_sleep_wake_cycle():
+    """Sleep frees the KV cache (after draining actives) and wake restores
+    serving with identical greedy output. The KV-event callback must fire
+    on the event-loop thread (the real publisher creates asyncio tasks)."""
+    import threading
+
+    engine, events = make_engine()
+    loop_thread = threading.get_ident()
+    event_threads = []
+    orig_append = events.append
+    engine.pool._on_event = lambda e: (
+        event_threads.append(threading.get_ident()), orig_append(e)
+    )
+    try:
+        out1 = await run_one(engine, req(range(10, 22), max_tokens=5))
+        toks1 = [t for o in out1 for t in o.token_ids]
+
+        await engine.sleep(level=1)
+        assert engine.sleep_level == 1
+        assert engine._k_cache is None
+        assert any(e.kind == "cleared" for e in events)
+        assert all(t == loop_thread for t in event_threads)
+
+        await engine.wake()
+        assert engine.sleep_level == 0
+        out2 = await run_one(engine, req(range(10, 22), max_tokens=5))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert toks1 == toks2
+    finally:
+        await engine.stop()
+
+
+async def test_engine_sleep_level2_offloads_weights():
+    engine, _ = make_engine()
+    try:
+        out1 = await run_one(engine, req(range(5, 15), max_tokens=4))
+        toks1 = [t for o in out1 for t in o.token_ids]
+        await engine.sleep(level=2)
+        assert engine.params is None
+        assert engine._host_params is not None
+        await engine.wake()
+        out2 = await run_one(engine, req(range(5, 15), max_tokens=4))
+        assert toks1 == [t for o in out2 for t in o.token_ids]
+    finally:
+        await engine.stop()
+
+
+async def test_engine_sleep_queues_requests_until_wake():
+    engine, _ = make_engine()
+    try:
+        await engine.sleep()
+        gen = asyncio.create_task(run_one(engine, req(range(20, 30), max_tokens=3)))
+        await asyncio.sleep(0.2)
+        assert not gen.done()  # queued while asleep
+        await engine.wake()
+        out = await asyncio.wait_for(gen, 60)
+        assert len([t for o in out for t in o.token_ids]) == 3
+    finally:
+        await engine.stop()
+
+
+async def test_system_server_routes():
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        status, body = await _get(server.port, "/health")
+        assert status == 200 and body["status"] == "healthy"
+
+        status, body = await _get(server.port, "/live")
+        assert status == 200
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                text = await r.text()
+        assert "dynamo_tpu_engine_kv_usage" in text
+
+        status, body = await _post(server.port, "/engine/stats")
+        assert status == 200 and "active_seqs" in body
+
+        status, body = await _post(server.port, "/engine/nope")
+        assert status == 404 and "routes" in body
+
+        # sleep → health shows asleep detail → wake
+        status, body = await _post(server.port, "/engine/sleep", {"level": 1})
+        assert status == 200 and body["sleeping"]
+        status, body = await _get(server.port, "/health")
+        assert status == 200 and "asleep" in body["details"]["engine"]
+        status, body = await _post(server.port, "/engine/wake")
+        assert status == 200 and not body["sleeping"]
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
+async def test_runtime_lora_load_unload(tmp_path):
+    root = str(tmp_path / "adapters")
+    write_adapter(root, "hot-a", seed=3)
+    write_adapter(root, "hot-b", seed=4)
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        status, body = await _get(server.port, "/v1/loras")
+        assert status == 200 and body["loras"] == []
+
+        status, body = await _post(
+            server.port, "/v1/loras", {"name": "hot-a", "path": f"{root}/hot-a"}
+        )
+        assert status == 201
+        status, body = await _post(
+            server.port, "/v1/loras", {"name": "hot-b", "path": f"{root}/hot-b"}
+        )
+        assert status == 201
+        status, body = await _get(server.port, "/v1/loras")
+        assert body["loras"] == ["hot-a", "hot-b"]
+        assert engine._lora_index == {"hot-a": 1, "hot-b": 2}
+
+        # duplicate load conflicts
+        status, _ = await _post(
+            server.port, "/v1/loras", {"name": "hot-a", "path": f"{root}/hot-a"}
+        )
+        assert status == 409
+
+        # adapter requests route through the freshly loaded stack
+        out = await run_one(
+            engine, req(range(10, 20), max_tokens=3, lora_name="hot-a")
+        )
+        assert len([t for o in out for t in o.token_ids]) == 3
+
+        # unload keeps the other adapter's index stable
+        status, _ = await _delete(server.port, "/v1/loras/hot-a")
+        assert status == 200
+        assert engine._lora_index == {"hot-b": 2}
+        status, _ = await _delete(server.port, "/v1/loras/hot-a")
+        assert status == 404
+
+        # reload fills the freed slot 1
+        status, _ = await _post(
+            server.port, "/v1/loras", {"name": "hot-a", "path": f"{root}/hot-a"}
+        )
+        assert status == 201
+        assert engine._lora_index == {"hot-a": 1, "hot-b": 2}
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
+def test_stats_prometheus_format():
+    text = engine_stats_prometheus(
+        {"kv_usage": 0.5, "active_seqs": 3, "kvbm": {"nested": 1}, "name": "x"}
+    )
+    assert "# TYPE dynamo_tpu_engine_kv_usage gauge" in text
+    assert "dynamo_tpu_engine_active_seqs 3.0" in text
+    assert "nested" not in text and "name" not in text
