@@ -202,7 +202,12 @@ class JaxEngine:
         if args.lora_dir:
             self._load_loras(args.lora_dir)
 
+        # RNG: one fixed base key + a host-side step counter folded in
+        # INSIDE the jitted programs. A host-side jax.random.split per
+        # dispatch measured ~28ms on the tunneled TPU platform — pure
+        # overhead on every engine step.
         self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
+        self._rng_step = 0
         self._step_fn = self._build_step_fn()
         # Two decode programs: the logprob-free one skips a full-vocab
         # log-softmax per fused step (the common case); the other serves
@@ -361,10 +366,13 @@ class JaxEngine:
         use_kernel = self._use_kernel
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, rng, temp, topk, topp, adapter_ids,
+                 block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
                  mm_embeds, mm_slot,
                  minp=None, rep=None, pres=None, freq=None,
                  bias_ids=None, bias_vals=None, pmask=None):
+            # Derive the per-dispatch key on device (host-side split costs
+            # ~28ms/dispatch on the tunneled platform).
+            rng = jax.random.fold_in(rng, rng_step)
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
@@ -394,7 +402,8 @@ class JaxEngine:
 
         if not want_procs:
             def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                     block_tables, rng, temp, topk, topp, adapter_ids):
+                     block_tables, rng, rng_step, temp, topk, topp, adapter_ids):
+                rng = jax.random.fold_in(rng, rng_step)
                 return llama.decode_multi(
                     params, cfg, tokens, start_pos, active, block_tables,
                     k_cache, v_cache, rng, temp, topk, topp,
@@ -408,8 +417,9 @@ class JaxEngine:
         from dynamo_tpu.ops import logits_process as lp
 
         def step_p(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                   block_tables, rng, temp, topk, topp, adapter_ids,
+                   block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
                    minp, rep, pres, freq, bias_ids, bias_vals, counts, pmask):
+            rng = jax.random.fold_in(rng, rng_step)
             pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
                                bias_ids=bias_ids, bias_vals=bias_vals)
             st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
@@ -424,7 +434,7 @@ class JaxEngine:
             return toks, logp, k_cache, v_cache, st.out_counts
 
         # donate caches + the token-count array (functionally threaded).
-        return jax.jit(step_p, donate_argnums=(2, 3, 19))
+        return jax.jit(step_p, donate_argnums=(2, 3, 20))
 
     def _ensure_proc_state(self):
         if self._proc_state is None:
@@ -441,7 +451,8 @@ class JaxEngine:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
         [B, K] logprobs)."""
-        self._rng, sub = jax.random.split(self._rng)
+        step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
+        self._rng_step += 1
         if want_procs:
             from dynamo_tpu.ops import logits_process as lp
 
@@ -453,7 +464,7 @@ class JaxEngine:
             toks, logp, self._k_cache, self._v_cache, counts = fn(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
-                jnp.asarray(block_tables), sub,
+                jnp.asarray(block_tables), self._rng, step_id,
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 jnp.asarray(adapter_ids),
                 jnp.asarray(self._minp), jnp.asarray(self._rep),
@@ -469,7 +480,7 @@ class JaxEngine:
             toks, logp, self._k_cache, self._v_cache = fn(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
-                jnp.asarray(block_tables), sub,
+                jnp.asarray(block_tables), self._rng, step_id,
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 jnp.asarray(adapter_ids),
             )
@@ -485,7 +496,8 @@ class JaxEngine:
         ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
         prompt_mask) per-row arrays — routes through the logits-processor
         prefill program."""
-        self._rng, sub = jax.random.split(self._rng)
+        step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
+        self._rng_step += 1
         if procs is not None:
             if self._step_fn_procs is None:
                 self._step_fn_procs = self._build_step_fn(want_procs=True)
@@ -493,7 +505,8 @@ class JaxEngine:
             toks, logp, self._k_cache, self._v_cache = self._step_fn_procs(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos),
-                jnp.asarray(chunk_lens), jnp.asarray(block_tables), sub,
+                jnp.asarray(chunk_lens), jnp.asarray(block_tables),
+                self._rng, step_id,
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 jnp.asarray(adapter_ids),
                 None if mm_embeds is None else jnp.asarray(mm_embeds),
@@ -506,7 +519,7 @@ class JaxEngine:
             toks, logp, self._k_cache, self._v_cache = self._step_fn(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
-                jnp.asarray(block_tables), sub,
+                jnp.asarray(block_tables), self._rng, step_id,
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 jnp.asarray(adapter_ids),
                 None if mm_embeds is None else jnp.asarray(mm_embeds),

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, AsyncIterator, Dict, Optional
 
 from aiohttp import web
@@ -87,6 +88,7 @@ class HttpService:
         app.router.add_get("/busy_threshold", self._busy_threshold_list)
         app.router.add_post("/busy_threshold", self._busy_threshold_route)
         app.router.add_post("/v1/responses", self._responses)
+        app.router.add_post("/v1/images/generations", self._images)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
         app.router.add_get("/openapi.json", self._openapi)
         return app
@@ -373,6 +375,47 @@ class HttpService:
             logger.exception("embeddings failed")
             timer.done(500)
             return _error_response(OpenAIError(str(exc), status=500, err_type="internal_error"))
+
+    async def _images(self, request: web.Request) -> web.Response:
+        """OpenAI images API (ref: openai.rs:1552 images route) — routes to
+        a model of type 'image' (e.g. a diffusion engine worker); the engine
+        yields {b64_json | url} items, folded into the images response."""
+        body, err = await self._read_json(request)
+        if err is not None:
+            return err
+        model = body.get("model", "")
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            return _error_response(OpenAIError("'prompt' is required"))
+        entry = self.models.get(model)
+        if entry is None or entry.card.model_type != "image":
+            return _error_response(
+                OpenAIError(
+                    f"model '{model}' does not support image generation",
+                    status=404, err_type="not_found_error",
+                )
+            )
+        timer = RequestTimer(self.metrics, model, "images")
+        try:
+            ctx = Context()
+            data = []
+            async for item in entry.engine.generate(body, ctx):
+                if isinstance(item, dict) and "error" in item:
+                    raise OpenAIError(
+                        str(item["error"]), status=500, err_type="internal_error"
+                    )
+                data.append(item)
+            timer.done(200)
+            return web.json_response({"created": int(time.time()), "data": data})
+        except OpenAIError as exc:
+            timer.done(exc.status)
+            return _error_response(exc)
+        except Exception as exc:  # pragma: no cover
+            logger.exception("image generation failed")
+            timer.done(500)
+            return _error_response(
+                OpenAIError(str(exc), status=500, err_type="internal_error")
+            )
 
     async def _read_json(self, request: web.Request):
         try:

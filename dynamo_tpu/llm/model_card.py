@@ -34,7 +34,7 @@ class RuntimeConfig:
 @dataclass
 class ModelDeploymentCard:
     name: str
-    model_type: str = "chat"  # chat | completion | embedding | multimodal
+    model_type: str = "chat"  # chat | completion | embedding | multimodal | image
     model_path: Optional[str] = None  # local dir with tokenizer/config
     context_length: int = 4096
     kv_block_size: int = 64

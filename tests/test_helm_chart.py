@@ -1,0 +1,109 @@
+"""Helm chart (deploy/helm/dynamo-tpu): the rendered graphdeployment
+template must be valid YAML and parse into a GraphDeployment the local
+controller can run (specs move laptop ↔ cluster unchanged)."""
+
+import os
+import re
+
+import yaml
+
+from dynamo_tpu.deploy.spec import GraphDeployment
+
+CHART = os.path.join(
+    os.path.dirname(__file__), "..", "deploy", "helm", "dynamo-tpu"
+)
+
+
+def _lookup(values, dotted):
+    node = values
+    for part in dotted.split(".")[2:]:  # skip "" and "Values"
+        node = node[part]
+    return node
+
+
+def render(template_path, values):
+    """Minimal helm-subset renderer: {{ .Values.x.y }} substitution and
+    {{- if .Values.flag }} ... {{- end }} blocks (no nesting)."""
+    with open(template_path) as f:
+        lines = f.read().splitlines()
+    out = []
+    emitting = True
+    for line in lines:
+        m = re.match(r"\s*\{\{-? if (\S+) \}\}", line)
+        if m:
+            emitting = bool(_lookup(values, m.group(1)))
+            continue
+        if re.match(r"\s*\{\{-? end \}\}", line):
+            emitting = True
+            continue
+        if not emitting:
+            continue
+        out.append(
+            re.sub(
+                r"\{\{ (\.Values\.[\w.]+) \}\}",
+                lambda m: str(_lookup(values, m.group(1))),
+                line,
+            )
+        )
+    return "\n".join(out)
+
+
+def _values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def test_chart_metadata_valid():
+    with open(os.path.join(CHART, "Chart.yaml")) as f:
+        chart = yaml.safe_load(f)
+    assert chart["name"] == "dynamo-tpu"
+    assert chart["apiVersion"] == "v2"
+
+
+def test_graphdeployment_renders_and_loads():
+    values = _values()
+    doc = yaml.safe_load(
+        render(
+            os.path.join(CHART, "templates", "graphdeployment.yaml"), values
+        )
+    )
+    assert doc["kind"] == "DynamoTpuGraphDeployment"
+    graph = GraphDeployment.from_dict(doc)
+    kinds = {name: s.kind for name, s in graph.services.items()}
+    assert kinds["frontend"] == "frontend"
+    assert kinds["decode"] == "worker"
+    assert kinds["planner"] == "planner"
+    assert kinds["prefill"] == "worker"
+    assert graph.services["decode"].replicas == values["decode"]["replicas"]
+    # every service kind resolves to a runnable command line
+    for svc in graph.services.values():
+        cmd = svc.resolved_command()
+        assert cmd and cmd[1] == "-m"
+
+
+def test_disabled_blocks_drop_out():
+    values = _values()
+    values["prefill"]["enabled"] = False
+    values["planner"]["enabled"] = False
+    doc = yaml.safe_load(
+        render(
+            os.path.join(CHART, "templates", "graphdeployment.yaml"), values
+        )
+    )
+    graph = GraphDeployment.from_dict(doc)
+    assert "prefill" not in graph.services
+    assert "planner" not in graph.services
+    assert "decode" in graph.services
+
+
+def test_discd_service_renders():
+    doc = yaml.safe_load(
+        render(
+            os.path.join(CHART, "templates", "discd-service.yaml"), _values()
+        )
+    )
+    assert doc["kind"] == "Service"
+    ports = {p["name"]: p["port"] for p in doc["spec"]["ports"]}
+    assert ports == {
+        "discovery": 6180, "events-xsub": 6181, "events-xpub": 6182
+    }

@@ -1,0 +1,56 @@
+"""/v1/images/generations route (ref: openai.rs:1552 images) — routes to a
+model of type 'image'; the engine yields b64_json items."""
+
+import base64
+
+import aiohttp
+
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+
+class MockImageEngine:
+    """Stand-in diffusion worker: yields n tiny base64 'images'."""
+
+    async def generate(self, request, context):
+        n = int(request.get("n", 1) or 1)
+        size = request.get("size", "64x64")
+        for i in range(n):
+            payload = f"img-{i}-{request['prompt']}-{size}".encode()
+            yield {"b64_json": base64.b64encode(payload).decode()}
+
+
+async def test_images_route():
+    manager = ModelManager()
+    manager.register(
+        "pix", MockImageEngine(),
+        ModelDeploymentCard(name="pix", model_type="image"),
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/images/generations",
+                json={"model": "pix", "prompt": "a tpu", "n": 2},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert len(body["data"]) == 2 and "created" in body
+            decoded = base64.b64decode(body["data"][0]["b64_json"]).decode()
+            assert "a tpu" in decoded
+
+            # chat models reject the route
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/images/generations",
+                json={"model": "missing", "prompt": "x"},
+            )
+            assert r.status == 404
+            # prompt is required
+            r = await session.post(
+                f"http://127.0.0.1:{port}/v1/images/generations",
+                json={"model": "pix"},
+            )
+            assert r.status == 400
+    finally:
+        await service.stop(grace_period=1)

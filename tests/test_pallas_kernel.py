@@ -42,6 +42,42 @@ def test_kernel_matches_xla_oracle(B, C, H, KH, D, bs, P, maxstart):
         np.testing.assert_allclose(out[b, :n], ref[b, :n], atol=2e-5, rtol=2e-5)
 
 
+DECODE_CASES = [
+    # B, H, KH, D, bs, P, maxstart, batch_block
+    (16, 14, 2, 64, 32, 8, 200, 8),  # qwen2-0.5b decode shape
+    (9, 8, 4, 64, 16, 4, 50, 8),     # B > BQ and not a multiple: pad branch
+    (8, 8, 8, 128, 32, 2, 40, 4),    # MHA head_dim 128
+    (2, 4, 2, 64, 16, 6, 0, 8),      # position 0 (single visible key)
+]
+
+
+@pytest.mark.parametrize("B,H,KH,D,bs,P,maxstart,BQ", DECODE_CASES)
+def test_decode_kernel_matches_xla_oracle(B, H, KH, D, bs, P, maxstart, BQ):
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_kernel,
+    )
+
+    rng = np.random.default_rng(B * 77 + H)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B * P + 4, bs, KH, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B * P + 4, bs, KH, D)), jnp.float32)
+    bt = jnp.asarray(
+        rng.permutation(B * P + 2)[: B * P].reshape(B, P).astype(np.int32)
+    )
+    start = jnp.asarray(
+        rng.integers(0, min(maxstart, P * bs - 1) + 1, B).astype(np.int32)
+    )
+    cl = jnp.ones(B, jnp.int32)
+
+    ref = np.asarray(_paged_attention_xla(q, k, v, bt, start, cl))
+    out = np.asarray(
+        paged_attention_decode_kernel(
+            q, k, v, bt, start, interpret=True, batch_block=BQ
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 def test_use_kernel_flag_falls_back_without_crash(monkeypatch):
     """use_kernel=True must never raise even if the kernel can't load
     (round-1 regression: crash-loop on missing module)."""
@@ -49,6 +85,8 @@ def test_use_kernel_flag_falls_back_without_crash(monkeypatch):
 
     monkeypatch.setattr(attn, "_kernel_fn", None)
     monkeypatch.setattr(attn, "_kernel_load_failed", True)
+    monkeypatch.setattr(attn, "_decode_kernel_fn", None)
+    monkeypatch.setattr(attn, "_decode_kernel_load_failed", True)
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((1, 1, 4, 64)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((4, 16, 2, 64)), jnp.float32)

@@ -1,0 +1,143 @@
+"""Logprob sensitivity analysis (llm/perf.py; ref: perf/logprobs.rs)."""
+
+import math
+
+from dynamo_tpu.llm.perf import (
+    analyze_logprob_sensitivity,
+    compare_streams,
+)
+
+
+def _item(positions):
+    """positions: list of [(token_id, prob), ...] candidate lists."""
+    return {
+        "token_ids": [p[0][0] for p in positions],
+        "logprobs": [
+            [
+                {"token_id": t, "logprob": math.log(pr)}
+                for t, pr in cands
+            ]
+            for cands in positions
+        ],
+    }
+
+
+class TestSensitivity:
+    def test_close_and_confident_positions(self):
+        stream = [
+            _item([[(1, 0.5), (2, 0.45)]]),   # near-tie (gap 0.05)
+            _item([[(3, 0.9), (4, 0.05)]]),   # confident (gap 0.85)
+        ]
+        ana = analyze_logprob_sensitivity([stream])
+        assert ana.total_streams == 1
+        assert ana.positions_analyzed == 2
+        close = ana.close_positions(threshold=0.1)
+        assert len(close) == 1
+        assert close[0].token_position == 0
+        assert abs(close[0].probability_difference - 0.05) < 1e-9
+        assert 0 < ana.close_fraction(0.1) < 1
+
+    def test_probability_remaining(self):
+        ana = analyze_logprob_sensitivity(
+            [[_item([[(1, 0.5), (2, 0.3)]])]]
+        )
+        p = ana.positions[0]
+        assert abs(p.probability_remaining - 0.2) < 1e-9
+
+    def test_single_candidate_skipped(self):
+        ana = analyze_logprob_sensitivity([[_item([[(1, 0.9)]])]])
+        assert ana.positions_analyzed == 0
+
+    def test_most_uncertain_ordering(self):
+        stream = [
+            _item([[(1, 0.5), (2, 0.1)]]),
+            _item([[(3, 0.5), (4, 0.49)]]),
+        ]
+        ana = analyze_logprob_sensitivity([stream])
+        top = ana.most_uncertain(1)
+        assert top[0].token_position == 1
+
+    def test_candidates_sorted_desc(self):
+        ana = analyze_logprob_sensitivity(
+            [[_item([[(2, 0.2), (1, 0.7)]])]]
+        )
+        c = ana.positions[0].candidates
+        assert c[0].token_id == 1 and c[1].token_id == 2
+
+    def test_token_positions_survive_missing_logprobs(self):
+        """An item with tokens but no/partial logprobs must not shift later
+        positions — compare_streams aligns near-ties by real token index."""
+        stream = [
+            {"token_ids": [10, 11]},  # no logprobs at all (2 tokens)
+            _item([[(1, 0.5), (2, 0.48)]]),  # near-tie at real index 2
+        ]
+        ana = analyze_logprob_sensitivity([stream])
+        assert ana.positions_analyzed == 1
+        assert ana.positions[0].token_position == 2
+        # partial logprobs within one item: first position has candidates,
+        # second doesn't, third does — indices 0 and 2.
+        item = {
+            "token_ids": [5, 6, 7],
+            "logprobs": [
+                [{"token_id": 5, "logprob": -0.1},
+                 {"token_id": 9, "logprob": -0.2}],
+                [],
+                [{"token_id": 7, "logprob": -0.1},
+                 {"token_id": 8, "logprob": -0.2}],
+            ],
+        }
+        ana = analyze_logprob_sensitivity([[item]])
+        assert [p.token_position for p in ana.positions] == [0, 2]
+
+
+class TestCompareStreams:
+    def test_divergence_classification(self):
+        # Stream A: near-tie at pos 0, confident at pos 1.
+        a = [[
+            _item([[(1, 0.5), (2, 0.48)], [(7, 0.95), (8, 0.01)]]),
+        ]]
+        # Stream B diverges at BOTH positions.
+        b = [[
+            _item([[(2, 0.5), (1, 0.48)], [(9, 0.95), (8, 0.01)]]),
+        ]]
+        result = compare_streams(a, b, threshold=0.1)
+        assert len(result["divergences"]) == 2
+        near = {d["position"]: d["near_tie"] for d in result["divergences"]}
+        assert near[0] is True  # expected sampling noise
+        assert near[1] is False  # correctness signal
+        assert len(result["suspicious"]) == 1
+        assert result["suspicious"][0]["position"] == 1
+
+    def test_identical_streams_no_divergence(self):
+        s = [[_item([[(1, 0.6), (2, 0.3)]])]]
+        result = compare_streams(s, s)
+        assert result["divergences"] == []
+
+
+def test_works_on_recorder_streams(tmp_path):
+    """End to end with the stream recorder format (llm/recorder.py)."""
+    import asyncio
+
+    from dynamo_tpu.llm.recorder import StreamRecorder, load_recording
+    from dynamo_tpu.runtime.context import Context
+
+    async def engine_generate(request, context, next=None):
+        yield _item([[(5, 0.5), (6, 0.45)]])
+
+    class _Next:
+        async def generate(self, request, context):
+            async for x in engine_generate(request, context):
+                yield x
+
+    async def run():
+        rec = StreamRecorder(str(tmp_path / "cap.jsonl"))
+        out = []
+        async for item in rec.generate({"p": 1}, Context(), _Next()):
+            out.append(item)
+        return out
+
+    asyncio.run(run())
+    streams = load_recording(str(tmp_path / "cap.jsonl"))
+    ana = analyze_logprob_sensitivity(streams)
+    assert ana.positions_analyzed == 1
+    assert ana.close_fraction(0.1) == 1.0
