@@ -125,7 +125,7 @@ class DecodeHandler:
         missing_from = 0
         pool = self._engine.pool
         for i, h in enumerate(hashes):
-            if h not in pool._by_hash:
+            if not pool.contains(h):
                 missing_from = i
                 break
         else:

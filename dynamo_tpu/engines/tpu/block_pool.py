@@ -65,6 +65,10 @@ class BlockPool:
 
     # -- prefix reuse ------------------------------------------------------
 
+    def contains(self, block_hash: int) -> bool:
+        """Whether a committed block with this content hash is resident."""
+        return block_hash in self._by_hash
+
     def match_prefix(self, block_hashes: Sequence[int]) -> int:
         n = 0
         for h in block_hashes:

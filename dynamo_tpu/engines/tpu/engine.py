@@ -1388,7 +1388,7 @@ class JaxEngine:
         parents: List[Optional[int]] = []
         parent: Optional[int] = None
         for i, h in enumerate(block_hashes):
-            if h in self.pool._by_hash:
+            if self.pool.contains(h):
                 parent = h
                 continue
             b = self.pool.alloc()

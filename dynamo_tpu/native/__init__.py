@@ -34,19 +34,31 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 _LOAD_CACHE: dict = {}
 
 
-def _build_and_load(name: str, source: str) -> Optional[ctypes.CDLL]:
+def _build_and_load(
+    name: str, source: str, extra_flags: tuple = ()
+) -> Optional[ctypes.CDLL]:
     """Compile ``source`` (under native/) to a cached .so and dlopen it."""
     if name in _LOAD_CACHE:
         return _LOAD_CACHE[name]
     lib = None
     if NATIVE.get():
         src = os.path.join(_HERE, source)
-        out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        # Flags participate in the artifact name: changing link flags must
+        # rebuild, not reuse a stale .so built differently.
+        import hashlib
+
+        tag = (
+            "-" + hashlib.md5(" ".join(extra_flags).encode()).hexdigest()[:8]
+            if extra_flags
+            else ""
+        )
+        out = os.path.join(_BUILD_DIR, f"lib{name}{tag}.so")
         try:
             if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", out],
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+                     "-o", out, *extra_flags],
                     check=True, capture_output=True, timeout=120,
                 )
                 logger.info("built native component %s", name)

@@ -1,0 +1,166 @@
+// C ABI KV-event publisher for external (C++) engines.
+//
+// Reference parity: lib/bindings/c (dynamo_llm_* functions, src/lib.rs:157,
+// 172, 341) — the reference embeds its Rust runtime behind a C ABI so
+// C++ engines (TRT-LLM) can publish KV-cache events and load metrics
+// without a Python interpreter. Here the equivalent: this library speaks
+// the framework's ZMQ event plane directly (PUB socket → the XSUB side of
+// the broker, two-frame [topic | msgpack] messages, the exact wire format
+// of runtime/events/zmq_plane.py) with a hand-rolled minimal msgpack
+// encoder for the RouterEvent document (router/protocols.py).
+//
+// libzmq is loaded via the system's shared library (libzmq.so.5 is a
+// stable C ABI); prototypes are declared here so no dev headers are
+// needed at build time.
+//
+// API (ctypes-friendly, see native/kv_publisher.py):
+//   void*  dyn_kv_publisher_new(endpoint, topic, worker_id, dp_rank)
+//   int    dyn_kv_publish(pub, kind, hashes, n, parent, has_parent, event_id)
+//   int    dyn_load_publish(pub, load_topic, active_seqs, waiting,
+//                           active_blocks, total_blocks)
+//   void   dyn_kv_publisher_free(pub)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// ---- minimal libzmq prototypes (ABI-stable since 4.x) ----------------------
+extern "C" {
+void *zmq_ctx_new(void);
+int zmq_ctx_term(void *ctx);
+void *zmq_socket(void *ctx, int type);
+int zmq_close(void *socket);
+int zmq_connect(void *socket, const char *endpoint);
+int zmq_send(void *socket, const void *buf, size_t len, int flags);
+int zmq_setsockopt(void *socket, int option, const void *val, size_t len);
+}
+static const int ZMQ_PUB_T = 1;
+static const int ZMQ_SNDMORE_F = 2;
+static const int ZMQ_LINGER_O = 17;
+
+// ---- minimal msgpack encoder ----------------------------------------------
+namespace {
+
+void put_u8(std::string &b, uint8_t v) { b.push_back((char)v); }
+
+void put_be(std::string &b, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; --i) b.push_back((char)((v >> (8 * i)) & 0xff));
+}
+
+void pack_uint(std::string &b, uint64_t v) {
+  put_u8(b, 0xcf);  // uint64 — widest form, always valid
+  put_be(b, v, 8);
+}
+
+void pack_int(std::string &b, int64_t v) {
+  if (v >= 0) return pack_uint(b, (uint64_t)v);
+  put_u8(b, 0xd3);  // int64
+  put_be(b, (uint64_t)v, 8);
+}
+
+void pack_str(std::string &b, const char *s) {
+  size_t n = std::strlen(s);
+  put_u8(b, 0xd9);  // str8 (all our strings are short)
+  put_u8(b, (uint8_t)n);
+  b.append(s, n);
+}
+
+void pack_map_header(std::string &b, uint32_t n) {
+  put_u8(b, 0xdf);  // map32
+  put_be(b, n, 4);
+}
+
+void pack_array_header(std::string &b, uint32_t n) {
+  put_u8(b, 0xdd);  // array32
+  put_be(b, n, 4);
+}
+
+void pack_nil(std::string &b) { put_u8(b, 0xc0); }
+
+struct Publisher {
+  void *ctx = nullptr;
+  void *sock = nullptr;
+  std::string topic;
+  uint64_t worker_id = 0;
+  int dp_rank = 0;
+};
+
+int send_two_frames(Publisher *p, const std::string &topic,
+                    const std::string &payload) {
+  if (zmq_send(p->sock, topic.data(), topic.size(), ZMQ_SNDMORE_F) < 0)
+    return -1;
+  if (zmq_send(p->sock, payload.data(), payload.size(), 0) < 0) return -2;
+  return 0;
+}
+
+}  // namespace
+
+// ---- C API -----------------------------------------------------------------
+extern "C" {
+
+void *dyn_kv_publisher_new(const char *xsub_endpoint, const char *topic,
+                           uint64_t worker_id, int dp_rank) {
+  auto *p = new Publisher();
+  p->ctx = zmq_ctx_new();
+  if (!p->ctx) { delete p; return nullptr; }
+  p->sock = zmq_socket(p->ctx, ZMQ_PUB_T);
+  if (!p->sock) { zmq_ctx_term(p->ctx); delete p; return nullptr; }
+  int linger = 0;
+  zmq_setsockopt(p->sock, ZMQ_LINGER_O, &linger, sizeof linger);
+  if (zmq_connect(p->sock, xsub_endpoint) != 0) {
+    zmq_close(p->sock); zmq_ctx_term(p->ctx); delete p; return nullptr;
+  }
+  p->topic = topic;
+  p->worker_id = worker_id;
+  p->dp_rank = dp_rank;
+  return p;
+}
+
+// kind: "stored" | "removed" | "cleared". Returns 0 on success.
+int dyn_kv_publish(void *pub, const char *kind, const uint64_t *hashes,
+                   int n_hashes, uint64_t parent_hash, int has_parent,
+                   uint64_t event_id) {
+  auto *p = (Publisher *)pub;
+  if (!p || !p->sock) return -3;
+  std::string b;
+  b.reserve(64 + 9 * (size_t)(n_hashes > 0 ? n_hashes : 0));
+  pack_map_header(b, 6);  // RouterEvent fields (router/protocols.py:29)
+  pack_str(b, "worker_id");   pack_uint(b, p->worker_id);
+  pack_str(b, "kind");        pack_str(b, kind);
+  pack_str(b, "block_hashes");
+  pack_array_header(b, (uint32_t)(n_hashes > 0 ? n_hashes : 0));
+  for (int i = 0; i < n_hashes; ++i) pack_uint(b, hashes[i]);
+  pack_str(b, "parent_hash");
+  if (has_parent) pack_uint(b, parent_hash); else pack_nil(b);
+  pack_str(b, "dp_rank");     pack_int(b, p->dp_rank);
+  pack_str(b, "event_id");    pack_uint(b, event_id);
+  return send_two_frames(p, p->topic, b);
+}
+
+// Load report (LoadSnapshot fields, router/protocols.py:52 — unknown keys
+// are dropped by from_dict, so only real fields are sent).
+int dyn_load_publish(void *pub, const char *load_topic, int active_seqs,
+                     int waiting, int active_blocks, int total_blocks) {
+  auto *p = (Publisher *)pub;
+  if (!p || !p->sock) return -3;
+  std::string b;
+  pack_map_header(b, 6);
+  pack_str(b, "worker_id");     pack_uint(b, p->worker_id);
+  pack_str(b, "dp_rank");       pack_int(b, p->dp_rank);
+  pack_str(b, "active_seqs");   pack_int(b, active_seqs);
+  pack_str(b, "waiting");       pack_int(b, waiting);
+  pack_str(b, "active_blocks"); pack_int(b, active_blocks);
+  pack_str(b, "total_blocks");  pack_int(b, total_blocks);
+  return send_two_frames(p, std::string(load_topic), b);
+}
+
+void dyn_kv_publisher_free(void *pub) {
+  auto *p = (Publisher *)pub;
+  if (!p) return;
+  if (p->sock) zmq_close(p->sock);
+  if (p->ctx) zmq_ctx_term(p->ctx);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+"""ctypes wrapper for the C KV-event publisher (kv_publish.cpp).
+
+Reference parity: lib/bindings/c — the C ABI external C++ engines use to
+publish KV-cache events and load reports into the framework's planes. The
+Python wrapper here exists for tests and as the embedding example; a real
+C++ engine calls the `dyn_*` functions directly (see kv_publish.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+from dynamo_tpu.native import _build_and_load
+from dynamo_tpu.router.protocols import kv_events_topic, load_topic
+
+_MASK64 = (1 << 64) - 1
+
+
+def load_kv_publish_lib() -> Optional[ctypes.CDLL]:
+    lib = _build_and_load(
+        "dynkvpub", "kv_publish.cpp", extra_flags=("-l:libzmq.so.5",)
+    )
+    if lib is None:
+        return None
+    lib.dyn_kv_publisher_new.restype = ctypes.c_void_p
+    lib.dyn_kv_publisher_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dyn_kv_publish.restype = ctypes.c_int
+    lib.dyn_kv_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+    ]
+    lib.dyn_load_publish.restype = ctypes.c_int
+    lib.dyn_load_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.dyn_kv_publisher_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class CKvEventPublisher:
+    """KV-event + load publishing through the native C library.
+
+    ``xsub_endpoint``: the broker's XSUB address, e.g. "tcp://127.0.0.1:6181"
+    (the first port of DYN_TPU_EVENT_PLANE_ADDR's host:xsub:xpub form).
+    """
+
+    def __init__(
+        self,
+        xsub_endpoint: str,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        dp_rank: int = 0,
+    ) -> None:
+        self._lib = load_kv_publish_lib()
+        if self._lib is None:
+            raise RuntimeError("native kv_publish library unavailable")
+        self._topic = kv_events_topic(namespace, component)
+        self._load_topic = load_topic(namespace, component)
+        self._handle = self._lib.dyn_kv_publisher_new(
+            xsub_endpoint.encode(), self._topic.encode(),
+            worker_id & _MASK64, dp_rank,
+        )
+        if not self._handle:
+            raise RuntimeError(f"cannot connect PUB socket to {xsub_endpoint}")
+        self._event_id = 0
+
+    def publish_stored(
+        self, block_hashes: Sequence[int], parent_hash: Optional[int] = None
+    ) -> None:
+        self._publish("stored", block_hashes, parent_hash)
+
+    def publish_removed(self, block_hashes: Sequence[int]) -> None:
+        self._publish("removed", block_hashes, None)
+
+    def publish_cleared(self) -> None:
+        self._publish("cleared", (), None)
+
+    def _publish(self, kind, hashes, parent) -> None:
+        self._event_id += 1
+        n = len(hashes)
+        arr = (ctypes.c_uint64 * max(n, 1))(*[h & _MASK64 for h in hashes])
+        rc = self._lib.dyn_kv_publish(
+            self._handle, kind.encode(), arr, n,
+            (parent or 0) & _MASK64, 1 if parent is not None else 0,
+            self._event_id,
+        )
+        if rc != 0:
+            raise RuntimeError(f"dyn_kv_publish failed: {rc}")
+
+    def publish_load(
+        self, active_seqs: int, waiting: int, active_blocks: int,
+        total_blocks: int,
+    ) -> None:
+        rc = self._lib.dyn_load_publish(
+            self._handle, self._load_topic.encode(),
+            active_seqs, waiting, active_blocks, total_blocks,
+        )
+        if rc != 0:
+            raise RuntimeError(f"dyn_load_publish failed: {rc}")
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dyn_kv_publisher_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()

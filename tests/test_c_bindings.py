@@ -1,0 +1,136 @@
+"""C-ABI KV-event publisher (native/kv_publish.cpp via ctypes wrapper):
+events published from the native library must arrive on the Python event
+plane, parse as RouterEvents, and feed the KV router's indexer — the
+external-C++-engine integration path (ref: lib/bindings/c dynamo_llm_*)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.native.kv_publisher import (
+    CKvEventPublisher,
+    load_kv_publish_lib,
+)
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.protocols import LoadSnapshot, RouterEvent
+from dynamo_tpu.runtime.events.zmq_plane import EventBroker, ZmqEventPlane
+
+pytestmark = pytest.mark.skipif(
+    load_kv_publish_lib() is None,
+    reason="native kv_publish library not buildable here",
+)
+
+
+async def _drain_first(sub, pub_retry, timeout=10.0):
+    """PUB sockets drop messages sent before the subscription propagates
+    (zmq slow-joiner); retry-publish until the first message lands, then
+    flush queued duplicates so later asserts see only NEW events."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        pub_retry()
+        try:
+            first = await asyncio.wait_for(sub.get(), 0.5)
+            break
+        except asyncio.TimeoutError:
+            if loop.time() > deadline:
+                raise
+    while True:  # retried publishes are identical; drop the extras
+        try:
+            await asyncio.wait_for(sub.get(), 0.1)
+        except asyncio.TimeoutError:
+            return first
+
+
+async def test_c_publisher_events_reach_indexer():
+    broker = EventBroker()
+    broker.start()
+    plane = ZmqEventPlane(broker.address)
+    pub = CKvEventPublisher(
+        f"tcp://127.0.0.1:{broker.xsub_port}", "ns", "backend",
+        worker_id=0xABCDEF, dp_rank=1,
+    )
+    try:
+        sub = plane.subscribe("ns.backend.kv_events")
+        topic, payload = await _drain_first(
+            sub, lambda: pub.publish_stored([11, 22, 33], parent_hash=None)
+        )
+        event = RouterEvent.from_dict(payload)
+        assert event.worker == (0xABCDEF, 1)
+        assert event.kind == "stored"
+        assert event.block_hashes == [11, 22, 33]
+        assert event.parent_hash is None
+
+        indexer = KvIndexer(block_size=16)
+        indexer.apply(event)
+        scores = indexer.find_matches([11, 22, 33])
+        assert scores.scores.get((0xABCDEF, 1)) == 3
+
+        # chained store with a parent + removal
+        pub.publish_stored([44], parent_hash=33)
+        _, payload = await asyncio.wait_for(sub.get(), 5)
+        ev2 = RouterEvent.from_dict(payload)
+        assert ev2.parent_hash == 33 and ev2.event_id > event.event_id
+        indexer.apply(ev2)
+        assert indexer.find_matches([11, 22, 33, 44]).scores[(0xABCDEF, 1)] == 4
+
+        pub.publish_removed([44])
+        _, payload = await asyncio.wait_for(sub.get(), 5)
+        indexer.apply(RouterEvent.from_dict(payload))
+        assert indexer.find_matches([11, 22, 33, 44]).scores[(0xABCDEF, 1)] == 3
+
+        pub.publish_cleared()
+        _, payload = await asyncio.wait_for(sub.get(), 5)
+        indexer.apply(RouterEvent.from_dict(payload))
+        assert indexer.find_matches([11, 22, 33]).scores.get((0xABCDEF, 1), 0) == 0
+        await sub.aclose()
+    finally:
+        pub.close()
+        await plane.close()
+        await broker.close()
+
+
+async def test_c_publisher_large_hashes_roundtrip():
+    """64-bit block hashes (top bit set) must survive the wire unsigned-
+    compatible with compute_block_hashes output."""
+    broker = EventBroker()
+    broker.start()
+    plane = ZmqEventPlane(broker.address)
+    pub = CKvEventPublisher(
+        f"tcp://127.0.0.1:{broker.xsub_port}", "ns", "backend", worker_id=7
+    )
+    big = (1 << 63) | 12345
+    try:
+        sub = plane.subscribe("ns.backend.kv_events")
+        _, payload = await _drain_first(
+            sub, lambda: pub.publish_stored([big])
+        )
+        assert RouterEvent.from_dict(payload).block_hashes == [big]
+        await sub.aclose()
+    finally:
+        pub.close()
+        await plane.close()
+        await broker.close()
+
+
+async def test_c_load_publish():
+    broker = EventBroker()
+    broker.start()
+    plane = ZmqEventPlane(broker.address)
+    pub = CKvEventPublisher(
+        f"tcp://127.0.0.1:{broker.xsub_port}", "ns", "backend", worker_id=9
+    )
+    try:
+        sub = plane.subscribe("ns.backend.load")
+        _, payload = await _drain_first(
+            sub, lambda: pub.publish_load(3, 1, 40, 100)
+        )
+        snap = LoadSnapshot.from_dict(payload)
+        assert snap.worker == (9, 0)
+        assert snap.active_seqs == 3 and snap.waiting == 1
+        assert abs(snap.kv_usage - 0.4) < 1e-9
+        await sub.aclose()
+    finally:
+        pub.close()
+        await plane.close()
+        await broker.close()

@@ -1,0 +1,104 @@
+"""Long-ISL serving: chunked prefill across many rounds, deep block
+tables, long-prefix cache reuse, and decode correctness at depth — the
+engine-level leg of the long-context strategy (SURVEY §5; VERDICT round-1
+flagged long ISL as untested)."""
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+
+ISL = 2100  # crosses 9 prefill chunks of 256 and ~132 blocks of 16
+
+
+def make_long_engine(**over):
+    defaults = dict(
+        config=tiny_config(max_position_embeddings=4096),
+        block_size=16,
+        num_kv_blocks=360,
+        max_num_seqs=2,
+        max_model_len=2304,
+        prefill_chunk=256,
+        decode_steps=4,
+    )
+    defaults.update(over)
+    return JaxEngine(JaxEngineArgs(**defaults))
+
+
+def req(tokens, max_tokens=6, rid="long"):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        request_id=rid,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+
+
+async def test_long_isl_prefill_and_decode():
+    engine = make_long_engine()
+    try:
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(10, 500, size=ISL).tolist()
+        out = await collect(engine.generate(req(prompt), Context()))
+        toks = [t for o in out for t in o.token_ids]
+        assert len(toks) == 6
+        stats = engine.stats()
+        assert stats["prefill_tokens"] >= ISL - 1
+        # deterministic at temperature 0 across a fresh identical request
+        out2 = await collect(engine.generate(req(prompt, rid="long2"), Context()))
+        assert [t for o in out2 for t in o.token_ids] == toks
+    finally:
+        await engine.stop()
+
+
+async def test_long_prefix_cache_reuse():
+    """Second request sharing a 2048-token prefix must prefill only the
+    tail — the chunked-prefill + prefix-cache interaction at depth."""
+    engine = make_long_engine()
+    try:
+        rng = np.random.default_rng(1)
+        shared = rng.integers(10, 500, size=2048).tolist()
+        p1 = shared + rng.integers(10, 500, size=8).tolist()
+        p2 = shared + rng.integers(10, 500, size=8).tolist()
+
+        await collect(engine.generate(req(p1, rid="a"), Context()))
+        prefill_before = engine.stats()["prefill_tokens"]
+        await collect(engine.generate(req(p2, rid="b"), Context()))
+        tail = engine.stats()["prefill_tokens"] - prefill_before
+        # 2048 shared tokens = 128 full blocks reused; only the tail (plus
+        # the cache-safety last-token recompute) prefills again.
+        assert tail <= 64, f"long prefix not reused: {tail} tokens prefilled"
+    finally:
+        await engine.stop()
+
+
+async def test_long_concurrent_sequences_block_accounting():
+    """Two deep sequences decoding concurrently: block tables stay
+    consistent and the pool frees everything at the end."""
+    engine = make_long_engine(num_kv_blocks=512, max_num_seqs=2)
+    try:
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(10, 500, size=1500).tolist() for _ in range(2)]
+        outs = await asyncio.gather(
+            *(
+                collect(engine.generate(req(p, rid=f"c{i}", max_tokens=10), Context()))
+                for i, p in enumerate(prompts)
+            )
+        )
+        for out in outs:
+            assert len([t for o in out for t in o.token_ids]) == 10
+        assert engine.stats()["active_seqs"] == 0
+        # all blocks are back to free or reusable-cached
+        pool = engine.pool
+        assert pool.active_blocks == 0
+    finally:
+        await engine.stop()
