@@ -26,49 +26,50 @@ _SECONDS_BUCKETS = (
 
 class FrontendMetrics:
     def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
+        from dynamo_tpu.runtime import metric_names as mn
+
         self.registry = registry or CollectorRegistry()
-        ns = "dynamo_tpu_frontend"
         self.requests_total = Counter(
-            f"{ns}_requests_total",
+            mn.FRONTEND_REQUESTS_TOTAL,
             "HTTP requests by model/endpoint/status",
             ["model", "endpoint", "status"],
             registry=self.registry,
         )
         self.inflight = Gauge(
-            f"{ns}_inflight_requests",
+            mn.FRONTEND_INFLIGHT,
             "Currently executing requests",
             ["model", "endpoint"],
             registry=self.registry,
         )
         self.request_duration = Histogram(
-            f"{ns}_request_duration_seconds",
+            mn.FRONTEND_REQUEST_DURATION,
             "End-to-end request duration",
             ["model", "endpoint"],
             buckets=_SECONDS_BUCKETS,
             registry=self.registry,
         )
         self.ttft = Histogram(
-            f"{ns}_time_to_first_token_seconds",
+            mn.FRONTEND_TTFT,
             "Time to first token (streaming requests)",
             ["model"],
             buckets=_SECONDS_BUCKETS,
             registry=self.registry,
         )
         self.itl = Histogram(
-            f"{ns}_inter_token_latency_seconds",
+            mn.FRONTEND_ITL,
             "Latency between streamed tokens",
             ["model"],
             buckets=_SECONDS_BUCKETS,
             registry=self.registry,
         )
         self.output_tokens = Counter(
-            f"{ns}_output_tokens_total",
+            mn.FRONTEND_OUTPUT_TOKENS_TOTAL,
             "Generated tokens",
             ["model"],
             registry=self.registry,
         )
         self.input_tokens = Counter(
-            f"{ns}_input_tokens_total",
+            mn.FRONTEND_INPUT_TOKENS_TOTAL,
             "Prompt tokens",
             ["model"],
             registry=self.registry,

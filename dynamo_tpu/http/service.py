@@ -591,6 +591,14 @@ class HttpService:
         finish_seen: Optional[str] = None
         audit_parts: Optional[list] = [] if self.audit.enabled else None
         reasoning_parser = ReasoningParser(style=entry.card.reasoning_style)
+        # Streaming tool-call jail (ref: jail.rs): when the request declared
+        # tools, raw dialect text is held back and surfaces as tool_calls
+        # deltas instead of content.
+        jail = None
+        if kind == "chat" and body.get("tools"):
+            from dynamo_tpu.parsers.jail import ToolCallJail
+
+            jail = ToolCallJail()
         try:
             async for item in _prepend(first_item, stream):
                 if isinstance(item, dict) and "annotation" in item:
@@ -635,12 +643,71 @@ class HttpService:
                         # reasoning_content delta field (ref: jail.rs stream
                         # rewriting for <think> sections).
                         delta["reasoning_content"] = reasoning
+                    if jail is not None:
+                        content = jail.feed(content)
+                        if out.finish_reason is not None:
+                            tail, jailed = jail.flush()
+                            content += tail
+                            if jailed:
+                                from dynamo_tpu.parsers import (
+                                    detect_and_parse_tool_calls,
+                                )
+                                from dynamo_tpu.parsers.jail import (
+                                    tool_call_stream_deltas,
+                                )
+
+                                calls, remainder = detect_and_parse_tool_calls(
+                                    jailed
+                                )
+                                if calls:
+                                    delta["tool_calls"] = (
+                                        tool_call_stream_deltas(calls)
+                                    )
+                                    finish_str = "tool_calls"
+                                    finish_seen = finish_str
+                                    # Text around the call survives, as in
+                                    # the unary path.
+                                    content += remainder
+                                else:  # false alarm: it was plain content
+                                    content += remainder
                     if content:
                         delta["content"] = content
                     chunk = chat_chunk(rid, entry.name, delta=delta, finish_reason=finish_str)
                 else:
                     chunk = completion_chunk(rid, entry.name, text=out.text, finish_reason=finish_str)
                 await _sse_send(response, chunk)
+            if kind == "chat" and status == 200 and finish_seen is None:
+                # Stream ended without a finish chunk (the unary path
+                # defaults to EOS here): release anything the reasoning
+                # parser or the jail still holds — buffered text must not
+                # vanish.
+                delta = {}
+                r_tail, c_tail = reasoning_parser.flush()
+                if r_tail:
+                    delta["reasoning_content"] = r_tail
+                content = c_tail
+                if jail is not None:
+                    content = jail.feed(content)
+                    tail, jailed = jail.flush()
+                    content += tail
+                    if jailed:
+                        from dynamo_tpu.parsers import detect_and_parse_tool_calls
+                        from dynamo_tpu.parsers.jail import tool_call_stream_deltas
+
+                        calls, remainder = detect_and_parse_tool_calls(jailed)
+                        content += remainder
+                        if calls:
+                            delta["tool_calls"] = tool_call_stream_deltas(calls)
+                            finish_seen = "tool_calls"
+                if content:
+                    delta["content"] = content
+                finish_seen = finish_seen or FinishReason.EOS.to_openai()
+                await _sse_send(
+                    response,
+                    chat_chunk(
+                        rid, entry.name, delta=delta, finish_reason=finish_seen
+                    ),
+                )
             if include_usage and status == 200:
                 usage = usage_block(prompt_tokens, completion_tokens)
                 if kind == "chat":

@@ -1,0 +1,89 @@
+"""Streaming tool-call jail: hold back content that is becoming a tool call.
+
+Reference parity: lib/llm/src/protocols/openai/chat_completions/jail.rs —
+when a streamed response starts emitting a tool-call dialect, the raw
+marker text must NOT reach the client as content deltas; it is jailed
+until the stream ends, parsed, and delivered as OpenAI `tool_calls`
+deltas with finish_reason "tool_calls".
+
+The jail is marker-driven: the opening tokens of every supported dialect
+(parsers/tool_calling.py) trigger it, and a suffix that might be a
+partially-received marker is held back one delta (the same holdback scheme
+the reasoning parser uses for tags straddling delta boundaries).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+# Opening markers of the tool-call dialects (tool_calling.py):
+# hermes/xml share <tool_call>; mistral, harmony (gpt-oss channels), DSML.
+TOOL_MARKERS: Tuple[str, ...] = (
+    "<tool_call>",
+    "[TOOL_CALLS]",
+    "<|channel|>",
+    "<｜DSML｜",
+)
+
+
+class ToolCallJail:
+    """Feed content deltas; get back what is safe to stream as content.
+    Once a full opening marker appears, everything from the marker onward
+    is jailed until ``flush()``."""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._jailed = False
+
+    @property
+    def jailed(self) -> bool:
+        return self._jailed
+
+    def feed(self, delta: str) -> str:
+        if self._jailed:
+            self._buf += delta
+            return ""
+        text = self._buf + delta
+        self._buf = ""
+        # Earliest full marker jails the rest of the stream.
+        idx, _marker = _find_first(text, TOOL_MARKERS)
+        if idx != -1:
+            self._jailed = True
+            self._buf = text[idx:]
+            return text[:idx]
+        # Hold back the longest suffix that is a prefix of any marker.
+        max_n = min(max(len(m) for m in TOOL_MARKERS) - 1, len(text))
+        for n in range(max_n, 0, -1):
+            tail = text[-n:]
+            if any(m.startswith(tail) for m in TOOL_MARKERS):
+                self._buf = tail
+                return text[:-n]
+        return text
+
+    def flush(self) -> Tuple[str, str]:
+        """End of stream → (releasable_content, jailed_text). Exactly one
+        of the two is non-empty (or both empty)."""
+        buf, self._buf = self._buf, ""
+        if self._jailed:
+            return "", buf
+        return buf, ""
+
+
+def _find_first(text: str, markers) -> Tuple[int, str]:
+    best, best_m = -1, ""
+    for m in markers:
+        i = text.find(m)
+        if i != -1 and (best == -1 or i < best):
+            best, best_m = i, m
+    return best, best_m
+
+
+def tool_call_stream_deltas(calls: List) -> List[dict]:
+    """OpenAI streaming `tool_calls` delta entries (indexed) from parsed
+    ToolCall objects (tool_calling.py)."""
+    out = []
+    for i, call in enumerate(calls):
+        entry = call.to_openai()
+        entry["index"] = i
+        out.append(entry)
+    return out

@@ -16,11 +16,16 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from dynamo_tpu.planner.planner_core import MetricsSnapshot
+from dynamo_tpu.runtime.metric_names import (
+    FRONTEND_INPUT_TOKENS_TOTAL,
+    FRONTEND_ITL,
+    FRONTEND_OUTPUT_TOKENS_TOTAL,
+    FRONTEND_REQUESTS_TOTAL,
+    FRONTEND_TTFT,
+)
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
-
-PREFIX = "dynamo_tpu_frontend"
 
 # (series name, sorted label items) -> value
 Sample = Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]
@@ -160,20 +165,20 @@ class FrontendScrapeSource:
 
     def snapshot_from(self, prev: Sample, cur: Sample, dt: float) -> MetricsSnapshot:
         where = {"model": self.model} if self.model else {}
-        name = f"{PREFIX}_requests_total"
+        name = FRONTEND_REQUESTS_TOTAL
         # completed requests across endpoints/statuses
         req_delta = _sum_series(cur, name, where) - _sum_series(prev, name, where)
-        in_delta = _sum_series(cur, f"{PREFIX}_input_tokens_total", where) - _sum_series(
-            prev, f"{PREFIX}_input_tokens_total", where
+        in_delta = _sum_series(cur, FRONTEND_INPUT_TOKENS_TOTAL, where) - _sum_series(
+            prev, FRONTEND_INPUT_TOKENS_TOTAL, where
         )
-        out_delta = _sum_series(cur, f"{PREFIX}_output_tokens_total", where) - _sum_series(
-            prev, f"{PREFIX}_output_tokens_total", where
+        out_delta = _sum_series(cur, FRONTEND_OUTPUT_TOKENS_TOTAL, where) - _sum_series(
+            prev, FRONTEND_OUTPUT_TOKENS_TOTAL, where
         )
         ttft = _histogram_quantile(
-            _bucket_deltas(prev, cur, f"{PREFIX}_time_to_first_token_seconds"), 0.5
+            _bucket_deltas(prev, cur, FRONTEND_TTFT), 0.5
         )
         itl = _histogram_quantile(
-            _bucket_deltas(prev, cur, f"{PREFIX}_inter_token_latency_seconds"), 0.5
+            _bucket_deltas(prev, cur, FRONTEND_ITL), 0.5
         )
         rate = req_delta / dt if dt > 0 else 0.0
         return MetricsSnapshot(

@@ -1,0 +1,53 @@
+"""Canonical metric names: ONE place defines every Prometheus name.
+
+Reference parity: lib/runtime/src/metrics/prometheus_names.rs — the
+reference centralizes metric-name constants so dashboards, alerts, the
+planner's scrape source, and the emitting components can never drift
+apart. Same rule here: emitters (http/metrics.py, runtime/system_server.py)
+and consumers (planner/metrics_source.py) import these constants instead
+of repeating strings.
+
+Naming scheme: ``dynamo_tpu_<subsystem>_<metric>[_unit][_total]``.
+"""
+
+from __future__ import annotations
+
+# -- frontend (http/metrics.py) ---------------------------------------------
+FRONTEND_PREFIX = "dynamo_tpu_frontend"
+FRONTEND_REQUESTS_TOTAL = f"{FRONTEND_PREFIX}_requests_total"
+FRONTEND_INFLIGHT = f"{FRONTEND_PREFIX}_inflight_requests"
+FRONTEND_REQUEST_DURATION = f"{FRONTEND_PREFIX}_request_duration_seconds"
+FRONTEND_TTFT = f"{FRONTEND_PREFIX}_time_to_first_token_seconds"
+FRONTEND_ITL = f"{FRONTEND_PREFIX}_inter_token_latency_seconds"
+FRONTEND_OUTPUT_TOKENS_TOTAL = f"{FRONTEND_PREFIX}_output_tokens_total"
+FRONTEND_INPUT_TOKENS_TOTAL = f"{FRONTEND_PREFIX}_input_tokens_total"
+
+# -- engine (runtime/system_server.py engine_stats_prometheus) ---------------
+ENGINE_PREFIX = "dynamo_tpu_engine"
+
+
+def engine_gauge(stat_key: str) -> str:
+    """Engine stats-dict key → canonical gauge name (system server)."""
+    return f"{ENGINE_PREFIX}_{stat_key}"
+
+
+ENGINE_ACTIVE_SEQS = engine_gauge("active_seqs")
+ENGINE_WAITING = engine_gauge("waiting")
+ENGINE_KV_USAGE = engine_gauge("kv_usage")
+ENGINE_FREE_BLOCKS = engine_gauge("free_blocks")
+ENGINE_CACHED_BLOCKS = engine_gauge("cached_blocks")
+ENGINE_TOTAL_BLOCKS = engine_gauge("total_blocks")
+ENGINE_DECODE_STEPS = engine_gauge("decode_steps")
+ENGINE_PREFILL_TOKENS = engine_gauge("prefill_tokens")
+ENGINE_GENERATED_TOKENS = engine_gauge("generated_tokens")
+ENGINE_SLEEP_LEVEL = engine_gauge("sleep_level")
+
+ALL_FRONTEND = (
+    FRONTEND_REQUESTS_TOTAL,
+    FRONTEND_INFLIGHT,
+    FRONTEND_REQUEST_DURATION,
+    FRONTEND_TTFT,
+    FRONTEND_ITL,
+    FRONTEND_OUTPUT_TOKENS_TOTAL,
+    FRONTEND_INPUT_TOKENS_TOTAL,
+)

@@ -178,14 +178,17 @@ class SystemStatusServer:
 
 def engine_stats_prometheus(stats: Dict[str, Any]) -> str:
     """Engine stats dict → Prometheus gauges with canonical names
-    (ref: metrics/prometheus_names.rs — a single place defines the names)."""
+    (ref: metrics/prometheus_names.rs — runtime/metric_names.py is the
+    single place that defines them)."""
+    from dynamo_tpu.runtime.metric_names import engine_gauge
+
     lines = []
     for key, value in stats.items():
         if isinstance(value, dict):
             continue  # nested (kvbm) stats get their own exporter if needed
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
-        name = f"dynamo_tpu_engine_{key}"
+        name = engine_gauge(key)
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {float(value)}")
     return "\n".join(lines)

@@ -58,6 +58,40 @@ class TestReasoning:
         assert c2 == "<thi"  # not a real tag; returned verbatim
 
 
+class TestToolCallJail:
+    """Streaming tool-call holdback (parsers/jail.py; ref: jail.rs)."""
+
+    def _run(self, deltas):
+        from dynamo_tpu.parsers.jail import ToolCallJail
+
+        jail = ToolCallJail()
+        released = "".join(jail.feed(d) for d in deltas)
+        tail, jailed = jail.flush()
+        return released + tail, jailed
+
+    def test_marker_spanning_deltas_jails_everything_after(self):
+        content, jailed = self._run(
+            ["before ", "<tool", "_call>", '{"name":"f"}', "</tool_call>"]
+        )
+        assert content == "before "
+        assert jailed == '<tool_call>{"name":"f"}</tool_call>'
+
+    def test_mistral_and_dsml_markers(self):
+        for marker in ("[TOOL_CALLS]", "<｜DSML｜"):
+            content, jailed = self._run(["hi ", marker + "stuff"])
+            assert content == "hi "
+            assert jailed.startswith(marker)
+
+    def test_false_alarm_released_on_flush(self):
+        content, jailed = self._run(["half <too"])
+        assert content == "half <too"
+        assert jailed == ""
+
+    def test_plain_content_passthrough(self):
+        content, jailed = self._run(["just ", "text"])
+        assert content == "just text" and jailed == ""
+
+
 class TestGraniteReasoning:
     """ref: lib/parsers/src/reasoning/granite_parser.rs — prose markers in
     two spellings each."""

@@ -64,6 +64,125 @@ async def test_unary_reasoning_and_tool_calls():
         await service.stop(grace_period=1)
 
 
+async def test_streaming_tool_call_jail():
+    """Tool-call dialect text in a STREAM must never reach the client as
+    content — it surfaces as tool_calls deltas with finish 'tool_calls'
+    (ref: jail.rs stream rewriting)."""
+    service, port = await start(
+        ["Let me check. ", "<tool", "_call>", '{"name": "get_w',
+         'eather", "arguments": {"city": "Paris"}}', "</tool_call>"]
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "weather?"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "get_weather"}}],
+                    "stream": True,
+                },
+            )
+            content, tool_calls, finish = "", [], None
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    choice = json.loads(line[6:])["choices"][0]
+                    delta = choice["delta"]
+                    content += delta.get("content", "")
+                    tool_calls += delta.get("tool_calls", [])
+                    finish = choice.get("finish_reason") or finish
+        assert content == "Let me check. "
+        assert "<tool_call>" not in content
+        assert finish == "tool_calls"
+        assert tool_calls and tool_calls[0]["index"] == 0
+        assert tool_calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(tool_calls[0]["function"]["arguments"]) == {
+            "city": "Paris"
+        }
+    finally:
+        await service.stop(grace_period=1)
+
+
+async def test_streaming_marker_false_alarm_released():
+    """A '<tool' that never becomes a tool call must still reach the
+    client as content by stream end."""
+    service, port = await start(["a <tool", "box full of bolts"])
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "t"}}],
+                    "stream": True,
+                },
+            )
+            content = ""
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    content += json.loads(line[6:])["choices"][0]["delta"].get(
+                        "content", ""
+                    )
+        assert content == "a <toolbox full of bolts"
+    finally:
+        await service.stop(grace_period=1)
+
+
+async def test_streaming_jail_survives_missing_finish_chunk():
+    """A stream that ends WITHOUT a finish_reason item must still release
+    jailed/held-back text (the unary path defaults to EOS; streaming must
+    not eat buffered content)."""
+
+    class NoFinishPipeline(ScriptedPipeline):
+        async def generate(self, request, context):
+            yield {"annotation": "_prompt_tokens", "value": 3}
+            for i, text in enumerate(self.deltas):
+                yield PostprocessedOutput(
+                    text=text, token_ids=[i], cumulative_tokens=i + 1,
+                    finish_reason=None,
+                )
+
+    manager = ModelManager()
+    manager.register(
+        "scripted",
+        NoFinishPipeline(
+            ["ok ", '<tool_call>{"name": "f", "arguments": {}}</tool_call>']
+        ),
+        ModelDeploymentCard(name="scripted", context_length=512),
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tools": [{"type": "function", "function": {"name": "f"}}],
+                    "stream": True,
+                },
+            )
+            content, tool_calls, finish = "", [], None
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    choice = json.loads(line[6:])["choices"][0]
+                    content += choice["delta"].get("content", "")
+                    tool_calls += choice["delta"].get("tool_calls", [])
+                    finish = choice.get("finish_reason") or finish
+        assert content == "ok "
+        assert tool_calls and tool_calls[0]["function"]["name"] == "f"
+        assert finish == "tool_calls"
+    finally:
+        await service.stop(grace_period=1)
+
+
 async def test_streaming_reasoning_deltas():
     service, port = await start(
         ["<th", "ink>deep ", "thought</think>", "the answer ", "is 4"]
