@@ -69,6 +69,18 @@ class BlockPool:
         """Whether a committed block with this content hash is resident."""
         return block_hash in self._by_hash
 
+    def snapshot_committed(self):
+        """Pin EVERY committed block and return
+        [(hash, parent_hash, block_id)] — a stable view for checkpointing.
+        The caller must release(ids, hashes) (aligned) when done."""
+        out = []
+        for h, entry in self._by_hash.items():
+            if entry.ref_count == 0:
+                self._lru.pop(h, None)
+            entry.ref_count += 1
+            out.append((h, entry.parent_hash, entry.block_id))
+        return out
+
     def match_prefix(self, block_hashes: Sequence[int]) -> int:
         n = 0
         for h in block_hashes:

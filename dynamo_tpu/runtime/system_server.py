@@ -213,10 +213,31 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
     async def _clear(body: Dict[str, Any]):
         return 200, {"cleared_blocks": engine.clear_kv_blocks()}
 
+    async def _checkpoint(body: Dict[str, Any]):
+        path = body.get("path")
+        if not path:
+            return 400, {"error": "body must include 'path'"}
+        return 200, await engine.save_checkpoint(path)
+
+    async def _restore(body: Dict[str, Any]):
+        path = body.get("path")
+        if not path:
+            return 400, {"error": "body must include 'path'"}
+        try:
+            n = await engine.load_checkpoint(path)
+        except (OSError, ValueError, KeyError, IndexError) as exc:
+            # Malformed manifests surface as any of these (JSONDecodeError
+            # is a ValueError; missing fields KeyError; short data arrays
+            # IndexError) — all are bad-input 400s, not server faults.
+            return 400, {"error": repr(exc)}
+        return 200, {"restored_blocks": n}
+
     server.register_engine_route("stats", _stats)
     server.register_engine_route("sleep", _sleep)
     server.register_engine_route("wake", _wake)
     server.register_engine_route("clear_kv_blocks", _clear)
+    server.register_engine_route("checkpoint", _checkpoint)
+    server.register_engine_route("restore", _restore)
 
     def _engine_health():
         failure = getattr(engine, "_failure", None)

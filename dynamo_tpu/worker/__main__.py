@@ -87,6 +87,10 @@ async def main() -> None:
                         help="per-worker system HTTP server port "
                         "(health/metrics/engine admin/LoRAs; 0 = ephemeral; "
                         "ref: system_status_server.rs)")
+    parser.add_argument("--kv-checkpoint-dir", default=None,
+                        help="warm-cache checkpoint directory (chrek/CRIU "
+                        "role): restored at startup when present, saved on "
+                        "graceful shutdown")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -234,6 +238,15 @@ async def main() -> None:
         await register_llm(runtime, card, endpoint, instance_id)
     load_pub.start()
     await engine.start()
+    if args.kv_checkpoint_dir:
+        import os
+
+        if os.path.exists(os.path.join(args.kv_checkpoint_dir, "manifest.json")):
+            try:
+                n = await engine.load_checkpoint(args.kv_checkpoint_dir)
+                print(f"restored {n} warm KV blocks", flush=True)
+            except Exception as exc:
+                print(f"KV checkpoint restore failed: {exc}", flush=True)
     system_server = None
     if args.system_port is not None:
         from dynamo_tpu.runtime.system_server import (
@@ -253,6 +266,13 @@ async def main() -> None:
     try:
         await asyncio.Event().wait()
     finally:
+        if args.kv_checkpoint_dir and engine.pool.cached_blocks > 0:
+            # Guarded: a drained/slept worker must not clobber a previous
+            # warm checkpoint with an empty one.
+            try:
+                await engine.save_checkpoint(args.kv_checkpoint_dir)
+            except Exception:
+                pass  # shutdown best-effort; next start just runs cold
         if system_server is not None:
             await system_server.stop()
         if kvbm is not None:

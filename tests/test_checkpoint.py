@@ -1,0 +1,112 @@
+"""Engine KV checkpoint/restore (the chrek/CRIU fast-cold-start role):
+a restarted worker comes back with its prefix cache warm — same greedy
+continuation, near-zero re-prefill (ref: deploy/chrek, DynamoCheckpoint
+CRD; weights are covered separately by models/weight_cache.py)."""
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tests.test_jax_engine import make_engine, req, run_one
+
+
+async def test_checkpoint_restore_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    prompt = list(range(10, 42))  # 8 full blocks of 4
+
+    engine_a, _ = make_engine()
+    try:
+        out_a = await run_one(engine_a, req(prompt, max_tokens=5))
+        toks_a = [t for o in out_a for t in o.token_ids]
+        result = await engine_a.save_checkpoint(ckpt)
+        assert result["blocks"] > 0
+    finally:
+        await engine_a.stop()
+
+    engine_b, _ = make_engine()
+    try:
+        restored = await engine_b.load_checkpoint(ckpt)
+        assert restored == result["blocks"]
+        assert engine_b.pool.cached_blocks >= restored
+
+        out_b = await run_one(engine_b, req(prompt, max_tokens=5))
+        toks_b = [t for o in out_b for t in o.token_ids]
+        assert toks_b == toks_a  # warm blocks carry the exact same KV
+        # the shared prefix must NOT re-prefill (tail + last-token only)
+        assert engine_b.stats()["prefill_tokens"] <= len(prompt) // 2
+    finally:
+        await engine_b.stop()
+
+
+async def test_restore_rejects_mismatched_shape(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    engine_a, _ = make_engine()
+    try:
+        await run_one(engine_a, req(range(8, 24), max_tokens=3))
+        await engine_a.save_checkpoint(ckpt)
+    finally:
+        await engine_a.stop()
+
+    engine_b, _ = make_engine(block_size=8)  # different page size
+    try:
+        with pytest.raises(ValueError, match="block_size"):
+            await engine_b.load_checkpoint(ckpt)
+    finally:
+        await engine_b.stop()
+
+
+async def test_restore_skips_resident_blocks(tmp_path):
+    """Restoring twice (or over a warm engine) installs nothing new."""
+    ckpt = str(tmp_path / "ckpt")
+    prompt = list(range(50, 70))
+    engine, _ = make_engine()
+    try:
+        await run_one(engine, req(prompt, max_tokens=3))
+        await engine.save_checkpoint(ckpt)
+        assert await engine.load_checkpoint(ckpt) == 0  # all resident
+    finally:
+        await engine.stop()
+
+
+async def test_empty_checkpoint(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    engine, _ = make_engine(enable_prefix_caching=False)
+    try:
+        await run_one(engine, req(range(6, 18), max_tokens=2))
+        result = await engine.save_checkpoint(ckpt)
+        assert result["blocks"] == 0
+    finally:
+        await engine.stop()
+
+    engine2, _ = make_engine(enable_prefix_caching=False)
+    try:
+        assert await engine2.load_checkpoint(ckpt) == 0
+    finally:
+        await engine2.stop()
+
+
+async def test_checkpoint_via_system_server(tmp_path):
+    from dynamo_tpu.runtime.system_server import SystemStatusServer, attach_engine
+
+    ckpt = str(tmp_path / "ckpt")
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        await run_one(engine, req(range(30, 50), max_tokens=3))
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{server.port}/engine/checkpoint",
+                json={"path": ckpt},
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["blocks"] > 0
+            async with s.post(
+                f"http://127.0.0.1:{server.port}/engine/restore", json={}
+            ) as r:
+                assert r.status == 400  # path required
+    finally:
+        await server.stop()
+        await engine.stop()
