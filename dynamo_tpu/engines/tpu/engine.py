@@ -81,6 +81,13 @@ class JaxEngineArgs:
     # latency dominates small-model decode on TPU; stop conditions are
     # evaluated host-side at this granularity (overshoot discarded).
     decode_steps: int = 8
+    # Speculative decoding: "ngram" = prompt-lookup proposals (no draft
+    # model) verified in ONE [B, spec_k+1]-token dispatch. Greedy-only — a
+    # tick with sampling/logprobs/processor requests falls back to the
+    # fused decode path. Wins latency on extractive/repetitive outputs.
+    spec_mode: Optional[str] = None
+    spec_ngram: int = 3  # match length for the prompt-lookup proposal
+    spec_k: int = 4  # proposed tokens per verify dispatch
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -102,6 +109,10 @@ class _Sequence:
     logprob_pending: Optional[float] = None
     admission_failures: int = 0  # deterministic per-request errors (poisoned)
     hash_salt: int = 0  # adapter ⊕ multimodal content salt (prefix cache)
+    # Speculative prompt-lookup: n-gram → position AFTER its last occurrence
+    # (incrementally indexed up to ngram_upto).
+    ngram_index: Dict[tuple, int] = field(default_factory=dict)
+    ngram_upto: int = 0
 
 
 def _next_pow2(n: int) -> int:
@@ -220,6 +231,9 @@ class JaxEngine:
         self._decode_procs_fns: Dict[bool, Any] = {}
         self._step_fn_procs: Optional[Any] = None
         self._proc_state: Optional[Any] = None  # logits_process.ProcState
+        self._spec_fn: Optional[Any] = None  # speculative verify program
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -561,6 +575,9 @@ class JaxEngine:
             "generated_tokens": self.generated_tokens,
             "sleep_level": self._sleep_level,
         }
+        if self.args.spec_mode:
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
         if self.kvbm is not None:
             out["kvbm"] = self.kvbm.stats()
         return out
@@ -716,7 +733,11 @@ class JaxEngine:
                     admitted = True
                 active = any(s is not None for s in self._slots)
                 if active:
-                    await self._decode_tick()
+                    if self.args.spec_mode == "ngram":
+                        if not await self._spec_tick():
+                            await self._decode_tick()
+                    else:
+                        await self._decode_tick()
                 elif not admitted:
                     self._wake.clear()
                     try:
@@ -1157,11 +1178,12 @@ class JaxEngine:
         self._sleep_event.set()
         return True
 
-    async def _decode_tick(self) -> None:
+    def _prepare_decode(self, lookahead: int) -> "List[_Sequence]":
+        """Shared decode-tick preamble: finish cancelled/overlong sequences
+        and ensure every survivor has blocks covering the next ``lookahead``
+        positions (preempt-by-recompute when the pool is dry). Returns the
+        active sequences."""
         args = self.args
-        K = args.decode_steps
-        # Ensure every active sequence has blocks covering the next K
-        # positions; preempt (recompute later) the youngest if the pool is dry.
         for slot in range(args.max_num_seqs - 1, -1, -1):
             seq = self._slots[slot]
             if seq is None:
@@ -1173,21 +1195,153 @@ class JaxEngine:
             if pos >= args.max_model_len:
                 self._finish(seq, FinishReason.LENGTH)
                 continue
-            last_pos = min(pos + K - 1, args.max_blocks_per_seq * args.block_size - 1)
+            last_pos = min(
+                pos + lookahead - 1, args.max_blocks_per_seq * args.block_size - 1
+            )
             need_blocks = last_pos // args.block_size + 1
-            ok = True
             while len(seq.block_ids) < need_blocks:
                 b = self.pool.alloc()
                 if b is None:
                     self._preempt(seq)
-                    ok = False
                     break
                 self._block_tables[slot, len(seq.block_ids)] = b
                 seq.block_ids.append(b)
-            if not ok:
-                continue
+        return [s for s in self._slots if s is not None]
 
-        active = [s for s in self._slots if s is not None]
+    # -- speculative decoding (prompt-lookup / n-gram) ---------------------
+
+    def _propose(self, seq: _Sequence) -> List[int]:
+        """Prompt-lookup proposal: index new tokens, then continue from the
+        most recent earlier occurrence of the trailing n-gram."""
+        n = self.args.spec_ngram
+        toks = seq.all_tokens
+        # Incremental index: register every n-gram ENDING at p, excluding
+        # the final position (its continuation is what we're predicting).
+        for p in range(max(seq.ngram_upto, n - 1), len(toks) - 1):
+            seq.ngram_index[tuple(toks[p - n + 1 : p + 1])] = p + 1
+        seq.ngram_upto = max(len(toks) - 1, 0)
+        if len(toks) < n:
+            return []
+        cont = seq.ngram_index.get(tuple(toks[-n:]))
+        if cont is None:
+            return []
+        return toks[cont : cont + self.args.spec_k]
+
+    def _build_spec_fn(self):
+        cfg = self.config
+        use_kernel = self._use_kernel
+
+        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
+                 block_tables, adapter_ids):
+            logits, k_cache, v_cache = llama.forward_paged(
+                params, cfg, tokens, start_pos, chunk_lens, block_tables,
+                k_cache, v_cache, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids, all_logits=True,
+            )
+            return jnp.argmax(logits, axis=-1), k_cache, v_cache
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    def _run_spec(self, tokens, start_pos, chunk_lens, block_tables,
+                  adapter_ids) -> np.ndarray:
+        if self._spec_fn is None:
+            self._spec_fn = self._build_spec_fn()
+        toks, self._k_cache, self._v_cache = self._spec_fn(
+            self.params, self._lora, self._k_cache, self._v_cache,
+            jnp.asarray(tokens), jnp.asarray(start_pos),
+            jnp.asarray(chunk_lens), jnp.asarray(block_tables),
+            jnp.asarray(adapter_ids),
+        )
+        return np.asarray(jax.device_get(toks))
+
+    def _spec_eligible(self, active: "List[_Sequence]") -> bool:
+        for s in active:
+            sp = s.request.sampling
+            # None means DEFAULT temperature (1.0, _sampling_of) — sampled,
+            # not greedy; only an explicit temperature <= 0 qualifies.
+            temp = sp.temperature if sp.temperature is not None else 1.0
+            if temp > 0.0 or sp.logprobs is not None:
+                return False
+            if self._uses_procs[s.slot]:
+                return False
+        return True
+
+    async def _spec_tick(self) -> bool:
+        """One verify dispatch over [next_token + proposals]. Returns False
+        when this tick is ineligible or nothing proposes — the fused
+        decode_steps-per-dispatch path wins whenever speculation has no
+        candidates (a 1-token verify would cost decode_steps× the
+        dispatches)."""
+        args = self.args
+        occupied = [s for s in self._slots if s is not None]
+        if not occupied:
+            return True
+        if not self._spec_eligible(occupied):
+            return False
+        proposals: Dict[int, List[int]] = {
+            s.slot: self._propose(s) for s in occupied
+        }
+        if not any(proposals.values()):
+            return False
+
+        C = args.spec_k + 1
+        active = self._prepare_decode(C)
+        if not active:
+            return True
+        S = args.max_num_seqs
+        tokens = np.zeros((S, C), dtype=np.int32)
+        lens = np.zeros(S, dtype=np.int32)
+        max_blocks = 1
+        for seq in active:
+            slot = seq.slot
+            prop = proposals.get(slot, [])
+            # Never speculate past the model-length cap.
+            room = args.max_model_len - int(self._pos[slot]) - 1
+            prop = prop[: max(min(len(prop), room), 0)]
+            proposals[slot] = prop
+            tokens[slot, 0] = seq.next_token
+            tokens[slot, 1 : 1 + len(prop)] = prop
+            lens[slot] = 1 + len(prop)
+            max_blocks = max(
+                max_blocks,
+                (int(self._pos[slot]) + C - 1) // args.block_size + 1,
+            )
+        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
+
+        out = await self._device(
+            self._run_spec,
+            tokens,
+            self._pos.copy(),
+            lens,
+            self._block_tables[:, :nb_bucket].copy(),
+            self._adapter_ids.copy(),
+        )
+        self.steps += 1
+        for seq in list(active):
+            if seq.slot < 0:
+                continue  # finished by an earlier emit in this loop
+            slot = seq.slot
+            prop = proposals.get(slot, [])
+            row = out[slot]
+            # Accept greedy-matching proposals; the first mismatch position
+            # yields the model's own token (always ≥1 token of progress).
+            emitted = [int(row[0])]
+            for i, p in enumerate(prop):
+                if p != int(row[i]):
+                    break
+                emitted.append(int(row[i + 1]))
+            self.spec_proposed += len(prop)
+            self.spec_accepted += len(emitted) - 1
+            self._emit_burst(
+                seq, np.asarray(emitted, dtype=np.int32),
+                np.zeros(len(emitted), dtype=np.float32),
+            )
+        return True
+
+    async def _decode_tick(self) -> None:
+        args = self.args
+        K = args.decode_steps
+        active = self._prepare_decode(K)
         if not active:
             return
 

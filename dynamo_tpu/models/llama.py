@@ -157,6 +157,7 @@ def forward_paged(
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32, 0 = no adapter
     mm_embeds: Optional[jnp.ndarray] = None,  # [M, d] image patch embeddings
     mm_slot: Optional[jnp.ndarray] = None,  # [B, C] int32 row into mm_embeds, -1=text
+    all_logits: bool = False,  # True → logits for EVERY position [B, C, V]
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
     v_cache). K/V for the chunk are scattered into the pools before attending,
@@ -234,6 +235,10 @@ def forward_paged(
     )
 
     x = _rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    if all_logits:
+        # Every position's logits (speculative verify reads them all).
+        head = params["embed"].T if c.tie_word_embeddings else params["lm_head"]
+        return (x @ head).astype(jnp.float32), k_cache, v_cache
     # Only the last valid position's logits are needed (sampling).
     last_idx = jnp.clip(chunk_lens - 1, 0, C - 1)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, d]

@@ -87,6 +87,13 @@ async def main() -> None:
                         help="per-worker system HTTP server port "
                         "(health/metrics/engine admin/LoRAs; 0 = ephemeral; "
                         "ref: system_status_server.rs)")
+    parser.add_argument("--speculative", choices=["ngram"], default=None,
+                        help="speculative decoding: ngram = prompt-lookup "
+                        "proposals verified in one dispatch (greedy only)")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="proposed tokens per speculative verify step")
+    parser.add_argument("--spec-ngram", type=int, default=3,
+                        help="match length for prompt-lookup proposals")
     parser.add_argument("--kv-checkpoint-dir", default=None,
                         help="warm-cache checkpoint directory (chrek/CRIU "
                         "role): restored at startup when present, saved on "
@@ -151,6 +158,9 @@ async def main() -> None:
             enable_prefix_caching=not args.no_prefix_caching,
             decode_steps=args.decode_steps,
             lora_dir=args.lora_dir,
+            spec_mode=args.speculative,
+            spec_k=args.spec_k,
+            spec_ngram=args.spec_ngram,
         ),
         params,
         mesh=mesh,
