@@ -66,6 +66,7 @@ class ModelWatcher:
         kv_router_config: Optional[KvRouterConfig] = None,
         enable_disagg: bool = True,
         prefill_component: str = "prefill",
+        encode_component: str = "encoder",
         disagg_threshold_tokens: int = 32,
         enable_busy_monitor: bool = True,
         enable_canary: bool = False,
@@ -78,6 +79,7 @@ class ModelWatcher:
         self._kv_config = kv_router_config
         self.enable_disagg = enable_disagg
         self.prefill_component = prefill_component
+        self.encode_component = encode_component
         self.disagg_threshold_tokens = disagg_threshold_tokens
         self.enable_busy_monitor = enable_busy_monitor
         self.enable_canary = enable_canary
@@ -164,6 +166,27 @@ class ModelWatcher:
         tokenizer = resolve_tokenizer(card)
         operators = [
             OpenAIPreprocessor(card, tokenizer, resolve_chat_template(card)),
+        ]
+        if card.model_type == "multimodal":
+            # E/P/D staging: encode images via the encode component, then
+            # splice placeholders + embeddings into the preprocessed request
+            # (multimodal/handlers.py MultimodalPreprocessor, the
+            # ECProcessor role). The encode worker registers at
+            # <namespace>/<encode_component>/encode.
+            from dynamo_tpu.multimodal import MultimodalPreprocessor
+
+            mm_ns = ep_info["namespace"]
+
+            async def encode_client():
+                return await (
+                    self._runtime.namespace(mm_ns)
+                    .component(self.encode_component)
+                    .endpoint("encode")
+                    .client()
+                )
+
+            operators.append(MultimodalPreprocessor(encode_client))
+        operators += [
             Backend(tokenizer),
             Migration(card.migration_limit),
         ]

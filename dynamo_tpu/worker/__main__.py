@@ -87,6 +87,10 @@ async def main() -> None:
                         help="per-worker system HTTP server port "
                         "(health/metrics/engine admin/LoRAs; 0 = ephemeral; "
                         "ref: system_status_server.rs)")
+    parser.add_argument("--model-type", choices=["chat", "completion", "multimodal"],
+                        default="chat",
+                        help="model card type; 'multimodal' makes the "
+                        "frontend splice encode-worker embeddings (E/P/D)")
     parser.add_argument("--speculative", choices=["ngram"], default=None,
                         help="speculative decoding: ngram = prompt-lookup "
                         "proposals verified in one dispatch (greedy only)")
@@ -196,6 +200,7 @@ async def main() -> None:
 
     card = ModelDeploymentCard(
         name=name,
+        model_type=args.model_type,
         model_path=model_path,
         context_length=args.max_model_len,
         kv_block_size=args.block_size,

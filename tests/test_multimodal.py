@@ -142,6 +142,52 @@ def chat_with_image(uri, text="describe this"):
     }
 
 
+async def test_model_watcher_wires_encode_stage():
+    """A model registered with model_type='multimodal' gets the encode
+    splice in its WATCHER-built pipeline — the deployed E/P/D path, not
+    just the hand-assembled one (recipes/multimodal-epd)."""
+    from dynamo_tpu.http import ModelManager
+    from dynamo_tpu.llm.discovery import ModelWatcher, register_llm
+
+    drt = DistributedRuntime.detached()
+    enc_ep = drt.namespace("mmw").component("encoder").endpoint("encode")
+    handler = EncodeWorkerHandler(VCFG)
+    await enc_ep.serve_endpoint(handler.generate)
+
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=CFG, block_size=4, num_kv_blocks=256, max_num_seqs=4,
+            max_model_len=256, prefill_chunk=16,
+        )
+    )
+    gen_ep = drt.namespace("mmw").component("backend").endpoint("generate")
+    await gen_ep.serve_endpoint(engine.generate)
+    card = ModelDeploymentCard(
+        name="mm-watched", model_type="multimodal", context_length=256
+    )
+    await register_llm(drt, card, gen_ep, instance_id=1)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        drt, manager, enable_disagg=False, enable_busy_monitor=False,
+    )
+    await watcher.start()
+    try:
+        await watcher.wait_for_model("mm-watched")
+        entry = manager.get("mm-watched")
+        uri = encode_image_data_uri(make_image(7))
+        body = chat_with_image(uri)
+        body["model"] = "mm-watched"
+        outs = await collect(entry.engine.generate(body, Context()))
+        deltas = [o for o in outs if not isinstance(o, dict)]
+        assert not any(o.error for o in deltas), [o.error for o in deltas]
+        assert handler.encoded_images == 1  # the encode stage really ran
+        assert sum(len(o.token_ids) for o in deltas) == 6
+    finally:
+        await watcher.stop()
+        await engine.stop()
+
+
 async def test_image_steers_generation_e2e():
     pipeline, engine, handler = await _mm_pipeline()
     uri_a = encode_image_data_uri(make_image(10))

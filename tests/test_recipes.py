@@ -1,0 +1,79 @@
+"""Every recipe document must load as a GraphDeployment whose services
+resolve to runnable command lines with valid flags (recipes are the
+user-facing contract — a stale flag here is a broken quick start)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from dynamo_tpu.deploy.spec import GraphDeployment
+
+RECIPES = sorted(
+    glob.glob(
+        os.path.join(os.path.dirname(__file__), "..", "recipes", "**", "*.yaml"),
+        recursive=True,
+    )
+)
+
+
+@pytest.mark.parametrize("path", RECIPES, ids=[os.path.basename(p) for p in RECIPES])
+def test_recipe_loads_and_resolves(path):
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    graph = GraphDeployment.from_dict(doc)
+    assert graph.services, f"{path} declares no services"
+    for name, svc in graph.services.items():
+        cmd = svc.resolved_command()
+        assert cmd[0] == sys.executable and cmd[1] == "-m"
+
+
+def _flags_of(module: str):
+    """Ask the service module's argparse for its known flags (--help)."""
+    out = subprocess.run(
+        [sys.executable, "-m", module, "--help"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, f"{module} --help failed: {out.stderr[-500:]}"
+    import re
+
+    return set(re.findall(r"--[\w-]+", out.stdout))
+
+
+_FLAG_CACHE = {}
+
+
+def _assert_flags(graph: GraphDeployment, origin: str) -> None:
+    for name, svc in graph.services.items():
+        module = svc.resolved_command()[2]
+        if module not in _FLAG_CACHE:
+            _FLAG_CACHE[module] = _flags_of(module)
+        known = _FLAG_CACHE[module]
+        used = [a for a in svc.args if a.startswith("--")]
+        unknown = [f for f in used if f not in known]
+        assert not unknown, f"{origin}:{name} uses unknown flags {unknown}"
+
+
+@pytest.mark.parametrize("path", RECIPES, ids=[os.path.basename(p) for p in RECIPES])
+def test_recipe_flags_exist(path):
+    """Every --flag used in a recipe must be a real flag of its service."""
+    with open(path) as f:
+        graph = GraphDeployment.from_dict(yaml.safe_load(f))
+    _assert_flags(graph, path)
+
+
+def test_helm_chart_flags_exist():
+    """The helm chart's rendered graph obeys the same contract."""
+    from tests.test_helm_chart import CHART, _values, render
+
+    doc = yaml.safe_load(
+        render(
+            os.path.join(CHART, "templates", "graphdeployment.yaml"), _values()
+        )
+    )
+    _assert_flags(GraphDeployment.from_dict(doc), "helm-chart")
