@@ -62,7 +62,7 @@ async def run_bench():
             block_size=block_size,
             num_kv_blocks=int(os.environ.get("BENCH_KV_BLOCKS", 65536 // block_size)),
             max_num_seqs=CONCURRENCY,
-            max_model_len=512,
+            max_model_len=max(512, ISL + OSL + 64),
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
             prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 128)),
             enable_prefix_caching=True,
@@ -128,7 +128,10 @@ async def run_bench():
     print(
         json.dumps(
             {
-                "metric": "aggregated decode throughput (qwen2.5-0.5b-shape, ISL=128, OSL=64)",
+                "metric": (
+                    "aggregated decode throughput "
+                    f"(qwen2.5-0.5b-shape, ISL={ISL}, OSL={OSL})"
+                ),
                 "value": round(value, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(value / BASELINE_TOKS_PER_SEC_PER_CHIP, 4),
