@@ -100,6 +100,11 @@ class RequestTimer:
         self._last_token = now
         self._m.output_tokens.labels(self._model).inc(count)
 
+    def count_tokens(self, count: int) -> None:
+        """Output-token accounting WITHOUT latency observations — secondary
+        n>1 choice streams would corrupt TTFT/ITL with cross-stream deltas."""
+        self._m.output_tokens.labels(self._model).inc(count)
+
     def on_input_tokens(self, count: int) -> None:
         self._m.input_tokens.labels(self._model).inc(count)
 

@@ -22,9 +22,7 @@ from dynamo_tpu.llm.protocols.common import FinishReason, PostprocessedOutput
 from dynamo_tpu.llm.protocols.openai import (
     OpenAIError,
     chat_chunk,
-    chat_completion,
     completion_chunk,
-    completion_response,
     gen_id,
     model_list,
     usage_block,
@@ -436,6 +434,17 @@ class HttpService:
                 OpenAIError(f"model '{model}' not found", status=404, err_type="not_found_error")
             )
         stream = bool(body.get("stream", False))
+        try:
+            n = _parse_n(body)
+        except OpenAIError as exc:
+            return _error_response(exc)
+        if stream and n > 1:
+            return _error_response(
+                OpenAIError(
+                    "streaming with n > 1 is not supported; request unary "
+                    "or n=1", status=400,
+                )
+            )
         endpoint = "chat_completions" if kind == "chat" else "completions"
         # W3C trace propagation (ref: logging.rs:72): an incoming
         # traceparent joins the caller's trace; spans flow via baggage.
@@ -480,10 +489,13 @@ class HttpService:
 
     # -- unary -------------------------------------------------------------
 
-    async def _unary_response(
-        self, body: Dict[str, Any], entry, ctx: Context, kind: str, timer: RequestTimer
-    ) -> web.Response:
-        rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
+    async def _collect_one(
+        self, body: Dict[str, Any], entry, ctx: Context, timer: RequestTimer,
+        *, primary: bool = True,
+    ):
+        """Fold one engine stream → (text, finish, prompt_tokens,
+        completion_tokens). Only the primary stream feeds latency
+        histograms (secondary n>1 streams would corrupt TTFT/ITL)."""
         text_parts = []
         finish: Optional[FinishReason] = None
         prompt_tokens = 0
@@ -491,7 +503,6 @@ class HttpService:
         async for item in entry.engine.generate(body, ctx):
             if isinstance(item, dict) and item.get("annotation") == "_prompt_tokens":
                 prompt_tokens = item["value"]
-                timer.on_input_tokens(prompt_tokens)
                 continue
             if isinstance(item, dict):
                 continue  # other annotations are streaming-only
@@ -501,36 +512,101 @@ class HttpService:
             if out.text:
                 text_parts.append(out.text)
             if out.token_ids:
-                timer.on_token(len(out.token_ids))
+                if primary:
+                    timer.on_token(len(out.token_ids))
+                else:
+                    timer.count_tokens(len(out.token_ids))
             completion_tokens = out.cumulative_tokens or completion_tokens
             if out.finish_reason is not None:
                 finish = out.finish_reason
-        text = "".join(text_parts)
-        usage = usage_block(prompt_tokens, completion_tokens)
-        finish_str = (finish or FinishReason.EOS).to_openai()
-        if kind == "chat":
-            # Post-parse the complete message: reasoning tags and tool-call
-            # dialects (ref: lib/parsers; jail.rs does this for streams).
-            from dynamo_tpu.parsers import detect_and_parse_tool_calls, split_reasoning
+        return "".join(text_parts), finish, prompt_tokens, completion_tokens
 
-            reasoning, content = split_reasoning(
-                text, style=entry.card.reasoning_style
-            )
-            tool_calls = None
-            if body.get("tools"):
-                calls, content = detect_and_parse_tool_calls(content)
-                if calls:
-                    tool_calls = [c.to_openai() for c in calls]
-                    finish_str = "tool_calls"
-            payload = chat_completion(
-                rid, entry.name, content=content, finish_reason=finish_str,
-                usage=usage, tool_calls=tool_calls,
-                reasoning_content=reasoning or None,
-            )
+    def _chat_choice(
+        self, entry, body: Dict[str, Any], text: str, finish_str: str, index: int
+    ) -> Dict[str, Any]:
+        """Parse one completed chat message into an OpenAI choice entry
+        (reasoning tags + tool-call dialects; ref: lib/parsers)."""
+        from dynamo_tpu.parsers import detect_and_parse_tool_calls, split_reasoning
+
+        reasoning, content = split_reasoning(
+            text, style=entry.card.reasoning_style
+        )
+        message: Dict[str, Any] = {"role": "assistant", "content": content}
+        if body.get("tools"):
+            calls, content = detect_and_parse_tool_calls(content)
+            message["content"] = content
+            if calls:
+                message["tool_calls"] = [c.to_openai() for c in calls]
+                finish_str = "tool_calls"
+        if reasoning:
+            message["reasoning_content"] = reasoning
+        return {
+            "index": index,
+            "message": message,
+            "logprobs": None,
+            "finish_reason": finish_str,
+        }
+
+    async def _unary_response(
+        self, body: Dict[str, Any], entry, ctx: Context, kind: str, timer: RequestTimer
+    ) -> web.Response:
+        rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
+        n = _parse_n(body)
+        if n <= 1:
+            results = [await self._collect_one(body, entry, ctx, timer)]
         else:
-            payload = completion_response(
-                rid, entry.name, text=text, finish_reason=finish_str, usage=usage
-            )
+            # n > 1: n independent engine requests (shared-prefix prefill is
+            # served from the cache; sampling diverges per slot). OpenAI
+            # usage counts the prompt once and sums completions. Child
+            # contexts inherit the request's deadline and hard-kill.
+            contexts = [ctx.child() for _ in range(n)]
+            tasks = [
+                asyncio.ensure_future(
+                    self._collect_one(
+                        dict(body), entry, c, timer, primary=(i == 0)
+                    )
+                )
+                for i, c in enumerate(contexts)
+            ]
+            try:
+                results = await asyncio.gather(*tasks)
+            except BaseException:
+                # One choice failed/cancelled: tear the siblings down and
+                # WAIT for them — they must not outlive the tracker guard.
+                for c in contexts:
+                    c.stop_generating(reason="sibling-choice-failed")
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+        prompt_tokens = results[0][2]
+        timer.on_input_tokens(prompt_tokens)
+        completion_tokens = sum(r[3] for r in results)
+        usage = usage_block(prompt_tokens, completion_tokens)
+        text = results[0][0]  # primary choice (audit record)
+        choices = []
+        for i, (choice_text, finish, _pt, _ct) in enumerate(results):
+            finish_str = (finish or FinishReason.EOS).to_openai()
+            if kind == "chat":
+                choices.append(
+                    self._chat_choice(entry, body, choice_text, finish_str, i)
+                )
+            else:
+                choices.append(
+                    {
+                        "index": i, "text": choice_text,
+                        "logprobs": None, "finish_reason": finish_str,
+                    }
+                )
+        finish_str = choices[0]["finish_reason"]
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if kind == "chat" else "text_completion",
+            "created": int(time.time()),
+            "model": entry.name,
+            "choices": choices,
+            "usage": usage,
+        }
         timer.done(200)
         if self.audit.enabled:
             from dynamo_tpu.http.audit import AuditRecord
@@ -749,6 +825,20 @@ class HttpService:
         with _suppress_conn_errors():
             await response.write_eof()
         return response
+
+
+def _parse_n(body: Dict[str, Any]) -> int:
+    """Validated 'n' (choice count). Raises a 400 OpenAIError on junk —
+    int('two') must not surface as a 500 (or escape as a raw aiohttp page
+    on the streaming path)."""
+    raw = body.get("n", 1)
+    if raw is None:
+        return 1
+    if isinstance(raw, bool) or not isinstance(raw, int):
+        raise OpenAIError("'n' must be an integer in [1, 8]")
+    if not 1 <= raw <= 8:
+        raise OpenAIError("'n' must be an integer in [1, 8]")
+    return raw
 
 
 def _error_response(exc: OpenAIError) -> web.Response:

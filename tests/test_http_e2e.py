@@ -106,6 +106,60 @@ async def test_chat_completion_streaming_sse():
         await service.stop(grace_period=1)
 
 
+async def test_n_choices():
+    """n>1 returns n indexed choices with summed completion usage; the
+    prompt is counted once (OpenAI semantics)."""
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 6,
+                    "n": 3,
+                },
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+            assert all(
+                c["message"]["role"] == "assistant" for c in body["choices"]
+            )
+            usage = body["usage"]
+            assert usage["completion_tokens"] == 18  # 3 × 6
+            assert usage["total_tokens"] == usage["prompt_tokens"] + 18
+
+            # streaming with n>1 is rejected up front
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "n": 2,
+                    "stream": True,
+                },
+            )
+            assert r.status == 400
+            assert "n > 1" in (await r.json())["error"]["message"]
+
+            # junk n is a 400, even on the streaming path
+            for bad_n in ["two", [2], 0, 9]:
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={
+                        "model": "mock-model",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "n": bad_n,
+                        "stream": True,
+                    },
+                )
+                assert r.status == 400, bad_n
+    finally:
+        await service.stop(grace_period=1)
+
+
 async def test_completions_endpoint():
     service, engine, port = await start_service()
     try:
