@@ -46,10 +46,21 @@ async def run_bench():
         SamplingOptions,
         StopConditions,
     )
-    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.models.config import (
+        llama3_8b_config,
+        mixtral_8x7b_config,
+        qwen2_500m_config,
+    )
     from dynamo_tpu.runtime.context import Context
 
-    cfg = qwen2_500m_config()
+    # BENCH_MODEL selects the shape. llama3-8b requires BENCH_QUANT=int8 to
+    # fit the single 16 GB chip (8 GB int8 weights + KV).
+    model_name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
+    cfg = {
+        "qwen2.5-0.5b": qwen2_500m_config,
+        "llama3-8b": llama3_8b_config,
+        "mixtral-8x7b": mixtral_8x7b_config,
+    }[model_name]()
     # Measured sweep (kernel × block size × concurrency) on the real chip:
     # 128-token pages give the decode kernel large contiguous page DMAs
     # (32-token pages: 5.8k tok/s; 64: 7.0k; 128: 7.6k; 256 over-pads at
@@ -71,6 +82,9 @@ async def run_bench():
                 None if (uk := os.environ.get("BENCH_USE_KERNEL")) is None
                 else uk == "1"
             ),
+            # BENCH_QUANT=int8 → weight-only int8 (8B-class shapes fit the
+            # one 16 GB chip; see tests/test_quant.py for parity bounds).
+            quantization=os.environ.get("BENCH_QUANT") or None,
         )
     )
 
@@ -130,7 +144,7 @@ async def run_bench():
             {
                 "metric": (
                     "aggregated decode throughput "
-                    f"(qwen2.5-0.5b-shape, ISL={ISL}, OSL={OSL})"
+                    f"({cfg.name}-shape, ISL={ISL}, OSL={OSL})"
                 ),
                 "value": round(value, 2),
                 "unit": "tokens/sec/chip",

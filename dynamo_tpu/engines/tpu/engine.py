@@ -88,6 +88,10 @@ class JaxEngineArgs:
     spec_mode: Optional[str] = None
     spec_ngram: int = 3  # match length for the prompt-lookup proposal
     spec_k: int = 4  # proposed tokens per verify dispatch
+    # Weight quantization: "int8" = per-channel weight-only int8
+    # (ops/quant.py) — halves weight HBM, 8B-class models fit one v5e chip
+    # (the reference's FP8/NVFP4-checkpoint deployment lever, TPU-style).
+    quantization: Optional[str] = None
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -188,12 +192,31 @@ class JaxEngine:
             args.num_kv_blocks, args.block_size, on_event=on_kv_event
         )
 
-        if params is None:
-            params = llama.init_params(self.config, jax.random.PRNGKey(args.seed))
-        if mesh is not None:
-            params = shard_params(
-                params, llama.param_logical_axes(self.config), self.rules, mesh
+        self._param_axes = llama.param_logical_axes(self.config)
+        if args.quantization and args.quantization != "int8":
+            raise ValueError(
+                f"unsupported quantization {args.quantization!r} (int8 only)"
             )
+        if params is None:
+            if args.quantization:
+                # Random-init directly in int8 — a full-precision tree
+                # would fill HBM (8B fp ≈ a whole 16 GB chip) and fp init
+                # on the single host core takes minutes at 8B scale.
+                from dynamo_tpu.models.quantize import init_quantized_params
+
+                params = init_quantized_params(self.config, args.seed)
+            else:
+                params = llama.init_params(
+                    self.config, jax.random.PRNGKey(args.seed)
+                )
+        if args.quantization:
+            from dynamo_tpu.models.quantize import quantize_params
+
+            # Idempotent for pre-quantized checkpoints (hf_loader/weight
+            # cache quantize host-side); rebuilds the axes tree either way.
+            params, self._param_axes = quantize_params(params, self._param_axes)
+        if mesh is not None:
+            params = shard_params(params, self._param_axes, self.rules, mesh)
         self.params = params
         self._k_cache, self._v_cache = self._alloc_kv_cache()
         # Sleep/wake (ref: vllm handlers.py sleep :286 / wake_up :317 — RL
@@ -651,8 +674,7 @@ class JaxEngine:
             self._host_params = None
             if self.mesh is not None:
                 params = shard_params(
-                    params, llama.param_logical_axes(self.config),
-                    self.rules, self.mesh,
+                    params, self._param_axes, self.rules, self.mesh
                 )
             else:
                 params = jax.tree_util.tree_map(jnp.asarray, params)

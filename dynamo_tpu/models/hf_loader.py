@@ -37,61 +37,126 @@ _HF_LAYER_MAP = {
 
 def _open_safetensors(model_dir: str):
     """Yield (name, numpy array) for every tensor in the checkpoint."""
-    from safetensors import safe_open  # lazy: not needed for random-init paths
-
-    index_path = os.path.join(model_dir, "model.safetensors.index.json")
-    if os.path.exists(index_path):
-        with open(index_path) as f:
-            index = json.load(f)
-        files = sorted(set(index["weight_map"].values()))
-    else:
-        files = [
-            f for f in sorted(os.listdir(model_dir)) if f.endswith(".safetensors")
-        ]
-    for fname in files:
-        with safe_open(os.path.join(model_dir, fname), framework="numpy") as f:
-            for name in f.keys():
-                yield name, f.get_tensor(name)
+    reader = _SafetensorsReader(model_dir)
+    for name in reader.names():
+        yield name, reader.get(name)
 
 
-def load_hf_checkpoint(model_dir: str, config: ModelConfig) -> Dict[str, Any]:
-    """Build the param pytree from a local HF model directory."""
+class _SafetensorsReader:
+    """Lazy per-tensor access across a (possibly sharded) checkpoint.
+
+    Tensors are fetched on demand so peak host memory during load is one
+    tensor, not the whole checkpoint (load_hf_checkpoint walks layer by
+    layer and devices-put or quantizes each before touching the next)."""
+
+    def __init__(self, model_dir: str) -> None:
+        from safetensors import safe_open  # lazy: unused by random-init paths
+
+        self._open = safe_open
+        self._dir = model_dir
+        self._by_name: Dict[str, str] = {}  # tensor name → file path
+        self._handles: Dict[str, Any] = {}
+        index_path = os.path.join(model_dir, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                index = json.load(f)
+            files = sorted(set(index["weight_map"].values()))
+        else:
+            files = [
+                f for f in sorted(os.listdir(model_dir))
+                if f.endswith(".safetensors")
+            ]
+        for fname in files:
+            path = os.path.join(model_dir, fname)
+            self._handles[fname] = self._open(path, framework="numpy")
+            for name in self._handles[fname].keys():
+                self._by_name[name] = fname
+
+    def names(self):
+        return list(self._by_name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def get(self, name: str) -> np.ndarray:
+        return self._handles[self._by_name[name]].get_tensor(name)
+
+
+def load_hf_checkpoint(
+    model_dir: str, config: ModelConfig, *, quantization: str | None = None
+) -> Dict[str, Any]:
+    """Build the param pytree from a local HF model directory.
+
+    ``quantization="int8"``: each matmul weight is quantized PER LAYER on
+    the host (numpy) before stacking/device-put, so full-precision weights
+    never reach HBM — peak device memory is the int8 tree, peak host memory
+    one fp32 layer tensor. Per-layer quantization is bit-identical to
+    quantizing the stacked tensor (scales never span the layer axis).
+    """
+    from dynamo_tpu.models.quantize import _LAYER_CONTRACT, _TOP_CONTRACT
+    from dynamo_tpu.ops.quant import quantize_q8
+
+    if quantization not in (None, "int8"):
+        raise ValueError(f"unsupported quantization {quantization!r}")
     c = config
-    raw: Dict[str, np.ndarray] = {}
-    for name, tensor in _open_safetensors(model_dir):
-        raw[name] = tensor
+    raw = _SafetensorsReader(model_dir)
 
     def get(name: str) -> np.ndarray:
         for prefix in ("model.", ""):
             if prefix + name in raw:
-                return raw[prefix + name]
+                return raw.get(prefix + name)
         raise KeyError(f"missing tensor {name!r} in {model_dir}")
 
-    def to_jnp(a: np.ndarray, transpose: bool) -> jnp.ndarray:
+    def to_np(a: np.ndarray, transpose: bool) -> np.ndarray:
         if a.dtype == np.uint16:  # bf16 stored raw
-            a = a.view(np.uint16)
-            out = jnp.asarray(a).view(jnp.bfloat16)
-        else:
-            out = jnp.asarray(a)
+            import ml_dtypes
+
+            a = a.view(ml_dtypes.bfloat16)
         if transpose:
-            out = out.T
-        return out.astype(c.dtype)
+            a = a.T
+        return a
+
+    def to_jnp(a: np.ndarray, transpose: bool) -> jnp.ndarray:
+        return jnp.asarray(to_np(a, transpose)).astype(c.dtype)
 
     layer_names = list(_HF_LAYER_MAP)
     if not c.qkv_bias:
         layer_names = [n for n in layer_names if not n.startswith("b")]
-    layers: Dict[str, List[jnp.ndarray]] = {n: [] for n in layer_names}
+    layers: Dict[str, List[Any]] = {n: [] for n in layer_names}
     for i in range(c.n_layers):
         for ours, (suffix, transpose) in _HF_LAYER_MAP.items():
             if ours not in layers:
                 continue
-            layers[ours].append(to_jnp(get(f"layers.{i}.{suffix}"), transpose))
+            a = to_np(get(f"layers.{i}.{suffix}"), transpose)
+            if quantization and ours in _LAYER_CONTRACT:
+                # stacked contract axis minus the leading L axis
+                layers[ours].append(
+                    quantize_q8(np.asarray(a), (_LAYER_CONTRACT[ours] - 1,))
+                )
+            else:
+                layers[ours].append(jnp.asarray(a).astype(c.dtype))
+
+    def stack(name: str, leaves: List[Any]) -> Any:
+        if leaves and isinstance(leaves[0], dict):
+            return {
+                "q8": jnp.asarray(np.stack([l["q8"] for l in leaves])),
+                "s": jnp.asarray(np.stack([l["s"] for l in leaves])),
+            }
+        return jnp.stack(leaves)
+
+    def top(name: str, a: np.ndarray, transpose: bool) -> Any:
+        if quantization and name in _TOP_CONTRACT:
+            q = quantize_q8(
+                np.asarray(to_np(a, transpose)), (_TOP_CONTRACT[name],)
+            )
+            return {"q8": jnp.asarray(q["q8"]), "s": jnp.asarray(q["s"])}
+        return to_jnp(a, transpose)
 
     params: Dict[str, Any] = {
-        "embed": to_jnp(get("embed_tokens.weight"), False),
-        "layers": {n: jnp.stack(v) for n, v in layers.items()},
+        "embed": top("embed", get("embed_tokens.weight"), False),
+        "layers": {n: stack(n, v) for n, v in layers.items()},
         "final_norm": to_jnp(get("norm.weight"), False),
     }
     if not c.tie_word_embeddings:
-        params["lm_head"] = to_jnp(raw["lm_head.weight"], True)
+        params["lm_head"] = top("lm_head", get("lm_head.weight"), True)
     return params

@@ -25,6 +25,7 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
 from dynamo_tpu.ops.lora import lora_delta
 from dynamo_tpu.ops.moe import moe_ffn
+from dynamo_tpu.ops.quant import embed_lookup, lm_head as q_lm_head, qeinsum
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
 Params = Dict[str, Any]
@@ -171,7 +172,7 @@ def forward_paged(
     B, C = tokens.shape
     hd = c.head_dim_
 
-    x = params["embed"][tokens]  # [B, C, d]
+    x = embed_lookup(params["embed"], tokens, c.dtype)  # [B, C, d]
     if mm_embeds is not None and mm_slot is not None:
         # Multimodal splice: placeholder positions take precomputed image
         # embeddings instead of the token table (multimodal/handlers.py).
@@ -185,9 +186,9 @@ def forward_paged(
         x = carry
         lp, k_c, v_c, ll = xs
         h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
-        q = jnp.einsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
-        k = jnp.einsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
-        v = jnp.einsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
+        q = qeinsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
+        k = qeinsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
+        v = qeinsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
         if c.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -204,7 +205,9 @@ def forward_paged(
         attn = paged_attention(
             q, k_c, v_c, block_tables, start_pos, chunk_lens, use_kernel=use_kernel
         ).reshape(B, C, -1)
-        x = x + attn @ lp["wo"] + lora_delta(ll, "wo", attn, adapter_ids)
+        x = x + qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
+            ll, "wo", attn, adapter_ids
+        )
 
         h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
         if c.is_moe:
@@ -216,16 +219,16 @@ def forward_paged(
             )
         else:
             gate = jax.nn.silu(
-                jnp.einsum("bcd,df->bcf", h, lp["w_gate"])
+                qeinsum("bcd,df->bcf", h, lp["w_gate"])
                 + lora_delta(ll, "w_gate", h, adapter_ids)
             )
-            up = jnp.einsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
+            up = qeinsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
                 ll, "w_up", h, adapter_ids
             )
             gu = gate * up
             x = (
                 x
-                + jnp.einsum("bcf,fd->bcd", gu, lp["w_down"])
+                + qeinsum("bcf,fd->bcd", gu, lp["w_down"])
                 + lora_delta(ll, "w_down", gu, adapter_ids)
             )
         return x, (k_c, v_c)
@@ -235,18 +238,19 @@ def forward_paged(
     )
 
     x = _rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
     if all_logits:
         # Every position's logits (speculative verify reads them all).
-        head = params["embed"].T if c.tie_word_embeddings else params["lm_head"]
-        return (x @ head).astype(jnp.float32), k_cache, v_cache
+        return (
+            q_lm_head(x, head, tied=c.tie_word_embeddings),
+            k_cache,
+            v_cache,
+        )
     # Only the last valid position's logits are needed (sampling).
     last_idx = jnp.clip(chunk_lens - 1, 0, C - 1)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, d]
-    if c.tie_word_embeddings:
-        logits = x_last @ params["embed"].T
-    else:
-        logits = x_last @ params["lm_head"]
-    return logits.astype(jnp.float32), k_cache, v_cache
+    logits = q_lm_head(x_last, head, tied=c.tie_word_embeddings)
+    return logits, k_cache, v_cache
 
 
 def encode(
@@ -261,16 +265,16 @@ def encode(
     c = config
     B, T = tokens.shape
     hd = c.head_dim_
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, c.dtype)
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)
 
     def layer_fn(carry, lp):
         x = carry
         h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
-        q = jnp.einsum("btd,dh->bth", h, lp["wq"])
-        k = jnp.einsum("btd,dh->bth", h, lp["wk"])
-        v = jnp.einsum("btd,dh->bth", h, lp["wv"])
+        q = qeinsum("btd,dh->bth", h, lp["wq"])
+        k = qeinsum("btd,dh->bth", h, lp["wk"])
+        v = qeinsum("btd,dh->bth", h, lp["wv"])
         if c.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = apply_rope(q.reshape(B, T, c.n_heads, hd), cos, sin)
@@ -288,7 +292,7 @@ def encode(
         s = jnp.where(causal[None, None] & valid[:, None], s, -1e30)
         attn = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vf)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1).astype(x.dtype)
-        x = x + attn @ lp["wo"]
+        x = x + qeinsum("bth,hd->btd", attn, lp["wo"])
         h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
         if c.is_moe:
             x = x + moe_ffn(
@@ -298,9 +302,9 @@ def encode(
                 norm_topk_prob=c.norm_topk_prob,
             )
         else:
-            gate = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["w_gate"]))
-            up = jnp.einsum("btd,df->btf", h, lp["w_up"])
-            x = x + jnp.einsum("btf,fd->btd", gate * up, lp["w_down"])
+            gate = jax.nn.silu(qeinsum("btd,df->btf", h, lp["w_gate"]))
+            up = qeinsum("btd,df->btf", h, lp["w_up"])
+            x = x + qeinsum("btf,fd->btd", gate * up, lp["w_down"])
         return x, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])

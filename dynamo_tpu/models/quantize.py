@@ -1,0 +1,146 @@
+"""Checkpoint quantization: fp params pytree → int8 weight-only pytree.
+
+Walks the llama-family param tree (models/llama.py layout) and replaces each
+matmul weight with the ``{"q8", "s"}`` pair from ops/quant.py; norms, biases
+and the (tiny, precision-sensitive) MoE router stay in the original dtype.
+The logical-axes tree is transformed in lockstep so parallel/sharding.py
+rules apply unchanged — the scale inherits the weight's axes with the
+contracted axis mapped to None (size 1 after keepdims).
+
+Reference parity: quantized-checkpoint serving (ref:
+recipes/llama-3-70b/README.md:7-11 FP8, docs/performance/tuning.md:50-57).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.quant import is_q8, quantize_q8
+
+# weight name → contracted axis (in the STACKED [L, ...] layout for layer
+# weights; top-level weights as stored).
+_LAYER_CONTRACT = {
+    "wq": 1, "wk": 1, "wv": 1, "wo": 1,
+    "w_gate": 1, "w_up": 1, "w_down": 1,
+    "we_gate": 2, "we_up": 2, "we_down": 2,
+}
+_TOP_CONTRACT = {"embed": 1, "lm_head": 0}
+
+
+def is_quantized(params: Any) -> bool:
+    return any(
+        is_q8(leaf)
+        for leaf in jax.tree.leaves(params, is_leaf=is_q8)
+        if isinstance(leaf, dict)
+    )
+
+
+def quantize_params(
+    params: Dict[str, Any], param_axes: Optional[Dict[str, Any]] = None
+) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]]]:
+    """Quantize a llama-family param tree (+ its logical-axes tree).
+
+    Returns ``(qparams, qaxes)``; ``qaxes`` is None when ``param_axes`` is.
+    Idempotent: already-quantized leaves pass through.
+    """
+    qparams: Dict[str, Any] = {}
+    qaxes: Optional[Dict[str, Any]] = {} if param_axes is not None else None
+
+    def put(dst, dst_axes, name, w, axes, contract):
+        if contract is None:
+            dst[name] = w
+            if dst_axes is not None:
+                dst_axes[name] = axes
+            return
+        # Idempotent: a pre-quantized leaf (e.g. loaded from the int8 weight
+        # cache) passes through but still gets the {"q8","s"} axes pair.
+        dst[name] = w if is_q8(w) else quantize_q8(w, (contract,))
+        if dst_axes is not None:
+            dst_axes[name] = {
+                "q8": axes,
+                "s": tuple(
+                    None if i == contract else ax for i, ax in enumerate(axes)
+                ),
+            }
+
+    for name, w in params.items():
+        axes = param_axes[name] if param_axes is not None else None
+        if name == "layers" and isinstance(w, dict):
+            qlayers: Dict[str, Any] = {}
+            qlaxes: Optional[Dict[str, Any]] = {} if qaxes is not None else None
+            for lname, lw in w.items():
+                put(
+                    qlayers, qlaxes, lname, lw,
+                    axes[lname] if axes is not None else None,
+                    _LAYER_CONTRACT.get(lname),
+                )
+            qparams[name] = qlayers
+            if qaxes is not None:
+                qaxes[name] = qlaxes
+        else:
+            put(qparams, qaxes, name, w, axes, _TOP_CONTRACT.get(name))
+    return qparams, qaxes
+
+
+def init_quantized_params(config: ModelConfig, seed: int = 0) -> Dict[str, Any]:
+    """Random-init DIRECTLY in int8 — no full-precision tree ever exists.
+
+    For benchmarks/tests on random weights (weights don't affect
+    throughput): int8 codes are drawn uniform in [-127, 127] host-side
+    (orders of magnitude faster than fp normal init on one CPU core) and
+    the per-channel scale is set so the dequantized std matches
+    models/llama.py init_params' He-style scaling (uniform int8 std ≈ 73.3).
+    Norms/biases/router stay fp as in quantize_params.
+    """
+    c = config
+    rng = np.random.default_rng(seed)
+    hd = c.head_dim_
+    L, d, ff, H, KH = c.n_layers, c.d_model, c.d_ff, c.n_heads, c.n_kv_heads
+    _INT8_STD = 73.3
+
+    def q(shape, target_std, contract_axis):
+        codes = rng.integers(-127, 128, size=shape, dtype=np.int8)
+        s_shape = tuple(1 if i == contract_axis else n for i, n in enumerate(shape))
+        scale = np.full(s_shape, target_std / _INT8_STD, dtype=np.float32)
+        return {"q8": jnp.asarray(codes), "s": jnp.asarray(scale)}
+
+    def fp(shape, fill=1.0):
+        return jnp.full(shape, fill, dtype=c.dtype)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": fp((L, d)),
+        "wq": q((L, d, H * hd), d**-0.5, 1),
+        "wk": q((L, d, KH * hd), d**-0.5, 1),
+        "wv": q((L, d, KH * hd), d**-0.5, 1),
+        "wo": q((L, H * hd, d), (H * hd) ** -0.5, 1),
+        "mlp_norm": fp((L, d)),
+    }
+    if c.is_moe:
+        E, eff = c.n_experts, c.moe_d_ff_
+        layers["router_w"] = jnp.asarray(
+            rng.normal(0, d**-0.5, size=(L, d, E)).astype(np.float32)
+        ).astype(c.dtype)
+        layers["we_gate"] = q((L, E, d, eff), d**-0.5, 2)
+        layers["we_up"] = q((L, E, d, eff), d**-0.5, 2)
+        layers["we_down"] = q((L, E, eff, d), eff**-0.5, 2)
+    else:
+        layers["w_gate"] = q((L, d, ff), d**-0.5, 1)
+        layers["w_up"] = q((L, d, ff), d**-0.5, 1)
+        layers["w_down"] = q((L, ff, d), ff**-0.5, 1)
+    if c.qkv_bias:
+        layers["bq"] = fp((L, H * hd), 0.0)
+        layers["bk"] = fp((L, KH * hd), 0.0)
+        layers["bv"] = fp((L, KH * hd), 0.0)
+    params: Dict[str, Any] = {
+        "embed": q((c.vocab_size, d), 1.0, 1),
+        "layers": layers,
+        "final_norm": fp((d,)),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = q((d, c.vocab_size), d**-0.5, 0)
+    return params

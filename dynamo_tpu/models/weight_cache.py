@@ -123,18 +123,21 @@ def load_checkpoint_cached(
     config: ModelConfig,
     *,
     cache_dir: str = DEFAULT_CACHE_DIR,
+    quantization: str | None = None,
 ) -> Tuple[Dict[str, Any], bool]:
     """HF checkpoint → engine pytree, through the restart cache.
 
+    Quantized loads cache the QUANTIZED tree under a distinct key — restarts
+    skip requantization and the cache holds int8 (half the disk).
     Returns (params, was_cache_hit)."""
-    key = _fingerprint(model_dir, config)
+    key = _fingerprint(model_dir, config) + (f"-{quantization}" if quantization else "")
     cached = load_params(cache_dir, key)
     if cached is not None:
         logger.info("weight cache hit for %s", model_dir)
         return cached, True
     from dynamo_tpu.models.hf_loader import load_hf_checkpoint
 
-    params = load_hf_checkpoint(model_dir, config)
+    params = load_hf_checkpoint(model_dir, config, quantization=quantization)
     try:
         save_params(cache_dir, key, params)
     except OSError:

@@ -23,6 +23,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.quant import qeinsum
+
 
 def moe_capacity(n_tokens: int, n_experts: int, top_k: int, capacity_factor: float) -> int:
     return max(int(math.ceil(n_tokens * top_k / n_experts * capacity_factor)), 1)
@@ -73,11 +75,11 @@ def moe_ffn(
     ).sum(1)[..., :cap]  # [S, E, cap]
     dispatch = (combine > 0).astype(x.dtype)
 
-    # -- expert compute ----------------------------------------------------
+    # -- expert compute (weights may be int8-quantized, ops/quant.py) ------
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, xs)  # [E, cap, d]
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, we_gate))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, we_up)
-    out = jnp.einsum("ecf,efd->ecd", gate * up, we_down)  # [E, cap, d]
+    gate = jax.nn.silu(qeinsum("ecd,edf->ecf", expert_in, we_gate))
+    up = qeinsum("ecd,edf->ecf", expert_in, we_up)
+    out = qeinsum("ecf,efd->ecd", gate * up, we_down)  # [E, cap, d]
 
     y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out)
     return y.reshape(B, C, d)

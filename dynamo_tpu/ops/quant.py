@@ -1,0 +1,115 @@
+"""Int8 weight-only quantization ops (TPU-native).
+
+Per-output-channel symmetric int8: each weight is stored as
+``{"q8": int8 tensor, "s": float32 scale}`` where the scale is the absmax
+over the *contracted* (input) axes divided by 127, kept with ``keepdims`` so
+the pair shards under exactly the original weight's logical axes (the
+contracted axis collapses to size 1 → trivially replicable).
+
+The matmul itself stays on the MXU in the activation dtype: the int8
+weight is upcast in-register (XLA fuses the convert into the dot's operand
+read) and the per-output-channel scale multiplies the *result* — exact up to
+weight rounding. The win is HBM: weight bytes halve vs bf16, which is the
+whole game for bandwidth-bound decode, and an 8B-class model fits a single
+16 GB v5e chip with room for KV.
+
+Reference parity: the reference serves FP8/NVFP4 checkpoints through its
+engines (ref: recipes/llama-3-70b/README.md:7-11 FP8 shapes,
+docs/performance/tuning.md:50-57 NVFP4 capacity table); int8 weight-only
+with XLA-fused dequant is the TPU-idiomatic equivalent deployment lever.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+QTensor = Dict[str, jnp.ndarray]  # {"q8": int8, "s": float32 keepdims}
+MaybeQ = Union[jnp.ndarray, QTensor]
+
+
+def quantize_q8(w: Any, contract_axes: Sequence[int]) -> QTensor:
+    """Symmetric per-output-channel int8 over the given contracted axes.
+
+    Numpy input → numpy output (host-side quantization: checkpoint loading
+    quantizes per-layer on the host so full-precision weights never touch
+    HBM); jax input → jax output on the input's device.
+    """
+    if isinstance(w, np.ndarray):
+        wf = np.asarray(w, dtype=np.float32)
+        amax = np.max(np.abs(wf), axis=tuple(contract_axes), keepdims=True)
+        s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.rint(wf / s), -127, 127).astype(np.int8)
+        return {"q8": q, "s": s}
+    wf = jnp.asarray(w, dtype=jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=tuple(contract_axes), keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q8": q, "s": s.astype(jnp.float32)}
+
+
+def is_q8(w: Any) -> bool:
+    return isinstance(w, dict) and "q8" in w
+
+
+def dequantize(w: MaybeQ, dtype: Any = jnp.float32) -> jnp.ndarray:
+    if not is_q8(w):
+        return jnp.asarray(w, dtype=dtype)
+    return (w["q8"].astype(jnp.float32) * w["s"]).astype(dtype)
+
+
+def qeinsum(spec: str, x: jnp.ndarray, w: MaybeQ) -> jnp.ndarray:
+    """``jnp.einsum(spec, x, w)`` where ``w`` may be int8-quantized.
+
+    Quantized path: einsum on the raw int8 codes upcast to ``x.dtype``
+    (fused by XLA into the dot), then multiply by the per-output-channel
+    scale broadcast into the output layout.
+    """
+    if not is_q8(w):
+        return jnp.einsum(spec, x, w)
+    lhs, out = spec.split("->")
+    w_labels = lhs.split(",")[1]
+    q, s = w["q8"], w["s"]
+    contracted = [lbl for lbl in w_labels if lbl not in out]
+    kept = [lbl for lbl in w_labels if lbl in out]
+    # Scale broadcasting relies on w's kept labels appearing in the output
+    # in the same relative order (true for every weight layout here).
+    assert kept == [lbl for lbl in out if lbl in w_labels], (
+        f"qeinsum: weight output labels reordered in {spec!r}"
+    )
+    y = jnp.einsum(spec, x, q.astype(x.dtype))
+    # Build the scale's output-aligned shape: kept w dims, 1 elsewhere.
+    sizes = {lbl: q.shape[i] for i, lbl in enumerate(w_labels)}
+    s_kept = jnp.squeeze(
+        s, axis=tuple(i for i, lbl in enumerate(w_labels) if lbl in contracted)
+    )
+    s_out = s_kept.reshape([sizes[lbl] if lbl in kept else 1 for lbl in out])
+    return (y.astype(jnp.float32) * s_out).astype(y.dtype)
+
+
+def embed_lookup(embed: MaybeQ, tokens: jnp.ndarray, dtype: Any) -> jnp.ndarray:
+    """Embedding-table row gather; rows dequantized by their per-row scale."""
+    if not is_q8(embed):
+        return embed[tokens]
+    rows = embed["q8"][tokens].astype(jnp.float32)  # [..., d]
+    return (rows * embed["s"][tokens]).astype(dtype)  # s[tokens]: [..., 1]
+
+
+def lm_head(x: jnp.ndarray, w: MaybeQ, *, tied: bool) -> jnp.ndarray:
+    """Project hidden states to vocab logits.
+
+    ``tied``: w is the embedding table [V, d] (scale per vocab row [V, 1]);
+    otherwise w is lm_head [d, V] (scale [1, V]). Returns float32 logits
+    with x's leading dims.
+    """
+    if not is_q8(w):
+        h = w.T if tied else w
+        return (x @ h).astype(jnp.float32)
+    q, s = w["q8"], w["s"]
+    if tied:
+        y = x @ q.astype(x.dtype).T  # [..., V]
+        return y.astype(jnp.float32) * s[:, 0]
+    y = x @ q.astype(x.dtype)
+    return y.astype(jnp.float32) * s[0]

@@ -102,6 +102,10 @@ async def main() -> None:
                         help="warm-cache checkpoint directory (chrek/CRIU "
                         "role): restored at startup when present, saved on "
                         "graceful shutdown")
+    parser.add_argument("--quantization", choices=["int8"], default=None,
+                        help="weight-only quantization (int8: per-channel, "
+                        "halves weight HBM — the FP8-checkpoint deployment "
+                        "lever, TPU-style)")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -137,6 +141,7 @@ async def main() -> None:
         params, cache_hit = load_checkpoint_cached(
             args.model, model_config,
             cache_dir=args.weight_cache_dir or DEFAULT_CACHE_DIR,
+            quantization=args.quantization,
         )
         print(f"weights loaded (cache {'hit' if cache_hit else 'miss'})", flush=True)
 
@@ -165,6 +170,7 @@ async def main() -> None:
             spec_mode=args.speculative,
             spec_k=args.spec_k,
             spec_ngram=args.spec_ngram,
+            quantization=args.quantization,
         ),
         params,
         mesh=mesh,

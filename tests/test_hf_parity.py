@@ -234,3 +234,48 @@ async def test_http_serves_real_checkpoint(tmp_path):
     # DecodeStream withholds trailing incomplete UTF-8 (U+FFFD) at flush;
     # normalize the reference the same way before comparing.
     assert text == tok.decode(ref_ids).rstrip("�")
+
+
+def test_int8_checkpoint_load_logits_close(tmp_path):
+    """hf_loader quantization="int8": host-side per-layer quantization must
+    land within int8 rounding of the fp32 logits, and the int8 weight cache
+    must round-trip the quantized tree bit-exactly."""
+    from dynamo_tpu.models.quantize import is_quantized
+    from dynamo_tpu.models.weight_cache import load_checkpoint_cached
+
+    model_dir, hf = _make_llama_dir(tmp_path)
+    config = _our_config(model_dir)
+    prompt = [3, 17, 42, 99, 5, 250, 11, 64]
+
+    qparams = load_hf_checkpoint(str(model_dir), config, quantization="int8")
+    assert is_quantized(qparams)
+    k, v = llama.init_kv_cache(config, 16, 4)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :4] = [1, 2, 3, 4]
+    args = (
+        jnp.asarray([prompt], dtype=jnp.int32),
+        jnp.zeros(1, jnp.int32),
+        jnp.asarray([len(prompt)], dtype=jnp.int32),
+        jnp.asarray(table),
+    )
+    logits, _, _ = llama.forward_paged(qparams, config, *args, k, v)
+    with torch.no_grad():
+        ref = hf(torch.tensor([prompt])).logits[0, -1].numpy()
+    rel = np.max(np.abs(np.asarray(logits[0]) - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.06, rel
+
+    # cache round-trip: second load hits the int8 cache, same tree
+    cache_dir = str(tmp_path / "wcache")
+    p1, hit1 = load_checkpoint_cached(
+        str(model_dir), config, cache_dir=cache_dir, quantization="int8"
+    )
+    p2, hit2 = load_checkpoint_cached(
+        str(model_dir), config, cache_dir=cache_dir, quantization="int8"
+    )
+    assert not hit1 and hit2
+    import jax
+
+    assert jax.tree.all(jax.tree.map(lambda a, b: bool(jnp.all(a == b)), p1, p2))
+    # fp cache key unaffected
+    pf, hitf = load_checkpoint_cached(str(model_dir), config, cache_dir=cache_dir)
+    assert not hitf and not is_quantized(pf)
