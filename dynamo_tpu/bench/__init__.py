@@ -1,0 +1,25 @@
+"""Benchmarking: AIPerf-style load generation against live endpoints.
+
+Reference parity: benchmarks/ + docs/benchmarks/benchmarking.md — the
+reference ships a benchmarking harness as a first-class component; here it
+is `python -m dynamo_tpu.bench` (loadgen.py) driving any OpenAI-compatible
+frontend (ours or not) with fixed ISL/OSL/concurrency workloads.
+"""
+
+from dynamo_tpu.bench.loadgen import (
+    LoadReport,
+    RequestResult,
+    WorkloadSpec,
+    reports_to_markdown,
+    run_load,
+    run_sweep,
+)
+
+__all__ = [
+    "LoadReport",
+    "RequestResult",
+    "WorkloadSpec",
+    "reports_to_markdown",
+    "run_load",
+    "run_sweep",
+]

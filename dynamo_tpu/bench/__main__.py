@@ -1,0 +1,61 @@
+"""CLI: `python -m dynamo_tpu.bench --url http://HOST:PORT --model NAME ...`
+
+Fixed ISL/OSL workload against an OpenAI-compatible frontend; pass several
+--concurrency values for a sweep. One JSON line per run on stdout;
+--markdown prints the sweep table afterwards (the tuning-guide shape).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from dynamo_tpu.bench.loadgen import (
+    WorkloadSpec,
+    reports_to_markdown,
+    run_sweep,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dynamo_tpu.bench",
+        description="AIPerf-style ISL/OSL/concurrency load generator",
+    )
+    parser.add_argument("--url", default="http://127.0.0.1:8080")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--isl", type=int, default=128)
+    parser.add_argument("--osl", type=int, default=64)
+    parser.add_argument(
+        "--concurrency", type=int, nargs="+", default=[8],
+        help="one value per sweep point",
+    )
+    parser.add_argument("--requests", type=int, default=32,
+                        help="measured requests per sweep point")
+    parser.add_argument("--warmup", type=int, default=0)
+    parser.add_argument("--prefix-len", type=int, default=0,
+                        help="shared prompt prefix tokens (prefix-cache hit path)")
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--markdown", action="store_true",
+                        help="print the sweep as a markdown table too")
+    args = parser.parse_args(argv)
+
+    spec = WorkloadSpec(
+        model=args.model, isl=args.isl, osl=args.osl,
+        requests=args.requests, warmup_requests=args.warmup,
+        prefix_len=args.prefix_len, vocab=args.vocab,
+        temperature=args.temperature, seed=args.seed,
+    )
+    reports = asyncio.run(run_sweep(args.url, spec, args.concurrency))
+    for rep in reports:
+        print(rep.to_json_line(), flush=True)
+    if args.markdown:
+        print(reports_to_markdown(reports))
+    return 1 if any(r.errors == len(r.results) for r in reports) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

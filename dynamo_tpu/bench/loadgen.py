@@ -1,0 +1,242 @@
+"""AIPerf-style load generator for OpenAI-compatible endpoints.
+
+Reference parity: the reference benchmarks with AIPerf — fixed ISL/OSL
+workloads swept over concurrency, reporting tokens/sec, TTFT and ITL
+percentiles (ref: docs/benchmarks/benchmarking.md, benchmarks/ — the
+methodology BASELINE.md prescribes). This is the in-tree equivalent: an
+asyncio client driving `/v1/completions` with pre-tokenized prompts
+(exact ISL), ``nvext.ignore_eos`` pinning OSL, and optional shared prefixes
+to exercise KV-aware routing.
+
+Measurement model: one streaming request per in-flight slot; TTFT = first
+SSE data chunk, ITL = gaps between subsequent chunks (chunk == one engine
+emission — with burst token emission a chunk can carry several tokens, the
+same granularity a user perceives).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class WorkloadSpec:
+    """Fixed ISL/OSL/concurrency workload (the AIPerf triple)."""
+
+    model: str
+    isl: int = 128
+    osl: int = 64
+    concurrency: int = 8
+    requests: int = 32
+    prefix_len: int = 0  # shared prompt prefix (prefix-cache/router overlap)
+    vocab: int = 256  # token ids drawn from [1, vocab)
+    temperature: float = 0.0
+    seed: int = 0
+    warmup_requests: int = 0  # sent before the measured window, not recorded
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    ttft_ms: float = 0.0
+    itls_ms: List[float] = field(default_factory=list)
+    latency_ms: float = 0.0
+    chunks: int = 0
+    text_len: int = 0
+    error: Optional[str] = None
+
+
+@dataclass
+class LoadReport:
+    spec: WorkloadSpec
+    wall_s: float
+    results: List[RequestResult]
+
+    @property
+    def ok_results(self) -> List[RequestResult]:
+        return [r for r in self.results if r.ok]
+
+    @property
+    def errors(self) -> int:
+        return sum(1 for r in self.results if not r.ok)
+
+    def _pct(self, values: List[float], q: float) -> float:
+        return float(np.percentile(values, q)) if values else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        ok = self.ok_results
+        ttfts = [r.ttft_ms for r in ok]
+        itls = [itl for r in ok for itl in r.itls_ms]
+        lats = [r.latency_ms for r in ok]
+        out_tokens = len(ok) * self.spec.osl
+        return {
+            "model": self.spec.model,
+            "isl": self.spec.isl,
+            "osl": self.spec.osl,
+            "concurrency": self.spec.concurrency,
+            "requests": len(self.results),
+            "errors": self.errors,
+            "wall_s": round(self.wall_s, 3),
+            "output_tok_per_s": round(out_tokens / self.wall_s, 2) if self.wall_s else 0.0,
+            "req_per_s": round(len(ok) / self.wall_s, 3) if self.wall_s else 0.0,
+            "p50_ttft_ms": round(self._pct(ttfts, 50), 1),
+            "p90_ttft_ms": round(self._pct(ttfts, 90), 1),
+            "p99_ttft_ms": round(self._pct(ttfts, 99), 1),
+            "p50_itl_ms": round(self._pct(itls, 50), 2),
+            "p90_itl_ms": round(self._pct(itls, 90), 2),
+            "p99_itl_ms": round(self._pct(itls, 99), 2),
+            "p50_latency_ms": round(self._pct(lats, 50), 1),
+            "p99_latency_ms": round(self._pct(lats, 99), 1),
+        }
+
+    def to_json_line(self) -> str:
+        return json.dumps(self.summary())
+
+
+MD_COLUMNS = [
+    ("concurrency", "conc"),
+    ("output_tok_per_s", "tok/s"),
+    ("req_per_s", "req/s"),
+    ("p50_ttft_ms", "p50 TTFT ms"),
+    ("p99_ttft_ms", "p99 TTFT ms"),
+    ("p50_itl_ms", "p50 ITL ms"),
+    ("p99_itl_ms", "p99 ITL ms"),
+    ("errors", "errors"),
+]
+
+
+def reports_to_markdown(reports: List["LoadReport"]) -> str:
+    """One sweep → one markdown table (the tuning-guide presentation)."""
+    if not reports:
+        return "(no results)"
+    s0 = reports[0].summary()
+    head = f"ISL={s0['isl']} OSL={s0['osl']} model={s0['model']}"
+    lines = [head, "", "| " + " | ".join(h for _, h in MD_COLUMNS) + " |",
+             "|" + "|".join("---" for _ in MD_COLUMNS) + "|"]
+    for rep in reports:
+        s = rep.summary()
+        lines.append("| " + " | ".join(str(s[k]) for k, _ in MD_COLUMNS) + " |")
+    return "\n".join(lines)
+
+
+def _make_prompt(spec: WorkloadSpec, rng: np.random.Generator, prefix: List[int]) -> List[int]:
+    body = rng.integers(1, spec.vocab, size=max(spec.isl - len(prefix), 1))
+    return prefix + [int(t) for t in body]
+
+
+async def _one_request(
+    session, url: str, spec: WorkloadSpec, prompt: List[int]
+) -> RequestResult:
+    payload = {
+        "model": spec.model,
+        "prompt": prompt,
+        "max_tokens": spec.osl,
+        "temperature": spec.temperature,
+        "stream": True,
+        "nvext": {"ignore_eos": True},
+    }
+    res = RequestResult(ok=False)
+    start = time.perf_counter()
+    last = start
+    try:
+        async with session.post(f"{url}/v1/completions", json=payload) as resp:
+            if resp.status != 200:
+                res.error = f"HTTP {resp.status}: {(await resp.text())[:200]}"
+                return res
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data:"):
+                    continue
+                data = line[5:].strip()
+                if data == "[DONE]":
+                    break
+                now = time.perf_counter()
+                if res.chunks == 0:
+                    res.ttft_ms = (now - start) * 1e3
+                else:
+                    res.itls_ms.append((now - last) * 1e3)
+                last = now
+                res.chunks += 1
+                try:
+                    chunk = json.loads(data)
+                    res.text_len += len(
+                        (chunk.get("choices") or [{}])[0].get("text") or ""
+                    )
+                except json.JSONDecodeError:
+                    pass
+        res.latency_ms = (time.perf_counter() - start) * 1e3
+        res.ok = res.chunks > 0
+        if not res.ok:
+            res.error = "empty stream"
+    except Exception as exc:  # connection errors land in the report
+        res.error = repr(exc)
+    return res
+
+
+async def run_load(url: str, spec: WorkloadSpec) -> LoadReport:
+    """Drive ``spec`` against ``url`` (e.g. http://127.0.0.1:8080)."""
+    import aiohttp
+
+    rng = np.random.default_rng(spec.seed)
+    prefix = (
+        [int(t) for t in rng.integers(1, spec.vocab, size=spec.prefix_len)]
+        if spec.prefix_len
+        else []
+    )
+    prompts = [
+        _make_prompt(spec, rng, prefix)
+        for _ in range(spec.requests + spec.warmup_requests)
+    ]
+    results: List[RequestResult] = []
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=None, sock_read=300)
+    ) as session:
+
+        async def drive(batch: List[List[int]], sink: Optional[List[RequestResult]]):
+            next_idx = 0
+            lock = asyncio.Lock()
+
+            async def worker():
+                nonlocal next_idx
+                while True:
+                    async with lock:
+                        if next_idx >= len(batch):
+                            return
+                        i = next_idx
+                        next_idx += 1
+                    r = await _one_request(session, url, spec, batch[i])
+                    if sink is not None:
+                        sink.append(r)
+
+            await asyncio.gather(
+                *(worker() for _ in range(max(spec.concurrency, 1)))
+            )
+
+        # Warmup fully drains BEFORE the measured clock starts — its wall
+        # time and results must not pollute the reported numbers.
+        if spec.warmup_requests:
+            await drive(prompts[: spec.warmup_requests], None)
+        started = time.perf_counter()
+        await drive(prompts[spec.warmup_requests :], results)
+    wall = time.perf_counter() - started
+    return LoadReport(spec=spec, wall_s=wall, results=results)
+
+
+async def run_sweep(
+    url: str, base: WorkloadSpec, concurrencies: List[int]
+) -> List[LoadReport]:
+    """Concurrency sweep, sequential runs (the AIPerf sweep loop)."""
+    import dataclasses
+
+    reports = []
+    for c in concurrencies:
+        spec = dataclasses.replace(base, concurrency=c)
+        reports.append(await run_load(url, spec))
+    return reports

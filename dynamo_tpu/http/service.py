@@ -23,8 +23,10 @@ from dynamo_tpu.llm.protocols.openai import (
     OpenAIError,
     chat_chunk,
     completion_chunk,
+    completion_envelope,
     gen_id,
     model_list,
+    parse_n,
     usage_block,
 )
 from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
@@ -435,7 +437,7 @@ class HttpService:
             )
         stream = bool(body.get("stream", False))
         try:
-            n = _parse_n(body)
+            n = parse_n(body)
         except OpenAIError as exc:
             return _error_response(exc)
         if stream and n > 1:
@@ -474,7 +476,7 @@ class HttpService:
             ):
                 if stream:
                     return await self._stream_response(request, body, entry, ctx, kind, timer)
-                return await self._unary_response(body, entry, ctx, kind, timer)
+                return await self._unary_response(body, entry, ctx, kind, timer, n)
         except OpenAIError as exc:
             timer.done(exc.status)
             return _error_response(exc)
@@ -548,10 +550,15 @@ class HttpService:
         }
 
     async def _unary_response(
-        self, body: Dict[str, Any], entry, ctx: Context, kind: str, timer: RequestTimer
+        self,
+        body: Dict[str, Any],
+        entry,
+        ctx: Context,
+        kind: str,
+        timer: RequestTimer,
+        n: int,
     ) -> web.Response:
         rid = gen_id("chatcmpl" if kind == "chat" else "cmpl")
-        n = _parse_n(body)
         if n <= 1:
             results = [await self._collect_one(body, entry, ctx, timer)]
         else:
@@ -599,14 +606,11 @@ class HttpService:
                     }
                 )
         finish_str = choices[0]["finish_reason"]
-        payload = {
-            "id": rid,
-            "object": "chat.completion" if kind == "chat" else "text_completion",
-            "created": int(time.time()),
-            "model": entry.name,
-            "choices": choices,
-            "usage": usage,
-        }
+        payload = completion_envelope(
+            rid, entry.name,
+            object_="chat.completion" if kind == "chat" else "text_completion",
+            choices=choices, usage=usage,
+        )
         timer.done(200)
         if self.audit.enabled:
             from dynamo_tpu.http.audit import AuditRecord
@@ -825,20 +829,6 @@ class HttpService:
         with _suppress_conn_errors():
             await response.write_eof()
         return response
-
-
-def _parse_n(body: Dict[str, Any]) -> int:
-    """Validated 'n' (choice count). Raises a 400 OpenAIError on junk —
-    int('two') must not surface as a 500 (or escape as a raw aiohttp page
-    on the streaming path)."""
-    raw = body.get("n", 1)
-    if raw is None:
-        return 1
-    if isinstance(raw, bool) or not isinstance(raw, int):
-        raise OpenAIError("'n' must be an integer in [1, 8]")
-    if not 1 <= raw <= 8:
-        raise OpenAIError("'n' must be an integer in [1, 8]")
-    return raw
 
 
 def _error_response(exc: OpenAIError) -> web.Response:

@@ -44,6 +44,18 @@ class OpenAIError(Exception):
         }
 
 
+def parse_n(req: Dict[str, Any]) -> int:
+    """Validated 'n' (choice count) — the ONE source of truth for both the
+    HTTP service gate and request preprocessing. None → 1; bools, non-ints
+    and out-of-range values 400 (int('two') must never surface as a 500)."""
+    raw = req.get("n", 1)
+    if raw is None:
+        return 1
+    if isinstance(raw, bool) or not isinstance(raw, int) or not 1 <= raw <= 8:
+        raise OpenAIError("'n' must be an integer in [1, 8]")
+    return raw
+
+
 def _require(cond: bool, message: str) -> None:
     if not cond:
         raise OpenAIError(message)
@@ -121,9 +133,7 @@ def _parse_shared(req: Dict[str, Any], parsed: ParsedRequest) -> ParsedRequest:
     parsed.stream = bool(req.get("stream", False))
     stream_options = req.get("stream_options") or {}
     parsed.stream_usage = bool(stream_options.get("include_usage", False))
-    n = req.get("n", 1)
-    _require(isinstance(n, int) and 1 <= n <= 8, "'n' must be an integer in [1, 8]")
-    parsed.n = n
+    parsed.n = parse_n(req)
 
     sampling = SamplingOptions(
         temperature=_opt_number(req, "temperature", 0.0, 2.0),
@@ -256,6 +266,27 @@ def chat_chunk(
     return chunk
 
 
+def completion_envelope(
+    id: str,
+    model: str,
+    *,
+    object_: str,  # "chat.completion" | "text_completion"
+    choices: List[Dict[str, Any]],
+    usage: Dict[str, Any],
+    created: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The unary response envelope — the ONE place its shape is defined
+    (HTTP unary handlers pass 1..n pre-built choice entries)."""
+    return {
+        "id": id,
+        "object": object_,
+        "created": created or int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": usage,
+    }
+
+
 def chat_completion(
     id: str,
     model: str,
@@ -274,12 +305,9 @@ def chat_completion(
         message["tool_calls"] = tool_calls
     if reasoning_content:
         message["reasoning_content"] = reasoning_content
-    return {
-        "id": id,
-        "object": "chat.completion",
-        "created": created or int(time.time()),
-        "model": model,
-        "choices": [
+    return completion_envelope(
+        id, model, object_="chat.completion", created=created,
+        choices=[
             {
                 "index": 0,
                 "message": message,
@@ -287,8 +315,8 @@ def chat_completion(
                 "finish_reason": finish_reason,
             }
         ],
-        "usage": usage,
-    }
+        usage=usage,
+    )
 
 
 def completion_chunk(
@@ -324,14 +352,13 @@ def completion_response(
     usage: Dict[str, Any],
     created: Optional[int] = None,
 ) -> Dict[str, Any]:
-    return {
-        "id": id,
-        "object": "text_completion",
-        "created": created or int(time.time()),
-        "model": model,
-        "choices": [{"index": 0, "text": text, "logprobs": None, "finish_reason": finish_reason}],
-        "usage": usage,
-    }
+    return completion_envelope(
+        id, model, object_="text_completion", created=created,
+        choices=[
+            {"index": 0, "text": text, "logprobs": None, "finish_reason": finish_reason}
+        ],
+        usage=usage,
+    )
 
 
 def embedding_response(model: str, embeddings: List[List[float]], prompt_tokens: int) -> Dict[str, Any]:

@@ -1,0 +1,105 @@
+"""Load generator (dynamo_tpu.bench) against a live mock-engine frontend.
+
+Mirrors the reference's AIPerf methodology tests: fixed ISL/OSL workload,
+percentile report, concurrency sweep (ref: docs/benchmarks/benchmarking.md).
+"""
+
+import json
+
+from dynamo_tpu.bench import (
+    WorkloadSpec,
+    reports_to_markdown,
+    run_load,
+    run_sweep,
+)
+from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+from dynamo_tpu.llm.entrypoint import build_local_pipeline
+
+
+async def start_service():
+    manager = ModelManager()
+    card = ModelDeploymentCard(name="mock-model", context_length=4096)
+    engine = MockEngine(
+        MockEngineArgs(speedup_ratio=500.0, block_size=4, num_kv_blocks=4096)
+    )
+    pipeline = build_local_pipeline(card, engine, tokenizer=tiny_tokenizer())
+    manager.register("mock-model", pipeline, card)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    return service, engine, port
+
+
+async def test_run_load_reports_fixed_workload():
+    service, engine, port = await start_service()
+    try:
+        spec = WorkloadSpec(
+            model="mock-model", isl=32, osl=8, concurrency=4, requests=12,
+            vocab=200,
+        )
+        report = await run_load(f"http://127.0.0.1:{port}", spec)
+        s = report.summary()
+        assert s["requests"] == 12
+        assert s["errors"] == 0, [r.error for r in report.results]
+        assert s["output_tok_per_s"] > 0
+        assert s["p50_ttft_ms"] > 0
+        # every stream produced chunks; ITL defined once >1 chunk arrives
+        assert all(r.chunks >= 1 for r in report.results)
+        json.loads(report.to_json_line())  # valid single-line JSON
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_run_load_counts_errors_for_unknown_model():
+    service, engine, port = await start_service()
+    try:
+        spec = WorkloadSpec(model="nope", isl=8, osl=4, concurrency=2, requests=4)
+        report = await run_load(f"http://127.0.0.1:{port}", spec)
+        assert report.errors == 4
+        assert all("HTTP" in (r.error or "") for r in report.results)
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_sweep_and_markdown_table():
+    service, engine, port = await start_service()
+    try:
+        spec = WorkloadSpec(
+            model="mock-model", isl=16, osl=4, requests=6, vocab=100,
+            prefix_len=8, warmup_requests=2,
+        )
+        reports = await run_sweep(f"http://127.0.0.1:{port}", spec, [1, 3])
+        assert [r.spec.concurrency for r in reports] == [1, 3]
+        assert all(r.errors == 0 for r in reports)
+        # measured window excludes warmup
+        assert all(len(r.results) == 6 for r in reports)
+        md = reports_to_markdown(reports)
+        assert "tok/s" in md and md.count("\n") >= 4
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+def test_cli_parses_and_sweeps(monkeypatch):
+    """__main__ wiring: argparse → run_sweep with the right spec."""
+    import dynamo_tpu.bench.__main__ as cli
+    from dynamo_tpu.bench.loadgen import LoadReport
+
+    seen = {}
+
+    async def fake_sweep(url, spec, concurrencies):
+        seen["url"], seen["spec"], seen["conc"] = url, spec, concurrencies
+        return [LoadReport(spec=spec, wall_s=1.0, results=[])]
+
+    monkeypatch.setattr(cli, "run_sweep", fake_sweep)
+    rc = cli.main(
+        ["--url", "http://h:1", "--model", "m", "--isl", "64", "--osl", "16",
+         "--concurrency", "2", "8", "--requests", "5", "--markdown"]
+    )
+    assert seen["url"] == "http://h:1"
+    assert seen["spec"].isl == 64 and seen["spec"].osl == 16
+    assert seen["conc"] == [2, 8]
+    assert rc == 1  # zero results counts as all-errors → non-zero exit
