@@ -1,0 +1,54 @@
+"""Observability assets stay wired to the canonical metric registry
+(runtime/metric_names.py) — dashboards must not drift from the code
+(ref: metrics/prometheus_names.rs rationale)."""
+
+import json
+import os
+import re
+
+from dynamo_tpu.runtime import metric_names as mn
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "deploy", "observability")
+
+
+def _canonical_names():
+    return {
+        v for k, v in vars(mn).items()
+        if isinstance(v, str) and v.startswith("dynamo_tpu_")
+    }
+
+
+def test_grafana_dashboard_metrics_are_canonical():
+    path = os.path.join(ROOT, "grafana_dashboards", "frontend.json")
+    with open(path) as f:
+        dash = json.load(f)
+    assert dash["panels"], "dashboard has no panels"
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    assert exprs
+    names = _canonical_names()
+    used = set()
+    for expr in exprs:
+        for m in re.findall(r"dynamo_tpu_[a-z_]+", expr):
+            base = re.sub(r"_(bucket|count|sum)$", "", m)
+            assert base in names, f"dashboard metric {m} not in metric_names.py"
+            used.add(base)
+    # the dashboard covers the core serving signals
+    for required in (
+        mn.FRONTEND_REQUESTS_TOTAL,
+        mn.FRONTEND_TTFT,
+        mn.FRONTEND_ITL,
+        mn.FRONTEND_OUTPUT_TOKENS_TOTAL,
+    ):
+        assert required in used
+
+
+def test_prometheus_config_parses_minimally():
+    # No yaml dependency assumptions beyond stdlib-adjacent: structural greps.
+    with open(os.path.join(ROOT, "prometheus.yml")) as f:
+        text = f.read()
+    assert "scrape_configs:" in text
+    assert "dynamo-tpu-frontend" in text and "dynamo-tpu-workers" in text
