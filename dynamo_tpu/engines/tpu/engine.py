@@ -92,6 +92,10 @@ class JaxEngineArgs:
     # (ops/quant.py) — halves weight HBM, 8B-class models fit one v5e chip
     # (the reference's FP8/NVFP4-checkpoint deployment lever, TPU-style).
     quantization: Optional[str] = None
+    # Static top-N width compiled into the logprobs decode programs
+    # (OpenAI caps top_logprobs at 20). Per-request counts trim at emit;
+    # the logprob-free programs never pay for it.
+    top_logprobs_cap: int = 20
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -252,7 +256,8 @@ class JaxEngine:
         # lazily on the first request that uses one — the common no-processor
         # path never pays for the [S, V] bookkeeping or the extra HBM reads.
         self._decode_procs_fns: Dict[bool, Any] = {}
-        self._step_fn_procs: Optional[Any] = None
+        # (want_procs, want_top) → lazily compiled prefill program variants.
+        self._step_fns: Dict[Tuple[bool, bool], Any] = {(False, False): self._step_fn}
         self._proc_state: Optional[Any] = None  # logits_process.ProcState
         self._spec_fn: Optional[Any] = None  # speculative verify program
         self.spec_proposed = 0
@@ -398,9 +403,10 @@ class JaxEngine:
 
     # -- jitted step -------------------------------------------------------
 
-    def _build_step_fn(self, want_procs: bool = False):
+    def _build_step_fn(self, want_procs: bool = False, want_top: bool = False):
         cfg = self.config
         use_kernel = self._use_kernel
+        num_top = self.args.top_logprobs_cap if want_top else 0
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
                  block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
@@ -427,6 +433,11 @@ class JaxEngine:
             else:
                 toks = sample_tokens(logits, rng, temp, topk, topp)
             logp = compute_logprobs(logits, toks)
+            if num_top > 0:
+                from dynamo_tpu.ops.sampling import top_logprobs as top_op
+
+                tv, ti = top_op(logits, num_top)
+                return toks, logp, tv, ti, k_cache, v_cache
             return toks, logp, k_cache, v_cache
 
         return jax.jit(step, donate_argnums=(2, 3))
@@ -436,6 +447,10 @@ class JaxEngine:
         cfg = self.config
         use_kernel = self._use_kernel
         num_steps = self.args.decode_steps
+
+        # The logprobs program variants also surface the per-step top-N
+        # alternatives (OpenAI top_logprobs); the common variants skip it.
+        num_top = self.args.top_logprobs_cap if want_logprobs else 0
 
         if not want_procs:
             def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
@@ -447,6 +462,7 @@ class JaxEngine:
                     num_steps=num_steps, use_kernel=use_kernel,
                     lora=lora, adapter_ids=adapter_ids,
                     want_logprobs=want_logprobs,
+                    num_top_logprobs=num_top,
                 )
 
             return jax.jit(step, donate_argnums=(2, 3))
@@ -460,15 +476,17 @@ class JaxEngine:
             pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
                                bias_ids=bias_ids, bias_vals=bias_vals)
             st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
-            toks, logp, k_cache, v_cache, st = llama.decode_multi(
+            out = llama.decode_multi(
                 params, cfg, tokens, start_pos, active, block_tables,
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
                 want_logprobs=want_logprobs,
                 min_p=minp, proc_params=pp, proc_state=st,
+                num_top_logprobs=num_top,
             )
-            return toks, logp, k_cache, v_cache, st.out_counts
+            st = out[-1]
+            return out[:-3] + (out[-3], out[-2], st.out_counts)
 
         # donate caches + the token-count array (functionally threaded).
         return jax.jit(step_p, donate_argnums=(2, 3, 20))
@@ -485,11 +503,12 @@ class JaxEngine:
     def _run_decode(
         self, tokens, start_pos, active, block_tables, temp, topk, topp,
         adapter_ids, want_logprobs=False, want_procs=False,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+    ):
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
-        [B, K] logprobs)."""
+        [B, K] logprobs, top_vals [B, K, N] | None, top_ids | None)."""
         step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
         self._rng_step += 1
+        topv = topi = None
         if want_procs:
             from dynamo_tpu.ops import logits_process as lp
 
@@ -498,7 +517,7 @@ class JaxEngine:
                 fn = self._build_decode_fn(want_logprobs, want_procs=True)
                 self._decode_procs_fns[want_logprobs] = fn
             st = self._ensure_proc_state()
-            toks, logp, self._k_cache, self._v_cache, counts = fn(
+            out = fn(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
                 jnp.asarray(block_tables), self._rng, step_id,
@@ -509,60 +528,84 @@ class JaxEngine:
                 jnp.asarray(self._bias_ids), jnp.asarray(self._bias_vals),
                 st.out_counts, st.prompt_mask,
             )
+            if want_logprobs:
+                toks, logp, topv, topi, self._k_cache, self._v_cache, counts = out
+            else:
+                toks, logp, self._k_cache, self._v_cache, counts = out
             self._proc_state = lp.ProcState(
                 out_counts=counts, prompt_mask=st.prompt_mask
             )
         else:
             fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
-            toks, logp, self._k_cache, self._v_cache = fn(
+            out = fn(
                 self.params, self._lora, self._k_cache, self._v_cache,
                 jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
                 jnp.asarray(block_tables), self._rng, step_id,
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 jnp.asarray(adapter_ids),
             )
-        return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
+            if want_logprobs:
+                toks, logp, topv, topi, self._k_cache, self._v_cache = out
+            else:
+                toks, logp, self._k_cache, self._v_cache = out
+        return (
+            np.asarray(jax.device_get(toks)),
+            np.asarray(jax.device_get(logp)),
+            None if topv is None else np.asarray(jax.device_get(topv)),
+            None if topi is None else np.asarray(jax.device_get(topi)),
+        )
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
-        adapter_ids, mm_embeds=None, mm_slot=None, procs=None,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+        adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
+    ):
         """Execute one step on the device thread (blocking). Caller passes
-        numpy inputs; returns (sampled tokens, logprobs) as numpy.
+        numpy inputs; returns (sampled tokens, logprobs, top_vals | None,
+        top_ids | None) as numpy.
 
         ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
         prompt_mask) per-row arrays — routes through the logits-processor
-        prefill program."""
+        prefill program. ``want_top``: also return the top-N alternatives
+        (the logprobs program variants, lazily compiled)."""
         step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
         self._rng_step += 1
+        key = (procs is not None, bool(want_top))
+        fn = self._step_fns.get(key)
+        if fn is None:
+            if key == (False, False):
+                fn = self._step_fn
+            else:
+                fn = self._build_step_fn(want_procs=key[0], want_top=key[1])
+            self._step_fns[key] = fn
+        args = [
+            self.params, self._lora, self._k_cache, self._v_cache,
+            jnp.asarray(tokens), jnp.asarray(start_pos),
+            jnp.asarray(chunk_lens), jnp.asarray(block_tables),
+            self._rng, step_id,
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+            jnp.asarray(adapter_ids),
+            None if mm_embeds is None else jnp.asarray(mm_embeds),
+            None if mm_slot is None else jnp.asarray(mm_slot),
+        ]
         if procs is not None:
-            if self._step_fn_procs is None:
-                self._step_fn_procs = self._build_step_fn(want_procs=True)
             minp, rep, pres, freq, bias_ids, bias_vals, pmask = procs
-            toks, logp, self._k_cache, self._v_cache = self._step_fn_procs(
-                self.params, self._lora, self._k_cache, self._v_cache,
-                jnp.asarray(tokens), jnp.asarray(start_pos),
-                jnp.asarray(chunk_lens), jnp.asarray(block_tables),
-                self._rng, step_id,
-                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-                jnp.asarray(adapter_ids),
-                None if mm_embeds is None else jnp.asarray(mm_embeds),
-                None if mm_slot is None else jnp.asarray(mm_slot),
+            args += [
                 jnp.asarray(minp), jnp.asarray(rep), jnp.asarray(pres),
                 jnp.asarray(freq), jnp.asarray(bias_ids),
                 jnp.asarray(bias_vals), jnp.asarray(pmask),
-            )
+            ]
+        out = fn(*args)
+        topv = topi = None
+        if want_top:
+            toks, logp, topv, topi, self._k_cache, self._v_cache = out
         else:
-            toks, logp, self._k_cache, self._v_cache = self._step_fn(
-                self.params, self._lora, self._k_cache, self._v_cache,
-                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(chunk_lens),
-                jnp.asarray(block_tables), self._rng, step_id,
-                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-                jnp.asarray(adapter_ids),
-                None if mm_embeds is None else jnp.asarray(mm_embeds),
-                None if mm_slot is None else jnp.asarray(mm_slot),
-            )
-        return np.asarray(jax.device_get(toks)), np.asarray(jax.device_get(logp))
+            toks, logp, self._k_cache, self._v_cache = out
+        return (
+            np.asarray(jax.device_get(toks)),
+            np.asarray(jax.device_get(logp)),
+            None if topv is None else np.asarray(jax.device_get(topv)),
+            None if topi is None else np.asarray(jax.device_get(topi)),
+        )
 
     async def _device(self, fn, *a):
         return await asyncio.get_running_loop().run_in_executor(
@@ -883,8 +926,8 @@ class JaxEngine:
             return 0
         self._admission_failure_streak = 0
         free_iter = (i for i, s in enumerate(self._slots) if s is None)
-        for (seq, prep), (tok, logp) in zip(batch, firsts):
-            self._install(seq, prep, next(free_iter), tok, logp)
+        for (seq, prep), (tok, logp, top) in zip(batch, firsts):
+            self._install(seq, prep, next(free_iter), tok, logp, top)
         return len(batch)
 
     def _contain_admission_failure(self, seqs: "List[_Sequence]", exc: Exception) -> None:
@@ -1006,7 +1049,13 @@ class JaxEngine:
         rows = len(batch)
         prompts = [seq.all_tokens for seq, _ in batch]
         pos = [prep.matched_tokens for _, prep in batch]
-        first: List[Optional[Tuple[int, float]]] = [None] * rows
+        first: List[Optional[Tuple[int, float, Optional[list]]]] = [None] * rows
+        # Any row asking for top-N logprobs routes the batch through the
+        # top-variant prefill program so the FIRST generated token carries
+        # alternatives too (not just the fused-decode tokens).
+        want_top = any(
+            (seq.request.sampling.logprobs or 0) > 0 for seq, _ in batch
+        )
 
         nb_needed = max(len(prep.ids) for _, prep in batch)
         nb_bucket = min(_next_pow2(nb_needed), args.max_blocks_per_seq)
@@ -1069,11 +1118,11 @@ class JaxEngine:
                 mm_chunk = np.full((Bp, c_bucket), -1, dtype=np.int32)
                 n0 = int(lens[0])
                 mm_chunk[0, :n0] = mm_slot_of[pos[0] : pos[0] + n0]
-            toks, logps = await self._device(
+            toks, logps, topv, topi = await self._device(
                 self._run_step,
                 tok_arr, start, lens, tables,
                 temp, topk, topp, adapter,
-                mm_embeds, mm_chunk, procs,
+                mm_embeds, mm_chunk, procs, want_top,
             )
             for r in range(rows):
                 n = int(lens[r])
@@ -1082,13 +1131,19 @@ class JaxEngine:
                 self.prefill_tokens += n
                 pos[r] += n
                 if pos[r] >= len(prompts[r]):
-                    first[r] = (int(toks[r]), float(logps[r]))
+                    top = None
+                    if topv is not None:
+                        top = [
+                            (int(topi[r, j]), float(topv[r, j]))
+                            for j in range(topv.shape[1])
+                        ]
+                    first[r] = (int(toks[r]), float(logps[r]), top)
         assert all(f is not None for f in first)
         return first  # type: ignore[return-value]
 
     def _install(
         self, seq: _Sequence, prep: "_Prep", slot: int, first_token: int,
-        first_logprob: float,
+        first_logprob: float, first_top: Optional[list] = None,
     ) -> None:
         """Commit fresh prompt blocks and join the decode batch."""
         args = self.args
@@ -1136,7 +1191,7 @@ class JaxEngine:
                 st, slot, seq.request.token_ids, seq.generated
             )
             self._proc_state = lp.count_token(st, slot, first_token)
-        self._emit_token(seq, first_token, first_logprob)
+        self._emit_token(seq, first_token, first_logprob, first_top)
 
     def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
         s = req.sampling
@@ -1383,7 +1438,7 @@ class JaxEngine:
             s.request.sampling.logprobs is not None for s in active
         )
         want_procs = any(self._uses_procs[s.slot] for s in active)
-        toks, logps = await self._device(
+        toks, logps, topv, topi = await self._device(
             self._run_decode,
             tokens,
             self._pos.copy(),
@@ -1397,9 +1452,16 @@ class JaxEngine:
         self.steps += 1
 
         for seq in list(active):
-            self._emit_burst(seq, toks[seq.slot], logps[seq.slot])
+            self._emit_burst(
+                seq, toks[seq.slot], logps[seq.slot],
+                None if topv is None else topv[seq.slot],
+                None if topi is None else topi[seq.slot],
+            )
 
-    def _emit_burst(self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray) -> None:
+    def _emit_burst(
+        self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray,
+        topv: Optional[np.ndarray] = None, topi: Optional[np.ndarray] = None,
+    ) -> None:
         """Consume one fused burst for a sequence: apply stop conditions
         per token but stream ONE BackendOutput for the whole burst — the
         asyncio queue/wakeup cost per token dominated decode throughput
@@ -1434,10 +1496,19 @@ class JaxEngine:
                 break  # overshoot tokens beyond the stop are discarded
         logprobs = None
         if req.sampling.logprobs is not None:
-            logprobs = [
-                [TokenLogprob(token_id=t, logprob=lp)]
-                for t, lp in zip(emitted, emitted_logps)
-            ]
+            # Entry 0 is the SAMPLED token; entries 1.. are the request's
+            # top-N alternatives (may repeat the sampled token, as OpenAI's
+            # top_logprobs does when it ranks in the top N).
+            n_top = min(int(req.sampling.logprobs), self.args.top_logprobs_cap)
+            logprobs = []
+            for k, (t, lp) in enumerate(zip(emitted, emitted_logps)):
+                entry = [TokenLogprob(token_id=t, logprob=lp)]
+                if topv is not None and n_top > 0:
+                    entry.extend(
+                        TokenLogprob(token_id=int(topi[k, j]), logprob=float(topv[k, j]))
+                        for j in range(n_top)
+                    )
+                logprobs.append(entry)
         seq.queue.put_nowait(
             BackendOutput(
                 token_ids=emitted,
@@ -1481,7 +1552,10 @@ class JaxEngine:
         seq.slot = -1
         self._requeue(seq)
 
-    def _emit_token(self, seq: _Sequence, token: int, logprob: float) -> None:
+    def _emit_token(
+        self, seq: _Sequence, token: int, logprob: float,
+        top: Optional[list] = None,  # [(token_id, logprob)] top-N candidates
+    ) -> None:
         """Append a generated token, evaluate stop conditions, stream output."""
         seq.generated.append(token)
         seq.all_tokens.append(token)
@@ -1503,7 +1577,13 @@ class JaxEngine:
 
         logprobs = None
         if req.sampling.logprobs is not None:
-            logprobs = [[TokenLogprob(token_id=token, logprob=logprob)]]
+            entry = [TokenLogprob(token_id=token, logprob=logprob)]
+            if top:
+                n_top = min(int(req.sampling.logprobs), self.args.top_logprobs_cap)
+                entry.extend(
+                    TokenLogprob(token_id=t, logprob=lp) for t, lp in top[:n_top]
+                )
+            logprobs = [entry]
         seq.queue.put_nowait(
             BackendOutput(
                 token_ids=[token],

@@ -22,8 +22,10 @@ from dynamo_tpu.llm.protocols.common import FinishReason, PostprocessedOutput
 from dynamo_tpu.llm.protocols.openai import (
     OpenAIError,
     chat_chunk,
+    chat_logprobs_block,
     completion_chunk,
     completion_envelope,
+    completion_logprobs_block,
     gen_id,
     model_list,
     parse_n,
@@ -241,8 +243,24 @@ class HttpService:
             )
         timer = RequestTimer(self.metrics, model, "responses")
         ctx = Context(baggage={"model": model})
+        stream = bool(body.get("stream", False))
+        rid = gen_id("resp")
+
+        def envelope(status: str, output=None, usage=None) -> Dict[str, Any]:
+            resp: Dict[str, Any] = {
+                "id": rid, "object": "response", "status": status,
+                "model": model, "output": output or [],
+            }
+            if usage is not None:
+                resp["usage"] = usage
+            return resp
+
         try:
             with self.tracker.guard():
+                if stream:
+                    return await self._responses_stream(
+                        request, chat_body, entry, ctx, timer, envelope
+                    )
                 text_parts: list = []
                 prompt_tokens = 0
                 completion_tokens = 0
@@ -263,12 +281,9 @@ class HttpService:
                         timer.on_token(len(out.token_ids))
                 timer.done(200)
                 return web.json_response(
-                    {
-                        "id": gen_id("resp"),
-                        "object": "response",
-                        "status": "completed",
-                        "model": model,
-                        "output": [
+                    envelope(
+                        "completed",
+                        output=[
                             {
                                 "type": "message",
                                 "role": "assistant",
@@ -280,12 +295,12 @@ class HttpService:
                                 ],
                             }
                         ],
-                        "usage": {
+                        usage={
                             "input_tokens": prompt_tokens,
                             "output_tokens": completion_tokens,
                             "total_tokens": prompt_tokens + completion_tokens,
                         },
-                    }
+                    )
                 )
         except OpenAIError as exc:
             timer.done(exc.status)
@@ -299,6 +314,110 @@ class HttpService:
             timer.done(500)
             return _error_response(OpenAIError(str(exc), status=500,
                                                err_type="internal_error"))
+
+    async def _responses_stream(
+        self, request: web.Request, chat_body, entry, ctx: Context,
+        timer: RequestTimer, envelope,
+    ) -> web.StreamResponse:
+        """Responses API streaming: typed SSE events
+        (response.created → response.output_text.delta* →
+        response.output_text.done → response.completed), each framed as
+        ``event: <type>`` + ``data: <json>`` with sequence numbers."""
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": ctx.id,
+            },
+        )
+        await response.prepare(request)
+        seq = 0
+
+        async def send(event_type: str, payload: Dict[str, Any]) -> None:
+            nonlocal seq
+            payload = {"type": event_type, "sequence_number": seq, **payload}
+            seq += 1
+            with _suppress_conn_errors():
+                await response.write(
+                    f"event: {event_type}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+
+        await send("response.created", {"response": envelope("in_progress")})
+        text_parts: list = []
+        prompt_tokens = 0
+        completion_tokens = 0
+        status = 200
+        try:
+            async for item in entry.engine.generate(chat_body, ctx):
+                if isinstance(item, dict):
+                    if item.get("annotation") == "_prompt_tokens":
+                        prompt_tokens = item["value"]
+                        timer.on_input_tokens(prompt_tokens)
+                    continue
+                out: PostprocessedOutput = item
+                if out.error:
+                    await send(
+                        "error",
+                        {"message": out.error, "code": "internal_error"},
+                    )
+                    # Terminal event so SDK consumers waiting on a final
+                    # response.* event resolve instead of hanging.
+                    await send(
+                        "response.failed", {"response": envelope("failed")}
+                    )
+                    status = 500
+                    break
+                if out.token_ids:
+                    completion_tokens += len(out.token_ids)
+                    timer.on_token(len(out.token_ids))
+                if out.text:
+                    text_parts.append(out.text)
+                    await send(
+                        "response.output_text.delta",
+                        {"item_id": "msg_0", "output_index": 0,
+                         "content_index": 0, "delta": out.text},
+                    )
+            if status == 200:
+                full = "".join(text_parts)
+                await send(
+                    "response.output_text.done",
+                    {"item_id": "msg_0", "output_index": 0,
+                     "content_index": 0, "text": full},
+                )
+                await send(
+                    "response.completed",
+                    {
+                        "response": envelope(
+                            "completed",
+                            output=[
+                                {
+                                    "type": "message",
+                                    "role": "assistant",
+                                    "content": [
+                                        {"type": "output_text", "text": full}
+                                    ],
+                                }
+                            ],
+                            usage={
+                                "input_tokens": prompt_tokens,
+                                "output_tokens": completion_tokens,
+                                "total_tokens": prompt_tokens + completion_tokens,
+                            },
+                        )
+                    },
+                )
+        except asyncio.CancelledError:
+            ctx.kill()
+            timer.done(499)
+            raise
+        finally:
+            if not ctx.stopped:
+                ctx.stop_generating(reason="response-stream-finished")
+        timer.done(status)
+        with _suppress_conn_errors():
+            await response.write_eof()
+        return response
 
     async def _openapi(self, request: web.Request) -> web.Response:
         """Minimal OpenAPI description of the served routes (ref: the
@@ -496,12 +615,13 @@ class HttpService:
         *, primary: bool = True,
     ):
         """Fold one engine stream → (text, finish, prompt_tokens,
-        completion_tokens). Only the primary stream feeds latency
-        histograms (secondary n>1 streams would corrupt TTFT/ITL)."""
+        completion_tokens, logprob_entries). Only the primary stream feeds
+        latency histograms (secondary n>1 streams would corrupt TTFT/ITL)."""
         text_parts = []
         finish: Optional[FinishReason] = None
         prompt_tokens = 0
         completion_tokens = 0
+        logprob_entries: list = []
         async for item in entry.engine.generate(body, ctx):
             if isinstance(item, dict) and item.get("annotation") == "_prompt_tokens":
                 prompt_tokens = item["value"]
@@ -518,13 +638,19 @@ class HttpService:
                     timer.on_token(len(out.token_ids))
                 else:
                     timer.count_tokens(len(out.token_ids))
+            if out.logprobs:
+                logprob_entries.extend(out.logprobs)
             completion_tokens = out.cumulative_tokens or completion_tokens
             if out.finish_reason is not None:
                 finish = out.finish_reason
-        return "".join(text_parts), finish, prompt_tokens, completion_tokens
+        return (
+            "".join(text_parts), finish, prompt_tokens, completion_tokens,
+            logprob_entries,
+        )
 
     def _chat_choice(
-        self, entry, body: Dict[str, Any], text: str, finish_str: str, index: int
+        self, entry, body: Dict[str, Any], text: str, finish_str: str, index: int,
+        logprob_entries=None,
     ) -> Dict[str, Any]:
         """Parse one completed chat message into an OpenAI choice entry
         (reasoning tags + tool-call dialects; ref: lib/parsers)."""
@@ -545,7 +671,9 @@ class HttpService:
         return {
             "index": index,
             "message": message,
-            "logprobs": None,
+            "logprobs": (
+                chat_logprobs_block(logprob_entries) if logprob_entries else None
+            ),
             "finish_reason": finish_str,
         }
 
@@ -592,17 +720,23 @@ class HttpService:
         usage = usage_block(prompt_tokens, completion_tokens)
         text = results[0][0]  # primary choice (audit record)
         choices = []
-        for i, (choice_text, finish, _pt, _ct) in enumerate(results):
+        for i, (choice_text, finish, _pt, _ct, lp_entries) in enumerate(results):
             finish_str = (finish or FinishReason.EOS).to_openai()
             if kind == "chat":
                 choices.append(
-                    self._chat_choice(entry, body, choice_text, finish_str, i)
+                    self._chat_choice(
+                        entry, body, choice_text, finish_str, i, lp_entries
+                    )
                 )
             else:
                 choices.append(
                     {
                         "index": i, "text": choice_text,
-                        "logprobs": None, "finish_reason": finish_str,
+                        "logprobs": (
+                            completion_logprobs_block(lp_entries)
+                            if lp_entries else None
+                        ),
+                        "finish_reason": finish_str,
                     }
                 )
         finish_str = choices[0]["finish_reason"]
@@ -668,6 +802,7 @@ class HttpService:
         completion_tokens = 0
         sent_role = False
         status = 200
+        lp_offset = 0  # running char offset for completions text_offset
         finish_seen: Optional[str] = None
         audit_parts: Optional[list] = [] if self.audit.enabled else None
         reasoning_parser = ReasoningParser(style=entry.card.reasoning_style)
@@ -752,9 +887,27 @@ class HttpService:
                                     content += remainder
                     if content:
                         delta["content"] = content
-                    chunk = chat_chunk(rid, entry.name, delta=delta, finish_reason=finish_str)
+                    chunk = chat_chunk(
+                        rid, entry.name, delta=delta, finish_reason=finish_str,
+                        logprobs=(
+                            chat_logprobs_block(out.logprobs)
+                            if out.logprobs else None
+                        ),
+                    )
                 else:
-                    chunk = completion_chunk(rid, entry.name, text=out.text, finish_reason=finish_str)
+                    lp_block = None
+                    if out.logprobs:
+                        lp_block = completion_logprobs_block(
+                            out.logprobs, text_offset=lp_offset
+                        )
+                        lp_offset = (
+                            lp_block["text_offset"][-1]
+                            + len(lp_block["tokens"][-1])
+                        )
+                    chunk = completion_chunk(
+                        rid, entry.name, text=out.text, finish_reason=finish_str,
+                        logprobs=lp_block,
+                    )
                 await _sse_send(response, chunk)
             if kind == "chat" and status == 200 and finish_seen is None:
                 # Stream ended without a finish chunk (the unary path

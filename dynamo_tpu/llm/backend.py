@@ -8,7 +8,7 @@ detection that needs text visibility the engine doesn't have).
 from __future__ import annotations
 
 import logging
-from typing import Any, AsyncIterator, List, Optional, Union
+from typing import Any, AsyncIterator, Dict, List, Optional, Union
 
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
@@ -43,6 +43,7 @@ class Backend:
         decode = DecodeStream(self.tokenizer)
         pending = ""  # decoded but held back
         cumulative = 0
+        decoded_memo: Dict[int, str] = {}  # logprob token id → string
 
         async for item in next.generate(request, context):
             if isinstance(item, dict) and "annotation" in item:
@@ -56,6 +57,21 @@ class Backend:
                     cumulative_tokens=cumulative,
                 )
                 return
+            if out.logprobs:
+                # Fill each entry's token string here — the detokenizer is
+                # the one pipeline stage that owns the tokenizer (the HTTP
+                # layer renders OpenAI logprob objects from `decoded`).
+                # Memoized per stream: top-N alternatives repeat the same
+                # ids constantly (up to cap+1 decodes per generated token
+                # otherwise).
+                for step_entries in out.logprobs:
+                    for tl in step_entries:
+                        if tl.decoded is None:
+                            s = decoded_memo.get(tl.token_id)
+                            if s is None:
+                                s = self.tokenizer.decode([tl.token_id])
+                                decoded_memo[tl.token_id] = s
+                            tl.decoded = s
             cumulative += len(out.token_ids)
             pending += decode.step(out.token_ids)
             if out.finish_reason is not None:

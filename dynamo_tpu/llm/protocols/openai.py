@@ -164,12 +164,14 @@ def _parse_shared(req: Dict[str, Any], parsed: ParsedRequest) -> ParsedRequest:
     logprobs = req.get("logprobs")
     if parsed.kind == "chat":
         if logprobs:
-            top_logprobs = req.get("top_logprobs", 1) or 1
+            top_logprobs = req.get("top_logprobs", 0) or 0
             _require(
                 isinstance(top_logprobs, int) and 0 <= top_logprobs <= 20,
                 "'top_logprobs' must be in [0, 20]",
             )
-            sampling.logprobs = max(1, top_logprobs)
+            # 0 alternatives is valid: sampled-token logprob only (OpenAI
+            # returns empty top_logprobs lists when none were requested).
+            sampling.logprobs = top_logprobs
     elif logprobs is not None:
         _require(isinstance(logprobs, int) and 0 <= logprobs <= 20, "'logprobs' must be in [0, 20]")
         sampling.logprobs = logprobs
@@ -266,6 +268,55 @@ def chat_chunk(
     return chunk
 
 
+def chat_logprobs_block(entries) -> Dict[str, Any]:
+    """OpenAI chat `choice.logprobs` from TokenLogprob step lists
+    (entry 0 = sampled token, entries 1.. = top-N alternatives)."""
+
+    def item(tl) -> Dict[str, Any]:
+        s = tl.decoded if tl.decoded is not None else ""
+        return {
+            "token": s,
+            "logprob": tl.logprob,
+            "bytes": list(s.encode("utf-8")),
+        }
+
+    content = []
+    for step in entries:
+        head = item(step[0])
+        head["top_logprobs"] = [item(tl) for tl in step[1:]]
+        content.append(head)
+    return {"content": content}
+
+
+def completion_logprobs_block(entries, text_offset: int = 0) -> Dict[str, Any]:
+    """Legacy text-completions `choice.logprobs` (tokens / token_logprobs /
+    top_logprobs / text_offset arrays)."""
+    tokens: List[str] = []
+    token_logprobs: List[float] = []
+    top: List[Dict[str, float]] = []
+    offsets: List[int] = []
+    off = text_offset
+    for step in entries:
+        s = step[0].decoded if step[0].decoded is not None else ""
+        tokens.append(s)
+        token_logprobs.append(step[0].logprob)
+        top.append(
+            {
+                (tl.decoded if tl.decoded is not None else str(tl.token_id)): tl.logprob
+                for tl in step[1:]
+            }
+            or None  # OpenAI uses null when no alternatives were requested
+        )
+        offsets.append(off)
+        off += len(s)
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_logprobs,
+        "top_logprobs": top,
+        "text_offset": offsets,
+    }
+
+
 def completion_envelope(
     id: str,
     model: str,
@@ -328,6 +379,7 @@ def completion_chunk(
     finish_reason: Optional[str] = None,
     created: Optional[int] = None,
     usage: Optional[Dict[str, Any]] = None,
+    logprobs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     chunk: Dict[str, Any] = {
         "id": id,
@@ -335,7 +387,7 @@ def completion_chunk(
         "created": created or int(time.time()),
         "model": model,
         "choices": [
-            {"index": index, "text": text, "logprobs": None, "finish_reason": finish_reason}
+            {"index": index, "text": text, "logprobs": logprobs, "finish_reason": finish_reason}
         ],
     }
     if usage is not None:

@@ -338,6 +338,7 @@ def decode_multi(
     min_p: Optional[jnp.ndarray] = None,  # [B]
     proc_params: Optional[Any] = None,  # logits_process.ProcParams
     proc_state: Optional[Any] = None,  # logits_process.ProcState
+    num_top_logprobs: int = 0,  # >0 → also return top-N alternatives/step
 ) -> Tuple[jnp.ndarray, ...]:
     """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
     single-token forward+sample steps). Minimizes host↔device round trips —
@@ -351,10 +352,17 @@ def decode_multi(
     are carried through the scan.
 
     Returns (tokens [B, num_steps], logprobs [B, num_steps], k_cache,
-    v_cache[, proc_state]).
+    v_cache[, proc_state]). With ``num_top_logprobs`` = N > 0 the tuple
+    gains (top_vals [B, num_steps, N], top_ids [B, num_steps, N]) right
+    after the logprobs entry — the per-step top-N alternatives that back
+    the OpenAI ``top_logprobs`` surface.
     """
     from dynamo_tpu.ops import logits_process as lp
-    from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
+    from dynamo_tpu.ops.sampling import (
+        compute_logprobs,
+        sample_tokens,
+        top_logprobs as top_logprobs_op,
+    )
 
     def one(carry, step_rng):
         if proc_state is not None:
@@ -376,20 +384,32 @@ def decode_multi(
             # Full-vocab log-softmax each step is pure waste when no active
             # request asked for logprobs (the common case).
             logp = jnp.zeros_like(nxt, dtype=jnp.float32)
+        ys = (nxt, logp)
+        if num_top_logprobs > 0:
+            tv, ti = top_logprobs_op(logits, num_top_logprobs)
+            ys = ys + (tv, ti)
         if st is not None:
             st = lp.record_tokens(st, nxt, active)
         pos = pos + active
         if st is not None:
-            return (nxt, pos, k_c, v_c, st), (nxt, logp)
-        return (nxt, pos, k_c, v_c), (nxt, logp)
+            return (nxt, pos, k_c, v_c, st), ys
+        return (nxt, pos, k_c, v_c), ys
 
     rngs = jax.random.split(rng, num_steps)
     if proc_state is not None:
-        (_, _, k_cache, v_cache, proc_state), (toks, logps) = jax.lax.scan(
+        (_, _, k_cache, v_cache, proc_state), ys = jax.lax.scan(
             one, (tokens, start_pos, k_cache, v_cache, proc_state), rngs
         )
-        return toks.T, logps.T, k_cache, v_cache, proc_state
-    (_, _, k_cache, v_cache), (toks, logps) = jax.lax.scan(
-        one, (tokens, start_pos, k_cache, v_cache), rngs
-    )
-    return toks.T, logps.T, k_cache, v_cache
+    else:
+        (_, _, k_cache, v_cache), ys = jax.lax.scan(
+            one, (tokens, start_pos, k_cache, v_cache), rngs
+        )
+    toks, logps = ys[0], ys[1]
+    out: Tuple[jnp.ndarray, ...] = (toks.T, logps.T)
+    if num_top_logprobs > 0:
+        # scan stacks on axis 0 (steps) → [B, S, N]
+        out = out + (ys[2].swapaxes(0, 1), ys[3].swapaxes(0, 1))
+    out = out + (k_cache, v_cache)
+    if proc_state is not None:
+        out = out + (proc_state,)
+    return out

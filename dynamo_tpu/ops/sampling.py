@@ -84,3 +84,14 @@ def compute_logprobs(
     """Log-probability of the chosen tokens (for logprobs=N support)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+
+
+def top_logprobs(
+    logits: jnp.ndarray,  # [B, V]
+    n: int,
+) -> tuple:
+    """Top-n (logprob, token_id) per row for OpenAI top_logprobs support.
+    Returns ([B, n] float32 logprobs, [B, n] int32 ids), descending."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(logp, n)
+    return vals, ids.astype(jnp.int32)

@@ -395,3 +395,46 @@ async def test_tls_serving(tmp_path):
     finally:
         await engine.stop()
         await service.stop(grace_period=1)
+
+
+async def test_responses_api_streaming():
+    """Responses API streaming: typed SSE events with sequence numbers
+    (created → output_text.delta* → output_text.done → completed)."""
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/responses",
+                json={
+                    "model": "mock-model",
+                    "input": "hello there",
+                    "max_output_tokens": 6,
+                    "stream": True,
+                },
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                events = []
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if line.startswith("data:"):
+                        events.append(json.loads(line[5:]))
+        types = [e["type"] for e in events]
+        assert types[0] == "response.created"
+        assert events[0]["response"]["status"] == "in_progress"
+        assert "response.output_text.delta" in types
+        assert types[-2] == "response.output_text.done"
+        assert types[-1] == "response.completed"
+        # sequence numbers are strictly increasing from 0
+        assert [e["sequence_number"] for e in events] == list(range(len(events)))
+        final = events[-1]["response"]
+        assert final["status"] == "completed"
+        full = final["output"][0]["content"][0]["text"]
+        deltas = "".join(
+            e["delta"] for e in events if e["type"] == "response.output_text.delta"
+        )
+        assert full == deltas and full
+        assert final["usage"]["output_tokens"] == 6
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)

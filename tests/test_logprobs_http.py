@@ -1,0 +1,248 @@
+"""logprobs / top_logprobs end-to-end: engine top-N production, protocol
+rendering, and the OpenAI HTTP surface (unary + streaming).
+
+Reference parity: the engines the reference orchestrates serve OpenAI
+logprobs; here the native engine computes top-N alternatives inside the
+fused decode program (models/llama.py decode_multi num_top_logprobs)."""
+
+import json
+import math
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.llm import ModelDeploymentCard, tiny_tokenizer
+from dynamo_tpu.llm.entrypoint import build_local_pipeline
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    TokenLogprob,
+)
+from dynamo_tpu.llm.protocols.openai import (
+    chat_logprobs_block,
+    completion_logprobs_block,
+)
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+
+
+def make_engine(**over):
+    defaults = dict(
+        config=tiny_config(),
+        block_size=4,
+        num_kv_blocks=64,
+        max_num_seqs=4,
+        max_model_len=128,
+        prefill_chunk=32,
+    )
+    defaults.update(over)
+    return JaxEngine(JaxEngineArgs(**defaults))
+
+
+async def test_engine_emits_topn_logprobs():
+    engine = make_engine()
+    try:
+        r = PreprocessedRequest(
+            token_ids=list(range(10, 22)),
+            request_id="lp",
+            sampling=SamplingOptions(temperature=0.0, logprobs=3),
+            stop=StopConditions(max_tokens=5),
+        )
+        outs = await collect(engine.generate(r, Context()))
+        steps = [s for o in outs if o.logprobs for s in o.logprobs]
+        assert len(steps) == 5
+        # EVERY token (including the prefill-produced first one) carries
+        # the requested top-3 alternatives.
+        for step in steps:
+            assert len(step) == 1 + 3
+            chosen, top = step[0], step[1:]
+            # greedy: the sampled token IS the argmax → equals top-1
+            assert chosen.token_id == top[0].token_id
+            assert math.isclose(chosen.logprob, top[0].logprob, rel_tol=1e-4)
+            # descending alternatives
+            assert top[0].logprob >= top[1].logprob >= top[2].logprob
+    finally:
+        await engine.stop()
+
+
+def test_logprob_block_rendering():
+    entries = [
+        [
+            TokenLogprob(token_id=5, logprob=-0.1, decoded="he"),
+            TokenLogprob(token_id=5, logprob=-0.1, decoded="he"),
+            TokenLogprob(token_id=7, logprob=-2.0, decoded="x"),
+        ],
+        [TokenLogprob(token_id=9, logprob=-0.5, decoded="llo")],
+    ]
+    chat = chat_logprobs_block(entries)
+    assert [e["token"] for e in chat["content"]] == ["he", "llo"]
+    assert chat["content"][0]["bytes"] == list(b"he")
+    assert len(chat["content"][0]["top_logprobs"]) == 2
+    assert chat["content"][1]["top_logprobs"] == []
+
+    comp = completion_logprobs_block(entries)
+    assert comp["tokens"] == ["he", "llo"]
+    assert comp["token_logprobs"] == [-0.1, -0.5]
+    assert comp["top_logprobs"][0] == {"he": -0.1, "x": -2.0}
+    assert comp["top_logprobs"][1] is None
+    assert comp["text_offset"] == [0, 2]
+
+
+async def start_service():
+    manager = ModelManager()
+    tok = tiny_tokenizer()
+    card = ModelDeploymentCard(name="tiny", context_length=128)
+    engine = make_engine()
+    pipeline = build_local_pipeline(card, engine, tokenizer=tok)
+    manager.register("tiny", pipeline, card)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    return service, engine, port
+
+
+async def test_chat_unary_logprobs_surface():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello world"}],
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                    "logprobs": True,
+                    "top_logprobs": 2,
+                },
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        lp = body["choices"][0]["logprobs"]
+        assert lp is not None and len(lp["content"]) == 4
+        for item in lp["content"]:
+            assert isinstance(item["token"], str)
+            assert item["logprob"] <= 0.0
+            assert isinstance(item["bytes"], list)
+            assert len(item["top_logprobs"]) == 2  # first token included
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_completions_streaming_logprobs_surface():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={
+                    "model": "tiny",
+                    "prompt": [5, 6, 7, 8, 9, 10],
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                    "logprobs": 2,
+                    "stream": True,
+                    "nvext": {"ignore_eos": True},
+                },
+            ) as resp:
+                assert resp.status == 200
+                tokens, token_lps = [], []
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line.endswith("[DONE]"):
+                        continue
+                    chunk = json.loads(line[5:])
+                    lp = chunk["choices"][0]["logprobs"]
+                    if lp:
+                        tokens.extend(lp["tokens"])
+                        token_lps.extend(lp["token_logprobs"])
+        assert len(tokens) == 4
+        assert all(v <= 0.0 for v in token_lps)
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_no_logprobs_by_default():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 2,
+                },
+            ) as resp:
+                body = await resp.json()
+        assert body["choices"][0]["logprobs"] is None
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_chat_logprobs_without_top_has_empty_alternatives():
+    """OpenAI contract: logprobs=true with no top_logprobs → each content
+    item has the sampled token's logprob and an EMPTY top_logprobs list."""
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 3,
+                    "temperature": 0.0,
+                    "logprobs": True,
+                },
+            ) as resp:
+                body = await resp.json()
+        lp = body["choices"][0]["logprobs"]
+        assert lp is not None and len(lp["content"]) == 3
+        assert all(item["top_logprobs"] == [] for item in lp["content"])
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
+
+
+async def test_streaming_completions_text_offset_accumulates():
+    service, engine, port = await start_service()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={
+                    "model": "tiny",
+                    "prompt": [5, 6, 7, 8, 9, 10],
+                    "max_tokens": 6,
+                    "temperature": 0.0,
+                    "logprobs": 0,
+                    "stream": True,
+                    "nvext": {"ignore_eos": True},
+                },
+            ) as resp:
+                offsets, tokens = [], []
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line.endswith("[DONE]"):
+                        continue
+                    lp = json.loads(line[5:])["choices"][0]["logprobs"]
+                    if lp:
+                        offsets.extend(lp["text_offset"])
+                        tokens.extend(lp["tokens"])
+        # offsets are the running char positions of each token in the
+        # concatenated completion, across chunk boundaries
+        expect, off = [], 0
+        for t in tokens:
+            expect.append(off)
+            off += len(t)
+        assert offsets == expect and len(offsets) == 6
+    finally:
+        await engine.stop()
+        await service.stop(grace_period=1)
