@@ -41,6 +41,19 @@ class ModelConfig:
     bos_token_id: Optional[int] = None
     dtype: Any = jnp.bfloat16
     name: str = "llama"
+    # Gemma-family knobs (defaults = llama semantics):
+    act_fn: str = "silu"  # "silu" | "gelu_tanh"
+    rmsnorm_unit_offset: bool = False  # weight stored as (w - 1), apply 1+w
+    post_norms: bool = False  # extra norms AFTER attention and FFN blocks
+    embed_scale: bool = False  # multiply embeddings by sqrt(d_model)
+    attn_logit_softcap: Optional[float] = None  # cap·tanh(s/cap) on scores
+    final_logit_softcap: Optional[float] = None  # same on lm_head logits
+    query_scale: Optional[float] = None  # q·scale⁻⁰·⁵ (query_pre_attn_scalar)
+    # Sliding-window attention: window size in tokens (None = full) applied
+    # to layers where ``layer_idx % sliding_window_every == 0`` (1 = all
+    # layers, Mistral-style; 2 = alternating, Gemma-2-style).
+    sliding_window: Optional[int] = None
+    sliding_window_every: int = 1
 
     @property
     def head_dim_(self) -> int:
@@ -58,6 +71,15 @@ class ModelConfig:
     def moe_d_ff_(self) -> int:
         return self.moe_d_ff if self.moe_d_ff is not None else self.d_ff
 
+    def layer_windows(self) -> List[int]:
+        """Per-layer attention window (0 = unlimited)."""
+        if not self.sliding_window:
+            return [0] * self.n_layers
+        return [
+            self.sliding_window if i % max(self.sliding_window_every, 1) == 0 else 0
+            for i in range(self.n_layers)
+        ]
+
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any], name: str = "") -> "ModelConfig":
         archs = cfg.get("architectures") or [""]
@@ -72,6 +94,25 @@ class ModelConfig:
         # MoE fields across HF dialects: Mixtral (num_local_experts),
         # Qwen-MoE (num_experts + moe_intermediate_size + norm_topk_prob)
         n_experts = cfg.get("num_local_experts") or cfg.get("num_experts") or 0
+        model_type = str(cfg.get("model_type", ""))
+        # Gemma-family: unit-offset norms, GeGLU, scaled/tied embeddings.
+        # Gemma-2 ADDS post-norms, softcaps and 1:1 local/global layers.
+        # Gemma-3 (5:1 pattern + qk-norm) is a different architecture we do
+        # not implement — refuse loudly rather than produce garbage logits.
+        gemma = "gemma" in arch or "gemma" in model_type
+        gemma2 = "gemma2" in arch or model_type == "gemma2"
+        if "gemma3" in arch or "gemma3" in model_type:
+            raise ValueError(
+                "gemma-3 checkpoints are not supported (qk-norm + 5:1 "
+                "local/global attention differ from the gemma-2 layout)"
+            )
+        # Some configs (Qwen2 dialect) carry a vestigial sliding_window with
+        # an explicit use_sliding_window=false gate — honor the gate.
+        sliding = (
+            cfg.get("sliding_window")
+            if cfg.get("use_sliding_window", True)
+            else None
+        )
         return cls(
             vocab_size=cfg["vocab_size"],
             d_model=cfg["hidden_size"],
@@ -88,10 +129,25 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             qkv_bias="qwen2" in arch,
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", gemma),
             eos_token_ids=eos_ids,
             bos_token_id=cfg.get("bos_token_id"),
             name=name or cfg.get("model_type", "llama"),
+            # Gemma-2 (ref: the HF Gemma2 config dialect)
+            act_fn=(
+                "gelu_tanh"
+                if cfg.get("hidden_act", cfg.get("hidden_activation"))
+                in ("gelu_pytorch_tanh", "gelu_tanh")
+                else "silu"
+            ),
+            rmsnorm_unit_offset=gemma,
+            post_norms=gemma2,
+            embed_scale=gemma,
+            attn_logit_softcap=cfg.get("attn_logit_softcapping"),
+            final_logit_softcap=cfg.get("final_logit_softcapping"),
+            query_scale=cfg.get("query_pre_attn_scalar"),
+            sliding_window=int(sliding) if sliding else None,
+            sliding_window_every=2 if gemma2 else 1,
         )
 
     @classmethod
@@ -192,4 +248,32 @@ def llama3_70b_config() -> ModelConfig:
         max_position_embeddings=8192,
         eos_token_ids=[128001, 128009],
         name="llama-3-70b",
+    )
+
+
+def gemma2_2b_config() -> ModelConfig:
+    """Gemma-2-2B shape (HF google/gemma-2-2b config.json values)."""
+    return ModelConfig(
+        vocab_size=256000,
+        d_model=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=256,
+        d_ff=9216,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+        eos_token_ids=[1, 107],
+        name="gemma-2-2b",
+        act_fn="gelu_tanh",
+        rmsnorm_unit_offset=True,
+        post_norms=True,
+        embed_scale=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_scale=256.0,
+        sliding_window=4096,
+        sliding_window_every=2,
     )

@@ -119,12 +119,19 @@ def load_hf_checkpoint(
     def to_jnp(a: np.ndarray, transpose: bool) -> jnp.ndarray:
         return jnp.asarray(to_np(a, transpose)).astype(c.dtype)
 
-    layer_names = list(_HF_LAYER_MAP)
+    layer_map = dict(_HF_LAYER_MAP)
+    if c.post_norms:
+        # Gemma-2 norm naming: post_attention_layernorm is a true POST-attn
+        # norm (llama reuses that name for the pre-MLP norm).
+        layer_map["attn_post_norm"] = ("post_attention_layernorm.weight", False)
+        layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
+        layer_map["mlp_post_norm"] = ("post_feedforward_layernorm.weight", False)
+    layer_names = list(layer_map)
     if not c.qkv_bias:
         layer_names = [n for n in layer_names if not n.startswith("b")]
     layers: Dict[str, List[Any]] = {n: [] for n in layer_names}
     for i in range(c.n_layers):
-        for ours, (suffix, transpose) in _HF_LAYER_MAP.items():
+        for ours, (suffix, transpose) in layer_map.items():
             if ours not in layers:
                 continue
             a = to_np(get(f"layers.{i}.{suffix}"), transpose)

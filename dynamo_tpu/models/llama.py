@@ -49,14 +49,20 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
     d, ff, H, KH = c.d_model, c.d_ff, c.n_heads, c.n_kv_heads
     s_d = d**-0.5
     s_ff = ff**-0.5
+    # Unit-offset norms (Gemma) store w-1 → effective weight 1+w; ones()
+    # here means effective 2.0 for them, fine for random init.
+    norm_fill = 0.0 if c.rmsnorm_unit_offset else 1.0
     layers: Params = {
-        "attn_norm": jnp.ones((L, d), dtype=c.dtype),
+        "attn_norm": jnp.full((L, d), norm_fill, dtype=c.dtype),
         "wq": norm(keys[0], (L, d, H * hd), s_d),
         "wk": norm(keys[1], (L, d, KH * hd), s_d),
         "wv": norm(keys[2], (L, d, KH * hd), s_d),
         "wo": norm(keys[3], (L, H * hd, d), (H * hd) ** -0.5),
-        "mlp_norm": jnp.ones((L, d), dtype=c.dtype),
+        "mlp_norm": jnp.full((L, d), norm_fill, dtype=c.dtype),
     }
+    if c.post_norms:
+        layers["attn_post_norm"] = jnp.full((L, d), norm_fill, dtype=c.dtype)
+        layers["mlp_post_norm"] = jnp.full((L, d), norm_fill, dtype=c.dtype)
     if c.is_moe:
         E, eff = c.n_experts, c.moe_d_ff_
         s_eff = eff**-0.5
@@ -75,7 +81,7 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
     params: Params = {
         "embed": norm(keys[7], (c.vocab_size, d), 1.0),
         "layers": layers,
-        "final_norm": jnp.ones((d,), dtype=c.dtype),
+        "final_norm": jnp.full((d,), norm_fill, dtype=c.dtype),
     }
     if not c.tie_word_embeddings:
         params["lm_head"] = norm(keys[8], (d, c.vocab_size), s_d)
@@ -92,6 +98,9 @@ def param_logical_axes(config: ModelConfig) -> Params:
         "wo": ("layers", "heads", "embed"),
         "mlp_norm": ("layers", "embed"),
     }
+    if config.post_norms:
+        layers["attn_post_norm"] = ("layers", "embed")
+        layers["mlp_post_norm"] = ("layers", "embed")
     if config.is_moe:
         layers["router_w"] = ("layers", "embed", None)
         layers["we_gate"] = ("layers", "experts", "embed", "ffn")
@@ -137,10 +146,20 @@ def kv_cache_logical_axes() -> Tuple[str, ...]:
 # ---------------------------------------------------------------------------
 
 
-def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+def _rms_norm(
+    x: jnp.ndarray, w: jnp.ndarray, eps: float, unit_offset: bool = False
+) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed = (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    # Gemma stores norm weights as (w - 1); effective scale is 1 + w.
+    return normed * (1.0 + w) if unit_offset else normed * w
+
+
+def _act(x: jnp.ndarray, act_fn: str) -> jnp.ndarray:
+    if act_fn == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def forward_paged(
@@ -173,6 +192,8 @@ def forward_paged(
     hd = c.head_dim_
 
     x = embed_lookup(params["embed"], tokens, c.dtype)  # [B, C, d]
+    if c.embed_scale:  # Gemma: embeddings scaled by sqrt(d_model)
+        x = x * jnp.asarray(c.d_model**0.5, dtype=c.dtype)
     if mm_embeds is not None and mm_slot is not None:
         # Multimodal splice: placeholder positions take precomputed image
         # embeddings instead of the token table (multimodal/handlers.py).
@@ -181,11 +202,19 @@ def forward_paged(
 
     pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]
+    uo = c.rmsnorm_unit_offset
+    sm_scale = (
+        c.query_scale**-0.5 if c.query_scale is not None else hd**-0.5
+    )
+    cap = float(c.attn_logit_softcap or 0.0)
+    # Per-layer sliding windows (0 = full) ride the scan xs so one traced
+    # body serves Gemma-2's alternating local/global layers.
+    windows = jnp.asarray(c.layer_windows(), dtype=jnp.int32)
 
     def layer_fn(carry, xs):
         x = carry
-        lp, k_c, v_c, ll = xs
-        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+        lp, k_c, v_c, ll, win = xs
+        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps, uo)
         q = qeinsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
         k = qeinsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
         v = qeinsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
@@ -203,53 +232,67 @@ def forward_paged(
         v_c = write_chunk_to_cache(v_c, v, block_tables, start_pos, chunk_lens)
 
         attn = paged_attention(
-            q, k_c, v_c, block_tables, start_pos, chunk_lens, use_kernel=use_kernel
+            q, k_c, v_c, block_tables, start_pos, chunk_lens,
+            use_kernel=use_kernel, sm_scale=sm_scale, window=win,
+            logit_cap=cap,
         ).reshape(B, C, -1)
-        x = x + qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
+        attn_out = qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
             ll, "wo", attn, adapter_ids
         )
+        if c.post_norms:
+            attn_out = _rms_norm(attn_out, lp["attn_post_norm"], c.rms_norm_eps, uo)
+        x = x + attn_out
 
-        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps, uo)
         if c.is_moe:
-            x = x + moe_ffn(
+            mlp_out = moe_ffn(
                 h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
                 top_k=c.n_experts_per_tok,
                 capacity_factor=c.moe_capacity_factor,
                 norm_topk_prob=c.norm_topk_prob,
             )
         else:
-            gate = jax.nn.silu(
+            gate = _act(
                 qeinsum("bcd,df->bcf", h, lp["w_gate"])
-                + lora_delta(ll, "w_gate", h, adapter_ids)
+                + lora_delta(ll, "w_gate", h, adapter_ids),
+                c.act_fn,
             )
             up = qeinsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
                 ll, "w_up", h, adapter_ids
             )
             gu = gate * up
-            x = (
-                x
-                + qeinsum("bcf,fd->bcd", gu, lp["w_down"])
-                + lora_delta(ll, "w_down", gu, adapter_ids)
+            mlp_out = qeinsum("bcf,fd->bcd", gu, lp["w_down"]) + lora_delta(
+                ll, "w_down", gu, adapter_ids
             )
+        if c.post_norms:
+            mlp_out = _rms_norm(mlp_out, lp["mlp_post_norm"], c.rms_norm_eps, uo)
+        x = x + mlp_out
         return x, (k_c, v_c)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache, lora or {})
+        layer_fn, x, (params["layers"], k_cache, v_cache, lora or {}, windows)
     )
 
-    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps, uo)
     head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
+
+    def _final(logits: jnp.ndarray) -> jnp.ndarray:
+        if c.final_logit_softcap:
+            fcap = float(c.final_logit_softcap)
+            logits = fcap * jnp.tanh(logits / fcap)
+        return logits
+
     if all_logits:
         # Every position's logits (speculative verify reads them all).
         return (
-            q_lm_head(x, head, tied=c.tie_word_embeddings),
+            _final(q_lm_head(x, head, tied=c.tie_word_embeddings)),
             k_cache,
             v_cache,
         )
     # Only the last valid position's logits are needed (sampling).
     last_idx = jnp.clip(chunk_lens - 1, 0, C - 1)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, d]
-    logits = q_lm_head(x_last, head, tied=c.tie_word_embeddings)
+    logits = _final(q_lm_head(x_last, head, tied=c.tie_word_embeddings))
     return logits, k_cache, v_cache
 
 
@@ -265,13 +308,20 @@ def encode(
     c = config
     B, T = tokens.shape
     hd = c.head_dim_
+    uo = c.rmsnorm_unit_offset
+    sm_scale = c.query_scale**-0.5 if c.query_scale is not None else hd**-0.5
+    cap = float(c.attn_logit_softcap or 0.0)
+    windows = jnp.asarray(c.layer_windows(), dtype=jnp.int32)
     x = embed_lookup(params["embed"], tokens, c.dtype)
+    if c.embed_scale:
+        x = x * jnp.asarray(c.d_model**0.5, dtype=c.dtype)
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)
 
-    def layer_fn(carry, lp):
+    def layer_fn(carry, xs):
         x = carry
-        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+        lp, win = xs
+        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps, uo)
         q = qeinsum("btd,dh->bth", h, lp["wq"])
         k = qeinsum("btd,dh->bth", h, lp["wk"])
         v = qeinsum("btd,dh->bth", h, lp["wv"])
@@ -284,31 +334,39 @@ def encode(
         qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)
         kf = jnp.repeat(k.astype(jnp.float32).transpose(0, 2, 1, 3), G, axis=1)
         vf = jnp.repeat(v.astype(jnp.float32).transpose(0, 2, 1, 3), G, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * hd**-0.5
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
+        if cap > 0.0:
+            s = cap * jnp.tanh(s / cap)
         t_q = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
         t_k = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
-        causal = t_q >= t_k
+        causal = (t_q >= t_k) & ((win <= 0) | (t_k > t_q - win))
         valid = t_k[None] < lengths[:, None, None]  # padded keys masked
         s = jnp.where(causal[None, None] & valid[:, None], s, -1e30)
         attn = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vf)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, T, -1).astype(x.dtype)
-        x = x + qeinsum("bth,hd->btd", attn, lp["wo"])
-        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+        attn_out = qeinsum("bth,hd->btd", attn, lp["wo"])
+        if c.post_norms:
+            attn_out = _rms_norm(attn_out, lp["attn_post_norm"], c.rms_norm_eps, uo)
+        x = x + attn_out
+        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps, uo)
         if c.is_moe:
-            x = x + moe_ffn(
+            mlp_out = moe_ffn(
                 h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
                 top_k=c.n_experts_per_tok,
                 capacity_factor=c.moe_capacity_factor,
                 norm_topk_prob=c.norm_topk_prob,
             )
         else:
-            gate = jax.nn.silu(qeinsum("btd,df->btf", h, lp["w_gate"]))
+            gate = _act(qeinsum("btd,df->btf", h, lp["w_gate"]), c.act_fn)
             up = qeinsum("btd,df->btf", h, lp["w_up"])
-            x = x + qeinsum("btf,fd->btd", gate * up, lp["w_down"])
+            mlp_out = qeinsum("btf,fd->btd", gate * up, lp["w_down"])
+        if c.post_norms:
+            mlp_out = _rms_norm(mlp_out, lp["mlp_post_norm"], c.rms_norm_eps, uo)
+        x = x + mlp_out
         return x, None
 
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps).astype(jnp.float32)
+    x, _ = jax.lax.scan(layer_fn, x, (params["layers"], windows))
+    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps, uo).astype(jnp.float32)
     mask = (jax.lax.broadcasted_iota(jnp.int32, (B, T), 1) < lengths[:, None])
     pooled = (x * mask[..., None]).sum(1) / jnp.maximum(
         lengths[:, None].astype(jnp.float32), 1.0

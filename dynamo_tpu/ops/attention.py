@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import logging
 from functools import partial
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -77,12 +77,18 @@ def paged_attention(
     *,
     sm_scale: Optional[float] = None,
     use_kernel: bool = False,
+    window: Any = 0,  # sliding window in tokens (int or traced scalar); 0 = full
+    logit_cap: float = 0.0,  # cap·tanh(s/cap) score softcap; 0 = off
 ) -> jnp.ndarray:
     """Returns [B, C, n_heads, head_dim].
 
     The chunk's own K/V must already be written into the cache (the model
     writes the chunk before attending); causality is enforced by masking key
-    position t to t <= start_pos + c for query offset c.
+    position t to t <= start_pos + c for query offset c. ``window`` > 0
+    additionally hides keys with t <= start_pos + c - window (Mistral-SWA /
+    Gemma-2 alternating-layer sliding windows) — it may be a TRACED scalar
+    so a lax.scan over layers can alternate windowed/full layers in one
+    compiled body; ``logit_cap`` applies the Gemma-2 score softcap.
     """
     if use_kernel:
         if q.shape[1] == 1:
@@ -92,21 +98,25 @@ def paged_attention(
             if decode_kernel is not None:
                 return decode_kernel(
                     q, k_cache, v_cache, block_tables, start_pos,
-                    sm_scale=sm_scale,
+                    sm_scale=sm_scale, window=window, logit_cap=logit_cap,
                 )
         kernel = _load_kernel()
         if kernel is not None:
             return kernel(
                 q, k_cache, v_cache, block_tables, start_pos, chunk_lens,
-                sm_scale=sm_scale,
+                sm_scale=sm_scale, window=window, logit_cap=logit_cap,
             )
     return _paged_attention_xla(
-        q, k_cache, v_cache, block_tables, start_pos, chunk_lens, sm_scale=sm_scale
+        q, k_cache, v_cache, block_tables, start_pos, chunk_lens, window,
+        sm_scale=sm_scale, logit_cap=logit_cap,
     )
 
 
-@partial(jax.jit, static_argnames=("sm_scale",))
-def _paged_attention_xla(q, k_cache, v_cache, block_tables, start_pos, chunk_lens, *, sm_scale=None):
+@partial(jax.jit, static_argnames=("sm_scale", "logit_cap"))
+def _paged_attention_xla(
+    q, k_cache, v_cache, block_tables, start_pos, chunk_lens,
+    window=0, *, sm_scale=None, logit_cap: float = 0.0,
+):
     B, C, n_heads, head_dim = q.shape
     num_blocks, block_size, n_kv_heads, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
@@ -122,11 +132,15 @@ def _paged_attention_xla(q, k_cache, v_cache, block_tables, start_pos, chunk_len
     qg = q.reshape(B, C, n_kv_heads, q_per_kv, head_dim).astype(jnp.float32)
     kf = k.astype(jnp.float32)
     scores = jnp.einsum("bcghd,btgd->bcght", qg, kf) * scale  # [B,C,KH,G,T]
+    if logit_cap > 0.0:
+        scores = logit_cap * jnp.tanh(scores / logit_cap)
 
     t_pos = jax.lax.broadcasted_iota(jnp.int32, (B, C, T), 2)
     c_pos = jax.lax.broadcasted_iota(jnp.int32, (B, C, T), 1)
     limit = start_pos[:, None, None] + c_pos  # key t visible iff t <= start+c
     mask = t_pos <= limit  # [B, C, T]
+    w = jnp.asarray(window, jnp.int32)
+    mask = mask & ((w <= 0) | (t_pos > limit - w))
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)

@@ -51,6 +51,7 @@ def _kernel(
     block_tables_ref,  # [B, P_pad] int32 (SMEM)
     start_pos_ref,  # [B] int32
     chunk_lens_ref,  # [B] int32
+    window_ref,  # [1] int32 — sliding window (0 = full attention)
     # VMEM blocks: q, then S (k, v) page pairs
     q_ref,  # [1, KH, C*G, D] (host pre-transposed: rows are (c, g), c-major)
     *refs,  # k_0, v_0, ..., k_{S-1}, v_{S-1}, o_ref, m, l, acc
@@ -58,6 +59,7 @@ def _kernel(
     block_size: int,
     n_groups: int,
     pages_per_step: int,
+    logit_cap: float = 0.0,
 ):
     S = pages_per_step
     kv_refs = refs[: 2 * S]
@@ -85,8 +87,14 @@ def _kernel(
     # Highest key position any valid query in this sequence can see is
     # start + clen - 1 (the chunk's own K/V are already in the cache).
     last_needed_page = jnp.maximum(start + clen - 1, 0) // block_size
+    win = window_ref[0]
+    # With a window, the EARLIEST key any query (offset 0) can see is
+    # start - win + 1; earlier page groups skip entirely.
+    first_needed_group = jnp.where(
+        win > 0, jnp.maximum(start - win + 1, 0) // block_size // S, 0
+    )
 
-    @pl.when(p * S <= last_needed_page)
+    @pl.when((p >= first_needed_group) & (p * S <= last_needed_page))
     def _compute():
         # Causal mask across the whole page group, shared by every head:
         # key position t visible to query offset c iff t <= start + c.
@@ -94,6 +102,7 @@ def _kernel(
         c_idx = jax.lax.broadcasted_iota(jnp.int32, (CG, W), 0) // G
         t_idx = p * W + jax.lax.broadcasted_iota(jnp.int32, (CG, W), 1)
         visible = t_idx <= start + c_idx
+        visible = visible & ((win <= 0) | (t_idx > start + c_idx - win))
 
         for h in range(KH):  # static unroll; KH is small (2-8)
             q = q_ref[0, h].astype(jnp.float32)  # [CG, D]
@@ -111,6 +120,8 @@ def _kernel(
                 )
                 * sm_scale
             )  # [CG, W]
+            if logit_cap > 0.0:
+                s_mat = logit_cap * jnp.tanh(s_mat / logit_cap)
             s_mat = jnp.where(visible, s_mat, NEG_INF)
 
             m_prev = m_ref[h]
@@ -137,12 +148,14 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [B, P] int32 (SMEM)
     start_pos_ref,  # [B] int32
+    window_ref,  # [1] int32 — sliding window (0 = full attention)
     # VMEM blocks: q [BQ, KH, G, D], then BQ (k, v) page pairs
     q_ref,
     *refs,  # k_0, v_0, ..., k_{BQ-1}, v_{BQ-1}, o_ref, m, l, acc
     sm_scale: float,
     block_size: int,
     batch_block: int,
+    logit_cap: float = 0.0,
 ):
     """Decode-specialized (C=1) kernel: the grid is (B/BQ, pages) and each
     sequential grid step visits ONE page of BQ different sequences. The
@@ -167,16 +180,23 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    win = window_ref[0]
     for j in range(BQ):  # static unroll over the sequence block
         start = start_pos_ref[bb * BQ + j]
         last_needed_page = start // block_size  # query position == start
+        # With a sliding window, pages wholly before start-win+1 skip both
+        # their compute AND never affect the causal/window mask.
+        first_needed_page = jnp.where(
+            win > 0, jnp.maximum(start - win + 1, 0) // block_size, 0
+        )
 
-        @pl.when(p <= last_needed_page)
+        @pl.when((p >= first_needed_page) & (p <= last_needed_page))
         def _compute(j=j, start=start):
             t_idx = p * block_size + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_size), 1
             )
             visible = t_idx <= start  # [1, bs], every (g) row shares it
+            visible = visible & ((win <= 0) | (t_idx > start - win))
             for h in range(KH):
                 q = q_ref[j, h].astype(jnp.float32)  # [G, D]
                 k = kv_refs[2 * j][0, :, h, :].astype(jnp.float32)  # [bs, D]
@@ -188,6 +208,8 @@ def _decode_kernel(
                     )
                     * sm_scale
                 )  # [G, bs]
+                if logit_cap > 0.0:
+                    s_mat = logit_cap * jnp.tanh(s_mat / logit_cap)
                 s_mat = jnp.where(visible, s_mat, NEG_INF)
                 m_prev = m_ref[j, h]
                 m_new = jnp.maximum(
@@ -213,7 +235,7 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "batch_block")
+    jax.jit, static_argnames=("sm_scale", "interpret", "batch_block", "logit_cap")
 )
 def paged_attention_decode_kernel(
     q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
@@ -221,14 +243,19 @@ def paged_attention_decode_kernel(
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     start_pos: jnp.ndarray,  # [B] int32
+    window=0,  # sliding window (int or traced scalar); 0 = full
     *,
     sm_scale: Optional[float] = None,
     interpret: bool = False,
     batch_block: int = 8,
+    logit_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Decode-path (C=1) batch-blocked kernel. Same contract as the XLA
     oracle at C=1; B is padded to a multiple of ``batch_block`` (padded
-    rows read page 0 at position 0 — one valid key, discarded output)."""
+    rows read page 0 at position 0 — one valid key, discarded output).
+    With a sliding ``window``, page-group steps wholly before the window
+    skip their compute (long-context decode on windowed layers gets
+    cheaper, the SWA point)."""
     B, C, n_heads, head_dim = q.shape
     assert C == 1, "decode kernel serves single-token steps"
     _, block_size, n_kv_heads, _ = k_cache.shape
@@ -245,12 +272,13 @@ def paged_attention_decode_kernel(
     q4 = q.reshape(B_pad, 1, n_kv_heads, G, head_dim)[:, 0]  # [B, KH, G, D]
     q4 = q4.reshape(B_pad, n_kv_heads, G, head_dim)
     P = block_tables.shape[1]
+    win = jnp.asarray(window, jnp.int32).reshape(1)
 
-    def q_map(bb, p, bt, sp):
+    def q_map(bb, p, bt, sp, w):
         return (bb, 0, 0, 0)
 
     def kv_map_for(j):
-        def kv_map(bb, p, bt, sp):
+        def kv_map(bb, p, bt, sp, w):
             return (bt[bb * BQ + j, p], 0, 0, 0)
 
         return kv_map
@@ -263,7 +291,7 @@ def paged_attention_decode_kernel(
         kv_args.extend([k_cache, v_cache])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B_pad // BQ, P),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map),
@@ -274,7 +302,8 @@ def paged_attention_decode_kernel(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, sm_scale=scale, block_size=block_size, batch_block=BQ
+        _decode_kernel, sm_scale=scale, block_size=block_size, batch_block=BQ,
+        logit_cap=logit_cap,
     )
     out = pl.pallas_call(
         kernel,
@@ -286,6 +315,7 @@ def paged_attention_decode_kernel(
     )(
         block_tables.astype(jnp.int32),
         start_pos.astype(jnp.int32),
+        win,
         q4,
         *kv_args,
     )
@@ -294,7 +324,8 @@ def paged_attention_decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_step")
+    jax.jit,
+    static_argnames=("sm_scale", "interpret", "pages_per_step", "logit_cap"),
 )
 def paged_attention_kernel(
     q: jnp.ndarray,  # [B, C, n_heads, head_dim]
@@ -303,6 +334,7 @@ def paged_attention_kernel(
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     start_pos: jnp.ndarray,  # [B] int32
     chunk_lens: jnp.ndarray,  # [B] int32
+    window=0,  # sliding window (int or traced scalar); 0 = full
     *,
     sm_scale: Optional[float] = None,
     interpret: bool = False,
@@ -310,6 +342,7 @@ def paged_attention_kernel(
     # to VMEM copies that cost more than the per-iteration overhead saved.
     # The knob stays for future Mosaic versions / other topologies.
     pages_per_step: int = 1,
+    logit_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Returns [B, C, n_heads, head_dim]; same contract as the XLA oracle
     (ops/attention.py::_paged_attention_xla)."""
@@ -319,6 +352,7 @@ def paged_attention_kernel(
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
     S = max(min(pages_per_step, P), 1)
+    win = jnp.asarray(window, jnp.int32).reshape(1)
 
     # Pad the table width to a multiple of S; padded entries point at page 0
     # whose keys land beyond every sequence's causal limit (masked).
@@ -333,11 +367,11 @@ def paged_attention_kernel(
     q5 = q.reshape(B, C, n_kv_heads, G, head_dim).transpose(0, 2, 1, 3, 4)
     q5 = q5.reshape(B, n_kv_heads, C * G, head_dim)
 
-    def q_map(b, p, bt, sp, cl):
+    def q_map(b, p, bt, sp, cl, w):
         return (b, 0, 0, 0)
 
     def kv_map_for(s):
-        def kv_map(b, p, bt, sp, cl):
+        def kv_map(b, p, bt, sp, cl, w):
             return (bt[b, p * S + s], 0, 0, 0)
 
         return kv_map
@@ -352,7 +386,7 @@ def paged_attention_kernel(
         kv_args.extend([k_cache, v_cache])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, P_pad // S),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map),
@@ -365,7 +399,7 @@ def paged_attention_kernel(
 
     kernel = functools.partial(
         _kernel, sm_scale=scale, block_size=block_size, n_groups=G,
-        pages_per_step=S,
+        pages_per_step=S, logit_cap=logit_cap,
     )
     out = pl.pallas_call(
         kernel,
@@ -378,6 +412,7 @@ def paged_attention_kernel(
         block_tables.astype(jnp.int32),
         start_pos.astype(jnp.int32),
         chunk_lens.astype(jnp.int32),
+        win,
         q5,
         *kv_args,
     )

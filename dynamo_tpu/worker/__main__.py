@@ -12,8 +12,10 @@ from dynamo_tpu.llm.discovery import register_llm
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig
 from dynamo_tpu.models.config import (
     ModelConfig,
+    gemma2_2b_config,
     llama3_8b_config,
     llama3_70b_config,
+    mixtral_8x7b_config,
     qwen2_500m_config,
     tiny_config,
 )
@@ -27,6 +29,8 @@ BUILTIN_CONFIGS = {
     "qwen2.5-0.5b": qwen2_500m_config,
     "llama-3-8b": llama3_8b_config,
     "llama-3-70b": llama3_70b_config,
+    "gemma-2-2b": gemma2_2b_config,
+    "mixtral-8x7b": mixtral_8x7b_config,
 }
 
 

@@ -279,3 +279,116 @@ def test_int8_checkpoint_load_logits_close(tmp_path):
     # fp cache key unaffected
     pf, hitf = load_checkpoint_cached(str(model_dir), config, cache_dir=cache_dir)
     assert not hitf and not is_quantized(pf)
+
+
+def _make_gemma2_dir(tmp_path):
+    """Tiny Gemma-2: alternating sliding-window layers, softcaps, GeGLU,
+    unit-offset + post norms, scaled embeddings — every arch knob."""
+    torch.manual_seed(11)
+    cfg = transformers.Gemma2Config(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        query_pre_attn_scalar=16,
+        sliding_window=8,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        hidden_activation="gelu_pytorch_tanh",
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        eos_token_id=0,
+        bos_token_id=None,
+        attn_implementation="eager",  # sdpa skips the softcap
+    )
+    model = transformers.Gemma2ForCausalLM(cfg).eval().to(torch.float32)
+    model_dir = tmp_path / "gemma2-tiny"
+    model.save_pretrained(str(model_dir), safe_serialization=True)
+    _save_tokenizer(model_dir)
+    return model_dir, model
+
+
+def test_gemma2_config_ingestion(tmp_path):
+    model_dir, _ = _make_gemma2_dir(tmp_path)
+    config = _our_config(model_dir)
+    assert config.act_fn == "gelu_tanh"
+    assert config.rmsnorm_unit_offset and config.post_norms and config.embed_scale
+    assert config.attn_logit_softcap == 50.0
+    assert config.final_logit_softcap == 30.0
+    assert config.query_scale == 16
+    assert config.sliding_window == 8 and config.sliding_window_every == 2
+    assert config.tie_word_embeddings
+    # alternating pattern: even layers windowed
+    assert config.layer_windows() == [8, 0, 8, 0]
+
+
+async def test_gemma2_checkpoint_greedy_decode_parity(tmp_path):
+    """Prompt longer than the sliding window (8) so local layers actually
+    mask; greedy tokens must match transformers exactly."""
+    model_dir, hf = _make_gemma2_dir(tmp_path)
+    config = _our_config(model_dir)
+    engine = _engine_for(model_dir, config)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, VOCAB, size=21).tolist()
+    try:
+        ours = await _engine_greedy(engine, prompt, 12)
+    finally:
+        await engine.stop()
+    assert ours == _hf_greedy(hf, prompt, 12)
+
+
+def test_gemma2_logits_parity(tmp_path):
+    model_dir, hf = _make_gemma2_dir(tmp_path)
+    config = _our_config(model_dir)
+    prompt = [3, 17, 42, 99, 5, 250, 11, 64, 7, 8, 9, 200, 13, 77, 101]
+    params = load_hf_checkpoint(str(model_dir), config)
+    k, v = llama.init_kv_cache(config, 16, 4)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :4] = [1, 2, 3, 4]
+    logits, _, _ = llama.forward_paged(
+        params, config,
+        jnp.asarray([prompt], dtype=jnp.int32),
+        jnp.zeros(1, jnp.int32),
+        jnp.asarray([len(prompt)], dtype=jnp.int32),
+        jnp.asarray(table), k, v,
+    )
+    with torch.no_grad():
+        ref = hf(torch.tensor([prompt])).logits[0, -1].numpy()
+    np.testing.assert_allclose(np.asarray(logits[0]), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_config_dialect_gates():
+    """Family detection edges: Gemma-1 (no post-norms), Qwen2's vestigial
+    sliding_window behind use_sliding_window=false, Gemma-3 refusal."""
+    base = dict(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+    )
+    g1 = ModelConfig.from_hf_config(
+        {**base, "architectures": ["GemmaForCausalLM"], "model_type": "gemma",
+         "hidden_activation": "gelu_pytorch_tanh"}
+    )
+    assert g1.rmsnorm_unit_offset and g1.embed_scale and g1.tie_word_embeddings
+    assert not g1.post_norms and g1.sliding_window is None
+
+    qwen = ModelConfig.from_hf_config(
+        {**base, "architectures": ["Qwen2ForCausalLM"],
+         "sliding_window": 4096, "use_sliding_window": False}
+    )
+    assert qwen.sliding_window is None
+
+    mistral = ModelConfig.from_hf_config(
+        {**base, "architectures": ["MistralForCausalLM"], "sliding_window": 4096}
+    )
+    assert mistral.sliding_window == 4096 and mistral.sliding_window_every == 1
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="gemma-3"):
+        ModelConfig.from_hf_config(
+            {**base, "architectures": ["Gemma3ForCausalLM"], "model_type": "gemma3"}
+        )

@@ -97,3 +97,54 @@ def test_use_kernel_flag_falls_back_without_crash(monkeypatch):
     out = paged_attention(q, k, v, bt, start, cl, use_kernel=True)
     ref = _paged_attention_xla(q, k, v, bt, start, cl)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("window", [4, 16, 40])
+def test_decode_kernel_sliding_window_matches_oracle(window):
+    from dynamo_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_kernel,
+    )
+
+    rng = np.random.default_rng(window)
+    B, H, KH, D, bs, P = 5, 4, 2, 64, 16, 6
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B * P + 2, bs, KH, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B * P + 2, bs, KH, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(B * P + 2)[: B * P].reshape(B, P).astype(np.int32))
+    start = jnp.asarray(rng.integers(0, P * bs - 1, B).astype(np.int32))
+    cl = jnp.ones((B,), jnp.int32)
+
+    ref = np.asarray(
+        _paged_attention_xla(q, k, v, bt, start, cl, window)
+    )
+    out = np.asarray(
+        paged_attention_decode_kernel(
+            q, k, v, bt, start, window, interpret=True, batch_block=2
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_prefill_kernel_window_and_softcap_match_oracle():
+    rng = np.random.default_rng(99)
+    B, C, H, KH, D, bs, P = 3, 8, 4, 2, 64, 16, 4
+    q = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B * P + 2, bs, KH, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B * P + 2, bs, KH, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(B * P + 2)[: B * P].reshape(B, P).astype(np.int32))
+    start = jnp.asarray([0, 13, 30], jnp.int32)
+    cl = jnp.asarray([8, 8, 5], jnp.int32)
+    for window, cap in ((6, 0.0), (0, 5.0), (10, 5.0)):
+        ref = np.asarray(
+            _paged_attention_xla(q, k, v, bt, start, cl, window, logit_cap=cap)
+        )
+        out = np.asarray(
+            paged_attention_kernel(
+                q, k, v, bt, start, cl, window, interpret=True, logit_cap=cap
+            )
+        )
+        for b in range(B):
+            n = int(cl[b])
+            np.testing.assert_allclose(
+                out[b, :n], ref[b, :n], atol=2e-5, rtol=2e-5
+            )
