@@ -162,6 +162,121 @@ def _act(x: jnp.ndarray, act_fn: str) -> jnp.ndarray:
     return jax.nn.silu(x)
 
 
+def decoder_layer(
+    c: ModelConfig,
+    lp: Params,  # one layer's params (axis 0 stripped)
+    ll: Dict[str, Any],  # one layer's stacked LoRA arrays ({} = none)
+    win: jnp.ndarray,  # scalar int32 sliding window (0 = full)
+    x: jnp.ndarray,  # [B, C, d]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_c: jnp.ndarray,  # [num_blocks, block_size, KH, D] — this layer's pool
+    v_c: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    chunk_lens: jnp.ndarray,
+    *,
+    use_kernel: bool,
+    adapter_ids: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder layer (attention + FFN, all family knobs). Shared by the
+    scan-over-layers forward and the pipeline-parallel stage executor
+    (parallel/pipeline.py), so every architecture behavior lives in exactly
+    one place."""
+    B, C = x.shape[:2]
+    hd = c.head_dim_
+    uo = c.rmsnorm_unit_offset
+    sm_scale = c.query_scale**-0.5 if c.query_scale is not None else hd**-0.5
+    cap = float(c.attn_logit_softcap or 0.0)
+
+    h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps, uo)
+    q = qeinsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
+    k = qeinsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
+    v = qeinsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
+    if c.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, C, c.n_heads, hd)
+    k = k.reshape(B, C, c.n_kv_heads, hd)
+    v = v.reshape(B, C, c.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_c = write_chunk_to_cache(k_c, k, block_tables, start_pos, chunk_lens)
+    v_c = write_chunk_to_cache(v_c, v, block_tables, start_pos, chunk_lens)
+
+    attn = paged_attention(
+        q, k_c, v_c, block_tables, start_pos, chunk_lens,
+        use_kernel=use_kernel, sm_scale=sm_scale, window=win,
+        logit_cap=cap,
+    ).reshape(B, C, -1)
+    attn_out = qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
+        ll, "wo", attn, adapter_ids
+    )
+    if c.post_norms:
+        attn_out = _rms_norm(attn_out, lp["attn_post_norm"], c.rms_norm_eps, uo)
+    x = x + attn_out
+
+    h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps, uo)
+    if c.is_moe:
+        mlp_out = moe_ffn(
+            h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
+            top_k=c.n_experts_per_tok,
+            capacity_factor=c.moe_capacity_factor,
+            norm_topk_prob=c.norm_topk_prob,
+        )
+    else:
+        gate = _act(
+            qeinsum("bcd,df->bcf", h, lp["w_gate"])
+            + lora_delta(ll, "w_gate", h, adapter_ids),
+            c.act_fn,
+        )
+        up = qeinsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
+            ll, "w_up", h, adapter_ids
+        )
+        gu = gate * up
+        mlp_out = qeinsum("bcf,fd->bcd", gu, lp["w_down"]) + lora_delta(
+            ll, "w_down", gu, adapter_ids
+        )
+    if c.post_norms:
+        mlp_out = _rms_norm(mlp_out, lp["mlp_post_norm"], c.rms_norm_eps, uo)
+    x = x + mlp_out
+    return x, k_c, v_c
+
+
+def embed_tokens(
+    params: Params,
+    config: ModelConfig,
+    tokens: jnp.ndarray,
+    mm_embeds: Optional[jnp.ndarray] = None,
+    mm_slot: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Token (+ multimodal splice) embeddings with family scaling."""
+    c = config
+    x = embed_lookup(params["embed"], tokens, c.dtype)
+    if c.embed_scale:  # Gemma: embeddings scaled by sqrt(d_model)
+        x = x * jnp.asarray(c.d_model**0.5, dtype=c.dtype)
+    if mm_embeds is not None and mm_slot is not None:
+        rows = mm_embeds[jnp.clip(mm_slot, 0, mm_embeds.shape[0] - 1)]
+        x = jnp.where((mm_slot >= 0)[..., None], rows.astype(x.dtype), x)
+    return x
+
+
+def lm_head_logits(
+    params: Params, config: ModelConfig, x: jnp.ndarray
+) -> jnp.ndarray:
+    """Final norm → vocab projection → final softcap. x: [..., d]."""
+    c = config
+    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps, c.rmsnorm_unit_offset)
+    head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
+    logits = q_lm_head(x, head, tied=c.tie_word_embeddings)
+    if c.final_logit_softcap:
+        fcap = float(c.final_logit_softcap)
+        logits = fcap * jnp.tanh(logits / fcap)
+    return logits
+
+
 def forward_paged(
     params: Params,
     config: ModelConfig,
@@ -191,22 +306,10 @@ def forward_paged(
     B, C = tokens.shape
     hd = c.head_dim_
 
-    x = embed_lookup(params["embed"], tokens, c.dtype)  # [B, C, d]
-    if c.embed_scale:  # Gemma: embeddings scaled by sqrt(d_model)
-        x = x * jnp.asarray(c.d_model**0.5, dtype=c.dtype)
-    if mm_embeds is not None and mm_slot is not None:
-        # Multimodal splice: placeholder positions take precomputed image
-        # embeddings instead of the token table (multimodal/handlers.py).
-        rows = mm_embeds[jnp.clip(mm_slot, 0, mm_embeds.shape[0] - 1)]
-        x = jnp.where((mm_slot >= 0)[..., None], rows.astype(x.dtype), x)
+    x = embed_tokens(params, c, tokens, mm_embeds, mm_slot)  # [B, C, d]
 
     pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]
-    uo = c.rmsnorm_unit_offset
-    sm_scale = (
-        c.query_scale**-0.5 if c.query_scale is not None else hd**-0.5
-    )
-    cap = float(c.attn_logit_softcap or 0.0)
     # Per-layer sliding windows (0 = full) ride the scan xs so one traced
     # body serves Gemma-2's alternating local/global layers.
     windows = jnp.asarray(c.layer_windows(), dtype=jnp.int32)
@@ -214,86 +317,24 @@ def forward_paged(
     def layer_fn(carry, xs):
         x = carry
         lp, k_c, v_c, ll, win = xs
-        h = _rms_norm(x, lp["attn_norm"], c.rms_norm_eps, uo)
-        q = qeinsum("bcd,dh->bch", h, lp["wq"]) + lora_delta(ll, "wq", h, adapter_ids)
-        k = qeinsum("bcd,dh->bch", h, lp["wk"]) + lora_delta(ll, "wk", h, adapter_ids)
-        v = qeinsum("bcd,dh->bch", h, lp["wv"]) + lora_delta(ll, "wv", h, adapter_ids)
-        if c.qkv_bias:
-            q = q + lp["bq"]
-            k = k + lp["bk"]
-            v = v + lp["bv"]
-        q = q.reshape(B, C, c.n_heads, hd)
-        k = k.reshape(B, C, c.n_kv_heads, hd)
-        v = v.reshape(B, C, c.n_kv_heads, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-
-        k_c = write_chunk_to_cache(k_c, k, block_tables, start_pos, chunk_lens)
-        v_c = write_chunk_to_cache(v_c, v, block_tables, start_pos, chunk_lens)
-
-        attn = paged_attention(
-            q, k_c, v_c, block_tables, start_pos, chunk_lens,
-            use_kernel=use_kernel, sm_scale=sm_scale, window=win,
-            logit_cap=cap,
-        ).reshape(B, C, -1)
-        attn_out = qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
-            ll, "wo", attn, adapter_ids
+        x, k_c, v_c = decoder_layer(
+            c, lp, ll, win, x, cos, sin, k_c, v_c,
+            block_tables, start_pos, chunk_lens,
+            use_kernel=use_kernel, adapter_ids=adapter_ids,
         )
-        if c.post_norms:
-            attn_out = _rms_norm(attn_out, lp["attn_post_norm"], c.rms_norm_eps, uo)
-        x = x + attn_out
-
-        h = _rms_norm(x, lp["mlp_norm"], c.rms_norm_eps, uo)
-        if c.is_moe:
-            mlp_out = moe_ffn(
-                h, lp["router_w"], lp["we_gate"], lp["we_up"], lp["we_down"],
-                top_k=c.n_experts_per_tok,
-                capacity_factor=c.moe_capacity_factor,
-                norm_topk_prob=c.norm_topk_prob,
-            )
-        else:
-            gate = _act(
-                qeinsum("bcd,df->bcf", h, lp["w_gate"])
-                + lora_delta(ll, "w_gate", h, adapter_ids),
-                c.act_fn,
-            )
-            up = qeinsum("bcd,df->bcf", h, lp["w_up"]) + lora_delta(
-                ll, "w_up", h, adapter_ids
-            )
-            gu = gate * up
-            mlp_out = qeinsum("bcf,fd->bcd", gu, lp["w_down"]) + lora_delta(
-                ll, "w_down", gu, adapter_ids
-            )
-        if c.post_norms:
-            mlp_out = _rms_norm(mlp_out, lp["mlp_post_norm"], c.rms_norm_eps, uo)
-        x = x + mlp_out
         return x, (k_c, v_c)
 
     x, (k_cache, v_cache) = jax.lax.scan(
         layer_fn, x, (params["layers"], k_cache, v_cache, lora or {}, windows)
     )
 
-    x = _rms_norm(x, params["final_norm"], c.rms_norm_eps, uo)
-    head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
-
-    def _final(logits: jnp.ndarray) -> jnp.ndarray:
-        if c.final_logit_softcap:
-            fcap = float(c.final_logit_softcap)
-            logits = fcap * jnp.tanh(logits / fcap)
-        return logits
-
     if all_logits:
         # Every position's logits (speculative verify reads them all).
-        return (
-            _final(q_lm_head(x, head, tied=c.tie_word_embeddings)),
-            k_cache,
-            v_cache,
-        )
+        return lm_head_logits(params, c, x), k_cache, v_cache
     # Only the last valid position's logits are needed (sampling).
     last_idx = jnp.clip(chunk_lens - 1, 0, C - 1)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, d]
-    logits = _final(q_lm_head(x_last, head, tied=c.tie_word_embeddings))
-    return logits, k_cache, v_cache
+    return lm_head_logits(params, c, x_last), k_cache, v_cache
 
 
 def encode(
