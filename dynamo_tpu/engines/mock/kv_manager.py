@@ -68,6 +68,11 @@ class KvManager:
     def usage(self) -> float:
         return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
 
+    def committed_view(self):
+        """Read-only [(hash, parent_hash)] of every resident block, for
+        KV-event re-sync (the radix tree tolerates replay order)."""
+        return [(h, b.parent_hash) for h, b in self._blocks.items()]
+
     # -- prefix matching ---------------------------------------------------
 
     def match_prefix(self, block_hashes: Sequence[int]) -> int:

@@ -81,6 +81,12 @@ class BlockPool:
             out.append((h, entry.parent_hash, entry.block_id))
         return out
 
+    def committed_view(self) -> List[Tuple[int, Optional[int]]]:
+        """Read-only [(hash, parent_hash)] of every committed block, in
+        insertion order (parents always commit before children, so replaying
+        this list rebuilds a radix index). Used by KV-event re-sync."""
+        return [(h, e.parent_hash) for h, e in self._by_hash.items()]
+
     def match_prefix(self, block_hashes: Sequence[int]) -> int:
         n = 0
         for h in block_hashes:

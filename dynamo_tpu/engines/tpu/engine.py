@@ -96,6 +96,13 @@ class JaxEngineArgs:
     # (OpenAI caps top_logprobs at 20). Per-request counts trim at emit;
     # the logprob-free programs never pay for it.
     top_logprobs_cap: int = 20
+    # KV cache layout: per-layer 4D pools (tuple of [NB, BS, KH, D]) instead
+    # of one stacked 5D array. The layered form lets XLA update each pool in
+    # place; the stacked form forces the layer-scan to rematerialize the FULL
+    # cache as scan ys every step (~2× cache size of HBM traffic — measured
+    # 22.2 → 15.2 ms/step at the bench shape). Stacked remains for
+    # pipeline-parallel stages that slice the layer axis.
+    layered_cache: bool = True
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -158,8 +165,20 @@ class _Prep:
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_blocks(cache, idx, blocks):
-    """cache [L, NB, BS, KH, D] ← blocks [L, n, BS, KH, D] at idx [n]."""
+    """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on both layouts:
+    stacked [L, NB, BS, KH, D] or per-layer tuple of [NB, BS, KH, D]."""
+    if isinstance(cache, (tuple, list)):
+        return tuple(c.at[idx].set(blocks[l]) for l, c in enumerate(cache))
     return cache.at[:, idx].set(blocks)
+
+
+@jax.jit
+def _gather_blocks(cache, idx):
+    """[L, n, BS, KH, D] of blocks idx [n], from either cache layout, as ONE
+    device program (a per-layer host gather would pay L dispatch RTTs)."""
+    if isinstance(cache, (tuple, list)):
+        return jnp.stack([c[idx] for c in cache])
+    return cache[:, idx]
 
 
 def _adapter_to_host(adapter):
@@ -309,14 +328,22 @@ class JaxEngine:
 
     def _alloc_kv_cache(self):
         k_cache, v_cache = llama.init_kv_cache(
-            self.config, self.args.num_kv_blocks, self.args.block_size
+            self.config, self.args.num_kv_blocks, self.args.block_size,
+            layered=self.args.layered_cache,
         )
         if self.mesh is not None:
-            cache_sharding = self.rules.sharding(
-                self.mesh, *llama.kv_cache_logical_axes()
-            )
-            k_cache = jax.device_put(k_cache, cache_sharding)
-            v_cache = jax.device_put(v_cache, cache_sharding)
+            if self.args.layered_cache:
+                cache_sharding = self.rules.sharding(
+                    self.mesh, *llama.kv_cache_layered_axes()
+                )
+                k_cache = tuple(jax.device_put(k, cache_sharding) for k in k_cache)
+                v_cache = tuple(jax.device_put(v, cache_sharding) for v in v_cache)
+            else:
+                cache_sharding = self.rules.sharding(
+                    self.mesh, *llama.kv_cache_logical_axes()
+                )
+                k_cache = jax.device_put(k_cache, cache_sharding)
+                v_cache = jax.device_put(v_cache, cache_sharding)
         return k_cache, v_cache
 
     def _load_loras(self, lora_dir: str) -> None:
@@ -1625,8 +1652,12 @@ class JaxEngine:
             def gather():
                 idx = jnp.asarray(np.array(ids, dtype=np.int32))
                 # [L, n, BS, KH, D] → [n, L, BS, KH, D]
-                k = np.asarray(jax.device_get(self._k_cache[:, idx].swapaxes(0, 1)))
-                v = np.asarray(jax.device_get(self._v_cache[:, idx].swapaxes(0, 1)))
+                k = np.asarray(
+                    jax.device_get(_gather_blocks(self._k_cache, idx).swapaxes(0, 1))
+                )
+                v = np.asarray(
+                    jax.device_get(_gather_blocks(self._v_cache, idx).swapaxes(0, 1))
+                )
                 return k, v
 
             k, v = await self._device(gather)
@@ -1713,10 +1744,14 @@ class JaxEngine:
                 def gather_and_write():
                     idx = jnp.asarray(np.array(ids, dtype=np.int32))
                     k = np.asarray(
-                        jax.device_get(self._k_cache[:, idx].swapaxes(0, 1))
+                        jax.device_get(
+                            _gather_blocks(self._k_cache, idx).swapaxes(0, 1)
+                        )
                     )
                     v = np.asarray(
-                        jax.device_get(self._v_cache[:, idx].swapaxes(0, 1))
+                        jax.device_get(
+                            _gather_blocks(self._v_cache, idx).swapaxes(0, 1)
+                        )
                     )
                     # Disk write stays off the event loop (multi-GB stall).
                     np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)

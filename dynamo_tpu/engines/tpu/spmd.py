@@ -1,0 +1,71 @@
+"""SPMD leader/follower execution for a multi-process JaxEngine.
+
+One logical worker spans N processes (parallel/multihost.py): the leader
+(process 0) runs the scheduler + endpoint and mirrors every device-program
+invocation over the op channel (runtime/network/spmd_channel.py); followers
+run :func:`follow`, re-issuing the identical invocation so every process
+enters the global-mesh jit together — the JAX-native version of the
+reference's DP leader / non-leader worker ranks
+(components/src/dynamo/vllm/main.py:67-78).
+
+Determinism contract: a follower's engine is constructed with the same
+JaxEngineArgs/params/seed as the leader's, and ops are applied in channel
+order — so jitted-program variant selection, RNG-step counters, and cache
+donation stay in lockstep with zero extra coordination.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dynamo_tpu.runtime.network.spmd_channel import SpmdBroadcaster, SpmdFollower
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Ops a follower executes. Each maps to the engine method of the same role;
+# the leader sends exactly the method's (numpy/python) arguments.
+OPS = ("step", "decode", "spec", "sleep", "wake", "stop")
+
+
+def follow(engine: Any, follower: SpmdFollower) -> None:
+    """Blocking follower loop: execute the leader's op stream until stop.
+
+    Runs the engine's raw device methods synchronously on this thread (the
+    follower process has no scheduler, no endpoint, no asyncio engine loop
+    — it exists to contribute its devices to the collectives).
+    """
+    while True:
+        op, args = follower.recv()
+        if op == "stop":
+            logger.info("SPMD follower: leader closed the channel")
+            return
+        try:
+            if op == "decode":
+                engine._run_decode(**args)
+            elif op == "step":
+                engine._run_step(**args)
+            elif op == "spec":
+                engine._run_spec(**args)
+            elif op == "sleep":
+                engine._do_sleep(int(args.get("level", 1)))
+            elif op == "wake":
+                engine._do_wake()
+            else:
+                raise ValueError(f"unknown SPMD op {op!r}")
+        except Exception:
+            # A follower that diverges can only poison the collective —
+            # surface loudly and exit; jax.distributed's heartbeat tears
+            # down the rest of the worker group.
+            logger.exception("SPMD follower failed applying op %r", op)
+            raise
+
+
+def make_broadcaster(port: int, num_followers: int) -> SpmdBroadcaster:
+    bcast = SpmdBroadcaster(port, num_followers)
+    bcast.wait_for_followers()
+    return bcast
+
+
+def make_follower(leader_host: str, port: int) -> SpmdFollower:
+    return SpmdFollower(leader_host, port)

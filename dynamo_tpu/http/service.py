@@ -411,6 +411,15 @@ class HttpService:
             ctx.kill()
             timer.done(499)
             raise
+        except Exception as exc:
+            # The SSE response is already prepared and partially written —
+            # returning a fresh JSON response here would corrupt the stream.
+            # Emit a terminal error + response.failed pair and end the stream
+            # cleanly instead (mirrors _stream_response's contract).
+            logger.exception("responses stream failed")
+            await send("error", {"message": str(exc), "code": "internal_error"})
+            await send("response.failed", {"response": envelope("failed")})
+            status = 500
         finally:
             if not ctx.stopped:
                 ctx.stop_generating(reason="response-stream-finished")
@@ -887,11 +896,18 @@ class HttpService:
                                     content += remainder
                     if content:
                         delta["content"] = content
+                    # OpenAI semantics: content logprobs correspond to emitted
+                    # content. When the reasoning parser withheld this chunk's
+                    # text (or routed it into reasoning_content), attaching the
+                    # token logprobs would describe tokens absent from the
+                    # delta — suppress them for those chunks.
                     chunk = chat_chunk(
                         rid, entry.name, delta=delta, finish_reason=finish_str,
                         logprobs=(
                             chat_logprobs_block(out.logprobs)
-                            if out.logprobs else None
+                            if out.logprobs
+                            and (delta.get("content") or delta.get("tool_calls"))
+                            else None
                         ),
                     )
                 else:

@@ -32,6 +32,7 @@ async def serve_mocker(args) -> None:
             ),
             on_kv_event=kv_pub.on_kv_event,
         )
+        kv_pub.set_snapshot_fn(engine.kv.committed_view)
         load_pub = LoadPublisher(
             runtime.event_plane, args.namespace, args.component, instance_id,
             lambda e=engine: {

@@ -134,10 +134,22 @@ class ModelConfig:
             bos_token_id=cfg.get("bos_token_id"),
             name=name or cfg.get("model_type", "llama"),
             # Gemma-2 (ref: the HF Gemma2 config dialect)
+            # Prefer the modern 'hidden_activation' key ('or', not a dict
+            # default: real Gemma-1 hub configs carry an explicit
+            # hidden_activation: null beside hidden_act). HF forces tanh-gelu
+            # for the gemma family regardless of hidden_act, so plain 'gelu'
+            # and an unset gemma config both resolve to gelu_tanh.
             act_fn=(
                 "gelu_tanh"
-                if cfg.get("hidden_act", cfg.get("hidden_activation"))
-                in ("gelu_pytorch_tanh", "gelu_tanh")
+                if (
+                    (cfg.get("hidden_activation") or cfg.get("hidden_act"))
+                    in ("gelu_pytorch_tanh", "gelu_tanh", "gelu")
+                    or (
+                        gemma
+                        and not cfg.get("hidden_activation")
+                        and not cfg.get("hidden_act")
+                    )
+                )
                 else "silu"
             ),
             rmsnorm_unit_offset=gemma,
@@ -233,6 +245,26 @@ def llama3_8b_config() -> ModelConfig:
         max_position_embeddings=8192,
         eos_token_ids=[128001, 128009],
         name="llama-3-8b",
+    )
+
+
+def llama3_3b_config() -> ModelConfig:
+    """Llama-3.2-3B shape (HF meta-llama/Llama-3.2-3B config.json values).
+    The largest dense shape whose bf16 AND int8 forms both fit one 16 GB
+    chip — the apples-to-apples proof shape for weight-only quantization."""
+    return ModelConfig(
+        vocab_size=128256,
+        d_model=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=8192,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+        eos_token_ids=[128001, 128009],
+        name="llama-3.2-3b",
     )
 
 

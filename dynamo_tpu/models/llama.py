@@ -131,14 +131,49 @@ def kv_cache_shape(
 
 
 def init_kv_cache(
-    config: ModelConfig, num_blocks: int, block_size: int
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    config: ModelConfig, num_blocks: int, block_size: int, *, layered: bool = False
+):
+    """Zeroed K/V pools. ``layered=False``: one stacked [L, NB, BS, KH, D]
+    array each (checkpoint/transfer-friendly). ``layered=True``: L-tuples of
+    4D arrays — the serving layout. The layered form is what the hot path
+    wants: the stacked form forces the layer-scan to rematerialize the FULL
+    cache as scan ys every step (~2× cache size of HBM traffic per decode
+    step, measured 22.2 → 15.2 ms/step at the bench shape when switched),
+    while per-layer carries update in place."""
+    if layered:
+        shape = kv_cache_shape(config, num_blocks, block_size)[1:]
+        k = tuple(jnp.zeros(shape, dtype=config.dtype) for _ in range(config.n_layers))
+        v = tuple(jnp.zeros(shape, dtype=config.dtype) for _ in range(config.n_layers))
+        return k, v
     shape = kv_cache_shape(config, num_blocks, block_size)
     return jnp.zeros(shape, dtype=config.dtype), jnp.zeros(shape, dtype=config.dtype)
 
 
 def kv_cache_logical_axes() -> Tuple[str, ...]:
     return ("layers", "kv_blocks", None, "kv_heads", "head_dim")
+
+
+def kv_cache_layered_axes() -> Tuple[str, ...]:
+    """Logical axes of ONE layer's pool in the layered layout."""
+    return ("kv_blocks", None, "kv_heads", "head_dim")
+
+
+def is_layered_cache(cache) -> bool:
+    return isinstance(cache, (tuple, list))
+
+
+def stack_kv_cache(k_layers, v_layers) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Layered → stacked (for checkpoint/export interop). Copies."""
+    return jnp.stack(tuple(k_layers)), jnp.stack(tuple(v_layers))
+
+
+def unstack_kv_cache(k_cache: jnp.ndarray, v_cache: jnp.ndarray):
+    """Stacked → layered. Copies (per-layer slices become separate buffers)."""
+    L = k_cache.shape[0]
+    return (
+        tuple(k_cache[l] for l in range(L)),
+        tuple(v_cache[l] for l in range(L)),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -310,23 +345,45 @@ def forward_paged(
 
     pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]
-    # Per-layer sliding windows (0 = full) ride the scan xs so one traced
-    # body serves Gemma-2's alternating local/global layers.
-    windows = jnp.asarray(c.layer_windows(), dtype=jnp.int32)
 
-    def layer_fn(carry, xs):
-        x = carry
-        lp, k_c, v_c, ll, win = xs
-        x, k_c, v_c = decoder_layer(
-            c, lp, ll, win, x, cos, sin, k_c, v_c,
-            block_tables, start_pos, chunk_lens,
-            use_kernel=use_kernel, adapter_ids=adapter_ids,
+    if is_layered_cache(k_cache):
+        # Serving layout: Python-unrolled layers over per-layer 4D pools.
+        # Static layer indices let XLA update every pool in place (step-scan
+        # carry / donated buffer). The stacked form below rematerializes the
+        # FULL cache as scan ys every call (~2× cache size of HBM traffic) —
+        # measured 22.2 → 15.2 ms/step at the bench shape when switched.
+        # HLO grows ~L× but is traced once; compile stays cached.
+        win_list = c.layer_windows()
+        k_out, v_out = [], []
+        for l in range(c.n_layers):
+            lp_l = jax.tree.map(lambda a, _l=l: a[_l], params["layers"])
+            ll_l = jax.tree.map(lambda a, _l=l: a[_l], lora) if lora else {}
+            x, k_l, v_l = decoder_layer(
+                c, lp_l, ll_l, jnp.asarray(win_list[l], jnp.int32), x, cos, sin,
+                k_cache[l], v_cache[l], block_tables, start_pos, chunk_lens,
+                use_kernel=use_kernel, adapter_ids=adapter_ids,
+            )
+            k_out.append(k_l)
+            v_out.append(v_l)
+        k_cache, v_cache = tuple(k_out), tuple(v_out)
+    else:
+        # Per-layer sliding windows (0 = full) ride the scan xs so one traced
+        # body serves Gemma-2's alternating local/global layers.
+        windows = jnp.asarray(c.layer_windows(), dtype=jnp.int32)
+
+        def layer_fn(carry, xs):
+            x = carry
+            lp, k_c, v_c, ll, win = xs
+            x, k_c, v_c = decoder_layer(
+                c, lp, ll, win, x, cos, sin, k_c, v_c,
+                block_tables, start_pos, chunk_lens,
+                use_kernel=use_kernel, adapter_ids=adapter_ids,
+            )
+            return x, (k_c, v_c)
+
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer_fn, x, (params["layers"], k_cache, v_cache, lora or {}, windows)
         )
-        return x, (k_c, v_c)
-
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache, lora or {}, windows)
-    )
 
     if all_logits:
         # Every position's logits (speculative verify reads them all).

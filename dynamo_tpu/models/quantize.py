@@ -112,14 +112,18 @@ def init_quantized_params(config: ModelConfig, seed: int = 0) -> Dict[str, Any]:
     def fp(shape, fill=1.0):
         return jnp.full(shape, fill, dtype=c.dtype)
 
+    norm_fill = 0.0 if c.rmsnorm_unit_offset else 1.0
     layers: Dict[str, Any] = {
-        "attn_norm": fp((L, d)),
+        "attn_norm": fp((L, d), norm_fill),
         "wq": q((L, d, H * hd), d**-0.5, 1),
         "wk": q((L, d, KH * hd), d**-0.5, 1),
         "wv": q((L, d, KH * hd), d**-0.5, 1),
         "wo": q((L, H * hd, d), (H * hd) ** -0.5, 1),
-        "mlp_norm": fp((L, d)),
+        "mlp_norm": fp((L, d), norm_fill),
     }
+    if c.post_norms:
+        layers["attn_post_norm"] = fp((L, d), norm_fill)
+        layers["mlp_post_norm"] = fp((L, d), norm_fill)
     if c.is_moe:
         E, eff = c.n_experts, c.moe_d_ff_
         layers["router_w"] = jnp.asarray(
@@ -139,7 +143,7 @@ def init_quantized_params(config: ModelConfig, seed: int = 0) -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": q((c.vocab_size, d), 1.0, 1),
         "layers": layers,
-        "final_norm": fp((d,)),
+        "final_norm": fp((d,), norm_fill),
     }
     if not c.tie_word_embeddings:
         params["lm_head"] = q((d, c.vocab_size), d**-0.5, 0)

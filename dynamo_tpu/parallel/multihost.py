@@ -1,0 +1,106 @@
+"""Multi-host topology: one logical worker spanning several processes.
+
+Reference parity: the reference's DP leader/non-leader worker pattern
+(components/src/dynamo/vllm/main.py:67-78 — rank 0 serves the endpoint,
+other ranks join collectives only) and its distributed KVBM leader/worker
+split (lib/llm/src/block_manager/distributed/{leader,worker}.rs roles).
+
+TPU-style: `jax.distributed.initialize` joins the processes into one JAX
+runtime; every process sees the GLOBAL device set, `make_mesh` lays a mesh
+over all of it, and jit executes SPMD — XLA inserts ICI/DCN collectives.
+The leader (process_index 0) runs the engine scheduler and serves the
+endpoint; followers run `engines/tpu/spmd.follow(...)`, executing the same
+device programs in lockstep (driven by the leader's op broadcast, see
+runtime/network/spmd_channel.py).
+
+Environment contract (mirrors the usual TPU pod env):
+  DYN_TPU_COORDINATOR   host:port of process 0's jax.distributed service
+  DYN_TPU_NUM_PROCESSES world size
+  DYN_TPU_PROCESS_ID    this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """What this process is within the logical worker."""
+
+    process_index: int
+    num_processes: int
+    coordinator: Optional[str] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def multihost_config_from_env() -> Optional[dict]:
+    """Read the multihost env contract; None when not configured."""
+    coord = os.environ.get("DYN_TPU_COORDINATOR")
+    if not coord:
+        return None
+    return {
+        "coordinator_address": coord,
+        "num_processes": int(os.environ.get("DYN_TPU_NUM_PROCESSES", "1")),
+        "process_id": int(os.environ.get("DYN_TPU_PROCESS_ID", "0")),
+    }
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> HostTopology:
+    """Join (or skip joining) the multi-process JAX runtime.
+
+    With no explicit args, reads the env contract; with neither, returns a
+    single-process topology without touching jax.distributed (the common
+    single-host path stays zero-cost). Must run before any JAX computation
+    creates a backend.
+    """
+    if coordinator_address is None:
+        cfg = multihost_config_from_env()
+        if cfg is None:
+            return HostTopology(process_index=0, num_processes=1)
+        coordinator_address = cfg["coordinator_address"]
+        num_processes = cfg["num_processes"]
+        process_id = cfg["process_id"]
+    if num_processes is None or num_processes <= 1:
+        return HostTopology(process_index=0, num_processes=1)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id or 0,
+    )
+    topo = HostTopology(
+        process_index=jax.process_index(),
+        num_processes=jax.process_count(),
+        coordinator=coordinator_address,
+    )
+    logger.info(
+        "multihost: process %d/%d (leader=%s), %d global / %d local devices",
+        topo.process_index, topo.num_processes, topo.is_leader,
+        len(jax.devices()), len(jax.local_devices()),
+    )
+    return topo
+
+
+def spmd_port(coordinator_address: str) -> int:
+    """Default op-broadcast port: coordinator port + 1 (one logical worker
+    per coordinator, so the offset can't collide within a worker group)."""
+    return int(coordinator_address.rsplit(":", 1)[1]) + 1

@@ -35,10 +35,13 @@ class KvIndexer:
         worker = event.worker
         last = self._last_event_id.get(worker)
         if event.event_id and last is not None and event.event_id <= last:
+            # In-flight duplicates arriving after a snapshot replaced them
+            # would corrupt the rebuilt state — drop, don't re-apply.
             logger.debug(
-                "stale KV event %s from worker %s (last %s)",
+                "dropping stale KV event %s from worker %s (last %s)",
                 event.event_id, worker, last,
             )
+            return
         if event.event_id:
             self._last_event_id[worker] = event.event_id
         if event.kind == "stored":
@@ -47,10 +50,29 @@ class KvIndexer:
             self.tree.remove(worker, event.block_hashes)
         elif event.kind == "cleared":
             self.tree.clear_worker(worker)
+        elif event.kind == "snapshot":
+            # Full-state resync: replace everything known about this worker.
+            self.tree.clear_worker(worker)
+            parents = event.parent_hashes or [None] * len(event.block_hashes)
+            for h, p in zip(event.block_hashes, parents):
+                self.tree.store(worker, [h], p)
         else:
             logger.warning("unknown KV event kind %r", event.kind)
             return
         self._events_applied += 1
+
+    def has_gap(self, event: RouterEvent) -> bool:
+        """True when ``event`` implies missed events from its worker (the
+        router should request a snapshot)."""
+        if not event.event_id or event.kind == "snapshot":
+            # A snapshot IS the gap repair — its event_id legitimately jumps
+            # past last+1 (live traffic between request and serialization).
+            return False
+        last = self._last_event_id.get(event.worker)
+        if last is None:
+            # Unknown worker joining mid-stream ("cleared" also rebases).
+            return event.kind != "cleared" and event.event_id > 1
+        return event.event_id > last + 1
 
     def remove_worker(self, worker: WorkerKey) -> None:
         self.tree.remove_worker(worker)

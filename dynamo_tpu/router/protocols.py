@@ -15,6 +15,7 @@ WorkerKey = Tuple[int, int]  # (worker_id, dp_rank)
 
 KV_EVENTS_TOPIC = "kv_events"
 LOAD_TOPIC = "load"
+KV_SYNC_TOPIC = "kv_sync"
 
 
 def kv_events_topic(namespace: str, component: str) -> str:
@@ -25,16 +26,29 @@ def load_topic(namespace: str, component: str) -> str:
     return f"{namespace}.{component}.{LOAD_TOPIC}"
 
 
+def kv_sync_topic(namespace: str, component: str) -> str:
+    """Snapshot-request channel: a (re)joining router asks publishers for a
+    full radix snapshot instead of waiting for TTL churn (the JetStream
+    re-sync role, ref: lib/llm/src/kv_router/subscriber.rs:266)."""
+    return f"{namespace}.{component}.{KV_SYNC_TOPIC}"
+
+
 @dataclass
 class RouterEvent:
-    """One KV-cache mutation at a worker (ref: protocols.rs RouterEvent)."""
+    """One KV-cache mutation at a worker (ref: protocols.rs RouterEvent).
+
+    ``kind="snapshot"`` carries the publisher's full committed-block set:
+    ``block_hashes[i]`` pairs with ``parent_hashes[i]`` (None = root), listed
+    parents-before-children so an indexer can rebuild its radix by replay.
+    """
 
     worker_id: int
-    kind: str  # "stored" | "removed" | "cleared"
+    kind: str  # "stored" | "removed" | "cleared" | "snapshot"
     block_hashes: List[int] = field(default_factory=list)
     parent_hash: Optional[int] = None
     dp_rank: int = 0
-    event_id: int = 0  # per-worker monotonic, for ordering diagnostics
+    event_id: int = 0  # per-worker monotonic; gaps trigger a sync request
+    parent_hashes: Optional[List[Optional[int]]] = None  # snapshot only
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

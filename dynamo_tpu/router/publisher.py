@@ -17,6 +17,7 @@ from dynamo_tpu.router.protocols import (
     LoadSnapshot,
     RouterEvent,
     kv_events_topic,
+    kv_sync_topic,
     load_topic,
 )
 from dynamo_tpu.utils.logging import get_logger
@@ -25,7 +26,16 @@ logger = get_logger(__name__)
 
 
 class KvEventPublisher:
-    """Bridge engine KV events → event plane topic."""
+    """Bridge engine KV events → event plane topic.
+
+    With ``snapshot_fn`` set (a callable returning the engine's current
+    [(block_hash, parent_hash)] committed set), the publisher also answers
+    sync requests on the kv_sync topic with a full ``kind="snapshot"`` event
+    — the JetStream re-sync role (ref: lib/llm/src/kv_router/subscriber.rs:266)
+    so a restarted router rebuilds its index immediately instead of waiting
+    for TTL churn. Snapshots ride the same queue as live events, preserving
+    the per-worker event order the indexer relies on.
+    """
 
     def __init__(
         self,
@@ -35,14 +45,18 @@ class KvEventPublisher:
         worker_id: int,
         *,
         dp_rank: int = 0,
+        snapshot_fn: Optional[Callable[[], list]] = None,
     ) -> None:
         self._plane = event_plane
         self._topic = kv_events_topic(namespace, component)
+        self._sync_topic = kv_sync_topic(namespace, component)
         self.worker_id = worker_id
         self.dp_rank = dp_rank
+        self._snapshot_fn = snapshot_fn
         self._queue: "asyncio.Queue[Optional[RouterEvent]]" = asyncio.Queue()
         self._event_id = 0
         self._task: Optional[asyncio.Task] = None
+        self._sync_task: Optional[asyncio.Task] = None
 
     def on_kv_event(self, event: KvEvent) -> None:
         """Engine callback (synchronous, loop thread)."""
@@ -58,6 +72,56 @@ class KvEventPublisher:
             )
         )
         self._ensure_task()
+
+    def set_snapshot_fn(self, fn: Callable[[], list]) -> None:
+        """Late-bind the snapshot source (the engine is usually constructed
+        after the publisher, taking on_kv_event as a callback) and start
+        answering sync requests."""
+        self._snapshot_fn = fn
+        self.start_sync_responder()
+
+    def enqueue_snapshot(self) -> None:
+        """Queue a full-state snapshot event (ordered with live events)."""
+        if self._snapshot_fn is None:
+            return
+        blocks = self._snapshot_fn()
+        self._event_id += 1
+        self._queue.put_nowait(
+            RouterEvent(
+                worker_id=self.worker_id,
+                dp_rank=self.dp_rank,
+                kind="snapshot",
+                block_hashes=[h for h, _ in blocks],
+                parent_hashes=[p for _, p in blocks],
+                event_id=self._event_id,
+            )
+        )
+        self._ensure_task()
+
+    def start_sync_responder(self) -> None:
+        """Subscribe to the sync topic and answer requests with snapshots."""
+        if self._snapshot_fn is None or self._sync_task is not None:
+            return
+        self._sync_task = asyncio.get_event_loop().create_task(
+            self._sync_pump(), name=f"kv-sync:{self.worker_id:#x}"
+        )
+
+    async def _sync_pump(self) -> None:
+        sub = None
+        try:
+            sub = self._plane.subscribe(self._sync_topic)
+            async for _topic, req in sub:
+                target = (req or {}).get("worker_id")
+                if target is not None and target != self.worker_id:
+                    continue
+                self.enqueue_snapshot()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("kv sync responder died")
+        finally:
+            if sub is not None:
+                await sub.aclose()
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
@@ -76,6 +140,13 @@ class KvEventPublisher:
                 logger.exception("failed to publish KV event")
 
     async def close(self) -> None:
+        if self._sync_task is not None:
+            self._sync_task.cancel()
+            try:
+                await self._sync_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._sync_task = None
         if self._task is not None and not self._task.done():
             self._queue.put_nowait(None)
             await self._task

@@ -26,6 +26,7 @@ from dynamo_tpu.router.protocols import (
     RouterEvent,
     WorkerKey,
     kv_events_topic,
+    kv_sync_topic,
     load_topic,
 )
 from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
@@ -73,6 +74,9 @@ class KvRouter:
         # Notified after each applied KV event so tests (and operators) can
         # await "indexer has seen N events" instead of sleeping.
         self._events_cond: Optional[asyncio.Condition] = None
+        # Re-sync request throttling: worker → loop-monotonic of last request.
+        self._sync_requested: Dict[Optional[WorkerKey], float] = {}
+        self._sync_cooldown_s = 2.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -92,6 +96,10 @@ class KvRouter:
             self._tasks.append(
                 loop.create_task(self._pump_kv(kv_sub), name="kv-router-events")
             )
+            # A (re)started router has an empty index: broadcast a snapshot
+            # request so publishers replay their committed state immediately
+            # (JetStream re-sync role) instead of the index warming over TTLs.
+            await self._request_sync(None)
 
     async def stop(self) -> None:
         for sub in self._subs:
@@ -109,12 +117,30 @@ class KvRouter:
     async def _pump_kv(self, sub) -> None:
         async for _topic, payload in sub:
             try:
-                self.indexer.apply(RouterEvent.from_dict(payload))
+                event = RouterEvent.from_dict(payload)
+                if hasattr(self.indexer, "has_gap") and self.indexer.has_gap(event):
+                    await self._request_sync(event.worker)
+                self.indexer.apply(event)
             except Exception:
                 logger.exception("bad KV event payload")
             if self._events_cond is not None:
                 async with self._events_cond:
                     self._events_cond.notify_all()
+
+    async def _request_sync(self, worker: Optional[WorkerKey]) -> None:
+        """Ask publishers (one worker, or all with None) for a snapshot."""
+        now = asyncio.get_running_loop().time()
+        last = self._sync_requested.get(worker)
+        if last is not None and now - last < self._sync_cooldown_s:
+            return
+        self._sync_requested[worker] = now
+        try:
+            await self._runtime.event_plane.publish(
+                kv_sync_topic(self.namespace, self.component),
+                {"worker_id": worker[0] if worker else None},
+            )
+        except Exception:
+            logger.exception("failed to publish kv sync request")
 
     async def wait_for_events(self, count: int, timeout: float = 5.0) -> None:
         """Block until at least ``count`` KV events have been applied to the

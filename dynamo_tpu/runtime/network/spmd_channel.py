@@ -1,0 +1,128 @@
+"""Lockstep op broadcast for SPMD multi-host workers.
+
+The leader's engine emits every device-program invocation as an op frame;
+followers execute the identical invocation so all processes enter each
+global-mesh jit together (XLA SPMD requires every process to issue the same
+program with the same global shapes). This is the control-plane analog of
+the reference's leader/worker ZMQ hookup in distributed KVBM
+(lib/llm/src/block_manager/distributed/leader.rs role) — here the payload
+is the jit inputs themselves, because in the JAX runtime the *program* is
+shared and only the host-side inputs need distributing.
+
+Wire format: length-prefixed msgpack maps. Numpy arrays ride as
+``{"__nd__": (dtype-str, shape, raw-bytes)}``. Blocking stdlib sockets —
+both ends use them from their single device thread, so ordering and
+backpressure come from TCP itself.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_LEN = struct.Struct("!Q")
+
+
+def _pack_default(obj):
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": (obj.dtype.str, list(obj.shape), obj.tobytes())}
+    if isinstance(obj, (np.integer, np.floating, np.bool_)):
+        return {"__np0__": (np.dtype(type(obj)).str, obj.item())}
+    raise TypeError(f"unserializable SPMD arg type {type(obj)!r}")
+
+
+def _unpack_hook(obj):
+    if "__nd__" in obj:
+        dt, shape, raw = obj["__nd__"]
+        return np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+    if "__np0__" in obj:
+        dt, val = obj["__np0__"]
+        return np.dtype(dt).type(val)
+    return obj
+
+
+def _send_frame(sock: socket.socket, payload: Any) -> None:
+    data = msgpack.packb(payload, default=_pack_default, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("SPMD channel closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return msgpack.unpackb(
+        _recv_exact(sock, n), raw=False, strict_map_key=False,
+        object_hook=_unpack_hook,
+    )
+
+
+class SpmdBroadcaster:
+    """Leader side: accept follower connections, fan out op frames."""
+
+    def __init__(self, port: int, num_followers: int, host: str = "0.0.0.0",
+                 accept_timeout_s: float = 120.0) -> None:
+        self._server = socket.create_server((host, port))
+        self._server.settimeout(accept_timeout_s)
+        self._conns: List[socket.socket] = []
+        self.num_followers = num_followers
+
+    def wait_for_followers(self) -> None:
+        while len(self._conns) < self.num_followers:
+            conn, addr = self._server.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            logger.info(
+                "SPMD follower %d/%d connected from %s",
+                len(self._conns), self.num_followers, addr,
+            )
+
+    def send(self, op: str, **kwargs: Any) -> None:
+        frame = {"op": op, **kwargs}
+        for conn in self._conns:
+            _send_frame(conn, frame)
+
+    def close(self) -> None:
+        for conn in self._conns:
+            try:
+                _send_frame(conn, {"op": "stop"})
+            except OSError:
+                pass
+            conn.close()
+        self._conns = []
+        self._server.close()
+
+
+class SpmdFollower:
+    """Follower side: connect to the leader and iterate op frames."""
+
+    def __init__(self, leader_host: str, port: int,
+                 connect_timeout_s: float = 120.0) -> None:
+        self._sock = socket.create_connection(
+            (leader_host, port), timeout=connect_timeout_s
+        )
+        self._sock.settimeout(None)  # ops arrive whenever traffic does
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def recv(self) -> Tuple[str, dict]:
+        frame = _recv_frame(self._sock)
+        op = frame.pop("op")
+        return op, frame
+
+    def close(self) -> None:
+        self._sock.close()

@@ -180,6 +180,10 @@ async def main() -> None:
         mesh=mesh,
         on_kv_event=kv_pub.on_kv_event,
     )
+    # Answer router re-sync requests with the pool's committed set (the
+    # JetStream replay role) — a restarted router rebuilds its radix index
+    # immediately instead of waiting for TTL churn.
+    kv_pub.set_snapshot_fn(engine.pool.committed_view)
     kvbm = None
     if args.kv_offload_blocks > 0:
         from dynamo_tpu.kvbm import DiskTier, HostTier, RemoteTier, TieredKvManager

@@ -209,3 +209,87 @@ async def test_load_publisher_snapshot():
     assert LoadSnapshot.from_dict(payload).worker_id == 7
     await sub.aclose()
     await rt.shutdown(grace_period=1)
+
+
+class TestResync:
+    """KV-event re-sync (the JetStream replay role): snapshot events rebuild
+    a restarted router's index; event-id gaps trigger snapshot requests."""
+
+    def test_indexer_snapshot_replaces_state(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply(ev(1, "stored", [10, 11], eid=1))
+        idx.apply(ev(1, "stored", [99], parent=11, eid=2))
+        snap = RouterEvent(
+            worker_id=1, kind="snapshot", block_hashes=[10, 11, 12],
+            parent_hashes=[None, 10, 11], event_id=7,
+        )
+        idx.apply(snap)
+        scores = idx.find_matches([10, 11, 12])
+        assert scores.scores.get((1, 0)) == 3
+        # the pre-snapshot block 99 is gone
+        assert idx.find_matches([99]).scores == {}
+
+    def test_indexer_drops_stale_after_snapshot(self):
+        idx = KvIndexer(block_size=4)
+        snap = RouterEvent(
+            worker_id=1, kind="snapshot", block_hashes=[10],
+            parent_hashes=[None], event_id=5,
+        )
+        idx.apply(snap)
+        # An in-flight pre-snapshot event arrives late: must not re-apply.
+        idx.apply(ev(1, "removed", [10], eid=3))
+        assert idx.find_matches([10]).scores.get((1, 0)) == 1
+
+    def test_gap_detection(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply(ev(1, "stored", [10], eid=1))
+        assert not idx.has_gap(ev(1, "stored", [11], eid=2))
+        assert idx.has_gap(ev(1, "stored", [12], eid=4))  # missed eid 3
+        # Unknown worker joining mid-stream counts as a gap too.
+        assert idx.has_gap(ev(2, "stored", [20], eid=9))
+        assert not idx.has_gap(ev(3, "stored", [30], eid=1))
+
+    async def test_router_restart_resyncs_from_publisher(self):
+        """Kill the router mid-traffic; a new router must recover the full
+        index from publisher snapshots without replaying traffic."""
+        rt = DistributedRuntime.detached()
+        ns, comp = "sync", "backend"
+        block = 4
+
+        pub = KvEventPublisher(rt.event_plane, ns, comp, 1)
+        eng = MockEngine(
+            MockEngineArgs(block_size=block, num_kv_blocks=64,
+                           decode_itl_s=0.001, prefill_base_s=0.001),
+            on_kv_event=pub.on_kv_event,
+        )
+        pub.set_snapshot_fn(eng.kv.committed_view)
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        served = await ep.serve_endpoint(eng.generate, instance_id=1)
+
+        router = KvRouter(rt, ns, comp, block_size=block)
+        await router.start()
+        try:
+            prefix = list(range(200, 216))  # 4 full blocks
+            out = await collect(eng.generate(_req(prefix), Context()))
+            assert out
+            await router.wait_for_events(1)
+            hashes = compute_block_hashes(prefix, block)
+            assert router.indexer.find_matches(hashes).scores
+
+            # Router dies; a fresh one starts with an empty index.
+            await router.stop()
+            router2 = KvRouter(rt, ns, comp, block_size=block)
+            await router2.start()  # start() broadcasts a sync request
+            try:
+                await router2.wait_for_events(1, timeout=5)
+                scores = router2.indexer.find_matches(hashes)
+                assert scores.scores.get((1, 0), 0) >= 4, (
+                    "restarted router did not recover the index via snapshot"
+                )
+            finally:
+                await router2.stop()
+        finally:
+            await served.shutdown(grace_period=1)
+            await pub.close()
+            await eng.stop()
+            await rt.shutdown(grace_period=1)
