@@ -75,7 +75,11 @@ async def run_bench():
             max_num_seqs=CONCURRENCY,
             max_model_len=max(512, ISL + OSL + 64),
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
-            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", 128)),
+            # One admission dispatch for the whole wave: prefill rows are
+            # near-free to batch (measured Bp 8→128 = 2.4× cost for 16× rows)
+            # and fewer admission rounds stop prefill from stealing decode
+            # ticks (measured 9.4k → 11.0k tok/s, ITL 20.9 → 15.4ms).
+            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", CONCURRENCY)),
             enable_prefix_caching=True,
             decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", 64)),
             use_kernel=(

@@ -40,6 +40,25 @@ async def run_apply(args) -> None:
         await controller.stop()
 
 
+async def run_operator(args) -> None:
+    """Watch DynamoTpuGraphDeployment(+Request) CRs via the k8s API and
+    reconcile them (deploy/k8s_operator.py). In-cluster by default; point
+    --apiserver at any API endpoint (e.g. `kubectl proxy`)."""
+    from dynamo_tpu.deploy.k8s_client import KubeClient
+    from dynamo_tpu.deploy.k8s_operator import K8sGraphOperator
+
+    if args.apiserver:
+        client = KubeClient(args.apiserver, token=args.token)
+    else:
+        client = KubeClient.in_cluster()
+    operator = K8sGraphOperator(client, k8s_namespace=args.k8s_namespace)
+    print(f"operator watching {args.k8s_namespace}", flush=True)
+    try:
+        await operator.run()
+    finally:
+        await operator.stop()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser("dynamo-tpu deploy")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -52,8 +71,16 @@ def main() -> None:
                 help="fold planner desired counts from discovery into "
                 "planner_scaled services",
             )
+    p = sub.add_parser("operator", help="kubernetes operator mode")
+    p.add_argument("--apiserver", default=None,
+                   help="API base URL (default: in-cluster config)")
+    p.add_argument("--token", default=None)
+    p.add_argument("--k8s-namespace", default="default")
     args = parser.parse_args()
     configure_logging()
+    if args.command == "operator":
+        asyncio.run(run_operator(args))
+        return
     if args.command == "validate":
         dep = GraphDeployment.from_file(args.file)
         print(json.dumps({

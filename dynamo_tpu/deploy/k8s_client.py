@@ -1,0 +1,177 @@
+"""Minimal async Kubernetes API client for custom resources.
+
+The environment has no `kubernetes` package, and the operator needs only a
+narrow slice of the API: list/watch/create/patch/delete on namespaced
+custom resources plus the status subresource. This client speaks that slice
+directly over aiohttp — the same REST surface controller-runtime wraps for
+the reference's Go operator
+(deploy/operator/internal/controller/dynamographdeployment_controller.go:110).
+
+Auth: in-cluster (service-account token + CA bundle) via
+:meth:`KubeClient.in_cluster`, or explicit ``base_url``/``token`` — which is
+also how tests point it at a fake apiserver.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import ssl
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, status: int, body: str) -> None:
+        super().__init__(f"kube API error {status}: {body[:300]}")
+        self.status = status
+        self.body = body
+
+
+class KubeClient:
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        token: Optional[str] = None,
+        ssl_ctx: Optional[ssl.SSLContext] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        self._ssl = ssl_ctx
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        """Build from the pod's service-account mount (the standard
+        in-cluster config: KUBERNETES_SERVICE_HOST/PORT + token + CA)."""
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        ctx = ssl.create_default_context(cafile=os.path.join(SA_DIR, "ca.crt"))
+        return cls(f"https://{host}:{port}", token=token, ssl_ctx=ctx)
+
+    def _headers(self, extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        h = {"Accept": "application/json"}
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        if extra:
+            h.update(extra)
+        return h
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # -- custom-resource CRUD ---------------------------------------------
+
+    def _cr_path(
+        self, group: str, version: str, namespace: str, plural: str,
+        name: Optional[str] = None, subresource: Optional[str] = None,
+    ) -> str:
+        p = f"/apis/{group}/{version}/namespaces/{namespace}/{plural}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    async def _request(
+        self, method: str, path: str, *,
+        params: Optional[Dict[str, str]] = None,
+        body: Optional[Any] = None,
+        content_type: str = "application/json",
+    ) -> Any:
+        sess = await self.session()
+        async with sess.request(
+            method, self.base_url + path, params=params,
+            data=None if body is None else json.dumps(body),
+            headers=self._headers({"Content-Type": content_type}),
+            ssl=self._ssl,
+        ) as resp:
+            text = await resp.text()
+            if resp.status >= 400:
+                raise KubeApiError(resp.status, text)
+            return json.loads(text) if text else None
+
+    async def list(
+        self, group: str, version: str, namespace: str, plural: str,
+    ) -> Tuple[List[Dict[str, Any]], str]:
+        """Returns (items, resourceVersion) — the watch bookmark."""
+        doc = await self._request(
+            "GET", self._cr_path(group, version, namespace, plural)
+        )
+        return doc.get("items", []), doc.get("metadata", {}).get(
+            "resourceVersion", ""
+        )
+
+    async def get(self, group, version, namespace, plural, name) -> Dict[str, Any]:
+        return await self._request(
+            "GET", self._cr_path(group, version, namespace, plural, name)
+        )
+
+    async def create(self, group, version, namespace, plural, body) -> Dict[str, Any]:
+        return await self._request(
+            "POST", self._cr_path(group, version, namespace, plural), body=body
+        )
+
+    async def delete(self, group, version, namespace, plural, name) -> None:
+        await self._request(
+            "DELETE", self._cr_path(group, version, namespace, plural, name)
+        )
+
+    async def patch_status(
+        self, group, version, namespace, plural, name, status: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """Merge-patch the /status subresource (requires the CRD's status
+        subresource, which both our CRDs declare)."""
+        return await self._request(
+            "PATCH",
+            self._cr_path(group, version, namespace, plural, name, "status"),
+            body={"status": status},
+            content_type="application/merge-patch+json",
+        )
+
+    async def watch(
+        self, group, version, namespace, plural,
+        *, resource_version: str = "", timeout_s: float = 30.0,
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """Stream watch events ({type: ADDED|MODIFIED|DELETED, object: ...})
+        as the apiserver emits them (chunked JSON lines). Returns when the
+        server closes the watch window — callers re-list + re-watch (the
+        standard level-triggered reconcile loop)."""
+        sess = await self.session()
+        params = {"watch": "true", "timeoutSeconds": str(int(timeout_s))}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        try:
+            async with sess.get(
+                self.base_url + self._cr_path(group, version, namespace, plural),
+                params=params, headers=self._headers(), ssl=self._ssl,
+                timeout=aiohttp.ClientTimeout(total=timeout_s + 10),
+            ) as resp:
+                if resp.status >= 400:
+                    raise KubeApiError(resp.status, await resp.text())
+                buf = b""
+                async for chunk in resp.content.iter_any():
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if line.strip():
+                            yield json.loads(line)
+        except asyncio.TimeoutError:
+            return

@@ -1,0 +1,293 @@
+"""Kubernetes operator: reconcile GraphDeployment CRs to running services.
+
+Reference parity:
+  - deploy/operator/internal/controller/dynamographdeployment_controller.go:110
+    — Reconcile(): drive observed state to CR spec, write status back.
+  - deploy/operator/api/v1alpha1/dynamographdeploymentrequest_types.go — the
+    DGDR flow: an SLA-profiling request CR that produces a sized
+    DynamoGraphDeployment.
+
+This operator watches the cluster through the minimal REST client
+(deploy/k8s_client.py) and maps each DynamoTpuGraphDeployment CR onto a
+GraphController (deploy/controller.py) — the CR's spec IS the
+GraphDeployment document, so specs move unchanged between `kubectl apply`
+and the local `python -m dynamo_tpu.deploy apply`. Worker pods vs local
+processes is a connector concern: the default ProcessConnector supervises
+subprocesses (one per replica) on the operator's node, which is also
+exactly what the envtest-style fake-apiserver tests observe.
+
+Level-triggered loop per kind: list → reconcile all → watch until the
+window closes → repeat. Planner-driven replica changes arrive as CR spec
+updates (the planner patches the CR, same as the reference's
+kubernetes_connector) or via the in-process discovery override the
+GraphController already honors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.deploy.controller import GraphController
+from dynamo_tpu.deploy.k8s_client import KubeApiError, KubeClient
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+GROUP = "dynamo-tpu.io"
+VERSION = "v1alpha1"
+GD_PLURAL = "graphdeployments"
+DGDR_PLURAL = "graphdeploymentrequests"
+
+
+def deployment_from_cr(cr: Dict[str, Any]) -> GraphDeployment:
+    """CR object → GraphDeployment (metadata.name names the deployment)."""
+    spec = dict(cr.get("spec") or {})
+    spec.setdefault("name", cr["metadata"]["name"])
+    return GraphDeployment.from_dict(spec)
+
+
+class K8sGraphOperator:
+    """One operator process: watches both CRD kinds in one k8s namespace."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        *,
+        k8s_namespace: str = "default",
+        discovery: Optional[Any] = None,
+        reconcile_interval_s: float = 1.0,
+        watch_timeout_s: float = 10.0,
+        sla_profiles: Optional[Any] = None,  # List[ConfigProfile] for DGDR
+    ) -> None:
+        self.client = client
+        self.k8s_namespace = k8s_namespace
+        self.discovery = discovery
+        self.reconcile_interval_s = reconcile_interval_s
+        self.watch_timeout_s = watch_timeout_s
+        self.sla_profiles = sla_profiles
+        self._controllers: Dict[str, GraphController] = {}
+        self._specs: Dict[str, str] = {}  # name → serialized spec (drift check)
+        self._dgdr_done: Dict[str, str] = {}  # name → outcome
+        self._tasks: list = []
+        self._stop = asyncio.Event()
+        self.reconciles = 0
+
+    # -- GraphDeployment reconcile ----------------------------------------
+
+    async def _apply_cr(self, cr: Dict[str, Any]) -> None:
+        name = cr["metadata"]["name"]
+        import json
+
+        def _shape_key(spec: Dict[str, Any]) -> str:
+            # Spec minus per-service replica counts: a replicas-only change
+            # scales in place; anything else (args, env, restart id, service
+            # set) rebuilds the controller — a rolling restart, like the
+            # reference operator's pod-template change handling.
+            shaped = json.loads(json.dumps(spec))
+            for svc in (shaped.get("services") or {}).values():
+                svc.pop("replicas", None)
+            return json.dumps(shaped, sort_keys=True)
+
+        spec = cr.get("spec") or {}
+        spec_key = _shape_key(spec)
+        ctrl = self._controllers.get(name)
+        if ctrl is not None and self._specs.get(name) != spec_key:
+            logger.info("GraphDeployment %s shape changed: rolling restart", name)
+            await ctrl.stop(teardown=True)
+            ctrl = None
+            self._controllers.pop(name, None)
+        if ctrl is not None:
+            # Replicas-only updates flow through the live controller.
+            ctrl.deployment = deployment_from_cr(cr)
+        if ctrl is None:
+            dep = deployment_from_cr(cr)
+            ctrl = GraphController(
+                dep, discovery=self.discovery,
+                reconcile_interval_s=self.reconcile_interval_s,
+            )
+            self._controllers[name] = ctrl
+        self._specs[name] = spec_key
+        counts = await ctrl.reconcile_once()
+        self.reconciles += 1
+        status = ctrl.status()
+        status["observedCounts"] = counts
+        try:
+            await self.client.patch_status(
+                GROUP, VERSION, self.k8s_namespace, GD_PLURAL, name,
+                {"services": status["services"], "reconciles": status["reconciles"]},
+            )
+        except KubeApiError as exc:
+            logger.warning("status patch for %s failed: %s", name, exc)
+
+    async def _remove_cr(self, name: str) -> None:
+        ctrl = self._controllers.pop(name, None)
+        self._specs.pop(name, None)
+        if ctrl is not None:
+            logger.info("GraphDeployment %s deleted: tearing down", name)
+            await ctrl.stop(teardown=True)
+
+    async def reconcile_deployments_once(self) -> None:
+        items, _rv = await self.client.list(
+            GROUP, VERSION, self.k8s_namespace, GD_PLURAL
+        )
+        seen = set()
+        for cr in items:
+            seen.add(cr["metadata"]["name"])
+            try:
+                await self._apply_cr(cr)
+            except Exception:
+                logger.exception(
+                    "reconcile of %s failed", cr["metadata"]["name"]
+                )
+        for name in list(self._controllers):
+            if name not in seen:
+                await self._remove_cr(name)
+
+    # -- DGDR: SLA-profiling request → sized deployment --------------------
+
+    async def reconcile_requests_once(self) -> None:
+        try:
+            items, _rv = await self.client.list(
+                GROUP, VERSION, self.k8s_namespace, DGDR_PLURAL
+            )
+        except KubeApiError as exc:
+            if exc.status == 404:  # CRD not installed: DGDR flow disabled
+                return
+            raise
+        for cr in items:
+            name = cr["metadata"]["name"]
+            if self._dgdr_done.get(name) or (cr.get("status") or {}).get("state") in (
+                "deployed", "failed"
+            ):
+                continue
+            try:
+                await self._fulfill_request(cr)
+                self._dgdr_done[name] = "deployed"
+            except Exception as exc:
+                logger.exception("DGDR %s failed", name)
+                self._dgdr_done[name] = "failed"
+                try:
+                    await self.client.patch_status(
+                        GROUP, VERSION, self.k8s_namespace, DGDR_PLURAL, name,
+                        {"state": "failed", "message": str(exc)[:500]},
+                    )
+                except KubeApiError:
+                    pass
+
+    async def _fulfill_request(self, cr: Dict[str, Any]) -> None:
+        """Run SLA sizing (profiler/sla.py) and create the sized
+        GraphDeployment (ref: dynamographdeploymentrequest_types.go flow)."""
+        from dynamo_tpu.profiler.sla import SlaTargets, Workload, recommend
+
+        name = cr["metadata"]["name"]
+        spec = cr.get("spec") or {}
+        targets = SlaTargets(
+            ttft_s=float(spec.get("sla", {}).get("ttft_s", 0.5)),
+            itl_s=float(spec.get("sla", {}).get("itl_s", 0.02)),
+        )
+        wl = spec.get("workload", {})
+        workload = Workload(
+            request_rate=float(wl.get("requests_per_s", 1.0)),
+            isl=float(wl.get("isl", 512)),
+            osl=float(wl.get("osl", 128)),
+        )
+        profiles = self.sla_profiles
+        if profiles is None:
+            raise RuntimeError(
+                "operator has no profile tables (sla_profiles); supply "
+                "pre-swept ConfigProfiles or run the profiler first"
+            )
+        report = recommend(profiles, targets, workload)
+        if report.chosen is None:
+            raise RuntimeError(
+                f"no config meets the SLA: {report.rejected}"
+            )
+        rec = report.chosen
+        template = spec.get("template") or {}
+        services = dict(template.get("services") or {})
+        # Size the worker pools the recommendation asked for.
+        for svc_name, svc in services.items():
+            role = svc.get("planner_role", "decode")
+            if svc.get("planner_scaled") or svc.get("sized"):
+                svc = dict(svc)
+                svc["replicas"] = (
+                    rec.prefill_workers if role == "prefill" else rec.decode_workers
+                )
+                services[svc_name] = svc
+        body = {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoTpuGraphDeployment",
+            "metadata": {"name": spec.get("deploymentName", f"{name}-deployment")},
+            "spec": {**template, "services": services},
+        }
+        try:
+            await self.client.create(
+                GROUP, VERSION, self.k8s_namespace, GD_PLURAL, body
+            )
+        except KubeApiError as exc:
+            if exc.status != 409:  # already created by a prior pass
+                raise
+        await self.client.patch_status(
+            GROUP, VERSION, self.k8s_namespace, DGDR_PLURAL, name,
+            {
+                "state": "deployed",
+                "deployment": body["metadata"]["name"],
+                "recommendation": {
+                    "config": rec.config_name,
+                    "prefill_workers": rec.prefill_workers,
+                    "decode_workers": rec.decode_workers,
+                    "total_chips": rec.total_chips,
+                },
+            },
+        )
+        logger.info(
+            "DGDR %s → deployment %s (%s: %dP/%dD, %d chips)",
+            name, body["metadata"]["name"], rec.config_name,
+            rec.prefill_workers, rec.decode_workers, rec.total_chips,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self) -> None:
+        """Level-triggered loop: reconcile everything, then watch until the
+        window closes (events only wake us early — the list is the truth)."""
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_deployments_once()
+                await self.reconcile_requests_once()
+            except Exception:
+                logger.exception("operator reconcile pass failed")
+            # Block on the watch stream until something changes or the
+            # window times out, then loop back to a full re-list.
+            try:
+                async for _event in self.client.watch(
+                    GROUP, VERSION, self.k8s_namespace, GD_PLURAL,
+                    timeout_s=self.watch_timeout_s,
+                ):
+                    break  # any event → re-reconcile
+            except KubeApiError:
+                await asyncio.sleep(self.reconcile_interval_s)
+            except Exception:
+                await asyncio.sleep(self.reconcile_interval_s)
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._tasks = [
+            asyncio.get_event_loop().create_task(self.run(), name="k8s-operator")
+        ]
+
+    async def stop(self, *, teardown: bool = True) -> None:
+        self._stop.set()
+        for t in self._tasks:
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        for name in list(self._controllers):
+            ctrl = self._controllers.pop(name)
+            await ctrl.stop(teardown=teardown)
+        await self.client.close()

@@ -34,6 +34,7 @@ import numpy as np
 
 from dynamo_tpu.engines.mock.kv_manager import KvEvent
 from dynamo_tpu.engines.tpu.block_pool import BlockPool
+from dynamo_tpu.engines.tpu.runner import DeviceRunner
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     FinishReason,
@@ -163,34 +164,6 @@ class _Prep:
     procs: Optional[_ProcPrep] = None
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_blocks(cache, idx, blocks):
-    """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on both layouts:
-    stacked [L, NB, BS, KH, D] or per-layer tuple of [NB, BS, KH, D]."""
-    if isinstance(cache, (tuple, list)):
-        return tuple(c.at[idx].set(blocks[l]) for l, c in enumerate(cache))
-    return cache.at[:, idx].set(blocks)
-
-
-@jax.jit
-def _gather_blocks(cache, idx):
-    """[L, n, BS, KH, D] of blocks idx [n], from either cache layout, as ONE
-    device program (a per-layer host gather would pay L dispatch RTTs)."""
-    if isinstance(cache, (tuple, list)):
-        return jnp.stack([c[idx] for c in cache])
-    return cache[:, idx]
-
-
-def _adapter_to_host(adapter):
-    """Keep retained adapters as host numpy: only the STACKED arrays belong
-    in HBM — retaining per-adapter device copies for restacking would
-    double LoRA device memory."""
-    adapter.weights = {
-        t: (np.asarray(A), np.asarray(B)) for t, (A, B) in adapter.weights.items()
-    }
-    return adapter
-
-
 class JaxEngine:
     """AsyncEngine over the native JAX model."""
 
@@ -202,83 +175,30 @@ class JaxEngine:
         mesh: Optional[jax.sharding.Mesh] = None,
         rules: Optional[ShardingRules] = None,
         on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+        topology: Optional[Any] = None,  # parallel/multihost.HostTopology
+        runner: Optional[DeviceRunner] = None,
     ) -> None:
         self.args = args
         self.config = args.config
         self.mesh = mesh
         self.rules = rules or ShardingRules()
-        backend = jax.default_backend()
-        self._use_kernel = (
-            args.use_kernel if args.use_kernel is not None else backend == "tpu"
-        )
         self.pool = BlockPool(
             args.num_kv_blocks, args.block_size, on_event=on_kv_event
         )
-
-        self._param_axes = llama.param_logical_axes(self.config)
-        if args.quantization and args.quantization != "int8":
-            raise ValueError(
-                f"unsupported quantization {args.quantization!r} (int8 only)"
-            )
-        if params is None:
-            if args.quantization:
-                # Random-init directly in int8 — a full-precision tree
-                # would fill HBM (8B fp ≈ a whole 16 GB chip) and fp init
-                # on the single host core takes minutes at 8B scale.
-                from dynamo_tpu.models.quantize import init_quantized_params
-
-                params = init_quantized_params(self.config, args.seed)
-            else:
-                params = llama.init_params(
-                    self.config, jax.random.PRNGKey(args.seed)
-                )
-        if args.quantization:
-            from dynamo_tpu.models.quantize import quantize_params
-
-            # Idempotent for pre-quantized checkpoints (hf_loader/weight
-            # cache quantize host-side); rebuilds the axes tree either way.
-            params, self._param_axes = quantize_params(params, self._param_axes)
-        if mesh is not None:
-            params = shard_params(params, self._param_axes, self.rules, mesh)
-        self.params = params
-        self._k_cache, self._v_cache = self._alloc_kv_cache()
-        # Sleep/wake (ref: vllm handlers.py sleep :286 / wake_up :317 — RL
-        # weight-sync workflows park the engine to free accelerator memory).
-        # 0 = awake; 1 = KV cache freed; 2 = weights offloaded to host too.
-        self._sleep_level = 0
+        # All device state (params, LoRA stacks, KV caches, RNG, compiled
+        # programs, sleep transitions) lives in the DeviceRunner; this class
+        # owns scheduling policy only. A pre-built runner may be injected
+        # (multihost leader shares construction with followers).
+        self.runner = runner or DeviceRunner(
+            args, params, mesh=mesh, rules=self.rules, topology=topology,
+        )
+        self._use_kernel = self.runner.use_kernel
+        # Sleep/wake orchestration (ref: vllm handlers.py sleep :286 /
+        # wake_up :317 — RL weight-sync workflows park the engine to free
+        # accelerator memory). 0 = awake; 1 = KV freed; 2 = weights too.
         self._sleep_requested: Optional[int] = None
         self._sleep_inflight = False
         self._sleep_event = asyncio.Event()
-        self._host_params: Optional[Any] = None
-
-        # Multi-LoRA state: adapter name → index into the stacked arrays
-        # (index 0 is the zero "no adapter" slot).
-        self._lora: Optional[Dict[str, Any]] = None
-        self._lora_index: Dict[str, int] = {}
-        self._adapter_list: List[Optional[Any]] = []  # slot i ↔ stacked index i+1
-        if args.lora_dir:
-            self._load_loras(args.lora_dir)
-
-        # RNG: one fixed base key + a host-side step counter folded in
-        # INSIDE the jitted programs. A host-side jax.random.split per
-        # dispatch measured ~28ms on the tunneled TPU platform — pure
-        # overhead on every engine step.
-        self._rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
-        self._rng_step = 0
-        self._step_fn = self._build_step_fn()
-        # Two decode programs: the logprob-free one skips a full-vocab
-        # log-softmax per fused step (the common case); the other serves
-        # batches where any request asked for logprobs.
-        self._decode_fn = self._build_decode_fn(want_logprobs=False)
-        self._decode_fn_logprobs = self._build_decode_fn(want_logprobs=True)
-        # Logits-processor program variants (penalties/bias/min-p) compile
-        # lazily on the first request that uses one — the common no-processor
-        # path never pays for the [S, V] bookkeeping or the extra HBM reads.
-        self._decode_procs_fns: Dict[bool, Any] = {}
-        # (want_procs, want_top) → lazily compiled prefill program variants.
-        self._step_fns: Dict[Tuple[bool, bool], Any] = {(False, False): self._step_fn}
-        self._proc_state: Optional[Any] = None  # logits_process.ProcState
-        self._spec_fn: Optional[Any] = None  # speculative verify program
         self.spec_proposed = 0
         self.spec_accepted = 0
 
@@ -324,208 +244,55 @@ class JaxEngine:
         self.prefill_tokens = 0
         self.generated_tokens = 0
 
-    # -- multi-LoRA --------------------------------------------------------
+    # -- device-state delegates (DeviceRunner owns the mechanism) ---------
 
-    def _alloc_kv_cache(self):
-        k_cache, v_cache = llama.init_kv_cache(
-            self.config, self.args.num_kv_blocks, self.args.block_size,
-            layered=self.args.layered_cache,
-        )
-        if self.mesh is not None:
-            if self.args.layered_cache:
-                cache_sharding = self.rules.sharding(
-                    self.mesh, *llama.kv_cache_layered_axes()
-                )
-                k_cache = tuple(jax.device_put(k, cache_sharding) for k in k_cache)
-                v_cache = tuple(jax.device_put(v, cache_sharding) for v in v_cache)
-            else:
-                cache_sharding = self.rules.sharding(
-                    self.mesh, *llama.kv_cache_logical_axes()
-                )
-                k_cache = jax.device_put(k_cache, cache_sharding)
-                v_cache = jax.device_put(v_cache, cache_sharding)
-        return k_cache, v_cache
+    @property
+    def params(self):
+        return self.runner.params
 
-    def _load_loras(self, lora_dir: str) -> None:
-        """Load every adapter under ``lora_dir`` and stack them layer-major
-        for the scan-over-layers forward (lora/loader.py)."""
-        from dynamo_tpu.lora import LocalLoRASource, load_lora_adapter
+    @property
+    def _k_cache(self):
+        return self.runner.k_cache
 
-        source = LocalLoRASource(lora_dir)
-        names = source.list_adapters()
-        if not names:
-            logger.warning("lora_dir %s contains no adapters", lora_dir)
-            return
-        self._adapter_list = [
-            _adapter_to_host(
-                load_lora_adapter(source.fetch(n, lora_dir), self.config, name=n)
-            )
-            for n in names
-        ]
-        self._restack_loras()
+    @property
+    def _v_cache(self):
+        return self.runner.v_cache
 
-    def _restack_loras(self) -> None:
-        """Rebuild the stacked LoRA arrays from ``_adapter_list`` (None
-        entries are freed slots that keep later indices stable — in-flight
-        sequences hold adapter ids by position)."""
-        from dynamo_tpu.lora.loader import LoRAAdapter, stack_adapters
+    @property
+    def _host_params(self):
+        return self.runner.host_params
 
-        real = [a for a in self._adapter_list if a is not None]
-        if not real:
-            self._lora = None
-            self._lora_index = {}
-            return
-        padded = [
-            a if a is not None
-            else LoRAAdapter(name=f"__free_{i}", rank=1, scaling=0.0)
-            for i, a in enumerate(self._adapter_list)
-        ]
-        targets = sorted({t for a in real for t in a.targets})
-        stacked = stack_adapters(padded, self.config, targets)
-        # [N+1, L, ...] → layer-major [L, N+1, ...] for lax.scan xs.
-        self._lora = {
-            t: (A.swapaxes(0, 1), B.swapaxes(0, 1)) for t, (A, B) in stacked.items()
-        }
-        self._lora_index = {
-            a.name: i
-            for i, a in enumerate(self._adapter_list, start=1)
-            if a is not None
-        }
-        logger.info(
-            "LoRA stack: %d slot(s), adapters %s (targets: %s)",
-            len(self._adapter_list), sorted(self._lora_index), targets,
-        )
+    @property
+    def _lora_index(self) -> Dict[str, int]:
+        return self.runner.lora_index
 
     def load_lora(self, name: str, adapter_dir: str) -> None:
         """Load one adapter at runtime (ref: vllm handlers.py LoRA load
         :453). Changing the stack shape recompiles the decode program on the
         next step — acceptable for an administrative operation."""
-        if name in self._lora_index:
+        if name in self.runner.lora_index:
             raise ValueError(f"LoRA adapter {name!r} already loaded")
+        from dynamo_tpu.engines.tpu.runner import _adapter_to_host
         from dynamo_tpu.lora import load_lora_adapter
 
         adapter = _adapter_to_host(
             load_lora_adapter(adapter_dir, self.config, name=name)
         )
         adapter.name = name
-        for i, slot in enumerate(self._adapter_list):
-            if slot is None:
-                self._adapter_list[i] = adapter
-                break
-        else:
-            self._adapter_list.append(adapter)
-        self._restack_loras()
+        self.runner.install_adapter(adapter)
 
     def unload_lora(self, name: str) -> None:
-        """Unload an adapter; its slot is zeroed (kept) so other adapters'
-        indices — captured by in-flight sequences — stay valid."""
-        idx = self._lora_index.get(name)
-        if idx is None:
-            raise KeyError(f"LoRA adapter {name!r} not loaded")
-        self._adapter_list[idx - 1] = None
-        self._restack_loras()
+        """Unload by name. In-flight sequences using the adapter keep their
+        (now zeroed) slot — they degrade to base-model output rather than
+        crash; new requests naming it are rejected at admission."""
+        if name not in self.runner.lora_index:
+            # KeyError (not ValueError): the admin surface maps it to 404
+            # while ValueError means conflict (409) on the load side.
+            raise KeyError(f"LoRA adapter {name!r} is not loaded")
+        self.runner.remove_adapter(name)
 
     def lora_names(self) -> List[str]:
-        return sorted(self._lora_index)
-
-    # -- jitted step -------------------------------------------------------
-
-    def _build_step_fn(self, want_procs: bool = False, want_top: bool = False):
-        cfg = self.config
-        use_kernel = self._use_kernel
-        num_top = self.args.top_logprobs_cap if want_top else 0
-
-        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
-                 mm_embeds, mm_slot,
-                 minp=None, rep=None, pres=None, freq=None,
-                 bias_ids=None, bias_vals=None, pmask=None):
-            # Derive the per-dispatch key on device (host-side split costs
-            # ~28ms/dispatch on the tunneled platform).
-            rng = jax.random.fold_in(rng, rng_step)
-            logits, k_cache, v_cache = llama.forward_paged(
-                params, cfg, tokens, start_pos, chunk_lens, block_tables,
-                k_cache, v_cache, use_kernel=use_kernel,
-                lora=lora, adapter_ids=adapter_ids,
-                mm_embeds=mm_embeds, mm_slot=mm_slot,
-            )
-            if want_procs:
-                from dynamo_tpu.ops import logits_process as lp
-
-                # At the first sampled token only the prompt has been seen.
-                pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
-                                   bias_ids=bias_ids, bias_vals=bias_vals)
-                logits = lp.apply_prompt_only(logits, pmask, pp)
-                toks = sample_tokens(logits, rng, temp, topk, topp, minp)
-            else:
-                toks = sample_tokens(logits, rng, temp, topk, topp)
-            logp = compute_logprobs(logits, toks)
-            if num_top > 0:
-                from dynamo_tpu.ops.sampling import top_logprobs as top_op
-
-                tv, ti = top_op(logits, num_top)
-                return toks, logp, tv, ti, k_cache, v_cache
-            return toks, logp, k_cache, v_cache
-
-        return jax.jit(step, donate_argnums=(2, 3))
-
-    def _build_decode_fn(self, want_logprobs: bool = False,
-                         want_procs: bool = False):
-        cfg = self.config
-        use_kernel = self._use_kernel
-        num_steps = self.args.decode_steps
-
-        # The logprobs program variants also surface the per-step top-N
-        # alternatives (OpenAI top_logprobs); the common variants skip it.
-        num_top = self.args.top_logprobs_cap if want_logprobs else 0
-
-        if not want_procs:
-            def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                     block_tables, rng, rng_step, temp, topk, topp, adapter_ids):
-                rng = jax.random.fold_in(rng, rng_step)
-                return llama.decode_multi(
-                    params, cfg, tokens, start_pos, active, block_tables,
-                    k_cache, v_cache, rng, temp, topk, topp,
-                    num_steps=num_steps, use_kernel=use_kernel,
-                    lora=lora, adapter_ids=adapter_ids,
-                    want_logprobs=want_logprobs,
-                    num_top_logprobs=num_top,
-                )
-
-            return jax.jit(step, donate_argnums=(2, 3))
-
-        from dynamo_tpu.ops import logits_process as lp
-
-        def step_p(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                   block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
-                   minp, rep, pres, freq, bias_ids, bias_vals, counts, pmask):
-            rng = jax.random.fold_in(rng, rng_step)
-            pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
-                               bias_ids=bias_ids, bias_vals=bias_vals)
-            st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
-            out = llama.decode_multi(
-                params, cfg, tokens, start_pos, active, block_tables,
-                k_cache, v_cache, rng, temp, topk, topp,
-                num_steps=num_steps, use_kernel=use_kernel,
-                lora=lora, adapter_ids=adapter_ids,
-                want_logprobs=want_logprobs,
-                min_p=minp, proc_params=pp, proc_state=st,
-                num_top_logprobs=num_top,
-            )
-            st = out[-1]
-            return out[:-3] + (out[-3], out[-2], st.out_counts)
-
-        # donate caches + the token-count array (functionally threaded).
-        return jax.jit(step_p, donate_argnums=(2, 3, 20))
-
-    def _ensure_proc_state(self):
-        if self._proc_state is None:
-            from dynamo_tpu.ops import logits_process as lp
-
-            self._proc_state = lp.init_state(
-                self.args.max_num_seqs, self.config.vocab_size
-            )
-        return self._proc_state
+        return sorted(self.runner.lora_index)
 
     def _run_decode(
         self, tokens, start_pos, active, block_tables, temp, topk, topp,
@@ -533,105 +300,29 @@ class JaxEngine:
     ):
         """Multi-step decode on the device thread. Returns ([B, K] tokens,
         [B, K] logprobs, top_vals [B, K, N] | None, top_ids | None)."""
-        step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
-        self._rng_step += 1
-        topv = topi = None
+        procs = None
         if want_procs:
-            from dynamo_tpu.ops import logits_process as lp
-
-            fn = self._decode_procs_fns.get(want_logprobs)
-            if fn is None:
-                fn = self._build_decode_fn(want_logprobs, want_procs=True)
-                self._decode_procs_fns[want_logprobs] = fn
-            st = self._ensure_proc_state()
-            out = fn(
-                self.params, self._lora, self._k_cache, self._v_cache,
-                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
-                jnp.asarray(block_tables), self._rng, step_id,
-                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-                jnp.asarray(adapter_ids),
-                jnp.asarray(self._minp), jnp.asarray(self._rep),
-                jnp.asarray(self._pres), jnp.asarray(self._freq),
-                jnp.asarray(self._bias_ids), jnp.asarray(self._bias_vals),
-                st.out_counts, st.prompt_mask,
+            procs = (
+                self._minp.copy(), self._rep.copy(), self._pres.copy(),
+                self._freq.copy(), self._bias_ids.copy(),
+                self._bias_vals.copy(),
             )
-            if want_logprobs:
-                toks, logp, topv, topi, self._k_cache, self._v_cache, counts = out
-            else:
-                toks, logp, self._k_cache, self._v_cache, counts = out
-            self._proc_state = lp.ProcState(
-                out_counts=counts, prompt_mask=st.prompt_mask
-            )
-        else:
-            fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
-            out = fn(
-                self.params, self._lora, self._k_cache, self._v_cache,
-                jnp.asarray(tokens), jnp.asarray(start_pos), jnp.asarray(active),
-                jnp.asarray(block_tables), self._rng, step_id,
-                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-                jnp.asarray(adapter_ids),
-            )
-            if want_logprobs:
-                toks, logp, topv, topi, self._k_cache, self._v_cache = out
-            else:
-                toks, logp, self._k_cache, self._v_cache = out
-        return (
-            np.asarray(jax.device_get(toks)),
-            np.asarray(jax.device_get(logp)),
-            None if topv is None else np.asarray(jax.device_get(topv)),
-            None if topi is None else np.asarray(jax.device_get(topi)),
+        return self.runner.run_decode(
+            tokens, start_pos, active, block_tables, temp, topk, topp,
+            adapter_ids, want_logprobs=want_logprobs, procs=procs,
         )
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
         adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
     ):
-        """Execute one step on the device thread (blocking). Caller passes
-        numpy inputs; returns (sampled tokens, logprobs, top_vals | None,
-        top_ids | None) as numpy.
-
-        ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
-        prompt_mask) per-row arrays — routes through the logits-processor
-        prefill program. ``want_top``: also return the top-N alternatives
-        (the logprobs program variants, lazily compiled)."""
-        step_id = np.int32(self._rng_step & 0x7FFFFFFF)  # int32-safe wrap
-        self._rng_step += 1
-        key = (procs is not None, bool(want_top))
-        fn = self._step_fns.get(key)
-        if fn is None:
-            if key == (False, False):
-                fn = self._step_fn
-            else:
-                fn = self._build_step_fn(want_procs=key[0], want_top=key[1])
-            self._step_fns[key] = fn
-        args = [
-            self.params, self._lora, self._k_cache, self._v_cache,
-            jnp.asarray(tokens), jnp.asarray(start_pos),
-            jnp.asarray(chunk_lens), jnp.asarray(block_tables),
-            self._rng, step_id,
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-            jnp.asarray(adapter_ids),
-            None if mm_embeds is None else jnp.asarray(mm_embeds),
-            None if mm_slot is None else jnp.asarray(mm_slot),
-        ]
-        if procs is not None:
-            minp, rep, pres, freq, bias_ids, bias_vals, pmask = procs
-            args += [
-                jnp.asarray(minp), jnp.asarray(rep), jnp.asarray(pres),
-                jnp.asarray(freq), jnp.asarray(bias_ids),
-                jnp.asarray(bias_vals), jnp.asarray(pmask),
-            ]
-        out = fn(*args)
-        topv = topi = None
-        if want_top:
-            toks, logp, topv, topi, self._k_cache, self._v_cache = out
-        else:
-            toks, logp, self._k_cache, self._v_cache = out
-        return (
-            np.asarray(jax.device_get(toks)),
-            np.asarray(jax.device_get(logp)),
-            None if topv is None else np.asarray(jax.device_get(topv)),
-            None if topi is None else np.asarray(jax.device_get(topi)),
+        """One prefill step on the device thread (blocking). See
+        DeviceRunner.run_step; kept as an engine method so tests can inject
+        faults by monkeypatching it."""
+        return self.runner.run_step(
+            tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
+            adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot, procs=procs,
+            want_top=want_top,
         )
 
     async def _device(self, fn, *a):
@@ -690,7 +381,9 @@ class JaxEngine:
 
     @property
     def sleep_level(self) -> int:
-        return self._sleep_level
+        return self.runner.sleep_level
+
+    _sleep_level = property(lambda self: self.runner.sleep_level)
 
     async def sleep(self, level: int = 1) -> None:
         """Park the engine to free device memory (ref: vllm handlers.py
@@ -699,6 +392,14 @@ class JaxEngine:
         wait until wake()."""
         if self._sleep_level > 0:
             return
+        if int(level) >= 2 and self.runner.multihost:
+            # Validate HERE, not in the tick: a failure after the request is
+            # queued would leave the sleep() caller awaiting an event that
+            # never fires.
+            raise RuntimeError(
+                "sleep level 2 (weight offload) is unsupported in multihost "
+                "mode; use level 1"
+            )
         await self.start()
         if self._failure is not None or (
             self._loop_task is None or self._loop_task.done()
@@ -730,29 +431,10 @@ class JaxEngine:
         # Device frees only — BlockPool (and its KV-event callback, which
         # touches asyncio state) is cleared on the event-loop thread in
         # _sleep_tick, per the engine's threading contract.
-        self._k_cache = None
-        self._v_cache = None
-        if level >= 2:
-            self._host_params = jax.device_get(self.params)
-            self.params = None
-        self._sleep_level = level
-        logger.info("engine asleep at level %d", level)
+        self.runner.sleep_device(level)
 
     def _do_wake(self) -> None:
-        if self._sleep_level >= 2 and self._host_params is not None:
-            params = self._host_params
-            self._host_params = None
-            if self.mesh is not None:
-                params = shard_params(
-                    params, self._param_axes, self.rules, self.mesh
-                )
-            else:
-                params = jax.tree_util.tree_map(jnp.asarray, params)
-            self.params = params
-        if self._k_cache is None:
-            self._k_cache, self._v_cache = self._alloc_kv_cache()
-        self._sleep_level = 0
-        logger.info("engine awake")
+        self.runner.wake_device()
 
     # -- AsyncEngine -------------------------------------------------------
 
@@ -1211,13 +893,12 @@ class JaxEngine:
             self._freq[slot] = p.freq
             self._bias_ids[slot] = p.bias_ids
             self._bias_vals[slot] = p.bias_vals
-            st = self._ensure_proc_state()
             # Original prompt only in the mask; prior generated tokens (a
             # preempted sequence being re-admitted) restore output counts.
-            st = lp.reset_slot(
-                st, slot, seq.request.token_ids, seq.generated
+            self.runner.proc_reset_slot(
+                slot, seq.request.token_ids, seq.generated
             )
-            self._proc_state = lp.count_token(st, slot, first_token)
+            self.runner.proc_count(slot, first_token)
         self._emit_token(seq, first_token, first_logprob, first_top)
 
     def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
@@ -1331,32 +1012,11 @@ class JaxEngine:
             return []
         return toks[cont : cont + self.args.spec_k]
 
-    def _build_spec_fn(self):
-        cfg = self.config
-        use_kernel = self._use_kernel
-
-        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, adapter_ids):
-            logits, k_cache, v_cache = llama.forward_paged(
-                params, cfg, tokens, start_pos, chunk_lens, block_tables,
-                k_cache, v_cache, use_kernel=use_kernel,
-                lora=lora, adapter_ids=adapter_ids, all_logits=True,
-            )
-            return jnp.argmax(logits, axis=-1), k_cache, v_cache
-
-        return jax.jit(step, donate_argnums=(2, 3))
-
     def _run_spec(self, tokens, start_pos, chunk_lens, block_tables,
-                  adapter_ids) -> np.ndarray:
-        if self._spec_fn is None:
-            self._spec_fn = self._build_spec_fn()
-        toks, self._k_cache, self._v_cache = self._spec_fn(
-            self.params, self._lora, self._k_cache, self._v_cache,
-            jnp.asarray(tokens), jnp.asarray(start_pos),
-            jnp.asarray(chunk_lens), jnp.asarray(block_tables),
-            jnp.asarray(adapter_ids),
+                  adapter_ids):
+        return self.runner.run_spec(
+            tokens, start_pos, chunk_lens, block_tables, adapter_ids
         )
-        return np.asarray(jax.device_get(toks))
 
     def _spec_eligible(self, active: "List[_Sequence]") -> bool:
         for s in active:
@@ -1649,18 +1309,7 @@ class JaxEngine:
             if not ids:
                 return [], None, None
 
-            def gather():
-                idx = jnp.asarray(np.array(ids, dtype=np.int32))
-                # [L, n, BS, KH, D] → [n, L, BS, KH, D]
-                k = np.asarray(
-                    jax.device_get(_gather_blocks(self._k_cache, idx).swapaxes(0, 1))
-                )
-                v = np.asarray(
-                    jax.device_get(_gather_blocks(self._v_cache, idx).swapaxes(0, 1))
-                )
-                return k, v
-
-            k, v = await self._device(gather)
+            k, v = await self._device(self.runner.gather_blocks, ids)
             return found, k, v
         finally:
             if pinned_ids:
@@ -1697,15 +1346,11 @@ class JaxEngine:
         if not ids:
             return 0
 
-        def scatter():
-            idx = jnp.asarray(np.array(ids, dtype=np.int32))
-            k_sel = jnp.asarray(k_blocks[sel]).swapaxes(0, 1).astype(self.config.dtype)
-            v_sel = jnp.asarray(v_blocks[sel]).swapaxes(0, 1).astype(self.config.dtype)
-            self._k_cache = _scatter_blocks(self._k_cache, idx, k_sel)
-            self._v_cache = _scatter_blocks(self._v_cache, idx, v_sel)
-
         try:
-            await self._device(scatter)
+            await self._device(
+                self.runner.scatter_blocks, ids,
+                np.asarray(k_blocks)[sel], np.asarray(v_blocks)[sel],
+            )
         except Exception:
             for b in ids:
                 self.pool.release([b], [])  # data never landed; just free
@@ -1742,17 +1387,7 @@ class JaxEngine:
             data_name = f"kv_blocks-{uuid.uuid4().hex[:12]}.npz" if ids else ""
             if ids:
                 def gather_and_write():
-                    idx = jnp.asarray(np.array(ids, dtype=np.int32))
-                    k = np.asarray(
-                        jax.device_get(
-                            _gather_blocks(self._k_cache, idx).swapaxes(0, 1)
-                        )
-                    )
-                    v = np.asarray(
-                        jax.device_get(
-                            _gather_blocks(self._v_cache, idx).swapaxes(0, 1)
-                        )
-                    )
+                    k, v = self.runner.gather_blocks(ids)
                     # Disk write stays off the event loop (multi-GB stall).
                     np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)
 

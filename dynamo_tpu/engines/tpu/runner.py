@@ -1,0 +1,631 @@
+"""DeviceRunner: sole owner of the engine's device state and programs.
+
+Split out of the engine monolith so the scheduler (engines/tpu/engine.py)
+owns *policy* — admission, slots, stop conditions — while this owns
+*mechanism*: params, LoRA stacks, KV cache arrays, RNG, the compiled step /
+fused-decode / speculative-verify programs, sleep/wake device transitions,
+and block gather/scatter. The reference keeps the same boundary between its
+scheduler components and engine runtimes (SURVEY §2.2 native-engine role).
+
+Multi-host SPMD: when constructed with a multi-process topology
+(parallel/multihost.py), the runner on the leader mirrors every device
+invocation over the op channel (runtime/network/spmd_channel.py) and the
+runner on each follower replays it (engines/tpu/spmd.follow) — every
+process issues identical global-mesh programs, the JAX-native form of the
+reference's DP leader / non-leader ranks
+(components/src/dynamo/vllm/main.py:67-78).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
+from dynamo_tpu.parallel.sharding import ShardingRules, shard_params
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(cache, idx, blocks):
+    """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on both layouts:
+    stacked [L, NB, BS, KH, D] or per-layer tuple of [NB, BS, KH, D]."""
+    if isinstance(cache, (tuple, list)):
+        return tuple(c.at[idx].set(blocks[l]) for l, c in enumerate(cache))
+    return cache.at[:, idx].set(blocks)
+
+
+@jax.jit
+def _gather_blocks(cache, idx):
+    """[L, n, BS, KH, D] of blocks idx [n], from either cache layout, as ONE
+    device program (a per-layer host gather would pay L dispatch RTTs)."""
+    if isinstance(cache, (tuple, list)):
+        return jnp.stack([c[idx] for c in cache])
+    return cache[:, idx]
+
+
+def _adapter_to_host(adapter):
+    """Keep retained adapters as host numpy: only the STACKED arrays belong
+    in HBM — retaining per-adapter device copies for restacking would
+    double LoRA device memory."""
+    adapter.weights = {
+        t: (np.asarray(A), np.asarray(B)) for t, (A, B) in adapter.weights.items()
+    }
+    return adapter
+
+
+class DeviceRunner:
+    """Device-state owner + program cache for one (possibly multi-process)
+    logical worker. All ``run_*``/device methods are synchronous and meant
+    to execute on the engine's single device thread (or the follower's main
+    thread)."""
+
+    def __init__(
+        self,
+        args: Any,  # JaxEngineArgs
+        params: Optional[Any] = None,
+        *,
+        mesh=None,
+        rules: Optional[ShardingRules] = None,
+        topology=None,  # parallel/multihost.HostTopology
+    ) -> None:
+        self.args = args
+        self.config = args.config
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        self.topology = topology
+        self.multihost = bool(topology is not None and topology.is_multihost)
+        self._spmd_tx = None  # SpmdBroadcaster on the leader
+        backend = jax.default_backend()
+        self.use_kernel = (
+            args.use_kernel if args.use_kernel is not None else backend == "tpu"
+        )
+        if self.multihost and mesh is None:
+            raise ValueError("multihost topology requires a device mesh")
+        self._repl = (
+            NamedSharding(mesh, P()) if (self.multihost and mesh is not None) else None
+        )
+
+        self._param_axes = llama.param_logical_axes(self.config)
+        if args.quantization and args.quantization != "int8":
+            raise ValueError(
+                f"unsupported quantization {args.quantization!r} (int8 only)"
+            )
+        if params is None:
+            if args.quantization:
+                # Random-init directly in int8 — a full-precision tree
+                # would fill HBM (8B fp ≈ a whole 16 GB chip) and fp init
+                # on the single host core takes minutes at 8B scale.
+                from dynamo_tpu.models.quantize import init_quantized_params
+
+                params = init_quantized_params(self.config, args.seed)
+            else:
+                params = llama.init_params(
+                    self.config, jax.random.PRNGKey(args.seed)
+                )
+        if args.quantization:
+            from dynamo_tpu.models.quantize import quantize_params
+
+            # Idempotent for pre-quantized checkpoints (hf_loader/weight
+            # cache quantize host-side); rebuilds the axes tree either way.
+            params, self._param_axes = quantize_params(params, self._param_axes)
+        if mesh is not None:
+            params = shard_params(params, self._param_axes, self.rules, mesh)
+        self.params = params
+        self.k_cache, self.v_cache = self.alloc_kv_cache()
+
+        # Multi-LoRA state: adapter name → index into the stacked arrays
+        # (index 0 is the zero "no adapter" slot).
+        self.lora: Optional[Dict[str, Any]] = None
+        self.lora_index: Dict[str, int] = {}
+        self._adapter_list: List[Optional[Any]] = []  # slot i ↔ stacked index i+1
+        if args.lora_dir:
+            self._load_loras(args.lora_dir)
+
+        # RNG: one fixed base key + a host-side step counter folded in
+        # INSIDE the jitted programs. A host-side jax.random.split per
+        # dispatch measured ~28ms on the tunneled TPU platform — pure
+        # overhead on every engine step.
+        self.rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
+        if self._repl is not None:
+            self.rng = jax.device_put(self.rng, self._repl)
+        self.rng_step = 0
+
+        self._step_fn = self._build_step_fn()
+        # Two decode programs: the logprob-free one skips a full-vocab
+        # log-softmax per fused step (the common case); the other serves
+        # batches where any request asked for logprobs.
+        self._decode_fn = self._build_decode_fn(want_logprobs=False)
+        self._decode_fn_logprobs = self._build_decode_fn(want_logprobs=True)
+        # Logits-processor program variants (penalties/bias/min-p) compile
+        # lazily on the first request that uses one — the common no-processor
+        # path never pays for the [S, V] bookkeeping or the extra HBM reads.
+        self._decode_procs_fns: Dict[bool, Any] = {}
+        # (want_procs, want_top) → lazily compiled prefill program variants.
+        self._step_fns: Dict[Tuple[bool, bool], Any] = {(False, False): self._step_fn}
+        self.proc_state: Optional[Any] = None  # logits_process.ProcState
+        self._spec_fn: Optional[Any] = None  # speculative verify program
+        self.sleep_level = 0
+        self.host_params: Optional[Any] = None
+
+    # -- SPMD --------------------------------------------------------------
+
+    def set_broadcaster(self, broadcaster) -> None:
+        """Leader only: mirror every device op to the followers."""
+        self._spmd_tx = broadcaster
+
+    def _mirror(self, op: str, **kwargs: Any) -> None:
+        if self._spmd_tx is not None:
+            self._spmd_tx.send(op, **kwargs)
+
+    def _dev(self, x):
+        """Host → device conversion for replicated jit inputs. Multihost:
+        every process supplies the identical full array, device_put builds
+        the replicated global array; single-process: plain asarray (jit
+        handles placement)."""
+        if x is None:
+            return None
+        if self._repl is not None:
+            return jax.device_put(np.asarray(x), self._repl)
+        return jnp.asarray(x)
+
+    def _constrain_out(self, *arrays):
+        """Force small sampled outputs fully-replicated under multihost so
+        every process (and the leader's numpy readback) can see them."""
+        if not self.multihost:
+            return arrays if len(arrays) > 1 else arrays[0]
+        out = tuple(
+            jax.lax.with_sharding_constraint(a, self._repl) for a in arrays
+        )
+        return out if len(out) > 1 else out[0]
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc_kv_cache(self):
+        k_cache, v_cache = llama.init_kv_cache(
+            self.config, self.args.num_kv_blocks, self.args.block_size,
+            layered=self.args.layered_cache,
+        )
+        if self.mesh is not None:
+            if self.args.layered_cache:
+                cache_sharding = self.rules.sharding(
+                    self.mesh, *llama.kv_cache_layered_axes()
+                )
+                k_cache = tuple(jax.device_put(k, cache_sharding) for k in k_cache)
+                v_cache = tuple(jax.device_put(v, cache_sharding) for v in v_cache)
+            else:
+                cache_sharding = self.rules.sharding(
+                    self.mesh, *llama.kv_cache_logical_axes()
+                )
+                k_cache = jax.device_put(k_cache, cache_sharding)
+                v_cache = jax.device_put(v_cache, cache_sharding)
+        return k_cache, v_cache
+
+    # -- LoRA --------------------------------------------------------------
+
+    def _load_loras(self, lora_dir: str) -> None:
+        """Load every adapter under ``lora_dir`` and stack them layer-major
+        for the layer-loop forward (lora/loader.py)."""
+        from dynamo_tpu.lora import LocalLoRASource, load_lora_adapter
+
+        source = LocalLoRASource(lora_dir)
+        names = source.list_adapters()
+        if not names:
+            logger.warning("lora_dir %s contains no adapters", lora_dir)
+            return
+        self._adapter_list = [
+            _adapter_to_host(
+                load_lora_adapter(source.fetch(n, lora_dir), self.config, name=n)
+            )
+            for n in names
+        ]
+        self._restack_loras()
+
+    def _restack_loras(self) -> None:
+        """Rebuild the stacked LoRA arrays from ``_adapter_list`` (None
+        entries are freed slots that keep later indices stable — in-flight
+        sequences hold adapter ids by position)."""
+        from dynamo_tpu.lora.loader import LoRAAdapter, stack_adapters
+
+        real = [a for a in self._adapter_list if a is not None]
+        if not real:
+            self.lora = None
+            self.lora_index = {}
+            return
+        padded = [
+            a if a is not None
+            else LoRAAdapter(name=f"__free_{i}", rank=1, scaling=0.0)
+            for i, a in enumerate(self._adapter_list)
+        ]
+        targets = sorted({t for a in real for t in a.targets})
+        stacked = stack_adapters(padded, self.config, targets)
+        # [N+1, L, ...] → layer-major [L, N+1, ...] for the layer loop.
+        self.lora = {
+            t: (self._dev(A.swapaxes(0, 1)), self._dev(B.swapaxes(0, 1)))
+            for t, (A, B) in stacked.items()
+        }
+        self.lora_index = {
+            a.name: i
+            for i, a in enumerate(self._adapter_list, start=1)
+            if a is not None
+        }
+        logger.info(
+            "LoRA stack: %d slot(s), adapters %s (targets: %s)",
+            len(self._adapter_list), sorted(self.lora_index), targets,
+        )
+
+    def install_adapter(self, adapter) -> None:
+        """Add one host-resident adapter into a free slot and restack.
+        Mirrored by value (not path) so followers need no shared FS."""
+        self._mirror(
+            "lora_install",
+            name=adapter.name, rank=adapter.rank, scaling=adapter.scaling,
+            weights={t: [A, B] for t, (A, B) in adapter.weights.items()},
+        )
+        for i, slot in enumerate(self._adapter_list):
+            if slot is None:
+                self._adapter_list[i] = adapter
+                break
+        else:
+            self._adapter_list.append(adapter)
+        self._restack_loras()
+
+    def remove_adapter(self, name: str) -> int:
+        """Free an adapter slot by name; returns its (stable) index."""
+        self._mirror("lora_remove", name=name)
+        idx = self.lora_index[name]
+        self._adapter_list[idx - 1] = None
+        self._restack_loras()
+        return idx
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _build_step_fn(self, want_procs: bool = False, want_top: bool = False):
+        cfg = self.config
+        use_kernel = self.use_kernel
+        num_top = self.args.top_logprobs_cap if want_top else 0
+
+        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
+                 block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
+                 mm_embeds, mm_slot,
+                 minp=None, rep=None, pres=None, freq=None,
+                 bias_ids=None, bias_vals=None, pmask=None):
+            # Derive the per-dispatch key on device (host-side split costs
+            # ~28ms/dispatch on the tunneled platform).
+            rng = jax.random.fold_in(rng, rng_step)
+            logits, k_cache, v_cache = llama.forward_paged(
+                params, cfg, tokens, start_pos, chunk_lens, block_tables,
+                k_cache, v_cache, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids,
+                mm_embeds=mm_embeds, mm_slot=mm_slot,
+            )
+            if want_procs:
+                from dynamo_tpu.ops import logits_process as lp
+
+                # At the first sampled token only the prompt has been seen.
+                pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
+                                   bias_ids=bias_ids, bias_vals=bias_vals)
+                logits = lp.apply_prompt_only(logits, pmask, pp)
+                toks = sample_tokens(logits, rng, temp, topk, topp, minp)
+            else:
+                toks = sample_tokens(logits, rng, temp, topk, topp)
+            logp = compute_logprobs(logits, toks)
+            if num_top > 0:
+                from dynamo_tpu.ops.sampling import top_logprobs as top_op
+
+                tv, ti = top_op(logits, num_top)
+                toks, logp, tv, ti = self._constrain_out(toks, logp, tv, ti)
+                return toks, logp, tv, ti, k_cache, v_cache
+            toks, logp = self._constrain_out(toks, logp)
+            return toks, logp, k_cache, v_cache
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    def _build_decode_fn(self, want_logprobs: bool = False,
+                         want_procs: bool = False):
+        cfg = self.config
+        use_kernel = self.use_kernel
+        num_steps = self.args.decode_steps
+
+        # The logprobs program variants also surface the per-step top-N
+        # alternatives (OpenAI top_logprobs); the common variants skip it.
+        num_top = self.args.top_logprobs_cap if want_logprobs else 0
+
+        if not want_procs:
+            def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
+                     block_tables, rng, rng_step, temp, topk, topp, adapter_ids):
+                rng = jax.random.fold_in(rng, rng_step)
+                out = llama.decode_multi(
+                    params, cfg, tokens, start_pos, active, block_tables,
+                    k_cache, v_cache, rng, temp, topk, topp,
+                    num_steps=num_steps, use_kernel=use_kernel,
+                    lora=lora, adapter_ids=adapter_ids,
+                    want_logprobs=want_logprobs,
+                    num_top_logprobs=num_top,
+                )
+                small = self._constrain_out(*out[:-2])
+                if not isinstance(small, tuple):
+                    small = (small,)
+                return small + out[-2:]
+
+            return jax.jit(step, donate_argnums=(2, 3))
+
+        from dynamo_tpu.ops import logits_process as lp
+
+        def step_p(params, lora, k_cache, v_cache, tokens, start_pos, active,
+                   block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
+                   minp, rep, pres, freq, bias_ids, bias_vals, counts, pmask):
+            rng = jax.random.fold_in(rng, rng_step)
+            pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
+                               bias_ids=bias_ids, bias_vals=bias_vals)
+            st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
+            out = llama.decode_multi(
+                params, cfg, tokens, start_pos, active, block_tables,
+                k_cache, v_cache, rng, temp, topk, topp,
+                num_steps=num_steps, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids,
+                want_logprobs=want_logprobs,
+                min_p=minp, proc_params=pp, proc_state=st,
+                num_top_logprobs=num_top,
+            )
+            st = out[-1]
+            small = self._constrain_out(*out[:-3])
+            if not isinstance(small, tuple):
+                small = (small,)
+            return small + (out[-3], out[-2], st.out_counts)
+
+        # donate caches + the token-count array (functionally threaded).
+        return jax.jit(step_p, donate_argnums=(2, 3, 20))
+
+    def _build_spec_fn(self):
+        cfg = self.config
+        use_kernel = self.use_kernel
+
+        def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
+                 block_tables, adapter_ids):
+            logits, k_cache, v_cache = llama.forward_paged(
+                params, cfg, tokens, start_pos, chunk_lens, block_tables,
+                k_cache, v_cache, use_kernel=use_kernel,
+                lora=lora, adapter_ids=adapter_ids, all_logits=True,
+            )
+            toks = self._constrain_out(jnp.argmax(logits, axis=-1))
+            return toks, k_cache, v_cache
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    # -- logits-processor device state ------------------------------------
+
+    def ensure_proc_state(self):
+        if self.proc_state is None:
+            from dynamo_tpu.ops import logits_process as lp
+
+            self.proc_state = lp.init_state(
+                self.args.max_num_seqs, self.config.vocab_size
+            )
+        return self.proc_state
+
+    def proc_reset_slot(self, slot: int, prompt_ids, generated) -> None:
+        """(Re)initialize one slot's processor bookkeeping; mirrored so
+        follower proc_state stays bit-identical."""
+        from dynamo_tpu.ops import logits_process as lp
+
+        self._mirror(
+            "proc_reset", slot=slot,
+            prompt_ids=np.asarray(prompt_ids, dtype=np.int32),
+            generated=np.asarray(generated, dtype=np.int32),
+        )
+        st = self.ensure_proc_state()
+        self.proc_state = lp.reset_slot(st, slot, list(prompt_ids), list(generated))
+
+    def proc_count(self, slot: int, token: int) -> None:
+        from dynamo_tpu.ops import logits_process as lp
+
+        self._mirror("proc_count", slot=slot, token=int(token))
+        st = self.ensure_proc_state()
+        self.proc_state = lp.count_token(st, slot, int(token))
+
+    # -- device invocations ------------------------------------------------
+
+    def run_step(
+        self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
+        adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
+    ):
+        """One prefill/verify forward + sample. Returns (tokens, logprobs,
+        top_vals | None, top_ids | None) as numpy.
+
+        ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
+        prompt_mask) per-row arrays — routes through the logits-processor
+        program. ``want_top``: also return the top-N alternatives."""
+        self._mirror(
+            "step", tokens=tokens, start_pos=start_pos, chunk_lens=chunk_lens,
+            block_tables=block_tables, temp=temp, topk=topk, topp=topp,
+            adapter_ids=adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot,
+            procs=None if procs is None else list(procs), want_top=want_top,
+        )
+        step_id = np.int32(self.rng_step & 0x7FFFFFFF)  # int32-safe wrap
+        self.rng_step += 1
+        key = (procs is not None, bool(want_top))
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._build_step_fn(want_procs=key[0], want_top=key[1])
+            self._step_fns[key] = fn
+        d = self._dev
+        args = [
+            self.params, self.lora, self.k_cache, self.v_cache,
+            d(tokens), d(start_pos), d(chunk_lens), d(block_tables),
+            self.rng, step_id,
+            d(temp), d(topk), d(topp), d(adapter_ids),
+            d(mm_embeds), d(mm_slot),
+        ]
+        if procs is not None:
+            minp, rep, pres, freq, bias_ids, bias_vals, pmask = procs
+            args += [
+                d(minp), d(rep), d(pres), d(freq),
+                d(bias_ids), d(bias_vals), d(pmask),
+            ]
+        out = fn(*args)
+        topv = topi = None
+        if want_top:
+            toks, logp, topv, topi, self.k_cache, self.v_cache = out
+        else:
+            toks, logp, self.k_cache, self.v_cache = out
+        return (
+            np.asarray(jax.device_get(toks)),
+            np.asarray(jax.device_get(logp)),
+            None if topv is None else np.asarray(jax.device_get(topv)),
+            None if topi is None else np.asarray(jax.device_get(topi)),
+        )
+
+    def run_decode(
+        self, tokens, start_pos, active, block_tables, temp, topk, topp,
+        adapter_ids, want_logprobs=False, procs=None,
+    ):
+        """Fused multi-step decode. ``procs``: optional (minp, rep, pres,
+        freq, bias_ids, bias_vals) slot arrays → the processor program.
+        Returns ([B, K] tokens, [B, K] logprobs, top_vals | None,
+        top_ids | None) as numpy."""
+        self._mirror(
+            "decode", tokens=tokens, start_pos=start_pos, active=active,
+            block_tables=block_tables, temp=temp, topk=topk, topp=topp,
+            adapter_ids=adapter_ids, want_logprobs=want_logprobs,
+            procs=None if procs is None else list(procs),
+        )
+        step_id = np.int32(self.rng_step & 0x7FFFFFFF)  # int32-safe wrap
+        self.rng_step += 1
+        topv = topi = None
+        d = self._dev
+        if procs is not None:
+            fn = self._decode_procs_fns.get(want_logprobs)
+            if fn is None:
+                fn = self._build_decode_fn(want_logprobs, want_procs=True)
+                self._decode_procs_fns[want_logprobs] = fn
+            st = self.ensure_proc_state()
+            minp, rep, pres, freq, bias_ids, bias_vals = procs
+            out = fn(
+                self.params, self.lora, self.k_cache, self.v_cache,
+                d(tokens), d(start_pos), d(active), d(block_tables),
+                self.rng, step_id, d(temp), d(topk), d(topp), d(adapter_ids),
+                d(minp), d(rep), d(pres), d(freq),
+                d(bias_ids), d(bias_vals),
+                st.out_counts, st.prompt_mask,
+            )
+            from dynamo_tpu.ops import logits_process as lp
+
+            if want_logprobs:
+                toks, logp, topv, topi, self.k_cache, self.v_cache, counts = out
+            else:
+                toks, logp, self.k_cache, self.v_cache, counts = out
+            self.proc_state = lp.ProcState(
+                out_counts=counts, prompt_mask=st.prompt_mask
+            )
+        else:
+            fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
+            out = fn(
+                self.params, self.lora, self.k_cache, self.v_cache,
+                d(tokens), d(start_pos), d(active), d(block_tables),
+                self.rng, step_id, d(temp), d(topk), d(topp), d(adapter_ids),
+            )
+            if want_logprobs:
+                toks, logp, topv, topi, self.k_cache, self.v_cache = out
+            else:
+                toks, logp, self.k_cache, self.v_cache = out
+        return (
+            np.asarray(jax.device_get(toks)),
+            np.asarray(jax.device_get(logp)),
+            None if topv is None else np.asarray(jax.device_get(topv)),
+            None if topi is None else np.asarray(jax.device_get(topi)),
+        )
+
+    def run_spec(self, tokens, start_pos, chunk_lens, block_tables,
+                 adapter_ids) -> np.ndarray:
+        """Greedy speculative verify: argmax logits at EVERY position."""
+        self._mirror(
+            "spec", tokens=tokens, start_pos=start_pos, chunk_lens=chunk_lens,
+            block_tables=block_tables, adapter_ids=adapter_ids,
+        )
+        if self._spec_fn is None:
+            self._spec_fn = self._build_spec_fn()
+        d = self._dev
+        toks, self.k_cache, self.v_cache = self._spec_fn(
+            self.params, self.lora, self.k_cache, self.v_cache,
+            d(tokens), d(start_pos), d(chunk_lens), d(block_tables),
+            d(adapter_ids),
+        )
+        return np.asarray(jax.device_get(toks))
+
+    # -- block transfer (disagg / checkpoint) ------------------------------
+
+    def gather_blocks(self, ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy blocks out of HBM → ([n, L, BS, KH, D] k, v) numpy."""
+        self._mirror("gather", ids=np.asarray(ids, dtype=np.int32))
+        idx = self._dev(np.asarray(ids, dtype=np.int32))
+        k = _gather_blocks(self.k_cache, idx)
+        v = _gather_blocks(self.v_cache, idx)
+        if self.multihost:
+            # Followers also compute the gather (they must join the
+            # collective); only the leader reads it back, replicated.
+            k, v = self._constrain_out(k, v)
+        k = np.asarray(jax.device_get(k.swapaxes(0, 1)))
+        v = np.asarray(jax.device_get(v.swapaxes(0, 1)))
+        return k, v
+
+    def scatter_blocks(self, ids: List[int], k_blocks, v_blocks) -> None:
+        """Insert [n, L, BS, KH, D] host blocks into HBM at ``ids``."""
+        self._mirror(
+            "scatter", ids=np.asarray(ids, dtype=np.int32),
+            k_blocks=np.asarray(k_blocks), v_blocks=np.asarray(v_blocks),
+        )
+        idx = self._dev(np.asarray(ids, dtype=np.int32))
+        k_sel = self._dev(
+            np.asarray(k_blocks).swapaxes(0, 1).astype(self.config.dtype)
+        )
+        v_sel = self._dev(
+            np.asarray(v_blocks).swapaxes(0, 1).astype(self.config.dtype)
+        )
+        self.k_cache = _scatter_blocks(self.k_cache, idx, k_sel)
+        self.v_cache = _scatter_blocks(self.v_cache, idx, v_sel)
+
+    # -- sleep / wake device transitions -----------------------------------
+
+    def sleep_device(self, level: int) -> None:
+        """Free device memory. Level 1: KV cache; level 2: weights → host.
+        Level 2 is single-host only (a tp-sharded global param tree is not
+        addressable from one process)."""
+        if level >= 2 and self.multihost:
+            raise RuntimeError(
+                "sleep level 2 (weight offload) is unsupported in multihost "
+                "mode; use level 1"
+            )
+        self._mirror("sleep", level=level)
+        self.k_cache = None
+        self.v_cache = None
+        if level >= 2:
+            self.host_params = jax.device_get(self.params)
+            self.params = None
+        self.sleep_level = level
+        logger.info("engine asleep at level %d", level)
+
+    def wake_device(self) -> None:
+        self._mirror("wake")
+        if self.sleep_level >= 2 and self.host_params is not None:
+            params = self.host_params
+            self.host_params = None
+            if self.mesh is not None:
+                params = shard_params(
+                    params, self._param_axes, self.rules, self.mesh
+                )
+            else:
+                params = jax.tree_util.tree_map(jnp.asarray, params)
+            self.params = params
+        if self.k_cache is None:
+            self.k_cache, self.v_cache = self.alloc_kv_cache()
+        self.sleep_level = 0
+        logger.info("engine awake")

@@ -1,17 +1,17 @@
 """SPMD leader/follower execution for a multi-process JaxEngine.
 
 One logical worker spans N processes (parallel/multihost.py): the leader
-(process 0) runs the scheduler + endpoint and mirrors every device-program
-invocation over the op channel (runtime/network/spmd_channel.py); followers
-run :func:`follow`, re-issuing the identical invocation so every process
-enters the global-mesh jit together — the JAX-native version of the
-reference's DP leader / non-leader worker ranks
-(components/src/dynamo/vllm/main.py:67-78).
+(process 0) runs the scheduler + endpoint; its DeviceRunner mirrors every
+device-program invocation over the op channel
+(runtime/network/spmd_channel.py); followers run :func:`follow`, re-issuing
+the identical invocation so every process enters the global-mesh jit
+together — the JAX-native version of the reference's DP leader /
+non-leader worker ranks (components/src/dynamo/vllm/main.py:67-78).
 
-Determinism contract: a follower's engine is constructed with the same
+Determinism contract: a follower's runner is constructed with the same
 JaxEngineArgs/params/seed as the leader's, and ops are applied in channel
-order — so jitted-program variant selection, RNG-step counters, and cache
-donation stay in lockstep with zero extra coordination.
+order — so jitted-program variant selection, RNG-step counters, processor
+state, and cache donation stay in lockstep with zero extra coordination.
 """
 
 from __future__ import annotations
@@ -23,17 +23,14 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-# Ops a follower executes. Each maps to the engine method of the same role;
-# the leader sends exactly the method's (numpy/python) arguments.
-OPS = ("step", "decode", "spec", "sleep", "wake", "stop")
 
-
-def follow(engine: Any, follower: SpmdFollower) -> None:
+def follow(runner: Any, follower: SpmdFollower) -> None:
     """Blocking follower loop: execute the leader's op stream until stop.
 
-    Runs the engine's raw device methods synchronously on this thread (the
+    Runs the runner's device methods synchronously on this thread (the
     follower process has no scheduler, no endpoint, no asyncio engine loop
-    — it exists to contribute its devices to the collectives).
+    — it exists to contribute its devices to the collectives). The runner's
+    own mirroring is a no-op here (no broadcaster is set on followers).
     """
     while True:
         op, args = follower.recv()
@@ -42,15 +39,40 @@ def follow(engine: Any, follower: SpmdFollower) -> None:
             return
         try:
             if op == "decode":
-                engine._run_decode(**args)
+                runner.run_decode(**args)
             elif op == "step":
-                engine._run_step(**args)
+                runner.run_step(**args)
             elif op == "spec":
-                engine._run_spec(**args)
+                runner.run_spec(**args)
+            elif op == "gather":
+                runner.gather_blocks(list(args["ids"]))
+            elif op == "scatter":
+                runner.scatter_blocks(
+                    list(args["ids"]), args["k_blocks"], args["v_blocks"]
+                )
+            elif op == "proc_reset":
+                runner.proc_reset_slot(
+                    int(args["slot"]), args["prompt_ids"], args["generated"]
+                )
+            elif op == "proc_count":
+                runner.proc_count(int(args["slot"]), int(args["token"]))
+            elif op == "lora_install":
+                from dynamo_tpu.lora.loader import LoRAAdapter
+
+                adapter = LoRAAdapter(
+                    name=args["name"], rank=int(args["rank"]),
+                    scaling=float(args["scaling"]),
+                    weights={
+                        t: (A, B) for t, (A, B) in args["weights"].items()
+                    },
+                )
+                runner.install_adapter(adapter)
+            elif op == "lora_remove":
+                runner.remove_adapter(args["name"])
             elif op == "sleep":
-                engine._do_sleep(int(args.get("level", 1)))
+                runner.sleep_device(int(args.get("level", 1)))
             elif op == "wake":
-                engine._do_wake()
+                runner.wake_device()
             else:
                 raise ValueError(f"unknown SPMD op {op!r}")
         except Exception:

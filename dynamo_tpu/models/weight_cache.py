@@ -1,13 +1,22 @@
-"""Sharded-weight cache for fast worker restart.
+"""Sharded-weight cache for fast worker restart (GMS role).
 
 Reference parity: the GPU Memory Service + chrek role
-(lib/gpu_memory_service/README.md, deploy/chrek/) — the reference keeps
-weights resident across worker restarts so a respawned process skips the
-slow load path. The TPU-native equivalent: after the first checkpoint
-ingest (HF name-mapping, transposes, dtype casts — the expensive part),
-the engine-ready pytree is persisted as raw memory-mappable .npy leaves +
-a manifest. A respawned worker mmaps straight into device transfer — no
-safetensors walk, no per-tensor transform.
+(lib/gpu_memory_service/README.md:1-60, deploy/chrek/) — the reference
+keeps weights resident OUTSIDE the worker so a crashed worker remaps
+instead of reloading. Two tiers here:
+
+  1. **Shared-memory tier** (``SHM_CACHE_DIR``, tmpfs): the engine-ready
+     pytree as raw mmap-able .npy leaves in RAM. The pages belong to the
+     kernel page cache, not the worker — a SIGKILLed worker's replacement
+     mmaps the same physical pages with zero copies and zero disk I/O.
+     This is the GMS ownership model, TPU-style: on TPU the weights' device
+     residency dies with the process (the runtime frees HBM), so what can
+     survive — and what is expensive — is the host-side ingest
+     (safetensors walk, name mapping, transposes, casts, quantization).
+  2. **Disk tier** (``DEFAULT_CACHE_DIR``): same format, survives reboot.
+
+A respawned worker mmaps straight into device transfer — no safetensors
+walk, no per-tensor transform, no requantization.
 
 Cache key = (checkpoint dir identity, config fingerprint), so a changed
 checkpoint or config never serves stale weights.
@@ -29,6 +38,12 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/dynamo_tpu/weights")
+# tmpfs weight residency (GMS role): RAM-backed, survives worker death.
+# None (tier disabled) when the host has no tmpfs mount — a disk-backed
+# "shm" directory would just duplicate the disk tier.
+SHM_CACHE_DIR = (
+    "/dev/shm/dynamo_tpu/weights" if os.path.isdir("/dev/shm") else None
+)
 
 
 def _fingerprint(model_dir: str, config: ModelConfig) -> str:
@@ -69,25 +84,31 @@ def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
 
 def save_params(cache_dir: str, key: str, params: Any) -> str:
     """Persist a param pytree as raw .npy leaves + manifest. Returns path."""
+    import shutil
+
     root = os.path.join(cache_dir, key)
     tmp = root + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
-    manifest: Dict[str, Any] = {"leaves": {}}
-    for name, leaf in _flatten(params).items():
-        arr = np.asarray(leaf)
-        dtype = str(arr.dtype)
-        if dtype == "bfloat16":  # raw bytes; np.save handles ml_dtypes fine,
-            arr = arr.view(np.uint16)  # but raw u16 keeps loads dependency-lean
-        fname = name.replace("/", "_") + ".npy"
-        np.save(os.path.join(tmp, fname), arr, allow_pickle=False)
-        manifest["leaves"][name] = {"file": fname, "dtype": dtype,
-                                    "shape": list(np.asarray(leaf).shape)}
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        manifest: Dict[str, Any] = {"leaves": {}}
+        for name, leaf in _flatten(params).items():
+            arr = np.asarray(leaf)
+            dtype = str(arr.dtype)
+            if dtype == "bfloat16":  # raw bytes; np.save handles ml_dtypes,
+                arr = arr.view(np.uint16)  # raw u16 keeps loads dependency-lean
+            fname = name.replace("/", "_") + ".npy"
+            np.save(os.path.join(tmp, fname), arr, allow_pickle=False)
+            manifest["leaves"][name] = {"file": fname, "dtype": dtype,
+                                        "shape": list(np.asarray(leaf).shape)}
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+    except BaseException:
+        # A half-written tmp dir must not linger — on the tmpfs tier it
+        # would pin RAM until reboot (and retry on every restart).
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     # Atomic publish: a crashed writer never leaves a half cache.
     if os.path.exists(root):
-        import shutil
-
         shutil.rmtree(root)
     os.replace(tmp, root)
     logger.info("weight cache written: %s (%d leaves)", root, len(manifest["leaves"]))
@@ -124,22 +145,40 @@ def load_checkpoint_cached(
     *,
     cache_dir: str = DEFAULT_CACHE_DIR,
     quantization: str | None = None,
+    shm_dir: str | None = SHM_CACHE_DIR,
 ) -> Tuple[Dict[str, Any], bool]:
-    """HF checkpoint → engine pytree, through the restart cache.
+    """HF checkpoint → engine pytree, through the restart caches.
 
-    Quantized loads cache the QUANTIZED tree under a distinct key — restarts
-    skip requantization and the cache holds int8 (half the disk).
-    Returns (params, was_cache_hit)."""
+    Lookup order: shared-memory tier (RAM pages surviving worker death —
+    the GMS role) → disk tier → full HF ingest. Misses repopulate every
+    tier above them. Quantized loads cache the QUANTIZED tree under a
+    distinct key — restarts skip requantization and the cache holds int8
+    (half the bytes). Returns (params, was_cache_hit)."""
     key = _fingerprint(model_dir, config) + (f"-{quantization}" if quantization else "")
+    if shm_dir:
+        cached = load_params(shm_dir, key)
+        if cached is not None:
+            logger.info("weight SHM hit for %s (RAM-resident, GMS role)", model_dir)
+            return cached, True
     cached = load_params(cache_dir, key)
     if cached is not None:
         logger.info("weight cache hit for %s", model_dir)
+        if shm_dir:
+            _try_save(shm_dir, key, cached)
         return cached, True
     from dynamo_tpu.models.hf_loader import load_hf_checkpoint
 
     params = load_hf_checkpoint(model_dir, config, quantization=quantization)
+    _try_save(cache_dir, key, params)
+    if shm_dir:
+        _try_save(shm_dir, key, params)
+    return params, False
+
+
+def _try_save(cache_dir: str, key: str, params: Any) -> None:
     try:
         save_params(cache_dir, key, params)
     except OSError:
-        logger.exception("weight cache write failed; serving uncached")
-    return params, False
+        logger.exception(
+            "weight cache write to %s failed; serving uncached", cache_dir
+        )

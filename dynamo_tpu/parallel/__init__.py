@@ -19,6 +19,11 @@ from dynamo_tpu.parallel.mesh import (
     make_mesh,
     local_mesh,
 )
+from dynamo_tpu.parallel.multihost import (
+    HostTopology,
+    init_multihost,
+    multihost_config_from_env,
+)
 from dynamo_tpu.parallel.sharding import (
     ShardingRules,
     logical_to_physical,
@@ -28,9 +33,12 @@ from dynamo_tpu.parallel.sharding import (
 
 __all__ = [
     "AxisNames",
+    "HostTopology",
     "MeshConfig",
+    "init_multihost",
     "make_mesh",
     "local_mesh",
+    "multihost_config_from_env",
     "ShardingRules",
     "logical_to_physical",
     "param_shardings",

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import threading
 from typing import Any, List, Optional, Tuple
 
 import msgpack
@@ -81,6 +82,11 @@ class SpmdBroadcaster:
         self._server.settimeout(accept_timeout_s)
         self._conns: List[socket.socket] = []
         self.num_followers = num_followers
+        # Ops normally flow from the engine's single device thread, but
+        # admin operations (LoRA load/unload, sleep) can reach the runner
+        # from the event loop — serialize whole frames so interleaved
+        # sendall calls can't corrupt the stream.
+        self._lock = threading.Lock()
 
     def wait_for_followers(self) -> None:
         while len(self._conns) < self.num_followers:
@@ -94,8 +100,9 @@ class SpmdBroadcaster:
 
     def send(self, op: str, **kwargs: Any) -> None:
         frame = {"op": op, **kwargs}
-        for conn in self._conns:
-            _send_frame(conn, frame)
+        with self._lock:
+            for conn in self._conns:
+                _send_frame(conn, frame)
 
     def close(self) -> None:
         for conn in self._conns:

@@ -263,6 +263,12 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
             )
 
     async def _unload(name: str) -> None:
-        engine.unload_lora(name)
+        # Same device-thread routing as _load: under multihost the restack
+        # op must serialize with in-flight decode mirroring.
+        device = getattr(engine, "_device", None)
+        if device is not None:
+            await device(engine.unload_lora, name)
+        else:
+            engine.unload_lora(name)
 
     server.register_loras(engine.lora_names, _load, _unload)

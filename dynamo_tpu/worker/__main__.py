@@ -110,6 +110,16 @@ async def main() -> None:
                         help="weight-only quantization (int8: per-channel, "
                         "halves weight HBM — the FP8-checkpoint deployment "
                         "lever, TPU-style)")
+    parser.add_argument("--coordinator", default=None,
+                        help="multi-host: host:port of rank 0's "
+                        "jax.distributed coordinator (or env "
+                        "DYN_TPU_COORDINATOR); one process per host forms "
+                        "ONE logical worker, rank 0 serves the endpoint")
+    parser.add_argument("--num-processes", type=int, default=None,
+                        help="multi-host world size (env DYN_TPU_NUM_PROCESSES)")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="multi-host rank of this process (env "
+                        "DYN_TPU_PROCESS_ID)")
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component
@@ -128,7 +138,16 @@ async def main() -> None:
             )
 
     configure_logging()
-    runtime = DistributedRuntime.from_settings()
+
+    # Multi-host: join the jax.distributed runtime BEFORE any JAX use (the
+    # backend must not exist yet). One process per host; rank 0 is the
+    # leader and the only rank that serves/registers the endpoint (ref DP
+    # leader pattern, components/src/dynamo/vllm/main.py:67-78).
+    from dynamo_tpu.parallel.multihost import init_multihost
+
+    topo = init_multihost(args.coordinator, args.num_processes, args.process_id)
+
+    runtime = DistributedRuntime.from_settings() if topo.is_leader else None
 
     model_path = None
     if args.model in BUILTIN_CONFIGS:
@@ -150,10 +169,63 @@ async def main() -> None:
         print(f"weights loaded (cache {'hit' if cache_hit else 'miss'})", flush=True)
 
     mesh = None
-    if args.tensor_parallel_size > 1:
+    if topo.is_multihost:
+        # The global mesh spans every process's devices. Default tp = the
+        # largest device-count divisor the model's kv heads can shard over
+        # (a NamedSharding with more partitions than the axis size fails at
+        # device_put); leftover devices become data parallelism.
+        n_dev = len(jax.devices())
+        if args.tensor_parallel_size > 1:
+            tp = args.tensor_parallel_size
+        else:
+            tp = 1
+            while (
+                tp * 2 <= n_dev
+                and n_dev % (tp * 2) == 0
+                and model_config.n_kv_heads % (tp * 2) == 0
+            ):
+                tp *= 2
+        mesh = make_mesh(MeshConfig(tp=tp, dp=n_dev // tp), jax.devices())
+    elif args.tensor_parallel_size > 1:
         mesh = make_mesh(
             MeshConfig(tp=args.tensor_parallel_size), jax.devices()
         )
+
+    engine_args = JaxEngineArgs(
+        config=model_config,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        max_num_seqs=args.max_num_seqs,
+        max_model_len=args.max_model_len,
+        prefill_chunk=args.prefill_chunk,
+        enable_prefix_caching=not args.no_prefix_caching,
+        decode_steps=args.decode_steps,
+        lora_dir=args.lora_dir,
+        spec_mode=args.speculative,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
+        quantization=args.quantization,
+    )
+
+    if topo.is_multihost:
+        from dynamo_tpu.engines.tpu import spmd
+        from dynamo_tpu.engines.tpu.runner import DeviceRunner
+        from dynamo_tpu.parallel.multihost import spmd_port
+
+        runner = DeviceRunner(engine_args, params, mesh=mesh, topology=topo)
+        port = spmd_port(topo.coordinator)
+        if not topo.is_leader:
+            # Follower rank: contribute devices to the collectives and
+            # replay the leader's op stream until it closes the channel.
+            host = topo.coordinator.rsplit(":", 1)[0]
+            spmd.follow(runner, spmd.make_follower(host, port))
+            return
+        bcast = spmd.make_broadcaster(
+            port, num_followers=topo.num_processes - 1
+        )
+        runner.set_broadcaster(bcast)
+    else:
+        runner = None
 
     name = args.served_model_name or model_config.name
     instance_id = random.getrandbits(63)
@@ -161,24 +233,11 @@ async def main() -> None:
         runtime.event_plane, args.namespace, args.component, instance_id
     )
     engine = JaxEngine(
-        JaxEngineArgs(
-            config=model_config,
-            block_size=args.block_size,
-            num_kv_blocks=args.num_kv_blocks,
-            max_num_seqs=args.max_num_seqs,
-            max_model_len=args.max_model_len,
-            prefill_chunk=args.prefill_chunk,
-            enable_prefix_caching=not args.no_prefix_caching,
-            decode_steps=args.decode_steps,
-            lora_dir=args.lora_dir,
-            spec_mode=args.speculative,
-            spec_k=args.spec_k,
-            spec_ngram=args.spec_ngram,
-            quantization=args.quantization,
-        ),
+        engine_args,
         params,
         mesh=mesh,
         on_kv_event=kv_pub.on_kv_event,
+        runner=runner,
     )
     # Answer router re-sync requests with the pool's committed set (the
     # JetStream replay role) — a restarted router rebuilds its radix index

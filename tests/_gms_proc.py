@@ -1,0 +1,72 @@
+"""Subprocess body for the GMS weight-survival test.
+
+Usage: python _gms_proc.py <model_dir> <disk_cache> <shm_cache> <mode>
+
+mode=serve: load weights through the tiered cache, report the load, serve
+one greedy generation, print its tokens, then hold the process open (the
+parent SIGKILLs it mid-serve — the crash the GMS tier must survive).
+mode=once: same but exit after printing (the respawned worker).
+"""
+
+import asyncio
+import dataclasses
+import json
+import sys
+import time
+
+import jax.numpy as jnp
+
+model_dir, disk_cache, shm_cache, mode = sys.argv[1:5]
+
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.models.weight_cache import load_checkpoint_cached  # noqa: E402
+
+config = dataclasses.replace(
+    ModelConfig.from_model_dir(model_dir), dtype=jnp.float32
+)
+t0 = time.perf_counter()
+params, hit = load_checkpoint_cached(
+    model_dir, config, cache_dir=disk_cache, shm_dir=shm_cache
+)
+load_ms = (time.perf_counter() - t0) * 1000
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs  # noqa: E402
+from dynamo_tpu.llm.protocols.common import (  # noqa: E402
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime.context import Context  # noqa: E402
+
+engine = JaxEngine(
+    JaxEngineArgs(
+        config=config, block_size=4, num_kv_blocks=32, max_num_seqs=2,
+        max_model_len=64, decode_steps=4,
+    ),
+    params,
+)
+
+
+async def serve_one():
+    req = PreprocessedRequest(
+        token_ids=[5, 6, 7, 8, 9], request_id="gms",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=6, ignore_eos=True),
+    )
+    t0 = time.perf_counter()
+    toks = []
+    ttft_ms = None
+    async for out in engine.generate(req, Context()):
+        if out.token_ids and ttft_ms is None:
+            ttft_ms = (time.perf_counter() - t0) * 1000
+        toks.extend(out.token_ids or [])
+    return toks, ttft_ms
+
+
+toks, ttft_ms = asyncio.run(serve_one())
+print("SERVED " + json.dumps(
+    {"hit": hit, "load_ms": round(load_ms, 1), "ttft_ms": round(ttft_ms, 1),
+     "tokens": toks}
+), flush=True)
+
+if mode == "serve":
+    # Hold the process with in-flight state; the parent SIGKILLs us here.
+    time.sleep(300)

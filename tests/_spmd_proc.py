@@ -1,0 +1,85 @@
+"""Subprocess body for the multi-host SPMD integration test.
+
+Usage: python _spmd_proc.py <rank> <coordinator host:port> <spmd port>
+
+Rank 0 = leader: builds the engine over the GLOBAL 2-process mesh, serves
+three generate() calls, prints the sampled tokens as JSON on stdout.
+Rank 1 = follower: replays the leader's op stream (engines/tpu/spmd.follow).
+
+Env must provide JAX_PLATFORMS=cpu and 4 virtual devices per process (the
+test sets them); jax.distributed joins the two processes into one 8-device
+JAX runtime — the worker spans processes the way a v5e-16×2-host slice
+would.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+coord = sys.argv[2]
+spmd_port = int(sys.argv[3])
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+from dynamo_tpu.parallel.multihost import init_multihost  # noqa: E402
+
+topo = init_multihost(coord, num_processes=2, process_id=rank)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs  # noqa: E402
+from dynamo_tpu.engines.tpu.runner import DeviceRunner  # noqa: E402
+from dynamo_tpu.engines.tpu import spmd  # noqa: E402
+from dynamo_tpu.models.config import tiny_config  # noqa: E402
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: E402
+
+cfg = tiny_config(n_heads=8, n_kv_heads=8)  # tp=8 divides the head axes
+mesh = make_mesh(MeshConfig(tp=8), jax.devices())
+args = JaxEngineArgs(
+    config=cfg, block_size=4, num_kv_blocks=32, max_num_seqs=2,
+    max_model_len=64, decode_steps=4, prefill_chunk=16, seed=7,
+)
+runner = DeviceRunner(args, mesh=mesh, topology=topo)
+
+if topo.is_leader:
+    bcast = spmd.make_broadcaster(spmd_port, num_followers=1)
+    runner.set_broadcaster(bcast)
+    engine = JaxEngine(args, mesh=mesh, runner=runner)
+
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.context import Context
+
+    async def main():
+        outs = []
+        for i in range(3):
+            toks = []
+            req = PreprocessedRequest(
+                token_ids=[7 + i, 8, 9, 10, 11],
+                request_id=f"mh-{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=6, ignore_eos=True),
+            )
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids or [])
+            outs.append(toks)
+        await engine.stop()
+        return outs
+
+    outs = asyncio.run(main())
+    bcast.close()
+    print("RESULT " + json.dumps(outs), flush=True)
+else:
+    follower = spmd.make_follower(coord.rsplit(":", 1)[0], spmd_port)
+    spmd.follow(runner, follower)
+    print("RESULT follower-done", flush=True)
