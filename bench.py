@@ -3,13 +3,28 @@
 Runs on whatever chip JAX sees (the driver provides one real TPU). AIPerf-
 style fixed ISL/OSL/concurrency workload (BASELINE.md measurement plan,
 config 1: Qwen2.5-0.5B-shape aggregated worker, random weights — weights
-don't affect throughput).
+don't affect throughput; config 2 proxy: Llama-3-8B int8 on the same chip,
+run as the "secondary" leg unless BENCH_SECONDARY=0).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
-supporting fields. vs_baseline compares tokens/sec/chip against an assumed
-A100-vLLM anchor for a 0.5B-class model (BASELINE.md north star: ≥ A100-vLLM
-tokens/sec/chip); the anchor is an estimate recorded here, not a measured
-number from the reference tree (it publishes none for this shape).
+supporting fields:
+
+  - ``anchor``: the baseline this run is judged against — a DERIVED
+    bandwidth-roofline estimate of A100-80G + vLLM decode throughput for
+    the SAME model/batch/context (BASELINE.md north star is "≥ A100-vLLM
+    tokens/sec/chip"; the reference publishes no in-tree number for these
+    shapes, so the anchor is computed from public hardware specs and a
+    stated efficiency factor instead of invented). Formula in the JSON.
+  - ``mfu`` / ``hbm_util``: this chip's achieved fraction of v5e peak
+    compute (197 TFLOP/s bf16) and of its decode bandwidth roofline
+    (819 GB/s HBM) — absolute efficiency, independent of any anchor.
+  - ``secondary``: the 8B-int8 leg's numbers.
+
+Knob reference (env): BENCH_ISL/OSL/CONCURRENCY/REQUESTS, BENCH_MODEL
+(qwen2.5-0.5b | llama3-8b | llama3-3b | mixtral-8x7b), BENCH_QUANT=int8,
+BENCH_BLOCK_SIZE/KV_BLOCKS/PREFILL_CHUNK/PREFILL_BATCH/DECODE_STEPS,
+BENCH_USE_KERNEL, BENCH_SPEC=ngram (speculative decoding),
+BENCH_SECONDARY=0 (skip the 8B-int8 leg).
 """
 
 from __future__ import annotations
@@ -28,18 +43,86 @@ import jax
 jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-# A100 + vLLM, 0.5B-class model, moderate concurrency: ~5k decode tok/s/GPU
-# (estimate; the reference repo publishes no in-tree number for this shape).
-BASELINE_TOKS_PER_SEC_PER_CHIP = 5000.0
-
 ISL = int(os.environ.get("BENCH_ISL", 128))
 OSL = int(os.environ.get("BENCH_OSL", 64))
 CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", 256))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", 512))
 VERBOSE = os.environ.get("BENCH_VERBOSE") == "1"
 
+# Public hardware specs the roofline anchor/metrics derive from.
+A100_80G_BW = 2039e9  # B/s (SXM)
+V5E_BW = 819e9  # B/s HBM
+V5E_PEAK_BF16 = 197e12  # FLOP/s
+# Achieved-bandwidth fraction granted to the A100+vLLM anchor. Optimistic
+# for the anchor (generous to the baseline): well-tuned decode sustains
+# ~40-60% of peak HBM bandwidth end-to-end; we grant 60%.
+ANCHOR_EFF = 0.6
+# Per-layer decode-step latency floor granted to the anchor: small models
+# are kernel-launch/overhead-bound on GPUs, not bandwidth-bound (~7-10
+# kernels per decoder layer × ~30-40µs launch+sync each). Without this
+# term a 0.5B "anchor" would claim 200k+ tok/s — far beyond anything vLLM
+# reports. 0.3 ms/layer ≈ the well-tuned end of small-model GPU serving.
+ANCHOR_LAYER_FLOOR_S = 0.3e-3
+# Public on-demand list prices (GCP, us-central, mid-2024 era): the
+# per-chip comparison is bandwidth-lopsided (A100-80G has 2.5× the HBM
+# bandwidth of a v5e), so the JSON also reports throughput per dollar.
+A100_80G_USD_HR = 3.67
+V5E_USD_HR = 1.20
 
-async def run_bench():
+
+def _param_count(cfg) -> int:
+    """Matmul-weight parameter count from the config (analytic)."""
+    d, L, hd = cfg.d_model, cfg.n_layers, cfg.head_dim_
+    H, KH, ff = cfg.n_heads, cfg.n_kv_heads, cfg.d_ff
+    per_layer = d * H * hd + 2 * d * KH * hd + H * hd * d  # wq wk wv wo
+    if cfg.is_moe:
+        eff = cfg.moe_d_ff_
+        per_layer += cfg.n_experts * 3 * d * eff + d * cfg.n_experts
+    else:
+        per_layer += 3 * d * ff
+    total = L * per_layer + cfg.vocab_size * d
+    if not cfg.tie_word_embeddings:
+        total += d * cfg.vocab_size
+    return total
+
+
+def _active_param_count(cfg) -> int:
+    """Params touched per token (MoE reads only top-k experts)."""
+    if not cfg.is_moe:
+        return _param_count(cfg)
+    d, L, hd = cfg.d_model, cfg.n_layers, cfg.head_dim_
+    H, KH, eff = cfg.n_heads, cfg.n_kv_heads, cfg.moe_d_ff_
+    per_layer = (
+        d * H * hd + 2 * d * KH * hd + H * hd * d
+        + cfg.n_experts_per_tok * 3 * d * eff + d * cfg.n_experts
+    )
+    total = L * per_layer + cfg.vocab_size * d
+    if not cfg.tie_word_embeddings:
+        total += d * cfg.vocab_size
+    return total
+
+
+def _decode_step_bytes(cfg, batch: int, avg_ctx: float, quant: str | None) -> float:
+    """HBM bytes one fused decode step must move: the full (active) weight
+    stream plus every sequence's KV history."""
+    wbytes = _active_param_count(cfg) * (1 if quant == "int8" else 2)
+    kv_per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim_ * 2
+    return wbytes + batch * avg_ctx * kv_per_tok
+
+
+def _anchor_toks_per_sec(cfg, batch: int, avg_ctx: float, quant: str | None) -> float:
+    """Derived A100-80G + vLLM decode estimate for the same workload:
+    per-step time = max(bandwidth roofline, kernel-launch floor)."""
+    step_bytes = _decode_step_bytes(cfg, batch, avg_ctx, quant)
+    step_s = max(
+        step_bytes / (A100_80G_BW * ANCHOR_EFF),
+        cfg.n_layers * ANCHOR_LAYER_FLOOR_S,
+    )
+    return batch / step_s
+
+
+async def run_leg(model_name: str, quant: str | None, spec: str | None,
+                  concurrency: int | None = None, requests: int | None = None):
     from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
@@ -47,17 +130,16 @@ async def run_bench():
         StopConditions,
     )
     from dynamo_tpu.models.config import (
+        llama3_3b_config,
         llama3_8b_config,
         mixtral_8x7b_config,
         qwen2_500m_config,
     )
     from dynamo_tpu.runtime.context import Context
 
-    # BENCH_MODEL selects the shape. llama3-8b requires BENCH_QUANT=int8 to
-    # fit the single 16 GB chip (8 GB int8 weights + KV).
-    model_name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
     cfg = {
         "qwen2.5-0.5b": qwen2_500m_config,
+        "llama3-3b": llama3_3b_config,
         "llama3-8b": llama3_8b_config,
         "mixtral-8x7b": mixtral_8x7b_config,
     }[model_name]()
@@ -67,38 +149,62 @@ async def run_bench():
     # ISL=128 and drops to 5.0k). Concurrency 256 beats 384/512 on ITL
     # without losing aggregate throughput.
     block_size = int(os.environ.get("BENCH_BLOCK_SIZE", 128))
+    concurrency = concurrency or CONCURRENCY
+    requests = requests or REQUESTS
+    # 8B int8 on one 16 GB chip: ~8 GB of weights leave ~3 GB for KV, which
+    # must cover concurrency × ceil((ISL+OSL)/block) blocks WITH headroom —
+    # undersizing thrashes preemption-by-recompute (measured: 256-seq batch
+    # on 256 blocks → 625 tok/s, TTFT 32s).
+    default_blocks = 65536 // block_size
+    if model_name == "llama3-8b":
+        default_blocks = 24576 // block_size
     engine = JaxEngine(
         JaxEngineArgs(
             config=cfg,
             block_size=block_size,
-            num_kv_blocks=int(os.environ.get("BENCH_KV_BLOCKS", 65536 // block_size)),
-            max_num_seqs=CONCURRENCY,
+            num_kv_blocks=int(os.environ.get("BENCH_KV_BLOCKS", default_blocks)),
+            max_num_seqs=concurrency,
             max_model_len=max(512, ISL + OSL + 64),
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
             # One admission dispatch for the whole wave: prefill rows are
-            # near-free to batch (measured Bp 8→128 = 2.4× cost for 16× rows)
-            # and fewer admission rounds stop prefill from stealing decode
-            # ticks (measured 9.4k → 11.0k tok/s, ITL 20.9 → 15.4ms).
-            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", CONCURRENCY)),
+            # near-free to batch (measured Bp 8→128 = 2.4× cost for 16×
+            # rows) and fewer admission rounds stop prefill from stealing
+            # decode ticks (measured 9.4k → 11.0k tok/s, ITL 20.9 → 15.4ms).
+            prefill_batch=int(os.environ.get("BENCH_PREFILL_BATCH", concurrency)),
             enable_prefix_caching=True,
             decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", 64)),
             use_kernel=(
                 None if (uk := os.environ.get("BENCH_USE_KERNEL")) is None
                 else uk == "1"
             ),
-            # BENCH_QUANT=int8 → weight-only int8 (8B-class shapes fit the
-            # one 16 GB chip; see tests/test_quant.py for parity bounds).
-            quantization=os.environ.get("BENCH_QUANT") or None,
+            # BENCH_QUANT=int8 → weight-only int8. At ≥3B shapes int8 BEATS
+            # bf16 (measured 3B: 16.2 vs 22.5 ms/step — decode is weight-
+            # bandwidth-bound and int8 halves the stream); at 0.5B the
+            # weights are too small for bandwidth to matter.
+            quantization=quant,
+            spec_mode=spec,
         )
     )
 
     rng = np.random.default_rng(0)
 
+    # BENCH_PROMPT=repeat: prompts are a repeated short pattern — the
+    # lookup-friendly workload (extractive/templated traffic) where
+    # speculative decoding should win; default is worst-case random.
+    repeat_prompts = os.environ.get("BENCH_PROMPT") == "repeat"
+
     def make_req(i: int) -> PreprocessedRequest:
+        if repeat_prompts:
+            pattern = rng.integers(10, cfg.vocab_size - 10, size=8).tolist()
+            toks = (pattern * (ISL // 8 + 1))[:ISL]
+        else:
+            toks = rng.integers(10, cfg.vocab_size - 10, size=ISL).tolist()
         return PreprocessedRequest(
-            token_ids=rng.integers(10, cfg.vocab_size - 10, size=ISL).tolist(),
+            token_ids=toks,
             request_id=f"bench-{i}",
-            sampling=SamplingOptions(temperature=1.0, top_p=0.95),
+            sampling=SamplingOptions(
+                temperature=0.0 if spec else 1.0, top_p=None if spec else 0.95
+            ),
             stop=StopConditions(max_tokens=OSL, ignore_eos=True),
         )
 
@@ -114,7 +220,7 @@ async def run_bench():
         return n, ttft, time.monotonic() - t0
 
     async def run_wave(count, offset):
-        sem = asyncio.Semaphore(CONCURRENCY)
+        sem = asyncio.Semaphore(concurrency)
 
         async def limited(i):
             async with sem:
@@ -124,14 +230,15 @@ async def run_bench():
 
     # Warmup wave triggers all jit compiles (prefill buckets + decode buckets).
     if VERBOSE:
-        print("warmup wave...", flush=True)
+        print(f"[{model_name}] warmup wave...", flush=True)
     t0 = time.monotonic()
-    await run_wave(CONCURRENCY, offset=10_000)
+    await run_wave(concurrency, offset=10_000)
     if VERBOSE:
-        print(f"warmup done in {time.monotonic()-t0:.1f}s; stats={engine.stats()}", flush=True)
+        print(f"[{model_name}] warmup done in {time.monotonic()-t0:.1f}s; "
+              f"stats={engine.stats()}", flush=True)
 
     t0 = time.monotonic()
-    results = await run_wave(REQUESTS, offset=0)
+    results = await run_wave(requests, offset=0)
     wall = time.monotonic() - t0
     await engine.stop()
 
@@ -141,27 +248,111 @@ async def run_bench():
         (r[2] - r[1]) / max(r[0] - 1, 1) for r in results if r[1] is not None
     )
     toks_per_sec = total_tokens / wall
-    n_chips = jax.device_count()
-    value = toks_per_sec / n_chips
-    print(
-        json.dumps(
+    stats = engine.stats()
+    avg_ctx = ISL + OSL / 2
+    step_bytes = _decode_step_bytes(cfg, concurrency, avg_ctx, quant)
+    # Our own decode roofline on this chip (ignores prefill: decode
+    # dominates the wall at OSL=64) and compute utilization.
+    roofline = concurrency * V5E_BW / step_bytes
+    flops_per_tok = 2 * _active_param_count(cfg)
+    return {
+        "model": cfg.name,
+        "quant": quant,
+        "toks_per_sec_per_chip": round(toks_per_sec / jax.device_count(), 2),
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 2),
+        "p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+        "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
+        "anchor_toks_per_sec": round(
+            _anchor_toks_per_sec(cfg, concurrency, avg_ctx, quant), 1
+        ),
+        "mfu": round(toks_per_sec * flops_per_tok / V5E_PEAK_BF16, 4),
+        "hbm_util": round(toks_per_sec / roofline, 4),
+        **(
             {
-                "metric": (
-                    "aggregated decode throughput "
-                    f"({cfg.name}-shape, ISL={ISL}, OSL={OSL})"
-                ),
-                "value": round(value, 2),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(value / BASELINE_TOKS_PER_SEC_PER_CHIP, 4),
-                "total_tokens": total_tokens,
-                "wall_s": round(wall, 2),
-                "p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
-                "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
-                "n_chips": n_chips,
-                "backend": jax.default_backend(),
+                "spec_proposed": stats.get("spec_proposed", 0),
+                "spec_accepted": stats.get("spec_accepted", 0),
             }
-        )
-    )
+            if spec
+            else {}
+        ),
+    }
+
+
+async def run_bench():
+    model_name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
+    quant = os.environ.get("BENCH_QUANT") or None
+    spec = os.environ.get("BENCH_SPEC") or None
+    primary = await run_leg(model_name, quant, spec)
+
+    secondary = None
+    if (
+        os.environ.get("BENCH_SECONDARY", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        # BASELINE config-2 proxy: the largest BASELINE-relevant dense shape
+        # one 16 GB chip serves — Llama-3-8B weight-only int8. Concurrency
+        # sized to the KV that fits beside 8 GB of weights.
+        try:
+            secondary = await run_leg(
+                "llama3-8b", "int8", None, concurrency=64, requests=128
+            )
+        except Exception as exc:  # secondary must never kill the headline
+            secondary = {"error": f"{type(exc).__name__}: {exc}"}
+
+    value = primary["toks_per_sec_per_chip"]
+    out = {
+        "metric": (
+            f"aggregated decode throughput ({primary['model']}-shape, "
+            f"ISL={ISL}, OSL={OSL})"
+        ),
+        "value": value,
+        "unit": "tokens/sec/chip",
+        # vs the DERIVED anchor (see module docstring): A100-80G HBM
+        # bandwidth roofline × 0.6 achieved-bandwidth for the same
+        # model/batch/context — not an invented constant.
+        "vs_baseline": round(value / primary["anchor_toks_per_sec"], 4),
+        "anchor": {
+            "source": (
+                "derived A100-80G + vLLM-class decode estimate: per-step "
+                "time = max(step_bytes / (2039 GB/s x 0.6 achieved), "
+                "n_layers x 0.3ms kernel-launch floor) for the same "
+                "model/batch/context; per-chip is bandwidth-lopsided "
+                "(A100 HBM = 2.5x v5e), so vs_baseline_per_dollar uses "
+                "public on-demand prices (A100 $3.67/hr, v5e $1.20/hr)"
+            ),
+            "formula": (
+                "B / max((w_bytes + B*ctx*kv_bytes)/(BW*eff), L*3e-4)"
+            ),
+            "toks_per_sec": primary["anchor_toks_per_sec"],
+        },
+        "vs_baseline_per_dollar": round(
+            (value / V5E_USD_HR)
+            / (primary["anchor_toks_per_sec"] / A100_80G_USD_HR), 4,
+        ),
+        "total_tokens": primary["total_tokens"],
+        "wall_s": primary["wall_s"],
+        "p50_ttft_ms": primary["p50_ttft_ms"],
+        "p50_itl_ms": primary["p50_itl_ms"],
+        "mfu": primary["mfu"],
+        "hbm_util": primary["hbm_util"],
+        "n_chips": jax.device_count(),
+        "backend": jax.default_backend(),
+        **{
+            k: primary[k]
+            for k in ("spec_proposed", "spec_accepted")
+            if k in primary
+        },
+    }
+    if secondary is not None:
+        if "anchor_toks_per_sec" in secondary:
+            secondary["vs_baseline"] = round(
+                secondary["toks_per_sec_per_chip"]
+                / secondary["anchor_toks_per_sec"], 4,
+            )
+        out["secondary"] = secondary
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

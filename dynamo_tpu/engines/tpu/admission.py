@@ -1,0 +1,424 @@
+"""Admission pipeline: waiting queue -> prefilled, installed sequences.
+
+Split from the engine monolith (the engine owns the scheduler loop; this
+owns the admission policy): batched prefix-cache matching + block leasing,
+joint chunked prefill over a [Bp, C] ragged batch, failure containment
+(poisoned-request quarantine with a systemic-failure breaker), and slot
+installation including logits-processor bookkeeping.
+
+Reference parity: the role of vLLM's scheduler admission + prefix-cache
+lookup behind components/src/dynamo/vllm (SURVEY §2.2), restructured
+around ONE batched device dispatch per chunk round (B=1 prefill wastes the
+MXU; measured 16× rows for 2.4× cost on the v5e).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
+
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    FinishReason,
+    PreprocessedRequest,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class Admitter:
+    """Engine-attached admission pipeline (state lives on the engine)."""
+
+    def __init__(self, engine: Any) -> None:
+        self.e = engine
+
+    async def _admit_batch(self) -> int:
+        """Admit + prefill up to ``prefill_batch`` waiting sequences in ONE
+        batched device dispatch per chunk round. Returns how many were
+        installed into the decode batch.
+
+        Failure containment matches the round-2 breaker semantics: a
+        poisoned batch is retried per-sequence (one retry then an error
+        stream); the cross-request failure streak still detects systemic
+        breakage and fails the engine terminally.
+        """
+        e = self.e
+
+        free_slots = [i for i, s in enumerate(e._slots) if s is None]
+        if not free_slots or not e._waiting:
+            return 0
+        batch: List[Tuple[Any, Any]] = []
+        limit = min(len(free_slots), e.args.prefill_batch)
+        while e._waiting and len(batch) < limit:
+            seq = e._waiting[0]
+            if seq.context.stopped:
+                e._waiting.popleft()
+                seq.queue.put_nowait(
+                    BackendOutput(finish_reason=FinishReason.CANCELLED)
+                )
+                continue
+            has_mm = bool((seq.request.extra or {}).get("mm_embeds"))
+            if has_mm and batch:
+                break  # multimodal rows carry their own embed arrays: solo batch
+            e._waiting.popleft()
+            try:
+                prep = await e._prepare_admission(seq)
+            except asyncio.CancelledError:
+                e._waiting.appendleft(seq)
+                raise
+            except Exception as exc:
+                e._contain_admission_failure([seq], exc)
+                return len(batch) if not batch else await e._finish_admission(batch)
+            if prep is None:  # pool dry; seq was requeued to the front
+                break
+            batch.append((seq, prep))
+            if has_mm:
+                break
+        if not batch:
+            return 0
+        return await e._finish_admission(batch)
+
+    async def _finish_admission(self, batch: "List[Tuple[Any, Any]]") -> int:
+        e = self.e
+        try:
+            firsts = await e._prefill_batch(batch)
+        except asyncio.CancelledError:
+            for seq, prep in batch:
+                e.pool.release(prep.ids, prep.hashes[: prep.matched])
+                e._requeue(seq)
+            raise
+        except Exception as exc:
+            for seq, prep in batch:
+                e.pool.release(prep.ids, prep.hashes[: prep.matched])
+                seq.block_ids = []
+                seq.block_hashes = []
+            e._contain_admission_failure([s for s, _ in batch], exc)
+            return 0
+        e._admission_failure_streak = 0
+        free_iter = (i for i, s in enumerate(e._slots) if s is None)
+        for (seq, prep), (tok, logp, top) in zip(batch, firsts):
+            e._install(seq, prep, next(free_iter), tok, logp, top)
+        return len(batch)
+
+    def _contain_admission_failure(self, seqs: "List[Any]", exc: Exception) -> None:
+        """Per-request retry-once-then-eject; streak detects systemic failure."""
+        e = self.e
+
+        for seq in seqs:
+            seq.admission_failures += 1
+            if seq.admission_failures >= 2:
+                logger.exception(
+                    "ejecting request %s after %d admission failures",
+                    seq.request.request_id, seq.admission_failures,
+                )
+                seq.queue.put_nowait(
+                    BackendOutput(
+                        error=f"admission failed: {type(exc).__name__}: {exc}",
+                        finish_reason=FinishReason.ERROR,
+                    )
+                )
+            else:
+                logger.exception(
+                    "admission of %s failed; will retry once",
+                    seq.request.request_id,
+                )
+                e._waiting.appendleft(seq)
+        e._admission_failure_streak += 1
+        if e._admission_failure_streak >= 6:
+            e._fail_terminally(exc)
+
+    async def _prepare_admission(self, seq: Any) -> "Optional[Any]":
+        """Pool work for one sequence: salting, prefix match, allocation.
+        Returns None (after requeueing the sequence) when the pool is dry."""
+        e = self.e
+
+        args = e.args
+        prompt = seq.all_tokens  # includes regenerated tokens after preemption
+        n_blocks_prompt = math.ceil(len(prompt) / args.block_size)
+
+        # Multimodal splice inputs (multimodal/handlers.py): packed patch
+        # embeddings + a prompt-position → embedding-row map.
+        mm_embeds: Optional[np.ndarray] = None
+        mm_slot_of: Optional[np.ndarray] = None
+        mm = seq.request.extra or {}
+        if "mm_embeds" in mm:
+            from dynamo_tpu.disagg.handlers import unpack_array
+
+            mm_embeds = unpack_array(mm["mm_embeds"]).astype(np.float32)
+            per_image = int(mm.get("mm_tokens_per_image", 0))
+            mm_slot_of = np.full(len(prompt), -1, dtype=np.int32)
+            row = 0
+            for start in mm.get("mm_positions", []):
+                for j in range(per_image):
+                    if start + j < len(prompt):
+                        mm_slot_of[start + j] = row
+                    row += 1
+
+        # Salted hashing: adapter ⊕ image content — neither LoRA K/V nor
+        # image-conditioned K/V may cross-pollinate the base prefix cache.
+        seq.hash_salt = adapter_salt(seq.request.lora_name)
+        if mm_embeds is not None:
+            import xxhash
+
+            seq.hash_salt ^= xxhash.xxh3_64(mm_embeds.tobytes()).intdigest()
+
+        hashes: List[int] = []
+        matched = 0
+        ids: List[int] = []
+        if args.enable_prefix_caching:
+            hashes = compute_block_hashes(
+                prompt, args.block_size, salt=seq.hash_salt
+            )
+            # Onboard from the lower tiers (G2/G3) anything that extends the
+            # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
+            if e.kvbm is not None and hashes:
+                n_dev = e.pool.match_prefix(hashes)
+                if n_dev < len(hashes):
+                    try:
+                        await e.kvbm.onboard(hashes)
+                    except Exception:
+                        logger.exception("KV onboard failed; prefilling locally")
+            matched, ids = e.pool.pin_prefix(hashes)
+        matched_tokens = min(matched * args.block_size, len(prompt) - 1)
+
+        # Watermark headroom so running decodes can still grow.
+        headroom = (
+            int(args.num_kv_blocks * args.watermark)
+            if any(s is not None for s in e._slots)
+            else 0
+        )
+        need = n_blocks_prompt - len(ids) + 1 + headroom
+        if need > e.pool.free_blocks:
+            e.pool.release(ids, hashes[:matched])
+            e._requeue(seq)
+            return None
+        while len(ids) < n_blocks_prompt:
+            b = e.pool.alloc()
+            if b is None:  # raced below watermark; put everything back
+                e.pool.release(ids, hashes[:matched])
+                e._requeue(seq)
+                return None
+            ids.append(b)
+        seq.block_ids = ids
+        seq.block_hashes = hashes[:matched]
+        return _prep_cls()(
+            ids=ids,
+            hashes=hashes,
+            matched=matched,
+            matched_tokens=matched_tokens,
+            sp=e._sampling_of(seq.request),
+            adapter_id=e._lora_index.get(seq.request.lora_name or "", 0),
+            mm_embeds=mm_embeds,
+            mm_slot_of=mm_slot_of,
+            procs=e._procs_of(seq.request),
+        )
+
+    async def _prefill_batch(
+        self, batch: "List[Tuple[Any, Any]]"
+    ) -> List[Tuple[int, float]]:
+        """Joint chunked prefill: one [Bp, C] dispatch per chunk round with
+        per-row start/len (forward_paged supports ragged rows natively).
+        Returns each row's (first_token, logprob)."""
+        e = self.e
+        args = e.args
+        rows = len(batch)
+        prompts = [seq.all_tokens for seq, _ in batch]
+        pos = [prep.matched_tokens for _, prep in batch]
+        first: List[Optional[Tuple[int, float, Optional[list]]]] = [None] * rows
+        # Any row asking for top-N logprobs routes the batch through the
+        # top-variant prefill program so the FIRST generated token carries
+        # alternatives too (not just the fused-decode tokens).
+        want_top = any(
+            (seq.request.sampling.logprobs or 0) > 0 for seq, _ in batch
+        )
+
+        nb_needed = max(len(prep.ids) for _, prep in batch)
+        nb_bucket = min(_next_pow2(nb_needed), args.max_blocks_per_seq)
+        Bp = _next_pow2(rows)
+        tables = np.zeros((Bp, nb_bucket), dtype=np.int32)
+        temp = np.ones(Bp, dtype=np.float32)
+        topk = np.zeros(Bp, dtype=np.int32)
+        topp = np.ones(Bp, dtype=np.float32)
+        adapter = np.zeros(Bp, dtype=np.int32)
+        for r, (_, prep) in enumerate(batch):
+            tables[r, : len(prep.ids)] = prep.ids
+            temp[r], topk[r], topp[r] = prep.sp
+            adapter[r] = prep.adapter_id
+        procs = None
+        if any(prep.procs is not None for _, prep in batch):
+            from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS, prompt_hot
+
+            V = e.config.vocab_size
+            minp = np.zeros(Bp, dtype=np.float32)
+            rep = np.ones(Bp, dtype=np.float32)
+            pres = np.zeros(Bp, dtype=np.float32)
+            freq = np.zeros(Bp, dtype=np.float32)
+            bias_ids = np.full((Bp, MAX_BIAS_SLOTS), -1, dtype=np.int32)
+            bias_vals = np.zeros((Bp, MAX_BIAS_SLOTS), dtype=np.float32)
+            pmask = np.zeros((Bp, V), dtype=np.bool_)
+            for r, (seq_r, prep) in enumerate(batch):
+                if prep.procs is None:
+                    continue
+                p = prep.procs
+                minp[r], rep[r], pres[r], freq[r] = p.minp, p.rep, p.pres, p.freq
+                bias_ids[r] = p.bias_ids
+                bias_vals[r] = p.bias_vals
+                # all_tokens (not just the prompt): for preempted re-prefills
+                # the repetition penalty must keep covering already-generated
+                # tokens. (pres/freq at this single re-sample are approximated
+                # as zero; exact history is restored at _install.)
+                pmask[r] = prompt_hot(seq_r.all_tokens, V)
+            procs = (minp, rep, pres, freq, bias_ids, bias_vals, pmask)
+        # Multimodal rows run solo (rows == 1), so row 0's arrays suffice.
+        mm_embeds = batch[0][1].mm_embeds if rows == 1 else None
+        mm_slot_of = batch[0][1].mm_slot_of if rows == 1 else None
+
+        while any(pos[r] < len(prompts[r]) for r in range(rows)):
+            chunks = [
+                prompts[r][pos[r] : pos[r] + args.prefill_chunk] for r in range(rows)
+            ]
+            c_bucket = min(
+                _next_pow2(max(len(c) for c in chunks)), args.prefill_chunk
+            )
+            tok_arr = np.zeros((Bp, c_bucket), dtype=np.int32)
+            start = np.zeros(Bp, dtype=np.int32)
+            lens = np.zeros(Bp, dtype=np.int32)
+            for r in range(rows):
+                ch = chunks[r][:c_bucket]
+                tok_arr[r, : len(ch)] = ch
+                start[r] = pos[r]
+                lens[r] = len(ch)
+            mm_chunk = None
+            if mm_slot_of is not None:
+                mm_chunk = np.full((Bp, c_bucket), -1, dtype=np.int32)
+                n0 = int(lens[0])
+                mm_chunk[0, :n0] = mm_slot_of[pos[0] : pos[0] + n0]
+            # Fresh prefills (no prefix-cache hit, first chunk round) take
+            # the dense in-chunk attention program — zero paged reads.
+            first_chunk = bool(np.all(start[:rows] == 0))
+            toks, logps, topv, topi = await e._device(
+                e._run_step,
+                tok_arr, start, lens, tables,
+                temp, topk, topp, adapter,
+                mm_embeds, mm_chunk, procs, want_top, first_chunk,
+            )
+            for r in range(rows):
+                n = int(lens[r])
+                if n == 0:
+                    continue
+                e.prefill_tokens += n
+                pos[r] += n
+                if pos[r] >= len(prompts[r]):
+                    top = None
+                    if topv is not None:
+                        top = [
+                            (int(topi[r, j]), float(topv[r, j]))
+                            for j in range(topv.shape[1])
+                        ]
+                    first[r] = (int(toks[r]), float(logps[r]), top)
+        assert all(f is not None for f in first)
+        return first  # type: ignore[return-value]
+
+    def _install(
+        self, seq: Any, prep: "Any", slot: int, first_token: int,
+        first_logprob: float, first_top: Optional[list] = None,
+    ) -> None:
+        """Commit fresh prompt blocks and join the decode batch."""
+        e = self.e
+        args = e.args
+        prompt = seq.all_tokens
+        if args.enable_prefix_caching:
+            full = len(prompt) // args.block_size
+            for i in range(prep.matched, full):
+                parent = prep.hashes[i - 1] if i else None
+                e.pool.commit(prep.ids[i], prep.hashes[i], parent)
+                seq.block_hashes.append(prep.hashes[i])
+                if e.kvbm is not None:
+                    e.kvbm.notify_commit(prep.hashes[i], i + 1)
+        seq.slot = slot
+        e._slots[slot] = seq
+        e._pos[slot] = len(prompt)
+        e._block_tables[slot, :] = 0
+        e._block_tables[slot, : len(prep.ids)] = prep.ids
+        e._temp[slot], e._topk[slot], e._topp[slot] = prep.sp
+        e._adapter_ids[slot] = prep.adapter_id
+        # Logits-processor slot state: neutral unless this occupant asks —
+        # stale device bookkeeping from a previous occupant is harmless
+        # under neutral params (identity transform).
+        p = prep.procs
+        e._uses_procs[slot] = p is not None
+        if p is None:
+            e._minp[slot] = 0.0
+            e._rep[slot] = 1.0
+            e._pres[slot] = 0.0
+            e._freq[slot] = 0.0
+            e._bias_ids[slot, :] = -1
+            e._bias_vals[slot, :] = 0.0
+        else:
+            from dynamo_tpu.ops import logits_process as lp
+
+            e._minp[slot] = p.minp
+            e._rep[slot] = p.rep
+            e._pres[slot] = p.pres
+            e._freq[slot] = p.freq
+            e._bias_ids[slot] = p.bias_ids
+            e._bias_vals[slot] = p.bias_vals
+            # Original prompt only in the mask; prior generated tokens (a
+            # preempted sequence being re-admitted) restore output counts.
+            e.runner.proc_reset_slot(
+                slot, seq.request.token_ids, seq.generated
+            )
+            e.runner.proc_count(slot, first_token)
+        e._emit_token(seq, first_token, first_logprob, first_top)
+
+    def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
+        e = self.e
+        s = req.sampling
+        temp = s.temperature if s.temperature is not None else 1.0
+        topk = s.top_k if s.top_k is not None and s.top_k > 0 else 0
+        topp = s.top_p if s.top_p is not None else 1.0
+        return float(temp), int(topk), float(topp)
+
+    def _procs_of(self, req: PreprocessedRequest) -> Optional[Any]:
+        """Logits-processor params, or None when the request uses none —
+        None keeps the batch on the processor-free compiled programs."""
+        e = self.e
+
+        s = req.sampling
+        rep = float(s.repetition_penalty) if s.repetition_penalty else 1.0
+        pres = float(s.presence_penalty) if s.presence_penalty else 0.0
+        freq = float(s.frequency_penalty) if s.frequency_penalty else 0.0
+        minp = float(s.min_p) if s.min_p else 0.0
+        bias = s.logit_bias
+        if rep == 1.0 and pres == 0.0 and freq == 0.0 and minp <= 0.0 and not bias:
+            return None
+        from dynamo_tpu.ops.logits_process import pack_bias
+
+        ids, vals = pack_bias(bias, e.config.vocab_size)
+        return _procprep_cls()(
+            minp=minp, rep=rep, pres=pres, freq=freq,
+            bias_ids=ids, bias_vals=vals,
+        )
+
+
+
+def _prep_cls():
+    from dynamo_tpu.engines.tpu.engine import _Prep
+
+    return _Prep
+
+
+def _procprep_cls():
+    from dynamo_tpu.engines.tpu.engine import _ProcPrep
+
+    return _ProcPrep

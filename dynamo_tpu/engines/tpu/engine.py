@@ -315,6 +315,7 @@ class JaxEngine:
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
         adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
+        first_chunk=False,
     ):
         """One prefill step on the device thread (blocking). See
         DeviceRunner.run_step; kept as an engine method so tests can inject
@@ -322,7 +323,7 @@ class JaxEngine:
         return self.runner.run_step(
             tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
             adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot, procs=procs,
-            want_top=want_top,
+            want_top=want_top, first_chunk=first_chunk,
         )
 
     async def _device(self, fn, *a):
@@ -574,358 +575,45 @@ class JaxEngine:
                 return i
         return None
 
+    # -- admission (policy in engines/tpu/admission.py) --------------------
+    # Thin delegates keep the engine surface stable (tests monkeypatch
+    # these names for fault injection) while the pipeline lives in one
+    # dedicated module.
+
+    @property
+    def _admitter(self):
+        if self.__dict__.get("_admitter_obj") is None:
+            from dynamo_tpu.engines.tpu.admission import Admitter
+
+            self.__dict__["_admitter_obj"] = Admitter(self)
+        return self.__dict__["_admitter_obj"]
+
     async def _admit_batch(self) -> int:
-        """Admit + prefill up to ``prefill_batch`` waiting sequences in ONE
-        batched device dispatch per chunk round. Returns how many were
-        installed into the decode batch.
+        return await self._admitter._admit_batch()
 
-        Failure containment matches the round-2 breaker semantics: a
-        poisoned batch is retried per-sequence (one retry then an error
-        stream); the cross-request failure streak still detects systemic
-        breakage and fails the engine terminally.
-        """
-        free_slots = [i for i, s in enumerate(self._slots) if s is None]
-        if not free_slots or not self._waiting:
-            return 0
-        batch: List[Tuple[_Sequence, _Prep]] = []
-        limit = min(len(free_slots), self.args.prefill_batch)
-        while self._waiting and len(batch) < limit:
-            seq = self._waiting[0]
-            if seq.context.stopped:
-                self._waiting.popleft()
-                seq.queue.put_nowait(
-                    BackendOutput(finish_reason=FinishReason.CANCELLED)
-                )
-                continue
-            has_mm = bool((seq.request.extra or {}).get("mm_embeds"))
-            if has_mm and batch:
-                break  # multimodal rows carry their own embed arrays: solo batch
-            self._waiting.popleft()
-            try:
-                prep = await self._prepare_admission(seq)
-            except asyncio.CancelledError:
-                self._waiting.appendleft(seq)
-                raise
-            except Exception as exc:
-                self._contain_admission_failure([seq], exc)
-                return len(batch) if not batch else await self._finish_admission(batch)
-            if prep is None:  # pool dry; seq was requeued to the front
-                break
-            batch.append((seq, prep))
-            if has_mm:
-                break
-        if not batch:
-            return 0
-        return await self._finish_admission(batch)
+    async def _finish_admission(self, batch) -> int:
+        return await self._admitter._finish_admission(batch)
 
-    async def _finish_admission(self, batch: "List[Tuple[_Sequence, _Prep]]") -> int:
-        try:
-            firsts = await self._prefill_batch(batch)
-        except asyncio.CancelledError:
-            for seq, prep in batch:
-                self.pool.release(prep.ids, prep.hashes[: prep.matched])
-                self._requeue(seq)
-            raise
-        except Exception as exc:
-            for seq, prep in batch:
-                self.pool.release(prep.ids, prep.hashes[: prep.matched])
-                seq.block_ids = []
-                seq.block_hashes = []
-            self._contain_admission_failure([s for s, _ in batch], exc)
-            return 0
-        self._admission_failure_streak = 0
-        free_iter = (i for i, s in enumerate(self._slots) if s is None)
-        for (seq, prep), (tok, logp, top) in zip(batch, firsts):
-            self._install(seq, prep, next(free_iter), tok, logp, top)
-        return len(batch)
+    def _contain_admission_failure(self, seqs, exc: Exception) -> None:
+        self._admitter._contain_admission_failure(seqs, exc)
 
-    def _contain_admission_failure(self, seqs: "List[_Sequence]", exc: Exception) -> None:
-        """Per-request retry-once-then-eject; streak detects systemic failure."""
-        for seq in seqs:
-            seq.admission_failures += 1
-            if seq.admission_failures >= 2:
-                logger.exception(
-                    "ejecting request %s after %d admission failures",
-                    seq.request.request_id, seq.admission_failures,
-                )
-                seq.queue.put_nowait(
-                    BackendOutput(
-                        error=f"admission failed: {type(exc).__name__}: {exc}",
-                        finish_reason=FinishReason.ERROR,
-                    )
-                )
-            else:
-                logger.exception(
-                    "admission of %s failed; will retry once",
-                    seq.request.request_id,
-                )
-                self._waiting.appendleft(seq)
-        self._admission_failure_streak += 1
-        if self._admission_failure_streak >= 6:
-            self._fail_terminally(exc)
+    async def _prepare_admission(self, seq: _Sequence):
+        return await self._admitter._prepare_admission(seq)
 
-    async def _prepare_admission(self, seq: _Sequence) -> "Optional[_Prep]":
-        """Pool work for one sequence: salting, prefix match, allocation.
-        Returns None (after requeueing the sequence) when the pool is dry."""
-        args = self.args
-        prompt = seq.all_tokens  # includes regenerated tokens after preemption
-        n_blocks_prompt = math.ceil(len(prompt) / args.block_size)
+    async def _prefill_batch(self, batch):
+        return await self._admitter._prefill_batch(batch)
 
-        # Multimodal splice inputs (multimodal/handlers.py): packed patch
-        # embeddings + a prompt-position → embedding-row map.
-        mm_embeds: Optional[np.ndarray] = None
-        mm_slot_of: Optional[np.ndarray] = None
-        mm = seq.request.extra or {}
-        if "mm_embeds" in mm:
-            from dynamo_tpu.disagg.handlers import unpack_array
-
-            mm_embeds = unpack_array(mm["mm_embeds"]).astype(np.float32)
-            per_image = int(mm.get("mm_tokens_per_image", 0))
-            mm_slot_of = np.full(len(prompt), -1, dtype=np.int32)
-            row = 0
-            for start in mm.get("mm_positions", []):
-                for j in range(per_image):
-                    if start + j < len(prompt):
-                        mm_slot_of[start + j] = row
-                    row += 1
-
-        # Salted hashing: adapter ⊕ image content — neither LoRA K/V nor
-        # image-conditioned K/V may cross-pollinate the base prefix cache.
-        seq.hash_salt = adapter_salt(seq.request.lora_name)
-        if mm_embeds is not None:
-            import xxhash
-
-            seq.hash_salt ^= xxhash.xxh3_64(mm_embeds.tobytes()).intdigest()
-
-        hashes: List[int] = []
-        matched = 0
-        ids: List[int] = []
-        if args.enable_prefix_caching:
-            hashes = compute_block_hashes(
-                prompt, args.block_size, salt=seq.hash_salt
-            )
-            # Onboard from the lower tiers (G2/G3) anything that extends the
-            # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
-            if self.kvbm is not None and hashes:
-                n_dev = self.pool.match_prefix(hashes)
-                if n_dev < len(hashes):
-                    try:
-                        await self.kvbm.onboard(hashes)
-                    except Exception:
-                        logger.exception("KV onboard failed; prefilling locally")
-            matched, ids = self.pool.pin_prefix(hashes)
-        matched_tokens = min(matched * args.block_size, len(prompt) - 1)
-
-        # Watermark headroom so running decodes can still grow.
-        headroom = (
-            int(args.num_kv_blocks * args.watermark)
-            if any(s is not None for s in self._slots)
-            else 0
+    def _install(self, seq: _Sequence, prep, slot: int, first_token: int,
+                 first_logprob: float, first_top=None) -> None:
+        self._admitter._install(
+            seq, prep, slot, first_token, first_logprob, first_top
         )
-        need = n_blocks_prompt - len(ids) + 1 + headroom
-        if need > self.pool.free_blocks:
-            self.pool.release(ids, hashes[:matched])
-            self._requeue(seq)
-            return None
-        while len(ids) < n_blocks_prompt:
-            b = self.pool.alloc()
-            if b is None:  # raced below watermark; put everything back
-                self.pool.release(ids, hashes[:matched])
-                self._requeue(seq)
-                return None
-            ids.append(b)
-        seq.block_ids = ids
-        seq.block_hashes = hashes[:matched]
-        return _Prep(
-            ids=ids,
-            hashes=hashes,
-            matched=matched,
-            matched_tokens=matched_tokens,
-            sp=self._sampling_of(seq.request),
-            adapter_id=self._lora_index.get(seq.request.lora_name or "", 0),
-            mm_embeds=mm_embeds,
-            mm_slot_of=mm_slot_of,
-            procs=self._procs_of(seq.request),
-        )
-
-    async def _prefill_batch(
-        self, batch: "List[Tuple[_Sequence, _Prep]]"
-    ) -> List[Tuple[int, float]]:
-        """Joint chunked prefill: one [Bp, C] dispatch per chunk round with
-        per-row start/len (forward_paged supports ragged rows natively).
-        Returns each row's (first_token, logprob)."""
-        args = self.args
-        rows = len(batch)
-        prompts = [seq.all_tokens for seq, _ in batch]
-        pos = [prep.matched_tokens for _, prep in batch]
-        first: List[Optional[Tuple[int, float, Optional[list]]]] = [None] * rows
-        # Any row asking for top-N logprobs routes the batch through the
-        # top-variant prefill program so the FIRST generated token carries
-        # alternatives too (not just the fused-decode tokens).
-        want_top = any(
-            (seq.request.sampling.logprobs or 0) > 0 for seq, _ in batch
-        )
-
-        nb_needed = max(len(prep.ids) for _, prep in batch)
-        nb_bucket = min(_next_pow2(nb_needed), args.max_blocks_per_seq)
-        Bp = _next_pow2(rows)
-        tables = np.zeros((Bp, nb_bucket), dtype=np.int32)
-        temp = np.ones(Bp, dtype=np.float32)
-        topk = np.zeros(Bp, dtype=np.int32)
-        topp = np.ones(Bp, dtype=np.float32)
-        adapter = np.zeros(Bp, dtype=np.int32)
-        for r, (_, prep) in enumerate(batch):
-            tables[r, : len(prep.ids)] = prep.ids
-            temp[r], topk[r], topp[r] = prep.sp
-            adapter[r] = prep.adapter_id
-        procs = None
-        if any(prep.procs is not None for _, prep in batch):
-            from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS, prompt_hot
-
-            V = self.config.vocab_size
-            minp = np.zeros(Bp, dtype=np.float32)
-            rep = np.ones(Bp, dtype=np.float32)
-            pres = np.zeros(Bp, dtype=np.float32)
-            freq = np.zeros(Bp, dtype=np.float32)
-            bias_ids = np.full((Bp, MAX_BIAS_SLOTS), -1, dtype=np.int32)
-            bias_vals = np.zeros((Bp, MAX_BIAS_SLOTS), dtype=np.float32)
-            pmask = np.zeros((Bp, V), dtype=np.bool_)
-            for r, (seq_r, prep) in enumerate(batch):
-                if prep.procs is None:
-                    continue
-                p = prep.procs
-                minp[r], rep[r], pres[r], freq[r] = p.minp, p.rep, p.pres, p.freq
-                bias_ids[r] = p.bias_ids
-                bias_vals[r] = p.bias_vals
-                # all_tokens (not just the prompt): for preempted re-prefills
-                # the repetition penalty must keep covering already-generated
-                # tokens. (pres/freq at this single re-sample are approximated
-                # as zero; exact history is restored at _install.)
-                pmask[r] = prompt_hot(seq_r.all_tokens, V)
-            procs = (minp, rep, pres, freq, bias_ids, bias_vals, pmask)
-        # Multimodal rows run solo (rows == 1), so row 0's arrays suffice.
-        mm_embeds = batch[0][1].mm_embeds if rows == 1 else None
-        mm_slot_of = batch[0][1].mm_slot_of if rows == 1 else None
-
-        while any(pos[r] < len(prompts[r]) for r in range(rows)):
-            chunks = [
-                prompts[r][pos[r] : pos[r] + args.prefill_chunk] for r in range(rows)
-            ]
-            c_bucket = min(
-                _next_pow2(max(len(c) for c in chunks)), args.prefill_chunk
-            )
-            tok_arr = np.zeros((Bp, c_bucket), dtype=np.int32)
-            start = np.zeros(Bp, dtype=np.int32)
-            lens = np.zeros(Bp, dtype=np.int32)
-            for r in range(rows):
-                ch = chunks[r][:c_bucket]
-                tok_arr[r, : len(ch)] = ch
-                start[r] = pos[r]
-                lens[r] = len(ch)
-            mm_chunk = None
-            if mm_slot_of is not None:
-                mm_chunk = np.full((Bp, c_bucket), -1, dtype=np.int32)
-                n0 = int(lens[0])
-                mm_chunk[0, :n0] = mm_slot_of[pos[0] : pos[0] + n0]
-            toks, logps, topv, topi = await self._device(
-                self._run_step,
-                tok_arr, start, lens, tables,
-                temp, topk, topp, adapter,
-                mm_embeds, mm_chunk, procs, want_top,
-            )
-            for r in range(rows):
-                n = int(lens[r])
-                if n == 0:
-                    continue
-                self.prefill_tokens += n
-                pos[r] += n
-                if pos[r] >= len(prompts[r]):
-                    top = None
-                    if topv is not None:
-                        top = [
-                            (int(topi[r, j]), float(topv[r, j]))
-                            for j in range(topv.shape[1])
-                        ]
-                    first[r] = (int(toks[r]), float(logps[r]), top)
-        assert all(f is not None for f in first)
-        return first  # type: ignore[return-value]
-
-    def _install(
-        self, seq: _Sequence, prep: "_Prep", slot: int, first_token: int,
-        first_logprob: float, first_top: Optional[list] = None,
-    ) -> None:
-        """Commit fresh prompt blocks and join the decode batch."""
-        args = self.args
-        prompt = seq.all_tokens
-        if args.enable_prefix_caching:
-            full = len(prompt) // args.block_size
-            for i in range(prep.matched, full):
-                parent = prep.hashes[i - 1] if i else None
-                self.pool.commit(prep.ids[i], prep.hashes[i], parent)
-                seq.block_hashes.append(prep.hashes[i])
-                if self.kvbm is not None:
-                    self.kvbm.notify_commit(prep.hashes[i], i + 1)
-        seq.slot = slot
-        self._slots[slot] = seq
-        self._pos[slot] = len(prompt)
-        self._block_tables[slot, :] = 0
-        self._block_tables[slot, : len(prep.ids)] = prep.ids
-        self._temp[slot], self._topk[slot], self._topp[slot] = prep.sp
-        self._adapter_ids[slot] = prep.adapter_id
-        # Logits-processor slot state: neutral unless this occupant asks —
-        # stale device bookkeeping from a previous occupant is harmless
-        # under neutral params (identity transform).
-        p = prep.procs
-        self._uses_procs[slot] = p is not None
-        if p is None:
-            self._minp[slot] = 0.0
-            self._rep[slot] = 1.0
-            self._pres[slot] = 0.0
-            self._freq[slot] = 0.0
-            self._bias_ids[slot, :] = -1
-            self._bias_vals[slot, :] = 0.0
-        else:
-            from dynamo_tpu.ops import logits_process as lp
-
-            self._minp[slot] = p.minp
-            self._rep[slot] = p.rep
-            self._pres[slot] = p.pres
-            self._freq[slot] = p.freq
-            self._bias_ids[slot] = p.bias_ids
-            self._bias_vals[slot] = p.bias_vals
-            # Original prompt only in the mask; prior generated tokens (a
-            # preempted sequence being re-admitted) restore output counts.
-            self.runner.proc_reset_slot(
-                slot, seq.request.token_ids, seq.generated
-            )
-            self.runner.proc_count(slot, first_token)
-        self._emit_token(seq, first_token, first_logprob, first_top)
 
     def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
-        s = req.sampling
-        temp = s.temperature if s.temperature is not None else 1.0
-        topk = s.top_k if s.top_k is not None and s.top_k > 0 else 0
-        topp = s.top_p if s.top_p is not None else 1.0
-        return float(temp), int(topk), float(topp)
+        return self._admitter._sampling_of(req)
 
-    def _procs_of(self, req: PreprocessedRequest) -> Optional[_ProcPrep]:
-        """Logits-processor params, or None when the request uses none —
-        None keeps the batch on the processor-free compiled programs."""
-        s = req.sampling
-        rep = float(s.repetition_penalty) if s.repetition_penalty else 1.0
-        pres = float(s.presence_penalty) if s.presence_penalty else 0.0
-        freq = float(s.frequency_penalty) if s.frequency_penalty else 0.0
-        minp = float(s.min_p) if s.min_p else 0.0
-        bias = s.logit_bias
-        if rep == 1.0 and pres == 0.0 and freq == 0.0 and minp <= 0.0 and not bias:
-            return None
-        from dynamo_tpu.ops.logits_process import pack_bias
-
-        ids, vals = pack_bias(bias, self.config.vocab_size)
-        return _ProcPrep(
-            minp=minp, rep=rep, pres=pres, freq=freq,
-            bias_ids=ids, bias_vals=vals,
-        )
+    def _procs_of(self, req: PreprocessedRequest):
+        return self._admitter._procs_of(req)
 
     def _requeue(self, seq: _Sequence) -> None:
         seq.block_ids = []
@@ -994,23 +682,16 @@ class JaxEngine:
         return [s for s in self._slots if s is not None]
 
     # -- speculative decoding (prompt-lookup / n-gram) ---------------------
+    # Policy lives in engines/tpu/spec.py (NgramSpecDecoder); the engine
+    # keeps the device hook + a lazily built decoder.
 
-    def _propose(self, seq: _Sequence) -> List[int]:
-        """Prompt-lookup proposal: index new tokens, then continue from the
-        most recent earlier occurrence of the trailing n-gram."""
-        n = self.args.spec_ngram
-        toks = seq.all_tokens
-        # Incremental index: register every n-gram ENDING at p, excluding
-        # the final position (its continuation is what we're predicting).
-        for p in range(max(seq.ngram_upto, n - 1), len(toks) - 1):
-            seq.ngram_index[tuple(toks[p - n + 1 : p + 1])] = p + 1
-        seq.ngram_upto = max(len(toks) - 1, 0)
-        if len(toks) < n:
-            return []
-        cont = seq.ngram_index.get(tuple(toks[-n:]))
-        if cont is None:
-            return []
-        return toks[cont : cont + self.args.spec_k]
+    @property
+    def _spec(self):
+        if self.__dict__.get("_spec_decoder") is None:
+            from dynamo_tpu.engines.tpu.spec import NgramSpecDecoder
+
+            self.__dict__["_spec_decoder"] = NgramSpecDecoder(self)
+        return self.__dict__["_spec_decoder"]
 
     def _run_spec(self, tokens, start_pos, chunk_lens, block_tables,
                   adapter_ids):
@@ -1018,89 +699,14 @@ class JaxEngine:
             tokens, start_pos, chunk_lens, block_tables, adapter_ids
         )
 
+    def _propose(self, seq: _Sequence) -> List[int]:
+        return self._spec.propose(seq)
+
     def _spec_eligible(self, active: "List[_Sequence]") -> bool:
-        for s in active:
-            sp = s.request.sampling
-            # None means DEFAULT temperature (1.0, _sampling_of) — sampled,
-            # not greedy; only an explicit temperature <= 0 qualifies.
-            temp = sp.temperature if sp.temperature is not None else 1.0
-            if temp > 0.0 or sp.logprobs is not None:
-                return False
-            if self._uses_procs[s.slot]:
-                return False
-        return True
+        return self._spec.eligible(active)
 
     async def _spec_tick(self) -> bool:
-        """One verify dispatch over [next_token + proposals]. Returns False
-        when this tick is ineligible or nothing proposes — the fused
-        decode_steps-per-dispatch path wins whenever speculation has no
-        candidates (a 1-token verify would cost decode_steps× the
-        dispatches)."""
-        args = self.args
-        occupied = [s for s in self._slots if s is not None]
-        if not occupied:
-            return True
-        if not self._spec_eligible(occupied):
-            return False
-        proposals: Dict[int, List[int]] = {
-            s.slot: self._propose(s) for s in occupied
-        }
-        if not any(proposals.values()):
-            return False
-
-        C = args.spec_k + 1
-        active = self._prepare_decode(C)
-        if not active:
-            return True
-        S = args.max_num_seqs
-        tokens = np.zeros((S, C), dtype=np.int32)
-        lens = np.zeros(S, dtype=np.int32)
-        max_blocks = 1
-        for seq in active:
-            slot = seq.slot
-            prop = proposals.get(slot, [])
-            # Never speculate past the model-length cap.
-            room = args.max_model_len - int(self._pos[slot]) - 1
-            prop = prop[: max(min(len(prop), room), 0)]
-            proposals[slot] = prop
-            tokens[slot, 0] = seq.next_token
-            tokens[slot, 1 : 1 + len(prop)] = prop
-            lens[slot] = 1 + len(prop)
-            max_blocks = max(
-                max_blocks,
-                (int(self._pos[slot]) + C - 1) // args.block_size + 1,
-            )
-        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
-
-        out = await self._device(
-            self._run_spec,
-            tokens,
-            self._pos.copy(),
-            lens,
-            self._block_tables[:, :nb_bucket].copy(),
-            self._adapter_ids.copy(),
-        )
-        self.steps += 1
-        for seq in list(active):
-            if seq.slot < 0:
-                continue  # finished by an earlier emit in this loop
-            slot = seq.slot
-            prop = proposals.get(slot, [])
-            row = out[slot]
-            # Accept greedy-matching proposals; the first mismatch position
-            # yields the model's own token (always ≥1 token of progress).
-            emitted = [int(row[0])]
-            for i, p in enumerate(prop):
-                if p != int(row[i]):
-                    break
-                emitted.append(int(row[i + 1]))
-            self.spec_proposed += len(prop)
-            self.spec_accepted += len(emitted) - 1
-            self._emit_burst(
-                seq, np.asarray(emitted, dtype=np.int32),
-                np.zeros(len(emitted), dtype=np.float32),
-            )
-        return True
+        return await self._spec.tick()
 
     async def _decode_tick(self) -> None:
         args = self.args
@@ -1363,151 +969,18 @@ class JaxEngine:
         return len(ids)
 
     # -- checkpoint / restore (the chrek/CRIU fast-cold-start role) --------
+    # Logic lives in engines/tpu/kv_checkpoint.py; these stay as the
+    # engine's public surface (system server + worker shutdown use them).
 
     async def save_checkpoint(self, ckpt_dir: str) -> Dict[str, Any]:
-        """Persist the warm prefix cache: every committed KV block plus its
-        hash-chain metadata (ref: deploy/chrek CRIU checkpoints — the TPU
-        analog persists the expensive-to-rebuild state: weights are covered
-        by models/weight_cache.py, the warmed KV cache by this). A restored
-        worker serves shared-prefix traffic without re-prefilling."""
-        import json
-        import os
+        from dynamo_tpu.engines.tpu import kv_checkpoint
 
-        import uuid
-
-        os.makedirs(ckpt_dir, exist_ok=True)
-        snap = self.pool.snapshot_committed()
-        hashes = [h for h, _, _ in snap]
-        ids = [bid for _, _, bid in snap]
-        try:
-            # The manifest is the commit point: it names the (nonce-unique)
-            # data file, so a crash at any point leaves the OLD manifest
-            # pointing at the OLD data — never a mismatched pair (same
-            # atomic-publish rule as models/weight_cache.py save_params).
-            data_name = f"kv_blocks-{uuid.uuid4().hex[:12]}.npz" if ids else ""
-            if ids:
-                def gather_and_write():
-                    k, v = self.runner.gather_blocks(ids)
-                    # Disk write stays off the event loop (multi-GB stall).
-                    np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)
-
-                await self._device(gather_and_write)
-            manifest = {
-                "version": 1,
-                "model": self.config.name,
-                "block_size": self.args.block_size,
-                "n_layers": self.config.n_layers,
-                "n_kv_heads": self.config.n_kv_heads,
-                "head_dim": self.config.head_dim_,
-                "data": data_name,
-                "blocks": [
-                    {"hash": h, "parent": p} for h, p, _ in snap
-                ],
-            }
-            tmp = os.path.join(ckpt_dir, f".manifest-{uuid.uuid4().hex[:8]}")
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            old = self._read_manifest(ckpt_dir)
-            os.replace(tmp, os.path.join(ckpt_dir, "manifest.json"))
-            if old and old.get("data") and old["data"] != data_name:
-                try:  # best-effort cleanup of the superseded data file
-                    os.unlink(os.path.join(ckpt_dir, old["data"]))
-                except OSError:
-                    pass
-            logger.info("checkpointed %d KV blocks to %s", len(ids), ckpt_dir)
-            return {"blocks": len(ids), "path": ckpt_dir}
-        finally:
-            if ids:
-                self.pool.release(ids, hashes)
-
-    @staticmethod
-    def _read_manifest(ckpt_dir: str):
-        import json
-        import os
-
-        try:
-            with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+        return await kv_checkpoint.save_checkpoint(self, ckpt_dir)
 
     async def load_checkpoint(self, ckpt_dir: str) -> int:
-        """Restore a save_checkpoint() capture into the pool as cached
-        content. Returns the number of blocks installed (stops early when
-        the pool is dry); raises ValueError on a shape/model mismatch."""
-        import json
-        import os
+        from dynamo_tpu.engines.tpu import kv_checkpoint
 
-        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-            manifest = json.load(f)
-        for key, ours in (
-            ("model", self.config.name),
-            ("block_size", self.args.block_size),
-            ("n_layers", self.config.n_layers),
-            ("n_kv_heads", self.config.n_kv_heads),
-            ("head_dim", self.config.head_dim_),
-        ):
-            if manifest.get(key) != ours:
-                raise ValueError(
-                    f"checkpoint {key}={manifest.get(key)!r} does not match "
-                    f"engine {key}={ours!r}"
-                )
-        blocks = manifest.get("blocks", [])
-        if not blocks:
-            return 0
-        data_name = manifest.get("data") or "kv_blocks.npz"
-
-        def read():  # disk read off the event loop
-            data = np.load(os.path.join(ckpt_dir, data_name))
-            return data["k"], data["v"]
-
-        k_all, v_all = await self._device(read)
-        index_of = {b["hash"]: i for i, b in enumerate(blocks)}
-
-        # Parents-first install order (chains form a forest).
-        placed = set()
-        ordered: List[Dict[str, Any]] = []
-        pending = list(blocks)
-        while pending:
-            progressed = False
-            rest = []
-            for b in pending:
-                parent = b["parent"]
-                if (
-                    parent is None
-                    or parent in placed
-                    or self.pool.contains(parent)
-                ):
-                    ordered.append(b)
-                    placed.add(b["hash"])
-                    progressed = True
-                else:
-                    rest.append(b)
-            pending = rest
-            if not progressed:
-                logger.warning(
-                    "checkpoint restore: %d blocks have unreachable parents",
-                    len(pending),
-                )
-                break
-
-        # Split into parent-linked runs and reuse the proven disagg install
-        # path (pin/scatter/commit/rollback invariants live in ONE place).
-        installed = 0
-        i = 0
-        while i < len(ordered):
-            j = i + 1
-            while j < len(ordered) and ordered[j]["parent"] == ordered[j - 1]["hash"]:
-                j += 1
-            run = ordered[i:j]
-            sel = [index_of[b["hash"]] for b in run]
-            installed += await self.import_blocks_async(
-                [b["hash"] for b in run], k_all[sel], v_all[sel],
-                anchor_parent=run[0]["parent"],
-            )
-            i = j
-        logger.info("restored %d KV blocks from %s", installed, ckpt_dir)
-        return installed
+        return await kv_checkpoint.load_checkpoint(self, ckpt_dir)
 
     def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
         self.pool.release(seq.block_ids, seq.block_hashes)

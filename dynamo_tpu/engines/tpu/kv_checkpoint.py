@@ -1,0 +1,157 @@
+"""Warm-KV checkpoint/restore (the chrek/CRIU fast-cold-start role).
+
+Reference parity: deploy/chrek/pkg/checkpoint/criu.go — the reference
+snapshots whole containers; on TPU a process image can't capture HBM, so
+the TPU-native equivalent persists the expensive-to-rebuild state
+explicitly: weights via models/weight_cache.py (GMS tiers), the warmed KV
+prefix cache via these functions. A restored worker serves shared-prefix
+traffic without re-prefilling.
+
+Split from the engine monolith: the engine exposes thin
+save_checkpoint/load_checkpoint delegates; all manifest/order logic lives
+here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Any, Dict, List
+
+import numpy as np
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def read_manifest(ckpt_dir: str):
+    try:
+        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
+    """Persist the warm prefix cache: every committed KV block plus its
+    hash-chain metadata."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    snap = engine.pool.snapshot_committed()
+    hashes = [h for h, _, _ in snap]
+    ids = [bid for _, _, bid in snap]
+    try:
+        # The manifest is the commit point: it names the (nonce-unique)
+        # data file, so a crash at any point leaves the OLD manifest
+        # pointing at the OLD data — never a mismatched pair (same
+        # atomic-publish rule as models/weight_cache.py save_params).
+        data_name = f"kv_blocks-{uuid.uuid4().hex[:12]}.npz" if ids else ""
+        if ids:
+            def gather_and_write():
+                k, v = engine.runner.gather_blocks(ids)
+                # Disk write stays off the event loop (multi-GB stall).
+                np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)
+
+            await engine._device(gather_and_write)
+        manifest = {
+            "version": 1,
+            "model": engine.config.name,
+            "block_size": engine.args.block_size,
+            "n_layers": engine.config.n_layers,
+            "n_kv_heads": engine.config.n_kv_heads,
+            "head_dim": engine.config.head_dim_,
+            "data": data_name,
+            "blocks": [{"hash": h, "parent": p} for h, p, _ in snap],
+        }
+        tmp = os.path.join(ckpt_dir, f".manifest-{uuid.uuid4().hex[:8]}")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        old = read_manifest(ckpt_dir)
+        os.replace(tmp, os.path.join(ckpt_dir, "manifest.json"))
+        if old and old.get("data") and old["data"] != data_name:
+            try:  # best-effort cleanup of the superseded data file
+                os.unlink(os.path.join(ckpt_dir, old["data"]))
+            except OSError:
+                pass
+        logger.info("checkpointed %d KV blocks to %s", len(ids), ckpt_dir)
+        return {"blocks": len(ids), "path": ckpt_dir}
+    finally:
+        if ids:
+            engine.pool.release(ids, hashes)
+
+
+async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
+    """Restore a save_checkpoint() capture into the pool as cached content.
+    Returns the number of blocks installed (stops early when the pool is
+    dry); raises ValueError on a shape/model mismatch."""
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    for key, ours in (
+        ("model", engine.config.name),
+        ("block_size", engine.args.block_size),
+        ("n_layers", engine.config.n_layers),
+        ("n_kv_heads", engine.config.n_kv_heads),
+        ("head_dim", engine.config.head_dim_),
+    ):
+        if manifest.get(key) != ours:
+            raise ValueError(
+                f"checkpoint {key}={manifest.get(key)!r} does not match "
+                f"engine {key}={ours!r}"
+            )
+    blocks = manifest.get("blocks", [])
+    if not blocks:
+        return 0
+    data_name = manifest.get("data") or "kv_blocks.npz"
+
+    def read():  # disk read off the event loop
+        data = np.load(os.path.join(ckpt_dir, data_name))
+        return data["k"], data["v"]
+
+    k_all, v_all = await engine._device(read)
+    index_of = {b["hash"]: i for i, b in enumerate(blocks)}
+
+    # Parents-first install order (chains form a forest).
+    placed = set()
+    ordered: List[Dict[str, Any]] = []
+    pending = list(blocks)
+    while pending:
+        progressed = False
+        rest = []
+        for b in pending:
+            parent = b["parent"]
+            if (
+                parent is None
+                or parent in placed
+                or engine.pool.contains(parent)
+            ):
+                ordered.append(b)
+                placed.add(b["hash"])
+                progressed = True
+            else:
+                rest.append(b)
+        pending = rest
+        if not progressed:
+            logger.warning(
+                "checkpoint restore: %d blocks have unreachable parents",
+                len(pending),
+            )
+            break
+
+    # Split into parent-linked runs and reuse the proven disagg install
+    # path (pin/scatter/commit/rollback invariants live in ONE place).
+    installed = 0
+    i = 0
+    while i < len(ordered):
+        j = i + 1
+        while j < len(ordered) and ordered[j]["parent"] == ordered[j - 1]["hash"]:
+            j += 1
+        run = ordered[i:j]
+        sel = [index_of[b["hash"]] for b in run]
+        installed += await engine.import_blocks_async(
+            [b["hash"] for b in run], k_all[sel], v_all[sel],
+            anchor_parent=run[0]["parent"],
+        )
+        i = j
+    logger.info("restored %d KV blocks from %s", installed, ckpt_dir)
+    return installed

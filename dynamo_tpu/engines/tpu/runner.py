@@ -149,8 +149,12 @@ class DeviceRunner:
         # lazily on the first request that uses one — the common no-processor
         # path never pays for the [S, V] bookkeeping or the extra HBM reads.
         self._decode_procs_fns: Dict[bool, Any] = {}
-        # (want_procs, want_top) → lazily compiled prefill program variants.
-        self._step_fns: Dict[Tuple[bool, bool], Any] = {(False, False): self._step_fn}
+        # (want_procs, want_top, first_chunk) → lazily compiled prefill
+        # program variants. first_chunk (fresh prefill, start_pos all 0)
+        # uses dense in-chunk attention — zero paged reads.
+        self._step_fns: Dict[Tuple[bool, bool, bool], Any] = {
+            (False, False, False): self._step_fn
+        }
         self.proc_state: Optional[Any] = None  # logits_process.ProcState
         self._spec_fn: Optional[Any] = None  # speculative verify program
         self.sleep_level = 0
@@ -288,7 +292,8 @@ class DeviceRunner:
 
     # -- jitted programs ---------------------------------------------------
 
-    def _build_step_fn(self, want_procs: bool = False, want_top: bool = False):
+    def _build_step_fn(self, want_procs: bool = False, want_top: bool = False,
+                       first_chunk: bool = False):
         cfg = self.config
         use_kernel = self.use_kernel
         num_top = self.args.top_logprobs_cap if want_top else 0
@@ -306,6 +311,7 @@ class DeviceRunner:
                 k_cache, v_cache, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids,
                 mm_embeds=mm_embeds, mm_slot=mm_slot,
+                first_chunk=first_chunk,
             )
             if want_procs:
                 from dynamo_tpu.ops import logits_process as lp
@@ -437,25 +443,31 @@ class DeviceRunner:
     def run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
         adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
+        first_chunk=False,
     ):
         """One prefill/verify forward + sample. Returns (tokens, logprobs,
         top_vals | None, top_ids | None) as numpy.
 
         ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals,
         prompt_mask) per-row arrays — routes through the logits-processor
-        program. ``want_top``: also return the top-N alternatives."""
+        program. ``want_top``: also return the top-N alternatives.
+        ``first_chunk``: every row is a fresh prefill (start_pos == 0) —
+        selects the dense in-chunk attention program (no paged reads)."""
         self._mirror(
             "step", tokens=tokens, start_pos=start_pos, chunk_lens=chunk_lens,
             block_tables=block_tables, temp=temp, topk=topk, topp=topp,
             adapter_ids=adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot,
             procs=None if procs is None else list(procs), want_top=want_top,
+            first_chunk=first_chunk,
         )
         step_id = np.int32(self.rng_step & 0x7FFFFFFF)  # int32-safe wrap
         self.rng_step += 1
-        key = (procs is not None, bool(want_top))
+        key = (procs is not None, bool(want_top), bool(first_chunk))
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._build_step_fn(want_procs=key[0], want_top=key[1])
+            fn = self._build_step_fn(
+                want_procs=key[0], want_top=key[1], first_chunk=key[2]
+            )
             self._step_fns[key] = fn
         d = self._dev
         args = [

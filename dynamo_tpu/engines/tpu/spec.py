@@ -1,0 +1,141 @@
+"""Speculative decoding policy: prompt-lookup (n-gram) proposals + greedy
+verify (split from the engine monolith; the engine owns only the hook).
+
+Reference parity: the reference exposes speculative decoding as an engine
+flag riding vLLM's implementation (components/src/dynamo/vllm/args.py
+speculative config plumbing); here proposals come from a per-sequence
+n-gram index over the prompt+generation (prompt-lookup decoding) and
+verification is ONE [S, spec_k+1]-token dispatch scoring every position
+(llama.forward_paged all_logits). Greedy-only: a tick with sampling /
+logprobs / logits-processor requests falls back to the fused decode path.
+
+Measured on the v5e (BENCH_SPEC=ngram, see docs/design_docs/
+performance.md): wins on extractive/repetitive workloads where proposals
+hit; loses on random-token workloads (every miss costs a dispatch that
+fused decode would have spent on decode_steps tokens) — hence the
+``tick()`` early-outs that keep the engine on the fused path whenever
+nothing proposes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class NgramSpecDecoder:
+    """Engine-attached speculative decoder (state lives on the sequences;
+    the device program lives in the runner)."""
+
+    def __init__(self, engine: Any) -> None:
+        self.e = engine
+
+    def propose(self, seq: Any) -> List[int]:
+        """Prompt-lookup proposal: index new tokens, then continue from the
+        most recent earlier occurrence of the trailing n-gram."""
+        n = self.e.args.spec_ngram
+        toks = seq.all_tokens
+        # Incremental index: register every n-gram ENDING at p, excluding
+        # the final position (its continuation is what we're predicting).
+        for p in range(max(seq.ngram_upto, n - 1), len(toks) - 1):
+            seq.ngram_index[tuple(toks[p - n + 1 : p + 1])] = p + 1
+        seq.ngram_upto = max(len(toks) - 1, 0)
+        if len(toks) < n:
+            return []
+        cont = seq.ngram_index.get(tuple(toks[-n:]))
+        if cont is None:
+            return []
+        return toks[cont : cont + self.e.args.spec_k]
+
+    def eligible(self, active: List[Any]) -> bool:
+        for s in active:
+            sp = s.request.sampling
+            # None means DEFAULT temperature (1.0) — sampled, not greedy;
+            # only an explicit temperature <= 0 qualifies.
+            temp = sp.temperature if sp.temperature is not None else 1.0
+            if temp > 0.0 or sp.logprobs is not None:
+                return False
+            if self.e._uses_procs[s.slot]:
+                return False
+        return True
+
+    async def tick(self) -> bool:
+        """One verify dispatch over [next_token + proposals]. Returns False
+        when this tick is ineligible or nothing proposes — the fused
+        decode_steps-per-dispatch path wins whenever speculation has no
+        candidates (a 1-token verify would cost decode_steps× the
+        dispatches)."""
+        e = self.e
+        args = e.args
+        occupied = [s for s in e._slots if s is not None]
+        if not occupied:
+            return True
+        if not self.eligible(occupied):
+            return False
+        proposals: Dict[int, List[int]] = {
+            s.slot: self.propose(s) for s in occupied
+        }
+        if not any(proposals.values()):
+            return False
+
+        C = args.spec_k + 1
+        active = e._prepare_decode(C)
+        if not active:
+            return True
+        S = args.max_num_seqs
+        tokens = np.zeros((S, C), dtype=np.int32)
+        lens = np.zeros(S, dtype=np.int32)
+        max_blocks = 1
+        for seq in active:
+            slot = seq.slot
+            prop = proposals.get(slot, [])
+            # Never speculate past the model-length cap.
+            room = args.max_model_len - int(e._pos[slot]) - 1
+            prop = prop[: max(min(len(prop), room), 0)]
+            proposals[slot] = prop
+            tokens[slot, 0] = seq.next_token
+            tokens[slot, 1 : 1 + len(prop)] = prop
+            lens[slot] = 1 + len(prop)
+            max_blocks = max(
+                max_blocks,
+                (int(e._pos[slot]) + C - 1) // args.block_size + 1,
+            )
+        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
+
+        out = await e._device(
+            e._run_spec,
+            tokens,
+            e._pos.copy(),
+            lens,
+            e._block_tables[:, :nb_bucket].copy(),
+            e._adapter_ids.copy(),
+        )
+        e.steps += 1
+        for seq in list(active):
+            if seq.slot < 0:
+                continue  # finished by an earlier emit in this loop
+            slot = seq.slot
+            prop = proposals.get(slot, [])
+            row = out[slot]
+            # Accept greedy-matching proposals; the first mismatch position
+            # yields the model's own token (always ≥1 token of progress).
+            emitted = [int(row[0])]
+            for i, p in enumerate(prop):
+                if p != int(row[i]):
+                    break
+                emitted.append(int(row[i + 1]))
+            e.spec_proposed += len(prop)
+            e.spec_accepted += len(emitted) - 1
+            e._emit_burst(
+                seq, np.asarray(emitted, dtype=np.int32),
+                np.zeros(len(emitted), dtype=np.float32),
+            )
+        return True

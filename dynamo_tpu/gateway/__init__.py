@@ -1,0 +1,18 @@
+"""Inference-gateway integration: the endpoint-picker (EPP) service.
+
+Reference parity: deploy/inference-gateway — the reference ships a custom
+EPP image whose `dyn-kv` plugin embeds its router in the Gateway API
+Inference Extension endpoint picker, so KV-aware, token-aware routing
+happens at the gateway layer before the request reaches any frontend.
+
+Here the same role is an aiohttp sidecar (gateway/epp.py): the gateway
+(or any L7 proxy with an ext-proc-style hook) POSTs the request body to
+``/v1/pick``; the picker tokenizes inline, scores workers through the
+KvRouter's radix index + load model, charges the in-flight prediction,
+and returns the chosen worker as a header hint. ``/v1/complete`` is the
+router-bookkeeping op releasing the charge when the stream ends.
+"""
+
+from dynamo_tpu.gateway.epp import EndpointPicker
+
+__all__ = ["EndpointPicker"]

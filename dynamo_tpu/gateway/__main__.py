@@ -1,0 +1,70 @@
+"""EPP service entrypoint.
+
+Usage:
+  python -m dynamo_tpu.gateway --namespace prod --component backend \
+      --port 9002 [--model-dir /path/to/hf/model]
+
+Wires a KvRouter (event-plane fed) behind the pick/complete HTTP surface.
+Without --model-dir the prompt tokenizer is the deterministic test
+tokenizer (llm.tiny_tokenizer) — fine for mocker clusters; real clusters
+pass the served model's directory so gateway-side hashing matches the
+engine's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu import config
+from dynamo_tpu.gateway.epp import EndpointPicker
+from dynamo_tpu.router import KvRouter
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def main() -> None:
+    parser = argparse.ArgumentParser("dynamo-tpu endpoint picker (EPP)")
+    parser.add_argument("--namespace", default=config.NAMESPACE.get())
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--port", type=int, default=9002)
+    parser.add_argument("--model-dir", default=None,
+                        help="HF model dir for the inline tokenizer")
+    args = parser.parse_args()
+    configure_logging()
+
+    runtime = DistributedRuntime.from_settings()
+    router = KvRouter(
+        runtime, args.namespace, args.component, block_size=args.block_size
+    )
+    await router.start()
+
+    if args.model_dir:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.model_dir)
+
+        def tokenize(text: str):
+            return tok.encode(text)
+    else:
+        from dynamo_tpu.llm import tiny_tokenizer
+
+        tok = tiny_tokenizer()
+
+        def tokenize(text: str):
+            return tok.encode(text)
+
+    epp = EndpointPicker(router, tokenize, port=args.port)
+    await epp.start()
+    print(f"EPP serving on :{epp.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await epp.stop()
+        await router.stop()
+        await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,194 @@
+"""Endpoint picker (EPP): KV-aware routing at the gateway layer.
+
+Reference parity: deploy/inference-gateway/epp — the `dyn-kv` plugin runs
+the router inside the Gateway API Inference Extension picker ("moves
+intelligent routing upstream"), tokenizing the prompt inline for a
+token-aware KV algorithm, with router bookkeeping ops and header routing
+hints (README.md "Header Routing Hints" / "Router bookkeeping
+operations"). TPU-native form: a small aiohttp service over the same
+KvRouter the frontends use.
+
+Routes:
+  POST /v1/pick      {model, prompt|messages|token_ids, request_id?,
+                      lora_name?} →
+                     {worker_id, dp_rank, overlap_blocks, request_id,
+                      headers: {"x-dynamo-worker": "..."}}
+  POST /v1/complete  {request_id} → releases the in-flight charge
+  GET  /healthz
+
+Charges expire after ``charge_ttl_s`` if /complete never arrives (a
+crashed gateway hop must not poison the load model forever).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from aiohttp import web
+
+from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+WORKER_HEADER = "x-dynamo-worker"
+
+
+class EndpointPicker:
+    def __init__(
+        self,
+        router: Any,  # router.KvRouter
+        tokenize: Callable[[str], Sequence[int]],
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        charge_ttl_s: float = 600.0,
+    ) -> None:
+        self.router = router
+        self.tokenize = tokenize
+        self.host = host
+        self.port = port
+        self.charge_ttl_s = charge_ttl_s
+        # request_id → (worker, charged_blocks, report_gen, deadline)
+        self._inflight: Dict[str, Tuple[Tuple[int, int], int, Any, float]] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._sweeper: Optional[asyncio.Task] = None
+        self.picks = 0
+        self.completes = 0
+        self.expired = 0
+
+    # -- request body → token ids -----------------------------------------
+
+    def _token_ids(self, body: Dict[str, Any]) -> Optional[Sequence[int]]:
+        if isinstance(body.get("token_ids"), list):
+            return body["token_ids"]
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            return self.tokenize(prompt)
+        messages = body.get("messages")
+        if isinstance(messages, list):
+            # Token-aware routing needs the text, not the chat structure —
+            # concatenating content fields approximates the engine's
+            # template closely enough for prefix-overlap scoring.
+            parts = []
+            for m in messages:
+                c = m.get("content")
+                if isinstance(c, str):
+                    parts.append(c)
+            return self.tokenize("\n".join(parts))
+        return None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _pick(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        token_ids = self._token_ids(body)
+        if token_ids is None:
+            return web.json_response(
+                {"error": "body needs token_ids, prompt, or messages"},
+                status=400,
+            )
+        worker, overlap = self.router.find_best_match(
+            token_ids, lora_name=body.get("lora_name")
+        )
+        if worker is None:
+            return web.json_response(
+                {"error": "no workers available"}, status=503
+            )
+        request_id = str(body.get("request_id") or uuid.uuid4().hex)
+        # Release EXACTLY what select_worker charged — the net new blocks
+        # (request minus predicted overlap), guarded by the worker's load
+        # report generation so a report landing between pick and complete
+        # doesn't double-subtract (scheduler.py complete_request contract).
+        n_blocks = max(
+            len(compute_block_hashes(
+                token_ids, self.router.block_size,
+                salt=adapter_salt(body.get("lora_name")),
+            )),
+            1,
+        )
+        charged = max(n_blocks - overlap, 0)
+        gen = self.router.scheduler.report_generation(worker)
+        self._inflight[request_id] = (
+            worker, charged, gen, time.monotonic() + self.charge_ttl_s
+        )
+        self.picks += 1
+        return web.json_response({
+            "worker_id": worker[0],
+            "dp_rank": worker[1],
+            "overlap_blocks": overlap,
+            "request_id": request_id,
+            # The gateway copies these onto the upstream request; frontends
+            # (or the request-plane client) honor the pin.
+            "headers": {WORKER_HEADER: f"{worker[0]}:{worker[1]}"},
+        })
+
+    async def _complete(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            request_id = body["request_id"]
+        except Exception:
+            return web.json_response(
+                {"error": "body must be {'request_id': ...}"}, status=400
+            )
+        entry = self._inflight.pop(request_id, None)
+        if entry is None:
+            return web.json_response({"released": False}, status=404)
+        worker, charged, gen, _ = entry
+        self.router.release(worker, charged, gen)
+        self.completes += 1
+        return web.json_response({"released": True})
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "ok",
+            "picks": self.picks,
+            "completes": self.completes,
+            "inflight": len(self._inflight),
+            "expired": self.expired,
+        })
+
+    async def _sweep(self) -> None:
+        while True:
+            await asyncio.sleep(min(self.charge_ttl_s / 4, 30.0))
+            now = time.monotonic()
+            for rid in [
+                r for r, (_, _, _, d) in self._inflight.items() if d < now
+            ]:
+                worker, charged, gen, _ = self._inflight.pop(rid)
+                self.router.release(worker, charged, gen)
+                self.expired += 1
+                logger.warning("EPP charge %s expired (no /complete)", rid)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_post("/v1/pick", self._pick)
+        app.router.add_post("/v1/complete", self._complete)
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._sweeper = asyncio.get_running_loop().create_task(
+            self._sweep(), name="epp-charge-sweeper"
+        )
+        logger.info("EPP listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            try:
+                await self._sweeper
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._runner is not None:
+            await self._runner.cleanup()

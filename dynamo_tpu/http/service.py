@@ -579,6 +579,18 @@ class HttpService:
         # W3C trace propagation (ref: logging.rs:72): an incoming
         # traceparent joins the caller's trace; spans flow via baggage.
         traceparent = request.headers.get("traceparent")
+        # Gateway pin (EPP header hint, gateway/epp.py): the inference
+        # gateway already ran KV-aware selection — carry the pin to the
+        # request-plane picker. The body key is trusted-infra-only: strip
+        # anything a client smuggled into the JSON before honoring the
+        # header (otherwise any client could steer load to one worker).
+        body.pop("_pinned_worker", None)
+        pin = request.headers.get("x-dynamo-worker")
+        if pin:
+            try:
+                body["_pinned_worker"] = int(pin.split(":", 1)[0])
+            except ValueError:
+                pass
         if self._model_busy(model, entry):
             # All workers over threshold: shed before any work is queued
             # (ref: busy_threshold.rs middleware → 503).

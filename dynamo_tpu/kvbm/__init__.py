@@ -19,8 +19,10 @@ from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, TierStats
 from dynamo_tpu.kvbm.manager import OffloadFilter, TieredKvManager
 from dynamo_tpu.kvbm.remote import KvStoreHandler, RemoteTier
 from dynamo_tpu.kvbm.connector import KvConnectorLeader, KvConnectorWorker
+from dynamo_tpu.kvbm.consolidator import KvEventConsolidator
 
 __all__ = [
     "DiskTier", "HostTier", "TierStats", "OffloadFilter", "TieredKvManager",
     "KvStoreHandler", "RemoteTier", "KvConnectorLeader", "KvConnectorWorker",
+    "KvEventConsolidator",
 ]

@@ -1,0 +1,161 @@
+"""KV-event consolidator: raw engine events → clean router events.
+
+Reference parity: lib/llm/src/block_manager/kv_consolidator/tracker.rs —
+external engines (vLLM-style) emit raw per-physical-block events that are
+noisy from a router's point of view: duplicates after restarts, remove
+events for hashes never stored, interleaved store/remove churn within one
+scheduler tick, and per-rank duplication under tensor parallelism. The
+consolidator tracks the logical resident set and emits only NET changes,
+batched per flush — so the event plane and every subscribed router index
+see a compact, monotonic stream.
+
+Used by the C-ABI publisher path (native/kv_publisher.py) and any
+connector-integrated external engine; the native JaxEngine's BlockPool
+already emits clean logical events and does not need one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.engines.mock.kv_manager import KvEvent
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _Pending:
+    stored: Dict[int, Optional[int]] = field(default_factory=dict)  # h → parent
+    removed: Set[int] = field(default_factory=set)
+
+
+class KvEventConsolidator:
+    """Dedup + net-change batching for raw KV event streams.
+
+    Feed raw events with :meth:`on_raw_event` (any thread-safe single
+    consumer); call :meth:`flush` to emit the net batch downstream (e.g.
+    KvEventPublisher.on_kv_event). A store+remove of the same hash within
+    one flush window cancels out entirely; duplicate stores of a resident
+    hash and removes of a non-resident hash are dropped.
+    """
+
+    def __init__(
+        self,
+        emit: Callable[[KvEvent], None],
+        *,
+        dedup_ranks: bool = True,
+    ) -> None:
+        self._emit = emit
+        self._resident: Dict[int, Optional[int]] = {}  # hash → parent
+        self._pending = _Pending()
+        self._dedup_ranks = dedup_ranks
+        self.raw_events = 0
+        self.emitted_events = 0
+
+    # -- ingestion ---------------------------------------------------------
+
+    def on_raw_event(self, event: KvEvent, rank: int = 0) -> None:
+        """Ingest one raw event. Under TP, every rank reports the same
+        logical mutation — rank > 0 duplicates are dropped up front."""
+        self.raw_events += 1
+        if self._dedup_ranks and rank != 0:
+            return
+        if event.kind == "stored":
+            parent = event.parent_hash
+            for h in event.block_hashes:
+                if h in self._pending.removed:
+                    # remove→store within the window: net effect is store
+                    self._pending.removed.discard(h)
+                if h not in self._resident:
+                    self._pending.stored[h] = parent
+                parent = h
+        elif event.kind == "removed":
+            for h in event.block_hashes:
+                if h in self._pending.stored:
+                    # store→remove within the window: cancels out
+                    del self._pending.stored[h]
+                elif h in self._resident:
+                    self._pending.removed.add(h)
+                # never-resident removes are dropped (restart echoes)
+        elif event.kind == "cleared":
+            self._pending.stored.clear()
+            self._pending.removed = set(self._resident)
+        else:
+            logger.warning("consolidator: unknown raw event kind %r", event.kind)
+
+    # -- flush -------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Emit the net batch; returns how many events went downstream."""
+        emitted = 0
+        if self._pending.removed:
+            self._emit(
+                KvEvent(kind="removed", block_hashes=sorted(self._pending.removed))
+            )
+            for h in self._pending.removed:
+                self._resident.pop(h, None)
+            emitted += 1
+        if self._pending.stored:
+            # Group into parent-linked runs so downstream indexers get
+            # chain-shaped stores. Insertion order is USUALLY topological,
+            # but a store→remove→re-store of a parent within one window
+            # re-inserts it AFTER its children — re-sort parents-first.
+            items = self._topo_order(self._pending.stored)
+            run: List[int] = []
+            run_parent: Optional[int] = None
+            prev: Optional[int] = None
+            for h, parent in items:
+                if not run:
+                    run, run_parent = [h], parent
+                elif parent == prev:
+                    run.append(h)
+                else:
+                    self._emit(
+                        KvEvent(kind="stored", block_hashes=run,
+                                parent_hash=run_parent)
+                    )
+                    emitted += 1
+                    run, run_parent = [h], parent
+                prev = h
+            if run:
+                self._emit(
+                    KvEvent(kind="stored", block_hashes=run, parent_hash=run_parent)
+                )
+                emitted += 1
+            self._resident.update(self._pending.stored)
+        self._pending = _Pending()
+        self.emitted_events += emitted
+        return emitted
+
+    @staticmethod
+    def _topo_order(stored):
+        """[(h, parent)] with every pending parent before its children
+        (unknown/already-resident parents count as satisfied)."""
+        pending = dict(stored)
+        ordered = []
+        placed = set()
+        while pending:
+            progressed = False
+            for h, parent in list(pending.items()):
+                if parent not in pending or parent in placed:
+                    ordered.append((h, parent))
+                    placed.add(h)
+                    del pending[h]
+                    progressed = True
+            if not progressed:  # cycle (corrupt input): emit as-is
+                ordered.extend(pending.items())
+                break
+        return ordered
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def resident_blocks(self) -> int:
+        return len(self._resident)
+
+    def committed_view(self) -> List[Tuple[int, Optional[int]]]:
+        """[(hash, parent)] — plugs into KvEventPublisher.set_snapshot_fn
+        so consolidated external engines answer re-sync requests too."""
+        return list(self._resident.items())

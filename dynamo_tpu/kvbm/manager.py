@@ -21,17 +21,37 @@ logger = get_logger(__name__)
 
 @dataclass
 class OffloadFilter:
-    """Which committed blocks get offloaded (ref: offload/filter.rs).
+    """Which committed blocks get offloaded (ref: offload/filter.rs —
+    chain-depth AND frequency admission).
 
     ``min_chain_depth`` skips shallow chains (short prompts rarely reused);
-    ``max_per_burst`` bounds the per-wakeup device→host traffic.
+    ``min_frequency`` > 1 offloads a hash only once it has committed that
+    many times (the reference's count-based filter: one-shot prompts never
+    earn host space, recurring prefixes do); ``max_per_burst`` bounds the
+    per-wakeup device→host traffic. Frequency counts live in a bounded
+    LRU so the filter itself can't grow without limit.
     """
 
     min_chain_depth: int = 0
+    min_frequency: int = 1
     max_per_burst: int = 32
+    max_tracked_hashes: int = 65536
 
-    def admit(self, chain_depth: int) -> bool:
-        return chain_depth >= self.min_chain_depth
+    def __post_init__(self) -> None:
+        from collections import OrderedDict
+
+        self._counts: "OrderedDict[int, int]" = OrderedDict()
+
+    def admit(self, chain_depth: int, block_hash: Optional[int] = None) -> bool:
+        if chain_depth < self.min_chain_depth:
+            return False
+        if self.min_frequency <= 1 or block_hash is None:
+            return True
+        n = self._counts.pop(block_hash, 0) + 1
+        self._counts[block_hash] = n  # most-recently-seen last
+        while len(self._counts) > self.max_tracked_hashes:
+            self._counts.popitem(last=False)
+        return n >= self.min_frequency
 
 
 class TieredKvManager:
@@ -62,7 +82,7 @@ class TieredKvManager:
         engine.kvbm = self
 
     def notify_commit(self, block_hash: int, chain_depth: int) -> None:
-        if self.filter.admit(chain_depth) and not self.tier.contains(block_hash):
+        if self.filter.admit(chain_depth, block_hash) and not self.tier.contains(block_hash):
             self._pending.put_nowait((block_hash, chain_depth))
             self._ensure_task()
 

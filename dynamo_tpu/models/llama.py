@@ -22,7 +22,11 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.ops.attention import paged_attention, write_chunk_to_cache
+from dynamo_tpu.ops.attention import (
+    dense_chunk_attention,
+    paged_attention,
+    write_chunk_to_cache,
+)
 from dynamo_tpu.ops.lora import lora_delta
 from dynamo_tpu.ops.moe import moe_ffn
 from dynamo_tpu.ops.quant import embed_lookup, lm_head as q_lm_head, qeinsum
@@ -213,11 +217,18 @@ def decoder_layer(
     *,
     use_kernel: bool,
     adapter_ids: Optional[jnp.ndarray],
+    first_chunk: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder layer (attention + FFN, all family knobs). Shared by the
     scan-over-layers forward and the pipeline-parallel stage executor
     (parallel/pipeline.py), so every architecture behavior lives in exactly
-    one place."""
+    one place.
+
+    ``first_chunk`` (static): every row's history is the in-flight chunk
+    itself (start_pos == 0, fresh prefill) — attend densely over the
+    registers (ops/attention.dense_chunk_attention) instead of reading the
+    pages just written; the cache is still written for the decode that
+    follows. Removes ALL per-layer page DMA from fresh-prefill programs."""
     B, C = x.shape[:2]
     hd = c.head_dim_
     uo = c.rmsnorm_unit_offset
@@ -241,11 +252,17 @@ def decoder_layer(
     k_c = write_chunk_to_cache(k_c, k, block_tables, start_pos, chunk_lens)
     v_c = write_chunk_to_cache(v_c, v, block_tables, start_pos, chunk_lens)
 
-    attn = paged_attention(
-        q, k_c, v_c, block_tables, start_pos, chunk_lens,
-        use_kernel=use_kernel, sm_scale=sm_scale, window=win,
-        logit_cap=cap,
-    ).reshape(B, C, -1)
+    if first_chunk:
+        attn = dense_chunk_attention(
+            q, k, v, chunk_lens, sm_scale=sm_scale, window=win,
+            logit_cap=cap,
+        ).reshape(B, C, -1)
+    else:
+        attn = paged_attention(
+            q, k_c, v_c, block_tables, start_pos, chunk_lens,
+            use_kernel=use_kernel, sm_scale=sm_scale, window=win,
+            logit_cap=cap,
+        ).reshape(B, C, -1)
     attn_out = qeinsum("bch,hd->bcd", attn, lp["wo"]) + lora_delta(
         ll, "wo", attn, adapter_ids
     )
@@ -328,6 +345,7 @@ def forward_paged(
     mm_embeds: Optional[jnp.ndarray] = None,  # [M, d] image patch embeddings
     mm_slot: Optional[jnp.ndarray] = None,  # [B, C] int32 row into mm_embeds, -1=text
     all_logits: bool = False,  # True → logits for EVERY position [B, C, V]
+    first_chunk: bool = False,  # static: fresh prefill, dense in-chunk attention
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
     v_cache). K/V for the chunk are scattered into the pools before attending,
@@ -362,6 +380,7 @@ def forward_paged(
                 c, lp_l, ll_l, jnp.asarray(win_list[l], jnp.int32), x, cos, sin,
                 k_cache[l], v_cache[l], block_tables, start_pos, chunk_lens,
                 use_kernel=use_kernel, adapter_ids=adapter_ids,
+                first_chunk=first_chunk,
             )
             k_out.append(k_l)
             v_out.append(v_l)
@@ -378,6 +397,7 @@ def forward_paged(
                 c, lp, ll, win, x, cos, sin, k_c, v_c,
                 block_tables, start_pos, chunk_lens,
                 use_kernel=use_kernel, adapter_ids=adapter_ids,
+                first_chunk=first_chunk,
             )
             return x, (k_c, v_c)
 

@@ -12,6 +12,7 @@ from dynamo_tpu.multimodal.encoder import (
     VisionEncoderConfig,
     encode_images,
     init_vision_params,
+    load_clip_vision,
 )
 from dynamo_tpu.multimodal.handlers import (
     EncodeWorkerHandler,
@@ -23,6 +24,7 @@ __all__ = [
     "VisionEncoderConfig",
     "encode_images",
     "init_vision_params",
+    "load_clip_vision",
     "EncodeWorkerHandler",
     "MultimodalPreprocessor",
     "fetch_media",

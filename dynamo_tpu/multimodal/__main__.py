@@ -25,6 +25,9 @@ async def main() -> None:
     parser.add_argument("--namespace", default=config.NAMESPACE.get())
     parser.add_argument("--component", default="encoder")
     parser.add_argument("--endpoint", default="encode")
+    parser.add_argument("--clip-model", default=None,
+                        help="HF CLIPVisionModel checkpoint directory "
+                        "(real weights; overrides the --vit-* shape flags)")
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--patch-size", type=int, default=32)
     parser.add_argument("--vit-d-model", type=int, default=256)
@@ -46,15 +49,22 @@ async def main() -> None:
 
     configure_logging()
     runtime = DistributedRuntime.from_settings()
-    handler = EncodeWorkerHandler(
-        VisionEncoderConfig(
-            image_size=args.image_size,
-            patch_size=args.patch_size,
-            d_model=args.vit_d_model,
-            n_layers=args.vit_layers,
-            out_dim=args.llm_d_model,
+    if args.clip_model:
+        from dynamo_tpu.multimodal.encoder import load_clip_vision
+
+        params, vcfg = load_clip_vision(args.clip_model, args.llm_d_model)
+        handler = EncodeWorkerHandler(vcfg, params=params)
+        print(f"loaded CLIP vision tower from {args.clip_model}", flush=True)
+    else:
+        handler = EncodeWorkerHandler(
+            VisionEncoderConfig(
+                image_size=args.image_size,
+                patch_size=args.patch_size,
+                d_model=args.vit_d_model,
+                n_layers=args.vit_layers,
+                out_dim=args.llm_d_model,
+            )
         )
-    )
     endpoint = (
         runtime.namespace(args.namespace)
         .component(args.component)

@@ -1,21 +1,29 @@
-"""Jitted ViT vision encoder producing LLM-space image embeddings.
+"""Jitted CLIP-architecture vision encoder producing LLM-space embeddings.
 
-The encode-worker compute (ref: encode_worker_handler.py runs a vision
-tower through vLLM); here it is a compact functional ViT: patch embedding
-as one reshape+matmul (lands on the MXU), pre-norm transformer blocks, and
-a projection to the language model's d_model. Weights are random-init until
-real VLM checkpoints are mapped — the E/P/D flow, transport, and splice
-are what this stage of the build exercises end-to-end.
+The encode-worker compute (ref: components/src/dynamo/vllm/
+multimodal_handlers/encode_worker_handler.py runs a vision tower through
+vLLM); here it is a functional CLIP vision transformer — the architecture
+real VLM checkpoints (LLaVA-style) ship — executed as one jitted program:
+patch "conv" as reshape+matmul (identical math, lands on the MXU), class
+token, pre-LN blocks with q/k/v/out biases and quick-GELU MLPs, final
+post-LN, then a projection into the language model's embedding space.
+
+``load_clip_vision`` maps a real HF CLIPVisionModel safetensors checkpoint
+into this layout (parity-tested against transformers CPU in
+tests/test_multimodal.py); ``init_vision_params`` random-inits the same
+layout for shape-only tests and benches.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclass(frozen=True)
@@ -27,6 +35,7 @@ class VisionEncoderConfig:
     n_heads: int = 4
     d_ff: int = 512
     out_dim: int = 128  # language model d_model
+    layer_norm_eps: float = 1e-5
 
     @property
     def n_patches(self) -> int:
@@ -36,10 +45,24 @@ class VisionEncoderConfig:
     def patch_dim(self) -> int:
         return self.patch_size * self.patch_size * 3
 
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any], out_dim: int) -> "VisionEncoderConfig":
+        v = cfg.get("vision_config", cfg)
+        return cls(
+            image_size=v["image_size"],
+            patch_size=v["patch_size"],
+            d_model=v["hidden_size"],
+            n_layers=v["num_hidden_layers"],
+            n_heads=v["num_attention_heads"],
+            d_ff=v["intermediate_size"],
+            out_dim=out_dim,
+            layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+        )
+
 
 def init_vision_params(config: VisionEncoderConfig, key: jax.Array) -> Dict[str, Any]:
     c = config
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 10)
 
     def norm(k, shape, scale):
         return jax.random.normal(k, shape, dtype=jnp.float32) * scale
@@ -47,59 +70,166 @@ def init_vision_params(config: VisionEncoderConfig, key: jax.Array) -> Dict[str,
     L, d = c.n_layers, c.d_model
     return {
         "patch_proj": norm(keys[0], (c.patch_dim, d), c.patch_dim**-0.5),
-        "pos_embed": norm(keys[1], (c.n_patches, d), 0.02),
+        "class_embed": norm(keys[7], (d,), 0.02),
+        "pos_embed": norm(keys[1], (c.n_patches + 1, d), 0.02),
+        "pre_norm": {"w": jnp.ones((d,)), "b": jnp.zeros((d,))},
         "layers": {
-            "norm1": jnp.ones((L, d)),
-            "wqkv": norm(keys[2], (L, d, 3 * d), d**-0.5),
-            "wo": norm(keys[3], (L, d, d), d**-0.5),
-            "norm2": jnp.ones((L, d)),
+            "norm1_w": jnp.ones((L, d)), "norm1_b": jnp.zeros((L, d)),
+            "wq": norm(keys[2], (L, d, d), d**-0.5), "bq": jnp.zeros((L, d)),
+            "wk": norm(keys[8], (L, d, d), d**-0.5), "bk": jnp.zeros((L, d)),
+            "wv": norm(keys[9], (L, d, d), d**-0.5), "bv": jnp.zeros((L, d)),
+            "wo": norm(keys[3], (L, d, d), d**-0.5), "bo": jnp.zeros((L, d)),
+            "norm2_w": jnp.ones((L, d)), "norm2_b": jnp.zeros((L, d)),
             "w1": norm(keys[4], (L, d, c.d_ff), d**-0.5),
+            "b1": jnp.zeros((L, c.d_ff)),
             "w2": norm(keys[5], (L, c.d_ff, d), c.d_ff**-0.5),
+            "b2": jnp.zeros((L, d)),
         },
-        "final_norm": jnp.ones((d,)),
+        "post_norm": {"w": jnp.ones((d,)), "b": jnp.zeros((d,))},
         "out_proj": norm(keys[6], (d, c.out_dim), d**-0.5),
     }
 
 
-def _ln(x, w):
+def load_clip_vision(
+    model_dir: str, out_dim: int, *,
+    projector: Optional[np.ndarray] = None,
+):
+    """Map an HF CLIPVisionModel checkpoint → (params, config).
+
+    ``projector``: optional [d_model, out_dim] multimodal projector (e.g.
+    a LLaVA mm_projector weight); random when absent (the tower is still
+    the real checkpoint — parity holds through post_norm).
+    Ref name map: vision_model.embeddings.{patch_embedding.weight,
+    class_embedding, position_embedding.weight}, pre_layrnorm (sic, the
+    HF spelling), encoder.layers.N.{layer_norm1,self_attn.{q,k,v,out}_proj,
+    layer_norm2,mlp.{fc1,fc2}}, post_layernorm.
+    """
+    import json
+
+    from dynamo_tpu.models.hf_loader import _SafetensorsReader
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg_json = json.load(f)
+    config = VisionEncoderConfig.from_hf_config(cfg_json, out_dim)
+    r = _SafetensorsReader(model_dir)
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("vision_model.", "model.vision_model.", ""):
+            if prefix + name in r:
+                return np.asarray(r.get(prefix + name), dtype=np.float32)
+        raise KeyError(name)
+
+    L, d = config.n_layers, config.d_model
+    # Patch conv [d, 3, p, p] → matmul weight [p*p*3, d] matching the
+    # patchify reshape below ([p, p, 3] row-major per patch).
+    conv = get("embeddings.patch_embedding.weight")  # [d, 3, p, p]
+    patch_proj = conv.transpose(2, 3, 1, 0).reshape(config.patch_dim, d)
+
+    def stack(fmt: str, transpose: bool = False):
+        arrs = [get(fmt.format(i)) for i in range(L)]
+        if transpose:
+            arrs = [a.T for a in arrs]
+        return jnp.asarray(np.stack(arrs))
+
+    params = {
+        "patch_proj": jnp.asarray(patch_proj),
+        "class_embed": jnp.asarray(get("embeddings.class_embedding")),
+        "pos_embed": jnp.asarray(get("embeddings.position_embedding.weight")),
+        "pre_norm": {
+            "w": jnp.asarray(get("pre_layrnorm.weight")),
+            "b": jnp.asarray(get("pre_layrnorm.bias")),
+        },
+        "layers": {
+            "norm1_w": stack("encoder.layers.{}.layer_norm1.weight"),
+            "norm1_b": stack("encoder.layers.{}.layer_norm1.bias"),
+            "wq": stack("encoder.layers.{}.self_attn.q_proj.weight", True),
+            "bq": stack("encoder.layers.{}.self_attn.q_proj.bias"),
+            "wk": stack("encoder.layers.{}.self_attn.k_proj.weight", True),
+            "bk": stack("encoder.layers.{}.self_attn.k_proj.bias"),
+            "wv": stack("encoder.layers.{}.self_attn.v_proj.weight", True),
+            "bv": stack("encoder.layers.{}.self_attn.v_proj.bias"),
+            "wo": stack("encoder.layers.{}.self_attn.out_proj.weight", True),
+            "bo": stack("encoder.layers.{}.self_attn.out_proj.bias"),
+            "norm2_w": stack("encoder.layers.{}.layer_norm2.weight"),
+            "norm2_b": stack("encoder.layers.{}.layer_norm2.bias"),
+            "w1": stack("encoder.layers.{}.mlp.fc1.weight", True),
+            "b1": stack("encoder.layers.{}.mlp.fc1.bias"),
+            "w2": stack("encoder.layers.{}.mlp.fc2.weight", True),
+            "b2": stack("encoder.layers.{}.mlp.fc2.bias"),
+        },
+        "post_norm": {
+            "w": jnp.asarray(get("post_layernorm.weight")),
+            "b": jnp.asarray(get("post_layernorm.bias")),
+        },
+        "out_proj": (
+            jnp.asarray(np.asarray(projector, dtype=np.float32))
+            if projector is not None
+            else init_vision_params(config, jax.random.PRNGKey(0))["out_proj"]
+        ),
+    }
+    return params, config
+
+
+def _ln(x, w, b, eps):
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * w
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
 def encode_images(
     params: Dict[str, Any],
-    images: jnp.ndarray,  # [N, H, W, 3] uint8
+    images: jnp.ndarray,  # [N, H, W, 3] uint8 or pre-normalized float
     config: VisionEncoderConfig,
+    raw_hidden: bool = False,  # True → post-norm hidden states (parity)
 ) -> jnp.ndarray:
-    """[N, n_patches, out_dim] image embeddings."""
+    """[N, n_patches, out_dim] LLM-space patch embeddings (class token
+    dropped, LLaVA-style), or [N, n_patches+1, d_model] with
+    ``raw_hidden`` (the CLIPVisionModel last_hidden_state for parity)."""
     c = config
     N = images.shape[0]
     p = c.patch_size
     g = c.image_size // p
-    x = images.astype(jnp.float32) / 127.5 - 1.0
+    eps = c.layer_norm_eps
+    x = images.astype(jnp.float32)
+    if images.dtype == jnp.uint8:
+        x = x / 127.5 - 1.0
     # [N, g, p, g, p, 3] → [N, g*g, p*p*3]: patchify as a reshape, then one
     # big matmul instead of a conv (identical math, simpler tiling).
     x = x.reshape(N, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
     x = x.reshape(N, g * g, c.patch_dim)
-    x = x @ params["patch_proj"] + params["pos_embed"]
+    x = x @ params["patch_proj"]
+    cls = jnp.broadcast_to(params["class_embed"], (N, 1, c.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+    x = _ln(x, params["pre_norm"]["w"], params["pre_norm"]["b"], eps)
+
+    T = c.n_patches + 1
+    hd = c.d_model // c.n_heads
 
     def block(x, lp):
-        h = _ln(x, lp["norm1"])
-        qkv = h @ lp["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        hd = c.d_model // c.n_heads
-        q = q.reshape(N, -1, c.n_heads, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(N, -1, c.n_heads, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(N, -1, c.n_heads, hd).transpose(0, 2, 1, 3)
-        attn = jax.nn.softmax(q @ k.swapaxes(-1, -2) / hd**0.5, axis=-1)
-        o = (attn @ v).transpose(0, 2, 1, 3).reshape(N, -1, c.d_model)
-        x = x + o @ lp["wo"]
-        h = _ln(x, lp["norm2"])
-        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        h = _ln(x, lp["norm1_w"], lp["norm1_b"], eps)
+        q = h @ lp["wq"] + lp["bq"]
+        k = h @ lp["wk"] + lp["bk"]
+        v = h @ lp["wv"] + lp["bv"]
+        q = q.reshape(N, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(N, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(N, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        attn = jax.nn.softmax(q @ k.swapaxes(-1, -2) * hd**-0.5, axis=-1)
+        o = (attn @ v).transpose(0, 2, 1, 3).reshape(N, T, c.d_model)
+        x = x + o @ lp["wo"] + lp["bo"]
+        h = _ln(x, lp["norm2_w"], lp["norm2_b"], eps)
+        x = x + _quick_gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
         return x, None
 
     x, _ = jax.lax.scan(block, x, params["layers"])
-    x = _ln(x, params["final_norm"])
-    return x @ params["out_proj"]
+    # NOTE: HF CLIPVisionModel.last_hidden_state is BEFORE post_layernorm
+    # (it only normalizes the pooled CLS token), and LLaVA's projector also
+    # consumes pre-post-LN hidden states — match both. post_norm weights
+    # stay loaded for pooled-embedding use.
+    if raw_hidden:
+        return x
+    return x[:, 1:] @ params["out_proj"]  # patches only, LLM space

@@ -148,6 +148,58 @@ def _paged_attention_xla(
     return out.reshape(B, C, n_heads, head_dim).astype(q.dtype)
 
 
+def dense_chunk_attention(
+    q: jnp.ndarray,  # [B, C, n_heads, head_dim]
+    k: jnp.ndarray,  # [B, C, n_kv_heads, head_dim] — the chunk's OWN K
+    v: jnp.ndarray,  # [B, C, n_kv_heads, head_dim]
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid tokens in the chunk
+    *,
+    sm_scale: Optional[float] = None,
+    window: Any = 0,
+    logit_cap: float = 0.0,
+) -> jnp.ndarray:
+    """First-chunk attention: the whole history IS the in-flight chunk, so
+    attend densely over the registers instead of reading the pages just
+    written — zero cache DMA. Returns [B, C, n_heads, head_dim].
+
+    This is the fast path for fresh prefills (start_pos == 0, one chunk):
+    at the bench shape it removes every per-layer paged read from the
+    prefill program (the page DMAs dominated prefill time; the ISL=128
+    chunk's dense scores are a [C, C] tile the MXU eats for free).
+    Padding key columns (>= chunk_lens) are masked so valid rows are exact;
+    padding ROWS produce garbage that callers already ignore (their cache
+    writes are dropped and their logits never read)."""
+    B, C, H, D = q.shape
+    KH = k.shape[2]
+    scale = sm_scale if sm_scale is not None else D**-0.5
+    if KH != H:  # GQA: repeat kv heads into query-head groups
+        rep = H // KH
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B, H, C, D]
+    kf = k.astype(jnp.float32).transpose(0, 2, 1, 3)
+    vf = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if logit_cap:
+        s = logit_cap * jnp.tanh(s / logit_cap)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    mask = cols <= rows  # causal within the chunk
+    win = jnp.asarray(window, jnp.int32)
+    mask = mask & ((win <= 0) | (cols > rows - win))  # sliding window
+    valid = cols[None] < chunk_lens[:, None, None]  # padding keys
+    # -1e30, NOT -inf: a padding row whose window admits no valid key would
+    # softmax to NaN, and the NEXT layer's p @ v turns 0-weight × NaN-value
+    # into NaN for EVERY row (0 × NaN = NaN). With a finite sentinel the
+    # empty row degrades to a uniform average — garbage but finite, and
+    # garbage rows are never read (their cache writes drop, their logits
+    # are never selected).
+    s = jnp.where((mask[None] & valid)[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def write_chunk_to_cache(
     cache: jnp.ndarray,  # [num_blocks, block_size, KH, D]
     chunk: jnp.ndarray,  # [B, C, KH, D]

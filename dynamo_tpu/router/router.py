@@ -202,6 +202,21 @@ class KvRouter:
         """Install this router as the Client's KV-mode instance picker."""
 
         async def picker(request: Any, instances: Dict[int, Any]) -> Optional[int]:
+            # Gateway pin (the EPP's x-dynamo-worker header hint,
+            # gateway/epp.py): the upstream picker already ran the KV
+            # algorithm and charged its own bookkeeping — honor the pin
+            # when that instance is still live.
+            pin = None
+            if isinstance(request, dict):
+                pin = request.get("_pinned_worker")
+                if pin is None:
+                    pin = (request.get("extra") or {}).get("_pinned_worker")
+            else:
+                # PreprocessedRequest object (the primary HTTP path passes
+                # the dataclass itself).
+                pin = (getattr(request, "extra", None) or {}).get("_pinned_worker")
+            if pin is not None and int(pin) in instances:
+                return int(pin)
             token_ids = _token_ids_of(request)
             if token_ids is None:
                 return None  # not a preprocessed request; fall back

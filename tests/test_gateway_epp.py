@@ -1,0 +1,111 @@
+"""Endpoint-picker (EPP) service: KV-aware routing at the gateway layer.
+
+Reference parity: deploy/inference-gateway epp `dyn-kv` plugin — the test
+mirrors its contract: tokenize inline, prefer the worker whose radix index
+holds the prompt's prefix, return a header hint, and keep the in-flight
+load model balanced through the bookkeeping op.
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.gateway.epp import WORKER_HEADER, EndpointPicker
+from dynamo_tpu.router import KvEventPublisher, KvRouter
+from dynamo_tpu.router.protocols import LoadSnapshot, load_topic
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+
+def _tokenize(text: str):
+    return [ord(c) % 251 + 3 for c in text]
+
+
+async def _post(port, path, body):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://127.0.0.1:{port}{path}", json=body) as r:
+            return r.status, await r.json()
+
+
+async def test_epp_prefers_cached_worker_and_releases():
+    rt = DistributedRuntime.detached()
+    ns, comp = "gw", "backend"
+    block = 4
+    router = KvRouter(rt, ns, comp, block_size=block)
+    await router.start()
+    epp = EndpointPicker(router, _tokenize, host="127.0.0.1")
+    await epp.start()
+    try:
+        # Two live workers (load snapshots), worker 1 holds the prefix.
+        for wid in (1, 2):
+            await rt.event_plane.publish(
+                load_topic(ns, comp),
+                LoadSnapshot(worker_id=wid, total_blocks=64).to_dict(),
+            )
+        prompt = "hello world, this is a cached prefix" * 2
+        toks = _tokenize(prompt)
+        pub = KvEventPublisher(rt.event_plane, ns, comp, 1)
+        hashes = compute_block_hashes(toks, block)
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        pub.on_kv_event(KvEvent(kind="stored", block_hashes=hashes))
+        await router.wait_for_events(1)
+
+        status, body = await _post(epp.port, "/v1/pick", {"prompt": prompt})
+        assert status == 200, body
+        assert body["worker_id"] == 1
+        assert body["overlap_blocks"] >= len(hashes) - 1
+        assert body["headers"][WORKER_HEADER].startswith("1:")
+        rid = body["request_id"]
+
+        # Bookkeeping: the charge exists, then /complete releases it.
+        assert len(epp._inflight) == 1
+        status, body = await _post(epp.port, "/v1/complete", {"request_id": rid})
+        assert status == 200 and body["released"]
+        assert len(epp._inflight) == 0
+        # Double-complete is a 404, not a double release.
+        status, _ = await _post(epp.port, "/v1/complete", {"request_id": rid})
+        assert status == 404
+
+        # messages-shaped bodies tokenize too (chat traffic at the gateway).
+        status, body = await _post(
+            epp.port, "/v1/pick",
+            {"messages": [{"role": "user", "content": prompt}]},
+        )
+        assert status == 200 and body["worker_id"] == 1
+
+        # Unroutable body → 400; health reflects the counters.
+        status, _ = await _post(epp.port, "/v1/pick", {"other": 1})
+        assert status == 400
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{epp.port}/healthz") as r:
+                h = await r.json()
+        assert h["picks"] == 2 and h["completes"] == 1
+    finally:
+        await epp.stop()
+        await pub.close()
+        await router.stop()
+        await rt.shutdown(grace_period=1)
+
+
+async def test_epp_charge_ttl_expiry():
+    rt = DistributedRuntime.detached()
+    router = KvRouter(rt, "gw2", "backend", block_size=4)
+    await router.start()
+    epp = EndpointPicker(router, _tokenize, host="127.0.0.1", charge_ttl_s=0.2)
+    await epp.start()
+    try:
+        await rt.event_plane.publish(
+            load_topic("gw2", "backend"),
+            LoadSnapshot(worker_id=5, total_blocks=64).to_dict(),
+        )
+        await asyncio.sleep(0.05)
+        status, body = await _post(epp.port, "/v1/pick", {"prompt": "abcdefgh"})
+        assert status == 200
+        assert len(epp._inflight) == 1
+        await asyncio.sleep(0.5)  # sweeper interval = ttl/4
+        assert len(epp._inflight) == 0 and epp.expired == 1
+    finally:
+        await epp.stop()
+        await router.stop()
+        await rt.shutdown(grace_period=1)

@@ -213,3 +213,90 @@ async def test_remote_tier_write_behind_and_onboard_fallback():
     finally:
         await kvbm_b.close()
         await engine_b.stop()
+
+
+class TestConsolidator:
+    """Raw external-engine event streams → net router events
+    (the kv_consolidator/tracker.rs role)."""
+
+    def _collect(self):
+        out = []
+        from dynamo_tpu.kvbm.consolidator import KvEventConsolidator
+
+        return out, KvEventConsolidator(out.append)
+
+    def test_store_remove_cancels(self):
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        out, c = self._collect()
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[1, 2], parent_hash=None))
+        c.on_raw_event(KvEvent(kind="removed", block_hashes=[2]))
+        assert c.flush() == 1
+        assert out[0].kind == "stored" and out[0].block_hashes == [1]
+        assert c.resident_blocks == 1
+
+    def test_duplicate_store_and_phantom_remove_dropped(self):
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        out, c = self._collect()
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[1], parent_hash=None))
+        c.flush()
+        out.clear()
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[1], parent_hash=None))
+        c.on_raw_event(KvEvent(kind="removed", block_hashes=[99]))
+        assert c.flush() == 0
+        assert out == []
+
+    def test_tp_rank_dedup(self):
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        out, c = self._collect()
+        for rank in range(4):
+            c.on_raw_event(
+                KvEvent(kind="stored", block_hashes=[7], parent_hash=None),
+                rank=rank,
+            )
+        c.flush()
+        assert len(out) == 1 and out[0].block_hashes == [7]
+
+    def test_chain_runs_and_snapshot_view(self):
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        out, c = self._collect()
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[1, 2, 3], parent_hash=None))
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[10], parent_hash=5))
+        assert c.flush() == 2
+        assert out[0].block_hashes == [1, 2, 3] and out[0].parent_hash is None
+        assert out[1].block_hashes == [10] and out[1].parent_hash == 5
+        assert dict(c.committed_view())[2] == 1  # parent chain preserved
+
+    def test_cleared_removes_all(self):
+        from dynamo_tpu.engines.mock.kv_manager import KvEvent
+
+        out, c = self._collect()
+        c.on_raw_event(KvEvent(kind="stored", block_hashes=[1, 2], parent_hash=None))
+        c.flush()
+        out.clear()
+        c.on_raw_event(KvEvent(kind="cleared"))
+        assert c.flush() == 1
+        assert out[0].kind == "removed" and sorted(out[0].block_hashes) == [1, 2]
+        assert c.resident_blocks == 0
+
+
+class TestFrequencyFilter:
+    def test_min_frequency_gates_offload(self):
+        from dynamo_tpu.kvbm.manager import OffloadFilter
+
+        f = OffloadFilter(min_frequency=2)
+        assert not f.admit(3, block_hash=42)  # first sighting: skip
+        assert f.admit(3, block_hash=42)      # second: offload
+        assert f.admit(3, block_hash=42)      # sticky after threshold
+        assert f.admit(3)                      # no hash → depth-only check
+
+    def test_tracking_is_bounded(self):
+        from dynamo_tpu.kvbm.manager import OffloadFilter
+
+        f = OffloadFilter(min_frequency=2, max_tracked_hashes=4)
+        for h in range(10):
+            f.admit(1, block_hash=h)
+        assert len(f._counts) <= 4

@@ -148,3 +148,68 @@ def test_prefill_kernel_window_and_softcap_match_oracle():
             np.testing.assert_allclose(
                 out[b, :n], ref[b, :n], atol=2e-5, rtol=2e-5
             )
+
+
+class TestDenseChunkAttention:
+    """First-chunk dense attention must match the paged path exactly (same
+    math, zero page reads) across GQA, windows, caps, and ragged rows."""
+
+    @pytest.mark.parametrize("H,KH,window,cap", [
+        (4, 4, 0, 0.0),      # MHA full
+        (8, 2, 0, 0.0),      # GQA
+        (4, 4, 5, 0.0),      # sliding window
+        (4, 2, 0, 30.0),     # logit cap (Gemma-2)
+    ])
+    def test_matches_paged(self, H, KH, window, cap):
+        from dynamo_tpu.ops.attention import (
+            dense_chunk_attention,
+            paged_attention,
+            write_chunk_to_cache,
+        )
+
+        B, C, D = 3, 16, 32
+        NB, BS = 16, 8
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, C, KH, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, C, KH, D)), jnp.float32)
+        lens = jnp.asarray([16, 9, 1], jnp.int32)  # ragged rows
+        start = jnp.zeros((B,), jnp.int32)
+        tables = jnp.asarray(
+            np.arange(B * 2, dtype=np.int32).reshape(B, 2)
+        )
+        k_c = jnp.zeros((NB, BS, KH, D), jnp.float32)
+        v_c = jnp.zeros((NB, BS, KH, D), jnp.float32)
+        k_c = write_chunk_to_cache(k_c, k, tables, start, lens)
+        v_c = write_chunk_to_cache(v_c, v, tables, start, lens)
+        want = paged_attention(
+            q, k_c, v_c, tables, start, lens, window=window, logit_cap=cap,
+        )
+        got = dense_chunk_attention(
+            q, k, v, lens, window=window, logit_cap=cap,
+        )
+        w = np.asarray(want)
+        g = np.asarray(got)
+        for b, n in enumerate([16, 9, 1]):
+            np.testing.assert_allclose(
+                g[b, :n], w[b, :n], rtol=2e-5, atol=2e-5,
+                err_msg=f"row {b} (len {n}) diverges",
+            )
+
+    def test_empty_window_padding_rows_stay_finite_across_layers(self):
+        """Regression: a padding row whose sliding window admits no valid
+        key must not NaN — at the NEXT layer 0-weight × NaN-value poisons
+        every row (0 × NaN = NaN)."""
+        from dynamo_tpu.ops.attention import dense_chunk_attention
+
+        B, C, H, D = 1, 32, 4, 16
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+        lens = jnp.asarray([21], jnp.int32)
+        # layer 1: window 8 → rows 29.. see no valid key (cols (21..29]∩[0,21)=∅)
+        o1 = dense_chunk_attention(x, x, x, lens, window=8)
+        assert bool(jnp.isfinite(o1).all()), "layer-1 output not finite"
+        # layer 2 consumes layer 1's output as k/v: all rows must stay finite
+        o2 = dense_chunk_attention(o1, o1, o1, lens, window=0)
+        assert bool(jnp.isfinite(o2[:, :21]).all()), "valid rows poisoned"
+        assert bool(jnp.isfinite(o2).all())
