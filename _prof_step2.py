@@ -81,8 +81,3 @@ L.write_chunk_to_cache = lambda c, *a, **kw: c
 k, v = llama.init_kv_cache(cfg, NB, BS, layered=True)
 timeit("no-cache-write", make(True), params, k, v)
 L.write_chunk_to_cache = real_write
-
-# Ablation: no sampling (argmax-free): want_logprobs False already; strip
-# sampling by fixing next token = input.
-import dynamo_tpu.ops.sampling as S
-real_sample = S.sample_tokens

@@ -134,6 +134,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         llama3_8b_config,
         mixtral_8x7b_config,
         qwen2_500m_config,
+        qwen3_8b_config,
     )
     from dynamo_tpu.runtime.context import Context
 
@@ -141,6 +142,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         "qwen2.5-0.5b": qwen2_500m_config,
         "llama3-3b": llama3_3b_config,
         "llama3-8b": llama3_8b_config,
+        "qwen3-8b": qwen3_8b_config,
         "mixtral-8x7b": mixtral_8x7b_config,
     }[model_name]()
     # Measured sweep (kernel × block size × concurrency) on the real chip:
@@ -156,7 +158,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     # undersizing thrashes preemption-by-recompute (measured: 256-seq batch
     # on 256 blocks → 625 tok/s, TTFT 32s).
     default_blocks = 65536 // block_size
-    if model_name == "llama3-8b":
+    if model_name in ("llama3-8b", "qwen3-8b"):
         default_blocks = 24576 // block_size
     engine = JaxEngine(
         JaxEngineArgs(

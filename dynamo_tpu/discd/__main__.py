@@ -15,6 +15,11 @@ async def main() -> None:
     parser.add_argument("--xsub", type=int, default=6181, help="event broker XSUB port")
     parser.add_argument("--xpub", type=int, default=6182, help="event broker XPUB port")
     parser.add_argument("--no-events", action="store_true", help="discovery only")
+    parser.add_argument("--events-log", default=None,
+                        help="durable event log path (JetStream role): "
+                        "persists every event with a sequence number and "
+                        "serves replay on --replay-port")
+    parser.add_argument("--replay-port", type=int, default=6183)
     args = parser.parse_args()
 
     configure_logging()
@@ -22,11 +27,16 @@ async def main() -> None:
     await server.start()
     broker = None
     if not args.no_events:
-        broker = EventBroker(args.host, args.xsub, args.xpub)
+        broker = EventBroker(
+            args.host, args.xsub, args.xpub,
+            log_path=args.events_log,
+            replay_port=args.replay_port if args.events_log else 0,
+        )
         broker.start()
     print(
         f"discd ready: discovery {args.host}:{server.bound_port}"
-        + (f", events {broker.address}" if broker else ""),
+        + (f", events {broker.address}" if broker else "")
+        + (f", replay :{broker.replay_port}" if broker and broker.log_path else ""),
         flush=True,
     )
     try:

@@ -29,6 +29,8 @@ class ModelConfig:
     rope_theta: float = 500000.0
     max_position_embeddings: int = 8192
     qkv_bias: bool = False  # Qwen2-style
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE).
+    qk_norm: bool = False
     tie_word_embeddings: bool = False
     # MoE knobs (0 experts = dense). Covers Mixtral/Qwen-MoE/DeepSeek-lite
     # shapes: every layer's FFN becomes top-k routed experts (ops/moe.py).
@@ -128,7 +130,8 @@ class ModelConfig:
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             rope_theta=cfg.get("rope_theta", 10000.0),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
-            qkv_bias="qwen2" in arch,
+            qkv_bias="qwen2" in arch and "qwen3" not in arch,
+            qk_norm="qwen3" in arch or model_type == "qwen3",
             tie_word_embeddings=cfg.get("tie_word_embeddings", gemma),
             eos_token_ids=eos_ids,
             bos_token_id=cfg.get("bos_token_id"),
@@ -245,6 +248,28 @@ def llama3_8b_config() -> ModelConfig:
         max_position_embeddings=8192,
         eos_token_ids=[128001, 128009],
         name="llama-3-8b",
+    )
+
+
+def qwen3_8b_config() -> ModelConfig:
+    """Qwen3-8B shape (HF Qwen/Qwen3-8B config.json values): qk-norm,
+    no qkv bias, head_dim 128 — the architecture family of the reference's
+    only hard in-tree perf anchor (aiconfigurator Qwen3-32B,
+    docs/performance/aiconfigurator.md:55-59)."""
+    return ModelConfig(
+        vocab_size=151936,
+        d_model=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=12288,
+        rms_norm_eps=1e-6,
+        rope_theta=1000000.0,
+        max_position_embeddings=40960,
+        qk_norm=True,
+        eos_token_ids=[151645],
+        name="qwen3-8b",
     )
 
 

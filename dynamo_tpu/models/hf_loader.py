@@ -126,6 +126,9 @@ def load_hf_checkpoint(
         layer_map["attn_post_norm"] = ("post_attention_layernorm.weight", False)
         layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
         layer_map["mlp_post_norm"] = ("post_feedforward_layernorm.weight", False)
+    if c.qk_norm:
+        layer_map["q_norm"] = ("self_attn.q_norm.weight", False)
+        layer_map["k_norm"] = ("self_attn.k_norm.weight", False)
     layer_names = list(layer_map)
     if not c.qkv_bias:
         layer_names = [n for n in layer_names if not n.startswith("b")]

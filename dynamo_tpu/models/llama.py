@@ -82,6 +82,9 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         layers["bq"] = jnp.zeros((L, H * hd), dtype=c.dtype)
         layers["bk"] = jnp.zeros((L, KH * hd), dtype=c.dtype)
         layers["bv"] = jnp.zeros((L, KH * hd), dtype=c.dtype)
+    if c.qk_norm:
+        layers["q_norm"] = jnp.ones((L, hd), dtype=c.dtype)
+        layers["k_norm"] = jnp.ones((L, hd), dtype=c.dtype)
     params: Params = {
         "embed": norm(keys[7], (c.vocab_size, d), 1.0),
         "layers": layers,
@@ -118,6 +121,9 @@ def param_logical_axes(config: ModelConfig) -> Params:
         layers["bq"] = ("layers", "heads")
         layers["bk"] = ("layers", "kv_heads")
         layers["bv"] = ("layers", "kv_heads")
+    if config.qk_norm:
+        layers["q_norm"] = ("layers", "head_dim")
+        layers["k_norm"] = ("layers", "head_dim")
     axes: Params = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -246,6 +252,11 @@ def decoder_layer(
     q = q.reshape(B, C, c.n_heads, hd)
     k = k.reshape(B, C, c.n_kv_heads, hd)
     v = v.reshape(B, C, c.n_kv_heads, hd)
+    if c.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim on q and k, BEFORE RoPE
+        # (HF Qwen3Attention order: norm → rope).
+        q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+        k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -445,8 +456,13 @@ def encode(
         v = qeinsum("btd,dh->bth", h, lp["wv"])
         if c.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = apply_rope(q.reshape(B, T, c.n_heads, hd), cos, sin)
-        k = apply_rope(k.reshape(B, T, c.n_kv_heads, hd), cos, sin)
+        q = q.reshape(B, T, c.n_heads, hd)
+        k = k.reshape(B, T, c.n_kv_heads, hd)
+        if c.qk_norm:  # Qwen3: per-head RMSNorm before RoPE (as decoder_layer)
+            q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+            k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
         v = v.reshape(B, T, c.n_kv_heads, hd)
         G = c.q_per_kv
         qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)

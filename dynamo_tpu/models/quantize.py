@@ -140,6 +140,9 @@ def init_quantized_params(config: ModelConfig, seed: int = 0) -> Dict[str, Any]:
         layers["bq"] = fp((L, H * hd), 0.0)
         layers["bk"] = fp((L, KH * hd), 0.0)
         layers["bv"] = fp((L, KH * hd), 0.0)
+    if c.qk_norm:
+        layers["q_norm"] = fp((L, hd), 1.0)
+        layers["k_norm"] = fp((L, hd), 1.0)
     params: Dict[str, Any] = {
         "embed": q((c.vocab_size, d), 1.0, 1),
         "layers": layers,

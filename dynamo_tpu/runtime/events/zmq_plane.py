@@ -28,9 +28,22 @@ logger = get_logger(__name__)
 
 
 class EventBroker:
-    """XSUB/XPUB forwarder (the 'nats-server' of this framework)."""
+    """XSUB/XPUB forwarder (the 'nats-server' of this framework).
 
-    def __init__(self, host: str = "127.0.0.1", xsub_port: int = 0, xpub_port: int = 0) -> None:
+    With ``log_path`` set, every forwarded message is appended to a durable
+    sequence-numbered log and a REP socket answers replay requests — the
+    JetStream role (ref: lib/runtime/src/transports/nats.rs — the
+    reference's default plane persists streams so a rejoining consumer
+    resyncs from its last sequence instead of losing the gap). A broker
+    restarted over the same log continues the sequence and serves history.
+    Replay protocol (REQ/REP msgpack): {"from_seq": N, "max": M} →
+    {"events": [[seq, topic, payload], ...], "next_seq": K, "end": bool}.
+    """
+
+    def __init__(
+        self, host: str = "127.0.0.1", xsub_port: int = 0, xpub_port: int = 0,
+        *, log_path: Optional[str] = None, replay_port: int = 0,
+    ) -> None:
         self.host = host
         self._ctx = zmq.asyncio.Context.instance()
         self._xsub = self._ctx.socket(zmq.XSUB)
@@ -42,6 +55,53 @@ class EventBroker:
         if xpub_port:
             self._xpub.bind(f"tcp://{host}:{xpub_port}")
         self._task: Optional[asyncio.Task] = None
+        self._replay_task: Optional[asyncio.Task] = None
+        self.log_path = log_path
+        self._log = None
+        self.seq = 0
+        self._rep: Optional[zmq.Socket] = None
+        self.replay_port = 0
+        self._offsets: dict = {}  # seq → byte offset (O(page) replay)
+        if log_path:
+            self.seq = self._recover_log(log_path)
+            self._log = open(log_path, "ab")
+            self._rep = self._ctx.socket(zmq.REP)
+            self.replay_port = replay_port or self._rep.bind_to_random_port(
+                f"tcp://{host}"
+            )
+            if replay_port:
+                self._rep.bind(f"tcp://{host}:{replay_port}")
+
+    def _recover_log(self, log_path: str) -> int:
+        """Continue the sequence after a broker restart over the same log:
+        index every record's byte offset (O(page) replay instead of a full
+        rescan per request) and TRUNCATE any crash-torn tail — appending
+        after garbage would poison every future replay."""
+        import os
+
+        if not os.path.exists(log_path):
+            return 0
+        last = 0
+        valid_end = 0
+        try:
+            with open(log_path, "rb") as f:
+                unpacker = msgpack.Unpacker(f, raw=False, strict_map_key=False)
+                try:
+                    for rec in unpacker:
+                        self._offsets[rec[0]] = valid_end
+                        last = rec[0]
+                        valid_end = unpacker.tell()
+                except Exception:
+                    logger.warning(
+                        "event log %s has a torn tail after seq %d; truncating",
+                        log_path, last,
+                    )
+            if valid_end < os.path.getsize(log_path):
+                with open(log_path, "r+b") as f:
+                    f.truncate(valid_end)
+        except OSError:
+            logger.exception("event log %s unreadable", log_path)
+        return last
 
     def _bind_ephemeral(self, sock: zmq.Socket, port: int) -> int:
         return sock.bind_to_random_port(f"tcp://{self.host}")
@@ -57,28 +117,132 @@ class EventBroker:
                 self._forward(), name="event-broker"
             )
             logger.info("event broker on %s", self.address)
+        if self._rep is not None and self._replay_task is None:
+            self._replay_task = asyncio.get_running_loop().create_task(
+                self._serve_replay(), name="event-broker-replay"
+            )
+            logger.info("event replay on %s:%d", self.host, self.replay_port)
+
+    def _append(self, frames) -> None:
+        if self._log is None or len(frames) != 2:
+            return
+        self.seq += 1
+        self._offsets[self.seq] = self._log.tell()
+        self._log.write(
+            msgpack.packb(
+                [self.seq, frames[0].decode(), frames[1]], use_bin_type=True
+            )
+        )
+        self._log.flush()
 
     async def _forward(self) -> None:
         # Bidirectional proxy: data XSUB→XPUB, subscriptions XPUB→XSUB.
+        if self._log is not None:
+            # Durable mode must capture events even with ZERO live
+            # subscribers: publishers' PUB sockets drop messages that match
+            # no subscription, so the broker itself subscribes to
+            # everything (the upstream \\x01 subscribe-all frame).
+            await self._xsub.send(b"\x01")
         poller = zmq.asyncio.Poller()
         poller.register(self._xsub, zmq.POLLIN)
         poller.register(self._xpub, zmq.POLLIN)
         while True:
             events = dict(await poller.poll())
             if self._xsub in events:
-                await self._xpub.send_multipart(await self._xsub.recv_multipart())
+                frames = await self._xsub.recv_multipart()
+                self._append(frames)
+                await self._xpub.send_multipart(frames)
             if self._xpub in events:
                 await self._xsub.send_multipart(await self._xpub.recv_multipart())
 
-    async def close(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+    async def _serve_replay(self) -> None:
+        assert self._rep is not None
+        while True:
             try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+                req = msgpack.unpackb(await self._rep.recv(), raw=False)
+                from_seq = int(req.get("from_seq", 1))
+                limit = int(req.get("max", 1024))
+                out = []
+                # Seek straight to the page (the offset index makes a full
+                # resync O(total) instead of O(total × pages)).
+                start_seq = max(from_seq, 1)
+                while start_seq <= self.seq and start_seq not in self._offsets:
+                    start_seq += 1
+                with open(self.log_path, "rb") as f:  # type: ignore[arg-type]
+                    f.seek(self._offsets.get(start_seq, 0))
+                    unpacker = msgpack.Unpacker(
+                        f, raw=False, strict_map_key=False
+                    )
+                    for rec in unpacker:
+                        if rec[0] >= from_seq:
+                            out.append(rec)
+                            if len(out) >= limit:
+                                break
+                next_seq = (out[-1][0] + 1) if out else from_seq
+                await self._rep.send(
+                    msgpack.packb(
+                        {
+                            "events": out,
+                            "next_seq": next_seq,
+                            "end": next_seq > self.seq,
+                        },
+                        use_bin_type=True,
+                    )
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("event replay request failed")
+                try:
+                    await self._rep.send(msgpack.packb({"error": "replay failed"}))
+                except Exception:
+                    pass
+
+    async def close(self) -> None:
+        for task in (self._task, self._replay_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
         self._xsub.close(0)
         self._xpub.close(0)
+        if self._rep is not None:
+            self._rep.close(0)
+        if self._log is not None:
+            self._log.close()
+
+
+async def replay_events(
+    host: str, replay_port: int, from_seq: int = 1, *, timeout_s: float = 10.0,
+):
+    """Fetch the broker's durable history from ``from_seq`` onward. Returns
+    a list of (seq, topic, payload) — a rejoining consumer applies these
+    before switching to the live subscription (the JetStream resync flow)."""
+    ctx = zmq.asyncio.Context.instance()
+    sock = ctx.socket(zmq.REQ)
+    sock.setsockopt(zmq.RCVTIMEO, int(timeout_s * 1000))
+    sock.setsockopt(zmq.SNDTIMEO, int(timeout_s * 1000))
+    sock.connect(f"tcp://{host}:{replay_port}")
+    out = []
+    try:
+        while True:
+            await sock.send(
+                msgpack.packb({"from_seq": from_seq}, use_bin_type=True)
+            )
+            resp = msgpack.unpackb(await sock.recv(), raw=False, strict_map_key=False)
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            for seq, topic, raw in resp["events"]:
+                out.append(
+                    (seq, topic, msgpack.unpackb(raw, raw=False, strict_map_key=False))
+                )
+            from_seq = resp["next_seq"]
+            if resp["end"] or not resp["events"]:
+                return out
+    finally:
+        sock.close(0)
 
 
 class ZmqEventPlane:

@@ -13,10 +13,12 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig
 from dynamo_tpu.models.config import (
     ModelConfig,
     gemma2_2b_config,
+    llama3_3b_config,
     llama3_8b_config,
     llama3_70b_config,
     mixtral_8x7b_config,
     qwen2_500m_config,
+    qwen3_8b_config,
     tiny_config,
 )
 from dynamo_tpu.parallel import MeshConfig, make_mesh
@@ -28,6 +30,8 @@ BUILTIN_CONFIGS = {
     "tiny": tiny_config,
     "qwen2.5-0.5b": qwen2_500m_config,
     "llama-3-8b": llama3_8b_config,
+    "llama-3.2-3b": llama3_3b_config,
+    "qwen3-8b": qwen3_8b_config,
     "llama-3-70b": llama3_70b_config,
     "gemma-2-2b": gemma2_2b_config,
     "mixtral-8x7b": mixtral_8x7b_config,

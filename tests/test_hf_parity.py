@@ -392,3 +392,76 @@ def test_config_dialect_gates():
         ModelConfig.from_hf_config(
             {**base, "architectures": ["Gemma3ForCausalLM"], "model_type": "gemma3"}
         )
+
+
+# ---------------------------------------------------------------------------
+# Qwen3 (qk-norm family — the reference's in-tree perf-anchor architecture)
+# ---------------------------------------------------------------------------
+
+
+def _make_qwen3_dir(tmp_path):
+    """Tiny Qwen3: per-head q/k RMSNorm before RoPE, no qkv bias,
+    explicit head_dim — the aiconfigurator anchor family."""
+    torch.manual_seed(13)
+    cfg = transformers.Qwen3Config(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,
+        max_position_embeddings=256,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        eos_token_id=0,
+        bos_token_id=None,
+        attn_implementation="eager",
+    )
+    model = transformers.Qwen3ForCausalLM(cfg).eval().to(torch.float32)
+    model_dir = tmp_path / "qwen3-tiny"
+    model.save_pretrained(str(model_dir), safe_serialization=True)
+    _save_tokenizer(model_dir)
+    return model_dir, model
+
+
+def test_qwen3_config_dialect(tmp_path):
+    model_dir, _ = _make_qwen3_dir(tmp_path)
+    config = _our_config(model_dir)
+    assert config.qk_norm
+    assert not config.qkv_bias
+    assert config.head_dim_ == 32
+
+
+def test_qwen3_logits_parity(tmp_path):
+    model_dir, hf = _make_qwen3_dir(tmp_path)
+    config = _our_config(model_dir)
+    prompt = [3, 17, 42, 99, 5, 250, 11, 64, 7, 8, 9, 200, 13]
+    params = load_hf_checkpoint(str(model_dir), config)
+    k, v = llama.init_kv_cache(config, 16, 4)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :4] = [1, 2, 3, 4]
+    logits, _, _ = llama.forward_paged(
+        params, config,
+        jnp.asarray([prompt], dtype=jnp.int32),
+        jnp.zeros(1, jnp.int32),
+        jnp.asarray([len(prompt)], dtype=jnp.int32),
+        jnp.asarray(table), k, v,
+    )
+    with torch.no_grad():
+        ref = hf(torch.tensor([prompt])).logits[0, -1].numpy()
+    np.testing.assert_allclose(np.asarray(logits[0]), ref, rtol=2e-4, atol=2e-4)
+
+
+async def test_qwen3_checkpoint_greedy_decode_parity(tmp_path):
+    model_dir, hf = _make_qwen3_dir(tmp_path)
+    config = _our_config(model_dir)
+    engine = _engine_for(model_dir, config)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, VOCAB, size=19).tolist()
+    try:
+        ours = await _engine_greedy(engine, prompt, 10)
+    finally:
+        await engine.stop()
+    assert ours == _hf_greedy(hf, prompt, 10)
