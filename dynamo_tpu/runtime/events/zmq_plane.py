@@ -88,7 +88,8 @@ class EventBroker:
                 unpacker = msgpack.Unpacker(f, raw=False, strict_map_key=False)
                 try:
                     for rec in unpacker:
-                        self._offsets[rec[0]] = valid_end
+                        if rec[0] % self.OFFSET_STRIDE == 1 or self.OFFSET_STRIDE == 1:
+                            self._offsets[rec[0]] = valid_end
                         last = rec[0]
                         valid_end = unpacker.tell()
                 except Exception:
@@ -99,8 +100,13 @@ class EventBroker:
             if valid_end < os.path.getsize(log_path):
                 with open(log_path, "r+b") as f:
                     f.truncate(valid_end)
-        except OSError:
-            logger.exception("event log %s unreadable", log_path)
+        except OSError as exc:
+            # Continuing at seq 0 over an existing log would append
+            # DUPLICATE sequence numbers and poison every future replay —
+            # refuse to start instead.
+            raise RuntimeError(
+                f"durable event log {log_path} unreadable: {exc}"
+            ) from exc
         return last
 
     def _bind_ephemeral(self, sock: zmq.Socket, port: int) -> int:
@@ -123,11 +129,18 @@ class EventBroker:
             )
             logger.info("event replay on %s:%d", self.host, self.replay_port)
 
+    # Sparse offset index: one entry per stride bounds broker RAM on busy
+    # planes (replay scans forward from the nearest indexed record).
+    # Retention is operator-driven: rotate by restarting onto a fresh
+    # --events-log path; consumers resync via snapshot if history rotated.
+    OFFSET_STRIDE = 256
+
     def _append(self, frames) -> None:
         if self._log is None or len(frames) != 2:
             return
         self.seq += 1
-        self._offsets[self.seq] = self._log.tell()
+        if self.seq % self.OFFSET_STRIDE == 1 or self.OFFSET_STRIDE == 1:
+            self._offsets[self.seq] = self._log.tell()
         self._log.write(
             msgpack.packb(
                 [self.seq, frames[0].decode(), frames[1]], use_bin_type=True
@@ -162,12 +175,21 @@ class EventBroker:
                 req = msgpack.unpackb(await self._rep.recv(), raw=False)
                 from_seq = int(req.get("from_seq", 1))
                 limit = int(req.get("max", 1024))
+                if from_seq > self.seq:
+                    # Fully caught up: O(1) empty page, no log scan.
+                    await self._rep.send(
+                        msgpack.packb(
+                            {"events": [], "next_seq": from_seq, "end": True},
+                            use_bin_type=True,
+                        )
+                    )
+                    continue
                 out = []
-                # Seek straight to the page (the offset index makes a full
-                # resync O(total) instead of O(total × pages)).
+                # Seek to the nearest indexed record at or before from_seq
+                # (sparse index; the parse loop skips the remainder).
                 start_seq = max(from_seq, 1)
-                while start_seq <= self.seq and start_seq not in self._offsets:
-                    start_seq += 1
+                while start_seq > 1 and start_seq not in self._offsets:
+                    start_seq -= 1
                 with open(self.log_path, "rb") as f:  # type: ignore[arg-type]
                     f.seek(self._offsets.get(start_seq, 0))
                     unpacker = msgpack.Unpacker(

@@ -120,9 +120,26 @@ class SpmdFollower:
 
     def __init__(self, leader_host: str, port: int,
                  connect_timeout_s: float = 120.0) -> None:
-        self._sock = socket.create_connection(
-            (leader_host, port), timeout=connect_timeout_s
-        )
+        # The leader binds its broadcaster only after constructing its
+        # DeviceRunner (params init, cache alloc) — the follower commonly
+        # gets here first. create_connection fails INSTANTLY on
+        # ECONNREFUSED, so retry until the deadline instead of dying on
+        # the startup race.
+        import time
+
+        deadline = time.monotonic() + connect_timeout_s
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (leader_host, port), timeout=5.0
+                )
+                break
+            except (ConnectionRefusedError, ConnectionResetError, socket.timeout):
+                # NOT a broad OSError: configuration errors (gaierror on a
+                # misspelled leader host) should fail fast, not hang 120 s.
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
         self._sock.settimeout(None)  # ops arrive whenever traffic does
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
