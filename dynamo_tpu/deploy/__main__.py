@@ -51,8 +51,15 @@ async def run_operator(args) -> None:
         client = KubeClient(args.apiserver, token=args.token)
     else:
         client = KubeClient.in_cluster()
-    operator = K8sGraphOperator(client, k8s_namespace=args.k8s_namespace)
-    print(f"operator watching {args.k8s_namespace}", flush=True)
+    operator = K8sGraphOperator(
+        client, k8s_namespace=args.k8s_namespace,
+        pod_backend=args.pod_backend,
+    )
+    print(
+        f"operator watching {args.k8s_namespace} "
+        f"(actuator: {'pods' if args.pod_backend else 'processes'})",
+        flush=True,
+    )
     try:
         await operator.run()
     finally:
@@ -76,6 +83,11 @@ def main() -> None:
                    help="API base URL (default: in-cluster config)")
     p.add_argument("--token", default=None)
     p.add_argument("--k8s-namespace", default="default")
+    p.add_argument(
+        "--pod-backend", action="store_true",
+        help="actuate CR replicas as cluster pods (TPU nodeSelector + "
+        "multihost DYN_TPU_* groups) instead of node-local subprocesses",
+    )
     args = parser.parse_args()
     configure_logging()
     if args.command == "operator":

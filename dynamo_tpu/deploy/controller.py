@@ -34,12 +34,17 @@ class GraphController:
         discovery: Optional[Any] = None,  # planner desired-count source
         reconcile_interval_s: float = 2.0,
         stdout=None,
+        connector: Optional[Any] = None,  # actuator override (PodConnector)
     ) -> None:
         self.deployment = deployment
         self.discovery = discovery
         self.reconcile_interval_s = reconcile_interval_s
         env = {**os.environ, **deployment.envs}
-        self._connector = ProcessConnector(
+        # The actuator is pluggable: local supervised subprocesses by
+        # default, cluster pods when the operator hands us a PodConnector
+        # (deploy/pod_connector.py) — policy (this reconcile loop) stays
+        # identical either way.
+        self._connector = connector or ProcessConnector(
             {
                 name: RoleSpec(
                     command=svc.resolved_command(),
@@ -74,6 +79,10 @@ class GraphController:
         return counts
 
     async def reconcile_once(self) -> Dict[str, int]:
+        if hasattr(self._connector, "deployment"):
+            # Pod actuator renders from the spec: keep it on the live one
+            # (replicas-only CR updates swap self.deployment in place).
+            self._connector.deployment = self.deployment
         if self.deployment.restart_id != self._applied_restart_id:
             logger.info(
                 "restart id changed (%r → %r): rolling restart",

@@ -146,6 +146,45 @@ class KubeClient:
             content_type="application/merge-patch+json",
         )
 
+    # -- core/v1 (pods, services) -----------------------------------------
+    #
+    # Pods aren't custom resources: they live under /api/v1 rather than
+    # /apis/{group}. The pod actuator (deploy/pod_connector.py) drives
+    # exactly this slice — list-by-label, create, delete — the same calls
+    # controller-runtime issues for the reference operator's child workloads
+    # (ref: deploy/operator/internal/controller/
+    # dynamographdeployment_controller.go:110).
+
+    def _core_path(
+        self, namespace: str, plural: str, name: Optional[str] = None
+    ) -> str:
+        p = f"/api/v1/namespaces/{namespace}/{plural}"
+        if name:
+            p += f"/{name}"
+        return p
+
+    async def list_core(
+        self, namespace: str, plural: str,
+        *, label_selector: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        params = {"labelSelector": label_selector} if label_selector else None
+        doc = await self._request(
+            "GET", self._core_path(namespace, plural), params=params
+        )
+        return doc.get("items", [])
+
+    async def create_core(
+        self, namespace: str, plural: str, body: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        return await self._request(
+            "POST", self._core_path(namespace, plural), body=body
+        )
+
+    async def delete_core(self, namespace: str, plural: str, name: str) -> None:
+        await self._request(
+            "DELETE", self._core_path(namespace, plural, name)
+        )
+
     async def watch(
         self, group, version, namespace, plural,
         *, resource_version: str = "", timeout_s: float = 30.0,

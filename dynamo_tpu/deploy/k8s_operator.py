@@ -42,9 +42,11 @@ DGDR_PLURAL = "graphdeploymentrequests"
 
 
 def deployment_from_cr(cr: Dict[str, Any]) -> GraphDeployment:
-    """CR object → GraphDeployment (metadata.name names the deployment)."""
+    """CR object → GraphDeployment. metadata.name IS the deployment name
+    (kube convention): a spec-level "name" is ignored so pod labels, the
+    orphan sweep, and status all key on one identity."""
     spec = dict(cr.get("spec") or {})
-    spec.setdefault("name", cr["metadata"]["name"])
+    spec["name"] = cr["metadata"]["name"]
     return GraphDeployment.from_dict(spec)
 
 
@@ -60,6 +62,7 @@ class K8sGraphOperator:
         reconcile_interval_s: float = 1.0,
         watch_timeout_s: float = 10.0,
         sla_profiles: Optional[Any] = None,  # List[ConfigProfile] for DGDR
+        pod_backend: bool = False,  # actuate CRs as cluster pods, not procs
     ) -> None:
         self.client = client
         self.k8s_namespace = k8s_namespace
@@ -67,6 +70,7 @@ class K8sGraphOperator:
         self.reconcile_interval_s = reconcile_interval_s
         self.watch_timeout_s = watch_timeout_s
         self.sla_profiles = sla_profiles
+        self.pod_backend = pod_backend
         self._controllers: Dict[str, GraphController] = {}
         self._specs: Dict[str, str] = {}  # name → serialized spec (drift check)
         self._dgdr_done: Dict[str, str] = {}  # name → outcome
@@ -103,9 +107,17 @@ class K8sGraphOperator:
             ctrl.deployment = deployment_from_cr(cr)
         if ctrl is None:
             dep = deployment_from_cr(cr)
+            connector = None
+            if self.pod_backend:
+                from dynamo_tpu.deploy.pod_connector import PodConnector
+
+                connector = PodConnector(
+                    self.client, dep, k8s_namespace=self.k8s_namespace
+                )
             ctrl = GraphController(
                 dep, discovery=self.discovery,
                 reconcile_interval_s=self.reconcile_interval_s,
+                connector=connector,
             )
             self._controllers[name] = ctrl
         self._specs[name] = spec_key
@@ -144,6 +156,46 @@ class K8sGraphOperator:
         for name in list(self._controllers):
             if name not in seen:
                 await self._remove_cr(name)
+        if self.pod_backend:
+            await self._sweep_orphan_pods(seen)
+
+    async def _sweep_orphan_pods(self, live_crs) -> None:
+        """Delete labeled pods/services whose deployment CR is gone — the
+        role ownerReference GC plays for the reference operator's child
+        workloads. Matters after operator restart: pods survive the
+        restart (PodConnector.survives_restart), so a CR deleted while no
+        operator was watching leaves orphans only this sweep can see."""
+        from dynamo_tpu.deploy.pod_connector import LABEL_DEPLOYMENT
+
+        # Existence selector: only objects this operator family labeled
+        # (server-side filtering on a real apiserver).
+        try:
+            pods = await self.client.list_core(
+                self.k8s_namespace, "pods", label_selector=LABEL_DEPLOYMENT
+            )
+            services = await self.client.list_core(
+                self.k8s_namespace, "services",
+                label_selector=LABEL_DEPLOYMENT,
+            )
+        except KubeApiError:
+            return
+        swept = set()
+        for plural, objs in (("pods", pods), ("services", services)):
+            for obj in objs:
+                owner = (obj.get("metadata", {}).get("labels") or {}).get(
+                    LABEL_DEPLOYMENT
+                )
+                if owner and owner not in live_crs:
+                    swept.add(owner)
+                    try:
+                        await self.client.delete_core(
+                            self.k8s_namespace, plural,
+                            obj["metadata"]["name"],
+                        )
+                    except KubeApiError:
+                        pass
+        for owner in swept:
+            logger.info("swept orphaned objects of deleted CR %s", owner)
 
     # -- DGDR: SLA-profiling request → sized deployment --------------------
 
@@ -289,5 +341,10 @@ class K8sGraphOperator:
         self._tasks = []
         for name in list(self._controllers):
             ctrl = self._controllers.pop(name)
-            await ctrl.stop(teardown=teardown)
+            # Operator exit is NOT CR deletion: actuators whose workloads
+            # outlive the operator (pods) are left running for the next
+            # operator instance to re-adopt; only local subprocesses die
+            # with their supervisor.
+            survives = getattr(ctrl._connector, "survives_restart", False)
+            await ctrl.stop(teardown=teardown and not survives)
         await self.client.close()

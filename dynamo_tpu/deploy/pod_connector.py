@@ -1,0 +1,290 @@
+"""PodConnector: the operator's cluster actuator — CR services → pods.
+
+Reference parity: deploy/operator/internal/controller/
+dynamographdeployment_controller.go:110 turns a DynamoGraphDeployment CR
+into cluster workloads (Deployments / multinode pod groups via Grove/LWS);
+deploy/operator/api/v1alpha1/dynamocomponentdeployment_types.go carries the
+multinode fields. This is the TPU-shaped equivalent: each service replica
+becomes ``hosts_per_replica`` pods wired together through the
+``DYN_TPU_COORDINATOR / DYN_TPU_NUM_PROCESSES / DYN_TPU_PROCESS_ID``
+environment contract (parallel/multihost.py) — one pod per host of a
+multihost SPMD worker group, scheduled onto a TPU podslice by GKE's
+accelerator/topology node selectors.
+
+Same duck-typed surface as planner/process_connector.ProcessConnector
+(``apply_counts`` / ``counts`` / ``close``), so GraphController drives
+local subprocesses and cluster pods through one code path; which actuator
+a deployment gets is the operator's choice, not the spec's.
+
+Level-triggered: every apply lists this deployment's pods by label and
+diffs against the rendered desired set — missing pods are created,
+unexpected / failed / template-drifted pods are deleted (recreated on the
+next pass, the standard "delete and let reconcile heal" controller move).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.deploy.k8s_client import KubeApiError, KubeClient
+from dynamo_tpu.deploy.spec import GraphDeployment, ServiceSpec
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+LABEL_DEPLOYMENT = "dynamo-tpu.io/deployment"
+LABEL_SERVICE = "dynamo-tpu.io/service"
+LABEL_HASH = "dynamo-tpu.io/template-hash"
+DEFAULT_COORD_PORT = 8476
+
+# GKE TPU scheduling keys (public, documented node labels).
+GKE_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+
+def _template_hash(doc: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()
+    ).hexdigest()[:10]
+
+
+def render_pod(
+    dep: GraphDeployment,
+    svc_name: str,
+    svc: ServiceSpec,
+    replica: int,
+    host: int,
+) -> Dict[str, Any]:
+    """One pod of one host of one replica of a service.
+
+    Multihost groups (hosts_per_replica > 1) get the ``DYN_TPU_*``
+    jax.distributed contract: host 0 of the replica is the coordinator,
+    addressed by stable pod DNS (hostname + the deployment's headless
+    subdomain service)."""
+    pod_name = f"{dep.name}-{svc_name}-{replica}-{host}"
+    port = svc.port or DEFAULT_COORD_PORT
+    env = {**dep.envs, **svc.env}
+    H = max(svc.hosts_per_replica, 1)
+    if H > 1:
+        coord = f"{dep.name}-{svc_name}-{replica}-0.{dep.name}:{port}"
+        env.update(
+            DYN_TPU_COORDINATOR=coord,
+            DYN_TPU_NUM_PROCESSES=str(H),
+            DYN_TPU_PROCESS_ID=str(host),
+        )
+    node_selector = dict(svc.node_selector)
+    if svc.tpu_accelerator:
+        node_selector[GKE_ACCELERATOR] = svc.tpu_accelerator
+    if svc.tpu_topology:
+        node_selector[GKE_TOPOLOGY] = svc.tpu_topology
+    container: Dict[str, Any] = {
+        "name": svc_name,
+        "image": svc.image or dep.image,
+        "command": svc.container_command(),
+        "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
+        "ports": [{"containerPort": port}],
+    }
+    if svc.chips_per_host > 0:
+        container["resources"] = {
+            "limits": {TPU_RESOURCE: str(svc.chips_per_host)}
+        }
+    spec: Dict[str, Any] = {
+        "restartPolicy": "Never",  # the reconcile loop owns recreation
+        "containers": [container],
+        # Stable DNS through the deployment's headless service: pods of a
+        # multihost group resolve each other before they are "ready".
+        "hostname": pod_name,
+        "subdomain": dep.name,
+    }
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    body = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name,
+            "labels": {
+                LABEL_DEPLOYMENT: dep.name,
+                LABEL_SERVICE: svc_name,
+            },
+        },
+        "spec": spec,
+    }
+    body["metadata"]["labels"][LABEL_HASH] = _template_hash(body)
+    return body
+
+
+def render_headless_service(dep: GraphDeployment) -> Dict[str, Any]:
+    """Headless service named after the deployment: gives every pod the
+    ``<pod>.<deployment>.<ns>.svc`` DNS name its group coordinator env
+    points at (the role StatefulSet DNS plays for the reference's
+    multinode groups)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": dep.name,
+            "labels": {LABEL_DEPLOYMENT: dep.name},
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {LABEL_DEPLOYMENT: dep.name},
+            "ports": [{"port": DEFAULT_COORD_PORT, "name": "coord"}],
+            # Host pods must resolve the coordinator BEFORE anyone is
+            # "ready" (jax.distributed blocks startup on it) — the same
+            # reason StatefulSet/LWS publish not-ready addresses.
+            "publishNotReadyAddresses": True,
+        },
+    }
+
+
+class PodConnector:
+    """Drive one GraphDeployment's pods through the kube API."""
+
+    # Pods outlive the operator process: an operator restart must NOT tear
+    # down the cluster's workloads (only CR deletion does). The operator
+    # consults this on its own shutdown path.
+    survives_restart = True
+
+    def __init__(
+        self,
+        client: KubeClient,
+        deployment: GraphDeployment,
+        *,
+        k8s_namespace: str = "default",
+    ) -> None:
+        self.client = client
+        self.deployment = deployment
+        self.k8s_namespace = k8s_namespace
+        self._last_counts: Dict[str, int] = {}
+
+    # -- connector surface (mirrors ProcessConnector) ----------------------
+
+    def counts(self) -> Dict[str, int]:
+        """Ready replica counts from the last reconcile's observation."""
+        return dict(self._last_counts)
+
+    async def apply_counts(
+        self, desired: Dict[str, int], *, reason: str = ""
+    ) -> None:
+        dep = self.deployment
+        await self._ensure_service()
+        observed = await self.client.list_core(
+            self.k8s_namespace, "pods",
+            label_selector=f"{LABEL_DEPLOYMENT}={dep.name}",
+        )
+        by_name = {p["metadata"]["name"]: p for p in observed}
+
+        want: Dict[str, Dict[str, Any]] = {}
+        for svc_name, svc in dep.services.items():
+            n = desired.get(svc_name, svc.replicas)
+            for r in range(n):
+                for h in range(max(svc.hosts_per_replica, 1)):
+                    pod = render_pod(dep, svc_name, svc, r, h)
+                    want[pod["metadata"]["name"]] = pod
+
+        # Delete: gone-from-spec, template drift, or terminal phase.
+        deleted = set()
+        for name, pod in list(by_name.items()):
+            phase = (pod.get("status") or {}).get("phase", "")
+            desired_pod = want.get(name)
+            drifted = (
+                desired_pod is not None
+                and pod["metadata"].get("labels", {}).get(LABEL_HASH)
+                != desired_pod["metadata"]["labels"][LABEL_HASH]
+            )
+            if desired_pod is None or drifted or phase in ("Failed", "Succeeded"):
+                logger.info(
+                    "deleting pod %s (%s)", name,
+                    "scale-down" if desired_pod is None
+                    else "template-drift" if drifted else f"phase={phase}",
+                )
+                try:
+                    await self.client.delete_core(
+                        self.k8s_namespace, "pods", name
+                    )
+                except KubeApiError as exc:
+                    if exc.status != 404:
+                        raise
+                deleted.add(name)
+
+        # Create what's missing.
+        for name, pod in want.items():
+            if name in by_name and name not in deleted:
+                continue
+            try:
+                await self.client.create_core(self.k8s_namespace, "pods", pod)
+            except KubeApiError as exc:
+                if exc.status != 409:  # racing a slow delete: next pass
+                    raise
+
+        # Observe ready counts: a replica is ready when every host pod of
+        # the group is Running. Re-list only when this pass mutated pods —
+        # an idle pass's first list is already the freshest truth (halves
+        # steady-state apiserver list load at the default 1s cadence).
+        created = [n for n in want if n not in by_name or n in deleted]
+        if created or deleted:
+            observed = await self.client.list_core(
+                self.k8s_namespace, "pods",
+                label_selector=f"{LABEL_DEPLOYMENT}={dep.name}",
+            )
+        running = {
+            p["metadata"]["name"]
+            for p in observed
+            if (p.get("status") or {}).get("phase") == "Running"
+        }
+        counts: Dict[str, int] = {}
+        for svc_name, svc in dep.services.items():
+            n = desired.get(svc_name, svc.replicas)
+            H = max(svc.hosts_per_replica, 1)
+            ready = 0
+            for r in range(n):
+                if all(
+                    f"{dep.name}-{svc_name}-{r}-{h}" in running
+                    for h in range(H)
+                ):
+                    ready += 1
+            counts[svc_name] = ready
+        self._last_counts = counts
+
+    async def close(self) -> None:
+        """Teardown: delete every pod of this deployment + the headless
+        service (CR deletion semantics)."""
+        dep = self.deployment
+        try:
+            pods = await self.client.list_core(
+                self.k8s_namespace, "pods",
+                label_selector=f"{LABEL_DEPLOYMENT}={dep.name}",
+            )
+        except KubeApiError:
+            return
+        for p in pods:
+            try:
+                await self.client.delete_core(
+                    self.k8s_namespace, "pods", p["metadata"]["name"]
+                )
+            except KubeApiError:
+                pass
+        try:
+            await self.client.delete_core(
+                self.k8s_namespace, "services", dep.name
+            )
+        except KubeApiError:
+            pass
+
+    # -- internals ---------------------------------------------------------
+
+    async def _ensure_service(self) -> None:
+        # Level-triggered on every pass (409 = already there): an
+        # externally deleted service heals like any other object.
+        try:
+            await self.client.create_core(
+                self.k8s_namespace, "services",
+                render_headless_service(self.deployment),
+            )
+        except KubeApiError as exc:
+            if exc.status != 409:
+                raise

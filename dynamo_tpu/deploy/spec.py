@@ -60,6 +60,19 @@ class ServiceSpec:
     planner_scaled: bool = False
     planner_role: str = "decode"  # which count of the plan applies
     grace_period_s: float = 10.0
+    # -- pod-target fields (used by the PodConnector actuator; ignored by
+    # the local ProcessConnector). One REPLICA of a multihost worker group
+    # is hosts_per_replica pods wired together via the DYN_TPU_* contract
+    # (parallel/multihost.py), the TPU analog of the reference's
+    # multinode Grove/LWS grouping (ref: deploy/operator/api/v1alpha1/
+    # dynamocomponentdeployment_types.go multinode fields).
+    image: str = ""  # container image; "" inherits the deployment default
+    hosts_per_replica: int = 1
+    chips_per_host: int = 0  # google.com/tpu resource limit (0 = none)
+    tpu_accelerator: str = ""  # gke nodeSelector accelerator value
+    tpu_topology: str = ""  # gke nodeSelector topology value
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    port: int = 0  # containerPort + coordinator port for multihost groups
 
     def resolved_command(self) -> List[str]:
         if self.command:
@@ -72,6 +85,14 @@ class ServiceSpec:
             )
         return [sys.executable, "-m", module, *self.args]
 
+    def container_command(self) -> List[str]:
+        """Command for a POD of this service: same resolution but with a
+        bare ``python`` — the operator host's sys.executable path means
+        nothing inside the container image."""
+        if self.command:
+            return list(self.command)
+        return ["python", *self.resolved_command()[1:]]
+
 
 @dataclass
 class GraphDeployment:
@@ -83,6 +104,9 @@ class GraphDeployment:
     envs: Dict[str, str] = field(default_factory=dict)
     # restart.id change triggers a rolling restart (ref: Restart.ID)
     restart_id: str = ""
+    # default container image for pod-target services (ref: the operator's
+    # component image resolution)
+    image: str = "dynamo-tpu:latest"
 
     @classmethod
     def from_dict(cls, doc: Dict[str, Any]) -> "GraphDeployment":
@@ -97,6 +121,15 @@ class GraphDeployment:
                 planner_scaled=bool(s.get("planner_scaled", False)),
                 planner_role=s.get("planner_role", "decode"),
                 grace_period_s=float(s.get("grace_period_s", 10.0)),
+                image=s.get("image", ""),
+                hosts_per_replica=int(s.get("hosts_per_replica", 1)),
+                chips_per_host=int(s.get("chips_per_host", 0)),
+                tpu_accelerator=s.get("tpu_accelerator", ""),
+                tpu_topology=s.get("tpu_topology", ""),
+                node_selector={
+                    k: str(v) for k, v in (s.get("node_selector") or {}).items()
+                },
+                port=int(s.get("port", 0)),
             )
         dep = cls(
             name=doc.get("name", "deployment"),
@@ -104,6 +137,7 @@ class GraphDeployment:
             services=services,
             envs={k: str(v) for k, v in (doc.get("envs") or {}).items()},
             restart_id=str(doc.get("restart", {}).get("id", "")) if doc.get("restart") else "",
+            image=doc.get("image", "dynamo-tpu:latest"),
         )
         dep.validate()
         return dep

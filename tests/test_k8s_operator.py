@@ -59,7 +59,50 @@ class FakeApiServer:
             app.router.add_patch(
                 base + "/{name}/status", self._make_patch_status(plural)
             )
+        # core/v1 pods + services (the fake kubelet runs every pod at once)
+        for plural in ("pods", "services"):
+            base = f"/api/v1/namespaces/{{ns}}/{plural}"
+            app.router.add_get(base, self._make_core_list(plural))
+            app.router.add_post(base, self._make_core_create(plural))
+            app.router.add_delete(
+                base + "/{name}", self._make_delete(plural)
+            )
         return app
+
+    def _make_core_list(self, plural):
+        async def handler(request):
+            items = [
+                obj for (p, _), obj in self.store.items() if p == plural
+            ]
+            sel = request.query.get("labelSelector")
+            if sel:
+                want = dict(
+                    kv.split("=", 1) for kv in sel.split(",") if "=" in kv
+                )
+                items = [
+                    o for o in items
+                    if all(
+                        o.get("metadata", {}).get("labels", {}).get(k) == v
+                        for k, v in want.items()
+                    )
+                ]
+            return web.json_response(
+                {"items": items, "metadata": {"resourceVersion": str(self.rv)}}
+            )
+        return handler
+
+    def _make_core_create(self, plural):
+        async def handler(request):
+            obj = await request.json()
+            name = obj["metadata"]["name"]
+            if (plural, name) in self.store:
+                return web.json_response({"reason": "AlreadyExists"}, status=409)
+            if plural == "pods":
+                obj["status"] = {"phase": "Running"}  # instant fake kubelet
+            self.store[(plural, name)] = obj
+            self.bump(obj)
+            return web.json_response(obj, status=201)
+        return handler
 
     def _make_list(self, plural):
         async def handler(request):
@@ -286,6 +329,109 @@ async def test_dgdr_creates_sized_deployment():
         await op.reconcile_deployments_once()
         status = dep["status"]["services"]
         assert status["decode"]["ready"] == rec["decode_workers"], status
+    finally:
+        await op.stop()
+        await runner.cleanup()
+
+
+def pod_gd_spec(replicas: int) -> dict:
+    """A CR whose worker is a 2-host multihost group on TPU podslices."""
+    return {
+        "namespace": "k8stest",
+        "image": "dynamo-tpu:test",
+        "services": {
+            "worker": {
+                "kind": "worker",
+                "args": ["--model", "tiny"],
+                "replicas": replicas,
+                "hosts_per_replica": 2,
+                "chips_per_host": 4,
+                "tpu_accelerator": "tpu-v5-lite-podslice",
+                "tpu_topology": "2x4",
+                "port": 9001,
+            },
+            "frontend": {"kind": "frontend", "replicas": 1},
+        },
+    }
+
+
+async def test_pod_backend_renders_multihost_pods():
+    """CR (replicas=2 × 2-host worker group) → 4 worker pods + 1 frontend
+    pod with the DYN_TPU_* contract, TPU nodeSelector, and headless DNS;
+    planner-style replica patch scales pods; a deleted pod is recreated.
+    (ref: dynamographdeployment_controller.go:110 creating cluster
+    workloads; dynamocomponentdeployment_types.go multinode fields)"""
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    client = KubeClient(url)
+    op = K8sGraphOperator(client, watch_timeout_s=1.0, pod_backend=True)
+    try:
+        fake.apply(GD_PLURAL, "tpudep", pod_gd_spec(2))
+        await op.reconcile_deployments_once()
+
+        pods = {n: o for (p, n), o in fake.store.items() if p == "pods"}
+        workers = {n: o for n, o in pods.items() if "-worker-" in n}
+        assert len(workers) == 4, sorted(pods)  # 2 replicas × 2 hosts
+        assert len([n for n in pods if "-frontend-" in n]) == 1
+
+        pod = workers["tpudep-worker-1-0"]
+        env = {
+            e["name"]: e["value"]
+            for e in pod["spec"]["containers"][0]["env"]
+        }
+        assert env["DYN_TPU_COORDINATOR"] == "tpudep-worker-1-0.tpudep:9001"
+        assert env["DYN_TPU_NUM_PROCESSES"] == "2"
+        assert env["DYN_TPU_PROCESS_ID"] == "0"
+        env1 = {
+            e["name"]: e["value"]
+            for e in workers["tpudep-worker-1-1"]["spec"]["containers"][0]["env"]
+        }
+        assert env1["DYN_TPU_PROCESS_ID"] == "1"
+        assert env1["DYN_TPU_COORDINATOR"] == "tpudep-worker-1-0.tpudep:9001"
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+        res = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert res["google.com/tpu"] == "4"
+        assert pod["spec"]["containers"][0]["image"] == "dynamo-tpu:test"
+        # container command must NOT bake in the operator's interpreter path
+        assert pod["spec"]["containers"][0]["command"][0] == "python"
+        # headless service for the group DNS exists
+        assert ("services", "tpudep") in fake.store
+        # status reflects multihost-group-aware readiness
+        obj = fake.store[(GD_PLURAL, "tpudep")]
+        assert obj["status"]["services"]["worker"]["ready"] == 2
+
+        # planner/kubectl patches replicas → scale down to 1 group
+        fake.apply(GD_PLURAL, "tpudep", pod_gd_spec(1))
+        await op.reconcile_deployments_once()
+        workers = {
+            n for (p, n) in fake.store if p == "pods" and "-worker-" in n
+        }
+        assert workers == {"tpudep-worker-0-0", "tpudep-worker-0-1"}, workers
+
+        # a deleted pod is recreated on the next reconcile pass
+        del fake.store[("pods", "tpudep-worker-0-1")]
+        await op.reconcile_deployments_once()
+        assert ("pods", "tpudep-worker-0-1") in fake.store
+
+        # headless service heals if deleted out-of-band (level-triggered)
+        del fake.store[("services", "tpudep")]
+        await op.reconcile_deployments_once()
+        assert ("services", "tpudep") in fake.store
+
+        # operator shutdown is NOT CR deletion: pods survive for the next
+        # operator instance to re-adopt
+        await op.stop()
+        assert [1 for (p, _) in fake.store if p == "pods"]
+
+        # a fresh operator re-adopts, and CR deletion tears everything down
+        op = K8sGraphOperator(
+            KubeClient(url), watch_timeout_s=1.0, pod_backend=True
+        )
+        del fake.store[(GD_PLURAL, "tpudep")]
+        await op.reconcile_deployments_once()
+        assert not [1 for (p, _) in fake.store if p in ("pods", "services")]
     finally:
         await op.stop()
         await runner.cleanup()
