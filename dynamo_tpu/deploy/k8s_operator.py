@@ -71,6 +71,7 @@ class K8sGraphOperator:
         self.watch_timeout_s = watch_timeout_s
         self.sla_profiles = sla_profiles
         self.pod_backend = pod_backend
+        self._swept_orphans = False
         self._controllers: Dict[str, GraphController] = {}
         self._specs: Dict[str, str] = {}  # name → serialized spec (drift check)
         self._dgdr_done: Dict[str, str] = {}  # name → outcome
@@ -156,8 +157,12 @@ class K8sGraphOperator:
         for name in list(self._controllers):
             if name not in seen:
                 await self._remove_cr(name)
-        if self.pod_backend:
+        if self.pod_backend and not self._swept_orphans:
+            # Only the operator-was-down window can create orphans (live CR
+            # deletion tears down via _remove_cr), so one sweep at startup
+            # suffices — no per-pass namespace LIST tax.
             await self._sweep_orphan_pods(seen)
+            self._swept_orphans = True
 
     async def _sweep_orphan_pods(self, live_crs) -> None:
         """Delete labeled pods/services whose deployment CR is gone — the

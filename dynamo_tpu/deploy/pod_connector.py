@@ -160,6 +160,7 @@ class PodConnector:
         self.deployment = deployment
         self.k8s_namespace = k8s_namespace
         self._last_counts: Dict[str, int] = {}
+        self._conflicts: Dict[str, int] = {}  # pod name → consecutive 409s
 
     # -- connector surface (mirrors ProcessConnector) ----------------------
 
@@ -214,12 +215,25 @@ class PodConnector:
         # Create what's missing.
         for name, pod in want.items():
             if name in by_name and name not in deleted:
+                self._conflicts.pop(name, None)
                 continue
             try:
                 await self.client.create_core(self.k8s_namespace, "pods", pod)
+                self._conflicts.pop(name, None)
             except KubeApiError as exc:
-                if exc.status != 409:  # racing a slow delete: next pass
+                if exc.status != 409:
                     raise
+                # One 409 is a slow-delete race; repeated 409s on a pod our
+                # label-filtered list never sees mean a FOREIGN same-name
+                # pod owns the name — silent forever without this.
+                n = self._conflicts[name] = self._conflicts.get(name, 0) + 1
+                if n >= 3:
+                    logger.warning(
+                        "pod %s: %d consecutive create conflicts — a pod "
+                        "outside this deployment's labels owns the name; "
+                        "replica will stay down until it is removed",
+                        name, n,
+                    )
 
         # Observe ready counts: a replica is ready when every host pod of
         # the group is Running. Re-list only when this pass mutated pods —

@@ -7,6 +7,7 @@ content-addressed KV blocks instead of NIXL descriptors.
 
 from __future__ import annotations
 
+import os
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
@@ -89,20 +90,59 @@ class PrefillHandler:
         )
 
 
+# Target size of one streamed KV chunk. Bounds the host-memory spike and
+# the serialization stall of a transfer (a 70B-class prompt's KV is
+# hundreds of MB — as ONE message it blocks the event loop and doubles
+# peak host memory; as ~8 MB chunks it pipelines: the exporter gathers
+# chunk N+1 while chunk N is on the wire and the importer scatters chunk
+# N-1, and the importer's engine keeps serving decode ticks between
+# chunks). Ref: the reference streams device-direct chunked/overlapped
+# (lib/llm/src/block_manager/block/transfer/cuda.rs:1, lib/memory/src/nixl/).
+KV_CHUNK_BYTES = int(os.environ.get("DYN_TPU_KV_CHUNK_BYTES", 8 << 20))
+
+
 class KvTransferHandler:
     """Serve content-addressed KV block export (the 'kv' side-channel
-    endpoint; plays the role of the NIXL read target)."""
+    endpoint; plays the role of the NIXL read target).
 
-    def __init__(self, engine: Any) -> None:
+    Streams the payload as bounded chunks: each reply message carries
+    ≤ ~KV_CHUNK_BYTES of blocks plus ``done`` on the final message. Device
+    gathers happen per chunk, so HBM→host readback overlaps the previous
+    chunk's network write instead of spiking once."""
+
+    def __init__(self, engine: Any, chunk_bytes: Optional[int] = None) -> None:
         self._engine = engine
+        self.chunk_bytes = chunk_bytes or KV_CHUNK_BYTES
+
+    def _blocks_per_chunk(self) -> int:
+        cfg = self._engine.args.config
+        block_bytes = (
+            2 * cfg.n_layers * self._engine.args.block_size
+            * cfg.n_kv_heads * cfg.head_dim_ * 2
+        )
+        return max(1, self.chunk_bytes // max(block_bytes, 1))
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         hashes: List[int] = list(request.get("block_hashes") or [])
-        found, k, v = await self._engine.export_blocks_async(hashes)
-        if not found:
-            yield {"found": [], "k": None, "v": None}
-            return
-        yield {"found": found, "k": pack_array(k), "v": pack_array(v)}
+        per = self._blocks_per_chunk()
+        sent_any = False
+        for off in range(0, len(hashes), per):
+            chunk = hashes[off : off + per]
+            found, k, v = await self._engine.export_blocks_async(chunk)
+            if not found:
+                break  # chain broken (evicted): stop at the last good run
+            sent_any = True
+            done = off + per >= len(hashes) or len(found) < len(chunk)
+            yield {
+                "found": found,
+                "k": pack_array(k),
+                "v": pack_array(v),
+                "done": done,
+            }
+            if len(found) < len(chunk):
+                return
+        if not sent_any:
+            yield {"found": [], "k": None, "v": None, "done": True}
 
 
 class DecodeHandler:
@@ -115,6 +155,13 @@ class DecodeHandler:
         # async () -> Client for the prefill component's "kv" endpoint
         self._kv_client_factory = kv_client_factory
         self._kv_client = None
+        # Observability for the fallback path: a transfer failure silently
+        # converting into a second full prefill is a 2× cost bug that MUST
+        # be visible in metrics (r3 review finding).
+        self.transfers = 0
+        self.transfer_failures = 0
+        self.blocks_pulled = 0
+        self.bytes_pulled = 0
 
     async def _pull_blocks(self, dp: DisaggregatedParams) -> int:
         info = dp.kv_transfer or {}
@@ -133,21 +180,52 @@ class DecodeHandler:
         want = hashes[missing_from:]
         if self._kv_client is None:
             self._kv_client = await self._kv_client_factory()
+        self.transfers += 1
+        imported = 0
+        # The block every chunk chains from: the last resident block before
+        # the missing run, then the tail of each imported chunk.
+        anchor = hashes[missing_from - 1] if missing_from > 0 else None
         try:
+            # Chunked pull: each reply is a bounded slice, imported as it
+            # lands — device scatters and the decode loop's ticks interleave
+            # with the next chunk's network read instead of waiting for one
+            # monolithic payload.
             async for reply in self._kv_client.direct(
                 {"op": "export", "block_hashes": want}, dp.worker_id
             ):
-                if not reply.get("found"):
-                    return 0
+                found = reply.get("found") or []
+                if not found:
+                    break
                 k = unpack_array(reply["k"])
                 v = unpack_array(reply["v"])
-                return await self._engine.import_blocks_async(reply["found"], k, v)
+                n = await self._engine.import_blocks_async(
+                    found, k, v, anchor_parent=anchor
+                )
+                imported += n
+                self.blocks_pulled += n
+                self.bytes_pulled += len(reply["k"]["b"]) + len(reply["v"]["b"])
+                if n < len(found):
+                    # Pool dry mid-chunk: anchoring later chunks on an
+                    # uninstalled hash would commit children whose parent
+                    # never committed (pool invariant) and every further
+                    # chunk would transfer + scatter into a full pool.
+                    logger.warning(
+                        "KV pool dry after importing %d/%d blocks of a "
+                        "chunk; stopping the pull early", n, len(found),
+                    )
+                    break
+                anchor = found[-1]
+                if reply.get("done", True):
+                    break
         except Exception:
+            self.transfer_failures += 1
             logger.exception(
-                "KV pull from prefill worker %s failed; decoding with local prefill",
-                dp.worker_id,
+                "KV pull from prefill worker %s failed after %d blocks; "
+                "decoding with local prefill (fallback #%d — a recurring "
+                "fallback means every request pays prefill TWICE)",
+                dp.worker_id, imported, self.transfer_failures,
             )
-        return 0
+        return imported
 
     async def generate(
         self, request: Any, context: Context

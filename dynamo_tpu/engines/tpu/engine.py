@@ -104,6 +104,12 @@ class JaxEngineArgs:
     # 22.2 → 15.2 ms/step at the bench shape). Stacked remains for
     # pipeline-parallel stages that slice the layer axis.
     layered_cache: bool = True
+    # KV-cache quantization: "int8" = per-token-per-head dynamic int8 pools
+    # (ops/kv_quant.py) — halves the decode step's history-read bytes AND
+    # the decode kernel's page VMEM (batch_block 8 → 16), and doubles the
+    # sequences a fixed HBM budget can hold. The reference's
+    # kv_cache_dtype=fp8 engine lever, TPU-style. Requires layered_cache.
+    kv_cache_dtype: Optional[str] = None
 
     @property
     def max_blocks_per_seq(self) -> int:

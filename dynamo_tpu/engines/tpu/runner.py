@@ -36,19 +36,44 @@ logger = get_logger(__name__)
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_blocks(cache, idx, blocks):
-    """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on both layouts:
-    stacked [L, NB, BS, KH, D] or per-layer tuple of [NB, BS, KH, D]."""
+    """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on all layouts:
+    stacked [L, NB, BS, KH, D], per-layer tuple of [NB, BS, KH, D], or
+    per-layer int8 {"q8", "s"} pools (blocks arrive in the dequantized
+    wire format and are re-quantized here — so bf16 and int8 engines
+    interoperate over disagg/checkpoint transfers)."""
+    from dynamo_tpu.ops.kv_quant import quantize_kv_chunk
+
+    def one(c, blk):
+        if isinstance(c, dict):
+            q8, s = quantize_kv_chunk(blk)  # [n, BS, KH, D], [n, BS, KH]
+            return {
+                "q8": c["q8"].at[idx].set(q8),
+                "s": c["s"].at[idx].set(s.transpose(0, 2, 1)),
+            }
+        return c.at[idx].set(blk.astype(c.dtype))
+
     if isinstance(cache, (tuple, list)):
-        return tuple(c.at[idx].set(blocks[l]) for l, c in enumerate(cache))
-    return cache.at[:, idx].set(blocks)
+        return tuple(one(c, blocks[l]) for l, c in enumerate(cache))
+    return cache.at[:, idx].set(blocks.astype(cache.dtype))
 
 
 @jax.jit
 def _gather_blocks(cache, idx):
-    """[L, n, BS, KH, D] of blocks idx [n], from either cache layout, as ONE
-    device program (a per-layer host gather would pay L dispatch RTTs)."""
+    """[L, n, BS, KH, D] of blocks idx [n], from any cache layout, as ONE
+    device program (a per-layer host gather would pay L dispatch RTTs).
+    Int8 pools are dequantized — the wire/checkpoint format is always
+    dense [L, n, BS, KH, D]."""
+    from dynamo_tpu.ops.kv_quant import dequantize_pages
+
+    def one(c):
+        if isinstance(c, dict):
+            return dequantize_pages(
+                c["q8"][idx], c["s"][idx], jnp.bfloat16
+            )
+        return c[idx]
+
     if isinstance(cache, (tuple, list)):
-        return jnp.stack([c[idx] for c in cache])
+        return jnp.stack([one(c) for c in cache])
     return cache[:, idx]
 
 
@@ -210,14 +235,29 @@ class DeviceRunner:
         k_cache, v_cache = llama.init_kv_cache(
             self.config, self.args.num_kv_blocks, self.args.block_size,
             layered=self.args.layered_cache,
+            kv_dtype=getattr(self.args, "kv_cache_dtype", None),
         )
         if self.mesh is not None:
             if self.args.layered_cache:
                 cache_sharding = self.rules.sharding(
                     self.mesh, *llama.kv_cache_layered_axes()
                 )
-                k_cache = tuple(jax.device_put(k, cache_sharding) for k in k_cache)
-                v_cache = tuple(jax.device_put(v, cache_sharding) for v in v_cache)
+                # int8 pools are {"q8": [NB, BS, KH, D], "s": [NB, KH, BS]}
+                # dicts — the scale's kv_heads axis shards with the values.
+                s_sharding = self.rules.sharding(
+                    self.mesh, "kv_blocks", "kv_heads", None
+                )
+
+                def place(pool):
+                    if isinstance(pool, dict):
+                        return {
+                            "q8": jax.device_put(pool["q8"], cache_sharding),
+                            "s": jax.device_put(pool["s"], s_sharding),
+                        }
+                    return jax.device_put(pool, cache_sharding)
+
+                k_cache = tuple(place(k) for k in k_cache)
+                v_cache = tuple(place(v) for v in v_cache)
             else:
                 cache_sharding = self.rules.sharding(
                     self.mesh, *llama.kv_cache_logical_axes()

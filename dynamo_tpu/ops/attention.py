@@ -117,16 +117,28 @@ def _paged_attention_xla(
     q, k_cache, v_cache, block_tables, start_pos, chunk_lens,
     window=0, *, sm_scale=None, logit_cap: float = 0.0,
 ):
+    from dynamo_tpu.ops.kv_quant import dequantize_pages, is_quantized_pool
+
+    def _gather(cache, B, T, n_kv_heads, head_dim):
+        if is_quantized_pool(cache):
+            pages = cache["q8"][block_tables]  # [B, P, bs, KH, D]
+            scales = cache["s"][block_tables]  # [B, P, KH, bs]
+            return dequantize_pages(pages, scales).reshape(
+                B, T, n_kv_heads, head_dim
+            )
+        return cache[block_tables].reshape(B, T, n_kv_heads, head_dim)
+
     B, C, n_heads, head_dim = q.shape
-    num_blocks, block_size, n_kv_heads, _ = k_cache.shape
+    values = k_cache["q8"] if is_quantized_pool(k_cache) else k_cache
+    num_blocks, block_size, n_kv_heads, _ = values.shape
     max_blocks = block_tables.shape[1]
     T = max_blocks * block_size
     q_per_kv = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
 
     # Gather pages: [B, max_blocks, block_size, KH, D] → [B, T, KH, D]
-    k = k_cache[block_tables].reshape(B, T, n_kv_heads, head_dim)
-    v = v_cache[block_tables].reshape(B, T, n_kv_heads, head_dim)
+    k = _gather(k_cache, B, T, n_kv_heads, head_dim)
+    v = _gather(v_cache, B, T, n_kv_heads, head_dim)
 
     # [B, C, KH, q_per_kv, D]
     qg = q.reshape(B, C, n_kv_heads, q_per_kv, head_dim).astype(jnp.float32)
@@ -210,8 +222,12 @@ def write_chunk_to_cache(
     """Scatter a chunk of K or V into its pages. Padding positions and
     positions beyond the block table's capacity (multi-step decode overshoot
     past a stop condition) are dropped (out-of-range index + mode='drop')."""
+    from dynamo_tpu.ops.kv_quant import is_quantized_pool, quantize_kv_chunk
+
     B, C = chunk.shape[:2]
-    num_blocks, block_size = cache.shape[:2]
+    quantized = is_quantized_pool(cache)
+    values = cache["q8"] if quantized else cache
+    num_blocks, block_size = values.shape[:2]
     capacity = block_tables.shape[1] * block_size
     c_off = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
     pos = start_pos[:, None] + c_off  # [B, C]
@@ -221,4 +237,12 @@ def write_chunk_to_cache(
     )
     block_idx = jnp.where(valid, block_idx, num_blocks)  # OOB → dropped
     slot = pos % block_size
-    return cache.at[block_idx, slot].set(chunk, mode="drop")
+    if not quantized:
+        return cache.at[block_idx, slot].set(chunk, mode="drop")
+    q8, s = quantize_kv_chunk(chunk)  # [B, C, KH, D], [B, C, KH]
+    # scales live [NB, KH, bs]: the two advanced indices surround the KH
+    # slice, so the indexed result is [B, C, KH] — exactly s's shape.
+    return {
+        "q8": cache["q8"].at[block_idx, slot].set(q8, mode="drop"),
+        "s": cache["s"].at[block_idx, :, slot].set(s, mode="drop"),
+    }

@@ -52,19 +52,22 @@ def _kernel(
     start_pos_ref,  # [B] int32
     chunk_lens_ref,  # [B] int32
     window_ref,  # [1] int32 — sliding window (0 = full attention)
-    # VMEM blocks: q, then S (k, v) page pairs
+    # VMEM blocks: q, then S (k, v) page pairs — int8 caches interleave a
+    # [1, KH, bs] scale ref after each page ref (k, ks, v, vs)
     q_ref,  # [1, KH, C*G, D] (host pre-transposed: rows are (c, g), c-major)
-    *refs,  # k_0, v_0, ..., k_{S-1}, v_{S-1}, o_ref, m, l, acc
+    *refs,  # pages..., o_ref, m, l, acc
     sm_scale: float,
     block_size: int,
     n_groups: int,
     pages_per_step: int,
     logit_cap: float = 0.0,
+    quantized: bool = False,
 ):
     S = pages_per_step
-    kv_refs = refs[: 2 * S]
-    o_ref = refs[2 * S]
-    m_ref, l_ref, acc_ref = refs[2 * S + 1 :]
+    stride = 4 if quantized else 2
+    kv_refs = refs[: stride * S]
+    o_ref = refs[stride * S]
+    m_ref, l_ref, acc_ref = refs[stride * S + 1 :]
 
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -106,12 +109,25 @@ def _kernel(
 
         for h in range(KH):  # static unroll; KH is small (2-8)
             q = q_ref[0, h].astype(jnp.float32)  # [CG, D]
+            st = stride
             k = jnp.concatenate(
-                [kv_refs[2 * s][0, :, h, :] for s in range(S)], axis=0
+                [kv_refs[st * s][0, :, h, :] for s in range(S)], axis=0
             ).astype(jnp.float32)  # [W, D]
             v = jnp.concatenate(
-                [kv_refs[2 * s + 1][0, :, h, :] for s in range(S)], axis=0
+                [kv_refs[st * s + st // 2][0, :, h, :] for s in range(S)],
+                axis=0,
             ).astype(jnp.float32)  # [W, D]
+            if quantized:
+                # Per-token scales ride the score/prob rows instead of
+                # touching the [W, D] pages (ops/kv_quant.py layout).
+                ks = jnp.concatenate(
+                    [kv_refs[st * s + 1][0, h][None, :] for s in range(S)],
+                    axis=1,
+                )  # [1, W]
+                vs = jnp.concatenate(
+                    [kv_refs[st * s + 3][0, h][None, :] for s in range(S)],
+                    axis=1,
+                )  # [1, W]
 
             s_mat = (
                 jax.lax.dot_general(
@@ -120,6 +136,8 @@ def _kernel(
                 )
                 * sm_scale
             )  # [CG, W]
+            if quantized:
+                s_mat = s_mat * ks
             if logit_cap > 0.0:
                 s_mat = logit_cap * jnp.tanh(s_mat / logit_cap)
             s_mat = jnp.where(visible, s_mat, NEG_INF)
@@ -129,6 +147,8 @@ def _kernel(
             alpha = jnp.exp(m_prev - m_new)
             probs = jnp.exp(s_mat - m_new)
             l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+            if quantized:
+                probs = probs * vs
             acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
                 probs, v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -149,24 +169,29 @@ def _decode_kernel(
     block_tables_ref,  # [B, P] int32 (SMEM)
     start_pos_ref,  # [B] int32
     window_ref,  # [1] int32 — sliding window (0 = full attention)
-    # VMEM blocks: q [BQ, KH, G, D], then BQ (k, v) page pairs
+    # VMEM blocks: q [BQ, KH, G, D], then BQ (k, v) page pairs — int8
+    # caches interleave a [1, KH, bs] scale ref after each page ref
     q_ref,
-    *refs,  # k_0, v_0, ..., k_{BQ-1}, v_{BQ-1}, o_ref, m, l, acc
+    *refs,  # pages..., o_ref, m, l, acc
     sm_scale: float,
     block_size: int,
     batch_block: int,
     logit_cap: float = 0.0,
+    quantized: bool = False,
 ):
     """Decode-specialized (C=1) kernel: the grid is (B/BQ, pages) and each
     sequential grid step visits ONE page of BQ different sequences. The
     generic kernel's (B, pages) grid ran B×P tiny steps whose per-iteration
     overhead dominated decode (measured ~10µs/step ≫ the 0.5µs of compute);
     batch-blocking amortizes it BQ-fold while every page DMA stays a single
-    contiguous [bs, KH, D] transfer."""
+    contiguous [bs, KH, D] transfer. Int8 caches halve both the DMA bytes
+    and the per-page VMEM, which doubles the default batch_block (8 → 16)
+    inside the same scoped-VMEM budget."""
     BQ = batch_block
-    kv_refs = refs[: 2 * BQ]
-    o_ref = refs[2 * BQ]
-    m_ref, l_ref, acc_ref = refs[2 * BQ + 1 :]
+    stride = 4 if quantized else 2
+    kv_refs = refs[: stride * BQ]
+    o_ref = refs[stride * BQ]
+    m_ref, l_ref, acc_ref = refs[stride * BQ + 1 :]
 
     bb = pl.program_id(0)
     p = pl.program_id(1)
@@ -199,8 +224,10 @@ def _decode_kernel(
             visible = visible & ((win <= 0) | (t_idx > start - win))
             for h in range(KH):
                 q = q_ref[j, h].astype(jnp.float32)  # [G, D]
-                k = kv_refs[2 * j][0, :, h, :].astype(jnp.float32)  # [bs, D]
-                v = kv_refs[2 * j + 1][0, :, h, :].astype(jnp.float32)
+                k = kv_refs[stride * j][0, :, h, :].astype(jnp.float32)
+                v = kv_refs[stride * j + stride // 2][0, :, h, :].astype(
+                    jnp.float32
+                )
                 s_mat = (
                     jax.lax.dot_general(
                         q, k, (((1,), (1,)), ((), ())),
@@ -208,6 +235,8 @@ def _decode_kernel(
                     )
                     * sm_scale
                 )  # [G, bs]
+                if quantized:
+                    s_mat = s_mat * kv_refs[stride * j + 1][0, h][None, :]
                 if logit_cap > 0.0:
                     s_mat = logit_cap * jnp.tanh(s_mat / logit_cap)
                 s_mat = jnp.where(visible, s_mat, NEG_INF)
@@ -220,6 +249,8 @@ def _decode_kernel(
                 l_ref[j, h] = l_ref[j, h] * alpha + jnp.sum(
                     probs, axis=-1, keepdims=True
                 )
+                if quantized:
+                    probs = probs * kv_refs[stride * j + 3][0, h][None, :]
                 acc_ref[j, h] = acc_ref[j, h] * alpha + jax.lax.dot_general(
                     probs, v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
@@ -239,15 +270,15 @@ def _decode_kernel(
 )
 def paged_attention_decode_kernel(
     q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
-    v_cache: jnp.ndarray,
+    k_cache,  # [num_blocks, block_size, KH, D] — or {"q8", "s"} int8 pool
+    v_cache,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     start_pos: jnp.ndarray,  # [B] int32
     window=0,  # sliding window (int or traced scalar); 0 = full
     *,
     sm_scale: Optional[float] = None,
     interpret: bool = False,
-    batch_block: int = 8,
+    batch_block: Optional[int] = None,
     logit_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Decode-path (C=1) batch-blocked kernel. Same contract as the XLA
@@ -255,12 +286,21 @@ def paged_attention_decode_kernel(
     rows read page 0 at position 0 — one valid key, discarded output).
     With a sliding ``window``, page-group steps wholly before the window
     skip their compute (long-context decode on windowed layers gets
-    cheaper, the SWA point)."""
+    cheaper, the SWA point). Int8 pools (ops/kv_quant.py) stream half the
+    bytes and default to batch_block 16."""
+    from dynamo_tpu.ops.kv_quant import is_quantized_pool
+
+    quantized = is_quantized_pool(k_cache)
     B, C, n_heads, head_dim = q.shape
     assert C == 1, "decode kernel serves single-token steps"
-    _, block_size, n_kv_heads, _ = k_cache.shape
+    k_values = k_cache["q8"] if quantized else k_cache
+    _, block_size, n_kv_heads, _ = k_values.shape
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
+    if batch_block is None:
+        # Measured on v5e: BQ bounded by the ~16 MB scoped VMEM the per-j
+        # double-buffered page pairs occupy; int8 pages are half the size.
+        batch_block = 16 if quantized else 8
     BQ = max(min(batch_block, B), 1)
 
     B_pad = ((B + BQ - 1) // BQ) * BQ
@@ -283,12 +323,25 @@ def paged_attention_decode_kernel(
 
         return kv_map
 
+    def s_map_for(j):
+        def s_map(bb, p, bt, sp, w):
+            return (bt[bb * BQ + j, p], 0, 0)
+
+        return s_map
+
     in_specs = [pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map)]
     kv_args = []
     for j in range(BQ):
         spec = pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map_for(j))
-        in_specs.extend([spec, spec])
-        kv_args.extend([k_cache, v_cache])
+        if quantized:
+            s_spec = pl.BlockSpec((1, n_kv_heads, block_size), s_map_for(j))
+            in_specs.extend([spec, s_spec, spec, s_spec])
+            kv_args.extend(
+                [k_cache["q8"], k_cache["s"], v_cache["q8"], v_cache["s"]]
+            )
+        else:
+            in_specs.extend([spec, spec])
+            kv_args.extend([k_cache, v_cache])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -303,7 +356,7 @@ def paged_attention_decode_kernel(
     )
     kernel = functools.partial(
         _decode_kernel, sm_scale=scale, block_size=block_size, batch_block=BQ,
-        logit_cap=logit_cap,
+        logit_cap=logit_cap, quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
@@ -329,8 +382,8 @@ def paged_attention_decode_kernel(
 )
 def paged_attention_kernel(
     q: jnp.ndarray,  # [B, C, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
-    v_cache: jnp.ndarray,  # [num_blocks, block_size, n_kv_heads, head_dim]
+    k_cache,  # [num_blocks, block_size, KH, D] — or {"q8", "s"} int8 pool
+    v_cache,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     start_pos: jnp.ndarray,  # [B] int32
     chunk_lens: jnp.ndarray,  # [B] int32
@@ -346,8 +399,12 @@ def paged_attention_kernel(
 ) -> jnp.ndarray:
     """Returns [B, C, n_heads, head_dim]; same contract as the XLA oracle
     (ops/attention.py::_paged_attention_xla)."""
+    from dynamo_tpu.ops.kv_quant import is_quantized_pool
+
+    quantized = is_quantized_pool(k_cache)
     B, C, n_heads, head_dim = q.shape
-    num_blocks, block_size, n_kv_heads, _ = k_cache.shape
+    k_values = k_cache["q8"] if quantized else k_cache
+    num_blocks, block_size, n_kv_heads, _ = k_values.shape
     P = block_tables.shape[1]
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
@@ -376,14 +433,27 @@ def paged_attention_kernel(
 
         return kv_map
 
+    def s_map_for(s):
+        def s_map(b, p, bt, sp, cl, w):
+            return (bt[b, p * S + s], 0, 0)
+
+        return s_map
+
     kv_spec = lambda s: pl.BlockSpec(  # noqa: E731
         (1, block_size, n_kv_heads, head_dim), kv_map_for(s)
     )
     in_specs = [pl.BlockSpec((1, n_kv_heads, C * G, head_dim), q_map)]
     kv_args = []
     for s in range(S):
-        in_specs.extend([kv_spec(s), kv_spec(s)])
-        kv_args.extend([k_cache, v_cache])
+        if quantized:
+            sc_spec = pl.BlockSpec((1, n_kv_heads, block_size), s_map_for(s))
+            in_specs.extend([kv_spec(s), sc_spec, kv_spec(s), sc_spec])
+            kv_args.extend(
+                [k_cache["q8"], k_cache["s"], v_cache["q8"], v_cache["s"]]
+            )
+        else:
+            in_specs.extend([kv_spec(s), kv_spec(s)])
+            kv_args.extend([k_cache, v_cache])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -399,7 +469,7 @@ def paged_attention_kernel(
 
     kernel = functools.partial(
         _kernel, sm_scale=scale, block_size=block_size, n_groups=G,
-        pages_per_step=S, logit_cap=logit_cap,
+        pages_per_step=S, logit_cap=logit_cap, quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,

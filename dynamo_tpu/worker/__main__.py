@@ -114,6 +114,11 @@ async def main() -> None:
                         help="weight-only quantization (int8: per-channel, "
                         "halves weight HBM — the FP8-checkpoint deployment "
                         "lever, TPU-style)")
+    parser.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
+                        help="KV-cache quantization (int8: per-token-head "
+                        "dynamic scales — 2x KV capacity and half the "
+                        "history-read bytes; the kv_cache_dtype=fp8 engine "
+                        "lever, TPU-style)")
     parser.add_argument("--coordinator", default=None,
                         help="multi-host: host:port of rank 0's "
                         "jax.distributed coordinator (or env "
@@ -209,6 +214,7 @@ async def main() -> None:
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
         quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
     )
 
     if topo.is_multihost:

@@ -198,3 +198,77 @@ async def test_prefill_router_falls_back_without_workers():
     finally:
         await engine.stop()
         await rt.shutdown(grace_period=1)
+
+
+async def test_chunked_streamed_transfer():
+    """With chunk_bytes forced tiny, the exporter streams MANY bounded
+    messages and the importer chains chunks via anchor_parent — final
+    decode output still equals the aggregated oracle, and the handler's
+    transfer counters record the pull."""
+    rt = DistributedRuntime.detached()
+    prefill_engine = make_engine(seed=5)
+    decode_engine = make_engine(seed=5)
+    oracle_engine = make_engine(seed=5)
+    ns = rt.namespace("tchunk")
+    served = []
+    try:
+        pc = ns.component("prefill")
+        exporter = KvTransferHandler(prefill_engine, chunk_bytes=1)  # 1 block/chunk
+        assert exporter._blocks_per_chunk() == 1
+        served.append(
+            await pc.endpoint("generate").serve_endpoint(
+                PrefillHandler(prefill_engine, worker_id=1).generate,
+                instance_id=1,
+            )
+        )
+        served.append(
+            await pc.endpoint("kv").serve_endpoint(
+                exporter.generate, instance_id=1
+            )
+        )
+
+        async def kv_client():
+            return await pc.endpoint("kv").client()
+
+        dc = ns.component("backend")
+        decode_handler = DecodeHandler(decode_engine, kv_client_factory=kv_client)
+        served.append(
+            await dc.endpoint("generate").serve_endpoint(
+                decode_handler.generate, instance_id=2
+            )
+        )
+        decode_client = await dc.endpoint("generate").client()
+
+        async def prefill_client():
+            return await pc.endpoint("generate").client()
+
+        pipeline = build_pipeline(
+            [PrefillRouter(prefill_client, threshold_tokens=8)], decode_client
+        )
+
+        prompt = list(range(30, 50))  # 20 tokens: 5 full blocks
+        oracle = await collect(
+            oracle_engine.generate(req(prompt, max_tokens=8), Context())
+        )
+        oracle_toks = [t for o in oracle for t in o.token_ids]
+        out = await collect(
+            pipeline.generate(req(prompt, max_tokens=8).to_dict(), Context())
+        )
+        toks = []
+        for o in out:
+            if hasattr(o, "token_ids"):
+                toks.extend(o.token_ids or [])
+            elif isinstance(o, dict):
+                toks.extend(o.get("token_ids") or [])
+        assert toks == oracle_toks, (toks, oracle_toks)
+        # multi-chunk pull really happened and was fully imported
+        assert decode_handler.transfers == 1
+        assert decode_handler.transfer_failures == 0
+        assert decode_handler.blocks_pulled >= 4, decode_handler.blocks_pulled
+        assert decode_handler.bytes_pulled > 0
+    finally:
+        for s in served:
+            await s.shutdown()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+        await oracle_engine.stop()
