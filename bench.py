@@ -122,7 +122,9 @@ def _anchor_toks_per_sec(cfg, batch: int, avg_ctx: float, quant: str | None) -> 
 
 
 async def run_leg(model_name: str, quant: str | None, spec: str | None,
-                  concurrency: int | None = None, requests: int | None = None):
+                  concurrency: int | None = None, requests: int | None = None,
+                  kv_quant: str | None = None, isl: int | None = None,
+                  osl: int | None = None):
     from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
@@ -153,20 +155,26 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     block_size = int(os.environ.get("BENCH_BLOCK_SIZE", 128))
     concurrency = concurrency or CONCURRENCY
     requests = requests or REQUESTS
+    isl = isl or ISL
+    osl = osl or OSL
+    kv_quant = kv_quant or os.environ.get("BENCH_KV_QUANT") or None
     # 8B int8 on one 16 GB chip: ~8 GB of weights leave ~3 GB for KV, which
     # must cover concurrency × ceil((ISL+OSL)/block) blocks WITH headroom —
     # undersizing thrashes preemption-by-recompute (measured: 256-seq batch
     # on 256 blocks → 625 tok/s, TTFT 32s).
     default_blocks = 65536 // block_size
     if model_name in ("llama3-8b", "qwen3-8b"):
-        default_blocks = 24576 // block_size
+        # int8 KV halves bytes/token -> double the token budget fits the
+        # same ~3 GB beside 8 GB of int8 weights
+        budget = 49152 if kv_quant == "int8" else 24576
+        default_blocks = budget // block_size
     engine = JaxEngine(
         JaxEngineArgs(
             config=cfg,
             block_size=block_size,
             num_kv_blocks=int(os.environ.get("BENCH_KV_BLOCKS", default_blocks)),
             max_num_seqs=concurrency,
-            max_model_len=max(512, ISL + OSL + 64),
+            max_model_len=max(512, isl + osl + 64),
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 128)),
             # One admission dispatch for the whole wave: prefill rows are
             # near-free to batch (measured Bp 8→128 = 2.4× cost for 16×
@@ -185,6 +193,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
             # weights are too small for bandwidth to matter.
             quantization=quant,
             spec_mode=spec,
+            kv_cache_dtype=kv_quant,
         )
     )
 
@@ -198,16 +207,16 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     def make_req(i: int) -> PreprocessedRequest:
         if repeat_prompts:
             pattern = rng.integers(10, cfg.vocab_size - 10, size=8).tolist()
-            toks = (pattern * (ISL // 8 + 1))[:ISL]
+            toks = (pattern * (isl // 8 + 1))[:isl]
         else:
-            toks = rng.integers(10, cfg.vocab_size - 10, size=ISL).tolist()
+            toks = rng.integers(10, cfg.vocab_size - 10, size=isl).tolist()
         return PreprocessedRequest(
             token_ids=toks,
             request_id=f"bench-{i}",
             sampling=SamplingOptions(
                 temperature=0.0 if spec else 1.0, top_p=None if spec else 0.95
             ),
-            stop=StopConditions(max_tokens=OSL, ignore_eos=True),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
         )
 
     async def run_one(req):
@@ -251,7 +260,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     )
     toks_per_sec = total_tokens / wall
     stats = engine.stats()
-    avg_ctx = ISL + OSL / 2
+    avg_ctx = isl + osl / 2
     step_bytes = _decode_step_bytes(cfg, concurrency, avg_ctx, quant)
     # Our own decode roofline on this chip (ignores prefill: decode
     # dominates the wall at OSL=64) and compute utilization.
@@ -260,6 +269,10 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     return {
         "model": cfg.name,
         "quant": quant,
+        "kv_quant": kv_quant,
+        "isl": isl,
+        "osl": osl,
+        "concurrency": concurrency,
         "toks_per_sec_per_chip": round(toks_per_sec / jax.device_count(), 2),
         "total_tokens": total_tokens,
         "wall_s": round(wall, 2),
@@ -279,6 +292,187 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
             else {}
         ),
     }
+
+
+async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 8,
+                         requests: int = 24):
+    """Disaggregated P/D measurement — the north-star metric's missing
+    number (BASELINE.md: 'disaggregated Llama-3-70B'; ref methodology
+    docs/benchmarks/benchmarking.md). One chip timeshares a prefill engine
+    and a decode engine wired through the real runtime endpoints + chunked
+    KV transfer (disagg/handlers.py), vs an aggregated single-engine
+    control on the SAME workload. Reports the TTFT delta (= transfer +
+    routing overhead), the achieved export→wire→import rate, and the ITL
+    delta (decode-tick degradation while pulls overlap decode).
+
+    The model is the 0.5B bench shape: two 8B engines cannot share one
+    16 GB chip, and every cost this leg measures (gather, serialize, wire,
+    scatter, overlap) is mechanism — per-GB rates transfer to bigger
+    models; docs/design_docs/performance.md extrapolates."""
+    from dynamo_tpu.disagg import (
+        DecodeHandler,
+        KvTransferHandler,
+        PrefillHandler,
+        PrefillRouter,
+    )
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import collect
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+
+    def mk_engine():
+        return JaxEngine(
+            JaxEngineArgs(
+                config=qwen2_500m_config(),
+                block_size=128,
+                num_kv_blocks=256,
+                max_num_seqs=concurrency,
+                max_model_len=isl + osl + 64,
+                prefill_chunk=min(512, isl),
+                prefill_batch=concurrency,
+                decode_steps=32,
+            )
+        )
+
+    rng = np.random.default_rng(7)
+    V = qwen2_500m_config().vocab_size
+
+    def mk_req(i):
+        return PreprocessedRequest(
+            token_ids=rng.integers(10, V - 10, size=isl).tolist(),
+            request_id=f"disagg-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+
+    async def run_wave(gen_fn, count):
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            async with sem:
+                t0 = time.monotonic()
+                ttft, n = None, 0
+                async for out in gen_fn(mk_req(i)):
+                    ids = (
+                        out.token_ids if hasattr(out, "token_ids")
+                        else out.get("token_ids")
+                    ) or []
+                    if ids and ttft is None:
+                        ttft = time.monotonic() - t0
+                    n += len(ids)
+                return n, ttft, time.monotonic() - t0
+
+        t0 = time.monotonic()
+        res = await asyncio.gather(*(one(i) for i in range(count)))
+        return res, time.monotonic() - t0
+
+    def stats(res, wall):
+        ttfts = sorted(r[1] for r in res if r[1] is not None)
+        itls = sorted(
+            (r[2] - r[1]) / max(r[0] - 1, 1) for r in res if r[1] is not None
+        )
+        toks = sum(r[0] for r in res)
+        return {
+            "toks_per_sec": round(toks / wall, 1),
+            "p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+            "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
+        }
+
+    # -- aggregated control -------------------------------------------------
+    agg = mk_engine()
+    try:
+        await run_wave(lambda r: agg.generate(r, Context()), concurrency)
+        res, wall = await run_wave(
+            lambda r: agg.generate(r, Context()), requests
+        )
+        agg_stats = stats(res, wall)
+    finally:
+        await agg.stop()
+
+    # -- disaggregated ------------------------------------------------------
+    rt = DistributedRuntime.detached()
+    prefill_engine, decode_engine = mk_engine(), mk_engine()
+    ns = rt.namespace("bench-disagg")
+    served = []
+    try:
+        pc = ns.component("prefill")
+        served.append(
+            await pc.endpoint("generate").serve_endpoint(
+                PrefillHandler(prefill_engine, worker_id=1).generate,
+                instance_id=1,
+            )
+        )
+        served.append(
+            await pc.endpoint("kv").serve_endpoint(
+                KvTransferHandler(prefill_engine).generate, instance_id=1
+            )
+        )
+
+        async def kv_client():
+            return await pc.endpoint("kv").client()
+
+        dc = ns.component("backend")
+        decode_handler = DecodeHandler(
+            decode_engine, kv_client_factory=kv_client
+        )
+        served.append(
+            await dc.endpoint("generate").serve_endpoint(
+                decode_handler.generate, instance_id=2
+            )
+        )
+        decode_client = await dc.endpoint("generate").client()
+
+        async def prefill_client():
+            return await pc.endpoint("generate").client()
+
+        pipeline = build_pipeline(
+            [PrefillRouter(prefill_client, threshold_tokens=64)],
+            decode_client,
+        )
+
+        async def gen(r):
+            async for out in pipeline.generate(r.to_dict(), Context()):
+                yield out
+
+        await run_wave(gen, concurrency)  # warm both engines + transfer
+        warm_bytes = decode_handler.bytes_pulled
+        warm_secs = decode_handler.transfer_seconds
+        res, wall = await run_wave(gen, requests)
+        dis_stats = stats(res, wall)
+        xfer_bytes = decode_handler.bytes_pulled - warm_bytes
+        xfer_secs = decode_handler.transfer_seconds - warm_secs
+        return {
+            "mode": "disaggregated P/D (one chip timeshared)",
+            "model": "qwen2.5-0.5b",
+            "isl": isl,
+            "osl": osl,
+            "concurrency": concurrency,
+            "aggregated": agg_stats,
+            "disagg": dis_stats,
+            "ttft_delta_ms": round(
+                dis_stats["p50_ttft_ms"] - agg_stats["p50_ttft_ms"], 1
+            ),
+            "itl_delta_ms": round(
+                dis_stats["p50_itl_ms"] - agg_stats["p50_itl_ms"], 2
+            ),
+            "transfer_mb": round(xfer_bytes / 1e6, 1),
+            "transfer_mb_per_s": round(xfer_bytes / max(xfer_secs, 1e-9) / 1e6, 1),
+            "blocks_pulled": decode_handler.blocks_pulled,
+            "transfer_failures": decode_handler.transfer_failures,
+        }
+    finally:
+        for s in served:
+            await s.shutdown()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+        await rt.shutdown()
 
 
 async def run_bench():
@@ -354,6 +548,16 @@ async def run_bench():
                 / secondary["anchor_toks_per_sec"], 4,
             )
         out["secondary"] = secondary
+
+    if (
+        os.environ.get("BENCH_DISAGG", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        try:
+            out["disagg"] = await run_disagg_leg()
+        except Exception as exc:  # never kill the headline
+            out["disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(out))
 
 

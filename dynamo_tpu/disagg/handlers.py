@@ -8,6 +8,7 @@ content-addressed KV blocks instead of NIXL descriptors.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
@@ -162,6 +163,7 @@ class DecodeHandler:
         self.transfer_failures = 0
         self.blocks_pulled = 0
         self.bytes_pulled = 0
+        self.transfer_seconds = 0.0  # wall time inside pulls (GB/s metric)
 
     async def _pull_blocks(self, dp: DisaggregatedParams) -> int:
         info = dp.kv_transfer or {}
@@ -181,6 +183,7 @@ class DecodeHandler:
         if self._kv_client is None:
             self._kv_client = await self._kv_client_factory()
         self.transfers += 1
+        t0 = time.monotonic()
         imported = 0
         # The block every chunk chains from: the last resident block before
         # the missing run, then the tail of each imported chunk.
@@ -225,6 +228,7 @@ class DecodeHandler:
                 "fallback means every request pays prefill TWICE)",
                 dp.worker_id, imported, self.transfer_failures,
             )
+        self.transfer_seconds += time.monotonic() - t0
         return imported
 
     async def generate(

@@ -101,11 +101,15 @@ def load_hf_checkpoint(
     c = config
     raw = _SafetensorsReader(model_dir)
 
+    def get_first(*names: str) -> np.ndarray:
+        for n in names:
+            for prefix in ("model.", ""):
+                if prefix + n in raw:
+                    return raw.get(prefix + n)
+        raise KeyError(f"none of {names!r} in {model_dir}")
+
     def get(name: str) -> np.ndarray:
-        for prefix in ("model.", ""):
-            if prefix + name in raw:
-                return raw.get(prefix + name)
-        raise KeyError(f"missing tensor {name!r} in {model_dir}")
+        return get_first(name)
 
     def to_np(a: np.ndarray, transpose: bool) -> np.ndarray:
         if a.dtype == np.uint16:  # bf16 stored raw
@@ -132,8 +136,79 @@ def load_hf_checkpoint(
     layer_names = list(layer_map)
     if not c.qkv_bias:
         layer_names = [n for n in layer_names if not n.startswith("b")]
+    if c.is_moe:
+        # Expert FFNs replace the dense MLP (mapped separately below).
+        layer_names = [
+            n for n in layer_names if n not in ("w_gate", "w_up", "w_down")
+        ]
+        layer_names += ["router_w", "we_gate", "we_up", "we_down"]
+        if any("shared_expert" in n for n in raw.names()):
+            # Qwen1.5/Qwen2-MoE carry a shared expert the routed forward
+            # (ops/moe.py) does not model; loading would silently drop
+            # those weights and serve wrong logits.
+            raise ValueError(
+                "checkpoint has shared-expert tensors (Qwen1.5/Qwen2-MoE "
+                "layout); shared experts are not supported — serve a "
+                "routed-experts-only family (Mixtral layout)"
+            )
     layers: Dict[str, List[Any]] = {n: [] for n in layer_names}
+
+    def moe_layer(i: int) -> Dict[str, Any]:
+        """Map one MoE layer: Mixtral (block_sparse_moe.gate +
+        experts.{e}.w1/w3/w2) or Qwen-MoE (mlp.gate + experts.{e}.
+        gate_proj/up_proj/down_proj) naming (ref: the reference serves
+        these checkpoints through its engines — recipes/deepseek-r1/
+        README.md:9-12 headlines MoE; HF layouts are the public contract).
+
+        Experts are processed ONE AT A TIME (quantized or cast before the
+        next is touched): a Mixtral-8x7B layer's experts are ~1.4 B params
+        — materializing them all in fp32 would be ~5.6 GB of host RAM per
+        layer."""
+        L = f"layers.{i}"
+        router = to_np(
+            get_first(
+                f"{L}.block_sparse_moe.gate.weight", f"{L}.mlp.gate.weight"
+            ),
+            True,
+        )  # [d, E]
+        out: Dict[str, Any] = {"router_w": jnp.asarray(router).astype(c.dtype)}
+        hf_names = {
+            "we_gate": ("w1", "gate_proj"),
+            "we_up": ("w3", "up_proj"),
+            "we_down": ("w2", "down_proj"),
+        }
+        for ours, (mixtral, qwen) in hf_names.items():
+            experts = []
+            for e in range(c.n_experts):
+                a = to_np(
+                    get_first(
+                        f"{L}.block_sparse_moe.experts.{e}.{mixtral}.weight",
+                        f"{L}.mlp.experts.{e}.{qwen}.weight",
+                    ),
+                    True,
+                )  # gate/up: [d, eff]; down: [eff, d]
+                if quantization:
+                    # per-expert quantization == stacked quantization: the
+                    # contract axis (we_*: stacked axis 2 → per-layer 1 →
+                    # per-expert 0) never spans the expert axis.
+                    experts.append(quantize_q8(np.asarray(a), (0,)))
+                else:
+                    # narrow to the serving dtype per expert — stacking
+                    # fp32 first would peak at ~4× the layer's final bytes
+                    experts.append(np.asarray(jnp.asarray(a).astype(c.dtype)))
+            if quantization:
+                out[ours] = {
+                    "q8": np.stack([x["q8"] for x in experts]),
+                    "s": np.stack([x["s"] for x in experts]),
+                }
+            else:
+                out[ours] = jnp.asarray(np.stack(experts)).astype(c.dtype)
+        return out
+
     for i in range(c.n_layers):
+        if c.is_moe:
+            for ours, arr in moe_layer(i).items():
+                layers[ours].append(arr)
         for ours, (suffix, transpose) in layer_map.items():
             if ours not in layers:
                 continue
