@@ -324,7 +324,6 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 8,
     from dynamo_tpu.models.config import qwen2_500m_config
     from dynamo_tpu.runtime.context import Context
     from dynamo_tpu.runtime.distributed import DistributedRuntime
-    from dynamo_tpu.runtime.engine import collect
     from dynamo_tpu.runtime.pipeline import build_pipeline
 
     def mk_engine():
@@ -443,11 +442,16 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 8,
 
         await run_wave(gen, concurrency)  # warm both engines + transfer
         warm_bytes = decode_handler.bytes_pulled
-        warm_secs = decode_handler.transfer_seconds
+        decode_handler.transfer_first_start = 0.0  # reset the rate window
         res, wall = await run_wave(gen, requests)
         dis_stats = stats(res, wall)
         xfer_bytes = decode_handler.bytes_pulled - warm_bytes
-        xfer_secs = decode_handler.transfer_seconds - warm_secs
+        # aggregate achieved rate over the overlapped-transfer window
+        # (summed per-pull seconds would double-count concurrent pulls)
+        xfer_secs = (
+            decode_handler.transfer_last_end
+            - decode_handler.transfer_first_start
+        )
         return {
             "mode": "disaggregated P/D (one chip timeshared)",
             "model": "qwen2.5-0.5b",

@@ -180,14 +180,47 @@ class PodConnector:
         by_name = {p["metadata"]["name"]: p for p in observed}
 
         want: Dict[str, Dict[str, Any]] = {}
+        groups: Dict[str, List[str]] = {}  # group key → member pod names
         for svc_name, svc in dep.services.items():
             n = desired.get(svc_name, svc.replicas)
+            H = max(svc.hosts_per_replica, 1)
             for r in range(n):
-                for h in range(max(svc.hosts_per_replica, 1)):
+                members = []
+                for h in range(H):
                     pod = render_pod(dep, svc_name, svc, r, h)
                     want[pod["metadata"]["name"]] = pod
+                    members.append(pod["metadata"]["name"])
+                if H > 1:
+                    groups[f"{svc_name}/{r}"] = members
 
-        # Delete: gone-from-spec, template drift, or terminal phase.
+        # Multihost group atomicity (the Grove/LWS semantic, and what
+        # jax.distributed requires — a lone restarted pod can never rejoin
+        # a running coordinator world): if ANY pod of a group is missing,
+        # Failed, or drifted, restart the WHOLE group together.
+        group_restart: set = set()
+        for key, members in groups.items():
+            if not any(m in by_name for m in members):
+                continue  # first-time creation, nothing to restart
+            for m in members:
+                pod = by_name.get(m)
+                phase = (pod.get("status") or {}).get("phase", "") if pod else ""
+                drifted = (
+                    pod is not None
+                    and pod["metadata"].get("labels", {}).get(LABEL_HASH)
+                    != want[m]["metadata"]["labels"][LABEL_HASH]
+                )
+                if pod is None or drifted or phase in ("Failed", "Succeeded"):
+                    group_restart.update(members)
+                    logger.info(
+                        "multihost group %s restarting as a unit (%s %s)",
+                        key, m,
+                        "missing" if pod is None
+                        else "drifted" if drifted else phase,
+                    )
+                    break
+
+        # Delete: gone-from-spec, template drift, terminal phase, or a
+        # member of a group being restarted as a unit.
         deleted = set()
         for name, pod in list(by_name.items()):
             phase = (pod.get("status") or {}).get("phase", "")
@@ -197,11 +230,17 @@ class PodConnector:
                 and pod["metadata"].get("labels", {}).get(LABEL_HASH)
                 != desired_pod["metadata"]["labels"][LABEL_HASH]
             )
-            if desired_pod is None or drifted or phase in ("Failed", "Succeeded"):
+            if (
+                desired_pod is None or drifted
+                or phase in ("Failed", "Succeeded")
+                or name in group_restart
+            ):
                 logger.info(
                     "deleting pod %s (%s)", name,
                     "scale-down" if desired_pod is None
-                    else "template-drift" if drifted else f"phase={phase}",
+                    else "template-drift" if drifted
+                    else "group-restart" if name in group_restart
+                    else f"phase={phase}",
                 )
                 try:
                     await self.client.delete_core(

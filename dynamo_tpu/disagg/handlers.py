@@ -163,7 +163,12 @@ class DecodeHandler:
         self.transfer_failures = 0
         self.blocks_pulled = 0
         self.bytes_pulled = 0
-        self.transfer_seconds = 0.0  # wall time inside pulls (GB/s metric)
+        self.transfer_seconds = 0.0  # summed per-pull elapsed (can overlap)
+        # Window edges for aggregate-rate math: concurrent pulls overlap,
+        # so bytes / (last_end - first_start) is the honest achieved rate
+        # while summed per-pull seconds would understate it.
+        self.transfer_first_start = 0.0
+        self.transfer_last_end = 0.0
 
     async def _pull_blocks(self, dp: DisaggregatedParams) -> int:
         info = dp.kv_transfer or {}
@@ -184,6 +189,8 @@ class DecodeHandler:
             self._kv_client = await self._kv_client_factory()
         self.transfers += 1
         t0 = time.monotonic()
+        if not self.transfer_first_start:
+            self.transfer_first_start = t0
         imported = 0
         # The block every chunk chains from: the last resident block before
         # the missing run, then the tail of each imported chunk.
@@ -228,7 +235,9 @@ class DecodeHandler:
                 "fallback means every request pays prefill TWICE)",
                 dp.worker_id, imported, self.transfer_failures,
             )
-        self.transfer_seconds += time.monotonic() - t0
+        now = time.monotonic()
+        self.transfer_seconds += now - t0
+        self.transfer_last_end = now
         return imported
 
     async def generate(

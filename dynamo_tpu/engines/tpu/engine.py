@@ -528,6 +528,18 @@ class JaxEngine:
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
+                from dynamo_tpu.runtime.network.spmd_channel import (
+                    SpmdChannelError,
+                )
+
+                if isinstance(exc, SpmdChannelError):
+                    # A follower died: the SPMD worker group is beyond
+                    # repair (the follower missed ops; every process must
+                    # issue every global program). Fail FAST — no retries —
+                    # so the supervisor restarts the whole group.
+                    logger.error("SPMD channel broke: failing worker: %s", exc)
+                    self._fail_terminally(exc)
+                    break
                 # Retry with exponential backoff (transient device hiccups
                 # can span seconds), then treat the failure as terminal: fail
                 # every pending request and refuse new ones. Round 1 retried

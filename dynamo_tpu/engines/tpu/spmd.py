@@ -83,9 +83,33 @@ def follow(runner: Any, follower: SpmdFollower) -> None:
             raise
 
 
-def make_broadcaster(port: int, num_followers: int) -> SpmdBroadcaster:
+FOLLOWER_LOSS_EXIT = 13  # distinct rc: supervisor restarts the group
+
+
+def make_broadcaster(
+    port: int, num_followers: int, *, die_on_follower_loss: bool = True
+) -> SpmdBroadcaster:
     bcast = SpmdBroadcaster(port, num_followers)
     bcast.wait_for_followers()
+    if die_on_follower_loss:
+        # A dead follower is unrecoverable (it missed ops; the group's
+        # collectives can never complete) AND undetectable from the op
+        # stream alone — the leader's next dispatch blocks inside a
+        # collective. Death-watch + immediate exit is the SPMD-correct
+        # fail-fast (the reference's worker ranks die together on NCCL
+        # abort; ref lib/llm/src/migration.rs:24 re-routes in-flight work
+        # at the frontend tier); the supervisor (pod group restart,
+        # deploy/pod_connector.py) brings the whole group back.
+        def _die(i: int, exc: BaseException) -> None:
+            import os as _os
+
+            logger.error(
+                "SPMD follower %d died (%s): worker group unrecoverable, "
+                "exiting rc=%d for group restart", i, exc, FOLLOWER_LOSS_EXIT,
+            )
+            _os._exit(FOLLOWER_LOSS_EXIT)
+
+        bcast.start_death_watch(_die)
     return bcast
 
 

@@ -32,6 +32,14 @@ logger = get_logger(__name__)
 _LEN = struct.Struct("!Q")
 
 
+class SpmdChannelError(ConnectionError):
+    """The lockstep op channel to a follower broke. Unrecoverable for the
+    worker group: a follower that missed even one op can never re-enter
+    the collective (every process must issue every global program), so
+    callers must fail the whole worker fast and let the supervisor restart
+    the group together (deploy/pod_connector.py group restart)."""
+
+
 def _pack_default(obj):
     if isinstance(obj, np.ndarray):
         return {"__nd__": (obj.dtype.str, list(obj.shape), obj.tobytes())}
@@ -102,9 +110,43 @@ class SpmdBroadcaster:
         frame = {"op": op, **kwargs}
         with self._lock:
             for conn in self._conns:
-                _send_frame(conn, frame)
+                try:
+                    _send_frame(conn, frame)
+                except OSError as exc:
+                    raise SpmdChannelError(
+                        f"SPMD follower channel broke sending {op!r}: {exc}"
+                    ) from exc
+
+    def start_death_watch(self, on_dead) -> None:
+        """Watch every follower socket for EOF/RST from a daemon thread and
+        invoke ``on_dead(index, exc)`` the moment one dies.
+
+        Needed because the op stream alone cannot fail fast: the leader's
+        FIRST send after a follower dies lands in the kernel buffer, and
+        the next global-mesh dispatch then blocks inside a collective that
+        will never complete — the break must be detected out-of-band.
+        Followers never send on this socket, so a blocking recv returns
+        only at death (or our own close, which sets _closing first)."""
+        self._closing = False
+
+        def watch(i: int, conn: socket.socket) -> None:
+            try:
+                data = conn.recv(1)
+            except OSError as exc:
+                data, err = b"", exc
+            else:
+                err = None
+            if not getattr(self, "_closing", False) and not data:
+                on_dead(i, err or ConnectionError("follower EOF"))
+
+        for i, conn in enumerate(self._conns):
+            threading.Thread(
+                target=watch, args=(i, conn),
+                name=f"spmd-death-watch-{i}", daemon=True,
+            ).start()
 
     def close(self) -> None:
+        self._closing = True
         for conn in self._conns:
             try:
                 _send_frame(conn, {"op": "stop"})

@@ -36,6 +36,15 @@ TRACE_FILE = config.env_str(
     "DYN_TPU_TRACE_FILE", "",
     "Append finished spans as JSONL to this path ('' disables file export)",
 )
+OTLP_ENDPOINT = config.env_str(
+    "DYN_TPU_OTLP_ENDPOINT", "",
+    "OTLP/HTTP traces endpoint (e.g. http://collector:4318/v1/traces); "
+    "'' disables the wire exporter",
+)
+OTLP_SERVICE = config.env_str(
+    "DYN_TPU_OTLP_SERVICE", "dynamo-tpu",
+    "service.name resource attribute on exported spans",
+)
 
 
 @dataclass
@@ -91,13 +100,171 @@ class Span:
         }
 
 
-class Tracer:
-    """Process-wide span recorder (ring buffer + optional JSONL file)."""
+def _otlp_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
 
-    def __init__(self, *, max_spans: int = 2048, path: Optional[str] = None) -> None:
+
+def _otlp_span(s: Span) -> Dict[str, Any]:
+    """One span in OTLP/HTTP JSON encoding (hex ids per the OTLP JSON
+    mapping). Ref: lib/runtime/src/logging.rs:72-97 ships the reference's
+    spans to a collector via the otel exporter; this is the wire-format
+    equivalent without an SDK dependency."""
+    out: Dict[str, Any] = {
+        "traceId": s.trace_id,
+        "spanId": s.span_id,
+        "name": s.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(s.start_s * 1e9)),
+        "endTimeUnixNano": str(int(s.end_s * 1e9)),
+        "attributes": [
+            {"key": k, "value": _otlp_value(v)}
+            for k, v in s.attributes.items()
+        ],
+        "status": (
+            {"code": 1}
+            if s.status == "ok"
+            else {"code": 2, "message": s.status}
+        ),
+    }
+    if s.parent_span_id:
+        out["parentSpanId"] = s.parent_span_id
+    if s.events:
+        out["events"] = [
+            {
+                "name": e.get("name", "event"),
+                "timeUnixNano": str(int(e.get("time_s", s.start_s) * 1e9)),
+            }
+            for e in s.events
+        ]
+    return out
+
+
+class OtlpHttpExporter:
+    """Minimal OTLP/HTTP JSON trace exporter (no otel SDK in the image).
+
+    Spans are queued by the tracer's export() and shipped in batches from
+    one daemon thread — span-producing paths never block on the network.
+    Failures drop the batch after a bounded retry (telemetry must never
+    take down serving)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        *,
+        service_name: str = "dynamo-tpu",
+        flush_interval_s: float = 2.0,
+        max_batch: int = 256,
+        max_queue: int = 8192,
+    ) -> None:
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self._queue: Deque[Span] = deque(maxlen=max_queue)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.sent = 0
+        self.dropped = 0
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def offer(self, span: Span) -> None:
+        with self._lock:
+            if len(self._queue) == self._queue.maxlen:
+                self.dropped += 1
+            self._queue.append(span)
+        if len(self._queue) >= self.max_batch:
+            self._wake.set()
+
+    def _drain(self) -> List[Span]:
+        with self._lock:
+            batch = list(self._queue)[: self.max_batch]
+            for _ in batch:
+                self._queue.popleft()
+        return batch
+
+    def _post(self, batch: List[Span]) -> None:
+        import urllib.request
+
+        body = json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {
+                                    "key": "service.name",
+                                    "value": {"stringValue": self.service_name},
+                                }
+                            ]
+                        },
+                        "scopeSpans": [
+                            {
+                                "scope": {"name": "dynamo_tpu"},
+                                "spans": [_otlp_span(s) for s in batch],
+                            }
+                        ],
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5.0):
+            pass
+        self.sent += len(batch)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            self.flush_once()
+
+    def flush_once(self) -> None:
+        while True:
+            batch = self._drain()
+            if not batch:
+                return
+            try:
+                self._post(batch)
+            except Exception:
+                self.dropped += len(batch)
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        self.flush_once()
+
+
+class Tracer:
+    """Process-wide span recorder: ring buffer + optional JSONL file +
+    optional OTLP/HTTP wire exporter (DYN_TPU_OTLP_ENDPOINT)."""
+
+    def __init__(
+        self, *, max_spans: int = 2048, path: Optional[str] = None,
+        otlp: Optional[OtlpHttpExporter] = None,
+    ) -> None:
         self._ring: Deque[Span] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         self._path = path if path is not None else (TRACE_FILE.get() or None)
+        if otlp is None and OTLP_ENDPOINT.get():
+            otlp = OtlpHttpExporter(
+                OTLP_ENDPOINT.get(), service_name=OTLP_SERVICE.get()
+            )
+        self.otlp = otlp
 
     def export(self, span: Span) -> None:
         with self._lock:
@@ -108,6 +275,8 @@ class Tracer:
                         f.write(json.dumps(span.to_dict()) + "\n")
                 except OSError:
                     self._path = None  # disable after first failure
+        if self.otlp is not None:
+            self.otlp.offer(span)
 
     def finished_spans(self) -> List[Span]:
         with self._lock:

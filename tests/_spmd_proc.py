@@ -60,6 +60,8 @@ if topo.is_leader:
     )
     from dynamo_tpu.runtime.context import Context
 
+    kill_test = os.environ.get("SPMD_KILL_TEST") == "1"
+
     async def main():
         outs = []
         for i in range(3):
@@ -73,6 +75,12 @@ if topo.is_leader:
             async for out in engine.generate(req, Context()):
                 toks.extend(out.token_ids or [])
             outs.append(toks)
+            if kill_test and i == 0:
+                # Signal the test harness to SIGKILL the follower, then
+                # keep serving: the death watch must exit this process
+                # with FOLLOWER_LOSS_EXIT (13) — NOT hang in a collective.
+                print("FIRST-DONE", flush=True)
+                await asyncio.sleep(2.0)
         await engine.stop()
         return outs
 

@@ -435,3 +435,39 @@ async def test_pod_backend_renders_multihost_pods():
     finally:
         await op.stop()
         await runner.cleanup()
+
+
+async def test_pod_multihost_group_restarts_atomically():
+    """One dead pod of a 2-host worker group → the WHOLE group's pods are
+    deleted and recreated together (jax.distributed worlds cannot be
+    rejoined by a lone restarted pod — the Grove/LWS group semantic, ref
+    dynamocomponentdeployment_types.go multinode fields). Singleton
+    services are untouched."""
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    op = K8sGraphOperator(
+        KubeClient(url), watch_timeout_s=1.0, pod_backend=True
+    )
+    try:
+        fake.apply(GD_PLURAL, "grp", pod_gd_spec(2))
+        await op.reconcile_deployments_once()
+        assert len([1 for (p, n) in fake.store if p == "pods"]) == 5
+
+        # mark ONE host pod of replica 0 Failed (fake kubelet crash)
+        fake.store[("pods", "grp-worker-0-1")]["status"]["phase"] = "Failed"
+        # remember identities to detect recreation
+        before = {
+            n: id(o) for (p, n), o in fake.store.items() if p == "pods"
+        }
+        await op.reconcile_deployments_once()
+        after = {n: id(o) for (p, n), o in fake.store.items() if p == "pods"}
+        # both pods of group worker/0 were recreated (new objects)...
+        assert after["grp-worker-0-0"] != before["grp-worker-0-0"]
+        assert after["grp-worker-0-1"] != before["grp-worker-0-1"]
+        # ...while group worker/1 and the frontend singleton were untouched
+        assert after["grp-worker-1-0"] == before["grp-worker-1-0"]
+        assert after["grp-worker-1-1"] == before["grp-worker-1-1"]
+        assert after["grp-frontend-0-0"] == before["grp-frontend-0-0"]
+    finally:
+        await op.stop()
+        await runner.cleanup()

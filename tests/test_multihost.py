@@ -79,3 +79,82 @@ def test_two_process_worker_serves():
         # greedy decode on the deterministic tiny model: 6 real tokens
         assert len(toks) == 6, results
     assert "follower-done" in outs[1][1]
+
+
+def test_follower_death_fails_leader_fast():
+    """SIGKILL the follower mid-serve: the leader must exit with the
+    group-restart code (13) within seconds via the SPMD death watch — NOT
+    hang inside a collective that can never complete. The supervisor side
+    of the contract (whole-group pod restart) is tested in
+    test_k8s_operator.py::test_pod_multihost_group_restarts_atomically."""
+    import time
+
+    coord_port = _free_port()
+    spmd_port = _free_port()
+    coord = f"127.0.0.1:{coord_port}"
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "SPMD_KILL_TEST": "1",
+    }
+    script = os.path.join(REPO, "tests", "_spmd_proc.py")
+    import queue as _queue
+    import tempfile
+    import threading
+
+    # stderr to files (a PIPE nobody drains can deadlock a chatty child);
+    # stdout watched from a reader thread so the wait has a REAL timeout.
+    err_files = [tempfile.TemporaryFile(mode="w+") for _ in range(2)]
+    leader = subprocess.Popen(
+        [sys.executable, script, "0", coord, str(spmd_port)],
+        stdout=subprocess.PIPE, stderr=err_files[0], env=env, text=True,
+        bufsize=1,
+    )
+    follower = subprocess.Popen(
+        [sys.executable, script, "1", coord, str(spmd_port)],
+        stdout=subprocess.DEVNULL, stderr=err_files[1], env=env, text=True,
+    )
+    try:
+        lines: _queue.Queue = _queue.Queue()
+
+        def _reader():
+            for line in leader.stdout:
+                lines.put(line)
+            lines.put(None)
+
+        threading.Thread(target=_reader, daemon=True).start()
+        saw_first = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                line = lines.get(timeout=5)
+            except _queue.Empty:
+                continue
+            if line is None:
+                break
+            if "FIRST-DONE" in line:
+                saw_first = True
+                break
+        assert saw_first, "leader never served its first request"
+
+        follower.kill()  # SIGKILL mid-group
+        t0 = time.monotonic()
+        try:
+            rc = leader.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            leader.kill()
+            raise AssertionError(
+                "leader hung after follower death (no fail-fast)"
+            )
+        elapsed = time.monotonic() - t0
+        err_files[0].seek(0)
+        assert rc == 13, (rc, err_files[0].read()[-2000:])
+        assert elapsed < 30, f"fail-fast took {elapsed:.1f}s"
+    finally:
+        for p in (leader, follower):
+            if p.poll() is None:
+                p.kill()
+        for f in err_files:
+            f.close()

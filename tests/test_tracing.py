@@ -148,3 +148,88 @@ async def test_recorder_captures_errors(tmp_path):
     replay = ReplayEngine(load_recording(path))
     with pytest.raises(RuntimeError, match="recorded stream ended in error"):
         await collect(replay.generate({}, Context()))
+
+
+async def test_otlp_exporter_ships_spans_to_collector():
+    """Spans produced around a REAL engine generate arrive at a fake OTLP
+    collector as OTLP/HTTP JSON with intact trace/parent ids (ref:
+    lib/runtime/src/logging.rs:72-97 otel export)."""
+    import json as _json
+
+    from aiohttp import web
+
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import tiny_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.utils.tracing import OtlpHttpExporter, Tracer
+
+    received = []
+
+    async def collect_handler(request):
+        received.append(await request.json())
+        return web.json_response({})
+
+    app = web.Application()
+    app.router.add_post("/v1/traces", collect_handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    exporter = OtlpHttpExporter(
+        f"http://127.0.0.1:{port}/v1/traces",
+        service_name="test-svc", flush_interval_s=0.2,
+    )
+    tracer = Tracer(otlp=exporter)
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=tiny_config(), block_size=8, num_kv_blocks=32,
+            max_num_seqs=2, max_model_len=64, decode_steps=2,
+        )
+    )
+    try:
+        ctx = Context()
+        with tracer.span("frontend.request", ctx, model="tiny"):
+            with tracer.span("engine.generate", ctx):
+                req = PreprocessedRequest(
+                    token_ids=[1, 2, 3], request_id="otlp",
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=4, ignore_eos=True),
+                )
+                async for _ in engine.generate(req, ctx):
+                    pass
+        # batches ship off-thread; close() joins + flushes the tail —
+        # run it OFF the event loop so the fake collector can respond
+        import asyncio as _asyncio
+
+        await _asyncio.to_thread(exporter.close)
+        assert exporter.sent == 2 and exporter.dropped == 0
+        assert received, "collector got no POST"
+        spans = []
+        for payload in received:
+            rs = payload["resourceSpans"][0]
+            attrs = {
+                a["key"]: a["value"] for a in rs["resource"]["attributes"]
+            }
+            assert attrs["service.name"] == {"stringValue": "test-svc"}
+            spans.extend(rs["scopeSpans"][0]["spans"])
+        by_name = {s["name"]: s for s in spans}
+        fr = by_name["frontend.request"]
+        eg = by_name["engine.generate"]
+        assert eg["traceId"] == fr["traceId"]
+        assert eg["parentSpanId"] == fr["spanId"]
+        assert "parentSpanId" not in fr
+        assert int(eg["endTimeUnixNano"]) > int(eg["startTimeUnixNano"])
+        assert {"key": "model", "value": {"stringValue": "tiny"}} in fr[
+            "attributes"
+        ]
+        assert fr["status"] == {"code": 1}
+    finally:
+        await engine.stop()
+        await runner.cleanup()
