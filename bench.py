@@ -554,6 +554,29 @@ async def run_bench():
         out["secondary"] = secondary
 
     if (
+        os.environ.get("BENCH_SECONDARY_LONG", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        # Decode-dominated 8B leg (ISL 128 / OSL 512, int8 KV): the regime
+        # the ITL SLA + decode anchor actually measure — at OSL 64 the
+        # prefill wall alone caps ANY engine near ~2.7k tok/s/chip on this
+        # hardware (docs/design_docs/performance.md "round-4 roofline").
+        try:
+            long_leg = await run_leg(
+                "llama3-8b", "int8", None, concurrency=64, requests=96,
+                kv_quant="int8", osl=512,
+            )
+            if "anchor_toks_per_sec" in long_leg:
+                long_leg["vs_baseline"] = round(
+                    long_leg["toks_per_sec_per_chip"]
+                    / long_leg["anchor_toks_per_sec"], 4,
+                )
+            out["secondary_long"] = long_leg
+        except Exception as exc:
+            out["secondary_long"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if (
         os.environ.get("BENCH_DISAGG", "1") != "0"
         and model_name == "qwen2.5-0.5b"
         and jax.default_backend() == "tpu"

@@ -1,0 +1,150 @@
+"""Speculative-decoding break-even measurement.
+
+Real-checkpoint acceptance cannot be measured in this environment (zero
+egress: no real weights exist, and random weights drive prompt-lookup
+acceptance to ~0 — docs/design_docs/performance.md r3 measurement). What
+CAN be measured on hardware is the COST side, which fixes the break-even
+acceptance rate any real deployment needs:
+
+  plain:  one fused decode step emits 1 token/seq in t_decode
+  spec:   one verify step over [B, k+1] emits (1 + accepted) tokens/seq
+          in t_verify (+ host proposal overhead, measured separately)
+
+  spec wins  ⇔  E[accepted] > t_verify / t_decode - 1
+
+Usage (real chip):
+  python -m dynamo_tpu.bench.spec_breakeven --model llama3-8b --quant int8
+  → JSON {t_decode_ms, t_verify_ms, k, break_even_acceptance, ...}
+
+Ref: the reference's engines expose spec decode as a config lever
+(docs per-engine spec-decode guidance); engines/tpu/spec.py is the
+local implementation this prices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def measure(model: str = "llama3-8b", quant: str | None = "int8",
+            batch: int = 64, ctx: int = 160, spec_k: int = 4,
+            block_size: int = 128, iters: int = 16) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engines.tpu.runner import DeviceRunner
+    from dynamo_tpu.engines.tpu.engine import JaxEngineArgs
+    from dynamo_tpu.models.config import (
+        llama3_8b_config,
+        qwen2_500m_config,
+        tiny_config,
+    )
+
+    cfg = {
+        "llama3-8b": llama3_8b_config,
+        "qwen2.5-0.5b": qwen2_500m_config,
+        "tiny": tiny_config,
+    }[model]()
+    P = (ctx + spec_k + block_size) // block_size + 1
+    args = JaxEngineArgs(
+        config=cfg, block_size=block_size, num_kv_blocks=batch * P + 8,
+        max_num_seqs=batch, max_model_len=P * block_size,
+        decode_steps=iters, quantization=quant,
+    )
+    runner = DeviceRunner(args)
+    rng = np.random.default_rng(0)
+    NB = args.num_kv_blocks
+    tables = rng.permutation(NB - 1)[: batch * P].reshape(batch, P).astype(
+        np.int32
+    )
+    pos = np.full((batch,), ctx, np.int32)
+    toks = np.ones((batch,), np.int32)
+    ones = np.ones((batch,), np.int32)
+    temp = np.zeros((batch,), np.float32)
+    topk = np.zeros((batch,), np.int32)
+    topp = np.ones((batch,), np.float32)
+
+    def time_it(fn, n=3):
+        fn()  # compile
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # plain fused decode: `iters` tokens/seq per dispatch
+    t_decode = time_it(
+        lambda: runner.run_decode(
+            toks, pos, ones, tables, temp, topk, topp, None
+        )
+    ) / iters
+
+    # spec verify: ONE [B, k+1] forward + argmax at every position
+    ver_toks = np.ones((batch, spec_k + 1), np.int32)
+    lens = np.full((batch,), spec_k + 1, np.int32)
+    t_verify = time_it(
+        lambda: runner.run_spec(ver_toks, pos, lens, tables, None)
+    )
+
+    # host proposal cost: the same index+lookup NgramSpecDecoder.propose
+    # runs per sequence per tick (engines/tpu/spec.py:41), standalone
+    hist = rng.integers(0, 1000, size=512).tolist()
+    n = 3
+
+    def propose_once():
+        index = {}
+        for p in range(n - 1, len(hist) - 1):
+            index[tuple(hist[p - n + 1 : p + 1])] = p + 1
+        cont = index.get(tuple(hist[-n:]))
+        return hist[cont : cont + spec_k] if cont is not None else []
+
+    t0 = time.perf_counter()
+    for _ in range(200):
+        propose_once()
+    t_proposal = (time.perf_counter() - t0) / 200
+
+    be = t_verify / t_decode - 1.0
+    return {
+        "metric": "speculative-decode break-even",
+        "model": cfg.name,
+        "quant": quant,
+        "batch": batch,
+        "ctx": ctx,
+        "spec_k": spec_k,
+        "t_decode_ms_per_token_step": round(t_decode * 1000, 3),
+        "t_verify_ms": round(t_verify * 1000, 3),
+        "t_proposal_us": round(t_proposal * 1e6, 1),
+        "verify_over_decode": round(t_verify / t_decode, 3),
+        # spec emits (1 + accepted) tokens per verify; plain emits
+        # t_verify/t_decode tokens in the same wall time
+        "break_even_accepted_tokens": round(be, 3),
+        "break_even_acceptance_rate": round(max(be, 0.0) / spec_k, 3),
+        "backend": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("spec break-even")
+    ap.add_argument("--model", default="llama3-8b")
+    ap.add_argument("--quant", default="int8")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--ctx", type=int, default=160)
+    ap.add_argument("--spec-k", type=int, default=4)
+    args = ap.parse_args()
+    print(
+        json.dumps(
+            measure(
+                args.model, args.quant or None, args.batch, args.ctx,
+                args.spec_k,
+            )
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

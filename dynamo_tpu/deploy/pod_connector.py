@@ -262,9 +262,14 @@ class PodConnector:
             except KubeApiError as exc:
                 if exc.status != 409:
                     raise
-                # One 409 is a slow-delete race; repeated 409s on a pod our
-                # label-filtered list never sees mean a FOREIGN same-name
-                # pod owns the name — silent forever without this.
+                if name in deleted:
+                    # We deleted this name THIS pass; on a real apiserver
+                    # it sits Terminating for its grace period — expected,
+                    # the next level-triggered pass recreates it.
+                    continue
+                # Repeated 409s on a pod our label-filtered list never
+                # sees mean a FOREIGN same-name pod owns the name — silent
+                # forever without this.
                 n = self._conflicts[name] = self._conflicts.get(name, 0) + 1
                 if n >= 3:
                     logger.warning(

@@ -96,6 +96,12 @@ class SpmdBroadcaster:
         # sendall calls can't corrupt the stream.
         self._lock = threading.Lock()
 
+    @property
+    def port(self) -> int:
+        """Actual bound port (pass 0 to the constructor to let the OS pick
+        — bind-before-publish eliminates probe-then-bind port races)."""
+        return self._server.getsockname()[1]
+
     def wait_for_followers(self) -> None:
         while len(self._conns) < self.num_followers:
             conn, addr = self._server.accept()

@@ -118,3 +118,21 @@ def test_killed_worker_recovers_without_reingest(tmp_path):
         f"ttft {served2['ttft_ms']:.0f}ms; cold load was "
         f"{served1['load_ms']:.0f}ms)"
     )
+
+
+def test_restart_bench_warm_beats_cold_3x(tmp_path):
+    """The chrek-role recovery number: a SIGKILLed worker's replacement
+    reaches its first token from the durable tiers (tmpfs weights +
+    persistent compile cache) at least 3x faster than a cold spawn
+    (ref: deploy/chrek/pkg/checkpoint/criu.go:1 — same metric, process
+    image replaced by tier re-attach)."""
+    pytest.importorskip("transformers")
+    from dynamo_tpu.bench.restart import run
+
+    model_dir = _model_dir(tmp_path)
+    out = run(model_dir, str(tmp_path / "caches"))
+    # Unloaded this measures ~5.6x (performance.md); under full-suite CPU
+    # contention the jitter-prone legs compress, so the gate is 2x overall
+    # plus a hard 5x on the weight tier itself (the contention-robust part).
+    assert out["warm_s"] < out["cold_s"] / 2, out
+    assert out["warm_weight_load_s"] < out["cold_weight_load_s"] / 5, out
