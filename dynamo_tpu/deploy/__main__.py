@@ -51,6 +51,13 @@ async def run_operator(args) -> None:
         client = KubeClient(args.apiserver, token=args.token)
     else:
         client = KubeClient.in_cluster()
+    webhook_runner = None
+    if args.webhook_port:
+        from dynamo_tpu.deploy.webhook import serve as serve_webhook
+
+        webhook_runner = await serve_webhook(
+            args.webhook_port, args.tls_cert, args.tls_key
+        )
     operator = K8sGraphOperator(
         client, k8s_namespace=args.k8s_namespace,
         pod_backend=args.pod_backend,
@@ -64,6 +71,8 @@ async def run_operator(args) -> None:
         await operator.run()
     finally:
         await operator.stop()
+        if webhook_runner is not None:
+            await webhook_runner.cleanup()
 
 
 def main() -> None:
@@ -88,6 +97,13 @@ def main() -> None:
         help="actuate CR replicas as cluster pods (TPU nodeSelector + "
         "multihost DYN_TPU_* groups) instead of node-local subprocesses",
     )
+    p.add_argument(
+        "--webhook-port", type=int, default=0,
+        help="also serve the validating admission webhook on this port "
+        "(0 = off; kube requires HTTPS — pass --tls-cert/--tls-key)",
+    )
+    p.add_argument("--tls-cert", default=None)
+    p.add_argument("--tls-key", default=None)
     args = parser.parse_args()
     configure_logging()
     if args.command == "operator":

@@ -1,0 +1,153 @@
+"""Validating admission webhook for the CRDs.
+
+Reference parity: the reference operator registers validating webhooks for
+its CRD kinds (deploy/operator/ webhook setup via controller-runtime) so a
+malformed DynamoGraphDeployment is rejected at `kubectl apply` time rather
+than crash-looping the reconciler. Same role here: an aiohttp server
+speaking the admission/v1 AdmissionReview contract; validation IS the spec
+parser (deploy/spec.py GraphDeployment.from_dict + validate) plus
+pod-target sanity checks, so apply-time rules can never drift from what
+the operator actually accepts.
+
+Serving: in-cluster this sits behind a Service with TLS certs mounted
+(--tls-cert/--tls-key; kube requires HTTPS for webhooks); tests drive the
+handler over plain HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from aiohttp import web
+
+from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def validate_graph_deployment(obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """(allowed, message). The single source of validation truth: parse
+    with the SAME code the operator reconciles with."""
+    try:
+        spec = dict(obj.get("spec") or {})
+        spec["name"] = (obj.get("metadata") or {}).get("name", "unnamed")
+        dep = GraphDeployment.from_dict(spec)
+    except Exception as exc:
+        return False, f"invalid spec: {exc}"
+    for name, svc in dep.services.items():
+        # (multihost groups without an explicit port get the render-time
+        # default coordinator port — allowed, not validated here)
+        if svc.hosts_per_replica < 1:
+            return False, f"service {name}: hosts_per_replica must be >= 1"
+        if svc.chips_per_host < 0:
+            return False, f"service {name}: negative chips_per_host"
+        if (svc.tpu_topology and not svc.tpu_accelerator) or (
+            svc.tpu_accelerator and not svc.tpu_topology
+        ):
+            return False, (
+                f"service {name}: tpu_accelerator and tpu_topology must be "
+                "set together (GKE schedules podslices on the pair)"
+            )
+    return True, "ok"
+
+
+def validate_request(request_obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """DGDR validation: SLA + workload fields must be positive numbers."""
+    spec = request_obj.get("spec") or {}
+    sla = spec.get("sla") or {}
+    wl = spec.get("workload") or {}
+    for key, doc in (("ttft_s", sla), ("itl_s", sla)):
+        if key in doc:
+            try:
+                if float(doc[key]) <= 0:
+                    return False, f"sla.{key} must be > 0"
+            except (TypeError, ValueError):
+                return False, f"sla.{key} is not a number"
+    for key in ("isl", "osl", "requests_per_s"):
+        if key in wl:
+            try:
+                if float(wl[key]) <= 0:
+                    return False, f"workload.{key} must be > 0"
+            except (TypeError, ValueError):
+                return False, f"workload.{key} is not a number"
+    if not (spec.get("template") or {}).get("services"):
+        return False, "template.services is required"
+    return True, "ok"
+
+
+_KIND_VALIDATORS = {
+    "DynamoTpuGraphDeployment": validate_graph_deployment,
+    "DynamoTpuGraphDeploymentRequest": validate_request,
+}
+
+
+def review_response(review: Dict[str, Any]) -> Dict[str, Any]:
+    """AdmissionReview in → AdmissionReview out (admission.k8s.io/v1)."""
+    req = review.get("request") or {}
+    uid = req.get("uid", "")
+    obj = req.get("object") or {}
+    kind = (obj.get("kind") or req.get("kind", {}).get("kind") or "")
+    validator = _KIND_VALIDATORS.get(kind)
+    if validator is None:
+        allowed, message = True, f"kind {kind!r} not validated"
+    else:
+        allowed, message = validator(obj)
+        if not allowed:
+            logger.info("denied %s %s: %s", kind,
+                        (obj.get("metadata") or {}).get("name"), message)
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": {
+            "uid": uid,
+            "allowed": allowed,
+            **(
+                {}
+                if allowed
+                else {"status": {"code": 422, "message": message}}
+            ),
+        },
+    }
+
+
+def build_app() -> web.Application:
+    async def handle(request: web.Request) -> web.Response:
+        try:
+            review = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "bad json"}, status=400)
+        return web.json_response(review_response(review))
+
+    async def healthz(_request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_post("/validate", handle)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+async def serve(
+    port: int = 9443,
+    tls_cert: Optional[str] = None,
+    tls_key: Optional[str] = None,
+) -> web.AppRunner:
+    import ssl
+
+    if bool(tls_cert) != bool(tls_key):
+        # Silently serving plain HTTP here would fail every admission
+        # request's mandatory TLS handshake with no hint in our log.
+        raise ValueError("--tls-cert and --tls-key must be set together")
+    ctx = None
+    if tls_cert and tls_key:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key)
+    runner = web.AppRunner(build_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port, ssl_context=ctx)
+    await site.start()
+    logger.info("admission webhook on :%d (%s)", port,
+                "https" if ctx else "http")
+    return runner

@@ -107,3 +107,32 @@ def test_discd_service_renders():
     assert ports == {
         "discovery": 6180, "events-xsub": 6181, "events-xpub": 6182
     }
+
+
+def test_operator_deployment_renders():
+    """Operator template: RBAC + Deployment running the pod-backend
+    operator with the admission webhook, gated by operator.enabled."""
+    values = _values()
+    rendered = render(
+        os.path.join(CHART, "templates", "operator.yaml"), values
+    )
+    docs = [d for d in yaml.safe_load_all(rendered) if d]
+    kinds = [d["kind"] for d in docs]
+    assert kinds == [
+        "ServiceAccount", "Role", "RoleBinding", "Deployment", "Service",
+        "ValidatingWebhookConfiguration",
+    ]
+    vwc = docs[5]
+    hook = vwc["webhooks"][0]
+    assert hook["clientConfig"]["service"]["path"] == "/validate"
+    assert "graphdeployments" in hook["rules"][0]["resources"]
+    dep = docs[3]
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--pod-backend" in cmd and "--webhook-port" in cmd
+    role = docs[1]
+    assert any("pods" in r["resources"] for r in role["rules"])
+
+    values["operator"]["enabled"] = False
+    assert not yaml.safe_load(
+        render(os.path.join(CHART, "templates", "operator.yaml"), values)
+    )

@@ -471,3 +471,84 @@ async def test_pod_multihost_group_restarts_atomically():
     finally:
         await op.stop()
         await runner.cleanup()
+
+
+def _review(kind, name, spec):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "u-1",
+            "object": {
+                "kind": kind,
+                "metadata": {"name": name},
+                "spec": spec,
+            },
+        },
+    }
+
+
+async def test_admission_webhook_validates_crs():
+    """The validating webhook rejects malformed CRs with the SAME parser
+    the operator reconciles with (ref: the reference operator's
+    controller-runtime validating webhooks)."""
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from dynamo_tpu.deploy.webhook import build_app
+
+    server = TestServer(build_app())
+    await server.start_server()
+    url = str(server.make_url("/validate"))
+    try:
+        async with ClientSession() as sess:
+            async def post(review):
+                async with sess.post(url, json=review) as resp:
+                    assert resp.status == 200
+                    return (await resp.json())["response"]
+
+            # valid deployment → allowed, uid echoed
+            ok = await post(_review(
+                "DynamoTpuGraphDeployment", "good",
+                {"services": {"w": {"kind": "worker", "replicas": 1}}},
+            ))
+            assert ok["allowed"] and ok["uid"] == "u-1"
+
+            # unknown service kind → denied with the parser's message
+            bad = await post(_review(
+                "DynamoTpuGraphDeployment", "bad",
+                {"services": {"w": {"kind": "nope"}}},
+            ))
+            assert not bad["allowed"]
+            assert "nope" in bad["status"]["message"]
+
+            # topology without accelerator → denied
+            bad2 = await post(_review(
+                "DynamoTpuGraphDeployment", "bad2",
+                {"services": {"w": {"kind": "worker", "tpu_topology": "2x4"}}},
+            ))
+            assert not bad2["allowed"]
+            assert "tpu_accelerator" in bad2["status"]["message"]
+
+            # DGDR with negative SLA → denied
+            bad3 = await post(_review(
+                "DynamoTpuGraphDeploymentRequest", "r1",
+                {"sla": {"itl_s": -1},
+                 "template": {"services": {"d": {"kind": "worker"}}}},
+            ))
+            assert not bad3["allowed"]
+
+            # DGDR valid → allowed
+            ok2 = await post(_review(
+                "DynamoTpuGraphDeploymentRequest", "r2",
+                {"sla": {"ttft_s": 1.0, "itl_s": 0.05},
+                 "workload": {"isl": 128, "osl": 64, "requests_per_s": 2},
+                 "template": {"services": {"d": {"kind": "worker"}}}},
+            ))
+            assert ok2["allowed"]
+
+            # unvalidated kind passes through
+            other = await post(_review("SomethingElse", "x", {}))
+            assert other["allowed"]
+    finally:
+        await server.close()
