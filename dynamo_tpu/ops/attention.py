@@ -91,9 +91,16 @@ def paged_attention(
     compiled body; ``logit_cap`` applies the Gemma-2 score softcap.
     """
     if use_kernel:
-        if q.shape[1] == 1:
-            # Decode: the batch-blocked kernel amortizes the sequential
-            # grid's per-step overhead over 8 sequences per iteration.
+        B, C, n_heads, _ = q.shape
+        k_values = k_cache["q8"] if isinstance(k_cache, dict) else k_cache
+        n_kv_heads = k_values.shape[2]
+        G = n_heads // n_kv_heads
+        if C <= 8 and C * G <= 64:
+            # Decode (C=1) and short chunks (speculative verify, chunk
+            # tails): the batch-blocked kernel amortizes the sequential
+            # grid's per-step overhead over 8-16 sequences per iteration
+            # (the generic (B, pages) grid runs B×P tiny steps — measured
+            # 3.3× of an 8B verify dispatch before this route).
             decode_kernel = _load_decode_kernel()
             if decode_kernel is not None:
                 return decode_kernel(

@@ -169,24 +169,30 @@ def _decode_kernel(
     block_tables_ref,  # [B, P] int32 (SMEM)
     start_pos_ref,  # [B] int32
     window_ref,  # [1] int32 — sliding window (0 = full attention)
-    # VMEM blocks: q [BQ, KH, G, D], then BQ (k, v) page pairs — int8
+    # VMEM blocks: q [BQ, KH, C*G, D], then BQ (k, v) page pairs — int8
     # caches interleave a [1, KH, bs] scale ref after each page ref
     q_ref,
     *refs,  # pages..., o_ref, m, l, acc
     sm_scale: float,
     block_size: int,
     batch_block: int,
+    n_groups: int,
     logit_cap: float = 0.0,
     quantized: bool = False,
 ):
-    """Decode-specialized (C=1) kernel: the grid is (B/BQ, pages) and each
+    """Batch-blocked kernel for decode (C=1) and SHORT chunks (C ≤ 8, the
+    speculative-verify shape): the grid is (B/BQ, pages) and each
     sequential grid step visits ONE page of BQ different sequences. The
     generic kernel's (B, pages) grid ran B×P tiny steps whose per-iteration
-    overhead dominated decode (measured ~10µs/step ≫ the 0.5µs of compute);
+    overhead dominated (measured ~10µs/step ≫ the 0.5µs of compute);
     batch-blocking amortizes it BQ-fold while every page DMA stays a single
     contiguous [bs, KH, D] transfer. Int8 caches halve both the DMA bytes
     and the per-page VMEM, which doubles the default batch_block (8 → 16)
-    inside the same scoped-VMEM budget."""
+    inside the same scoped-VMEM budget.
+
+    Query rows per (j, h) are (c, g) pairs, c-major; causality masks key t
+    visible to row (c, g) iff t <= start_j + c (the chunk's own K/V are
+    already in the cache, as in the generic kernel)."""
     BQ = batch_block
     stride = 4 if quantized else 2
     kv_refs = refs[: stride * BQ]
@@ -197,7 +203,9 @@ def _decode_kernel(
     p = pl.program_id(1)
     num_steps = pl.num_programs(1)
     KH = q_ref.shape[1]
-    G = q_ref.shape[2]
+    CG = q_ref.shape[2]
+    G = n_groups
+    C = CG // G
 
     @pl.when(p == 0)
     def _init():
@@ -208,7 +216,8 @@ def _decode_kernel(
     win = window_ref[0]
     for j in range(BQ):  # static unroll over the sequence block
         start = start_pos_ref[bb * BQ + j]
-        last_needed_page = start // block_size  # query position == start
+        # Highest key any row can see: start + C - 1 (last chunk row).
+        last_needed_page = (start + C - 1) // block_size
         # With a sliding window, pages wholly before start-win+1 skip both
         # their compute AND never affect the causal/window mask.
         first_needed_page = jnp.where(
@@ -217,13 +226,24 @@ def _decode_kernel(
 
         @pl.when((p >= first_needed_page) & (p <= last_needed_page))
         def _compute(j=j, start=start):
-            t_idx = p * block_size + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_size), 1
-            )
-            visible = t_idx <= start  # [1, bs], every (g) row shares it
-            visible = visible & ((win <= 0) | (t_idx > start - win))
+            if C == 1:
+                # decode fast path: one shared [1, bs] mask row (broadcast)
+                t_idx = p * block_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_size), 1
+                )
+                limit = start
+            else:
+                t_idx = p * block_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (CG, block_size), 1
+                )
+                c_idx = jax.lax.broadcasted_iota(
+                    jnp.int32, (CG, block_size), 0
+                ) // G
+                limit = start + c_idx
+            visible = t_idx <= limit
+            visible = visible & ((win <= 0) | (t_idx > limit - win))
             for h in range(KH):
-                q = q_ref[j, h].astype(jnp.float32)  # [G, D]
+                q = q_ref[j, h].astype(jnp.float32)  # [CG, D]
                 k = kv_refs[stride * j][0, :, h, :].astype(jnp.float32)
                 v = kv_refs[stride * j + stride // 2][0, :, h, :].astype(
                     jnp.float32
@@ -292,7 +312,7 @@ def paged_attention_decode_kernel(
 
     quantized = is_quantized_pool(k_cache)
     B, C, n_heads, head_dim = q.shape
-    assert C == 1, "decode kernel serves single-token steps"
+    assert C <= 8, "batch-blocked kernel serves decode / short-chunk steps"
     k_values = k_cache["q8"] if quantized else k_cache
     _, block_size, n_kv_heads, _ = k_values.shape
     G = n_heads // n_kv_heads
@@ -301,6 +321,9 @@ def paged_attention_decode_kernel(
         # Measured on v5e: BQ bounded by the ~16 MB scoped VMEM the per-j
         # double-buffered page pairs occupy; int8 pages are half the size.
         batch_block = 16 if quantized else 8
+    # C>1 multiplies the q block and all three scratches by C: shrink BQ
+    # so the VMEM footprint stays at the C=1 budget.
+    batch_block = max(1, batch_block // C)
     BQ = max(min(batch_block, B), 1)
 
     B_pad = ((B + BQ - 1) // BQ) * BQ
@@ -309,8 +332,14 @@ def paged_attention_decode_kernel(
         block_tables = jnp.pad(block_tables, ((0, B_pad - B), (0, 0)))
         start_pos = jnp.pad(start_pos, (0, B_pad - B))
 
-    q4 = q.reshape(B_pad, 1, n_kv_heads, G, head_dim)[:, 0]  # [B, KH, G, D]
-    q4 = q4.reshape(B_pad, n_kv_heads, G, head_dim)
+    # [B, C, H, D] → [B, KH, C*G, D]; rows (c, g) c-major, as the kernel's
+    # causal mask expects.
+    q4 = (
+        q.reshape(B_pad, C, n_kv_heads, G, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B_pad, n_kv_heads, C * G, head_dim)
+    )
+    CG = C * G
     P = block_tables.shape[1]
     win = jnp.asarray(window, jnp.int32).reshape(1)
 
@@ -329,7 +358,7 @@ def paged_attention_decode_kernel(
 
         return s_map
 
-    in_specs = [pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map)]
+    in_specs = [pl.BlockSpec((BQ, n_kv_heads, CG, head_dim), q_map)]
     kv_args = []
     for j in range(BQ):
         spec = pl.BlockSpec((1, block_size, n_kv_heads, head_dim), kv_map_for(j))
@@ -347,22 +376,22 @@ def paged_attention_decode_kernel(
         num_scalar_prefetch=3,
         grid=(B_pad // BQ, P),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((BQ, n_kv_heads, G, head_dim), q_map),
+        out_specs=pl.BlockSpec((BQ, n_kv_heads, CG, head_dim), q_map),
         scratch_shapes=[
-            pltpu.VMEM((BQ, n_kv_heads, G, 1), jnp.float32),
-            pltpu.VMEM((BQ, n_kv_heads, G, 1), jnp.float32),
-            pltpu.VMEM((BQ, n_kv_heads, G, head_dim), jnp.float32),
+            pltpu.VMEM((BQ, n_kv_heads, CG, 1), jnp.float32),
+            pltpu.VMEM((BQ, n_kv_heads, CG, 1), jnp.float32),
+            pltpu.VMEM((BQ, n_kv_heads, CG, head_dim), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _decode_kernel, sm_scale=scale, block_size=block_size, batch_block=BQ,
-        logit_cap=logit_cap, quantized=quantized,
+        n_groups=G, logit_cap=logit_cap, quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (B_pad, n_kv_heads, G, head_dim), q.dtype
+            (B_pad, n_kv_heads, CG, head_dim), q.dtype
         ),
         interpret=interpret,
     )(
@@ -372,8 +401,12 @@ def paged_attention_decode_kernel(
         q4,
         *kv_args,
     )
-    out = out[:B].reshape(B, n_kv_heads, 1, G, head_dim).transpose(0, 2, 1, 3, 4)
-    return out.reshape(B, 1, n_heads, head_dim)
+    out = (
+        out[:B]
+        .reshape(B, n_kv_heads, C, G, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+    )
+    return out.reshape(B, C, n_heads, head_dim)
 
 
 @functools.partial(

@@ -120,15 +120,25 @@ def test_operator_deployment_renders():
     kinds = [d["kind"] for d in docs]
     assert kinds == [
         "ServiceAccount", "Role", "RoleBinding", "Deployment", "Service",
-        "ValidatingWebhookConfiguration",
+        "Issuer", "Certificate", "ValidatingWebhookConfiguration",
     ]
-    vwc = docs[5]
+    # every namespaced resource pinned to dynamoNamespace, and the operator
+    # told to watch it (a 'default'-watching operator reconciles nothing)
+    for d in docs[:5] + docs[5:7]:
+        assert d["metadata"].get("namespace") == values["dynamoNamespace"], d["kind"]
+    vwc = docs[7]
     hook = vwc["webhooks"][0]
     assert hook["clientConfig"]["service"]["path"] == "/validate"
     assert "graphdeployments" in hook["rules"][0]["resources"]
     dep = docs[3]
     cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--pod-backend" in cmd and "--webhook-port" in cmd
+    assert values["dynamoNamespace"] in cmd  # --k8s-namespace target
+    # the mounted certs Secret is actually created by the Certificate
+    cert = docs[6]
+    assert cert["spec"]["secretName"] == dep["spec"]["template"]["spec"][
+        "volumes"
+    ][0]["secret"]["secretName"]
     role = docs[1]
     assert any("pods" in r["resources"] for r in role["rules"])
 

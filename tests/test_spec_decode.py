@@ -196,3 +196,16 @@ async def test_spec_concurrent_batch_equivalence():
     finally:
         await plain.stop()
         await spec.stop()
+
+
+def test_spec_breakeven_harness_smoke():
+    """The break-even bench marshals DeviceRunner's private program
+    signatures directly — this smoke run breaks loudly if that contract
+    drifts (review finding: no other coverage ties them together)."""
+    from dynamo_tpu.bench.spec_breakeven import measure
+
+    out = measure(model="tiny", quant=None, batch=2, ctx=12, spec_k=2,
+                  block_size=8, iters=2)
+    assert out["t_decode_ms_per_token_step"] > 0
+    assert out["t_verify_ms"] > 0
+    assert 0 <= out["break_even_acceptance_rate"] <= out["spec_k"]
