@@ -318,9 +318,16 @@ def paged_attention_decode_kernel(
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
     if batch_block is None:
-        # Measured on v5e: BQ bounded by the ~16 MB scoped VMEM the per-j
-        # double-buffered page pairs occupy; int8 pages are half the size.
-        batch_block = 16 if quantized else 8
+        import os
+
+        env_bq = os.environ.get("DYN_TPU_DECODE_BQ")
+        if env_bq:
+            batch_block = int(env_bq)
+        else:
+            # Measured on v5e: BQ bounded by the ~16 MB scoped VMEM the
+            # per-j double-buffered page pairs occupy; int8 pages are half
+            # the size. DYN_TPU_DECODE_BQ overrides for shape tuning.
+            batch_block = 16 if quantized else 8
     # C>1 multiplies the q block and all three scratches by C: shrink BQ
     # so the VMEM footprint stays at the C=1 budget.
     batch_block = max(1, batch_block // C)
