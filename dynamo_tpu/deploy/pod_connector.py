@@ -289,10 +289,16 @@ class PodConnector:
                 self.k8s_namespace, "pods",
                 label_selector=f"{LABEL_DEPLOYMENT}={dep.name}",
             )
+        # Terminating pods keep phase Running until the kubelet finishes —
+        # exclude anything with a deletionTimestamp (and anything this same
+        # pass deleted) so ready counts don't briefly over-report to the
+        # planner after a group restart or scale-down.
         running = {
             p["metadata"]["name"]
             for p in observed
             if (p.get("status") or {}).get("phase") == "Running"
+            and not p["metadata"].get("deletionTimestamp")
+            and p["metadata"]["name"] not in deleted
         }
         counts: Dict[str, int] = {}
         for svc_name, svc in dep.services.items():

@@ -87,7 +87,14 @@ def review_response(review: Dict[str, Any]) -> Dict[str, Any]:
     """AdmissionReview in → AdmissionReview out (admission.k8s.io/v1)."""
     req = review.get("request") or {}
     uid = req.get("uid", "")
-    obj = req.get("object") or {}
+    obj = req.get("object")
+    if obj is None:
+        # DELETE (and any op where object is null) carries no new spec to
+        # validate — allow rather than deny on an empty dict, so the handler
+        # stays safe if DELETE is ever added to the webhook rules.
+        return _admission_response(
+            uid, True, f"no object for {req.get('operation', '?')}"
+        )
     kind = (obj.get("kind") or req.get("kind", {}).get("kind") or "")
     validator = _KIND_VALIDATORS.get(kind)
     if validator is None:
@@ -97,6 +104,10 @@ def review_response(review: Dict[str, Any]) -> Dict[str, Any]:
         if not allowed:
             logger.info("denied %s %s: %s", kind,
                         (obj.get("metadata") or {}).get("name"), message)
+    return _admission_response(uid, allowed, message)
+
+
+def _admission_response(uid: str, allowed: bool, message: str) -> Dict[str, Any]:
     return {
         "apiVersion": "admission.k8s.io/v1",
         "kind": "AdmissionReview",

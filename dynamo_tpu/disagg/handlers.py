@@ -116,10 +116,15 @@ class KvTransferHandler:
         self.chunk_bytes = chunk_bytes or KV_CHUNK_BYTES
 
     def _blocks_per_chunk(self) -> int:
+        from dynamo_tpu.engines.tpu.runner import kv_wire_itemsize
+
         cfg = self._engine.args.config
+        itemsize = kv_wire_itemsize(
+            cfg.dtype, getattr(self._engine.args, "kv_cache_dtype", None)
+        )
         block_bytes = (
             2 * cfg.n_layers * self._engine.args.block_size
-            * cfg.n_kv_heads * cfg.head_dim_ * 2
+            * cfg.n_kv_heads * cfg.head_dim_ * itemsize
         )
         return max(1, self.chunk_bytes // max(block_bytes, 1))
 

@@ -57,12 +57,28 @@ def _scatter_blocks(cache, idx, blocks):
     return cache.at[:, idx].set(blocks.astype(cache.dtype))
 
 
+# The wire/checkpoint format for exported KV blocks is always DENSE:
+# int8 pools are dequantized to KV_QUANT_WIRE_DTYPE by _gather_blocks;
+# non-quantized pools ship in their storage dtype (casting would perturb
+# fp32 test configs). Chunk sizing on the transfer path must use
+# kv_wire_itemsize(), not a literal.
+KV_QUANT_WIRE_DTYPE = jnp.bfloat16
+
+
+def kv_wire_itemsize(storage_dtype, kv_cache_dtype: "str | None") -> int:
+    """Bytes per element of exported KV blocks for a pool with the given
+    storage dtype and kv_cache_dtype engine setting."""
+    if kv_cache_dtype == "int8":
+        return jnp.dtype(KV_QUANT_WIRE_DTYPE).itemsize
+    return jnp.dtype(storage_dtype).itemsize
+
+
 @jax.jit
 def _gather_blocks(cache, idx):
     """[L, n, BS, KH, D] of blocks idx [n], from any cache layout, as ONE
     device program (a per-layer host gather would pay L dispatch RTTs).
-    Int8 pools are dequantized — the wire/checkpoint format is always
-    dense [L, n, BS, KH, D]."""
+    Int8 pools are dequantized to KV_QUANT_WIRE_DTYPE — the wire/checkpoint
+    format is always dense [L, n, BS, KH, D]."""
     from dynamo_tpu.ops.kv_quant import dequantize_pages
 
     def one(c):

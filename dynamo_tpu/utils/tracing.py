@@ -178,11 +178,16 @@ class OtlpHttpExporter:
         self._thread.start()
 
     def offer(self, span: Span) -> None:
+        """Enqueue a span; when the bounded queue is full the OLDEST span is
+        evicted (deque maxlen semantics) and counted as dropped."""
         with self._lock:
             if len(self._queue) == self._queue.maxlen:
                 self.dropped += 1
             self._queue.append(span)
-        if len(self._queue) >= self.max_batch:
+            # Decide the wake inside the lock: the post-append length is
+            # only stable here, and a racy read could miss the batch edge.
+            wake = len(self._queue) >= self.max_batch
+        if wake:
             self._wake.set()
 
     def _drain(self) -> List[Span]:
