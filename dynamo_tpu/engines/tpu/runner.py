@@ -84,7 +84,7 @@ def _gather_blocks(cache, idx):
     def one(c):
         if isinstance(c, dict):
             return dequantize_pages(
-                c["q8"][idx], c["s"][idx], jnp.bfloat16
+                c["q8"][idx], c["s"][idx], KV_QUANT_WIRE_DTYPE
             )
         return c[idx]
 
@@ -160,6 +160,24 @@ class DeviceRunner:
             params, self._param_axes = quantize_params(params, self._param_axes)
         if mesh is not None:
             params = shard_params(params, self._param_axes, self.rules, mesh)
+        if self.args.layered_cache and not isinstance(
+            params.get("layers"), (tuple, list)
+        ):
+            # Serving layout: per-layer weight buffers next to the per-layer
+            # KV pools (see llama.unstack_layer_params — removes the
+            # per-step weight relayout fusions the stacked form costs).
+            params = dict(
+                params,
+                layers=llama.unstack_layer_params(
+                    params["layers"], self.config.n_layers
+                ),
+            )
+            self._param_axes = dict(
+                self._param_axes,
+                layers=llama.unstack_layer_axes(
+                    self._param_axes["layers"], self.config.n_layers
+                ),
+            )
         self.params = params
         self.k_cache, self.v_cache = self.alloc_kv_cache()
 

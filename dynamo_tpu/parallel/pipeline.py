@@ -55,6 +55,13 @@ def forward_paged_pp(
     c = config
     PP = mesh.shape[axis]
     B, C = tokens.shape
+    if isinstance(params.get("layers"), (tuple, list)):
+        raise ValueError(
+            "forward_paged_pp requires STACKED layer params ([L, ...] per "
+            "leaf, sliced over the pp axis); got the layered serving layout "
+            "— construct the runner with layered_cache=False for pipeline "
+            "parallelism"
+        )
     assert c.n_layers % PP == 0, "n_layers must divide by pp degree"
     assert B % PP == 0, "batch must divide into pp microbatches"
     M = PP  # microbatch count = stages (the classic GPipe fill)

@@ -229,7 +229,9 @@ async def test_lora_matches_merged_weights(lora_root):
     params = merged_engine.params
     for target, (A, B) in adapter.weights.items():
         delta = jnp.einsum("ldr,lrh->ldh", A, B) * adapter.scaling
-        params["layers"][target] = params["layers"][target] + delta
+        # layered serving layout: layers is a list of per-layer trees
+        for l, lp in enumerate(params["layers"]):
+            lp[target] = lp[target] + delta[l]
     try:
         merged = await run_one(merged_engine, req(prompt))
     finally:

@@ -250,6 +250,7 @@ async def test_engine_int8_random_init_uses_direct_path():
     )
     try:
         assert is_quantized(engine.params)
-        assert engine.params["layers"]["wq"]["q8"].dtype == jnp.int8
+        # layered_cache serving layout: layers is a list of per-layer trees
+        assert engine.params["layers"][0]["wq"]["q8"].dtype == jnp.int8
     finally:
         await engine.stop()
