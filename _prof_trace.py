@@ -32,7 +32,7 @@ CTX = int(os.environ.get("PCTX", 160))
 P = (CTX + 1 + BS - 1) // BS
 NB = max(B * P + 8, 192 * 128 // BS)
 STEPS = int(os.environ.get("PSTEPS", 16))
-OUT = sys.argv[1] if len(sys.argv) > 1 else "/root/repo/docs/design_docs/trace_8b"
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_8b"
 
 params = init_quantized_params(cfg, 0)
 axes = llama.param_logical_axes(cfg)

@@ -134,6 +134,19 @@ class KubeClient:
             "DELETE", self._cr_path(group, version, namespace, plural, name)
         )
 
+    async def patch(
+        self, group, version, namespace, plural, name, body: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """Merge-patch the main resource (spec/metadata). The scaling-adapter
+        flow uses this: the planner patches adapter spec.replicas, the
+        operator patches the target GraphDeployment's service replicas."""
+        return await self._request(
+            "PATCH",
+            self._cr_path(group, version, namespace, plural, name),
+            body=body,
+            content_type="application/merge-patch+json",
+        )
+
     async def patch_status(
         self, group, version, namespace, plural, name, status: Dict[str, Any],
     ) -> Dict[str, Any]:

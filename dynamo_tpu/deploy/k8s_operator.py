@@ -39,6 +39,19 @@ GROUP = "dynamo-tpu.io"
 VERSION = "v1alpha1"
 GD_PLURAL = "graphdeployments"
 DGDR_PLURAL = "graphdeploymentrequests"
+SA_PLURAL = "scalingadapters"
+CKPT_PLURAL = "checkpoints"
+
+
+def _identity_hash(identity: Dict[str, Any]) -> str:
+    """Deterministic hash of a checkpoint identity (dedup key; the role of
+    the reference's IdentityHash on DynamoCheckpoint status)."""
+    import hashlib
+    import json
+
+    return hashlib.sha256(
+        json.dumps(identity, sort_keys=True).encode()
+    ).hexdigest()[:16]
 
 
 def deployment_from_cr(cr: Dict[str, Any]) -> GraphDeployment:
@@ -63,6 +76,7 @@ class K8sGraphOperator:
         watch_timeout_s: float = 10.0,
         sla_profiles: Optional[Any] = None,  # List[ConfigProfile] for DGDR
         pod_backend: bool = False,  # actuate CRs as cluster pods, not procs
+        checkpoint_runner: Optional[Any] = None,  # async (identity) → location
     ) -> None:
         self.client = client
         self.k8s_namespace = k8s_namespace
@@ -71,13 +85,16 @@ class K8sGraphOperator:
         self.watch_timeout_s = watch_timeout_s
         self.sla_profiles = sla_profiles
         self.pod_backend = pod_backend
+        self.checkpoint_runner = checkpoint_runner
         self._swept_orphans = False
         self._controllers: Dict[str, GraphController] = {}
         self._specs: Dict[str, str] = {}  # name → serialized spec (drift check)
         self._dgdr_done: Dict[str, str] = {}  # name → outcome
+        self._ckpt_tasks: Dict[str, asyncio.Task] = {}  # name → running job
         self._tasks: list = []
         self._stop = asyncio.Event()
         self.reconciles = 0
+        self.adapter_scales = 0  # adapter-driven replica patches applied
 
     # -- GraphDeployment reconcile ----------------------------------------
 
@@ -305,17 +322,194 @@ class K8sGraphOperator:
             rec.prefill_workers, rec.decode_workers, rec.total_chips,
         )
 
+    # -- ScalingAdapter: the ONLY writer of GD service replicas ------------
+    #
+    # Autoscalers (planner, HPA-style controllers) patch the adapter CR's
+    # spec.replicas; this reconciler copies it onto the target
+    # GraphDeployment's service — the reference's anti-conflict design
+    # (ref: deploy/operator/api/v1alpha1/
+    # dynamographdeploymentscalingadapter_types.go:27-67: adapter is the
+    # intermediary so multiple autoscalers never race on the DGD itself).
+
+    async def reconcile_adapters_once(self) -> None:
+        try:
+            items, _rv = await self.client.list(
+                GROUP, VERSION, self.k8s_namespace, SA_PLURAL
+            )
+        except KubeApiError as exc:
+            if exc.status == 404:  # CRD not installed: adapters disabled
+                return
+            raise
+        for cr in items:
+            # Per-CR isolation (same as the GD pass): one malformed adapter
+            # must not starve the rest of the operator's reconcile loop.
+            try:
+                await self._reconcile_adapter(cr)
+            except Exception:
+                logger.exception(
+                    "adapter %s reconcile failed", cr["metadata"]["name"]
+                )
+
+    async def _reconcile_adapter(self, cr: Dict[str, Any]) -> None:
+        import time as _time
+
+        name = cr["metadata"]["name"]
+        spec = cr.get("spec") or {}
+        ref = spec.get("dgdRef") or {}
+        gd_name = ref.get("name")
+        svc_name = ref.get("serviceName")
+        try:
+            desired = int(spec.get("replicas"))
+        except (TypeError, ValueError):
+            await self._patch_adapter_status(
+                name, {"message": "spec.replicas must be an integer"}
+            )
+            return
+        if not gd_name or not svc_name:
+            return
+        try:
+            gd = await self.client.get(
+                GROUP, VERSION, self.k8s_namespace, GD_PLURAL, gd_name
+            )
+        except KubeApiError as exc:
+            if exc.status == 404:
+                await self._patch_adapter_status(
+                    name, {"message": f"GraphDeployment {gd_name} not found"}
+                )
+                return
+            raise
+        services = (gd.get("spec") or {}).get("services") or {}
+        svc = services.get(svc_name)
+        if svc is None:
+            await self._patch_adapter_status(
+                name, {"message": f"service {svc_name!r} not in {gd_name}"}
+            )
+            return
+        observed_spec = int(svc.get("replicas", 1))
+        # status.replicas backs the HPA scale subresource: report the
+        # OBSERVED ready count (GD status), never the just-written desired
+        # — echoing desired would make an autoscaler see phantom capacity.
+        ready = (
+            (gd.get("status") or {}).get("services") or {}
+        ).get(svc_name, {}).get("ready")
+        status: Dict[str, Any] = {
+            "replicas": int(ready) if ready is not None else observed_spec,
+            "selector": f"dynamo-tpu.io/deployment={gd_name}",
+            "message": "",
+        }
+        if observed_spec != desired:
+            await self.client.patch(
+                GROUP, VERSION, self.k8s_namespace, GD_PLURAL, gd_name,
+                {"spec": {"services": {svc_name: {"replicas": desired}}}},
+            )
+            self.adapter_scales += 1
+            status["lastScaleTime"] = _time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
+            )
+            logger.info(
+                "adapter %s: %s/%s replicas %d → %d",
+                name, gd_name, svc_name, observed_spec, desired,
+            )
+        await self._patch_adapter_status(name, status)
+
+    async def _patch_adapter_status(self, name: str, status: Dict[str, Any]):
+        try:
+            await self.client.patch_status(
+                GROUP, VERSION, self.k8s_namespace, SA_PLURAL, name, status
+            )
+        except KubeApiError:
+            pass
+
+    # -- Checkpoint: cluster-driveable warm-restart artifacts --------------
+    #
+    # A Checkpoint CR names a model identity; fulfilling it runs the warm
+    # tier (weight cache + jax compile cache priming) so later workers of
+    # that identity restart warm. Phases Pending → Creating → Ready/Failed
+    # mirror the reference (ref: deploy/operator/api/v1alpha1/
+    # dynamocheckpoint_types.go: identity→job→tar flow; here the "job" is
+    # the in-tree checkpoint runner instead of a CRIU tar builder).
+
+    async def reconcile_checkpoints_once(self) -> None:
+        try:
+            items, _rv = await self.client.list(
+                GROUP, VERSION, self.k8s_namespace, CKPT_PLURAL
+            )
+        except KubeApiError as exc:
+            if exc.status == 404:
+                return
+            raise
+        for cr in items:
+            name = cr["metadata"]["name"]
+            phase = (cr.get("status") or {}).get("phase")
+            if phase in ("Ready", "Failed") or name in self._ckpt_tasks:
+                continue
+            identity = (cr.get("spec") or {}).get("identity") or {}
+            ih = _identity_hash(identity)
+            await self._patch_ckpt_status(
+                name, {"phase": "Creating", "identityHash": ih}
+            )
+            self._ckpt_tasks[name] = asyncio.get_event_loop().create_task(
+                self._run_checkpoint(name, identity, ih),
+                name=f"ckpt-{name}",
+            )
+
+    async def _run_checkpoint(self, name, identity, ih) -> None:
+        runner = self.checkpoint_runner
+        if runner is None:
+            from dynamo_tpu.deploy.checkpoint_job import run_checkpoint_job
+
+            runner = run_checkpoint_job
+        try:
+            location = await runner(identity)
+            await self._patch_ckpt_status(
+                name,
+                {"phase": "Ready", "identityHash": ih, "location": location},
+            )
+            logger.info("checkpoint %s ready at %s", name, location)
+        except Exception as exc:
+            logger.exception("checkpoint %s failed", name)
+            await self._patch_ckpt_status(
+                name,
+                {
+                    "phase": "Failed",
+                    "identityHash": ih,
+                    "message": str(exc)[:500],
+                },
+            )
+        finally:
+            self._ckpt_tasks.pop(name, None)
+
+    async def _patch_ckpt_status(self, name: str, status: Dict[str, Any]):
+        try:
+            await self.client.patch_status(
+                GROUP, VERSION, self.k8s_namespace, CKPT_PLURAL, name, status
+            )
+        except KubeApiError:
+            pass
+
     # -- lifecycle ---------------------------------------------------------
 
     async def run(self) -> None:
         """Level-triggered loop: reconcile everything, then watch until the
         window closes (events only wake us early — the list is the truth)."""
         while not self._stop.is_set():
-            try:
-                await self.reconcile_deployments_once()
-                await self.reconcile_requests_once()
-            except Exception:
-                logger.exception("operator reconcile pass failed")
+            # Adapters first: their replica patches land before the GD
+            # pass reads the specs, so a scale round-trips in ONE pass.
+            # Each sub-pass is isolated — an optional feature failing (e.g.
+            # a 403 on the adapter list from a stale ClusterRole) must not
+            # starve deployment reconciliation.
+            for pass_fn in (
+                self.reconcile_adapters_once,
+                self.reconcile_deployments_once,
+                self.reconcile_requests_once,
+                self.reconcile_checkpoints_once,
+            ):
+                try:
+                    await pass_fn()
+                except Exception:
+                    logger.exception(
+                        "operator pass %s failed", pass_fn.__name__
+                    )
             # Block on the watch stream until something changes or the
             # window times out, then loop back to a full re-list.
             try:
@@ -337,6 +531,13 @@ class K8sGraphOperator:
 
     async def stop(self, *, teardown: bool = True) -> None:
         self._stop.set()
+        for t in list(self._ckpt_tasks.values()):
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._ckpt_tasks = {}
         for t in self._tasks:
             t.cancel()
             try:

@@ -77,9 +77,38 @@ def validate_request(request_obj: Dict[str, Any]) -> Tuple[bool, str]:
     return True, "ok"
 
 
+def validate_scaling_adapter(obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """Adapter validation: non-negative replicas + complete dgdRef."""
+    spec = obj.get("spec") or {}
+    replicas = spec.get("replicas")
+    try:
+        if int(replicas) < 0:
+            return False, "spec.replicas must be >= 0"
+    except (TypeError, ValueError):
+        return False, "spec.replicas must be an integer"
+    ref = spec.get("dgdRef") or {}
+    if not ref.get("name") or not ref.get("serviceName"):
+        return False, "spec.dgdRef.name and spec.dgdRef.serviceName required"
+    return True, "ok"
+
+
+def validate_checkpoint(obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """Checkpoint validation: a model identity is required."""
+    spec = obj.get("spec") or {}
+    identity = spec.get("identity") or {}
+    if not identity.get("model"):
+        return False, "spec.identity.model is required"
+    quant = identity.get("quantization")
+    if quant not in (None, "", "int8"):
+        return False, f"unsupported quantization {quant!r} (int8 only)"
+    return True, "ok"
+
+
 _KIND_VALIDATORS = {
     "DynamoTpuGraphDeployment": validate_graph_deployment,
     "DynamoTpuGraphDeploymentRequest": validate_request,
+    "DynamoTpuScalingAdapter": validate_scaling_adapter,
+    "DynamoTpuCheckpoint": validate_checkpoint,
 }
 
 

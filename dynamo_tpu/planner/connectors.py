@@ -22,6 +22,86 @@ def planner_key(namespace: str) -> str:
     return f"planner/{namespace}/desired"
 
 
+class ScalingAdapterConnector:
+    """Apply a ReplicaPlan by patching ScalingAdapter CRs — the planner
+    never touches pods or GraphDeployments directly; the operator's adapter
+    reconciler is the single writer of service replicas.
+
+    Reference parity: components/src/dynamo/planner/kubernetes_connector.py
+    (planner patches a CR, operator reconciles) +
+    deploy/operator/api/v1alpha1/dynamographdeploymentscalingadapter_types.go
+    (the adapter intermediary that serializes autoscaler writes)."""
+
+    def __init__(
+        self,
+        client: Any,  # deploy.k8s_client.KubeClient
+        deployment: str,  # target GraphDeployment name
+        *,
+        k8s_namespace: str = "default",
+        prefill_service: str = "prefill",
+        decode_service: str = "decode",
+    ) -> None:
+        self.client = client
+        self.deployment = deployment
+        self.k8s_namespace = k8s_namespace
+        self.prefill_service = prefill_service
+        self.decode_service = decode_service
+        self.applied: Optional[Dict[str, int]] = None
+
+    def _adapter_name(self, service: str) -> str:
+        return f"{self.deployment}-{service}"
+
+    async def _ensure_and_patch(self, service: str, replicas: int) -> None:
+        from dynamo_tpu.deploy.k8s_operator import (
+            GROUP, SA_PLURAL, VERSION,
+        )
+        from dynamo_tpu.deploy.k8s_client import KubeApiError
+
+        name = self._adapter_name(service)
+        body = {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoTpuScalingAdapter",
+            "metadata": {"name": name},
+            "spec": {
+                "replicas": int(replicas),
+                "dgdRef": {
+                    "name": self.deployment,
+                    "serviceName": service,
+                },
+            },
+        }
+        try:
+            await self.client.patch(
+                GROUP, VERSION, self.k8s_namespace, SA_PLURAL, name,
+                {"spec": {"replicas": int(replicas)}},
+            )
+        except KubeApiError as exc:
+            if exc.status != 404:
+                raise
+            await self.client.create(
+                GROUP, VERSION, self.k8s_namespace, SA_PLURAL, body
+            )
+
+    async def apply(self, plan) -> None:
+        if self.prefill_service == self.decode_service:
+            # Aggregated single-pool deployment: one adapter serves both
+            # roles — size it for the LARGER demand instead of letting the
+            # second write silently clobber the first.
+            await self._ensure_and_patch(
+                self.decode_service, max(int(plan.prefill), int(plan.decode))
+            )
+        else:
+            await self._ensure_and_patch(self.prefill_service, plan.prefill)
+            await self._ensure_and_patch(self.decode_service, plan.decode)
+        self.applied = {
+            "prefill": int(plan.prefill), "decode": int(plan.decode)
+        }
+        logger.info(
+            "planner → adapters %s: prefill=%d decode=%d (%s)",
+            self.deployment, plan.prefill, plan.decode, plan.reason,
+        )
+
+
 class VirtualConnector:
     def __init__(self, discovery: Any, namespace: str) -> None:
         self.discovery = discovery

@@ -20,10 +20,12 @@ from aiohttp import web
 
 from dynamo_tpu.deploy.k8s_client import KubeClient
 from dynamo_tpu.deploy.k8s_operator import (
+    CKPT_PLURAL,
     DGDR_PLURAL,
     GD_PLURAL,
     GROUP,
     K8sGraphOperator,
+    SA_PLURAL,
     VERSION,
 )
 
@@ -50,7 +52,7 @@ class FakeApiServer:
 
     def app(self) -> web.Application:
         app = web.Application()
-        for plural in (GD_PLURAL, DGDR_PLURAL):
+        for plural in (GD_PLURAL, DGDR_PLURAL, SA_PLURAL, CKPT_PLURAL):
             base = self._path(plural)
             app.router.add_get(base, self._make_list(plural))
             app.router.add_post(base, self._make_create(plural))
@@ -59,6 +61,7 @@ class FakeApiServer:
             app.router.add_patch(
                 base + "/{name}/status", self._make_patch_status(plural)
             )
+            app.router.add_patch(base + "/{name}", self._make_patch(plural))
         # core/v1 pods + services (the fake kubelet runs every pod at once)
         for plural in ("pods", "services"):
             base = f"/api/v1/namespaces/{{ns}}/{plural}"
@@ -163,6 +166,27 @@ class FakeApiServer:
                 return web.json_response({"reason": "NotFound"}, status=404)
             self.bump(obj)
             return web.json_response({})
+        return handler
+
+    def _make_patch(self, plural):
+        async def handler(request):
+            obj = self.store.get((plural, request.match_info["name"]))
+            if obj is None:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            patch = await request.json()
+
+            def merge(dst, src):  # RFC 7386 merge-patch semantics
+                for k, v in src.items():
+                    if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    elif v is None:
+                        dst.pop(k, None)
+                    else:
+                        dst[k] = v
+
+            merge(obj, patch)
+            self.bump(obj)
+            return web.json_response(obj)
         return handler
 
     def _make_patch_status(self, plural):
@@ -552,3 +576,150 @@ async def test_admission_webhook_validates_crs():
             assert other["allowed"]
     finally:
         await server.close()
+
+
+async def test_scaling_adapter_drives_gd_replicas():
+    """Planner patches the adapter CR; the operator's adapter reconciler is
+    the single writer of GD service replicas (ref: scalingadapter_types.go
+    intermediary design)."""
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    client = KubeClient(url)
+    op = K8sGraphOperator(client, watch_timeout_s=1.0)
+    try:
+        fake.apply(GD_PLURAL, "demo", gd_spec(1))
+        # planner-side connector creates + patches the adapter CR
+        from dynamo_tpu.planner.connectors import ScalingAdapterConnector
+        from dynamo_tpu.planner.planner_core import ReplicaPlan
+
+        conn = ScalingAdapterConnector(
+            client, "demo", decode_service="backend",
+            prefill_service="backend",
+        )
+        await conn.apply(ReplicaPlan(prefill=3, decode=3, reason="load"))
+        assert ("scalingadapters", "demo-backend") in fake.store
+
+        await op.reconcile_adapters_once()
+        gd = fake.store[(GD_PLURAL, "demo")]
+        assert gd["spec"]["services"]["backend"]["replicas"] == 3
+        assert op.adapter_scales == 1
+        sa = fake.store[(SA_PLURAL, "demo-backend")]
+        # status.replicas reports OBSERVED capacity (pre-scale spec here:
+        # no GD ready status yet), never the just-written desired count.
+        assert sa["status"]["replicas"] == 1
+        assert sa["status"]["selector"] == "dynamo-tpu.io/deployment=demo"
+        assert sa["status"].get("lastScaleTime")
+
+        # full pass: adapter patch lands before the GD reconcile reads it
+        await op.reconcile_adapters_once()
+        await op.reconcile_deployments_once()
+        await asyncio.sleep(0.3)
+        await op.reconcile_deployments_once()
+        gd = fake.store[(GD_PLURAL, "demo")]
+        assert gd["status"]["services"]["backend"]["ready"] == 3
+        # once the GD reports ready, the adapter's scale surface follows
+        await op.reconcile_adapters_once()
+        sa = fake.store[(SA_PLURAL, "demo-backend")]
+        assert sa["status"]["replicas"] == 3
+
+        # scale back down through the same path
+        await conn.apply(ReplicaPlan(prefill=1, decode=1, reason="idle"))
+        await op.reconcile_adapters_once()
+        gd = fake.store[(GD_PLURAL, "demo")]
+        assert gd["spec"]["services"]["backend"]["replicas"] == 1
+
+        # dangling dgdRef → message in status, no crash
+        fake.apply(SA_PLURAL, "bad", {
+            "replicas": 2, "dgdRef": {"name": "ghost", "serviceName": "x"},
+        })
+        # malformed replicas → message in status, and the rest of the
+        # pass still reconciles (per-CR isolation)
+        fake.apply(SA_PLURAL, "worse", {
+            "replicas": "abc", "dgdRef": {"name": "demo", "serviceName": "backend"},
+        })
+        await op.reconcile_adapters_once()
+        assert "not found" in fake.store[(SA_PLURAL, "bad")]["status"]["message"]
+        assert "integer" in fake.store[(SA_PLURAL, "worse")]["status"]["message"]
+    finally:
+        await op.stop()
+        await runner.cleanup()
+
+
+async def test_checkpoint_cr_lifecycle():
+    """Checkpoint CR: Pending → Creating → Ready with identityHash +
+    location from the runner; a failing runner lands Failed with message
+    (ref: dynamocheckpoint_types.go phase machine)."""
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    client = KubeClient(url)
+    ran = []
+
+    async def fake_runner(identity):
+        ran.append(identity)
+        if identity.get("model") == "boom":
+            raise RuntimeError("no such weights")
+        return f"/dev/shm/ckpt/{identity['model']}"
+
+    op = K8sGraphOperator(
+        client, watch_timeout_s=1.0, checkpoint_runner=fake_runner
+    )
+    try:
+        fake.apply(CKPT_PLURAL, "warm-8b", {
+            "identity": {"model": "llama-3-8b", "quantization": "int8"},
+        })
+        await op.reconcile_checkpoints_once()
+        assert await _wait_for(
+            lambda: fake.store[(CKPT_PLURAL, "warm-8b")]["status"].get("phase")
+            == "Ready"
+        )
+        st = fake.store[(CKPT_PLURAL, "warm-8b")]["status"]
+        assert st["location"].endswith("llama-3-8b")
+        assert len(st["identityHash"]) == 16
+        assert ran == [{"model": "llama-3-8b", "quantization": "int8"}]
+
+        # idempotent: Ready CRs are not re-run
+        await op.reconcile_checkpoints_once()
+        await asyncio.sleep(0.1)
+        assert len(ran) == 1
+
+        # failure path
+        fake.apply(CKPT_PLURAL, "bad", {"identity": {"model": "boom"}})
+        await op.reconcile_checkpoints_once()
+        assert await _wait_for(
+            lambda: fake.store[(CKPT_PLURAL, "bad")]["status"].get("phase")
+            == "Failed"
+        )
+        assert "no such weights" in fake.store[(CKPT_PLURAL, "bad")]["status"]["message"]
+    finally:
+        await op.stop()
+        await runner.cleanup()
+
+
+async def test_webhook_validates_new_kinds():
+    from dynamo_tpu.deploy.webhook import review_response
+
+    def rev(kind, spec):
+        return review_response({
+            "request": {
+                "uid": "u",
+                "object": {
+                    "kind": kind,
+                    "metadata": {"name": "t"},
+                    "spec": spec,
+                },
+            }
+        })["response"]
+
+    ok = rev("DynamoTpuScalingAdapter",
+             {"replicas": 2, "dgdRef": {"name": "a", "serviceName": "b"}})
+    assert ok["allowed"]
+    assert not rev("DynamoTpuScalingAdapter",
+                   {"replicas": -1,
+                    "dgdRef": {"name": "a", "serviceName": "b"}})["allowed"]
+    assert not rev("DynamoTpuScalingAdapter",
+                   {"replicas": 1, "dgdRef": {"name": "a"}})["allowed"]
+    assert rev("DynamoTpuCheckpoint",
+               {"identity": {"model": "tiny", "quantization": "int8"}})["allowed"]
+    assert not rev("DynamoTpuCheckpoint", {"identity": {}})["allowed"]
+    assert not rev("DynamoTpuCheckpoint",
+                   {"identity": {"model": "t", "quantization": "fp4"}})["allowed"]
