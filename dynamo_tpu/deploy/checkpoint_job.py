@@ -5,58 +5,62 @@ deploy/chrek — the reference builds a CRIU process-image tar in a Job; the
 TPU-native warm-restart tiers are (a) quantized weights in the tmpfs/disk
 weight cache (models/weight_cache.py — measured cold 39.7 s → warm 7.0 s
 restart, bench/restart.py) and (b) the persistent jax compile cache. This
-job materializes tier (a) for the named identity so any later worker of
-that identity starts warm, cluster-driven via the Checkpoint CRD.
+job materializes tier (a) through the SAME loader path workers use
+(load_checkpoint_cached — same fingerprint key, same shm/disk tiers), so a
+Ready Checkpoint CR means the identity's next worker start is a cache hit,
+cluster-driven via the Checkpoint CRD.
 """
 
 from __future__ import annotations
 
 import asyncio
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-DEFAULT_CACHE_DIR = os.environ.get(
-    "DYN_TPU_WEIGHT_CACHE", "/dev/shm/dynamo_tpu_weights"
-)
 
+def _warm(identity: Dict[str, Any], shm_dir: Optional[str],
+          cache_dir: Optional[str]) -> str:
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models import weight_cache as wc
 
-def _build_and_save(identity: Dict[str, Any], cache_dir: str) -> str:
-    from dynamo_tpu.models import llama
-    from dynamo_tpu.models.weight_cache import save_params
-    from dynamo_tpu.worker.__main__ import BUILTIN_CONFIGS
-
-    model = identity.get("model") or "tiny"
-    if model not in BUILTIN_CONFIGS:
+    model_dir = identity.get("modelDir")
+    if not model_dir or not os.path.isdir(model_dir):
+        # Builtin-config workers random-init in the engine — there is no
+        # weight artifact to warm, so a Ready status would be a lie.
         raise ValueError(
-            f"unknown model {model!r} (builtin: {sorted(BUILTIN_CONFIGS)})"
+            "identity.modelDir must name a checkpoint directory; workers "
+            "load through load_checkpoint_cached(model_dir, ...) and only "
+            "that path has warm tiers (builtin-name identities random-init)"
         )
-    config = BUILTIN_CONFIGS[model]()
-    quant = identity.get("quantization")
-    key = f"ckpt-{model}-{quant or 'fp'}"
-
-    import jax
-
-    params = llama.init_params(config, jax.random.PRNGKey(0))
-    if quant == "int8":
-        from dynamo_tpu.models.quantize import quantize_params
-
-        params, _ = quantize_params(params, llama.param_logical_axes(config))
-    import numpy as np
-
-    host = jax.tree_util.tree_map(lambda a: np.asarray(a), params)
-    return save_params(cache_dir, key, host)
+    config = ModelConfig.from_model_dir(model_dir)
+    quant = identity.get("quantization") or None
+    kwargs: Dict[str, Any] = {"quantization": quant}
+    if cache_dir:
+        kwargs["cache_dir"] = cache_dir
+    if shm_dir is not None:
+        kwargs["shm_dir"] = shm_dir
+    _params, hit = wc.load_checkpoint_cached(model_dir, config, **kwargs)
+    tier = shm_dir if shm_dir is not None else wc.SHM_CACHE_DIR
+    location = tier or kwargs.get("cache_dir", wc.DEFAULT_CACHE_DIR)
+    logger.info(
+        "checkpoint warm for %s (%s): %s", model_dir,
+        "already cached" if hit else "ingested", location,
+    )
+    return location
 
 
 async def run_checkpoint_job(
-    identity: Dict[str, Any], cache_dir: str = DEFAULT_CACHE_DIR
+    identity: Dict[str, Any],
+    shm_dir: Optional[str] = None,
+    cache_dir: Optional[str] = None,
 ) -> str:
-    """Build the identity's weights (builtin config; real deployments point
-    model at a checkpoint dir handled by hf_loader+weight_cache) and stash
-    them in the warm tier. Returns the cache path (CR status.location)."""
+    """Ingest the identity's checkpoint through the worker loader path,
+    populating the shm + disk weight tiers under the loader's own
+    fingerprint key. Returns the warm-tier path (CR status.location)."""
     return await asyncio.get_event_loop().run_in_executor(
-        None, _build_and_save, identity, cache_dir
+        None, _warm, identity, shm_dir, cache_dir
     )

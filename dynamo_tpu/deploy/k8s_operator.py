@@ -510,18 +510,37 @@ class K8sGraphOperator:
                     logger.exception(
                         "operator pass %s failed", pass_fn.__name__
                     )
-            # Block on the watch stream until something changes or the
-            # window times out, then loop back to a full re-list.
-            try:
-                async for _event in self.client.watch(
-                    GROUP, VERSION, self.k8s_namespace, GD_PLURAL,
-                    timeout_s=self.watch_timeout_s,
-                ):
-                    break  # any event → re-reconcile
-            except KubeApiError:
-                await asyncio.sleep(self.reconcile_interval_s)
-            except Exception:
-                await asyncio.sleep(self.reconcile_interval_s)
+            # Block on watch streams (ALL reconciled kinds — a planner
+            # write to a ScalingAdapter or a new Checkpoint must wake the
+            # loop as promptly as a GD change) until something changes or
+            # the window times out, then loop back to a full re-list.
+            async def _first_event(plural: str) -> None:
+                try:
+                    async for _event in self.client.watch(
+                        GROUP, VERSION, self.k8s_namespace, plural,
+                        timeout_s=self.watch_timeout_s,
+                    ):
+                        return
+                except Exception:
+                    # Uninstalled CRD (404) or transient apiserver error:
+                    # park for the window so this watcher neither wakes the
+                    # loop early nor busy-spins it.
+                    await asyncio.sleep(self.watch_timeout_s)
+
+            tasks = [
+                asyncio.ensure_future(_first_event(p))
+                for p in (GD_PLURAL, SA_PLURAL, CKPT_PLURAL)
+            ]
+            _done, pending = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in pending:
+                t.cancel()
+            for t in pending:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
 
     def start(self) -> None:
         self._stop.clear()

@@ -110,6 +110,12 @@ class JaxEngineArgs:
     # sequences a fixed HBM budget can hold. The reference's
     # kv_cache_dtype=fp8 engine lever, TPU-style. Requires layered_cache.
     kv_cache_dtype: Optional[str] = None
+    # Fused-layer decode megakernel (ops/pallas/fused_layer.py): one pallas
+    # program per layer streaming int8 weights with the attention page
+    # fetches overlapped. None = auto (TPU + int8 weights + layered bf16
+    # cache + eligible architecture). The XLA path stays the fallback for
+    # every ineligible shape and for prefill.
+    use_megakernel: Optional[bool] = None
 
     @property
     def max_blocks_per_seq(self) -> int:

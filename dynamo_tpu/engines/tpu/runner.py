@@ -129,6 +129,29 @@ class DeviceRunner:
         self.use_kernel = (
             args.use_kernel if args.use_kernel is not None else backend == "tpu"
         )
+        from dynamo_tpu.ops.pallas.fused_layer import supports as _mk_supports
+
+        mk_eligible = (
+            args.layered_cache
+            and not getattr(args, "kv_cache_dtype", None)
+            and args.quantization == "int8"
+            and mesh is None
+            and args.max_num_seqs % 4 == 0
+            and _mk_supports(
+                args.config, lora=bool(args.lora_dir), quantized_weights=True
+            )
+        )
+        if args.use_megakernel is None:
+            self.use_megakernel = backend == "tpu" and mk_eligible
+        else:
+            self.use_megakernel = bool(args.use_megakernel) and mk_eligible
+            if args.use_megakernel and not mk_eligible:
+                logger.warning(
+                    "use_megakernel=True requested but the configuration is "
+                    "ineligible (needs: layered bf16 cache, int8 weights, "
+                    "no mesh/LoRA, max_num_seqs %% 4 == 0, supported "
+                    "architecture) — falling back to the XLA decode path"
+                )
         if self.multihost and mesh is None:
             raise ValueError("multihost topology requires a device mesh")
         self._repl = (
@@ -429,6 +452,7 @@ class DeviceRunner:
                          want_procs: bool = False):
         cfg = self.config
         use_kernel = self.use_kernel
+        use_megakernel = self.use_megakernel
         num_steps = self.args.decode_steps
 
         # The logprobs program variants also surface the per-step top-N
@@ -443,6 +467,7 @@ class DeviceRunner:
                     params, cfg, tokens, start_pos, active, block_tables,
                     k_cache, v_cache, rng, temp, topk, topp,
                     num_steps=num_steps, use_kernel=use_kernel,
+                    use_megakernel=use_megakernel,
                     lora=lora, adapter_ids=adapter_ids,
                     want_logprobs=want_logprobs,
                     num_top_logprobs=num_top,
@@ -467,6 +492,7 @@ class DeviceRunner:
                 params, cfg, tokens, start_pos, active, block_tables,
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
+                use_megakernel=use_megakernel,
                 lora=lora, adapter_ids=adapter_ids,
                 want_logprobs=want_logprobs,
                 min_p=minp, proc_params=pp, proc_state=st,

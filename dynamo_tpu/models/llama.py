@@ -424,6 +424,7 @@ def forward_paged(
     mm_slot: Optional[jnp.ndarray] = None,  # [B, C] int32 row into mm_embeds, -1=text
     all_logits: bool = False,  # True → logits for EVERY position [B, C, V]
     first_chunk: bool = False,  # static: fresh prefill, dense in-chunk attention
+    use_megakernel: bool = False,  # C=1: fused-layer pallas decode path
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step over a chunk. Returns (last_logits [B, V], k_cache,
     v_cache). K/V for the chunk are scattered into the pools before attending,
@@ -451,6 +452,57 @@ def forward_paged(
         # HLO grows ~L× but is traced once; compile stays cached.
         win_list = c.layer_windows()
         layered_params = isinstance(params["layers"], (tuple, list))
+        from dynamo_tpu.ops.pallas.fused_layer import MAX_TABLE_PAGES
+
+        if (
+            use_megakernel
+            and C == 1
+            and layered_params
+            and not lora
+            and block_tables.shape[1] <= MAX_TABLE_PAGES
+        ):
+            # Fused-layer decode megakernel (ops/pallas/fused_layer.py):
+            # one pallas program per layer; the current token's K/V come
+            # back as outputs and are scattered AFTER (the kernel attends
+            # history pages + the in-register token).
+            from dynamo_tpu.ops.attention import write_chunk_to_cache
+            from dynamo_tpu.ops.pallas.fused_layer import (
+                fused_decoder_layer,
+            )
+
+            sm = (
+                c.query_scale**-0.5
+                if c.query_scale is not None
+                else c.head_dim_**-0.5
+            )
+            x2 = x[:, 0]
+            cos1, sin1 = cos[:, 0], sin[:, 0]
+            k_out, v_out = [], []
+            for l in range(c.n_layers):
+                x2, k_n, v_n = fused_decoder_layer(
+                    x2, cos1, sin1, params["layers"][l],
+                    k_cache[l], v_cache[l], block_tables, start_pos,
+                    eps=c.rms_norm_eps, sm_scale=sm,
+                )
+                k_out.append(
+                    write_chunk_to_cache(
+                        k_cache[l], k_n[:, None], block_tables,
+                        start_pos, chunk_lens,
+                    )
+                )
+                v_out.append(
+                    write_chunk_to_cache(
+                        v_cache[l], v_n[:, None], block_tables,
+                        start_pos, chunk_lens,
+                    )
+                )
+            x = x2[:, None]
+            k_cache, v_cache = tuple(k_out), tuple(v_out)
+            if all_logits:
+                return lm_head_logits(params, c, x), k_cache, v_cache
+            return (
+                lm_head_logits(params, c, x[:, 0]), k_cache, v_cache
+            )
         k_out, v_out = [], []
         for l in range(c.n_layers):
             if layered_params:
@@ -595,6 +647,7 @@ def decode_multi(
     *,
     num_steps: int,
     use_kernel: bool = False,
+    use_megakernel: bool = False,
     lora: Optional[Dict[str, Any]] = None,
     adapter_ids: Optional[jnp.ndarray] = None,
     want_logprobs: bool = True,
@@ -635,7 +688,8 @@ def decode_multi(
             st = None
         logits, k_c, v_c = forward_paged(
             params, config, toks[:, None], pos, active, block_tables, k_c, v_c,
-            use_kernel=use_kernel, lora=lora, adapter_ids=adapter_ids,
+            use_kernel=use_kernel, use_megakernel=use_megakernel, lora=lora,
+            adapter_ids=adapter_ids,
         )
         if proc_params is not None:
             logits = lp.apply(logits, proc_params, st)

@@ -723,3 +723,73 @@ async def test_webhook_validates_new_kinds():
     assert not rev("DynamoTpuCheckpoint", {"identity": {}})["allowed"]
     assert not rev("DynamoTpuCheckpoint",
                    {"identity": {"model": "t", "quantization": "fp4"}})["allowed"]
+
+
+async def test_checkpoint_default_runner_warms_worker_loader(tmp_path):
+    """End-to-end warm restart via CRD: the DEFAULT checkpoint runner must
+    populate the SAME tier/key the worker loader reads — after the CR goes
+    Ready, load_checkpoint_cached() for that identity is a cache hit."""
+    import pytest as _pytest
+
+    _pytest.importorskip("transformers")
+    import functools
+
+    import torch
+    import transformers
+
+    hf = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    model = transformers.LlamaForCausalLM(hf).eval().to(torch.float32)
+    model_dir = str(tmp_path / "model")
+    model.save_pretrained(model_dir, safe_serialization=True)
+    shm = str(tmp_path / "shm")
+    disk = str(tmp_path / "disk")
+
+    from dynamo_tpu.deploy.checkpoint_job import run_checkpoint_job
+
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    client = KubeClient(url)
+    op = K8sGraphOperator(
+        client, watch_timeout_s=1.0,
+        checkpoint_runner=functools.partial(
+            run_checkpoint_job, shm_dir=shm, cache_dir=disk
+        ),
+    )
+    try:
+        fake.apply(CKPT_PLURAL, "warm", {
+            "identity": {"model": "tiny-hf", "modelDir": model_dir},
+        })
+        await op.reconcile_checkpoints_once()
+        assert await _wait_for(
+            lambda: fake.store[(CKPT_PLURAL, "warm")]["status"].get("phase")
+            in ("Ready", "Failed"), timeout=120.0,
+        )
+        st = fake.store[(CKPT_PLURAL, "warm")]["status"]
+        assert st["phase"] == "Ready", st
+        assert st["location"] == shm
+
+        # the worker loader now hits the tier the job populated
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.models.weight_cache import load_checkpoint_cached
+
+        _params, hit = load_checkpoint_cached(
+            model_dir, ModelConfig.from_model_dir(model_dir),
+            cache_dir=disk, shm_dir=shm,
+        )
+        assert hit, "Ready checkpoint did not warm the loader path"
+
+        # identity without modelDir → Failed with a truthful message
+        fake.apply(CKPT_PLURAL, "builtin", {"identity": {"model": "tiny"}})
+        await op.reconcile_checkpoints_once()
+        assert await _wait_for(
+            lambda: fake.store[(CKPT_PLURAL, "builtin")]["status"].get("phase")
+            == "Failed"
+        )
+        assert "modelDir" in fake.store[(CKPT_PLURAL, "builtin")]["status"]["message"]
+    finally:
+        await op.stop()
+        await runner.cleanup()
