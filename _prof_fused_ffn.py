@@ -162,7 +162,7 @@ def in_program(f):
 
 if __name__ == "__main__":
     # correctness first
-    if "check" in sys.argv or True:
+    if True:  # correctness gate always runs (cheap vs the bench)
         a = np.asarray(ffn_pallas(x0, wg, wu, wd, sg, su, sd), dtype=np.float32)
         b = np.asarray(ffn_xla(x0, wg, wu, wd, sg, su, sd), dtype=np.float32)
         err = np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-9)

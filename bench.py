@@ -307,7 +307,8 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
 
 
 async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
-                         requests: int = 12):
+                         requests: int = 12, *, ceiling_only: bool = False,
+                         n_layers: int | None = None):
     """Disaggregated P/D measurement — the north-star metric's missing
     number (BASELINE.md: 'disaggregated Llama-3-70B'; ref methodology
     docs/benchmarks/benchmarking.md). One chip timeshares a prefill engine
@@ -345,10 +346,16 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
     from dynamo_tpu.runtime.distributed import DistributedRuntime
     from dynamo_tpu.runtime.pipeline import build_pipeline
 
+    import dataclasses
+
+    cfg = qwen2_500m_config()
+    if n_layers:
+        cfg = dataclasses.replace(cfg, n_layers=n_layers)
+
     def mk_engine():
         return JaxEngine(
             JaxEngineArgs(
-                config=qwen2_500m_config(),
+                config=cfg,
                 block_size=128,
                 num_kv_blocks=256,
                 max_num_seqs=concurrency,
@@ -360,7 +367,7 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
         )
 
     rng = np.random.default_rng(7)
-    V = qwen2_500m_config().vocab_size
+    V = cfg.vocab_size
 
     def mk_req(i):
         return PreprocessedRequest(
@@ -404,15 +411,17 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
         }
 
     # -- aggregated control -------------------------------------------------
-    agg = mk_engine()
-    try:
-        await run_wave(lambda r: agg.generate(r, Context()), concurrency)
-        res, wall = await run_wave(
-            lambda r: agg.generate(r, Context()), requests
-        )
-        agg_stats = stats(res, wall)
-    finally:
-        await agg.stop()
+    agg_stats = None
+    if not ceiling_only:
+        agg = mk_engine()
+        try:
+            await run_wave(lambda r: agg.generate(r, Context()), concurrency)
+            res, wall = await run_wave(
+                lambda r: agg.generate(r, Context()), requests
+            )
+            agg_stats = stats(res, wall)
+        finally:
+            await agg.stop()
 
     # -- disaggregated ------------------------------------------------------
     rt = DistributedRuntime.detached()
@@ -488,6 +497,66 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
             if pulled and nbytes:
                 xfer_rates.append(nbytes / dt)
         xfer_mb_s = round(max(xfer_rates) / 1e6, 1) if xfer_rates else None
+
+        if ceiling_only:
+            # On-host ceiling mode (VERDICT r4 item 5): the same gather →
+            # wire → scatter path with NO device tunnel in it — the
+            # framework's own transfer cost as a number. Also measure
+            # decode ITL with and without a concurrent export stream
+            # draining (VERDICT item 4's overlap bound).
+            # warm + baseline on the SAME engine the loaded wave uses so
+            # the degradation ratio compares compiled-state like-for-like
+            await run_wave(
+                lambda r: prefill_engine.generate(r, Context()), concurrency
+            )
+            base_res, base_wall = await run_wave(
+                lambda r: prefill_engine.generate(r, Context()), concurrency
+            )
+            base_itl = stats(base_res, base_wall)["p50_itl_ms"]
+
+            stop_xfer = asyncio.Event()
+
+            async def export_loop():
+                from dynamo_tpu.tokens.blocks import (
+                    compute_block_hashes as cbh,
+                )
+                prompt = rng.integers(10, V - 10, size=isl).tolist()
+                r = mk_req(77_000)
+                r.token_ids = prompt
+                r.stop.max_tokens = 1
+                async for _ in prefill_engine.generate(r, Context()):
+                    pass
+                hashes = cbh(prompt, 128)
+                while not stop_xfer.is_set():
+                    await prefill_engine.export_blocks_async(hashes)
+
+            xfer_task = asyncio.ensure_future(export_loop())
+            await asyncio.sleep(0.2)
+            loaded_res, loaded_wall = await run_wave(
+                lambda r: prefill_engine.generate(r, Context()), concurrency
+            )
+            stop_xfer.set()
+            try:
+                await xfer_task
+            except Exception:
+                pass
+            loaded_itl = stats(loaded_res, loaded_wall)["p50_itl_ms"]
+            return {
+                "transfer_onhost_mb_per_s": xfer_mb_s,
+                "itl_ms": base_itl,
+                "itl_under_transfer_ms": loaded_itl,
+                "itl_transfer_degradation": round(
+                    loaded_itl / max(base_itl, 1e-9) - 1.0, 3
+                ),
+                "n_layers": cfg.n_layers,
+                "note": (
+                    "CPU backend, no tunnel in the path; on this 1-core "
+                    "host the engines, wire, and decode compute share one "
+                    "core, so itl degradation bounds CPU contention, not "
+                    "device stalls (overlap is asserted by "
+                    "tests/test_disagg.py::test_export_readback_overlaps_decode)"
+                ),
+            }
 
         res, wall = await run_wave(gen, requests)
         dis_stats = stats(res, wall)
@@ -631,8 +700,44 @@ async def run_bench():
             out["disagg"] = await run_disagg_leg()
         except Exception as exc:  # never kill the headline
             out["disagg"] = {"error": f"{type(exc).__name__}: {exc}"}
+        # On-host ceiling companion (CPU subprocess, no tunnel in the
+        # path): the framework's OWN transfer rate next to the tunneled
+        # number, so the dev-tunnel RTT floor can't masquerade as
+        # framework cost (VERDICT r4 item 5).
+        try:
+            import subprocess
+            import sys as _sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [_sys.executable, os.path.abspath(__file__),
+                 "--disagg-ceiling"],
+                env=env, capture_output=True, text=True, timeout=900,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+            if isinstance(out.get("disagg"), dict):
+                out["disagg"]["onhost"] = json.loads(line)
+        except Exception as exc:
+            if isinstance(out.get("disagg"), dict):
+                out["disagg"]["onhost"] = {
+                    "error": f"{type(exc).__name__}: {exc}"
+                }
     print(json.dumps(out))
 
 
+async def run_disagg_ceiling():
+    res = await run_disagg_leg(
+        isl=512, osl=8, concurrency=2, ceiling_only=True, n_layers=4
+    )
+    print(json.dumps(res))
+
+
 if __name__ == "__main__":
-    asyncio.run(run_bench())
+    import sys as _sys
+
+    if "--disagg-ceiling" in _sys.argv:
+        asyncio.run(run_disagg_ceiling())
+    else:
+        asyncio.run(run_bench())

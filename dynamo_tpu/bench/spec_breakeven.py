@@ -123,21 +123,59 @@ def measure(model: str = "llama3-8b", quant: str | None = "int8",
 
     t_decode = time_loop(dec_call, 3, lambda o: o[0]) / iters
 
-    # spec verify: ONE [B, k+1] forward + argmax at every position
+    # spec verify: ONE [B, k+1] forward + rejection-sampling acceptance at
+    # every position (greedy rows degrade to argmax verify inside the same
+    # program)
     ver_toks = np.ones((batch, spec_k + 1), np.int32)
     lens = np.full((batch,), spec_k + 1, np.int32)
     if runner._spec_fn is None:
         runner._spec_fn = runner._build_spec_fn()
 
-    def ver_call():
-        out = runner._spec_fn(
-            runner.params, runner.lora, runner.k_cache, runner.v_cache,
-            d(ver_toks), d(pos), d(lens), d(tables), None,
-        )
-        runner.k_cache, runner.v_cache = out[-2], out[-1]
-        return out
+    def mk_ver_call(vtemp):
+        vt = d(np.full((batch,), vtemp, np.float32))
 
-    t_verify = time_loop(ver_call, 8, lambda o: o[0])
+        def ver_call():
+            out = runner._spec_fn(
+                runner.params, runner.lora, runner.k_cache, runner.v_cache,
+                d(ver_toks), d(pos), d(lens), d(tables), None,
+                runner.rng, np.int32(2), vt, d(topk), d(topp),
+            )
+            runner.k_cache, runner.v_cache = out[-2], out[-1]
+            return out
+
+        return ver_call
+
+    t_verify = time_loop(mk_ver_call(0.0), 8, lambda o: o[0])
+
+    # sampled-mode probe: the same verify program with temperature>0 rows.
+    # Proposals here are the model's own greedy continuations, so the
+    # accepted-token count shows how much of the greedy acceptance a
+    # sampled deployment retains at this temperature (rejection sampling
+    # accepts proposal x with prob p(x) — r5, VERDICT item 7).
+    t_verify_sampled = time_loop(mk_ver_call(0.8), 8, lambda o: o[0])
+    greedy_emit, greedy_counts = runner.run_spec(
+        ver_toks, pos, lens, tables, None,
+    )
+    # Proposals = each row's VALID greedy-verify emissions; positions past
+    # counts[i] are zero padding, not model tokens, so pad by repeating the
+    # last valid token (repeats depress tail acceptance — the column is a
+    # lower bound on sampled acceptance of greedy-quality proposals).
+    sampled_props = np.zeros((batch, spec_k), np.int32)
+    for i in range(batch):
+        n = max(int(greedy_counts[i]), 1)
+        row = greedy_emit[i, :n]
+        sampled_props[i, :min(n, spec_k)] = row[:spec_k]
+        if n < spec_k:
+            sampled_props[i, n:] = row[n - 1]
+    sp_toks = np.concatenate(
+        [ver_toks[:, :1], sampled_props], axis=1
+    ).astype(np.int32)
+    _em, sp_counts = runner.run_spec(
+        sp_toks, pos, lens, tables, None,
+        temp=np.full((batch,), 0.8, np.float32),
+        topk=topk, topp=topp,
+    )
+    sampled_accepted = float(np.mean(sp_counts - 1))
 
     # host proposal cost: the same index+lookup NgramSpecDecoder.propose
     # runs per sequence per tick (engines/tpu/spec.py:41), standalone
@@ -172,6 +210,8 @@ def measure(model: str = "llama3-8b", quant: str | None = "int8",
         # t_verify/t_decode tokens in the same wall time
         "break_even_accepted_tokens": round(be, 3),
         "break_even_acceptance_rate": round(max(be, 0.0) / spec_k, 3),
+        "t_verify_sampled_ms": round(t_verify_sampled * 1000, 3),
+        "sampled_accepted_of_greedy_props": round(sampled_accepted, 3),
         "backend": jax.default_backend(),
     }
 

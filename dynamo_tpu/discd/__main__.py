@@ -20,10 +20,15 @@ async def main() -> None:
                         "persists every event with a sequence number and "
                         "serves replay on --replay-port")
     parser.add_argument("--replay-port", type=int, default=6183)
+    parser.add_argument("--snapshot", default=None,
+                        help="keyspace+lease snapshot file: restored at "
+                        "startup, written on change — a crashed discd comes "
+                        "back with the same keys and live lease ids (the "
+                        "etcd-durability role, single-node form)")
     args = parser.parse_args()
 
     configure_logging()
-    server = DiscdServer(args.host, args.port)
+    server = DiscdServer(args.host, args.port, snapshot_path=args.snapshot)
     await server.start()
     broker = None
     if not args.no_events:

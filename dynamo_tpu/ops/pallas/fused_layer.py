@@ -48,12 +48,19 @@ NEG_INF = -1e30
 MAX_TABLE_PAGES = 16
 
 
+def _tiles_for(d: int, HD: int, KHD: int, F: int):
+    """(TQ, TO, TF) weight-streaming tile widths for these dims."""
+    return min(256, KHD), min(512, d), min(512, F)
+
+
 def supports(config, *, lora: bool, quantized_weights: bool) -> bool:
     """Static eligibility of the megakernel for a model config. Every knob
     the kernel does NOT implement must be gated here — the kernel hardcodes
-    SiLU and plain (non-unit-offset) RMSNorm."""
+    SiLU and plain (non-unit-offset) RMSNorm — and every tiling constraint
+    fused_decoder_layer asserts must hold, so an auto-enabled config can
+    never crash at first decode instead of falling back."""
     c = config
-    return bool(
+    if not (
         quantized_weights
         and not lora
         and not any(int(w) != 0 for w in c.layer_windows())
@@ -65,9 +72,15 @@ def supports(config, *, lora: bool, quantized_weights: bool) -> bool:
         and not c.rmsnorm_unit_offset
         and (c.attn_logit_softcap or 0.0) == 0.0
         and c.head_dim_ == 128
-        and c.d_model % 256 == 0
-        and c.d_ff % 512 == 0
         and (c.n_heads % c.n_kv_heads) == 0
+    ):
+        return False
+    d, D = c.d_model, c.head_dim_
+    HD, KHD, F = c.n_heads * D, c.n_kv_heads * D, c.d_ff
+    TQ, TO, TF = _tiles_for(d, HD, KHD, F)
+    return bool(
+        HD % TQ == 0 and KHD % TQ == 0 and TQ % D == 0
+        and d % TO == 0 and F % TF == 0
     )
 
 
@@ -496,9 +509,7 @@ def fused_decoder_layer(
     assert B % BQ == 0, (B, BQ)
 
     KHD = KH * D
-    TQ = min(256, KHD)  # qkv col tile: must divide every projection width
-    TO = min(512, d)
-    TF = min(512, F)
+    TQ, TO, TF = _tiles_for(d, HD, KHD, F)  # same derivation supports() gates
     assert HD % TQ == 0 and KHD % TQ == 0 and TQ % D == 0, (HD, KHD, TQ)
     assert d % TO == 0 and F % TF == 0, (d, TO, F, TF)
 

@@ -95,3 +95,109 @@ def top_logprobs(
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     vals, ids = jax.lax.top_k(logp, n)
     return vals, ids.astype(jnp.int32)
+
+
+def spec_verify_sample(
+    logits: jnp.ndarray,  # [B, C, V] — position i decides token i+1
+    proposals: jnp.ndarray,  # [B, C-1] int32 draft tokens (one-hot draft q)
+    prop_len: jnp.ndarray,  # [B] int32 — valid proposal count per row
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B]; <=0 greedy
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+):
+    """Speculative verify with REJECTION SAMPLING (Leviathan/Chen): exact
+    target-distribution sampling for sampled requests, greedy verify as the
+    temperature<=0 special case — one program serves mixed ticks.
+
+    The prompt-lookup draft is deterministic (one-hot q), so acceptance of
+    proposal x at a position with filtered target distribution p is
+    u < p(x), and a rejection replaces it with a sample from p with x
+    zeroed and renormalized — exactly max(p − q, 0) normalized. Filtering
+    (temperature/top-k/top-p inside the top-W candidates) matches
+    sample_tokens, so spec and non-spec paths draw from the same target.
+
+    Returns (emitted [B, C] int32, counts [B] int32): row b's first
+    counts[b] entries are the accepted prefix plus the final corrected (or
+    bonus) token.
+    """
+    B, C, V = logits.shape
+    W = min(SAMPLE_WIDTH, V)
+    N = B * C
+    flat = logits.reshape(N, V)
+
+    if jax.default_backend() == "tpu":
+        raw_top, top_idx = jax.lax.approx_max_k(flat, W, recall_target=0.99)
+        order = jnp.argsort(-raw_top, axis=-1)
+        raw_top = jnp.take_along_axis(raw_top, order, axis=-1)
+        top_idx = jnp.take_along_axis(top_idx, order, axis=-1)
+    else:
+        raw_top, top_idx = jax.lax.top_k(flat, W)
+
+    rep = lambda a: jnp.repeat(a, C, axis=0)  # noqa: E731 — [B] → [N]
+    temp = jnp.maximum(rep(temperature), 1e-6)[:, None]
+    top_logits = raw_top.astype(jnp.float32) / temp
+
+    ranks = jax.lax.broadcasted_iota(jnp.int32, (N, W), 1)
+    k = jnp.where(rep(top_k) > 0, jnp.minimum(rep(top_k), W), W)[:, None]
+    keep_k = ranks < k
+    probs = jax.nn.softmax(top_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < jnp.clip(rep(top_p), 0.0, 1.0)[:, None]
+    keep = keep_k & keep_p
+    masked = jnp.where(keep, top_logits, NEG_INF)
+
+    # draft token per position: proposals shifted onto logit positions
+    prop_pos = jnp.concatenate(
+        [proposals, jnp.zeros((B, 1), jnp.int32)], axis=1
+    ).reshape(N)  # position i's draft (garbage past prop_len, masked later)
+    match = top_idx == prop_pos[:, None]  # [N, W]
+    pr = jax.nn.softmax(masked, axis=-1)  # renormalized filtered target
+    p_prop = jnp.sum(jnp.where(match & keep, pr, 0.0), axis=-1)  # [N]
+
+    rng_u, rng_g = jax.random.split(rng)
+    u = jax.random.uniform(rng_u, (N,), dtype=jnp.float32)
+    gumbel = jax.random.gumbel(rng_g, (N, W), dtype=jnp.float32)
+
+    greedy = rep(temperature) <= 0.0
+    argmax_tok = top_idx[:, 0]
+    accept = jnp.where(greedy, prop_pos == argmax_tok, u < p_prop)
+
+    # plain sample (bonus position) + rejection sample (proposal excluded)
+    choice = jnp.argmax(masked + gumbel, axis=-1)
+    sample = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
+    masked_excl = jnp.where(match, NEG_INF, masked)
+    choice_r = jnp.argmax(masked_excl + gumbel, axis=-1)
+    resample = jnp.take_along_axis(top_idx, choice_r[:, None], axis=-1)[:, 0]
+    sample = jnp.where(greedy, argmax_tok, sample)
+    resample = jnp.where(greedy, argmax_tok, resample)
+
+    accept = accept.reshape(B, C)
+    sample = sample.reshape(B, C)
+    resample = resample.reshape(B, C)
+
+    pl_ = jnp.maximum(prop_len, 0)[:, None]  # [B, 1]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    acc_run = jnp.cumprod(
+        jnp.where(pos < pl_, accept, False).astype(jnp.int32), axis=1
+    )
+    n_acc = jnp.sum(acc_run, axis=1)  # [B] accepted proposal count
+
+    gather1 = lambda a, i: jnp.take_along_axis(  # noqa: E731
+        a, i[:, None], axis=1
+    )[:, 0]
+    rejected = n_acc < pl_[:, 0]
+    final = jnp.where(
+        rejected, gather1(resample, n_acc), gather1(sample, n_acc)
+    )
+
+    props_padded = jnp.concatenate(
+        [proposals, jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    emitted = jnp.where(
+        pos < n_acc[:, None],
+        props_padded,
+        jnp.where(pos == n_acc[:, None], final[:, None], 0),
+    )
+    counts = n_acc + 1
+    return emitted, counts

@@ -40,7 +40,14 @@ logger = get_logger(__name__)
 
 
 class DiscdServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        snapshot_path: Optional[str] = None,
+        snapshot_interval_s: float = 2.0,
+    ) -> None:
         self.host = host
         self.port = port
         self._data: Dict[str, Tuple[Dict[str, Any], Optional[str]]] = {}
@@ -51,8 +58,23 @@ class DiscdServer:
         self._sweeper: Optional[asyncio.Task] = None
         self.bound_port: Optional[int] = None
         self._conn_writers: set = set()
+        # -- HA minimum (the raft-replicated-etcd role, single-node form):
+        # keyspace + lease snapshots so a crashed/restarted discd comes back
+        # with the SAME keys and lease ids. Restored leases restart their
+        # TTL clock from boot, so live owners (whose keepalive loops retry
+        # through the outage — runtime/distributed._keep_alive_loop) re-beat
+        # within one interval and never lose registration; truly dead
+        # owners still expire one TTL after the restart.
+        # Ref: the reference's etcd lease/keyspace durability
+        # (lib/runtime/src/transports/etcd.rs).
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval_s = snapshot_interval_s
+        self._dirty = False
+        self.restored_keys = 0
 
     async def start(self) -> int:
+        if self.snapshot_path:
+            self._load_snapshot()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.bound_port = self._server.sockets[0].getsockname()[1]
         self._sweeper = asyncio.get_running_loop().create_task(
@@ -68,6 +90,8 @@ class DiscdServer:
                 await self._sweeper
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.snapshot_path and self._dirty:
+            self._save_snapshot()
         if self._server is not None:
             self._server.close()
             # 3.12 wait_closed() waits for live connections too — close them.
@@ -75,7 +99,52 @@ class DiscdServer:
                 writer.close()
             await self._server.wait_closed()
 
+    # -- snapshot persistence ----------------------------------------------
+
+    def _save_snapshot(self) -> None:
+        import json
+        import os
+
+        doc = {
+            "data": {k: [v, lid] for k, (v, lid) in self._data.items()},
+            "leases": {lid: ttl for lid, (ttl, _beat) in self._leases.items()},
+        }
+        tmp = self.snapshot_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.snapshot_path)  # atomic on POSIX
+            self._dirty = False
+        except OSError:
+            logger.exception("discd snapshot write failed")
+
+    def _load_snapshot(self) -> None:
+        import json
+        import os
+
+        if not os.path.exists(self.snapshot_path):
+            return
+        try:
+            with open(self.snapshot_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            logger.exception("discd snapshot unreadable; starting empty")
+            return
+        now = time.monotonic()
+        self._data = {
+            k: (v, lid) for k, (v, lid) in (doc.get("data") or {}).items()
+        }
+        self._leases = {
+            lid: (float(ttl), now) for lid, ttl in (doc.get("leases") or {}).items()
+        }
+        self.restored_keys = len(self._data)
+        logger.info(
+            "discd restored %d keys, %d leases from %s",
+            len(self._data), len(self._leases), self.snapshot_path,
+        )
+
     async def _sweep_loop(self) -> None:
+        last_snap = time.monotonic()
         while True:
             await asyncio.sleep(0.5)
             now = time.monotonic()
@@ -85,12 +154,21 @@ class DiscdServer:
             for lid in expired:
                 logger.info("discd lease %s expired", lid[:8])
                 await self._drop_lease(lid)
+            if (
+                self.snapshot_path
+                and self._dirty
+                and now - last_snap >= self.snapshot_interval_s
+            ):
+                self._save_snapshot()
+                last_snap = now
 
     async def _drop_lease(self, lease_id: str) -> None:
-        self._leases.pop(lease_id, None)
+        if self._leases.pop(lease_id, None) is not None:
+            self._dirty = True
         doomed = [k for k, (_, lid) in self._data.items() if lid == lease_id]
         for key in doomed:
             del self._data[key]
+            self._dirty = True
             await self._notify(EventKind.DELETE, key, None)
 
     async def _notify(self, kind: EventKind, key: str, value: Optional[Dict[str, Any]]) -> None:
@@ -142,11 +220,13 @@ class DiscdServer:
         if op == "put":
             key = header["key"]
             self._data[key] = (payload, header.get("lease"))
+            self._dirty = True
             await fw.send({"reqid": reqid, "ok": True})
             await self._notify(EventKind.PUT, key, payload)
         elif op == "delete":
             key = header["key"]
             existed = self._data.pop(key, None) is not None
+            self._dirty = self._dirty or existed
             await fw.send({"reqid": reqid, "ok": True})
             if existed:
                 await self._notify(EventKind.DELETE, key, None)
@@ -175,6 +255,7 @@ class DiscdServer:
         elif op == "lease_create":
             lid = uuid.uuid4().hex
             self._leases[lid] = (float(header["ttl"]), time.monotonic())
+            self._dirty = True
             await fw.send({"reqid": reqid, "ok": True, "lease_id": lid})
         elif op == "lease_keepalive":
             lid = header["lease_id"]

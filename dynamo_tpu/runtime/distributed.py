@@ -246,8 +246,15 @@ class DistributedRuntime:
 
     async def _unserve(self, served: ServedEndpoint, grace_period: float = 30.0) -> None:
         key = served.instance.key
-        # De-register first so routers stop picking us, then drain.
-        await self.discovery.delete(key)
+        # De-register first so routers stop picking us, then drain. A dead
+        # discovery plane must not abort the shutdown: the lease expiry (or
+        # a discd snapshot-restore sweep) will retire the key for us.
+        try:
+            await self.discovery.delete(key)
+        except Exception as exc:
+            logger.warning(
+                "deregister of %s failed (discovery down?): %r", key, exc
+            )
         tracker = self._serve_trackers.pop(key, None)
         if tracker is not None:
             await tracker.drain(grace_period)
@@ -292,7 +299,13 @@ class DistributedRuntime:
         for served in list(self._served.values()):
             await self._unserve(served, grace_period=grace_period)
         if self._lease is not None:
-            await self.discovery.revoke_lease(self._lease)
+            try:
+                await self.discovery.revoke_lease(self._lease)
+            except Exception as exc:
+                logger.warning(
+                    "lease revoke failed (discovery down?): %r — the TTL "
+                    "sweep will expire it", exc
+                )
             self._lease = None
         await self.tracker.drain(grace_period)
         for plane in self._extra_planes:

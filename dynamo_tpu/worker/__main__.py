@@ -38,7 +38,10 @@ BUILTIN_CONFIGS = {
 }
 
 
-async def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
+    """The worker's argument surface. Factored out so recipe validation
+    (tests/test_recipes.py, tests/test_70b_fit.py) resolves the SAME
+    defaults a deployed worker gets."""
     parser = argparse.ArgumentParser("dynamo-tpu worker (native JAX engine)")
     parser.add_argument(
         "--model",
@@ -129,6 +132,11 @@ async def main() -> None:
     parser.add_argument("--process-id", type=int, default=None,
                         help="multi-host rank of this process (env "
                         "DYN_TPU_PROCESS_ID)")
+    return parser
+
+
+async def main() -> None:
+    parser = build_parser()
     args = parser.parse_args()
     if args.is_prefill_worker and args.component == "backend":
         args.component = args.prefill_component

@@ -1,0 +1,115 @@
+"""Arithmetic HBM-fit check for the Llama-3-70B disagg recipe.
+
+VERDICT r4 item 3: the 70B recipe must be load-bearing, not YAML fiction —
+this test FAILS if recipes/llama-3-70b/disagg-tp8.yaml's knobs (worker args
++ worker-arg defaults) exceed the v5e per-chip HBM budget with the actual
+per-block / per-param byte arithmetic the engine allocates.
+
+Reference shapes: the reference serves this model disaggregated on a
+single 8-GPU node (recipes/llama-3-70b/README.md:7-11); the TPU plan is
+tp8 over one v5e-8 slice per pool with int8 weights.
+"""
+
+import os
+
+import jax.numpy as jnp
+import yaml
+
+from dynamo_tpu.models.config import llama3_70b_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECIPE = os.path.join(REPO, "recipes", "llama-3-70b", "disagg-tp8.yaml")
+
+V5E_HBM_BYTES = 16 * 1024**3
+# Engine-external floor: XLA runtime preallocation, scoped VMEM spills,
+# framework buffers. Measured single-chip 8B serving leaves ~1 GB of slack
+# beyond weights+KV+activations; budget conservatively.
+RUNTIME_RESERVE = 1.5 * 1024**3
+
+
+def _worker_args(service):
+    """Parse a recipe service's args through the REAL worker argparser so
+    defaulted knobs (block size, kv blocks, max seqs) are the ones a
+    deployed worker would actually get."""
+    from dynamo_tpu.worker.__main__ import build_parser
+
+    parser = build_parser()
+    ns, _unknown = parser.parse_known_args(service["args"])
+    return ns
+
+
+def _int8_weight_bytes(cfg):
+    """Total int8 weight bytes (q8 leaves; f32 scales are per-output-col,
+    3-4 orders smaller and covered by the runtime reserve)."""
+    d, hd = cfg.d_model, cfg.head_dim_
+    H, KH, F, L, V = (
+        cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.n_layers, cfg.vocab_size,
+    )
+    per_layer = (
+        d * H * hd  # wq
+        + 2 * d * KH * hd  # wk, wv
+        + H * hd * d  # wo
+        + 2 * d * F  # gate, up
+        + F * d  # down
+    )
+    embed = V * d
+    lm_head = 0 if cfg.tie_word_embeddings else d * V
+    return L * per_layer + embed + lm_head
+
+
+def test_disagg_tp8_recipe_fits_v5e_hbm():
+    with open(RECIPE) as f:
+        doc = yaml.safe_load(f)
+    cfg = llama3_70b_config()
+
+    for role in ("prefill", "decode"):
+        svc = doc["services"][role]
+        ns = _worker_args(svc)
+        assert ns.model == "llama-3-70b"
+        tp = ns.tensor_parallel_size
+        assert tp == 8, "recipe must shard over the 8-chip slice"
+
+        # weights: int8, sharded over tp (per-channel scales in reserve)
+        weight_pc = _int8_weight_bytes(cfg) / tp
+
+        # KV pool: layers x blocks x block_size x (KH/tp) x D x bf16 x {K,V}
+        kh_pc = max(cfg.n_kv_heads // tp, 1)
+        kv_pc = (
+            cfg.n_layers * ns.num_kv_blocks * ns.block_size
+            * kh_pc * cfg.head_dim_ * 2 * 2
+        )
+
+        # activation working set (prefill worst case): the chunk's hidden
+        # states in a handful of live f32 copies + FFN intermediates
+        # (sharded over tp) + final-position logits.
+        chunk = ns.prefill_chunk or ns.max_model_len
+        act = (
+            chunk * cfg.d_model * 4 * 4  # residual/norm/attn copies (f32)
+            + chunk * (cfg.d_ff // tp) * 4 * 2  # gate/up intermediates
+            + ns.max_num_seqs * cfg.vocab_size * 4  # logits
+        )
+
+        total = weight_pc + kv_pc + act + RUNTIME_RESERVE
+        assert total <= V5E_HBM_BYTES, (
+            f"{role}: plan exceeds v5e HBM: weights {weight_pc/1e9:.2f} GB "
+            f"+ kv {kv_pc/1e9:.2f} GB + act {act/1e9:.2f} GB + reserve "
+            f"{RUNTIME_RESERVE/1e9:.2f} GB = {total/1e9:.2f} GB > 16 GB"
+        )
+
+        # the pool must hold at least max_num_seqs full-length sequences'
+        # worth of pages with the measured 1.5x headroom rule-of-thumb...
+        # or rely on preemption; require at least ONE full-length sequence
+        # so a single long request cannot deadlock the scheduler.
+        pages_per_seq = -(-ns.max_model_len // ns.block_size)
+        assert ns.num_kv_blocks >= pages_per_seq, (
+            f"{role}: pool ({ns.num_kv_blocks} blocks) cannot hold one "
+            f"max_model_len sequence ({pages_per_seq} pages)"
+        )
+
+
+def test_70b_weight_arithmetic_matches_param_count():
+    """Sanity-pin the byte arithmetic to the known ~70.6B parameter count
+    (±2%) so the fit test cannot silently drift from the real model."""
+    cfg = llama3_70b_config()
+    n = _int8_weight_bytes(cfg)  # int8: bytes == params
+    assert abs(n - 70.6e9) / 70.6e9 < 0.02, n

@@ -272,3 +272,49 @@ async def test_chunked_streamed_transfer():
         await prefill_engine.stop()
         await decode_engine.stop()
         await oracle_engine.stop()
+
+
+async def test_export_readback_overlaps_decode():
+    """The export's HBM→host readback must run on the transfer lane, not
+    the device thread: a generate() issued while a (artificially slow)
+    export is draining must finish well before the export does."""
+    import time as _time
+
+    engine = make_engine()
+    real_readback = engine.runner.gather_blocks_readback
+    try:
+        prompt = list(range(40, 56))
+        await collect(engine.generate(req(prompt, max_tokens=2), Context()))
+        # pre-warm the second request's program shapes so the timed leg
+        # measures scheduling, not CPU compile time
+        await collect(
+            engine.generate(req(list(range(80, 90)), max_tokens=6), Context())
+        )
+        hashes = compute_block_hashes(prompt, 4)
+
+        def slow_readback(k, v):
+            _time.sleep(1.2)  # a slow wire/DCN drain
+            return real_readback(k, v)
+
+        engine.runner.gather_blocks_readback = slow_readback
+        t0 = _time.monotonic()
+        export_task = asyncio.ensure_future(
+            engine.export_blocks_async(hashes)
+        )
+        await asyncio.sleep(0.05)  # let the dispatch land first
+        out = await collect(
+            engine.generate(req(list(range(60, 70)), max_tokens=6), Context())
+        )
+        t_decode_done = _time.monotonic() - t0
+        found, _k, _v = await export_task
+        t_export_done = _time.monotonic() - t0
+        assert [t for o in out for t in o.token_ids], "decode produced nothing"
+        assert found == hashes
+        # decode finished while the transfer was still sleeping on the wire
+        assert t_decode_done < t_export_done, (t_decode_done, t_export_done)
+        assert t_decode_done < 1.0, (
+            f"decode stalled behind the transfer ({t_decode_done:.2f}s)"
+        )
+    finally:
+        engine.runner.gather_blocks_readback = real_readback
+        await engine.stop()

@@ -305,3 +305,90 @@ async def test_runtime_over_discd_tcp_zmq(tmp_path):
         await worker_rt.shutdown(grace_period=1)
         await broker.close()
         await server.stop()
+
+
+async def test_discd_kill_and_restore_from_snapshot(tmp_path):
+    """HA minimum (the etcd-durability role): kill discd mid-serve —
+    established request-plane traffic keeps flowing on leases — then
+    restart discd from its snapshot: the SAME keys and lease ids are back,
+    keepalives resume, and a fresh client resolves the worker without it
+    re-registering."""
+    snap = str(tmp_path / "discd.json")
+    server = DiscdServer(snapshot_path=snap, snapshot_interval_s=0.2)
+    port = await server.start()
+
+    worker_rt = DistributedRuntime(
+        discovery=DiscdDiscovery(f"127.0.0.1:{port}"),
+        request_plane=TcpRequestPlane(),
+    )
+    front_rt = DistributedRuntime(
+        discovery=DiscdDiscovery(f"127.0.0.1:{port}"),
+        request_plane=TcpRequestPlane(),
+    )
+
+    async def handler(request, context):
+        yield {"echo": request["msg"]}
+
+    served = await (
+        worker_rt.namespace("ha").component("w").endpoint("g")
+        .serve_endpoint(handler)
+    )
+    client = await front_rt.namespace("ha").component("w").endpoint("g").client()
+    try:
+        await client.wait_for_instances(timeout=5)
+        assert (await collect(client.generate({"msg": "a"}))) == [{"echo": "a"}]
+        # let a dirty snapshot land
+        await asyncio.sleep(0.8)
+
+        # ---- kill discd (ungraceful close of the service object) ----
+        await server.stop()
+
+        # serving continues: the request plane is a direct worker TCP
+        # connection; discovery being down must not break it
+        assert (await collect(client.generate({"msg": "b"}))) == [{"echo": "b"}]
+
+        # ---- restart from the snapshot on the SAME port ----
+        server2 = DiscdServer(port=port, snapshot_path=snap)
+        await server2.start()
+        try:
+            assert server2.restored_keys >= 1, "snapshot restored no keys"
+
+            # the worker's lease id survived: its keepalive loop resumes
+            # against the restored lease (no 'lease not found' churn)
+            lease_ids = set(server2._leases)
+            assert worker_rt._lease.id in lease_ids
+
+            # a brand-new client resolves the worker from restored state
+            # WITHOUT the worker re-registering
+            fresh_rt = DistributedRuntime(
+                discovery=DiscdDiscovery(f"127.0.0.1:{port}"),
+                request_plane=TcpRequestPlane(),
+            )
+            fresh = await (
+                fresh_rt.namespace("ha").component("w").endpoint("g").client()
+            )
+            try:
+                await fresh.wait_for_instances(timeout=5)
+                out = await collect(fresh.generate({"msg": "c"}))
+                assert out == [{"echo": "c"}]
+            finally:
+                await fresh.close()
+                await fresh_rt.shutdown(grace_period=1)
+
+            # a key whose owner DIED during the outage still expires: drop
+            # the worker's lease and watch the key disappear
+            await server2._drop_lease(worker_rt._lease.id)
+            left = [
+                k for k in server2._data if k.startswith("instances/ha/")
+            ]
+            assert not left, left
+        finally:
+            await server2.stop()
+    finally:
+        await client.close()
+        try:
+            await served.shutdown(grace_period=1)
+        except Exception:
+            pass
+        await front_rt.shutdown(grace_period=1)
+        await worker_rt.shutdown(grace_period=1)

@@ -98,9 +98,11 @@ async def test_spec_accepts_on_looping_output():
         await spec.stop()
 
 
-async def test_default_temperature_is_not_greedy():
-    """temperature=None means the DEFAULT (1.0, sampled): the spec path
-    must not hijack it into deterministic argmax decoding."""
+async def test_default_temperature_completes_under_spec():
+    """temperature=None means the DEFAULT (1.0, sampled). Since r5 the
+    rejection-sampling verify serves sampled rows EXACTLY (distribution
+    preservation is asserted in tests/test_spec_sampling.py), so sampled
+    requests may engage the spec path — they must simply complete."""
     spec, _ = make_engine(spec_mode="ngram")
     try:
         r = PreprocessedRequest(
@@ -111,14 +113,14 @@ async def test_default_temperature_is_not_greedy():
         )
         out = await collect(spec.generate(r, Context()))
         assert len([t for o in out for t in o.token_ids]) == 5
-        assert spec.spec_proposed == 0  # never took the spec path
     finally:
         await spec.stop()
 
 
-async def test_sampling_request_falls_back():
-    """A temperature>0 request in the batch must not break (the tick falls
-    back to the fused decode path) and still completes."""
+async def test_sampling_request_completes():
+    """A temperature>0 request in the batch is served by the
+    rejection-sampling verify (or the fused path when nothing proposes)
+    and still completes."""
     spec, _ = make_engine(spec_mode="ngram")
     try:
         r = PreprocessedRequest(
