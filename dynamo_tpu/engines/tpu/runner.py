@@ -124,6 +124,32 @@ class DeviceRunner:
         self.rules = rules or ShardingRules()
         self.topology = topology
         self.multihost = bool(topology is not None and topology.is_multihost)
+        if getattr(args, "kv_cache_dtype", None) == "auto":
+            # Measured policy (docs/design_docs/performance.md): int8 KV
+            # loses at short context (+2 scale DMAs/page dominate) and wins
+            # on long context + pool capacity. Quantize when the model
+            # length crosses the break-even OR the pool cannot hold the
+            # worst case at bf16 (capacity pressure -> halving bytes beats
+            # preemption-by-recompute thrash).
+            from dynamo_tpu import config as _cfg
+
+            pool_tokens = args.num_kv_blocks * args.block_size
+            pressure = pool_tokens < args.max_num_seqs * args.max_model_len
+            args.kv_cache_dtype = (
+                "int8"
+                if args.layered_cache
+                and (
+                    args.max_model_len >= _cfg.KV_QUANT_AUTO_CTX.get()
+                    or pressure
+                )
+                else None
+            )
+            logger.info(
+                "kv_cache_dtype=auto resolved to %s (max_model_len=%d, "
+                "pool_tokens=%d, pressure=%s)",
+                args.kv_cache_dtype, args.max_model_len, pool_tokens,
+                pressure,
+            )
         self._spmd_tx = None  # SpmdBroadcaster on the leader
         backend = jax.default_backend()
         self.use_kernel = (

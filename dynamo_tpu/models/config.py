@@ -56,6 +56,20 @@ class ModelConfig:
     # layers, Mistral-style; 2 = alternating, Gemma-2-style).
     sliding_window: Optional[int] = None
     sliding_window_every: int = 1
+    # HF-style pattern (Gemma-3): layer i is WINDOWED unless
+    # (i + 1) % sliding_window_pattern == 0 (i.e. every pattern-th layer is
+    # global — the 5:1 local/global layout). Takes precedence over
+    # sliding_window_every when set.
+    sliding_window_pattern: Optional[int] = None
+    # Authoritative per-layer window list (overrides every pattern knob):
+    # ingested verbatim from an HF ``layer_types`` list, so aperiodic
+    # layouts are honored exactly.
+    layer_window_overrides: Optional[List[int]] = None
+    # Gemma-3 dual-frequency RoPE: LOCAL (windowed) layers use this theta;
+    # global layers use rope_theta (optionally linearly position-scaled by
+    # rope_scaling_factor, the HF rope_scaling={linear, factor} dialect).
+    rope_local_theta: Optional[float] = None
+    rope_scaling_factor: Optional[float] = None
 
     @property
     def head_dim_(self) -> int:
@@ -75,8 +89,17 @@ class ModelConfig:
 
     def layer_windows(self) -> List[int]:
         """Per-layer attention window (0 = unlimited)."""
+        if self.layer_window_overrides is not None:
+            assert len(self.layer_window_overrides) == self.n_layers
+            return list(self.layer_window_overrides)
         if not self.sliding_window:
             return [0] * self.n_layers
+        if self.sliding_window_pattern:
+            p = self.sliding_window_pattern
+            return [
+                self.sliding_window if (i + 1) % p != 0 else 0
+                for i in range(self.n_layers)
+            ]
         return [
             self.sliding_window if i % max(self.sliding_window_every, 1) == 0 else 0
             for i in range(self.n_layers)
@@ -98,16 +121,42 @@ class ModelConfig:
         n_experts = cfg.get("num_local_experts") or cfg.get("num_experts") or 0
         model_type = str(cfg.get("model_type", ""))
         # Gemma-family: unit-offset norms, GeGLU, scaled/tied embeddings.
-        # Gemma-2 ADDS post-norms, softcaps and 1:1 local/global layers.
-        # Gemma-3 (5:1 pattern + qk-norm) is a different architecture we do
-        # not implement — refuse loudly rather than produce garbage logits.
+        # Gemma-2 ADDS post-norms, softcaps and 1:1 local/global layers;
+        # Gemma-3 swaps softcaps for qk-norm, 5:1 local/global layers and
+        # dual-frequency RoPE (implemented since r5).
         gemma = "gemma" in arch or "gemma" in model_type
         gemma2 = "gemma2" in arch or model_type == "gemma2"
-        if "gemma3" in arch or "gemma3" in model_type:
+        # Gemma-3 (text): gemma-2 layout + qk-norm, 5:1 local/global layers
+        # (sliding_window_pattern / layer_types), dual-frequency RoPE
+        # (rope_local_base_freq on windowed layers), softcaps removed.
+        gemma3 = "gemma3" in arch or "gemma3" in model_type
+        swp = cfg.get("sliding_window_pattern") or cfg.get(
+            "_sliding_window_pattern"
+        )
+        window_overrides = None
+        if cfg.get("layer_types") and cfg.get("sliding_window"):
+            # layer_types is the authoritative per-layer layout — honor it
+            # VERBATIM (aperiodic lists included) instead of inferring a
+            # period from it.
+            window_overrides = [
+                int(cfg["sliding_window"]) if t == "sliding_attention" else 0
+                for t in cfg["layer_types"]
+            ]
+        if gemma3 and not swp and window_overrides is None:
+            # A gemma-3 config carrying neither field would silently fall
+            # through to every-layer-windowed — the garbage-logits mode the
+            # old refusal existed to prevent.
             raise ValueError(
-                "gemma-3 checkpoints are not supported (qk-norm + 5:1 "
-                "local/global attention differ from the gemma-2 layout)"
+                "gemma-3 config carries neither sliding_window_pattern nor "
+                "layer_types; cannot determine the local/global layer layout"
             )
+        rope_scaling = cfg.get("rope_scaling") or {}
+        rope_factor = (
+            float(rope_scaling.get("factor"))
+            if rope_scaling.get("rope_type", rope_scaling.get("type")) == "linear"
+            and rope_scaling.get("factor")
+            else None
+        )
         # Some configs (Qwen2 dialect) carry a vestigial sliding_window with
         # an explicit use_sliding_window=false gate — honor the gate.
         sliding = (
@@ -131,7 +180,7 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             qkv_bias="qwen2" in arch and "qwen3" not in arch,
-            qk_norm="qwen3" in arch or model_type == "qwen3",
+            qk_norm="qwen3" in arch or model_type == "qwen3" or gemma3,
             tie_word_embeddings=cfg.get("tie_word_embeddings", gemma),
             eos_token_ids=eos_ids,
             bos_token_id=cfg.get("bos_token_id"),
@@ -156,13 +205,23 @@ class ModelConfig:
                 else "silu"
             ),
             rmsnorm_unit_offset=gemma,
-            post_norms=gemma2,
+            post_norms=gemma2 or gemma3,
             embed_scale=gemma,
             attn_logit_softcap=cfg.get("attn_logit_softcapping"),
             final_logit_softcap=cfg.get("final_logit_softcapping"),
             query_scale=cfg.get("query_pre_attn_scalar"),
             sliding_window=int(sliding) if sliding else None,
             sliding_window_every=2 if gemma2 else 1,
+            sliding_window_pattern=(
+                int(swp) if (gemma3 and swp and window_overrides is None)
+                else None
+            ),
+            layer_window_overrides=window_overrides,
+            rope_local_theta=(
+                float(cfg.get("rope_local_base_freq", 10000.0))
+                if gemma3 else None
+            ),
+            rope_scaling_factor=rope_factor,
         )
 
     @classmethod
@@ -305,6 +364,35 @@ def llama3_70b_config() -> ModelConfig:
         max_position_embeddings=8192,
         eos_token_ids=[128001, 128009],
         name="llama-3-70b",
+    )
+
+
+def gemma3_1b_config() -> ModelConfig:
+    """Gemma-3-1B text shape (HF google/gemma-3-1b-it config.json values):
+    5:1 local/global layers, dual-frequency RoPE, qk-norm."""
+    return ModelConfig(
+        vocab_size=262144,
+        d_model=1152,
+        n_layers=26,
+        n_heads=4,
+        n_kv_heads=1,
+        head_dim=256,
+        d_ff=6912,
+        rms_norm_eps=1e-6,
+        rope_theta=1000000.0,
+        rope_local_theta=10000.0,
+        max_position_embeddings=32768,
+        qk_norm=True,
+        tie_word_embeddings=True,
+        act_fn="gelu_tanh",
+        rmsnorm_unit_offset=True,
+        post_norms=True,
+        embed_scale=True,
+        query_scale=256,
+        sliding_window=512,
+        sliding_window_pattern=6,
+        eos_token_ids=[1, 106],
+        name="gemma-3-1b",
     )
 
 

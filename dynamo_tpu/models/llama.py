@@ -291,6 +291,8 @@ def decoder_layer(
     use_kernel: bool,
     adapter_ids: Optional[jnp.ndarray],
     first_chunk: bool = False,
+    cos_loc: Optional[jnp.ndarray] = None,  # Gemma-3 local-rope table
+    sin_loc: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder layer (attention + FFN, all family knobs). Shared by the
     scan-over-layers forward and the pipeline-parallel stage executor
@@ -320,10 +322,18 @@ def decoder_layer(
     k = k.reshape(B, C, c.n_kv_heads, hd)
     v = v.reshape(B, C, c.n_kv_heads, hd)
     if c.qk_norm:
-        # Qwen3: per-head RMSNorm over head_dim on q and k, BEFORE RoPE
-        # (HF Qwen3Attention order: norm → rope).
-        q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps)
-        k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps)
+        # Qwen3/Gemma-3: per-head RMSNorm over head_dim on q and k, BEFORE
+        # RoPE (HF attention order: norm → rope). Gemma-family norms store
+        # (w - 1), hence the unit offset.
+        q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps, uo)
+        k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps, uo)
+    if cos_loc is not None:
+        # Gemma-3 dual-frequency RoPE: windowed (local) layers rotate with
+        # the local-base table; global layers with the (possibly
+        # position-scaled) global table. ``win`` is a traced scalar.
+        sel = (win > 0)
+        cos = jnp.where(sel, cos_loc, cos)
+        sin = jnp.where(sel, sin_loc, sin)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -441,7 +451,14 @@ def forward_paged(
     x = embed_tokens(params, c, tokens, mm_embeds, mm_slot)  # [B, C, d]
 
     pos = start_pos[:, None] + jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
-    cos, sin = rope_table(pos, hd, c.rope_theta)  # [B, C, hd]
+    cos, sin = rope_table(
+        pos, hd, c.rope_theta, scale=c.rope_scaling_factor or 1.0
+    )  # [B, C, hd]
+    cos_loc = sin_loc = None
+    if c.rope_local_theta is not None:
+        # Gemma-3: local (windowed) layers rotate at the local base freq,
+        # UNscaled (HF applies rope_scaling only to the global rope).
+        cos_loc, sin_loc = rope_table(pos, hd, c.rope_local_theta)
 
     if is_layered_cache(k_cache):
         # Serving layout: Python-unrolled layers over per-layer 4D pools.
@@ -514,7 +531,7 @@ def forward_paged(
                 c, lp_l, ll_l, jnp.asarray(win_list[l], jnp.int32), x, cos, sin,
                 k_cache[l], v_cache[l], block_tables, start_pos, chunk_lens,
                 use_kernel=use_kernel, adapter_ids=adapter_ids,
-                first_chunk=first_chunk,
+                first_chunk=first_chunk, cos_loc=cos_loc, sin_loc=sin_loc,
             )
             k_out.append(k_l)
             v_out.append(v_l)
@@ -531,7 +548,7 @@ def forward_paged(
                 c, lp, ll, win, x, cos, sin, k_c, v_c,
                 block_tables, start_pos, chunk_lens,
                 use_kernel=use_kernel, adapter_ids=adapter_ids,
-                first_chunk=first_chunk,
+                first_chunk=first_chunk, cos_loc=cos_loc, sin_loc=sin_loc,
             )
             return x, (k_c, v_c)
 
@@ -568,7 +585,12 @@ def encode(
     if c.embed_scale:
         x = x * jnp.asarray(c.d_model**0.5, dtype=c.dtype)
     pos = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
-    cos, sin = rope_table(pos, hd, c.rope_theta)
+    cos, sin = rope_table(
+        pos, hd, c.rope_theta, scale=c.rope_scaling_factor or 1.0
+    )
+    cos_loc = sin_loc = None
+    if c.rope_local_theta is not None:
+        cos_loc, sin_loc = rope_table(pos, hd, c.rope_local_theta)
 
     def layer_fn(carry, xs):
         x = carry
@@ -581,11 +603,16 @@ def encode(
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, T, c.n_heads, hd)
         k = k.reshape(B, T, c.n_kv_heads, hd)
-        if c.qk_norm:  # Qwen3: per-head RMSNorm before RoPE (as decoder_layer)
-            q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps)
-            k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if c.qk_norm:  # Qwen3/Gemma-3: per-head RMSNorm before RoPE
+            q = _rms_norm(q, lp["q_norm"], c.rms_norm_eps, uo)
+            k = _rms_norm(k, lp["k_norm"], c.rms_norm_eps, uo)
+        lcos, lsin = cos, sin
+        if cos_loc is not None:  # Gemma-3 dual-frequency rope
+            sel = (win > 0)
+            lcos = jnp.where(sel, cos_loc, cos)
+            lsin = jnp.where(sel, sin_loc, sin)
+        q = apply_rope(q, lcos, lsin)
+        k = apply_rope(k, lcos, lsin)
         v = v.reshape(B, T, c.n_kv_heads, hd)
         G = c.q_per_kv
         qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)

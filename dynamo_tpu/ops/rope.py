@@ -5,14 +5,19 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_table(positions: jnp.ndarray, head_dim: int, theta: float):
+def rope_table(positions: jnp.ndarray, head_dim: int, theta: float,
+               scale: float = 1.0):
     """cos/sin tables for integer positions.
 
     positions: [...], returns (cos, sin) each [..., head_dim].
+    ``scale`` > 1 is HF linear rope_scaling (positions divided by factor —
+    Gemma-3's global-rope long-context stretch).
     """
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    angles = (
+        positions.astype(jnp.float32)[..., None] / scale
+    ) * freqs  # [..., half]
     cos = jnp.cos(angles)
     sin = jnp.sin(angles)
     # rotate_half layout: duplicate for both halves

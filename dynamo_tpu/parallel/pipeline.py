@@ -86,7 +86,15 @@ def forward_paged_pp(
             )
             from dynamo_tpu.ops.rope import rope_table
 
-            cos, sin = rope_table(pos, c.head_dim_, c.rope_theta)
+            cos, sin = rope_table(
+                pos, c.head_dim_, c.rope_theta,
+                scale=c.rope_scaling_factor or 1.0,
+            )
+            cos_loc = sin_loc = None
+            if c.rope_local_theta is not None:
+                cos_loc, sin_loc = rope_table(
+                    pos, c.head_dim_, c.rope_local_theta
+                )
 
             def layer_fn(carry, xs):
                 x = carry
@@ -94,6 +102,7 @@ def forward_paged_pp(
                 x, k_l, v_l = llama.decoder_layer(
                     c, lp, {}, win, x, cos, sin, k_l, v_l, bt, sp, cl,
                     use_kernel=use_kernel, adapter_ids=None,
+                    cos_loc=cos_loc, sin_loc=sin_loc,
                 )
                 return x, (k_l, v_l)
 

@@ -13,6 +13,7 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard, RuntimeConfig
 from dynamo_tpu.models.config import (
     ModelConfig,
     gemma2_2b_config,
+    gemma3_1b_config,
     llama3_3b_config,
     llama3_8b_config,
     llama3_70b_config,
@@ -34,6 +35,7 @@ BUILTIN_CONFIGS = {
     "qwen3-8b": qwen3_8b_config,
     "llama-3-70b": llama3_70b_config,
     "gemma-2-2b": gemma2_2b_config,
+    "gemma-3-1b": gemma3_1b_config,
     "mixtral-8x7b": mixtral_8x7b_config,
 }
 
@@ -117,11 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="weight-only quantization (int8: per-channel, "
                         "halves weight HBM — the FP8-checkpoint deployment "
                         "lever, TPU-style)")
-    parser.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
+    parser.add_argument("--kv-cache-dtype", choices=["int8", "auto"],
+                        default=None,
                         help="KV-cache quantization (int8: per-token-head "
                         "dynamic scales — 2x KV capacity and half the "
                         "history-read bytes; the kv_cache_dtype=fp8 engine "
-                        "lever, TPU-style)")
+                        "lever, TPU-style). 'auto' applies the measured "
+                        "break-even policy: int8 when max_model_len >= "
+                        "DYN_TPU_KV_QUANT_AUTO_CTX or the pool cannot hold "
+                        "the worst case at bf16")
     parser.add_argument("--coordinator", default=None,
                         help="multi-host: host:port of rank 0's "
                         "jax.distributed coordinator (or env "

@@ -387,11 +387,36 @@ def test_config_dialect_gates():
     )
     assert mistral.sliding_window == 4096 and mistral.sliding_window_every == 1
 
-    import pytest as _pytest
+    # Gemma-3 (r5: implemented — was refused in r4): qk-norm + pattern +
+    # dual-frequency rope fields ingest; softcaps stay unset.
+    g3 = ModelConfig.from_hf_config(
+        {**base, "architectures": ["Gemma3ForCausalLM"],
+         "model_type": "gemma3_text", "sliding_window": 512,
+         "sliding_window_pattern": 6, "rope_local_base_freq": 10000.0,
+         "rope_theta": 1000000.0,
+         "hidden_activation": "gelu_pytorch_tanh"}
+    )
+    assert g3.qk_norm and g3.post_norms and g3.rmsnorm_unit_offset
+    assert g3.sliding_window_pattern == 6 and g3.rope_local_theta == 10000.0
+    assert g3.attn_logit_softcap is None
 
-    with _pytest.raises(ValueError, match="gemma-3"):
+    # layer_types list alone (no explicit pattern) also derives the pattern
+    g3b = ModelConfig.from_hf_config(
+        {**base, "architectures": ["Gemma3ForCausalLM"],
+         "model_type": "gemma3_text", "sliding_window": 512,
+         "layer_types": ["sliding_attention", "full_attention"],
+         "hidden_activation": "gelu_pytorch_tanh"}
+    )
+    # the layer_types list is honored VERBATIM (aperiodic layouts included)
+    assert g3b.layer_windows() == [512, 0]
+
+    # neither pattern nor layer_types on a gemma-3 config → loud refusal
+    # (the silent every-layer-windowed fallback is the garbage-logits mode)
+    with __import__("pytest").raises(ValueError, match="gemma-3"):
         ModelConfig.from_hf_config(
-            {**base, "architectures": ["Gemma3ForCausalLM"], "model_type": "gemma3"}
+            {**base, "architectures": ["Gemma3ForCausalLM"],
+             "model_type": "gemma3_text", "sliding_window": 512,
+             "hidden_activation": "gelu_pytorch_tanh"}
         )
 
 
@@ -599,3 +624,84 @@ def test_mixtral_checkpoint_ep_sharded_parity(tmp_path):
     np.testing.assert_allclose(
         np.asarray(base), np.asarray(sharded), rtol=2e-4, atol=2e-4
     )
+
+
+def _make_gemma3_dir(tmp_path):
+    """Tiny Gemma-3 text model: 5:1-style local/global pattern (pattern=3
+    here so a 6-layer model exercises both kinds), qk-norm, dual-frequency
+    RoPE, no softcaps — the r4-refused architecture, now implemented."""
+    torch.manual_seed(12)
+    cfg = transformers.Gemma3TextConfig(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=6,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        query_pre_attn_scalar=16,
+        sliding_window=8,
+        sliding_window_pattern=3,
+        rope_theta=1000000.0,
+        rope_local_base_freq=10000.0,
+        hidden_activation="gelu_pytorch_tanh",
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        eos_token_id=0,
+        bos_token_id=None,
+        attn_implementation="eager",
+    )
+    model = transformers.Gemma3ForCausalLM(cfg).eval().to(torch.float32)
+    model_dir = tmp_path / "gemma3-tiny"
+    model.save_pretrained(str(model_dir), safe_serialization=True)
+    _save_tokenizer(model_dir)
+    return model_dir, model
+
+
+def test_gemma3_config_ingestion(tmp_path):
+    model_dir, _ = _make_gemma3_dir(tmp_path)
+    config = _our_config(model_dir)
+    assert config.qk_norm and config.rmsnorm_unit_offset
+    assert config.post_norms and config.embed_scale
+    assert config.attn_logit_softcap is None
+    assert config.sliding_window == 8
+    assert config.rope_local_theta == 10000.0
+    assert config.rope_theta == 1000000.0
+    # every 3rd layer global, others windowed
+    assert config.layer_windows() == [8, 8, 0, 8, 8, 0]
+
+
+def test_gemma3_logits_parity(tmp_path):
+    model_dir, hf = _make_gemma3_dir(tmp_path)
+    config = _our_config(model_dir)
+    prompt = [3, 17, 42, 99, 5, 250, 11, 64, 7, 8, 9, 200, 13, 77, 101]
+    params = load_hf_checkpoint(str(model_dir), config)
+    k, v = llama.init_kv_cache(config, 16, 4)
+    table = np.zeros((1, 8), dtype=np.int32)
+    table[0, :4] = [1, 2, 3, 4]
+    logits, _, _ = llama.forward_paged(
+        params, config,
+        jnp.asarray([prompt], dtype=jnp.int32),
+        jnp.zeros(1, jnp.int32),
+        jnp.asarray([len(prompt)], dtype=jnp.int32),
+        jnp.asarray(table), k, v,
+    )
+    with torch.no_grad():
+        ref = hf(torch.tensor([prompt])).logits[0, -1].numpy()
+    np.testing.assert_allclose(np.asarray(logits[0]), ref, rtol=2e-4, atol=2e-4)
+
+
+async def test_gemma3_checkpoint_greedy_decode_parity(tmp_path):
+    """Prompt longer than the window (8) so local layers mask AND the
+    local/global rope split matters; greedy tokens must match
+    transformers exactly."""
+    model_dir, hf = _make_gemma3_dir(tmp_path)
+    config = _our_config(model_dir)
+    engine = _engine_for(model_dir, config)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(1, VOCAB, size=21).tolist()
+    try:
+        ours = await _engine_greedy(engine, prompt, 12)
+    finally:
+        await engine.stop()
+    assert ours == _hf_greedy(hf, prompt, 12)

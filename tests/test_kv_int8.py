@@ -210,3 +210,31 @@ def test_gather_scatter_roundtrip_int8():
         got.astype(jnp.float32) - blocks.astype(jnp.float32)
     ).max()
     assert float(err) < 0.05, float(err)
+
+
+def test_kv_cache_dtype_auto_policy():
+    """kv_cache_dtype='auto' resolves by the measured break-even: bf16 at
+    short max_model_len with a roomy pool, int8 at long context or under
+    pool-capacity pressure."""
+    from dynamo_tpu.engines.tpu.runner import DeviceRunner
+    from dynamo_tpu.engines.tpu import JaxEngineArgs
+    from dynamo_tpu.models.config import tiny_config
+
+    def resolve(**kw):
+        args = JaxEngineArgs(
+            config=tiny_config(), block_size=4, max_num_seqs=2,
+            kv_cache_dtype="auto", **kw,
+        )
+        r = DeviceRunner(args)
+        return args.kv_cache_dtype, r
+
+    # short context, pool holds worst case → stays bf16
+    got, _ = resolve(max_model_len=64, num_kv_blocks=64)
+    assert got is None
+    # long context → int8
+    got, r = resolve(max_model_len=1024, num_kv_blocks=1024)
+    assert got == "int8"
+    assert isinstance(r.k_cache[0], dict)  # quantized pools allocated
+    # short context but pool pressure (2 seqs × 64 tokens > 16-token pool)
+    got, _ = resolve(max_model_len=64, num_kv_blocks=4)
+    assert got == "int8"
