@@ -58,9 +58,15 @@ async def run_operator(args) -> None:
         webhook_runner = await serve_webhook(
             args.webhook_port, args.tls_cert, args.tls_key
         )
+    elector = None
+    if args.leader_elect:
+        from dynamo_tpu.deploy.leader import LeaderElector
+
+        elector = LeaderElector(client, k8s_namespace=args.k8s_namespace)
     operator = K8sGraphOperator(
         client, k8s_namespace=args.k8s_namespace,
         pod_backend=args.pod_backend,
+        leader_elector=elector,
     )
     print(
         f"operator watching {args.k8s_namespace} "
@@ -104,6 +110,12 @@ def main() -> None:
     )
     p.add_argument("--tls-cert", default=None)
     p.add_argument("--tls-key", default=None)
+    p.add_argument(
+        "--leader-elect", action="store_true",
+        help="coordination/v1 Lease leader election: only the holder "
+        "reconciles, so replicated operators never double-actuate "
+        "(ref operator's --leader-elect)",
+    )
     args = parser.parse_args()
     configure_logging()
     if args.command == "operator":

@@ -77,6 +77,7 @@ class K8sGraphOperator:
         sla_profiles: Optional[Any] = None,  # List[ConfigProfile] for DGDR
         pod_backend: bool = False,  # actuate CRs as cluster pods, not procs
         checkpoint_runner: Optional[Any] = None,  # async (identity) → location
+        leader_elector: Optional[Any] = None,  # deploy.leader.LeaderElector
     ) -> None:
         self.client = client
         self.k8s_namespace = k8s_namespace
@@ -86,6 +87,7 @@ class K8sGraphOperator:
         self.sla_profiles = sla_profiles
         self.pod_backend = pod_backend
         self.checkpoint_runner = checkpoint_runner
+        self.leader_elector = leader_elector
         self._swept_orphans = False
         self._controllers: Dict[str, GraphController] = {}
         self._specs: Dict[str, str] = {}  # name → serialized spec (drift check)
@@ -492,7 +494,18 @@ class K8sGraphOperator:
     async def run(self) -> None:
         """Level-triggered loop: reconcile everything, then watch until the
         window closes (events only wake us early — the list is the truth)."""
+        if self.leader_elector is not None:
+            self.leader_elector.start()
         while not self._stop.is_set():
+            if self.leader_elector is not None and not self.leader_elector.is_leader:
+                # Replicated operators: only the lease holder reconciles
+                # (ref: deploy/operator/cmd/main.go --leader-elect). A
+                # candidate parks until it acquires; its controllers stay
+                # cold so two operators never double-actuate.
+                await self.leader_elector.wait_leader(
+                    timeout=self.reconcile_interval_s
+                )
+                continue
             # Adapters first: their replica patches land before the GD
             # pass reads the specs, so a scale round-trips in ONE pass.
             # Each sub-pass is isolated — an optional feature failing (e.g.
@@ -564,6 +577,11 @@ class K8sGraphOperator:
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks = []
+        if self.leader_elector is not None:
+            # Release the lease only AFTER the run loop has fully exited:
+            # releasing mid-pass would let a standby start actuating while
+            # this instance's in-flight reconcile is still mutating pods.
+            await self.leader_elector.stop()
         for name in list(self._controllers):
             ctrl = self._controllers.pop(name)
             # Operator exit is NOT CR deletion: actuators whose workloads

@@ -1,0 +1,212 @@
+"""Operator leader election over coordination.k8s.io/v1 Leases.
+
+Reference parity: the reference operator runs controller-runtime's
+lease-based leader election (deploy/operator/cmd/main.go:136-175,
+--leader-elect) so replicated operator pods never double-reconcile. Same
+contract here: one Lease object per election id; the holder renews
+renewTime every renew_interval; a candidate takes over when the lease is
+older than lease_duration (crashed holder) or absent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Optional
+
+from dynamo_tpu.deploy.k8s_client import KubeApiError
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+GROUP = "coordination.k8s.io"
+VERSION = "v1"
+PLURAL = "leases"
+
+
+def _now_rfc3339() -> str:
+    t = time.time()
+    base = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t))
+    return f"{base}.{int((t % 1) * 1e6):06d}Z"
+
+
+def _parse_rfc3339(s: str) -> float:
+    import calendar
+
+    s = s.rstrip("Z")
+    frac = 0.0
+    if "." in s:
+        s, f = s.split(".", 1)
+        frac = float(f"0.{f}") if f else 0.0
+    return calendar.timegm(time.strptime(s, "%Y-%m-%dT%H:%M:%S")) + frac
+
+
+class LeaderElector:
+    """Lease-based election: call start(); gate work on ``is_leader`` (or
+    ``await wait_leader()``). Crash-safety comes from the lease going stale,
+    not from graceful release — though stop() does release when possible."""
+
+    def __init__(
+        self,
+        client: Any,  # deploy.k8s_client.KubeClient
+        *,
+        name: str = "dynamo-tpu-operator",
+        k8s_namespace: str = "default",
+        identity: Optional[str] = None,
+        lease_duration_s: float = 15.0,
+        renew_interval_s: Optional[float] = None,
+    ) -> None:
+        self._last_renew_ok = 0.0  # monotonic time of last successful renew
+        self.client = client
+        self.name = name
+        self.k8s_namespace = k8s_namespace
+        self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s or lease_duration_s / 3.0
+        self.is_leader = False
+        self.transitions = 0  # acquired-count (observability/tests)
+        self._task: Optional[asyncio.Task] = None
+        self._leader_event = asyncio.Event()
+        self._stop = asyncio.Event()
+
+    async def wait_leader(self, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self._leader_event.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def try_acquire_once(self) -> bool:
+        """One acquire/renew attempt; updates is_leader."""
+        spec_patch = {
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration_s),
+                "renewTime": _now_rfc3339(),
+            }
+        }
+        try:
+            lease = await self.client.get(
+                GROUP, VERSION, self.k8s_namespace, PLURAL, self.name
+            )
+        except KubeApiError as exc:
+            if exc.status != 404:
+                raise
+            body = {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": "Lease",
+                "metadata": {"name": self.name},
+                **spec_patch,
+            }
+            try:
+                await self.client.create(
+                    GROUP, VERSION, self.k8s_namespace, PLURAL, body
+                )
+                self._become(True)
+                return True
+            except KubeApiError as exc2:
+                if exc2.status == 409:  # lost the create race
+                    self._become(False)
+                    return False
+                raise
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = spec.get("renewTime")
+        age = (
+            time.time() - _parse_rfc3339(renew)
+            if renew
+            else self.lease_duration_s + 1
+        )
+        duration = float(spec.get("leaseDurationSeconds") or self.lease_duration_s)
+        if holder == self.identity or not holder or age > duration:
+            # renew, first claim, or takeover of a stale (crashed) holder.
+            # The patch carries the observed resourceVersion: a concurrent
+            # candidate's patch bumps it, so the second writer gets 409
+            # instead of silently stealing the claim (split-brain guard —
+            # the role of client-go leaderelection's update-with-RV).
+            rv = (lease.get("metadata") or {}).get("resourceVersion")
+            body = dict(spec_patch)
+            if rv is not None:
+                body["metadata"] = {"resourceVersion": str(rv)}
+            try:
+                await self.client.patch(
+                    GROUP, VERSION, self.k8s_namespace, PLURAL, self.name,
+                    body,
+                )
+            except KubeApiError as exc:
+                if exc.status == 409:  # lost the takeover race
+                    self._become(False)
+                    return False
+                raise
+            self._become(True)
+            return True
+        self._become(False)
+        return False
+
+    def _become(self, leader: bool) -> None:
+        if leader:
+            self._last_renew_ok = time.monotonic()
+        if leader and not self.is_leader:
+            self.transitions += 1
+            logger.info("leader election %s: ACQUIRED by %s", self.name, self.identity)
+            self._leader_event.set()
+        elif not leader and self.is_leader:
+            logger.warning("leader election %s: LOST by %s", self.name, self.identity)
+            self._leader_event.clear()
+        self.is_leader = leader
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.try_acquire_once()
+            except Exception:
+                # apiserver hiccups: a leader keeps working until the lease
+                # WOULD have gone stale — past that point a standby may
+                # legitimately hold it, so this instance must demote
+                # (client-go's renew deadline semantics) rather than
+                # double-reconcile.
+                logger.exception("leader election attempt failed")
+                if (
+                    self.is_leader
+                    and time.monotonic() - self._last_renew_ok
+                    > self.lease_duration_s
+                ):
+                    logger.warning(
+                        "leader election %s: renew deadline exceeded — "
+                        "demoting %s", self.name, self.identity,
+                    )
+                    self._become(False)
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.renew_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._task = asyncio.get_event_loop().create_task(
+            self._run(), name=f"leader-{self.name}"
+        )
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self.is_leader:
+            # graceful release: zero the holder so a peer takes over at its
+            # next tick instead of waiting out the lease duration
+            try:
+                await self.client.patch(
+                    GROUP, VERSION, self.k8s_namespace, PLURAL, self.name,
+                    {"spec": {"holderIdentity": None, "renewTime": None}},
+                )
+            except Exception:
+                pass
+            self._become(False)

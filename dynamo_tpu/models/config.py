@@ -133,13 +133,20 @@ class ModelConfig:
         swp = cfg.get("sliding_window_pattern") or cfg.get(
             "_sliding_window_pattern"
         )
+        # (gated: a vestigial sliding_window behind use_sliding_window=false
+        # must not re-enter through the layer_types path either)
+        _gated_window = (
+            cfg.get("sliding_window")
+            if cfg.get("use_sliding_window", True)
+            else None
+        )
         window_overrides = None
-        if cfg.get("layer_types") and cfg.get("sliding_window"):
+        if cfg.get("layer_types") and _gated_window:
             # layer_types is the authoritative per-layer layout — honor it
             # VERBATIM (aperiodic lists included) instead of inferring a
             # period from it.
             window_overrides = [
-                int(cfg["sliding_window"]) if t == "sliding_attention" else 0
+                int(_gated_window) if t == "sliding_attention" else 0
                 for t in cfg["layer_types"]
             ]
         if gemma3 and not swp and window_overrides is None:

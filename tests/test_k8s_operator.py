@@ -43,6 +43,7 @@ class FakeApiServer:
     def bump(self, obj=None):
         self.rv += 1
         if obj is not None:
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
             for q in self._watchers:
                 q.put_nowait(obj)
         return str(self.rv)
@@ -62,6 +63,13 @@ class FakeApiServer:
                 base + "/{name}/status", self._make_patch_status(plural)
             )
             app.router.add_patch(base + "/{name}", self._make_patch(plural))
+        # coordination.k8s.io/v1 leases (leader election)
+        lbase = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+        app.router.add_get(lbase, self._make_list("leases"))
+        app.router.add_post(lbase, self._make_create("leases"))
+        app.router.add_get(lbase + "/{name}", self._make_get("leases"))
+        app.router.add_patch(lbase + "/{name}", self._make_patch("leases"))
+        app.router.add_delete(lbase + "/{name}", self._make_delete("leases"))
         # core/v1 pods + services (the fake kubelet runs every pod at once)
         for plural in ("pods", "services"):
             base = f"/api/v1/namespaces/{{ns}}/{plural}"
@@ -174,6 +182,10 @@ class FakeApiServer:
             if obj is None:
                 return web.json_response({"reason": "NotFound"}, status=404)
             patch = await request.json()
+            want_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            have_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if want_rv is not None and have_rv is not None and want_rv != have_rv:
+                return web.json_response({"reason": "Conflict"}, status=409)
 
             def merge(dst, src):  # RFC 7386 merge-patch semantics
                 for k, v in src.items():
@@ -792,4 +804,84 @@ async def test_checkpoint_default_runner_warms_worker_loader(tmp_path):
         assert "modelDir" in fake.store[(CKPT_PLURAL, "builtin")]["status"]["message"]
     finally:
         await op.stop()
+        await runner.cleanup()
+
+
+async def test_leader_election_single_winner_and_takeover():
+    """Two electors: exactly one acquires; when the holder stops renewing
+    (crash), the candidate takes over after the lease goes stale; graceful
+    stop hands over immediately."""
+    from dynamo_tpu.deploy.leader import LeaderElector
+
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    c1, c2 = KubeClient(url), KubeClient(url)
+    a = LeaderElector(c1, identity="op-a", lease_duration_s=1.0)
+    b = LeaderElector(c2, identity="op-b", lease_duration_s=1.0)
+    try:
+        assert await a.try_acquire_once()
+        assert not await b.try_acquire_once()
+        assert a.is_leader and not b.is_leader
+
+        # holder keeps renewing → candidate stays out
+        assert await a.try_acquire_once()
+        assert not await b.try_acquire_once()
+
+        # crash: a stops renewing; after the lease duration b takes over
+        await asyncio.sleep(1.2)
+        assert await b.try_acquire_once()
+        assert b.is_leader
+
+        # graceful release: b stops, a can acquire immediately
+        await b.stop()
+        assert await a.try_acquire_once()
+        assert a.is_leader
+    finally:
+        await a.stop()
+        await b.stop()
+        await c1.close()
+        await c2.close()
+        await runner.cleanup()
+
+
+async def test_operator_reconciles_only_as_leader():
+    """Two operators with electors on the same election: only the lease
+    holder reconciles; after the holder stops, the standby takes over and
+    reconciles the same CRs."""
+    from dynamo_tpu.deploy.leader import LeaderElector
+
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    cl_a, cl_b = KubeClient(url), KubeClient(url)
+    op_a = K8sGraphOperator(
+        cl_a, watch_timeout_s=0.3, reconcile_interval_s=0.1,
+        leader_elector=LeaderElector(
+            cl_a, identity="op-a", lease_duration_s=1.0,
+            renew_interval_s=0.2,
+        ),
+    )
+    op_b = K8sGraphOperator(
+        cl_b, watch_timeout_s=0.3, reconcile_interval_s=0.1,
+        leader_elector=LeaderElector(
+            cl_b, identity="op-b", lease_duration_s=1.0,
+            renew_interval_s=0.2,
+        ),
+    )
+    try:
+        fake.apply(GD_PLURAL, "ha-demo", gd_spec(1))
+        op_a.start()
+        await asyncio.sleep(0.3)  # a acquires first
+        op_b.start()
+        assert await _wait_for(lambda: op_a.reconciles > 0)
+        await asyncio.sleep(0.5)
+        assert op_b.reconciles == 0, "standby operator reconciled"
+        assert not op_b.leader_elector.is_leader
+
+        # failover: stop the leader; standby must take over and reconcile
+        await op_a.stop()
+        assert await _wait_for(lambda: op_b.reconciles > 0, timeout=30.0)
+        assert op_b.leader_elector.is_leader
+    finally:
+        await op_a.stop()
+        await op_b.stop()
         await runner.cleanup()
