@@ -79,6 +79,19 @@ class KvConnectorLeader:
         new_tokens = (matched - engine_blocks) * self.block_size
         return new_tokens, new_tokens > 0
 
+    def limit_match(self, request_id: str, num_blocks: int) -> None:
+        """Engine could only allocate ``num_blocks`` of the promised match
+        (pool pressure): shrink the slot so build_connector_meta never
+        emits load instructions for unallocated positions."""
+        slot = self._slots.get(request_id)
+        if slot is not None:
+            slot.matched = min(slot.matched, slot.engine_matched + num_blocks)
+
+    def forget(self, request_id: str) -> None:
+        """Drop a slot without a write-back decision (onboard-only flows —
+        request_finished is the full-lifecycle form)."""
+        self._slots.pop(request_id, None)
+
     def update_state_after_alloc(
         self, request_id: str, block_ids: List[int]
     ) -> None:

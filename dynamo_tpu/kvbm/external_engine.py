@@ -1,0 +1,171 @@
+"""External-engine adapter: a whole serving engine behind the KVBM
+connector seam.
+
+Reference parity: the reference's core business is serving engines it does
+NOT own through exactly this surface (kvbm vllm_integration's
+connector_leader/connector_worker pair wrapped by the engine-side adapter
+classes in components/src/dynamo/vllm). This module is that adapter for a
+JAX engine standing in as the "foreign" engine: KV moves ONLY through
+KvConnectorLeader/KvConnectorWorker + the host tier — the adapter never
+reaches into another engine's pools — so any engine that can expose
+put-block/get-block callbacks gets tiered KV reuse, onboarding, and
+write-back without the framework owning its internals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from dynamo_tpu.kvbm.connector import KvConnectorLeader, KvConnectorWorker
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ExternalEngineKvAdapter:
+    """Bridge one engine onto the connector halves.
+
+    The engine plays the reference's vLLM role: its scheduler consults the
+    LEADER for beyond-cache matches before prefill, its per-rank worker
+    executes the leader's opaque transfer instructions via two callbacks
+    that are the only place engine memory is touched.
+
+    ``salt``: requests whose engine hashes carry a per-request salt (LoRA
+    adapter, multimodal content — see admission.py) must pass the SAME salt
+    here, or their blocks can neither match nor round-trip.
+
+    Transfers on one adapter are serialized (one leader/worker pair holds
+    one bound metadata blob at a time); the engine keeps serving decode
+    between them."""
+
+    def __init__(self, engine: Any, tier: Any) -> None:
+        self.engine = engine
+        self.block_size = engine.args.block_size
+        self.leader = KvConnectorLeader(tier, self.block_size)
+        self.worker = KvConnectorWorker(tier)
+        self.worker.register_kv_caches(self._put_block, self._get_block)
+        self._lock = asyncio.Lock()  # meta bind → execute is a critical section
+        self.loads = 0
+        self.saves = 0
+
+    # -- engine-memory callbacks (the register_kv_caches contract) ---------
+
+    def _put_block(self, engine_block_id: int, k: np.ndarray, v: np.ndarray):
+        self.engine.runner.scatter_blocks(
+            [engine_block_id], np.asarray(k)[None], np.asarray(v)[None]
+        )
+
+    def _get_block(self, engine_block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        k, v = self.engine.runner.gather_blocks([engine_block_id])
+        return k[0], v[0]
+
+    # -- scheduler-side flows ----------------------------------------------
+
+    async def onboard(
+        self, request_id: str, prompt: List[int], *, salt: int = 0
+    ) -> int:
+        """Pre-admission: ask the leader what the KVBM can supply beyond
+        the engine's own prefix cache, execute the load instructions, and
+        commit the landed blocks so admission sees them as ordinary prefix
+        hits. Returns blocks onboarded."""
+        e = self.engine
+        hashes = compute_block_hashes(prompt, self.block_size, salt=salt)
+        async with self._lock:
+            # Pin the engine-matched prefix through the transfer: alloc()'s
+            # LRU eviction must not recycle the blocks the match (and the
+            # commit parent chain) depend on — same invariant admission
+            # establishes with pin-before-alloc.
+            engine_matched, pinned = e.pool.pin_prefix(hashes)
+            try:
+                return await self._onboard_locked(
+                    request_id, hashes, engine_matched
+                )
+            finally:
+                if pinned:
+                    e.pool.release(pinned, hashes[: len(pinned)])
+                self.leader.forget(request_id)
+
+    async def _onboard_locked(
+        self, request_id: str, hashes: List[int], engine_matched: int
+    ) -> int:
+        e = self.engine
+        new_tokens, _is_async = self.leader.get_num_new_matched_tokens(
+            request_id, hashes, engine_matched * self.block_size
+        )
+        if new_tokens <= 0:
+            return 0
+        span = range(
+            engine_matched, engine_matched + new_tokens // self.block_size
+        )
+        ids_full: List[int] = [-1] * len(hashes)
+        allocated: List[Tuple[int, int]] = []  # (position, engine block id)
+        for i in span:
+            b = e.pool.alloc()
+            if b is None:
+                break
+            ids_full[i] = b
+            allocated.append((i, b))
+        if not allocated:
+            return 0
+        # Pool pressure may have cut the allocation short: shrink the match
+        # so the leader never emits instructions targeting the -1 slots.
+        self.leader.limit_match(request_id, len(allocated))
+        self.leader.update_state_after_alloc(request_id, ids_full)
+        self.worker.bind_connector_metadata(self.leader.build_connector_meta())
+        try:
+            await e._device(self.worker.start_load_kv)
+        finally:
+            self.worker.clear_connector_metadata()
+        failed = {
+            h
+            for hs in self.worker.get_failed_loads().values()
+            for h in hs
+        }
+        parent = hashes[engine_matched - 1] if engine_matched else None
+        committed = 0
+        chain_broken = False
+        for i, b in allocated:
+            h = hashes[i]
+            if chain_broken or h in failed:
+                # a failed load revokes the match promise for this block
+                # AND everything after it (prefix chains must be gapless)
+                chain_broken = True
+                e.pool.release([b], [])
+                continue
+            e.pool.commit(b, h, parent)
+            e.pool.release([b], [h])  # cached, unreferenced
+            parent = h
+            committed += 1
+        self.loads += committed
+        return committed
+
+    async def offload(
+        self, request_id: str, prompt: List[int], *, salt: int = 0
+    ) -> int:
+        """Post-request write-back: the leader decides which committed
+        blocks the tier lacks; the worker reads them out of engine memory
+        and stores them. Returns blocks saved."""
+        e = self.engine
+        hashes = compute_block_hashes(prompt, self.block_size, salt=salt)
+        async with self._lock:
+            matched, ids = e.pool.pin_prefix(hashes)
+            try:
+                pairs = list(zip(hashes[:matched], ids))
+                if not self.leader.request_finished(request_id, pairs):
+                    return 0
+                self.worker.bind_connector_metadata(
+                    self.leader.build_connector_meta()
+                )
+                try:
+                    n = await e._device(self.worker.save_kv_blocks)
+                finally:
+                    self.worker.clear_connector_metadata()
+                self.saves += n
+                return n
+            finally:
+                if ids:
+                    e.pool.release(ids, hashes[: len(ids)])
