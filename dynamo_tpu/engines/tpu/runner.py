@@ -669,6 +669,38 @@ class DeviceRunner:
         freq, bias_ids, bias_vals) slot arrays → the processor program.
         Returns ([B, K] tokens, [B, K] logprobs, top_vals | None,
         top_ids | None) as numpy."""
+        if self.use_megakernel:
+            # One-shot safety net: the fused-layer kernel compiles lazily
+            # at the first decode dispatch — if Mosaic rejects it on this
+            # jaxlib/chip (or the shape trips a VMEM limit), demote to the
+            # XLA decode path instead of poisoning serving. Single-process
+            # only by construction (megakernel requires mesh is None), so
+            # no SPMD follower can diverge.
+            try:
+                return self._run_decode_inner(
+                    tokens, start_pos, active, block_tables, temp, topk,
+                    topp, adapter_ids, want_logprobs, procs,
+                )
+            except Exception:
+                logger.exception(
+                    "megakernel decode failed — falling back to the XLA "
+                    "decode path for this engine"
+                )
+                self.use_megakernel = False
+                self._decode_fn = self._build_decode_fn(want_logprobs=False)
+                self._decode_fn_logprobs = self._build_decode_fn(
+                    want_logprobs=True
+                )
+                self._decode_procs_fns = {}
+        return self._run_decode_inner(
+            tokens, start_pos, active, block_tables, temp, topk, topp,
+            adapter_ids, want_logprobs, procs,
+        )
+
+    def _run_decode_inner(
+        self, tokens, start_pos, active, block_tables, temp, topk, topp,
+        adapter_ids, want_logprobs=False, procs=None,
+    ):
         self._mirror(
             "decode", tokens=tokens, start_pos=start_pos, active=active,
             block_tables=block_tables, temp=temp, topk=topk, topp=topp,
