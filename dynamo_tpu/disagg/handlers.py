@@ -19,11 +19,47 @@ from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     PreprocessedRequest,
 )
+from dynamo_tpu.runtime import lifecycle
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+class DisaggMetrics:
+    """Canonical disagg transfer families (runtime/metric_names.py
+    ALL_DISAGG). One instance per DecodeHandler; ``render`` plugs into the
+    system server's ``register_metrics`` seam. The handler's plain counters
+    (``transfers``/``transfer_failures``/…) stay — tests and the aggregate
+    rate math read them — these are their scrapeable form."""
+
+    def __init__(self) -> None:
+        from dynamo_tpu.runtime import metric_names as mn
+        from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.transfers = self.registry.counter(
+            mn.DISAGG_TRANSFERS_TOTAL, "KV pulls from prefill workers"
+        )
+        self.transfer_failures = self.registry.counter(
+            mn.DISAGG_TRANSFER_FAILURES_TOTAL,
+            "Failed KV pulls — each one IS the 2x-cost path: the decode "
+            "worker falls back to a second full local prefill",
+        )
+        self.blocks_pulled = self.registry.counter(
+            mn.DISAGG_BLOCKS_PULLED_TOTAL, "KV blocks imported from prefill"
+        )
+        self.bytes_pulled = self.registry.counter(
+            mn.DISAGG_BYTES_PULLED_TOTAL, "KV bytes pulled over the wire"
+        )
+        self.transfer_duration = self.registry.histogram(
+            mn.DISAGG_TRANSFER_DURATION,
+            "Wall time of one KV pull (request-scoped, chunks included)",
+        )
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
 
 
 def pack_array(a) -> Dict[str, Any]:
@@ -174,8 +210,15 @@ class DecodeHandler:
         # while summed per-pull seconds would understate it.
         self.transfer_first_start = 0.0
         self.transfer_last_end = 0.0
+        self.metrics = DisaggMetrics()
 
-    async def _pull_blocks(self, dp: DisaggregatedParams) -> int:
+    def register_metrics(self, server: Any) -> None:
+        """Expose this handler's transfer families on a SystemStatusServer."""
+        server.register_metrics(self.metrics.render)
+
+    async def _pull_blocks(
+        self, dp: DisaggregatedParams, trace_id: Optional[str] = None
+    ) -> int:
         info = dp.kv_transfer or {}
         hashes = list(info.get("block_hashes") or [])
         if not hashes or self._kv_client_factory is None:
@@ -193,6 +236,7 @@ class DecodeHandler:
         if self._kv_client is None:
             self._kv_client = await self._kv_client_factory()
         self.transfers += 1
+        self.metrics.transfers.inc()
         t0 = time.monotonic()
         if not self.transfer_first_start:
             self.transfer_first_start = t0
@@ -218,7 +262,10 @@ class DecodeHandler:
                 )
                 imported += n
                 self.blocks_pulled += n
-                self.bytes_pulled += len(reply["k"]["b"]) + len(reply["v"]["b"])
+                chunk_bytes = len(reply["k"]["b"]) + len(reply["v"]["b"])
+                self.bytes_pulled += chunk_bytes
+                self.metrics.blocks_pulled.inc(n)
+                self.metrics.bytes_pulled.inc(chunk_bytes)
                 if n < len(found):
                     # Pool dry mid-chunk: anchoring later chunks on an
                     # uninstalled hash would commit children whose parent
@@ -234,6 +281,7 @@ class DecodeHandler:
                     break
         except Exception:
             self.transfer_failures += 1
+            self.metrics.transfer_failures.inc()
             logger.exception(
                 "KV pull from prefill worker %s failed after %d blocks; "
                 "decoding with local prefill (fallback #%d — a recurring "
@@ -243,6 +291,9 @@ class DecodeHandler:
         now = time.monotonic()
         self.transfer_seconds += now - t0
         self.transfer_last_end = now
+        # Exemplar: a transfer-latency spike on a dashboard resolves to the
+        # trace (and thus the /debug/requests timeline) that caused it.
+        self.metrics.transfer_duration.observe(now - t0, trace_id=trace_id)
         return imported
 
     async def generate(
@@ -254,7 +305,18 @@ class DecodeHandler:
             else PreprocessedRequest.from_dict(dict(request))
         )
         if req.disaggregated_params is not None:
-            pulled = await self._pull_blocks(req.disaggregated_params)
+            t0 = time.monotonic()
+            pulled = await self._pull_blocks(
+                req.disaggregated_params,
+                trace_id=lifecycle.trace_id_of(context),
+            )
+            lifecycle.record(
+                req.request_id, "kv_transfer",
+                context=context,
+                blocks=pulled,
+                worker=req.disaggregated_params.worker_id,
+                duration_ms=round((time.monotonic() - t0) * 1000, 3),
+            )
             if pulled:
                 logger.info(
                     "imported %d KV blocks from prefill worker %s",

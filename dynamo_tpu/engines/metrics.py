@@ -1,0 +1,64 @@
+"""Engine step-loop metrics (runtime/metric_names.py ALL_ENGINE families).
+
+Reference parity: the reference's backend ForwardPassMetrics / engine-side
+Prometheus gauges — but for the step loop itself: how long each device
+dispatch takes, how full the batch is, and how many tokens each step moved,
+split prefill vs decode. These are the signals the planner's SLA math and
+the ROADMAP's autoscaling direction need (step time × occupancy = achieved
+throughput; prefill-vs-decode token mix = P/D balance).
+
+One instance per engine object on a private registry (see
+runtime/metrics_core.py for why not prometheus_client's global registry);
+``render`` plugs into ``SystemStatusServer.register_metrics`` — wired by
+``attach_engine`` for any engine exposing a ``step_metrics`` attribute.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class EngineStepMetrics:
+    def __init__(self) -> None:
+        from dynamo_tpu.runtime import metric_names as mn
+        from dynamo_tpu.runtime.metrics_core import COUNT_BUCKETS, MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.step_duration = self.registry.histogram(
+            mn.ENGINE_STEP_DURATION,
+            "Device step wall time (one dispatch), by phase (prefill|decode)",
+            ["phase"],
+        )
+        self.batch_occupancy = self.registry.histogram(
+            mn.ENGINE_BATCH_OCCUPANCY,
+            "Sequences packed into one device step, by phase",
+            ["phase"],
+            buckets=COUNT_BUCKETS,
+        )
+        self.prefill_tokens = self.registry.histogram(
+            mn.ENGINE_STEP_PREFILL_TOKENS,
+            "Prompt tokens processed per prefill step",
+            buckets=COUNT_BUCKETS,
+        )
+        self.decode_tokens = self.registry.histogram(
+            mn.ENGINE_STEP_DECODE_TOKENS,
+            "Tokens emitted per decode step (fused multi-iteration burst)",
+            buckets=COUNT_BUCKETS,
+        )
+
+    def observe_prefill(self, duration_s: float, occupancy: int, tokens: int) -> None:
+        self.step_duration.observe(duration_s, phase="prefill")
+        self.batch_occupancy.observe(occupancy, phase="prefill")
+        self.prefill_tokens.observe(tokens)
+
+    def observe_decode(self, duration_s: float, occupancy: int, tokens: int) -> None:
+        self.step_duration.observe(duration_s, phase="decode")
+        self.batch_occupancy.observe(occupancy, phase="decode")
+        self.decode_tokens.observe(tokens)
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
+
+    def register_metrics(self, server: Any) -> None:
+        """Expose this engine's step families on a SystemStatusServer."""
+        server.register_metrics(self.render)

@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.runtime import lifecycle
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 
 from dynamo_tpu.llm.protocols.common import (
@@ -234,6 +236,13 @@ class Admitter:
         rows = len(batch)
         prompts = [seq.all_tokens for seq, _ in batch]
         pos = [prep.matched_tokens for _, prep in batch]
+        for seq, prep in batch:
+            lifecycle.record(
+                seq.request.request_id, "prefill_start",
+                context=seq.context,
+                prompt_tokens=len(seq.all_tokens),
+                cached_tokens=prep.matched_tokens,
+            )
         first: List[Optional[Tuple[int, float, Optional[list]]]] = [None] * rows
         # Any row asking for top-N logprobs routes the batch through the
         # top-variant prefill program so the FIRST generated token carries
@@ -306,11 +315,20 @@ class Admitter:
             # Fresh prefills (no prefix-cache hit, first chunk round) take
             # the dense in-chunk attention program — zero paged reads.
             first_chunk = bool(np.all(start[:rows] == 0))
+            t0 = time.monotonic()
             toks, logps, topv, topi = await e._device(
                 e._run_step,
                 tok_arr, start, lens, tables,
                 temp, topk, topp, adapter,
                 mm_embeds, mm_chunk, procs, want_top, first_chunk,
+            )
+            e.step_metrics.observe_prefill(
+                # Occupancy counts rows still prefilling this round — short
+                # prompts finish earlier chunk rounds and ride along with
+                # lens == 0.
+                time.monotonic() - t0,
+                int(np.count_nonzero(lens[:rows])),
+                int(lens.sum()),
             )
             for r in range(rows):
                 n = int(lens[r])

@@ -24,6 +24,7 @@ import asyncio
 import collections
 import functools
 import math
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
@@ -262,6 +263,11 @@ class JaxEngine:
         self.steps = 0  # decode iterations (observability)
         self.prefill_tokens = 0
         self.generated_tokens = 0
+        # Step-loop metric families (registered on the system server by
+        # attach_engine; dependency-free, so always on).
+        from dynamo_tpu.engines.metrics import EngineStepMetrics
+
+        self.step_metrics = EngineStepMetrics()
 
     # -- device-state delegates (DeviceRunner owns the mechanism) ---------
 
@@ -764,6 +770,7 @@ class JaxEngine:
             s.request.sampling.logprobs is not None for s in active
         )
         want_procs = any(self._uses_procs[s.slot] for s in active)
+        t0 = time.monotonic()
         toks, logps, topv, topi = await self._device(
             self._run_decode,
             tokens,
@@ -775,14 +782,21 @@ class JaxEngine:
             want_logprobs,
             want_procs,
         )
+        step_s = time.monotonic() - t0
         self.steps += 1
 
+        gen0 = self.generated_tokens
         for seq in list(active):
             self._emit_burst(
                 seq, toks[seq.slot], logps[seq.slot],
                 None if topv is None else topv[seq.slot],
                 None if topi is None else topi[seq.slot],
             )
+        # Emitted (post-stop-condition) tokens, not dispatched K×B — the
+        # honest throughput number the planner divides by step time.
+        self.step_metrics.observe_decode(
+            step_s, len(active), self.generated_tokens - gen0
+        )
 
     def _emit_burst(
         self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray,

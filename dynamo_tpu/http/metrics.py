@@ -3,6 +3,12 @@
 Reference parity: lib/llm/src/http/service/metrics.rs (request counters,
 TTFT/ITL/duration histograms, in-flight gauges) with the canonical naming
 scheme of lib/runtime/src/metrics/prometheus_names.rs.
+
+Exemplars (tentpole part 3): the TTFT and request-duration histograms carry
+the request's trace id as an OpenMetrics exemplar — rendered when the
+scraper negotiates ``application/openmetrics-text`` — so a latency spike on
+a dashboard links straight to ``/debug/traces?trace_id=…`` and the
+``/debug/requests/{id}`` timeline captured for that request.
 """
 
 from __future__ import annotations
@@ -16,6 +22,9 @@ from prometheus_client import (
     Gauge,
     Histogram,
     generate_latest,
+)
+from prometheus_client.openmetrics.exposition import (
+    generate_latest as generate_openmetrics,
 )
 
 _SECONDS_BUCKETS = (
@@ -75,12 +84,19 @@ class FrontendMetrics:
             registry=self.registry,
         )
 
-    def render(self) -> bytes:
+    def render(self, openmetrics: bool = False) -> bytes:
+        if openmetrics:
+            return generate_openmetrics(self.registry)
         return generate_latest(self.registry)
 
 
 class RequestTimer:
-    """Per-request observation helper feeding FrontendMetrics."""
+    """Per-request observation helper feeding FrontendMetrics.
+
+    ``bind_context`` (called once the request's root span exists) attaches
+    the trace id — from then on TTFT/duration observations carry it as an
+    exemplar, and first-token/done lifecycle events are stamped onto the
+    request's /debug timeline."""
 
     def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str) -> None:
         self._m = metrics
@@ -89,12 +105,40 @@ class RequestTimer:
         self._start = time.monotonic()
         self._last_token: Optional[float] = None
         self._done = False
+        self._request_id: Optional[str] = None
+        self._trace_id: Optional[str] = None
         self._m.inflight.labels(model, endpoint).inc()
+
+    def bind_context(self, context) -> None:
+        """Adopt the request's id + active trace (runtime Context whose
+        baggage carries a traceparent)."""
+        from dynamo_tpu.runtime import lifecycle
+
+        self._request_id = getattr(context, "id", None)
+        self._trace_id = lifecycle.trace_id_of(context)
+        lifecycle.record(
+            self._request_id, "received",
+            trace_id=self._trace_id,
+            model=self._model, endpoint=self._endpoint,
+        )
+
+    def _exemplar(self) -> Optional[dict]:
+        return {"trace_id": self._trace_id} if self._trace_id else None
 
     def on_token(self, count: int = 1) -> None:
         now = time.monotonic()
         if self._last_token is None:
-            self._m.ttft.labels(self._model).observe(now - self._start)
+            self._m.ttft.labels(self._model).observe(
+                now - self._start, exemplar=self._exemplar()
+            )
+            if self._request_id:
+                from dynamo_tpu.runtime import lifecycle
+
+                lifecycle.record(
+                    self._request_id, "first_token",
+                    trace_id=self._trace_id,
+                    ttft_ms=round((now - self._start) * 1000, 3),
+                )
         else:
             self._m.itl.labels(self._model).observe(now - self._last_token)
         self._last_token = now
@@ -115,5 +159,12 @@ class RequestTimer:
         self._m.inflight.labels(self._model, self._endpoint).dec()
         self._m.requests_total.labels(self._model, self._endpoint, str(status)).inc()
         self._m.request_duration.labels(self._model, self._endpoint).observe(
-            time.monotonic() - self._start
+            time.monotonic() - self._start, exemplar=self._exemplar()
         )
+        if self._request_id:
+            from dynamo_tpu.runtime import lifecycle
+
+            lifecycle.record(
+                self._request_id, "done",
+                trace_id=self._trace_id, status=status,
+            )

@@ -127,6 +127,13 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def _metrics_route(self, request: web.Request) -> web.Response:
+        if "application/openmetrics-text" in request.headers.get("Accept", ""):
+            # OpenMetrics exposition carries trace-id exemplars on the TTFT
+            # and request-duration histograms (see http/metrics.py).
+            return web.Response(
+                body=self.metrics.render(openmetrics=True),
+                content_type="application/openmetrics-text",
+            )
         return web.Response(body=self.metrics.render(), content_type="text/plain")
 
     async def _models_route(self, request: web.Request) -> web.Response:
@@ -614,6 +621,10 @@ class HttpService:
             with self.tracker.guard(), span(
                 f"http.{endpoint}", ctx, model=model, stream=stream
             ):
+                # The root span just wrote its traceparent into the context
+                # baggage: binding here gives the timer (exemplars) and the
+                # lifecycle timeline the request's trace id.
+                timer.bind_context(ctx)
                 if stream:
                     return await self._stream_response(request, body, entry, ctx, kind, timer)
                 return await self._unary_response(body, entry, ctx, kind, timer, n)

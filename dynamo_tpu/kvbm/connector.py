@@ -49,11 +49,15 @@ class _RequestSlot:
 class KvConnectorLeader:
     """Scheduler-side half: match decisions + transfer-instruction builder."""
 
-    def __init__(self, tier: Any, block_size: int) -> None:
+    def __init__(self, tier: Any, block_size: int, *, metrics: Any = None) -> None:
         self.tier = tier  # HostTier-compatible: contains/get/put
         self.block_size = block_size
         self._slots: Dict[str, _RequestSlot] = {}
         self._pending_saves: Dict[str, List[Tuple[int, int]]] = {}
+        # Shared KvbmMetrics (kvbm/manager.py) when the host process exposes
+        # a /metrics surface; duck-typed so the connector stays arms-length.
+        self._metrics = metrics
+        self.pool_pressure_truncations = 0
 
     def get_num_new_matched_tokens(
         self,
@@ -85,7 +89,15 @@ class KvConnectorLeader:
         emits load instructions for unallocated positions."""
         slot = self._slots.get(request_id)
         if slot is not None:
-            slot.matched = min(slot.matched, slot.engine_matched + num_blocks)
+            limited = slot.engine_matched + num_blocks
+            if limited < slot.matched:
+                # Pool pressure made the KVBM's match promise partially
+                # undeliverable — a planner watching truncations knows the
+                # engine pool, not the tiers, is the bottleneck.
+                self.pool_pressure_truncations += 1
+                if self._metrics is not None:
+                    self._metrics.pool_pressure_truncations.inc()
+            slot.matched = min(slot.matched, limited)
 
     def forget(self, request_id: str) -> None:
         """Drop a slot without a write-back decision (onboard-only flows —
@@ -148,7 +160,7 @@ class KvConnectorWorker:
     """Per-rank half: executes the leader's transfer instructions against
     engine memory via the registered callbacks."""
 
-    def __init__(self, tier: Any) -> None:
+    def __init__(self, tier: Any, *, metrics: Any = None) -> None:
         self.tier = tier
         self._put: Optional[PutBlockFn] = None
         self._get: Optional[GetBlockFn] = None
@@ -156,6 +168,9 @@ class KvConnectorWorker:
         self._finished_loads: Set[str] = set()
         self._finished_saves: Set[str] = set()
         self._failed_loads: Dict[str, List[int]] = {}
+        # Shared KvbmMetrics (kvbm/manager.py): the external-engine seam
+        # reports through the same ALL_KVBM families as the native manager.
+        self._metrics = metrics
 
     def register_kv_caches(self, put_block: PutBlockFn, get_block: GetBlockFn) -> None:
         """The engine's device-memory accessors (ref: register_kv_caches
@@ -190,9 +205,16 @@ class KvConnectorWorker:
                     "engine must recompute", block_hash, rid,
                 )
                 self._failed_loads.setdefault(rid, []).append(block_hash)
+                if self._metrics is not None:
+                    self._metrics.failed_loads.inc()
                 continue
             self._put(engine_block_id, blk[0], blk[1])
             n += 1
+            if self._metrics is not None:
+                self._metrics.onboard_blocks.inc()
+                self._metrics.onboard_bytes.inc(
+                    int(blk[0].nbytes) + int(blk[1].nbytes)
+                )
         for rid in touched:
             if rid not in self._failed_loads:
                 self._finished_loads.add(rid)
@@ -215,9 +237,13 @@ class KvConnectorWorker:
         n = 0
         for rid, block_hash, engine_block_id in meta.get("saves", ()):
             k, v = self._get(engine_block_id)
-            self.tier.put(block_hash, np.asarray(k), np.asarray(v))
+            ka, va = np.asarray(k), np.asarray(v)
+            self.tier.put(block_hash, ka, va)
             n += 1
             self._finished_saves.add(rid)
+            if self._metrics is not None:
+                self._metrics.offload_blocks.inc()
+                self._metrics.offload_bytes.inc(int(ka.nbytes) + int(va.nbytes))
         return n
 
     def get_finished(self) -> Tuple[Set[str], Set[str]]:

@@ -19,6 +19,79 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+class KvbmMetrics:
+    """Canonical KVBM metric families (runtime/metric_names.py ALL_KVBM).
+
+    One instance is shared by everything that moves KV for a process: the
+    TieredKvManager (native-engine offload/onboard), and optionally the
+    connector leader/worker (external-engine seam, which counts
+    pool-pressure truncations and revoked loads). Tier occupancy and
+    hit/miss totals are sampled from the tiers' own TierStats at scrape
+    time, so the attributes tests already read stay the source of truth."""
+
+    def __init__(self) -> None:
+        from dynamo_tpu.runtime import metric_names as mn
+        from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.offload_blocks = self.registry.counter(
+            mn.KVBM_OFFLOAD_BLOCKS_TOTAL, "KV blocks offloaded device->tiers"
+        )
+        self.offload_bytes = self.registry.counter(
+            mn.KVBM_OFFLOAD_BYTES_TOTAL, "KV bytes offloaded device->tiers"
+        )
+        self.onboard_blocks = self.registry.counter(
+            mn.KVBM_ONBOARD_BLOCKS_TOTAL, "KV blocks onboarded tiers->device"
+        )
+        self.onboard_bytes = self.registry.counter(
+            mn.KVBM_ONBOARD_BYTES_TOTAL, "KV bytes onboarded tiers->device"
+        )
+        self.lookup_hits = self.registry.counter(
+            mn.KVBM_LOOKUP_HITS_TOTAL, "Tier lookup hits", ["tier"]
+        )
+        self.lookup_misses = self.registry.counter(
+            mn.KVBM_LOOKUP_MISSES_TOTAL, "Tier lookup misses", ["tier"]
+        )
+        self.tier_blocks = self.registry.gauge(
+            mn.KVBM_TIER_BLOCKS, "Blocks resident per tier", ["tier"]
+        )
+        self.tier_evictions = self.registry.counter(
+            mn.KVBM_TIER_EVICTIONS_TOTAL, "LRU evictions per tier", ["tier"]
+        )
+        self.pool_pressure_truncations = self.registry.counter(
+            mn.KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL,
+            "Promised KVBM matches shrunk because the engine pool could not "
+            "allocate the full run",
+        )
+        self.failed_loads = self.registry.counter(
+            mn.KVBM_FAILED_LOADS_TOTAL,
+            "Instructed loads revoked because the block vanished from the "
+            "tiers before transfer (engine must recompute)",
+        )
+        self._tier_sources: Dict[str, Any] = {}
+        self.registry.on_render(self._sample_tiers)
+
+    def watch_tier(self, name: str, tier: Any) -> None:
+        """Sample ``tier`` (``.stats`` TierStats + ``__len__``) at scrape
+        time under the given tier label."""
+        self._tier_sources[name] = tier
+
+    def _sample_tiers(self) -> None:
+        for name, tier in self._tier_sources.items():
+            stats = getattr(tier, "stats", None)
+            if stats is not None:
+                self.lookup_hits.set_total(stats.hits, tier=name)
+                self.lookup_misses.set_total(stats.misses, tier=name)
+                self.tier_evictions.set_total(stats.evicted, tier=name)
+            try:
+                self.tier_blocks.set(len(tier), tier=name)
+            except TypeError:
+                pass
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
+
+
 @dataclass
 class OffloadFilter:
     """Which committed blocks get offloaded (ref: offload/filter.rs —
@@ -61,10 +134,19 @@ class TieredKvManager:
         *,
         filter: Optional[OffloadFilter] = None,
         remote: Optional[Any] = None,  # G4 RemoteTier (kvbm/remote.py)
+        metrics: Optional[KvbmMetrics] = None,
     ) -> None:
         self.tier = top_tier
         self.remote = remote
         self.filter = filter or OffloadFilter()
+        self.metrics = metrics or KvbmMetrics()
+        self.metrics.watch_tier(getattr(top_tier, "name", "host"), top_tier)
+        if top_tier.next_tier is not None:
+            self.metrics.watch_tier(
+                getattr(top_tier.next_tier, "name", "disk"), top_tier.next_tier
+            )
+        if remote is not None:
+            self.metrics.watch_tier("remote", remote)
         # hash → chain depth, queued for offload
         self._pending: "asyncio.Queue[Tuple[int, int]]" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
@@ -124,6 +206,8 @@ class TieredKvManager:
                 # G4 write-behind: the shared store absorbs it asynchronously.
                 self.remote.put(h, k[0], v[0])
             self.offloaded += 1
+            self.metrics.offload_blocks.inc()
+            self.metrics.offload_bytes.inc(int(k[0].nbytes) + int(v[0].nbytes))
 
     # -- onboard (G2/G3 → G1) ------------------------------------------------
 
@@ -164,7 +248,15 @@ class TieredKvManager:
             run, np.stack(ks), np.stack(vs)
         )
         self.onboarded += installed
+        self.metrics.onboard_blocks.inc(installed)
+        if installed:
+            per_block = int(ks[0].nbytes) + int(vs[0].nbytes)
+            self.metrics.onboard_bytes.inc(installed * per_block)
         return installed
+
+    def register_metrics(self, server: Any) -> None:
+        """Expose this manager's metric families on a SystemStatusServer."""
+        server.register_metrics(self.metrics.render)
 
     def stats(self) -> Dict[str, Any]:
         out = {

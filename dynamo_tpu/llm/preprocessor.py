@@ -138,6 +138,12 @@ class OpenAIPreprocessor:
     ) -> AsyncIterator[Any]:
         pre = self.preprocess(request)
         pre.request_id = context.id
+        from dynamo_tpu.runtime import lifecycle
+
+        lifecycle.record(
+            pre.request_id, "tokenized",
+            context=context, n_tokens=len(pre.token_ids),
+        )
         # Internal annotation consumed by the frontend for usage reporting
         # (never forwarded to clients).
         yield {"annotation": "_prompt_tokens", "value": len(pre.token_ids)}

@@ -30,10 +30,75 @@ from dynamo_tpu.router.protocols import (
     load_topic,
 )
 from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.runtime import lifecycle
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def _worker_label(worker: Any) -> str:
+    if isinstance(worker, tuple):
+        return ":".join(str(p) for p in worker)
+    return str(worker)
+
+
+class RouterMetrics:
+    """Canonical router metric families (runtime/metric_names.py ALL_ROUTER)
+    on a private registry; ``render`` plugs into the system status server's
+    ``register_metrics`` seam. Per-worker load gauges sample the scheduler's
+    cost-model state at scrape time (on_render), so the exposed load is the
+    same signal ``select_worker`` is acting on."""
+
+    def __init__(self, scheduler: KvScheduler) -> None:
+        from dynamo_tpu.runtime import metric_names as mn
+        from dynamo_tpu.runtime.metrics_core import COUNT_BUCKETS, MetricsRegistry
+
+        self._scheduler = scheduler
+        self.registry = MetricsRegistry()
+        self.decisions = self.registry.counter(
+            mn.ROUTER_DECISIONS_TOTAL,
+            "Routing decisions by reason "
+            "(kv_overlap|load_only|pinned|fallback|no_worker)",
+            ["reason"],
+        )
+        self.overlap_blocks = self.registry.histogram(
+            mn.ROUTER_OVERLAP_BLOCKS,
+            "Predicted prefix-overlap blocks per routed request",
+            buckets=COUNT_BUCKETS,
+        )
+        self.worker_load = self.registry.gauge(
+            mn.ROUTER_WORKER_LOAD_BLOCKS,
+            "Predicted active decode blocks per worker (reported + in-flight)",
+            ["worker"],
+        )
+        self.worker_kv_usage = self.registry.gauge(
+            mn.ROUTER_WORKER_KV_USAGE,
+            "Last reported KV-cache usage fraction per worker",
+            ["worker"],
+        )
+        self.kv_events = self.registry.counter(
+            mn.ROUTER_KV_EVENTS_TOTAL,
+            "KV cache events applied to the router index",
+        )
+        self._gauge_workers: set = set()
+        self.registry.on_render(self._sample_workers)
+
+    def _sample_workers(self) -> None:
+        view = self._scheduler.load_view()
+        labels = set()
+        for worker, (load_blocks, kv_usage) in view.items():
+            label = _worker_label(worker)
+            labels.add(label)
+            self.worker_load.set(load_blocks, worker=label)
+            self.worker_kv_usage.set(kv_usage, worker=label)
+        for gone in self._gauge_workers - labels:
+            self.worker_load.remove(worker=gone)
+            self.worker_kv_usage.remove(worker=gone)
+        self._gauge_workers = labels
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
 
 
 class KvRouter:
@@ -62,6 +127,7 @@ class KvRouter:
 
             self.indexer = ApproxKvIndexer(block_size, prune_config)
         self.scheduler = KvScheduler(config)
+        self.metrics = RouterMetrics(self.scheduler)
         self._tasks: list = []
         self._subs: list = []
         # request identity -> stack of (worker, charged blocks, report gen);
@@ -123,6 +189,8 @@ class KvRouter:
                 self.indexer.apply(event)
             except Exception:
                 logger.exception("bad KV event payload")
+            else:
+                self.metrics.kv_events.inc()
             if self._events_cond is not None:
                 async with self._events_cond:
                     self._events_cond.notify_all()
@@ -166,6 +234,10 @@ class KvRouter:
         self.indexer.remove_worker(worker)
         self.scheduler.remove_worker(worker)
 
+    def register_metrics(self, server: Any) -> None:
+        """Expose this router's metric families on a SystemStatusServer."""
+        server.register_metrics(self.metrics.render)
+
     # -- selection ---------------------------------------------------------
 
     def find_best_match(
@@ -186,6 +258,13 @@ class KvRouter:
         request_blocks = max(len(hashes), 1)
         worker = self.scheduler.select_worker(request_blocks, overlaps, candidates)
         overlap = overlaps.scores.get(worker, 0) if worker is not None else 0
+        if worker is None:
+            self.metrics.decisions.inc(reason="no_worker")
+        else:
+            self.metrics.decisions.inc(
+                reason="kv_overlap" if overlap > 0 else "load_only"
+            )
+            self.metrics.overlap_blocks.observe(overlap)
         if not self.use_kv_events and worker is not None:
             # Approximate mode: assume the chosen worker will cache these
             # blocks (ref: kv_router.rs:937 routing-decision recording).
@@ -216,9 +295,15 @@ class KvRouter:
                 # the dataclass itself).
                 pin = (getattr(request, "extra", None) or {}).get("_pinned_worker")
             if pin is not None and int(pin) in instances:
+                self.metrics.decisions.inc(reason="pinned")
+                lifecycle.record(
+                    _request_id_of(request), "routed",
+                    worker=int(pin), reason="pinned",
+                )
                 return int(pin)
             token_ids = _token_ids_of(request)
             if token_ids is None:
+                self.metrics.decisions.inc(reason="fallback")
                 return None  # not a preprocessed request; fall back
             candidates = [(iid, 0) for iid in instances]
             lora = (
@@ -246,6 +331,10 @@ class KvRouter:
                     request.estimated_prefix_hit_blocks = overlap
                 except AttributeError:
                     pass
+            lifecycle.record(
+                _request_id_of(request), "routed",
+                worker=worker[0], overlap_blocks=overlap,
+            )
             return worker[0]
 
         def on_done(instance_id: Optional[int], request: Any) -> None:
@@ -264,3 +353,10 @@ def _token_ids_of(request: Any) -> Optional[Sequence[int]]:
         ids = request.get("token_ids")
         return ids if isinstance(ids, (list, tuple)) else None
     return getattr(request, "token_ids", None)
+
+
+def _request_id_of(request: Any) -> Optional[str]:
+    if isinstance(request, dict):
+        rid = request.get("request_id")
+        return rid if isinstance(rid, str) else None
+    return getattr(request, "request_id", None)

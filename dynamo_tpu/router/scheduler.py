@@ -87,6 +87,16 @@ class KvScheduler:
     def workers(self) -> List[WorkerKey]:
         return sorted(self._workers)
 
+    def load_view(self) -> Dict[WorkerKey, Tuple[int, float]]:
+        """worker → (predicted decode blocks, kv usage) — the cost-model
+        inputs, sampled for the router's per-worker load gauges (the signal
+        the planner and FlowKV-style load-aware policies read)."""
+        ttl = self.config.inflight_ttl_s
+        return {
+            w: (state.decode_blocks(ttl), state.kv_usage())
+            for w, state in self._workers.items()
+        }
+
     # -- selection ---------------------------------------------------------
 
     def select_worker(

@@ -13,6 +13,13 @@ from dynamo_tpu.runtime.component import (
 from dynamo_tpu.runtime.context import Context, EngineStream, current_context
 from dynamo_tpu.runtime.distributed import DistributedRuntime, LocalRequestPlane
 from dynamo_tpu.runtime.engine import AsyncEngine, as_engine, collect
+from dynamo_tpu.runtime.metric_names import (
+    ALL_DISAGG,
+    ALL_ENGINE,
+    ALL_FRONTEND,
+    ALL_KVBM,
+    ALL_ROUTER,
+)
 from dynamo_tpu.runtime.pipeline import (
     MapRequestOperator,
     MapStreamOperator,
@@ -23,6 +30,11 @@ from dynamo_tpu.runtime.pipeline import (
 from dynamo_tpu.runtime.tasks import TaskTracker
 
 __all__ = [
+    "ALL_DISAGG",
+    "ALL_ENGINE",
+    "ALL_FRONTEND",
+    "ALL_KVBM",
+    "ALL_ROUTER",
     "AsyncEngine",
     "Client",
     "Component",

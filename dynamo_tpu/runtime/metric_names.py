@@ -42,6 +42,45 @@ ENGINE_PREFILL_TOKENS = engine_gauge("prefill_tokens")
 ENGINE_GENERATED_TOKENS = engine_gauge("generated_tokens")
 ENGINE_SLEEP_LEVEL = engine_gauge("sleep_level")
 
+# -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
+ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
+ENGINE_BATCH_OCCUPANCY = f"{ENGINE_PREFIX}_batch_occupancy"
+ENGINE_STEP_PREFILL_TOKENS = f"{ENGINE_PREFIX}_prefill_tokens_per_step"
+ENGINE_STEP_DECODE_TOKENS = f"{ENGINE_PREFIX}_decode_tokens_per_step"
+
+# -- router (router/router.py KvRouter + router/scheduler.py) ----------------
+ROUTER_PREFIX = "dynamo_tpu_router"
+ROUTER_DECISIONS_TOTAL = f"{ROUTER_PREFIX}_decisions_total"
+ROUTER_OVERLAP_BLOCKS = f"{ROUTER_PREFIX}_overlap_blocks"
+ROUTER_WORKER_LOAD_BLOCKS = f"{ROUTER_PREFIX}_worker_load_blocks"
+ROUTER_WORKER_KV_USAGE = f"{ROUTER_PREFIX}_worker_kv_usage"
+ROUTER_KV_EVENTS_TOTAL = f"{ROUTER_PREFIX}_kv_events_total"
+
+# -- KVBM (kvbm/manager.py TieredKvManager + kvbm/connector.py) --------------
+KVBM_PREFIX = "dynamo_tpu_kvbm"
+KVBM_OFFLOAD_BLOCKS_TOTAL = f"{KVBM_PREFIX}_offload_blocks_total"
+KVBM_OFFLOAD_BYTES_TOTAL = f"{KVBM_PREFIX}_offload_bytes_total"
+KVBM_ONBOARD_BLOCKS_TOTAL = f"{KVBM_PREFIX}_onboard_blocks_total"
+KVBM_ONBOARD_BYTES_TOTAL = f"{KVBM_PREFIX}_onboard_bytes_total"
+KVBM_LOOKUP_HITS_TOTAL = f"{KVBM_PREFIX}_lookup_hits_total"
+KVBM_LOOKUP_MISSES_TOTAL = f"{KVBM_PREFIX}_lookup_misses_total"
+KVBM_TIER_BLOCKS = f"{KVBM_PREFIX}_tier_blocks"
+KVBM_TIER_EVICTIONS_TOTAL = f"{KVBM_PREFIX}_tier_evictions_total"
+KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL = (
+    f"{KVBM_PREFIX}_pool_pressure_truncations_total"
+)
+KVBM_FAILED_LOADS_TOTAL = f"{KVBM_PREFIX}_failed_loads_total"
+
+# -- disagg (disagg/handlers.py DecodeHandler) -------------------------------
+DISAGG_PREFIX = "dynamo_tpu_disagg"
+DISAGG_TRANSFERS_TOTAL = f"{DISAGG_PREFIX}_transfers_total"
+# Each failure IS the 2×-cost path: the decode worker falls back to a
+# second full local prefill of the same prompt.
+DISAGG_TRANSFER_FAILURES_TOTAL = f"{DISAGG_PREFIX}_transfer_failures_total"
+DISAGG_BLOCKS_PULLED_TOTAL = f"{DISAGG_PREFIX}_blocks_pulled_total"
+DISAGG_BYTES_PULLED_TOTAL = f"{DISAGG_PREFIX}_bytes_pulled_total"
+DISAGG_TRANSFER_DURATION = f"{DISAGG_PREFIX}_transfer_duration_seconds"
+
 ALL_FRONTEND = (
     FRONTEND_REQUESTS_TOTAL,
     FRONTEND_INFLIGHT,
@@ -50,4 +89,50 @@ ALL_FRONTEND = (
     FRONTEND_ITL,
     FRONTEND_OUTPUT_TOKENS_TOTAL,
     FRONTEND_INPUT_TOKENS_TOTAL,
+)
+
+ALL_ROUTER = (
+    ROUTER_DECISIONS_TOTAL,
+    ROUTER_OVERLAP_BLOCKS,
+    ROUTER_WORKER_LOAD_BLOCKS,
+    ROUTER_WORKER_KV_USAGE,
+    ROUTER_KV_EVENTS_TOTAL,
+)
+
+ALL_KVBM = (
+    KVBM_OFFLOAD_BLOCKS_TOTAL,
+    KVBM_OFFLOAD_BYTES_TOTAL,
+    KVBM_ONBOARD_BLOCKS_TOTAL,
+    KVBM_ONBOARD_BYTES_TOTAL,
+    KVBM_LOOKUP_HITS_TOTAL,
+    KVBM_LOOKUP_MISSES_TOTAL,
+    KVBM_TIER_BLOCKS,
+    KVBM_TIER_EVICTIONS_TOTAL,
+    KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL,
+    KVBM_FAILED_LOADS_TOTAL,
+)
+
+ALL_DISAGG = (
+    DISAGG_TRANSFERS_TOTAL,
+    DISAGG_TRANSFER_FAILURES_TOTAL,
+    DISAGG_BLOCKS_PULLED_TOTAL,
+    DISAGG_BYTES_PULLED_TOTAL,
+    DISAGG_TRANSFER_DURATION,
+)
+
+ALL_ENGINE = (
+    ENGINE_ACTIVE_SEQS,
+    ENGINE_WAITING,
+    ENGINE_KV_USAGE,
+    ENGINE_FREE_BLOCKS,
+    ENGINE_CACHED_BLOCKS,
+    ENGINE_TOTAL_BLOCKS,
+    ENGINE_DECODE_STEPS,
+    ENGINE_PREFILL_TOKENS,
+    ENGINE_GENERATED_TOKENS,
+    ENGINE_SLEEP_LEVEL,
+    ENGINE_STEP_DURATION,
+    ENGINE_BATCH_OCCUPANCY,
+    ENGINE_STEP_PREFILL_TOKENS,
+    ENGINE_STEP_DECODE_TOKENS,
 )

@@ -10,14 +10,25 @@ process exposes a small HTTP surface for orchestration:
   POST /v1/loras           {"name": ..., "path": ...} load an adapter
   DELETE /v1/loras/{name}  unload an adapter
 
+Debug surface (serving-plane observability tentpole):
+  GET  /debug/requests       recent + slow request-timeline summaries
+  GET  /debug/requests/{id}  one ordered lifecycle timeline
+  GET  /debug/traces         the process tracer's finished-span ring
+
 This is the TPU build's analog of the reference's axum system server; the
 engine registers its callbacks via ``attach_engine`` (the reference's
 engine-routes registry, system_status_server.rs /engine/{*path} handler).
+
+``/metrics`` speaks OpenMetrics when the scraper asks for it (Accept:
+application/openmetrics-text): metrics sources whose render callable takes
+an ``openmetrics`` keyword (runtime/metrics_core.py registries) then emit
+trace-id exemplars, linking histogram spikes to /debug timelines.
 """
 
 from __future__ import annotations
 
 import asyncio
+import inspect
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from aiohttp import web
@@ -30,13 +41,81 @@ logger = get_logger(__name__)
 EngineRoute = Callable[[Dict[str, Any]], Awaitable[Tuple[int, Any]]]
 
 
+def _takes_openmetrics(fn: Callable[..., str]) -> bool:
+    """Does this metrics source accept an ``openmetrics`` keyword
+    (metrics_core registries do; plain text lambdas don't)?"""
+    try:
+        return "openmetrics" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def _merge_expositions(parts: List[str]) -> str:
+    """Concatenate metric sources, collapsing duplicate family metadata.
+
+    Two same-kind subsystem objects on one server (metrics_core's per-object
+    registries make this easy — e.g. two tiered managers both calling
+    ``register_metrics``) each emit their own ``# HELP``/``# TYPE`` block
+    for the same family, and Prometheus rejects an exposition whose
+    metadata repeats or interleaves. Group every source's samples under one
+    metadata block per family (first HELP/TYPE wins); sample lines pass
+    through verbatim. Identical series from two sources therefore stay
+    visible as duplicates (Prometheus flags them) instead of being
+    silently collapsed or summed — objects whose series would collide
+    should share one metrics instance instead.
+    """
+    order: List[str] = []
+    meta: Dict[str, List[str]] = {}
+    samples: Dict[str, List[str]] = {}
+
+    def block(name: str) -> None:
+        if name not in meta:
+            meta[name] = []
+            samples[name] = []
+            order.append(name)
+
+    for part in parts:
+        current = ""  # bare samples before any metadata keep source order
+        for line in part.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind, name = line.split(None, 3)[1:3]
+                block(name)
+                current = name
+                if not any(m.startswith(f"# {kind} ") for m in meta[name]):
+                    meta[name].append(line)
+            elif line.startswith("#"):
+                continue  # stray comments / EOF markers from a source
+            else:
+                block(current)
+                samples[current].append(line)
+    lines: List[str] = []
+    for name in order:
+        lines.extend(meta[name])
+        lines.extend(samples[name])
+    return "\n".join(lines)
+
+
 class SystemStatusServer:
-    def __init__(self, *, host: str = "0.0.0.0", port: int = 0) -> None:
+    def __init__(
+        self,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        lifecycle: Any = None,  # RequestLifecycle; None = process-global
+        tracer: Any = None,  # utils/tracing.Tracer; None = process-global
+    ) -> None:
         self.host = host
         self.port = port
+        self._lifecycle = lifecycle
+        self._tracer = tracer
         self._engine_routes: Dict[str, EngineRoute] = {}
         self._health_sources: Dict[str, Callable[[], Tuple[bool, Any]]] = {}
-        self._metrics_sources: List[Callable[[], str]] = []
+        # (render fn, takes-openmetrics-kwarg) — classified once at
+        # registration so the scrape path skips per-request reflection.
+        self._metrics_sources: List[Tuple[Callable[[], str], bool]] = []
         self._lora_list: Optional[Callable[[], List[str]]] = None
         self._lora_load: Optional[Callable[[str, str], Awaitable[None]]] = None
         self._lora_unload: Optional[Callable[[str], Awaitable[None]]] = None
@@ -54,7 +133,7 @@ class SystemStatusServer:
 
     def register_metrics(self, fn: Callable[[], str]) -> None:
         """fn returns Prometheus exposition-format text."""
-        self._metrics_sources.append(fn)
+        self._metrics_sources.append((fn, _takes_openmetrics(fn)))
 
     def register_loras(self, list_fn, load_fn, unload_fn) -> None:
         self._lora_list = list_fn
@@ -68,6 +147,9 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/requests/{id}", self._debug_request)
+        app.router.add_get("/debug/traces", self._debug_traces)
         app.router.add_route("*", "/engine/{path:.*}", self._engine)
         app.router.add_get("/v1/loras", self._loras_list)
         app.router.add_post("/v1/loras", self._loras_load)
@@ -109,17 +191,74 @@ class SystemStatusServer:
         return web.json_response({"status": "live"})
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        openmetrics = "application/openmetrics-text" in request.headers.get(
+            "Accept", ""
+        )
         parts = []
-        for fn in self._metrics_sources:
+        for fn, takes_om in self._metrics_sources:
             try:
-                parts.append(fn())
+                if openmetrics and takes_om:
+                    parts.append(fn(openmetrics=True))
+                else:
+                    parts.append(fn())
             except Exception:
                 logger.exception("metrics source failed")
+        text = _merge_expositions([p for p in parts if p])
+        if openmetrics:
+            return web.Response(
+                text=text + "\n# EOF\n",
+                content_type="application/openmetrics-text",
+                charset="utf-8",
+            )
         return web.Response(
-            text="\n".join(parts) + "\n",
+            text=text + "\n",
             content_type="text/plain",
             charset="utf-8",
         )
+
+    # -- debug surface (lifecycle timelines + trace ring) ------------------
+
+    def _lifecycle_obj(self):
+        if self._lifecycle is None:
+            from dynamo_tpu.runtime.lifecycle import global_lifecycle
+
+            self._lifecycle = global_lifecycle()
+        return self._lifecycle
+
+    def _tracer_obj(self):
+        if self._tracer is None:
+            from dynamo_tpu.utils.tracing import global_tracer
+
+            self._tracer = global_tracer()
+        return self._tracer
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        lc = self._lifecycle_obj()
+        return web.json_response(
+            {
+                "slow_threshold_s": lc.slow_threshold_s,
+                "requests": [tl.summary() for tl in lc.timelines()],
+                "slow": [tl.request_id for tl in lc.slow_timelines()],
+            }
+        )
+
+    async def _debug_request(self, request: web.Request) -> web.Response:
+        rid = request.match_info["id"]
+        tl = self._lifecycle_obj().get(rid)
+        if tl is None:
+            return web.json_response(
+                {"error": f"no timeline for request {rid!r}"}, status=404
+            )
+        return web.json_response(tl.to_dict())
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """Dump the span ring, optionally filtered: /debug/traces?trace_id=…
+        returns only that trace (the exemplar-chasing path)."""
+        want = request.query.get("trace_id")
+        spans = self._tracer_obj().finished_spans()
+        if want:
+            spans = [s for s in spans if s.trace_id == want]
+        return web.json_response({"spans": [s.to_dict() for s in spans]})
 
     async def _engine(self, request: web.Request) -> web.Response:
         path = request.match_info["path"].strip("/")
@@ -179,18 +318,29 @@ class SystemStatusServer:
 def engine_stats_prometheus(stats: Dict[str, Any]) -> str:
     """Engine stats dict → Prometheus gauges with canonical names
     (ref: metrics/prometheus_names.rs — runtime/metric_names.py is the
-    single place that defines them)."""
+    single place that defines them). Nested dict stats (the ``kvbm``
+    sub-dict) flatten into ``<prefix>_<key>_<subkey>`` gauges instead of
+    silently disappearing from the scrape."""
     from dynamo_tpu.runtime.metric_names import engine_gauge
 
-    lines = []
-    for key, value in stats.items():
-        if isinstance(value, dict):
-            continue  # nested (kvbm) stats get their own exporter if needed
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        name = engine_gauge(key)
+    lines: List[str] = []
+
+    def emit(name: str, value: float, source: str) -> None:
+        lines.append(f"# HELP {name} Engine stat {source!r} (engine.stats())")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {float(value)}")
+
+    def numeric(value: Any) -> bool:
+        return not isinstance(value, bool) and isinstance(value, (int, float))
+
+    for key, value in stats.items():
+        if isinstance(value, dict):
+            for sub, sv in value.items():
+                if numeric(sv):
+                    emit(engine_gauge(f"{key}_{sub}"), sv, f"{key}.{sub}")
+            continue
+        if numeric(value):
+            emit(engine_gauge(key), value, key)
     return "\n".join(lines)
 
 
@@ -249,6 +399,9 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
 
     server.register_health("engine", _engine_health)
     server.register_metrics(lambda: engine_stats_prometheus(engine.stats()))
+    step_metrics = getattr(engine, "step_metrics", None)
+    if step_metrics is not None:
+        step_metrics.register_metrics(server)
 
     async def _load(name: str, path: str) -> None:
         # Disk I/O + stacking + host→device transfer off the event loop —

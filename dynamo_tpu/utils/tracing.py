@@ -317,6 +317,11 @@ class Tracer:
             start_s=time.time(),
             attributes=dict(attributes),
         )
+        # Monotonic anchor for the duration: an NTP step between start and
+        # end must not produce negative (or inflated) span durations. The
+        # wall-clock start_s stays as the export timestamp; end_s is derived
+        # as start + monotonic elapsed so duration_ms is always honest.
+        start_mono = time.perf_counter()
         if context is not None:
             context.baggage["traceparent"] = TraceContext(
                 span.trace_id, span.span_id, parent.sampled
@@ -327,7 +332,7 @@ class Tracer:
             span.status = f"error: {type(exc).__name__}"
             raise
         finally:
-            span.end_s = time.time()
+            span.end_s = span.start_s + (time.perf_counter() - start_mono)
             self.export(span)
 
 

@@ -368,6 +368,12 @@ async def main() -> None:
 
         system_server = SystemStatusServer(port=args.system_port)
         attach_engine(system_server, engine)
+        if kvbm is not None:
+            kvbm.register_metrics(system_server)
+        if hasattr(handler, "register_metrics"):
+            # DecodeHandler exposes the disagg transfer families; the
+            # prefill handler has nothing to add.
+            handler.register_metrics(system_server)
         await system_server.start()
         print(f"system server on :{system_server.port}", flush=True)
     print(

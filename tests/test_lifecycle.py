@@ -1,0 +1,143 @@
+"""Per-request lifecycle timelines + the system server's /debug surface
+(ISSUE 1 tentpole part 2: received → … → done event timelines keyed by
+request id and trace id, slow-request capture ring, /debug endpoints)."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.lifecycle import RequestLifecycle
+from dynamo_tpu.runtime.system_server import SystemStatusServer
+from dynamo_tpu.utils.tracing import Tracer
+
+
+async def _get(port, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, await r.json()
+
+
+class TestRequestLifecycle:
+    def test_events_ordered_with_offsets(self):
+        lc = RequestLifecycle(max_recent=8, max_slow=2, slow_threshold_s=60.0)
+        lc.record("r1", "received", model="m")
+        lc.record("r1", "tokenized", n_tokens=7)
+        lc.record("r1", "routed", worker=3, overlap_blocks=2)
+        lc.record("r1", "done", status=200)
+        tl = lc.get("r1").to_dict()
+        assert [e["event"] for e in tl["events"]] == [
+            "received", "tokenized", "routed", "done",
+        ]
+        offsets = [e["offset_ms"] for e in tl["events"]]
+        assert offsets == sorted(offsets) and offsets[0] == 0.0
+        assert tl["events"][2]["attrs"] == {"worker": 3, "overlap_blocks": 2}
+        assert tl["done"] is True
+
+    def test_trace_id_adopted_from_context(self):
+        lc = RequestLifecycle(slow_threshold_s=60.0)
+        ctx = Context(baggage={})
+        tracer = Tracer(max_spans=8)
+        with tracer.span("frontend", ctx):
+            lc.record("r1", "received", context=ctx)
+        [span] = tracer.finished_spans()
+        assert lc.get("r1").trace_id == span.trace_id
+
+    def test_slow_ring_survives_recent_eviction(self):
+        lc = RequestLifecycle(max_recent=2, max_slow=4, slow_threshold_s=0.01)
+        lc.record("slow", "received")
+        time.sleep(0.02)
+        lc.record("slow", "done")
+        # fast requests churn the recent ring past "slow"
+        for i in range(5):
+            lc.record(f"fast{i}", "received")
+            lc.record(f"fast{i}", "done")
+        assert lc.get("fast0") is None  # evicted, was never slow
+        slow = lc.get("slow")  # retained by the slow ring
+        assert slow is not None and slow.duration_s >= 0.01
+        assert "slow" in {tl.request_id for tl in lc.slow_timelines()}
+
+    def test_inflight_timeline_survives_recent_churn(self):
+        """Eviction prefers finished timelines: a long-tail request still
+        in flight while > max_recent others complete must keep its events,
+        or its eventual "done" could never qualify it for the slow ring."""
+        lc = RequestLifecycle(max_recent=2, max_slow=4, slow_threshold_s=0.01)
+        lc.record("tail", "received")
+        lc.record("tail", "routed", worker=1)
+        for i in range(8):  # finished requests churn past capacity
+            lc.record(f"fast{i}", "received")
+            lc.record(f"fast{i}", "done")
+        time.sleep(0.02)
+        lc.record("tail", "done")
+        tail = lc.get("tail")
+        assert tail is not None
+        assert [e.name for e in tail.events] == ["received", "routed", "done"]
+        assert "tail" in {tl.request_id for tl in lc.slow_timelines()}
+        # boundedness still wins when every entry is in flight
+        lc2 = RequestLifecycle(max_recent=2, max_slow=2, slow_threshold_s=60.0)
+        for i in range(5):
+            lc2.record(f"open{i}", "received")
+        assert len(lc2.timelines()) == 2
+
+    def test_slow_ring_is_bounded(self):
+        lc = RequestLifecycle(max_recent=1, max_slow=2, slow_threshold_s=0.0)
+        for i in range(4):
+            lc.record(f"r{i}", "received")
+            lc.record(f"r{i}", "done")
+        assert [tl.request_id for tl in lc.slow_timelines()] == ["r2", "r3"]
+
+    def test_record_never_raises(self):
+        lc = RequestLifecycle()
+        lc.record(None, "received")  # no request id: dropped
+        lc.record("r", "x", context=object())  # baggage-free context: fine
+        assert lc.get("r") is not None
+
+
+async def test_debug_endpoints_timeline_matches_trace():
+    """GET /debug/requests/{id} returns an ordered timeline whose trace id
+    matches a span in GET /debug/traces (acceptance criterion)."""
+    lc = RequestLifecycle(max_recent=4, max_slow=2, slow_threshold_s=60.0)
+    tracer = Tracer(max_spans=16)
+    server = SystemStatusServer(
+        host="127.0.0.1", port=0, lifecycle=lc, tracer=tracer
+    )
+    await server.start()
+    try:
+        ctx = Context(baggage={})
+        with tracer.span("http.chat_completions", ctx, model="m"):
+            lc.record("req-1", "received", context=ctx)
+            with tracer.span("router.pick", ctx):
+                lc.record("req-1", "routed", context=ctx, worker=0)
+            lc.record("req-1", "done", context=ctx, status=200)
+
+        status, body = await _get(server.port, "/debug/requests")
+        assert status == 200
+        assert "req-1" in [r["request_id"] for r in body["requests"]]
+
+        status, tl = await _get(server.port, "/debug/requests/req-1")
+        assert status == 200
+        assert [e["event"] for e in tl["events"]] == [
+            "received", "routed", "done",
+        ]
+        assert tl["trace_id"]
+
+        status, traces = await _get(server.port, "/debug/traces")
+        assert status == 200
+        trace_ids = {s["trace_id"] for s in traces["spans"]}
+        assert tl["trace_id"] in trace_ids
+
+        # the exemplar-chasing filter returns only that trace's spans
+        status, filtered = await _get(
+            server.port, f"/debug/traces?trace_id={tl['trace_id']}"
+        )
+        assert {s["trace_id"] for s in filtered["spans"]} == {tl["trace_id"]}
+        assert {s["name"] for s in filtered["spans"]} == {
+            "http.chat_completions", "router.pick",
+        }
+
+        status, _ = await _get(server.port, "/debug/requests/nope")
+        assert status == 404
+    finally:
+        await server.stop()

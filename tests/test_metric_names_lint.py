@@ -1,0 +1,71 @@
+"""Static lint: every emitted Prometheus name comes from
+runtime/metric_names.py (ref: metrics/prometheus_names.rs rationale —
+dashboards, the planner's scrape source, and emitters must never drift).
+
+Any ``dynamo_tpu_*`` string literal outside metric_names.py is an emitter
+bypassing the canonical constants and fails this test.
+"""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(__file__), "..", "dynamo_tpu")
+
+# String literals that LOOK like metric names ('dynamo_tpu_' + snake tail).
+LITERAL_RE = re.compile(r"""["']dynamo_tpu_[a-z0-9_]*["']""")
+
+# The single place allowed to define dynamo_tpu_* literals.
+DEFINING_FILE = os.path.join("runtime", "metric_names.py")
+
+# Non-metric literals that legitimately share the prefix.
+ALLOWED_LITERALS = {
+    '"dynamo_tpu_context"',  # runtime/context.py ContextVar name
+}
+
+
+def _py_files():
+    for root, _dirs, files in os.walk(PKG):
+        for fname in files:
+            if fname.endswith(".py"):
+                yield os.path.join(root, fname)
+
+
+def test_no_metric_name_literals_outside_metric_names():
+    violations = []
+    for path in _py_files():
+        rel = os.path.relpath(path, PKG)
+        if rel == DEFINING_FILE:
+            continue
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in LITERAL_RE.findall(line):
+                    if m.replace("'", '"') in ALLOWED_LITERALS:
+                        continue
+                    violations.append(f"{rel}:{lineno}: {m}")
+    assert not violations, (
+        "string-literal metric names outside runtime/metric_names.py "
+        "(import the constant instead):\n" + "\n".join(violations)
+    )
+
+
+def test_all_family_tuples_are_canonical_and_exported():
+    """The ALL_* tuples exist, are importable from dynamo_tpu.runtime, and
+    contain only names defined in metric_names.py."""
+    from dynamo_tpu import runtime as rt
+    from dynamo_tpu.runtime import metric_names as mn
+
+    defined = {
+        v for v in vars(mn).values()
+        if isinstance(v, str) and v.startswith("dynamo_tpu_")
+    }
+    for family in ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_DISAGG",
+                   "ALL_ENGINE"):
+        tup = getattr(rt, family)
+        assert tup and isinstance(tup, tuple)
+        for name in tup:
+            assert name in defined, f"{family} contains undefined {name}"
+    # families don't collide
+    all_names = [n for f in ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM",
+                             "ALL_DISAGG", "ALL_ENGINE")
+                 for n in getattr(rt, f)]
+    assert len(all_names) == len(set(all_names))

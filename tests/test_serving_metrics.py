@@ -1,0 +1,198 @@
+"""Serving-plane observability e2e (ISSUE 1 acceptance): one system server
+scraping a real serving run exposes at least one non-zero sample from each
+of the four new subsystem families — router, KVBM, disagg, engine-step —
+with every name sourced from runtime/metric_names.py."""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.disagg import DecodeHandler, KvTransferHandler, PrefillHandler
+from dynamo_tpu.kvbm import HostTier, TieredKvManager
+from dynamo_tpu.planner.metrics_source import parse_prometheus_text
+from dynamo_tpu.router.router import KvRouter
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime import metric_names as mn
+from dynamo_tpu.runtime.system_server import SystemStatusServer, attach_engine
+
+from tests.test_jax_engine import make_engine, req
+
+
+class _DirectKvClient:
+    """Request-plane stand-in: routes pulls straight at a KvTransferHandler
+    (the wire protocol is identical; no runtime needed for a metrics test)."""
+
+    def __init__(self, handler):
+        self._h = handler
+
+    async def direct(self, payload, worker_id):
+        async for reply in self._h.generate(payload, Context()):
+            yield reply
+
+
+def _nonzero(sample, name):
+    """True when the family member has any sample > 0 (histograms expose
+    name_bucket/_sum/_count series)."""
+    for (n, _labels), v in sample.items():
+        if (n == name or n.startswith(name + "_")) and v > 0:
+            return True
+    return False
+
+
+async def test_metrics_expose_all_four_subsystem_families():
+    prefill_engine, _ = make_engine()
+    decode_engine, _ = make_engine()
+    kvbm = TieredKvManager(HostTier(64))
+    kvbm.attach(prefill_engine)
+
+    prefill_handler = PrefillHandler(prefill_engine, worker_id=1)
+    kv_handler = KvTransferHandler(prefill_engine)
+
+    async def kv_client():
+        return _DirectKvClient(kv_handler)
+
+    decode_handler = DecodeHandler(decode_engine, kv_client_factory=kv_client)
+
+    router = KvRouter(DistributedRuntime.detached(), "t", "c", block_size=4)
+    router.scheduler.add_worker((1, 0))
+
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, decode_engine)
+    kvbm.register_metrics(server)
+    router.register_metrics(server)
+    decode_handler.register_metrics(server)
+    await server.start()
+    try:
+        prompt = list(range(100, 116))  # 4 full blocks at block_size=4
+
+        # router family: a routing decision over the live scheduler state
+        worker, _overlap = router.find_best_match(prompt)
+        assert worker == (1, 0)
+
+        # disagg + engine-step: prefill worker computes KV, decode worker
+        # pulls it over the (stand-in) wire, then decodes
+        pre_out = [
+            o async for o in prefill_handler.generate(
+                req(prompt, max_tokens=4), Context()
+            )
+        ]
+        dp = pre_out[-1].disaggregated_params
+        assert dp is not None and dp.kv_transfer["block_hashes"]
+        decode_req = req(prompt, max_tokens=4)
+        decode_req.disaggregated_params = dp
+        out = [
+            o async for o in decode_handler.generate(decode_req, Context())
+        ]
+        assert any(o.token_ids for o in out)
+        assert decode_handler.blocks_pulled > 0
+
+        # kvbm family: the prefill engine's committed blocks offload
+        await asyncio.sleep(0.3)
+        assert kvbm.offloaded > 0
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        sample = parse_prometheus_text(text)
+
+        assert _nonzero(sample, mn.ROUTER_DECISIONS_TOTAL)
+        assert _nonzero(sample, mn.ROUTER_WORKER_LOAD_BLOCKS) or (
+            # a zero-load worker still exports its gauge series
+            (mn.ROUTER_WORKER_LOAD_BLOCKS, (("worker", "1:0"),)) in sample
+        )
+        assert _nonzero(sample, mn.KVBM_OFFLOAD_BLOCKS_TOTAL)
+        assert _nonzero(sample, mn.KVBM_OFFLOAD_BYTES_TOTAL)
+        assert _nonzero(sample, mn.DISAGG_TRANSFERS_TOTAL)
+        assert _nonzero(sample, mn.DISAGG_BLOCKS_PULLED_TOTAL)
+        assert _nonzero(sample, mn.DISAGG_TRANSFER_DURATION)
+        assert _nonzero(sample, mn.ENGINE_STEP_DURATION)
+        assert _nonzero(sample, mn.ENGINE_BATCH_OCCUPANCY)
+        assert _nonzero(sample, mn.ENGINE_STEP_PREFILL_TOKENS)
+        assert _nonzero(sample, mn.ENGINE_STEP_DECODE_TOKENS)
+
+        # every exposed dynamo_tpu_router/kvbm/disagg series name is
+        # resolvable to a canonical constant (acceptance criterion)
+        canonical = set(mn.ALL_ROUTER) | set(mn.ALL_KVBM) | set(mn.ALL_DISAGG)
+        for (n, _labels) in sample:
+            for prefix in (mn.ROUTER_PREFIX, mn.KVBM_PREFIX, mn.DISAGG_PREFIX):
+                if n.startswith(prefix + "_"):
+                    base = n
+                    for suffix in ("_bucket", "_sum", "_count"):
+                        if base.endswith(suffix):
+                            base = base[: -len(suffix)]
+                    assert base in canonical, f"non-canonical series {n}"
+    finally:
+        await server.stop()
+        await kvbm.close()
+        await prefill_engine.stop()
+        await decode_engine.stop()
+
+
+async def test_router_load_gauges_track_and_forget_workers():
+    """Per-worker load gauges sample the scheduler at scrape time and drop
+    series for departed workers (no frozen ghosts on dashboards)."""
+    router = KvRouter(DistributedRuntime.detached(), "t", "c", block_size=4)
+    router.scheduler.add_worker((1, 0))
+    router.scheduler.add_worker((2, 0))
+    text = router.metrics.render()
+    assert 'worker="1:0"' in text and 'worker="2:0"' in text
+    router.remove_worker((2, 0))
+    text = router.metrics.render()
+    assert 'worker="1:0"' in text and 'worker="2:0"' not in text
+
+
+def test_frontend_exemplars_and_lifecycle_stamps():
+    """TTFT/request-duration histograms carry the request's trace id as an
+    OpenMetrics exemplar, and the timer stamps received/first_token/done
+    onto the request's /debug timeline (tentpole part 3)."""
+    from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
+    from dynamo_tpu.runtime.lifecycle import global_lifecycle
+    from dynamo_tpu.utils.tracing import Tracer
+
+    lc = global_lifecycle()
+    lc.clear()
+    metrics = FrontendMetrics()
+    timer = RequestTimer(metrics, "m", "chat_completions")
+    ctx = Context(baggage={})
+    tracer = Tracer(max_spans=4)
+    with tracer.span("http.chat_completions", ctx):
+        timer.bind_context(ctx)
+        timer.on_token()
+        timer.on_token()
+        timer.done(200)
+    [span] = tracer.finished_spans()
+
+    om = metrics.render(openmetrics=True).decode()
+    assert f'trace_id="{span.trace_id}"' in om
+    plain = metrics.render().decode()
+    assert "trace_id" not in plain  # exemplars are openmetrics-only
+
+    tl = lc.get(ctx.id)
+    assert tl is not None and tl.trace_id == span.trace_id
+    events = [e.name for e in tl.events]
+    assert events == ["received", "first_token", "done"]
+    assert tl.done
+    lc.clear()
+
+
+def test_counter_openmetrics_family_drops_total_suffix():
+    """OpenMetrics keys counter metadata on the family name and requires the
+    _total suffix on samples; classic text format keys metadata on the
+    sample name. Strict scrapers reject a # TYPE line carrying _total."""
+    from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter(mn.DISAGG_TRANSFERS_TOTAL, "transfers", ["mode"])
+    c.inc(mode="remote")
+
+    family = mn.DISAGG_TRANSFERS_TOTAL[: -len("_total")]
+    om = reg.render(openmetrics=True)
+    assert f"# TYPE {family} counter" in om
+    assert f"# HELP {family} transfers" in om
+    assert f"# TYPE {mn.DISAGG_TRANSFERS_TOTAL} counter" not in om
+    assert f'{mn.DISAGG_TRANSFERS_TOTAL}{{mode="remote"}} 1' in om
+
+    plain = reg.render()
+    assert f"# TYPE {mn.DISAGG_TRANSFERS_TOTAL} counter" in plain
+    assert f'{mn.DISAGG_TRANSFERS_TOTAL}{{mode="remote"}} 1' in plain

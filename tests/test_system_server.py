@@ -187,8 +187,112 @@ async def test_runtime_lora_load_unload(tmp_path):
 
 def test_stats_prometheus_format():
     text = engine_stats_prometheus(
-        {"kv_usage": 0.5, "active_seqs": 3, "kvbm": {"nested": 1}, "name": "x"}
+        {
+            "kv_usage": 0.5,
+            "active_seqs": 3,
+            "kvbm": {"offloaded": 7, "host": {"hits": 1}, "label": "x"},
+            "name": "x",
+        }
     )
     assert "# TYPE dynamo_tpu_engine_kv_usage gauge" in text
+    assert "# HELP dynamo_tpu_engine_kv_usage" in text
     assert "dynamo_tpu_engine_active_seqs 3.0" in text
-    assert "nested" not in text and "name" not in text
+    # nested kvbm stats flatten into dynamo_tpu_engine_kvbm_* gauges
+    # instead of being silently dropped (ISSUE 1 satellite)
+    assert "dynamo_tpu_engine_kvbm_offloaded 7.0" in text
+    # ...but only one level deep, and never non-numeric values
+    assert "hits" not in text and "x" not in text and "name" not in text
+
+
+async def test_metrics_concatenates_sources_and_survives_failure():
+    """/metrics joins every register_metrics source; one source throwing
+    must not take out the others (ISSUE 1 satellite)."""
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    server.register_metrics(lambda: "# TYPE a counter\na_total 1")
+
+    def broken():
+        raise RuntimeError("boom")
+
+    server.register_metrics(broken)
+    server.register_metrics(lambda: "# TYPE b gauge\nb 2")
+    await server.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        assert "a_total 1" in text and "b 2" in text
+    finally:
+        await server.stop()
+
+
+async def test_metrics_openmetrics_negotiation_renders_exemplars():
+    """An Accept: application/openmetrics-text scrape switches
+    metrics_core sources into OpenMetrics mode (trace-id exemplars on
+    histogram buckets); plain sources still render."""
+    from dynamo_tpu.runtime import metric_names as mn
+    from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hist = reg.histogram(mn.DISAGG_TRANSFER_DURATION, "transfer time")
+    hist.observe(0.02, trace_id="ab" * 16)
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    server.register_metrics(reg.render)
+    server.register_metrics(lambda: "plain_gauge 7")
+    await server.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                plain = await r.text()
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            ) as r:
+                om = await r.text()
+                assert "openmetrics-text" in r.content_type
+        assert "trace_id" not in plain and "plain_gauge 7" in plain
+        assert f'# {{trace_id="{"ab" * 16}"}}' in om
+        assert "plain_gauge 7" in om
+        assert om.rstrip().endswith("# EOF")
+    finally:
+        await server.stop()
+
+
+async def test_metrics_merges_duplicate_families_across_sources():
+    """Two same-kind subsystem objects (each a private metrics_core
+    registry) registered on one server must not emit duplicate # HELP/
+    # TYPE blocks for the shared family — Prometheus rejects repeated or
+    interleaved metadata. Samples from both land under one block."""
+    from dynamo_tpu.runtime import metric_names as mn
+    from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+    regs = []
+    for worker in ("w0", "w1"):
+        reg = MetricsRegistry()
+        c = reg.counter(mn.ROUTER_DECISIONS_TOTAL, "decisions", ["worker"])
+        c.inc(worker=worker)
+        regs.append(reg)
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    for reg in regs:
+        server.register_metrics(reg.render)
+    await server.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                text = await r.text()
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            ) as r:
+                om = await r.text()
+    finally:
+        await server.stop()
+    family = mn.ROUTER_DECISIONS_TOTAL[: -len("_total")]
+    for body, name in ((text, mn.ROUTER_DECISIONS_TOTAL), (om, family)):
+        assert body.count(f"# TYPE {name} counter") == 1
+        assert body.count(f"# HELP {name} ") == 1
+        assert f'{mn.ROUTER_DECISIONS_TOTAL}{{worker="w0"}} 1' in body
+        assert f'{mn.ROUTER_DECISIONS_TOTAL}{{worker="w1"}} 1' in body
+    # metadata must not interleave: both samples follow the single block
+    lines = [l for l in text.splitlines() if mn.ROUTER_DECISIONS_TOTAL in l]
+    assert [l.startswith("#") for l in lines] == [True, True, False, False]

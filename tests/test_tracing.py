@@ -233,3 +233,89 @@ async def test_otlp_exporter_ships_spans_to_collector():
     finally:
         await engine.stop()
         await runner.cleanup()
+
+
+class TestOtlpExporterEdges:
+    """ISSUE 1 satellite: batch-edge wakeup, bounded-queue eviction
+    accounting, and failure isolation of the OTLP exporter."""
+
+    @staticmethod
+    def _span(name="s"):
+        from dynamo_tpu.utils.tracing import Span, new_trace_context
+
+        tc = new_trace_context()
+        return Span(
+            name=name, trace_id=tc.trace_id, span_id=tc.span_id,
+            parent_span_id=None, start_s=1.0, end_s=2.0,
+        )
+
+    def test_batch_edge_wakes_exporter_before_interval(self):
+        """Hitting max_batch queued spans must wake the flush thread
+        immediately — not after the (here: absurdly long) flush interval."""
+        import threading
+
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/nope", flush_interval_s=3600.0, max_batch=3,
+        )
+        posted = threading.Event()
+        batches = []
+
+        def fake_post(batch):
+            batches.append(list(batch))
+            posted.set()
+
+        exporter._post = fake_post
+        try:
+            exporter.offer(self._span("a"))
+            exporter.offer(self._span("b"))
+            assert not posted.wait(0.2), "woke before the batch edge"
+            exporter.offer(self._span("c"))  # edge: len(queue) == max_batch
+            assert posted.wait(2.0), "batch edge did not wake the exporter"
+            assert [s.name for s in batches[0]] == ["a", "b", "c"]
+        finally:
+            exporter._stop.set()
+            exporter._wake.set()
+            exporter._thread.join(timeout=2.0)
+
+    def test_full_queue_evicts_oldest_and_counts_dropped(self):
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/nope", flush_interval_s=3600.0,
+            max_batch=100, max_queue=3,
+        )
+        try:
+            for name in ("a", "b", "c", "d", "e"):
+                exporter.offer(self._span(name))
+            assert exporter.dropped == 2  # a and b evicted by deque maxlen
+            with exporter._lock:
+                assert [s.name for s in exporter._queue] == ["c", "d", "e"]
+        finally:
+            exporter._stop.set()
+            exporter._wake.set()
+            exporter._thread.join(timeout=2.0)
+
+    def test_failing_collector_never_raises_into_producers(self):
+        """offer() and flush_once() against a dead endpoint must swallow the
+        failure (dropping the batch) — telemetry can't take down serving."""
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter, Tracer
+
+        # port 9 (discard) is closed: connections fail fast
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/v1/traces", flush_interval_s=3600.0,
+        )
+        tracer = Tracer(max_spans=8, otlp=exporter)
+        try:
+            with tracer.span("produced-while-collector-down"):
+                pass  # export → offer: must not raise
+            exporter.flush_once()  # ships → fails → drops, no raise
+            assert exporter.sent == 0
+            assert exporter.dropped == 1
+            with exporter._lock:
+                assert not exporter._queue  # failed batch not re-queued
+        finally:
+            exporter._stop.set()
+            exporter._wake.set()
+            exporter._thread.join(timeout=2.0)
