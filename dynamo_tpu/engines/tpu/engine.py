@@ -148,6 +148,17 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def table_width_bucket(max_blocks: int, cap: int) -> int:
+    """Pow2 bucket for a dispatched block-table width, clamped to the
+    engine's per-sequence table capacity. Every distinct width is a
+    separate compiled decode program — the megakernel's dynamic page loop
+    makes the TRACE width-independent, but XLA still specializes on the
+    operand shape — so bucketing bounds the program count to ~log2(cap)
+    as contexts grow instead of one program per context length. Shared by
+    the decode tick and the speculative-verify dispatch (spec.py)."""
+    return min(_next_pow2(max(max_blocks, 1)), cap)
+
+
 @dataclass
 class _ProcPrep:
     """Per-request logits-processor parameters (ops/logits_process.py).
@@ -764,7 +775,7 @@ class JaxEngine:
                 max_blocks,
                 (int(self._pos[seq.slot]) + K - 1) // args.block_size + 1,
             )
-        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
+        nb_bucket = table_width_bucket(max_blocks, args.max_blocks_per_seq)
 
         want_logprobs = any(
             s.request.sampling.logprobs is not None for s in active

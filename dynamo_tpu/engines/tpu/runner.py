@@ -93,6 +93,43 @@ def _gather_blocks(cache, idx):
     return cache[:, idx]
 
 
+def _is_kernel_compile_error(exc: BaseException) -> bool:
+    """Is this exception a kernel COMPILE/LOWERING failure (Mosaic
+    rejection, VMEM/window limits, XLA compile errors) rather than a
+    transient device/runtime error? The megakernel's fallback demotes only
+    on these: a deterministic lowering failure will recur on every
+    dispatch, while a transient error (device halt, tunnel hiccup,
+    preempted RPC) would wrongly demote the engine to the ~1/3-roofline
+    XLA decode path for the rest of its life."""
+    msg = str(exc)
+    low = msg.lower()
+    if "mosaic" in low or "vmem" in low or "lowering" in low:
+        return True
+    names = {t.__name__ for t in type(exc).__mro__}
+    if names & {
+        "LoweringError",  # pallas/mosaic lowering rejections
+        "MosaicError",
+        "VerificationError",
+    }:
+        return True
+    if "NotImplementedError" in names:
+        # Mosaic "unsupported op" rejections — but only when the message
+        # looks like one: an unrelated host-side NotImplementedError
+        # (feature guard, library stub) must not demote the kernel.
+        return (
+            "unsupported" in low or "primitive" in low or "pallas" in low
+        )
+    if "XlaRuntimeError" in names:
+        # jaxlib's catch-all execution error. Compile rejections carry
+        # INTERNAL / UNIMPLEMENTED / RESOURCE_EXHAUSTED statuses; the
+        # transport/device transients below must PROPAGATE, not demote.
+        transient = (
+            "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+        )
+        return not any(t in msg for t in transient)
+    return False
+
+
 def _adapter_to_host(adapter):
     """Keep retained adapters as host numpy: only the STACKED arrays belong
     in HBM — retaining per-adapter device copies for restacking would
@@ -264,6 +301,19 @@ class DeviceRunner:
             (False, False, False): self._step_fn
         }
         self.proc_state: Optional[Any] = None  # logits_process.ProcState
+        # (table width, want_logprobs, uses_procs) combinations at which a
+        # megakernel decode has succeeded. Each pow2 width bucket AND each
+        # program variant compiles separately (a wider SMEM table — or the
+        # first logprobs/processor request — can newly trip a lowering
+        # limit long after the base program is serving fine), so the
+        # compile-failure fallback stays armed per combination: a
+        # compile-shaped error at an UNPROVEN one demotes; any error at a
+        # proven one propagates (it cannot be a compile rejection — that
+        # exact program already compiled and ran). Demotion is engine-wide
+        # on purpose: routing per-width through two compiled program
+        # families isn't worth the machinery — the XLA path keeps serving
+        # and the demotion is logged loudly.
+        self._mk_proven_keys: set = set()
         self._spec_fn: Optional[Any] = None  # speculative verify program
         self.sleep_level = 0
         self.host_params: Optional[Any] = None
@@ -670,21 +720,39 @@ class DeviceRunner:
         Returns ([B, K] tokens, [B, K] logprobs, top_vals | None,
         top_ids | None) as numpy."""
         if self.use_megakernel:
-            # One-shot safety net: the fused-layer kernel compiles lazily
-            # at the first decode dispatch — if Mosaic rejects it on this
-            # jaxlib/chip (or the shape trips a VMEM limit), demote to the
-            # XLA decode path instead of poisoning serving. Single-process
-            # only by construction (megakernel requires mesh is None), so
-            # no SPMD follower can diverge.
+            # Compile-failure safety net: each table-width bucket's
+            # megakernel program compiles lazily at its first dispatch —
+            # if Mosaic rejects it on this jaxlib/chip (or the shape trips
+            # a VMEM/SMEM limit), demote to the XLA decode path instead of
+            # poisoning serving. Single-process only by construction
+            # (megakernel requires mesh is None), so no SPMD follower can
+            # diverge. NARROW by design: only compile/lowering-shaped
+            # errors, and only at (width, program-variant) combinations
+            # that have never succeeded — a transient device error during
+            # steady-state serving propagates to the engine loop instead
+            # of permanently demoting the fast path (ADVICE r5).
+            key = (
+                int(np.asarray(block_tables).shape[1]),
+                bool(want_logprobs),
+                procs is not None,
+            )
             try:
-                return self._run_decode_inner(
+                out = self._run_decode_inner(
                     tokens, start_pos, active, block_tables, temp, topk,
                     topp, adapter_ids, want_logprobs, procs,
                 )
-            except Exception:
+                self._mk_proven_keys.add(key)
+                return out
+            except Exception as exc:
+                if (
+                    key in self._mk_proven_keys
+                    or not _is_kernel_compile_error(exc)
+                ):
+                    raise
                 logger.exception(
-                    "megakernel decode failed — falling back to the XLA "
-                    "decode path for this engine"
+                    "megakernel decode failed to compile/lower at table "
+                    "width %d (logprobs=%s, procs=%s) — falling back to "
+                    "the XLA decode path for this engine", *key,
                 )
                 self.use_megakernel = False
                 self._decode_fn = self._build_decode_fn(want_logprobs=False)

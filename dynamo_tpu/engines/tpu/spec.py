@@ -23,12 +23,10 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+# Shared with the decode tick so spec-verify dispatches reuse the same
+# pow2 table-width buckets (and their compiled programs' shapes). No
+# cycle: engine.py imports this module lazily (the _spec property).
+from dynamo_tpu.engines.tpu.engine import table_width_bucket
 
 
 class NgramSpecDecoder:
@@ -110,7 +108,7 @@ class NgramSpecDecoder:
                 max_blocks,
                 (int(e._pos[slot]) + C - 1) // args.block_size + 1,
             )
-        nb_bucket = min(_next_pow2(max_blocks), args.max_blocks_per_seq)
+        nb_bucket = table_width_bucket(max_blocks, args.max_blocks_per_seq)
 
         emitted_all, counts = await e._device(
             e._run_spec,

@@ -469,14 +469,12 @@ def forward_paged(
         # HLO grows ~L× but is traced once; compile stays cached.
         win_list = c.layer_windows()
         layered_params = isinstance(params["layers"], (tuple, list))
-        from dynamo_tpu.ops.pallas.fused_layer import MAX_TABLE_PAGES
 
         if (
             use_megakernel
             and C == 1
             and layered_params
             and not lora
-            and block_tables.shape[1] <= MAX_TABLE_PAGES
         ):
             # Fused-layer decode megakernel (ops/pallas/fused_layer.py):
             # one pallas program per layer; the current token's K/V come

@@ -8,6 +8,18 @@ stream through VMEM tiles with manual double-buffered DMAs; KV pages stream
 in per-(wave, page) steps whose first DMAs are issued during the qkv weight
 stream, so page-issue latency hides under matmul compute.
 
+History pages are driven by a DYNAMIC page loop (r6): the per-row block
+tables and page counts live in SMEM (scalar-prefetch operands, available
+before the body runs), each batch wave runs a ``fori_loop`` bounded by the
+wave's maximum page count, and every DMA/compute step is gated per row on
+its own scalar-prefetched count. Trace/compile size is therefore
+independent of the table width — long contexts (4k+ tokens) compile the
+same program as short ones — and short rows in a long-context batch skip
+their dead pages entirely (no stream, no mask) instead of streaming-then-
+masking up to the table capacity. Table widths are pow2-bucketed by the
+engine (engines/tpu/engine.py::table_width_bucket), so XLA holds a handful
+of programs per shape, one per bucket.
+
 Why this exists (r5): the per-layer XLA decode structure leaves the chip at
 ~1/3 of its HBM roofline at the 8B shape — a device trace showed ~490
 fusions + ~390 copies per step of inter-op glue, a DMA-issue-bound
@@ -21,11 +33,16 @@ Reference parity: plays the role of the fused decode kernels inside the
 engines the reference orchestrates (vLLM/TRT-LLM fused attention+GEMM
 paths); the reference repo itself carries no TPU equivalent.
 
-Scope (v1): C=1 decode, dense FFN, no sliding window, no logit cap, no
+Scope (v2): C=1 decode, dense FFN, no sliding window, no logit cap, no
 qkv-bias, no qk-norm, no post-norms, no LoRA delta, int8 weights
-({"q8","s"} per ops/quant.py), bf16 KV pools. The XLA path
-(models/llama.py::decoder_layer) remains the fallback for every other
-configuration and stays the numerics oracle.
+({"q8","s"} per ops/quant.py), bf16 KV pools. Context length is NOT a
+scope limit any more: the dynamic page loop serves any table width the
+engine's block tables can describe (the former ``MAX_TABLE_PAGES = 16``
+static-unroll ceiling — 256 tokens at block_size 16 — is gone). The XLA
+path (models/llama.py::decoder_layer) remains the fallback for every
+other configuration and stays the numerics oracle; parity is asserted in
+interpret mode at 256/1k/4k-token contexts and ragged short+long batches
+(tests/test_fused_layer.py, tests/test_zlongctx_fused.py).
 """
 
 from __future__ import annotations
@@ -39,13 +56,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-
-
-# Table widths past this fall back to the XLA path: the kernel statically
-# unrolls (B/BQ)·P page-steps, so trace/compile size scales with the table
-# width (and padded pages are streamed then masked — see att_step's
-# per-row page gate for the within-bound skipping).
-MAX_TABLE_PAGES = 16
 
 
 def _tiles_for(d: int, HD: int, KHD: int, F: int):
@@ -85,9 +95,11 @@ def supports(config, *, lora: bool, quantized_weights: bool) -> bool:
 
 
 def _fused_layer_kernel(
-    # SMEM operands
+    # SMEM operands (scalar-prefetch: available before the body runs, so
+    # they drive every page DMA's index and the dynamic loop bounds)
     tables_ref,  # [B, P] int32
     start_ref,  # [B] int32
+    pcount_ref,  # [B] int32 — history pages per row: ceil(start / BS)
     # VMEM operands
     x_ref,  # [B, d] bf16 residual stream
     cos_ref,  # [B, D] f32 rope table at each row's position
@@ -139,7 +151,6 @@ def _fused_layer_kernel(
     NOT_ = d // TO
     NFT = F // TF
     NW = B // BQ  # attention waves
-    NPS = NW * P  # attention page-steps
     half = D // 2
 
     def qkv_src(t):
@@ -169,45 +180,48 @@ def _fused_layer_kernel(
             return v * cos_ref[...] + rot * sin_ref[...]
 
         # ---- phases 1+2 share the page-staging scratch: qkv streaming
-        # issues the first page DMAs so their latency hides under matmuls ----
+        # issues wave 0's first page DMAs so their latency hides under
+        # matmuls ----
         def qkv_and_attention(q4_ref, fl_m, fl_l, fl_acc, pages, psem):
-            # THREE page-step slots: step s+2 is issued while step s is being
-            # consumed, and lands in the slot that held step s-1 (already
-            # consumed) — an issued DMA never targets a buffer with pending
-            # reads, so no DMA/vector ordering assumption is needed.
-            def page_dma(slot, step, j, which):
+            # THREE page-step slots: page pp+2 is issued while page pp is
+            # being consumed, and lands in the slot that held page pp-1
+            # (already consumed) — an issued DMA never targets a buffer
+            # with pending reads, so no DMA/vector ordering assumption is
+            # needed. Slots are indexed dynamically (pp % 3): the page loop
+            # is a fori_loop over scalar-prefetched counts, not an unroll.
+            def page_dma(slot, w, pp, j, which):
                 pool = k_pool_ref if which == 0 else v_pool_ref
-                page = tables_ref[(step // P) * BQ + j, step % P]
+                page = tables_ref[w * BQ + j, pp]
                 return pltpu.make_async_copy(
                     pool.at[page],
                     pages.at[slot, j, which],
                     psem.at[slot, j, which],
                 )
 
-            def row_needs(step, j):
-                """Does row j of step's wave have history on step's page?
-                Same SMEM-derived predicate at issue (step+2) and wait
-                (step), so conditional start/wait pairs always match."""
-                b = (step // P) * BQ + j
-                last_page = jnp.maximum(start_ref[b] - 1, 0) // BS
-                return (step % P) <= last_page
+            def row_needs(w, pp, j):
+                """Does row j of wave w have history on page pp? The SAME
+                SMEM-derived predicate gates issue (pp+2), wait (pp) and
+                compute (pp), so conditional start/wait pairs always match
+                — and a short row in a long-context wave does nothing at
+                all for its dead pages (no stream, no mask)."""
+                return pp < pcount_ref[w * BQ + j]
 
-            def issue_step(step):
-                slot = step % 3
+            def issue_page(w, pp):
+                slot = pp % 3  # derived here so issue/wait can't desync
                 for j in range(BQ):
 
-                    @pl.when(row_needs(step, j))
+                    @pl.when(row_needs(w, pp, j))
                     def _(j=j):
-                        page_dma(slot, step, j, 0).start()
-                        page_dma(slot, step, j, 1).start()
+                        page_dma(slot, w, pp, j, 0).start()
+                        page_dma(slot, w, pp, j, 1).start()
 
-            def wait_step(step, j):
-                slot = step % 3
+            def wait_page(w, pp, j):
+                slot = pp % 3
 
-                @pl.when(row_needs(step, j))
+                @pl.when(row_needs(w, pp, j))
                 def _():
-                    page_dma(slot, step, j, 0).wait()
-                    page_dma(slot, step, j, 1).wait()
+                    page_dma(slot, w, pp, j, 0).wait()
+                    page_dma(slot, w, pp, j, 1).wait()
 
             # ---- phase 1: qkv weight streaming + fused RoPE ----
             def phase_qkv(wbuf):
@@ -219,9 +233,9 @@ def _fused_layer_kernel(
                     )
 
                 w_dma(0, 0).start()
-                issue_step(0)
-                if NPS > 1:
-                    issue_step(1)
+                issue_page(0, 0)
+                if P > 1:
+                    issue_page(0, 1)
 
                 h = h_ref[...]
                 for t in range(NQT):  # static: tile→(ref, head) per tile
@@ -247,97 +261,115 @@ def _fused_layer_kernel(
             pl.run_scoped(phase_qkv, wbuf=pltpu.VMEM((2, d, TQ), jnp.int8))
 
             # ---- phase 2: paged attention, page-granular flash pipeline.
-            # STATIC unroll over page-steps: every batch row, sem slot, and
-            # scale slice is a compile-time index (the per-layer kernel is
-            # compiled ONCE and reused by all layers, so the unroll cost is
-            # paid a single time), matching the proven static-index style
-            # of ops/pallas/paged_attention.py. ----
-            def att_step(step):
-                w = step // P
-                pp = step % P
-                slot = step % 3
+            # DYNAMIC page loop per wave: the fori_loop trip count is the
+            # wave's maximum scalar-prefetched page count, so the traced
+            # program holds ONE page-step body per wave regardless of the
+            # table width — trace/compile cost no longer scales with
+            # context length (the old static unroll paid (B/BQ)·P bodies
+            # and capped the table at 16 pages). Batch waves stay a static
+            # unroll: NW = B/BQ is small and fixed by the batch shape, and
+            # static j/kh indices keep the proven static-index style of
+            # ops/pallas/paged_attention.py inside the loop body. ----
+            def att_wave(w):
+                npg = pcount_ref[w * BQ]
+                for j in range(1, BQ):
+                    npg = jnp.maximum(npg, pcount_ref[w * BQ + j])
 
-                if step + 2 < NPS:
-                    issue_step(step + 2)
+                fl_m[...] = jnp.full_like(fl_m, NEG_INF)
+                fl_l[...] = jnp.zeros_like(fl_l)
+                fl_acc[...] = jnp.zeros_like(fl_acc)
 
-                if pp == 0:
-                    fl_m[...] = jnp.full_like(fl_m, NEG_INF)
-                    fl_l[...] = jnp.zeros_like(fl_l)
-                    fl_acc[...] = jnp.zeros_like(fl_acc)
+                def page_step(pp, carry):
+                    slot = pp % 3
+                    issue_page(w, pp + 2)
 
-                for j in range(BQ):
-                    b = w * BQ + j
-                    start = start_ref[b]
-                    wait_step(step, j)
-
-                    # Skip rows whose history ends before this page — the
-                    # DMA was never issued (row_needs) and the flash state
-                    # is untouched, so traffic+compute track sequence
-                    # length, not table capacity.
-                    @pl.when(row_needs(step, j))
-                    def _(j=j, b=b, start=start):
-                        for kh in range(KH):
-                            q = q4_ref[b, kh]  # [G, D]
-                            kpg = pages[slot, j, 0, :, kh, :].astype(
-                                jnp.float32
-                            )
-                            vpg = pages[slot, j, 1, :, kh, :].astype(
-                                jnp.float32
-                            )
-                            s = jax.lax.dot_general(
-                                q, kpg, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                            ) * sm_scale  # [G, BS]
-                            t_idx = pp * BS + jax.lax.broadcasted_iota(
-                                jnp.int32, (G, BS), 1
-                            )
-                            s = jnp.where(t_idx < start, s, NEG_INF)
-                            m = fl_m[j, kh]
-                            m_new = jnp.maximum(
-                                m, jnp.max(s, -1, keepdims=True)
-                            )
-                            alpha = jnp.exp(m - m_new)
-                            p_ = jnp.exp(s - m_new)
-                            fl_l[j, kh] = fl_l[j, kh] * alpha + jnp.sum(
-                                p_, -1, keepdims=True
-                            )
-                            fl_acc[j, kh] = fl_acc[j, kh] * alpha + (
-                                jax.lax.dot_general(
-                                    p_, vpg, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32,
-                                )
-                            )
-                            fl_m[j, kh] = m_new
-
-                # wave finalize: current-token column + normalize + store
-                if pp == P - 1:
                     for j in range(BQ):
                         b = w * BQ + j
-                        for kh in range(KH):
-                            q = q4_ref[b, kh]  # [G, D]
-                            kcur = kn_ref[pl.ds(b, 1), kh, :].astype(
-                                jnp.float32
-                            )  # [1, D]
-                            vcur = vn_ref[pl.ds(b, 1), kh, :].astype(
-                                jnp.float32
-                            )
-                            s_c = jax.lax.dot_general(
-                                q, kcur, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                            ) * sm_scale  # [G, 1]
-                            m = fl_m[j, kh]
-                            m_new = jnp.maximum(m, s_c)
-                            alpha = jnp.exp(m - m_new)
-                            p_c = jnp.exp(s_c - m_new)
-                            l = fl_l[j, kh] * alpha + p_c
-                            acc = fl_acc[j, kh] * alpha + p_c * vcur
-                            out = acc / jnp.maximum(l, 1e-30)
-                            attn4_ref[pl.ds(b, 1), kh, :, :] = out.reshape(
-                                1, G, D
-                            ).astype(attn4_ref.dtype)
+                        start = start_ref[b]
+                        wait_page(w, pp, j)
 
-            for _step in range(NPS):
-                att_step(_step)
+                        # Skip rows whose history ends before this page —
+                        # the DMA was never issued (row_needs) and the
+                        # flash state is untouched, so traffic+compute
+                        # track sequence length, not table capacity.
+                        @pl.when(row_needs(w, pp, j))
+                        def _(j=j, b=b, start=start):
+                            for kh in range(KH):
+                                q = q4_ref[b, kh]  # [G, D]
+                                kpg = pages[slot, j, 0, :, kh, :].astype(
+                                    jnp.float32
+                                )
+                                vpg = pages[slot, j, 1, :, kh, :].astype(
+                                    jnp.float32
+                                )
+                                s = jax.lax.dot_general(
+                                    q, kpg, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                ) * sm_scale  # [G, BS]
+                                t_idx = pp * BS + jax.lax.broadcasted_iota(
+                                    jnp.int32, (G, BS), 1
+                                )
+                                s = jnp.where(t_idx < start, s, NEG_INF)
+                                m = fl_m[j, kh]
+                                m_new = jnp.maximum(
+                                    m, jnp.max(s, -1, keepdims=True)
+                                )
+                                alpha = jnp.exp(m - m_new)
+                                p_ = jnp.exp(s - m_new)
+                                fl_l[j, kh] = fl_l[j, kh] * alpha + jnp.sum(
+                                    p_, -1, keepdims=True
+                                )
+                                fl_acc[j, kh] = fl_acc[j, kh] * alpha + (
+                                    jax.lax.dot_general(
+                                        p_, vpg, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32,
+                                    )
+                                )
+                                fl_m[j, kh] = m_new
+
+                    return carry
+
+                jax.lax.fori_loop(0, npg, page_step, 0)
+
+                # Next wave's first pages start streaming while this wave
+                # finalizes — the cross-wave analogue of hiding wave 0's
+                # prologue under the qkv weight stream. Every DMA this
+                # wave issued was waited inside the loop (matched
+                # row_needs predicates), so slots 0/1 have no pending
+                # traffic.
+                if w + 1 < NW:
+                    issue_page(w + 1, 0)
+                    if P > 1:
+                        issue_page(w + 1, 1)
+
+                # wave finalize: current-token column + normalize + store
+                for j in range(BQ):
+                    b = w * BQ + j
+                    for kh in range(KH):
+                        q = q4_ref[b, kh]  # [G, D]
+                        kcur = kn_ref[pl.ds(b, 1), kh, :].astype(
+                            jnp.float32
+                        )  # [1, D]
+                        vcur = vn_ref[pl.ds(b, 1), kh, :].astype(
+                            jnp.float32
+                        )
+                        s_c = jax.lax.dot_general(
+                            q, kcur, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        ) * sm_scale  # [G, 1]
+                        m = fl_m[j, kh]
+                        m_new = jnp.maximum(m, s_c)
+                        alpha = jnp.exp(m - m_new)
+                        p_c = jnp.exp(s_c - m_new)
+                        l = fl_l[j, kh] * alpha + p_c
+                        acc = fl_acc[j, kh] * alpha + p_c * vcur
+                        out = acc / jnp.maximum(l, 1e-30)
+                        attn4_ref[pl.ds(b, 1), kh, :, :] = out.reshape(
+                            1, G, D
+                        ).astype(attn4_ref.dtype)
+
+            for _w in range(NW):
+                att_wave(_w)
 
         pl.run_scoped(
             qkv_and_attention,
@@ -493,9 +525,11 @@ def fused_decoder_layer(
     """Run one fused decoder layer. Returns (x_out [B, d], k_new [B, KH, D],
     v_new [B, KH, D]); the caller scatters k_new/v_new into the pools
     (ops/attention.write_chunk_to_cache) AFTER the call — the kernel
-    attends to history pages plus the in-register current token, so rows
-    whose history is shorter than the padded page count are handled by the
-    causal mask alone."""
+    attends to history pages plus the in-register current token. Rows
+    whose history is shorter than the table width skip their dead pages
+    via the scalar-prefetched per-row page counts; the table width P may
+    be anything (one compiled program per distinct P — callers should
+    bucket widths, see engines/tpu/engine.py::table_width_bucket)."""
     if interpret is None:
         # CPU (tests, dryruns): Mosaic doesn't lower there — emulate.
         interpret = jax.default_backend() != "tpu"
@@ -525,9 +559,16 @@ def fused_decoder_layer(
 
     two_d = lambda a: a.reshape(1, -1)  # noqa: E731 — Mosaic wants >=2D
 
+    start32 = start_pos.astype(jnp.int32)
+    # Per-row history page count: the scalar-prefetch operand that bounds
+    # the kernel's dynamic page loop and gates every page DMA per row.
+    # Clamped to the table width so a row can never index past its table
+    # (the causal mask already hides any positions beyond it).
+    pcounts = jnp.minimum((start32 + BS - 1) // BS, P)
+
     out = pl.pallas_call(
         kernel,
-        in_specs=[smem(), smem()] + [vmem()] * 12 + [hbm()] * 9,
+        in_specs=[smem(), smem(), smem()] + [vmem()] * 12 + [hbm()] * 9,
         out_specs=(vmem(), vmem(), vmem()),
         out_shape=(
             jax.ShapeDtypeStruct((B, d), x.dtype),
@@ -537,7 +578,8 @@ def fused_decoder_layer(
         interpret=interpret,
     )(
         block_tables.astype(jnp.int32),
-        start_pos.astype(jnp.int32),
+        start32,
+        pcounts,
         x, cos.astype(jnp.float32), sin.astype(jnp.float32),
         two_d(lp["attn_norm"]), two_d(lp["mlp_norm"]),
         two_d(lp["wq"]["s"]), two_d(lp["wk"]["s"]), two_d(lp["wv"]["s"]),

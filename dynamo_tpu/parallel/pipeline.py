@@ -25,8 +25,9 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.parallel.sharding import shard_map_unchecked
 
 from dynamo_tpu.models.config import ModelConfig
 
@@ -149,18 +150,17 @@ def forward_paged_pp(
         return out, k_c, v_c
 
     replicated = P()
-    out, k_cache, v_cache = shard_map(
+    out, k_cache, v_cache = shard_map_unchecked(
         stage_fn,
-        mesh=mesh,
-        in_specs=(
+        mesh,
+        (
             layer_specs,  # layer stack sharded over pp
             P(axis),  # per-layer windows
             P(axis),  # k_cache on layers
             P(axis),  # v_cache
             replicated, replicated, replicated, replicated,
         ),
-        out_specs=(replicated, P(axis), P(axis)),
-        check_vma=False,
+        (replicated, P(axis), P(axis)),
     )(params["layers"], windows, k_cache, v_cache, x_mb, sp_mb, cl_mb, bt_mb)
 
     x = out.reshape(B, C, -1)

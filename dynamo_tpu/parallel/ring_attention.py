@@ -101,11 +101,12 @@ def ring_attention(
 def make_ring_attention(mesh: Mesh, axis: str = "sp", *, causal: bool = True):
     """Jitted [B, T, H, D] ring attention with T sharded over ``axis``."""
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    from dynamo_tpu.parallel.sharding import shard_map_unchecked
+
+    fn = shard_map_unchecked(
         functools.partial(ring_attention, axis=axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+        mesh,
+        (spec, spec, spec),
+        spec,
     )
     return jax.jit(fn)

@@ -310,11 +310,12 @@ async def test_export_readback_overlaps_decode():
         t_export_done = _time.monotonic() - t0
         assert [t for o in out for t in o.token_ids], "decode produced nothing"
         assert found == hashes
-        # decode finished while the transfer was still sleeping on the wire
+        # decode finished while the transfer was still sleeping on the
+        # wire. The RELATIVE ordering is the whole claim — an absolute
+        # wall-clock bound here flaked on loaded hosts where compile/jit
+        # stalls stretched the decode leg past any fixed budget while the
+        # overlap itself held (ADVICE r5).
         assert t_decode_done < t_export_done, (t_decode_done, t_export_done)
-        assert t_decode_done < 1.0, (
-            f"decode stalled behind the transfer ({t_decode_done:.2f}s)"
-        )
     finally:
         engine.runner.gather_blocks_readback = real_readback
         await engine.stop()

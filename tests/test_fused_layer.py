@@ -42,7 +42,7 @@ def _layer_params(cfg, seed=0):
     return jax.tree.map(lambda a: a[0], qparams["layers"])
 
 
-def _setup(cfg, B=8, BS=16, P=2, seed=1):
+def _setup(cfg, B=8, BS=16, P=2, seed=1, start=None):
     rng = np.random.default_rng(seed)
     NB = B * P + 4
     d = cfg.d_model
@@ -59,13 +59,12 @@ def _setup(cfg, B=8, BS=16, P=2, seed=1):
     tables = jnp.asarray(
         rng.permutation(NB)[: B * P].reshape(B, P).astype(np.int32)
     )
-    # varied positions: page boundaries, zero history, mid-page — clamped
-    # to the table's page capacity (positions past BS*P don't exist)
-    sp = np.array(
-        [0, 1, BS - 1, BS, BS + 3, 2 * BS - 1, 7, BS + BS // 2][:B],
-        dtype=np.int32,
-    )
-    sp = np.minimum(sp, BS * P - 1)
+    if start is None:
+        # varied positions: page boundaries, zero history, mid-page —
+        # clamped to the table's page capacity (positions past BS*P don't
+        # exist)
+        start = [0, 1, BS - 1, BS, BS + 3, 2 * BS - 1, 7, BS + BS // 2][:B]
+    sp = np.minimum(np.asarray(start, dtype=np.int32), BS * P - 1)
     start_pos = jnp.asarray(sp)
     return x, k_pool, v_pool, tables, start_pos
 
@@ -168,6 +167,47 @@ def test_fused_layer_then_write_matches_pool_update():
     )
 
 
+def _parity(cfg, B, P, start, seed=2, batch_block=4):
+    """Fused kernel vs XLA oracle on one shape; returns max relative err."""
+    lp = _layer_params(cfg)
+    x, k_pool, v_pool, tables, start_pos = _setup(
+        cfg, B=B, P=P, seed=seed, start=start
+    )
+    ref_x, _, _ = _oracle(cfg, lp, x, k_pool, v_pool, tables, start_pos)
+    pos = start_pos[:, None]
+    cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+    got_x, _, _ = fused_decoder_layer(
+        x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
+        eps=cfg.rms_norm_eps, sm_scale=cfg.head_dim_**-0.5,
+        batch_block=batch_block, interpret=True,
+    )
+    a = np.asarray(got_x, dtype=np.float32)
+    b = np.asarray(ref_x, dtype=np.float32)
+    scale = np.max(np.abs(b)) + 1e-6
+    err = np.max(np.abs(a - b)) / scale
+    assert err < 4e-2, err
+    return err
+
+
+def test_table_width_buckets_bounded():
+    """As contexts grow, dispatched table widths collapse into ~log2(cap)
+    pow2 buckets — the compiled-program-count bound for the decode and
+    spec-verify dispatches. (The jit-cache-growth companion lives in
+    test_zlongctx_fused.py with the other long-context checks.)"""
+    import math
+
+    from dynamo_tpu.engines.tpu.engine import table_width_bucket
+
+    cap = 256  # 4096 tokens at block_size 16
+    buckets = {table_width_bucket(n, cap) for n in range(1, cap + 1)}
+    assert len(buckets) <= int(math.log2(cap)) + 1, sorted(buckets)
+    assert max(buckets) == cap  # the top bucket still reaches capacity
+    assert table_width_bucket(0, cap) == 1
+    for n in range(1, cap + 1):
+        # a bucket always covers the width that requested it
+        assert n <= table_width_bucket(n, cap) <= cap
+
+
 async def test_engine_megakernel_matches_xla_decode():
     """Full engine on CPU (interpret mode): greedy decode with the
     megakernel ON must match the XLA decode path token-for-token on a
@@ -245,3 +285,35 @@ async def test_megakernel_failure_falls_back_to_xla(monkeypatch):
         assert not any(o.error for o in outs)
     finally:
         await e.stop()
+
+
+def test_is_kernel_compile_error_classification():
+    """The one-shot fallback's error filter: compile/lowering shapes
+    demote, transient device/wire errors do not (ADVICE r5)."""
+    from dynamo_tpu.engines.tpu.runner import _is_kernel_compile_error
+
+    assert _is_kernel_compile_error(RuntimeError("Mosaic lowering failed"))
+    assert _is_kernel_compile_error(RuntimeError("exceeded VMEM limit"))
+    assert _is_kernel_compile_error(NotImplementedError("unsupported op"))
+    # an unrelated host-side NotImplementedError is NOT a Mosaic rejection
+    assert not _is_kernel_compile_error(
+        NotImplementedError("feature not available on this backend")
+    )
+    assert not _is_kernel_compile_error(ValueError("socket closed"))
+    assert not _is_kernel_compile_error(RuntimeError("device halted"))
+    assert not _is_kernel_compile_error(TimeoutError("tunnel RTT blew up"))
+    # jaxlib's XlaRuntimeError is a catch-all: compile rejections demote,
+    # transport/device transient statuses must propagate.
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert _is_kernel_compile_error(
+        XlaRuntimeError("INTERNAL: Mosaic failed to compile module")
+    )
+    assert _is_kernel_compile_error(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: scoped memory over budget")
+    )
+    assert not _is_kernel_compile_error(
+        XlaRuntimeError("UNAVAILABLE: Socket closed")
+    )
+    assert not _is_kernel_compile_error(
+        XlaRuntimeError("DEADLINE_EXCEEDED: tunnel RPC timed out")
+    )

@@ -210,4 +210,12 @@ def test_spec_breakeven_harness_smoke():
                   block_size=8, iters=2)
     assert out["t_decode_ms_per_token_step"] > 0
     assert out["t_verify_ms"] > 0
-    assert 0 <= out["break_even_acceptance_rate"] <= out["spec_k"]
+    # The rate is a RATIO of two wall-time measurements (iters=2): under
+    # full-suite contention on the 1-core host it can legitimately exceed
+    # spec_k (= "spec cannot win at this measured shape"), so the smoke
+    # gate is finite-and-nonnegative — the marshalling contract — not a
+    # bound derived from timing.
+    import math
+
+    rate = out["break_even_acceptance_rate"]
+    assert rate >= 0 and math.isfinite(rate), out

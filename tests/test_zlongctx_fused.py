@@ -1,0 +1,246 @@
+"""Long-context megakernel checks: interpret-mode parity past the removed
+MAX_TABLE_PAGES=16 ceiling, trace/compile-cost regressions for the dynamic
+page loop, and the narrowed one-shot fallback's runtime behavior.
+
+Named test_z* DELIBERATELY: these are the suite's heaviest interpret-mode
+compiles (~2 min total on the 1-core CI host), and the tier-1 run sits at
+the edge of its wall-clock budget — sorting them last keeps the broad
+suite's coverage ahead of them. Run directly when touching the kernel:
+
+    pytest tests/test_zlongctx_fused.py -q
+
+Companion design doc: docs/design_docs/megakernel_paged_streaming.md.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dynamo_tpu.ops.pallas.fused_layer import fused_decoder_layer
+from dynamo_tpu.ops.rope import rope_table
+
+from test_fused_layer import _cfg, _layer_params, _parity, _setup
+
+
+@pytest.mark.parametrize("ctx", [256, 1024, 4096])
+def test_fused_layer_long_context_parity(ctx):
+    """The old static unroll capped tables at MAX_TABLE_PAGES=16 (256
+    tokens at BS=16); the dynamic page loop must match the XLA oracle at
+    any table width — here 16, 64 and 256 pages, with rows at the context
+    edge, mid-context, near-zero and zero history."""
+    cfg = _cfg()
+    BS = 16
+    P = ctx // BS
+    start = [ctx - 1, ctx // 2, 3, 0]
+    _parity(cfg, 4, P, start, seed=2 + ctx)
+
+
+def test_fused_layer_ragged_batch_parity():
+    """Short and long rows mixed in one long-context batch: the per-row
+    early exit (short rows skip their dead pages entirely — no stream, no
+    mask) must not perturb numerics for either kind, across waves with
+    different max page counts."""
+    cfg = _cfg()
+    start = [0, 3, 16, 255, 1024, 2047, 4095, 500]
+    _parity(cfg, 8, 256, start, seed=3)
+
+
+def _count_eqns(jaxpr) -> int:
+    """Total equation count including nested jaxprs (pjit bodies, the
+    pallas kernel jaxpr, fori_loop/cond branches)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        total += 1
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (tuple, list)) else [val]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    total += _count_eqns(inner)
+                elif hasattr(v, "eqns"):
+                    total += _count_eqns(v)
+    return total
+
+
+def test_trace_size_independent_of_table_width():
+    """Compile-cost regression for the dynamic page loop: the traced
+    program's equation count must NOT scale with the table width (the old
+    kernel unrolled (B/BQ)*P page-steps, so P=64 traced ~4x the bodies of
+    P=16 and pages past 16 were rejected outright)."""
+    import functools as ft
+
+    cfg = _cfg()
+    lp = _layer_params(cfg)
+
+    def trace_eqns(P):
+        x, k_pool, v_pool, tables, start_pos = _setup(
+            cfg, B=4, P=P, seed=4, start=[1, 5, 9, 13]
+        )
+        pos = start_pos[:, None]
+        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+        f = ft.partial(
+            fused_decoder_layer,
+            eps=cfg.rms_norm_eps, sm_scale=cfg.head_dim_**-0.5,
+            batch_block=4, interpret=True,
+        )
+        jaxpr = jax.make_jaxpr(f)(
+            x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos
+        )
+        return _count_eqns(jaxpr.jaxpr)
+
+    n_small, n_large = trace_eqns(8), trace_eqns(64)
+    assert n_large <= n_small + 2, (n_small, n_large)
+
+
+def test_compiled_program_count_tracks_width_buckets():
+    """The jit cache grows once per DISTINCT table width and stays flat on
+    repeats — with table_width_bucket collapsing widths into pow2 buckets
+    (tests/test_fused_layer.py::test_table_width_buckets_bounded), the
+    compiled-program count is bounded by the bucket count, not by context
+    length."""
+    cfg = _cfg()
+    lp = _layer_params(cfg)
+    s0 = fused_decoder_layer._cache_size()
+    seen = set()
+    for P in (8, 8, 32, 32):
+        x, k_pool, v_pool, tables, start_pos = _setup(
+            cfg, B=4, P=P, seed=5, start=[0, 1, 2, 3]
+        )
+        pos = start_pos[:, None]
+        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+        fused_decoder_layer(
+            x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
+            eps=cfg.rms_norm_eps, sm_scale=cfg.head_dim_**-0.5,
+            batch_block=4, interpret=True,
+        )
+        seen.add(P)
+        assert fused_decoder_layer._cache_size() - s0 == len(seen)
+
+
+def _mk_runner():
+    from dynamo_tpu.engines.tpu import JaxEngineArgs
+    from dynamo_tpu.engines.tpu.runner import DeviceRunner
+
+    args = JaxEngineArgs(
+        config=_cfg(), block_size=16, num_kv_blocks=64, max_num_seqs=4,
+        max_model_len=64, quantization="int8", use_megakernel=True,
+    )
+    r = DeviceRunner(args)
+    assert r.use_megakernel
+    return r
+
+
+def _raw_decode(r, nb=1):
+    S = 4
+    return r.run_decode(
+        np.zeros(S, np.int32), np.zeros(S, np.int32),
+        np.ones(S, np.int32), np.zeros((S, nb), np.int32),
+        np.zeros(S, np.float32), np.zeros(S, np.int32),
+        np.ones(S, np.float32), np.zeros(S, np.int32),
+    )
+
+
+def test_transient_decode_error_does_not_demote(monkeypatch):
+    """A transient (non-compile-shaped) error at first dispatch must
+    PROPAGATE instead of permanently demoting the engine to the XLA
+    decode path — the ADVICE r5 finding against `except Exception`."""
+    from dynamo_tpu.ops.pallas import fused_layer
+
+    r = _mk_runner()
+
+    def boom(*a, **k):
+        raise ValueError("socket closed: transient wire error")
+
+    monkeypatch.setattr(fused_layer, "fused_decoder_layer", boom)
+    with pytest.raises(ValueError):
+        _raw_decode(r)
+    assert r.use_megakernel, "transient error demoted the megakernel"
+
+
+def test_transient_at_unproven_width_propagates(monkeypatch):
+    """Provenness is per table-width bucket: after a success at width 1, a
+    TRANSIENT error at the never-compiled width 2 still propagates (it is
+    not compile-shaped), keeping the megakernel armed."""
+    from dynamo_tpu.ops.pallas import fused_layer
+
+    r = _mk_runner()
+    toks, _, _, _ = _raw_decode(r, nb=1)
+    assert toks.shape[0] == 4
+    assert (1, False, False) in r._mk_proven_keys
+
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+
+    def boom(*a, **k):
+        raise XlaRuntimeError("UNAVAILABLE: Socket closed")
+
+    monkeypatch.setattr(fused_layer, "fused_decoder_layer", boom)
+    # nb=2 forces a fresh trace (new table width) so the patch takes hold
+    with pytest.raises(RuntimeError):
+        _raw_decode(r, nb=2)
+    assert r.use_megakernel, "transient at new width demoted the megakernel"
+
+
+def test_unproven_width_compile_error_demotes(monkeypatch):
+    """A DETERMINISTIC lowering failure at a wider, never-proven bucket
+    (e.g. the first long-context request tripping an SMEM/VMEM limit the
+    short-context program never hit) must still demote to the XLA path —
+    long-context serving degrades instead of erroring forever."""
+    from dynamo_tpu.ops.pallas import fused_layer
+
+    r = _mk_runner()
+    _raw_decode(r, nb=1)
+    assert (1, False, False) in r._mk_proven_keys
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic lowering failed: scoped VMEM over budget")
+
+    monkeypatch.setattr(fused_layer, "fused_decoder_layer", boom)
+    toks, _, _, _ = _raw_decode(r, nb=2)  # demotes, then serves via XLA
+    assert toks.shape[0] == 4
+    assert not r.use_megakernel, "compile failure at new width did not demote"
+
+
+async def test_engine_megakernel_past_old_table_ceiling():
+    """A prompt past the old 256-token ceiling (decode table bucket of 32
+    pages > the removed MAX_TABLE_PAGES=16) must decode THROUGH the
+    megakernel — _mk_proven_keys shows a fused dispatch actually ran, i.e. no
+    silent width-gate fallback — and match the XLA path token-for-token."""
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.engine import collect
+
+    cfg = _cfg()
+    prompt = [(i % 90) + 3 for i in range(300)]
+
+    async def run(use_mk):
+        e = JaxEngine(JaxEngineArgs(
+            config=cfg, block_size=16, num_kv_blocks=128, max_num_seqs=4,
+            max_model_len=4096, quantization="int8", use_megakernel=use_mk,
+        ))
+        assert e.runner.use_megakernel == use_mk  # eligible at 4096
+        try:
+            req = PreprocessedRequest(
+                token_ids=prompt, request_id=f"long-mk{use_mk}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=8),
+            )
+            outs = await collect(e.generate(req, Context()))
+            if use_mk:
+                assert e.runner.use_megakernel, "demoted mid-run"
+                assert e.runner._mk_proven_keys, "megakernel never ran"
+                # the decode table bucket exceeded the old 16-page ceiling
+                assert max(k[0] for k in e.runner._mk_proven_keys) > 16
+            return [t for d in outs for t in d.token_ids]
+        finally:
+            await e.stop()
+
+    base = await run(False)
+    fused = await run(True)
+    assert len(base) == 8
+    assert fused == base, (fused, base)
