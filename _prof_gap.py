@@ -1,0 +1,125 @@
+"""Host-gap profiler: inter-burst device idle at pipeline_depth 1 vs 2.
+
+The decode pipelining win (docs/design_docs/decode_pipelining.md) is the
+host work the device no longer waits on between fused bursts: readback
+RTT + stop-condition reconciliation + emit + scheduler tick. This script
+runs the SAME decode-heavy workload at depth 1 and depth 2 on whatever
+backend JAX sees and reports, per depth:
+
+  - wall_per_burst_ms : end-to-end wall clock / reaped bursts
+  - host_gap_ms       : mean of dynamo_tpu_engine_host_gap_seconds — the
+                        measured host-injected device wait per dispatch
+  - derived idle delta: wall_per_burst(d1) - wall_per_burst(d2) ≈ the
+                        hidden per-burst host overhead
+
+Env: PROF_ISL / PROF_OSL / PROF_CONCURRENCY / PROF_STEPS / PROF_MODEL
+(tiny | qwen2.5-0.5b), PROF_ROUNDS.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+
+async def run_depth(depth: int):
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config, tiny_config
+    from dynamo_tpu.runtime.context import Context
+
+    model = os.environ.get("PROF_MODEL", "tiny")
+    cfg = tiny_config() if model == "tiny" else qwen2_500m_config()
+    isl = int(os.environ.get("PROF_ISL", 32))
+    osl = int(os.environ.get("PROF_OSL", 128))
+    conc = int(os.environ.get("PROF_CONCURRENCY", 8))
+    steps = int(os.environ.get("PROF_STEPS", 8))
+
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=cfg,
+            block_size=16,
+            num_kv_blocks=max(256, conc * (isl + osl) // 16 + 64),
+            max_num_seqs=conc,
+            max_model_len=isl + osl + 32,
+            prefill_chunk=min(128, isl),
+            prefill_batch=conc,
+            decode_steps=steps,
+            pipeline_depth=depth,
+        )
+    )
+    rng = np.random.default_rng(7)
+
+    def mk_req(i):
+        return PreprocessedRequest(
+            token_ids=rng.integers(10, cfg.vocab_size - 10, size=isl).tolist(),
+            request_id=f"gap-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+
+    async def one(i):
+        n = 0
+        async for out in engine.generate(mk_req(i), Context()):
+            n += len(out.token_ids or [])
+        return n
+
+    try:
+        # Warmup wave pays every compile; the measured wave is steady-state.
+        await asyncio.gather(*(one(1000 + i) for i in range(conc)))
+        g0, s0 = engine.step_metrics.host_gap_stats()
+        steps0 = engine.steps
+        t0 = time.monotonic()
+        toks = sum(
+            await asyncio.gather(*(one(i) for i in range(conc)))
+        )
+        wall = time.monotonic() - t0
+        bursts = max(engine.steps - steps0, 1)
+        g1, s1 = engine.step_metrics.host_gap_stats()
+        return {
+            "pipeline_depth": depth,
+            "tokens": toks,
+            "wall_s": round(wall, 3),
+            "bursts": bursts,
+            "wall_per_burst_ms": round(1000 * wall / bursts, 3),
+            "host_gap_ms": round(
+                1000 * (s1 - s0) / max(g1 - g0, 1), 3
+            ),
+            "toks_per_s": round(toks / wall, 1),
+        }
+    finally:
+        await engine.stop()
+
+
+async def main():
+    rounds = int(os.environ.get("PROF_ROUNDS", 1))
+    out = {"backend": None, "runs": []}
+    import jax
+
+    out["backend"] = jax.default_backend()
+    for _ in range(rounds):
+        d1 = await run_depth(1)
+        d2 = await run_depth(2)
+        d1["hidden_host_ms_per_burst"] = round(
+            d1["wall_per_burst_ms"] - d2["wall_per_burst_ms"], 3
+        )
+        out["runs"].append({"depth1": d1, "depth2": d2})
+    r = out["runs"][-1]
+    out["summary"] = {
+        "host_gap_ms_d1": r["depth1"]["host_gap_ms"],
+        "host_gap_ms_d2": r["depth2"]["host_gap_ms"],
+        "wall_per_burst_ms_d1": r["depth1"]["wall_per_burst_ms"],
+        "wall_per_burst_ms_d2": r["depth2"]["wall_per_burst_ms"],
+        "overlap_win_ms_per_burst": r["depth1"]["hidden_host_ms_per_burst"],
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

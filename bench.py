@@ -24,6 +24,7 @@ Knob reference (env): BENCH_ISL/OSL/CONCURRENCY/REQUESTS, BENCH_MODEL
 (qwen2.5-0.5b | llama3-8b | llama3-3b | mixtral-8x7b), BENCH_QUANT=int8,
 BENCH_BLOCK_SIZE/KV_BLOCKS/PREFILL_CHUNK/PREFILL_BATCH/DECODE_STEPS,
 BENCH_USE_KERNEL, BENCH_SPEC=ngram (speculative decoding),
+BENCH_PIPELINE_DEPTH (decode-tick pipelining; 2 default, 1 = synchronous),
 BENCH_SECONDARY=0 (skip the 8B-int8 leg).
 """
 
@@ -194,6 +195,10 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
             quantization=quant,
             spec_mode=spec,
             kv_cache_dtype=kv_quant,
+            # Decode-tick pipelining (docs/design_docs/decode_pipelining.md):
+            # 2 double-buffers bursts so readback + emit hide under device
+            # compute; 1 reproduces the pre-pipelining synchronous ticks.
+            pipeline_depth=int(os.environ.get("BENCH_PIPELINE_DEPTH", 2)),
         )
     )
 
@@ -253,6 +258,11 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     wall = time.monotonic() - t0
     await engine.stop()
     stats = engine.stats()
+    # Host-gap aggregate: mean host-injected device wait per decode
+    # dispatch (0 when the next burst was already in flight) — the number
+    # the pipeline_depth knob exists to shrink.
+    gap_count, gap_sum = engine.step_metrics.host_gap_stats()
+    host_gap_ms = round(1000 * gap_sum / gap_count, 3) if gap_count else None
 
     # Drop every reference to the engine's device arrays BEFORE the next
     # leg allocates (an un-GC'd 8 GB int8 tree plus the next leg's engine
@@ -290,6 +300,8 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         "wall_s": round(wall, 2),
         "p50_ttft_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
         "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
+        "pipeline_depth": stats.get("pipeline_depth"),
+        "host_gap_ms": host_gap_ms,
         "anchor_toks_per_sec": round(
             _anchor_toks_per_sec(cfg, concurrency, avg_ctx, quant), 1
         ),
@@ -648,6 +660,8 @@ async def run_bench():
         "wall_s": primary["wall_s"],
         "p50_ttft_ms": primary["p50_ttft_ms"],
         "p50_itl_ms": primary["p50_itl_ms"],
+        "pipeline_depth": primary["pipeline_depth"],
+        "host_gap_ms": primary["host_gap_ms"],
         "mfu": primary["mfu"],
         "hbm_util": primary["hbm_util"],
         "n_chips": jax.device_count(),

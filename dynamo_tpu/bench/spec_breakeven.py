@@ -108,17 +108,25 @@ def measure(model: str = "llama3-8b", quant: str | None = "int8",
         return max(time.perf_counter() - t0 - t_rtt, 1e-9) / n
 
     d = jnp.asarray
-    dec_args = (
-        d(toks), d(pos), d(ones), d(tables), runner.rng,
-        np.int32(1), d(temp), d(topk), d(topp), None,
-    )
+    salts = np.zeros((batch,), np.int32)
+    dec_fn = runner._decode_state_fns.get((False, False))
+    if dec_fn is None:
+        dec_fn = runner._build_decode_fn()
+        runner._decode_state_fns[(False, False)] = dec_fn
 
+    # The state-path decode program donates tokens/pos (the carry), so
+    # hand it FRESH device copies each call — pos stays constant across
+    # timed iterations (constant attention work), unlike threading the
+    # advancing carry.
     def dec_call():
-        out = runner._decode_fn(
+        out = dec_fn(
             runner.params, runner.lora, runner.k_cache, runner.v_cache,
-            *dec_args,
+            d(toks), d(pos), d(ones), d(tables),
+            d(salts), runner.rng, d(temp), d(topk), d(topp),
+            d(np.zeros((batch,), np.int32)),
         )
-        runner.k_cache, runner.v_cache = out[-2], out[-1]
+        # out = (toks, logp, k, v, carry_tok, carry_pos)
+        runner.k_cache, runner.v_cache = out[2], out[3]
         return out
 
     t_decode = time_loop(dec_call, 3, lambda o: o[0]) / iters

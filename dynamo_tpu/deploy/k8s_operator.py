@@ -389,13 +389,20 @@ class K8sGraphOperator:
             return
         observed_spec = int(svc.get("replicas", 1))
         # status.replicas backs the HPA scale subresource: report the
-        # OBSERVED ready count (GD status), never the just-written desired
-        # — echoing desired would make an autoscaler see phantom capacity.
+        # OBSERVED ready count (GD status) only. When the GD has no ready
+        # count yet, falling back to the GD spec would echo the replica
+        # count a previous reconcile just WROTE — phantom capacity that
+        # makes an autoscaler believe a scale-up already landed. Report
+        # the adapter's last known ready count instead (0 before the
+        # first readiness report).
         ready = (
             (gd.get("status") or {}).get("services") or {}
         ).get(svc_name, {}).get("ready")
+        if ready is None:
+            last_known = (cr.get("status") or {}).get("replicas")
+            ready = int(last_known) if last_known is not None else 0
         status: Dict[str, Any] = {
-            "replicas": int(ready) if ready is not None else observed_spec,
+            "replicas": int(ready),
             "selector": f"dynamo-tpu.io/deployment={gd_name}",
             "message": "",
         }

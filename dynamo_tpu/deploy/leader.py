@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import time
 import uuid
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from dynamo_tpu.deploy.k8s_client import KubeApiError
 from dynamo_tpu.utils.logging import get_logger
@@ -32,6 +32,8 @@ def _now_rfc3339() -> str:
 
 
 def _parse_rfc3339(s: str) -> float:
+    """Kept for observability/tooling: takeover no longer compares parsed
+    remote timestamps against the local clock (see try_acquire_once)."""
     import calendar
 
     s = s.rstrip("Z")
@@ -66,6 +68,14 @@ class LeaderElector:
         self.renew_interval_s = renew_interval_s or lease_duration_s / 3.0
         self.is_leader = False
         self.transitions = 0  # acquired-count (observability/tests)
+        # Staleness is judged by LOCAL observation, never by comparing our
+        # wall clock against the remote holder's renewTime (client-go
+        # leaderelection semantics): record what (holder, renewTime) we
+        # last SAW and our local monotonic time when it last CHANGED. A
+        # live holder on a skewed clock keeps changing renewTime, so the
+        # observation timer keeps resetting and the lease is never stolen.
+        self._observed: Optional[Tuple[Any, Any]] = None
+        self._observed_changed_at = 0.0
         self._task: Optional[asyncio.Task] = None
         self._leader_event = asyncio.Event()
         self._stop = asyncio.Event()
@@ -113,13 +123,21 @@ class LeaderElector:
         spec = lease.get("spec") or {}
         holder = spec.get("holderIdentity")
         renew = spec.get("renewTime")
-        age = (
-            time.time() - _parse_rfc3339(renew)
-            if renew
-            else self.lease_duration_s + 1
-        )
         duration = float(spec.get("leaseDurationSeconds") or self.lease_duration_s)
-        if holder == self.identity or not holder or age > duration:
+        # Local-observation staleness (client-go semantics): restart the
+        # clock whenever the observed (holder, renewTime) pair changes, and
+        # only call the lease stale once it has sat UNCHANGED for a full
+        # lease duration of OUR monotonic time. Comparing time.time()
+        # against the remote renewTime would let cross-machine clock skew
+        # greater than lease_duration − renew_interval steal a LIVE lease
+        # (split-brain: two operators reconciling at once).
+        now_mono = time.monotonic()
+        observed = (holder, renew)
+        if observed != self._observed:
+            self._observed = observed
+            self._observed_changed_at = now_mono
+        stale = (now_mono - self._observed_changed_at) > duration
+        if holder == self.identity or not holder or stale:
             # renew, first claim, or takeover of a stale (crashed) holder.
             # The patch carries the observed resourceVersion: a concurrent
             # candidate's patch bumps it, so the second writer gets 409
@@ -200,13 +218,35 @@ class LeaderElector:
                 pass
             self._task = None
         if self.is_leader:
-            # graceful release: zero the holder so a peer takes over at its
-            # next tick instead of waiting out the lease duration
+            # Graceful release: zero the holder so a peer takes over at its
+            # next tick instead of waiting out the lease duration. Guarded:
+            # re-read the lease and release ONLY while we are still the
+            # recorded holder, carrying the observed resourceVersion so a
+            # concurrent renew/takeover turns our release into a 409 no-op
+            # — an unconditional null patch here would wipe a peer that
+            # legitimately took the lease over after our last renew.
             try:
-                await self.client.patch(
-                    GROUP, VERSION, self.k8s_namespace, PLURAL, self.name,
-                    {"spec": {"holderIdentity": None, "renewTime": None}},
+                lease = await self.client.get(
+                    GROUP, VERSION, self.k8s_namespace, PLURAL, self.name
                 )
+                spec = lease.get("spec") or {}
+                if spec.get("holderIdentity") == self.identity:
+                    body: dict = {
+                        "spec": {"holderIdentity": None, "renewTime": None}
+                    }
+                    rv = (lease.get("metadata") or {}).get("resourceVersion")
+                    if rv is not None:
+                        body["metadata"] = {"resourceVersion": str(rv)}
+                    await self.client.patch(
+                        GROUP, VERSION, self.k8s_namespace, PLURAL,
+                        self.name, body,
+                    )
+            except KubeApiError as exc:
+                if exc.status != 409:  # lost a race: someone else owns it
+                    logger.warning(
+                        "leader election %s: graceful release failed (%s)",
+                        self.name, exc,
+                    )
             except Exception:
                 pass
             self._become(False)

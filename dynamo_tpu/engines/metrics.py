@@ -45,6 +45,22 @@ class EngineStepMetrics:
             "Tokens emitted per decode step (fused multi-iteration burst)",
             buckets=COUNT_BUCKETS,
         )
+        # Decode-tick pipelining (dispatch/reap split): host_gap is the
+        # device wait the host injected between the previous burst's
+        # readback completing and the next dispatch being enqueued — 0
+        # whenever another burst was already queued on the device. The
+        # depth-1 vs depth-2 comparison of this family IS the overlap win.
+        self.host_gap = self.registry.histogram(
+            mn.ENGINE_HOST_GAP,
+            "Host-injected device wait between decode bursts "
+            "(0 = the next burst was already in flight)",
+        )
+        self.inflight_depth = self.registry.histogram(
+            mn.ENGINE_INFLIGHT_DEPTH,
+            "Decode bursts in flight on the device at each dispatch "
+            "(including the one being dispatched)",
+            buckets=COUNT_BUCKETS,
+        )
 
     def observe_prefill(self, duration_s: float, occupancy: int, tokens: int) -> None:
         self.step_duration.observe(duration_s, phase="prefill")
@@ -55,6 +71,17 @@ class EngineStepMetrics:
         self.step_duration.observe(duration_s, phase="decode")
         self.batch_occupancy.observe(occupancy, phase="decode")
         self.decode_tokens.observe(tokens)
+
+    def observe_host_gap(self, gap_s: float) -> None:
+        self.host_gap.observe(gap_s)
+
+    def observe_inflight(self, depth: int) -> None:
+        self.inflight_depth.observe(depth)
+
+    def host_gap_stats(self) -> tuple:
+        """(count, total_seconds) observed on the host-gap family — the
+        aggregate bench.py records as host_gap_ms."""
+        return self.host_gap.snapshot_total()
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics=openmetrics)

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.engines.tpu.runner import _next_pow2
 from dynamo_tpu.runtime import lifecycle
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 
@@ -32,10 +33,6 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
-
-
-def _next_pow2(n: int) -> int:
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
 class Admitter:
@@ -259,10 +256,12 @@ class Admitter:
         topk = np.zeros(Bp, dtype=np.int32)
         topp = np.ones(Bp, dtype=np.float32)
         adapter = np.zeros(Bp, dtype=np.int32)
-        for r, (_, prep) in enumerate(batch):
+        salts = np.zeros(Bp, dtype=np.int32)
+        for r, (seq_r, prep) in enumerate(batch):
             tables[r, : len(prep.ids)] = prep.ids
             temp[r], topk[r], topp[r] = prep.sp
             adapter[r] = prep.adapter_id
+            salts[r] = seq_r.salt
         procs = None
         if any(prep.procs is not None for _, prep in batch):
             from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS, prompt_hot
@@ -320,7 +319,7 @@ class Admitter:
                 e._run_step,
                 tok_arr, start, lens, tables,
                 temp, topk, topp, adapter,
-                mm_embeds, mm_chunk, procs, want_top, first_chunk,
+                mm_embeds, mm_chunk, procs, want_top, first_chunk, salts,
             )
             e.step_metrics.observe_prefill(
                 # Occupancy counts rows still prefilling this round — short
@@ -370,6 +369,14 @@ class Admitter:
         e._block_tables[slot, : len(prep.ids)] = prep.ids
         e._temp[slot], e._topk[slot], e._topp[slot] = prep.sp
         e._adapter_ids[slot] = prep.adapter_id
+        e._salts[slot] = seq.salt
+        e._tok_mirror[slot] = int(first_token)
+        # Installation mutates every per-slot field the device-resident
+        # decode state reads — reconcile at the next dispatch. Installs
+        # only ever happen behind the scheduler's drain barrier, so no
+        # in-flight burst can be holding this slot stale-active.
+        e._dirty_state.add(slot)
+        e._dirty_tables.add(slot)
         # Logits-processor slot state: neutral unless this occupant asks —
         # stale device bookkeeping from a previous occupant is harmless
         # under neutral params (identity transform).

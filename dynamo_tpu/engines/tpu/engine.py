@@ -35,7 +35,7 @@ import numpy as np
 
 from dynamo_tpu.engines.mock.kv_manager import KvEvent
 from dynamo_tpu.engines.tpu.block_pool import BlockPool
-from dynamo_tpu.engines.tpu.runner import DeviceRunner
+from dynamo_tpu.engines.tpu.runner import DeviceRunner, _next_pow2
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     FinishReason,
@@ -117,6 +117,17 @@ class JaxEngineArgs:
     # cache + eligible architecture). The XLA path stays the fallback for
     # every ineligible shape and for prefill.
     use_megakernel: Optional[bool] = None
+    # Decode-tick pipelining: how many fused decode bursts may be in flight
+    # on the device at once. 2 (default) double-buffers — burst N+1 is
+    # dispatched from the device-resident carry while the host reads back
+    # and emits burst N, hiding readback RTT + emit/scheduler work behind
+    # device compute. 1 = fully synchronous (dispatch, read, emit, repeat).
+    # Token/logprob streams are bit-identical across depths for a fixed
+    # seed: sampling noise is keyed on (seed, sequence salt, token index),
+    # never dispatch order (docs/design_docs/decode_pipelining.md).
+    # spec_mode caps the effective depth at 1 (prompt-lookup proposals
+    # need reconciled host tokens at every burst boundary).
+    pipeline_depth: int = 2
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -138,14 +149,38 @@ class _Sequence:
     logprob_pending: Optional[float] = None
     admission_failures: int = 0  # deterministic per-request errors (poisoned)
     hash_salt: int = 0  # adapter ⊕ multimodal content salt (prefix cache)
+    # Sampling-RNG salt (arrival order): the sequence's noise stream is
+    # keyed (engine seed, salt, token index) — survives preemption and is
+    # independent of slot/batch/dispatch placement.
+    salt: int = 0
     # Speculative prompt-lookup: n-gram → position AFTER its last occurrence
     # (incrementally indexed up to ngram_upto).
     ngram_index: Dict[tuple, int] = field(default_factory=dict)
     ngram_upto: int = 0
 
 
-def _next_pow2(n: int) -> int:
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+@dataclass
+class _InflightBurst:
+    """One dispatched-but-unreaped decode burst (pipelined decode tick).
+    ``seqs`` snapshots (slot, sequence) at dispatch time; at reap, a row is
+    emitted only if its slot still holds the SAME sequence — rows whose
+    sequence finished in an earlier burst while this one was in flight are
+    dropped (their device-side writes landed in the 2-burst lookahead
+    blocks that were reserved at dispatch, so they corrupt nothing)."""
+
+    handles: Any  # runner._DecodeHandles
+    seqs: List[Tuple[int, _Sequence]]
+    t_dispatch: float
+    occupancy: int
+
+
+# Block-table lookahead reserved by every decode dispatch, in bursts of
+# ``decode_steps`` tokens. Constant 2 at EVERY pipeline depth — the
+# speculative burst can never outrun its reservation, and depth 1 and
+# depth 2 request pool blocks at identical points in the reap order, which
+# is what makes preemption decisions (and therefore full token streams)
+# depth-independent (docs/design_docs/decode_pipelining.md).
+PIPELINE_LOOKAHEAD_BURSTS = 2
 
 
 def table_width_bucket(max_blocks: int, cap: int) -> int:
@@ -236,6 +271,26 @@ class JaxEngine:
         self._topk = np.zeros(S, dtype=np.int32)
         self._topp = np.ones(S, dtype=np.float32)
         self._adapter_ids = np.zeros(S, dtype=np.int32)
+        self._tok_mirror = np.zeros(S, dtype=np.int32)  # decode input token
+        self._salts = np.zeros(S, dtype=np.int32)  # per-slot sampling salt
+        self._next_salt = 0  # arrival-order salt counter
+        # Dirty-slot tracking for the device-resident decode state: the
+        # numpy arrays above are the scheduler's VIEW; the device copies in
+        # DeviceRunner.slot_state are reconciled incrementally at the next
+        # dispatch for exactly the slots a mutating event touched
+        # (admission, finish, preempt, spec emission → _dirty_state; block
+        # append / table rewrite → _dirty_tables). Invariant: a slot with a
+        # LIVE sequence is only ever state-dirty while no burst is in
+        # flight (mutating events either happen at reap — where the dirty
+        # row deactivates a finished slot — or behind a drain barrier).
+        self._dirty_state: set = set(range(S))
+        self._dirty_tables: set = set(range(S))
+        # Pipelined decode: dispatched-but-unreaped bursts, oldest first.
+        self._inflight: "collections.deque[_InflightBurst]" = (
+            collections.deque()
+        )
+        self.preemptions = 0
+        self._t_last_ready: Optional[float] = None  # last burst readback
         # Per-slot logits-processor params (neutral unless the occupant asks).
         from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS
 
@@ -330,28 +385,33 @@ class JaxEngine:
     def lora_names(self) -> List[str]:
         return sorted(self.runner.lora_index)
 
-    def _run_decode(
-        self, tokens, start_pos, active, block_tables, temp, topk, topp,
-        adapter_ids, want_logprobs=False, want_procs=False,
-    ):
-        """Multi-step decode on the device thread. Returns ([B, K] tokens,
-        [B, K] logprobs, top_vals [B, K, N] | None, top_ids | None)."""
-        procs = None
-        if want_procs:
-            procs = (
-                self._minp.copy(), self._rep.copy(), self._pres.copy(),
-                self._freq.copy(), self._bias_ids.copy(),
-                self._bias_vals.copy(),
-            )
-        return self.runner.run_decode(
-            tokens, start_pos, active, block_tables, temp, topk, topp,
-            adapter_ids, want_logprobs=want_logprobs, procs=procs,
+    def _pipeline_depth(self) -> int:
+        # Speculative decoding caps the effective depth at 1: every spec
+        # tick needs fully-reconciled host tokens to propose from, and a
+        # pipelined fallback would advance 2 bursts between proposal
+        # points — halving the lookup cadence and skipping right over
+        # n-gram matches. Spec is itself a latency path; it keeps the
+        # synchronous tick it was tuned for.
+        if self.args.spec_mode:
+            return 1
+        return max(1, int(getattr(self.args, "pipeline_depth", 1) or 1))
+
+    def _dispatch_on_device(self, nb, want_logprobs, want_procs,
+                            state_sync, table_sync):
+        """Device-thread half of a burst dispatch: reconcile dirty slot
+        rows into the device-resident state, then enqueue the burst."""
+        if state_sync is not None:
+            self.runner.sync_slots(*state_sync)
+        if table_sync is not None:
+            self.runner.sync_tables(*table_sync)
+        return self.runner.decode_dispatch(
+            nb, want_logprobs=want_logprobs, use_procs=want_procs
         )
 
     def _run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
         adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
-        first_chunk=False,
+        first_chunk=False, salts=None,
     ):
         """One prefill step on the device thread (blocking). See
         DeviceRunner.run_step; kept as an engine method so tests can inject
@@ -359,7 +419,7 @@ class JaxEngine:
         return self.runner.run_step(
             tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
             adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot, procs=procs,
-            want_top=want_top, first_chunk=first_chunk,
+            want_top=want_top, first_chunk=first_chunk, salts=salts,
         )
 
     async def _device(self, fn, *a):
@@ -396,6 +456,9 @@ class JaxEngine:
             "prefill_tokens": self.prefill_tokens,
             "generated_tokens": self.generated_tokens,
             "sleep_level": self._sleep_level,
+            "pipeline_depth": self._pipeline_depth(),
+            "inflight_bursts": len(self._inflight),
+            "preemptions": self.preemptions,
         }
         if self.args.spec_mode:
             out["spec_proposed"] = self.spec_proposed
@@ -516,7 +579,12 @@ class JaxEngine:
             queue=asyncio.Queue(),
             prompt=prompt,
             all_tokens=list(prompt),
+            # Arrival-order RNG salt: the sequence's sampling noise is
+            # (seed, salt, token index), so its stream is identical no
+            # matter which slot/burst/pipeline depth serves it.
+            salt=self._next_salt,
         )
+        self._next_salt = (self._next_salt + 1) & 0x7FFFFFFF
         self._waiting.append(seq)
         self._wake.set()
         while True:
@@ -535,6 +603,18 @@ class JaxEngine:
                 if self._sleep_requested is not None or self._sleep_level > 0:
                     if await self._sleep_tick():
                         continue
+                # Admission installs into slots and allocates pool blocks —
+                # both must see fully-reconciled state, so drain the
+                # pipeline first. Gated on a free slot actually existing:
+                # under saturation (queue deep, every slot busy) the
+                # admission attempt is doomed and the pipeline keeps
+                # flowing instead of degrading to depth 1.
+                if (
+                    self._waiting
+                    and self._inflight
+                    and any(s is None for s in self._slots)
+                ):
+                    await self._drain_inflight()
                 admitted = False
                 # Admit in batched prefill dispatches; a per-tick batch cap
                 # bounds how long running decodes stall behind prefill
@@ -543,7 +623,15 @@ class JaxEngine:
                     if await self._admit_batch() == 0:
                         break
                     admitted = True
-                active = any(s is not None for s in self._slots)
+                if admitted:
+                    # Prefill just ran on the device: the wait before the
+                    # next decode dispatch is device-busy time, not
+                    # host-injected gap — don't observe it.
+                    self._t_last_ready = None
+                active = (
+                    any(s is not None for s in self._slots)
+                    or bool(self._inflight)
+                )
                 if active:
                     if self.args.spec_mode == "ngram":
                         if not await self._spec_tick():
@@ -551,6 +639,8 @@ class JaxEngine:
                     else:
                         await self._decode_tick()
                 elif not admitted:
+                    # Idle: request inter-arrival time is not host gap.
+                    self._t_last_ready = None
                     self._wake.clear()
                     try:
                         await asyncio.wait_for(self._wake.wait(), timeout=0.05)
@@ -571,6 +661,11 @@ class JaxEngine:
                     logger.error("SPMD channel broke: failing worker: %s", exc)
                     self._fail_terminally(exc)
                     break
+                # A failed tick may leave dispatched-but-unreaped bursts
+                # whose device carry ran ahead of what was emitted: drop
+                # them and resync from the host mirrors — the retried
+                # bursts regenerate identical tokens (position-keyed RNG).
+                self._abort_inflight()
                 # Retry with exponential backoff (transient device hiccups
                 # can span seconds), then treat the failure as terminal: fail
                 # every pending request and refuse new ones. Round 1 retried
@@ -591,6 +686,9 @@ class JaxEngine:
                 self._consecutive_tick_failures = 0
                 if self._failure is not None:  # systemic admission failure
                     break
+        # Shutdown: in-flight results are dropped (every surviving sequence
+        # is about to be finished with CANCELLED/ERROR anyway).
+        self._inflight.clear()
         reason = (
             FinishReason.ERROR if self._failure is not None else FinishReason.CANCELLED
         )
@@ -687,6 +785,9 @@ class JaxEngine:
         level = self._sleep_requested
         if level is None:  # wake() cancelled the request mid-drain
             return True
+        # All sequences have finished; reap any zombie bursts so nothing
+        # holds device buffers (or stale carry) across the sleep.
+        await self._drain_inflight()
         self._sleep_requested = None
         self.pool.clear()  # on the loop thread: emits 'cleared' to routers
         # _sleep_inflight closes the window where a concurrent wake() sees
@@ -728,6 +829,7 @@ class JaxEngine:
                     break
                 self._block_tables[slot, len(seq.block_ids)] = b
                 seq.block_ids.append(b)
+                self._dirty_tables.add(slot)
         return [s for s in self._slots if s is not None]
 
     # -- speculative decoding (prompt-lookup / n-gram) ---------------------
@@ -759,55 +861,194 @@ class JaxEngine:
         return await self._spec.tick()
 
     async def _decode_tick(self) -> None:
+        """Pipelined decode tick: top the in-flight window up to
+        ``pipeline_depth`` bursts, then reap (read back + emit) the oldest.
+        At depth 1 this degenerates to dispatch-then-reap — today's fully
+        synchronous behavior. At depth 2 the device always has the next
+        burst queued while the host overlaps readback, stop-condition
+        reconciliation and emission of the previous one."""
+        depth = self._pipeline_depth()
+        while len(self._inflight) < depth:
+            if not await self._dispatch_burst():
+                break
+        if self._inflight:
+            await self._reap_burst()
+
+    def _blocks_shortfall(self, lookahead: int) -> int:
+        """How many blocks the next _prepare_decode would need beyond what
+        the pool can serve (same per-seq arithmetic, so a non-positive
+        shortfall guarantees allocation succeeds without preemption)."""
+        args = self.args
+        need = 0
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            pos = int(self._pos[slot])
+            last_pos = min(
+                pos + lookahead - 1,
+                args.max_blocks_per_seq * args.block_size - 1,
+            )
+            need += max(0, last_pos // args.block_size + 1 - len(seq.block_ids))
+        return need - self.pool.free_blocks
+
+    async def _dispatch_burst(self) -> bool:
+        """Prepare + enqueue one decode burst. Returns False when there is
+        nothing to decode. H2D on the steady path is ZERO: slot state and
+        tables upload only for dirty slots; tokens/pos ride the device
+        carry of the previous burst."""
         args = self.args
         K = args.decode_steps
-        active = self._prepare_decode(K)
+        lookahead = K * PIPELINE_LOOKAHEAD_BURSTS
+        # Preemption drains the pipeline first: if growing the tables could
+        # exhaust the pool, reap in-flight bursts (their finishes may free
+        # blocks) before letting _prepare_decode preempt — so a preemption
+        # decision is only ever taken against fully-reconciled state, at
+        # the same reap boundary regardless of pipeline depth.
+        while self._inflight and self._blocks_shortfall(lookahead) > 0:
+            await self._reap_burst()
+        active = self._prepare_decode(lookahead)
         if not active:
-            return
+            return False
 
-        tokens = np.zeros(args.max_num_seqs, dtype=np.int32)
-        active_mask = np.zeros(args.max_num_seqs, dtype=np.int32)
+        state_sync = self._build_state_sync()
+        table_sync = self._build_table_sync()
+        # Width bucket for THIS burst: host pos lags the device carry by K
+        # per in-flight burst, so the burst being dispatched spans up to
+        # host pos + (inflight + 1) * K — the same bucket a depth-1 engine
+        # computes for the same burst index.
+        inflight_off = K * len(self._inflight)
         max_blocks = 1
         for seq in active:
-            tokens[seq.slot] = seq.next_token
-            active_mask[seq.slot] = 1
             max_blocks = max(
                 max_blocks,
-                (int(self._pos[seq.slot]) + K - 1) // args.block_size + 1,
+                (int(self._pos[seq.slot]) + inflight_off + K - 1)
+                // args.block_size + 1,
             )
         nb_bucket = table_width_bucket(max_blocks, args.max_blocks_per_seq)
-
         want_logprobs = any(
             s.request.sampling.logprobs is not None for s in active
         )
         want_procs = any(self._uses_procs[s.slot] for s in active)
+        had_inflight = bool(self._inflight)
         t0 = time.monotonic()
-        toks, logps, topv, topi = await self._device(
-            self._run_decode,
-            tokens,
-            self._pos.copy(),
-            active_mask,
-            self._block_tables[:, :nb_bucket].copy(),
-            self._temp.copy(), self._topk.copy(), self._topp.copy(),
-            self._adapter_ids.copy(),
-            want_logprobs,
-            want_procs,
+        handles = await self._device(
+            self._dispatch_on_device, nb_bucket, want_logprobs, want_procs,
+            state_sync, table_sync,
         )
-        step_s = time.monotonic() - t0
-        self.steps += 1
+        # Host-gap: how long the device sat idle on host work between the
+        # previous burst's readback and this dispatch. When another burst
+        # was already in flight the device never waited — observe 0.
+        if self._t_last_ready is not None:
+            gap = 0.0 if had_inflight else max(
+                0.0, t0 - self._t_last_ready
+            )
+            self.step_metrics.observe_host_gap(gap)
+        self.step_metrics.observe_inflight(len(self._inflight) + 1)
+        self._inflight.append(
+            _InflightBurst(
+                handles=handles,
+                seqs=[(s.slot, s) for s in active],
+                t_dispatch=t0,
+                occupancy=len(active),
+            )
+        )
+        return True
 
+    def _build_state_sync(self):
+        """Payload for DeviceRunner.sync_slots covering the dirty slots
+        (None when clean — the steady-state case)."""
+        if not self._dirty_state:
+            return None
+        slots = sorted(self._dirty_state)
+        self._dirty_state.clear()
+        sl = np.asarray(slots, dtype=np.int64)
+        rows = {
+            "tokens": self._tok_mirror[sl],
+            "pos": self._pos[sl],
+            "active": np.asarray(
+                [1 if self._slots[s] is not None else 0 for s in slots],
+                np.int32,
+            ),
+            "temp": self._temp[sl],
+            "topk": self._topk[sl],
+            "topp": self._topp[sl],
+            "adapter_ids": self._adapter_ids[sl],
+            "salts": self._salts[sl],
+            "minp": self._minp[sl],
+            "rep": self._rep[sl],
+            "pres": self._pres[sl],
+            "freq": self._freq[sl],
+            "bias_ids": self._bias_ids[sl],
+            "bias_vals": self._bias_vals[sl],
+        }
+        return (slots, rows)
+
+    def _build_table_sync(self):
+        if not self._dirty_tables:
+            return None
+        slots = sorted(self._dirty_tables)
+        self._dirty_tables.clear()
+        return (slots, self._block_tables[np.asarray(slots, np.int64)].copy())
+
+    async def _reap_burst(self) -> None:
+        """Read back + emit the OLDEST in-flight burst. Stop conditions are
+        reconciled here: a row whose sequence already finished (in a burst
+        reaped while this one was in flight) is dropped — its slot was
+        deactivated and its device pos reset by the dirty-slot sync, and
+        its speculative KV writes landed in reserved lookahead blocks."""
+        rec = self._inflight.popleft()
+        toks, logps, topv, topi = await self._device(
+            self.runner.decode_read, rec.handles
+        )
+        self._t_last_ready = time.monotonic()
+        self.steps += 1
         gen0 = self.generated_tokens
-        for seq in list(active):
+        for slot, seq in rec.seqs:
+            if self._slots[slot] is not seq or seq.slot != slot:
+                continue  # finished/preempted while this burst was in flight
             self._emit_burst(
-                seq, toks[seq.slot], logps[seq.slot],
-                None if topv is None else topv[seq.slot],
-                None if topi is None else topi[seq.slot],
+                seq, toks[slot], logps[slot],
+                None if topv is None else topv[slot],
+                None if topi is None else topi[slot],
             )
         # Emitted (post-stop-condition) tokens, not dispatched K×B — the
-        # honest throughput number the planner divides by step time.
+        # honest throughput number the planner divides by step time. The
+        # duration is dispatch→readback of THIS burst (queue-inclusive at
+        # depth ≥ 2).
         self.step_metrics.observe_decode(
-            step_s, len(active), self.generated_tokens - gen0
+            time.monotonic() - rec.t_dispatch, rec.occupancy,
+            self.generated_tokens - gen0,
         )
+
+    async def _drain_inflight(self) -> None:
+        """Barrier: reap every in-flight burst. Required before any event
+        that must see (or mutate) fully-reconciled slot/pool state —
+        admission installs, speculative ticks, sleep, preemption."""
+        while self._inflight:
+            await self._reap_burst()
+
+    def _abort_inflight(self) -> None:
+        """Failure path: drop un-reaped bursts and resync EVERYTHING from
+        the host mirrors. The device carry (pos/tokens) advanced past what
+        was emitted; marking all slots dirty rolls the device state back to
+        the scheduler's view, and the position-keyed sampling RNG makes the
+        retried bursts regenerate the identical tokens."""
+        self._inflight.clear()
+        self._dirty_state.update(range(self.args.max_num_seqs))
+        self._dirty_tables.update(range(self.args.max_num_seqs))
+        # Aborted proc-variant bursts already installed their advanced
+        # out_counts into runner.proc_state at dispatch — rebuild every
+        # live penalty-using slot's counts from the EMITTED history, or
+        # the retry would apply penalties against double-counted tallies
+        # (different logits → different tokens than the no-failure run).
+        for slot, seq in enumerate(self._slots):
+            if seq is not None and self._uses_procs[slot]:
+                self.runner.proc_reset_slot(
+                    slot, seq.request.token_ids, seq.generated
+                )
+        # Don't let the failure + retry-backoff window masquerade as host
+        # gap on the next dispatch.
+        self._t_last_ready = None
 
     def _emit_burst(
         self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray,
@@ -864,6 +1105,7 @@ class JaxEngine:
         seq.generated.extend(emitted)
         seq.all_tokens.extend(emitted)
         seq.next_token = emitted[-1]
+        self._tok_mirror[slot] = emitted[-1]
         self.generated_tokens += n_take
         self._pos[slot] += n_take  # these tokens' KV is now resident
         self._commit_complete_blocks(seq, slot)
@@ -918,12 +1160,18 @@ class JaxEngine:
                 self.kvbm.notify_commit(h, bi + 1)
 
     def _preempt(self, seq: _Sequence) -> None:
-        """Release blocks and requeue for recompute (vLLM-style preemption)."""
+        """Release blocks and requeue for recompute (vLLM-style preemption).
+        Only ever reached with an empty pipeline (_dispatch_burst drains
+        before letting allocation fail), so the recompute — whose sampling
+        keys are position-salted — regenerates the identical stream."""
         logger.warning("preempting request %s (KV pool exhausted)", seq.request.request_id)
         self.pool.release(seq.block_ids, seq.block_hashes)
         slot = seq.slot
         self._slots[slot] = None
         self._pos[slot] = 0
+        self._tok_mirror[slot] = 0
+        self._dirty_state.add(slot)
+        self.preemptions += 1
         seq.slot = -1
         self._requeue(seq)
 
@@ -1079,6 +1327,11 @@ class JaxEngine:
         if seq.slot >= 0:
             self._slots[seq.slot] = None
             self._pos[seq.slot] = 0
+            self._tok_mirror[seq.slot] = 0
+            # Deactivate the device-side slot at the next dispatch: any
+            # still-in-flight burst that has this row stale-active gets its
+            # tokens dropped at reap, and the row stops advancing after.
+            self._dirty_state.add(seq.slot)
             seq.slot = -1
         if emit:
             seq.queue.put_nowait(BackendOutput(finish_reason=reason))

@@ -19,6 +19,7 @@ reference's DP leader / non-leader ranks
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -27,11 +28,50 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.models import llama
-from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
+from dynamo_tpu.ops.sampling import compute_logprobs, fold_row_keys, sample_tokens
 from dynamo_tpu.parallel.sharding import ShardingRules, shard_params
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@jax.jit
+def _scatter_state_rows(state, idx, rows):
+    """Write ``rows[k][i]`` into ``state[k][idx[i]]`` for every slot-state
+    field — ONE device program per row-count bucket, so a dirty-slot sync
+    costs a single small H2D + dispatch regardless of how many per-slot
+    arrays the decode state carries.
+
+    Deliberately NOT donated: donating these dict-of-small-array operands
+    through a shared module-level jit trips a native double-free in
+    jaxlib 0.4.37's CPU client when the persistent compilation cache
+    serves the executable (segfault at the next engine's buffer GC,
+    reproduced under tests/). The copies are a few KB on rare mutating
+    events — not a hot path."""
+    return {k: state[k].at[idx].set(rows[k]) for k in state}
+
+
+@jax.jit
+def _scatter_table_rows(tables, idx, rows):
+    return tables.at[idx].set(rows)
+
+
+@dataclass
+class _DecodeHandles:
+    """Un-materialized device results of one dispatched decode burst.
+    Returned by decode_dispatch; decode_read blocks on them. mk_key is the
+    megakernel (width, logprobs, procs) provenness key, or None when the
+    burst ran on the XLA path."""
+
+    toks: Any
+    logp: Any
+    topv: Optional[Any] = None
+    topi: Optional[Any] = None
+    mk_key: Optional[Tuple[int, bool, bool]] = None
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -275,25 +315,63 @@ class DeviceRunner:
         if args.lora_dir:
             self._load_loras(args.lora_dir)
 
-        # RNG: one fixed base key + a host-side step counter folded in
-        # INSIDE the jitted programs. A host-side jax.random.split per
-        # dispatch measured ~28ms on the tunneled TPU platform — pure
-        # overhead on every engine step.
+        # RNG: ONE fixed base key. Decode/prefill sampling keys are derived
+        # on device from (base key, sequence salt, token index) —
+        # ops/sampling.fold_row_keys — so noise never depends on dispatch
+        # order (the pipelined scheduler's determinism contract). The
+        # host-side rng_step counter remains only for the speculative
+        # verify program, which has no per-token position structure.
         self.rng = jax.random.PRNGKey(args.seed ^ 0x5EED)
         if self._repl is not None:
             self.rng = jax.device_put(self.rng, self._repl)
         self.rng_step = 0
 
+        # Device-resident decode slot state: everything the fused decode
+        # program reads per slot lives in HBM and is updated INCREMENTALLY
+        # on the rare mutating events (admission, finish, preempt, block
+        # append) via sync_slots/sync_tables — never re-uploaded from host
+        # numpy on steady-state ticks. The engine keeps numpy mirrors as
+        # the scheduler's view only. tokens/pos are additionally threaded
+        # through each burst as a donated carry (decode_dispatch).
+        from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS
+
+        S = args.max_num_seqs
+        state0 = {
+            "tokens": np.zeros(S, np.int32),
+            "pos": np.zeros(S, np.int32),
+            "active": np.zeros(S, np.int32),
+            "temp": np.ones(S, np.float32),
+            "topk": np.zeros(S, np.int32),
+            "topp": np.ones(S, np.float32),
+            "adapter_ids": np.zeros(S, np.int32),
+            "salts": np.zeros(S, np.int32),
+            "minp": np.zeros(S, np.float32),
+            "rep": np.ones(S, np.float32),
+            "pres": np.zeros(S, np.float32),
+            "freq": np.zeros(S, np.float32),
+            "bias_ids": np.full((S, MAX_BIAS_SLOTS), -1, np.int32),
+            "bias_vals": np.zeros((S, MAX_BIAS_SLOTS), np.float32),
+        }
+        self.slot_state = {
+            k: self._dev_persistent(v) for k, v in state0.items()
+        }
+        self.slot_tables = self._dev_persistent(
+            np.zeros((S, args.max_blocks_per_seq), np.int32)
+        )
+        # H2D accounting for the hot path: every slot-state upload and
+        # decode dispatch appends ("slot_sync"|"table_sync", rows) /
+        # ("decode", nb). Tests assert steady-state ticks are pure
+        # dispatches (no re-upload of pos/temp/topk/topp/adapter_ids/
+        # block_tables); bounded ring so serving never grows it unbounded.
+        self.transfer_log: List[Tuple[str, int]] = []
+        self._transfer_log_cap = 4096
+
+        # State-path decode programs, keyed (want_logprobs, use_procs).
+        # The logprob-free variant skips a full-vocab log-softmax per fused
+        # step (the common case); processor variants compile lazily on the
+        # first request that uses one.
+        self._decode_state_fns: Dict[Tuple[bool, bool], Any] = {}
         self._step_fn = self._build_step_fn()
-        # Two decode programs: the logprob-free one skips a full-vocab
-        # log-softmax per fused step (the common case); the other serves
-        # batches where any request asked for logprobs.
-        self._decode_fn = self._build_decode_fn(want_logprobs=False)
-        self._decode_fn_logprobs = self._build_decode_fn(want_logprobs=True)
-        # Logits-processor program variants (penalties/bias/min-p) compile
-        # lazily on the first request that uses one — the common no-processor
-        # path never pays for the [S, V] bookkeeping or the extra HBM reads.
-        self._decode_procs_fns: Dict[bool, Any] = {}
         # (want_procs, want_top, first_chunk) → lazily compiled prefill
         # program variants. first_chunk (fresh prefill, start_pos all 0)
         # uses dense in-chunk attention — zero paged reads.
@@ -488,13 +566,10 @@ class DeviceRunner:
         num_top = self.args.top_logprobs_cap if want_top else 0
 
         def step(params, lora, k_cache, v_cache, tokens, start_pos, chunk_lens,
-                 block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
+                 block_tables, salts, rng, temp, topk, topp, adapter_ids,
                  mm_embeds, mm_slot,
                  minp=None, rep=None, pres=None, freq=None,
                  bias_ids=None, bias_vals=None, pmask=None):
-            # Derive the per-dispatch key on device (host-side split costs
-            # ~28ms/dispatch on the tunneled platform).
-            rng = jax.random.fold_in(rng, rng_step)
             logits, k_cache, v_cache = llama.forward_paged(
                 params, cfg, tokens, start_pos, chunk_lens, block_tables,
                 k_cache, v_cache, use_kernel=use_kernel,
@@ -502,6 +577,12 @@ class DeviceRunner:
                 mm_embeds=mm_embeds, mm_slot=mm_slot,
                 first_chunk=first_chunk,
             )
+            # Sampling key per row = (base key, sequence salt, index of the
+            # sampled token) — start_pos + chunk_lens is exactly the index
+            # the sampled token will occupy, matching decode_multi's
+            # per-step fold so a preempted sequence's recompute redraws
+            # identical noise for the same position.
+            row_keys = fold_row_keys(rng, salts, start_pos + chunk_lens)
             if want_procs:
                 from dynamo_tpu.ops import logits_process as lp
 
@@ -509,9 +590,11 @@ class DeviceRunner:
                 pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
                                    bias_ids=bias_ids, bias_vals=bias_vals)
                 logits = lp.apply_prompt_only(logits, pmask, pp)
-                toks = sample_tokens(logits, rng, temp, topk, topp, minp)
+                toks = sample_tokens(logits, None, temp, topk, topp, minp,
+                                     row_keys=row_keys)
             else:
-                toks = sample_tokens(logits, rng, temp, topk, topp)
+                toks = sample_tokens(logits, None, temp, topk, topp,
+                                     row_keys=row_keys)
             logp = compute_logprobs(logits, toks)
             if num_top > 0:
                 from dynamo_tpu.ops.sampling import top_logprobs as top_op
@@ -526,6 +609,18 @@ class DeviceRunner:
 
     def _build_decode_fn(self, want_logprobs: bool = False,
                          want_procs: bool = False):
+        """Fused-decode program over the DEVICE-RESIDENT slot state.
+
+        Inputs beyond params/caches are the slot-state arrays (tokens, pos,
+        active, table slice, salts, sampling/processor params) — all device
+        arrays, so a steady-state dispatch moves zero host bytes. tokens
+        and pos are donated and come back as the carry (last sampled token
+        + advanced position per slot), which the runner installs as the
+        next burst's inputs without any host round trip.
+
+        Output layout: (toks [S,K], logps [S,K][, top_vals, top_ids],
+        k_cache, v_cache[, proc_counts], carry_tokens [S], carry_pos [S]).
+        """
         cfg = self.config
         use_kernel = self.use_kernel
         use_megakernel = self.use_megakernel
@@ -536,36 +631,37 @@ class DeviceRunner:
         num_top = self.args.top_logprobs_cap if want_logprobs else 0
 
         if not want_procs:
-            def step(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                     block_tables, rng, rng_step, temp, topk, topp, adapter_ids):
-                rng = jax.random.fold_in(rng, rng_step)
+            def step(params, lora, k_cache, v_cache, tokens, pos, active,
+                     block_tables, salts, rng, temp, topk, topp, adapter_ids):
                 out = llama.decode_multi(
-                    params, cfg, tokens, start_pos, active, block_tables,
+                    params, cfg, tokens, pos, active, block_tables,
                     k_cache, v_cache, rng, temp, topk, topp,
                     num_steps=num_steps, use_kernel=use_kernel,
                     use_megakernel=use_megakernel,
                     lora=lora, adapter_ids=adapter_ids,
                     want_logprobs=want_logprobs,
                     num_top_logprobs=num_top,
+                    salts=salts, want_carry=True,
                 )
-                small = self._constrain_out(*out[:-2])
+                # out = (*small, k, v, carry_tok, carry_pos)
+                small = self._constrain_out(*out[:-4])
                 if not isinstance(small, tuple):
                     small = (small,)
-                return small + out[-2:]
+                carry = self._constrain_out(*out[-2:])
+                return small + out[-4:-2] + carry
 
-            return jax.jit(step, donate_argnums=(2, 3))
+            return jax.jit(step, donate_argnums=(2, 3, 4, 5))
 
         from dynamo_tpu.ops import logits_process as lp
 
-        def step_p(params, lora, k_cache, v_cache, tokens, start_pos, active,
-                   block_tables, rng, rng_step, temp, topk, topp, adapter_ids,
+        def step_p(params, lora, k_cache, v_cache, tokens, pos, active,
+                   block_tables, salts, rng, temp, topk, topp, adapter_ids,
                    minp, rep, pres, freq, bias_ids, bias_vals, counts, pmask):
-            rng = jax.random.fold_in(rng, rng_step)
             pp = lp.ProcParams(rep=rep, pres=pres, freq=freq,
                                bias_ids=bias_ids, bias_vals=bias_vals)
             st = lp.ProcState(out_counts=counts, prompt_mask=pmask)
             out = llama.decode_multi(
-                params, cfg, tokens, start_pos, active, block_tables,
+                params, cfg, tokens, pos, active, block_tables,
                 k_cache, v_cache, rng, temp, topk, topp,
                 num_steps=num_steps, use_kernel=use_kernel,
                 use_megakernel=use_megakernel,
@@ -573,15 +669,18 @@ class DeviceRunner:
                 want_logprobs=want_logprobs,
                 min_p=minp, proc_params=pp, proc_state=st,
                 num_top_logprobs=num_top,
+                salts=salts, want_carry=True,
             )
-            st = out[-1]
-            small = self._constrain_out(*out[:-3])
+            # out = (*small, k, v, proc_state, carry_tok, carry_pos)
+            st = out[-3]
+            small = self._constrain_out(*out[:-5])
             if not isinstance(small, tuple):
                 small = (small,)
-            return small + (out[-3], out[-2], st.out_counts)
+            carry = self._constrain_out(*out[-2:])
+            return small + (out[-5], out[-4], st.out_counts) + carry
 
-        # donate caches + the token-count array (functionally threaded).
-        return jax.jit(step_p, donate_argnums=(2, 3, 20))
+        # donate caches + tokens/pos carry + the token-count array.
+        return jax.jit(step_p, donate_argnums=(2, 3, 4, 5, 20))
 
     def _build_spec_fn(self):
         cfg = self.config
@@ -663,7 +762,7 @@ class DeviceRunner:
     def run_step(
         self, tokens, start_pos, chunk_lens, block_tables, temp, topk, topp,
         adapter_ids, mm_embeds=None, mm_slot=None, procs=None, want_top=False,
-        first_chunk=False,
+        first_chunk=False, salts=None,
     ):
         """One prefill/verify forward + sample. Returns (tokens, logprobs,
         top_vals | None, top_ids | None) as numpy.
@@ -672,16 +771,19 @@ class DeviceRunner:
         prompt_mask) per-row arrays — routes through the logits-processor
         program. ``want_top``: also return the top-N alternatives.
         ``first_chunk``: every row is a fresh prefill (start_pos == 0) —
-        selects the dense in-chunk attention program (no paged reads)."""
+        selects the dense in-chunk attention program (no paged reads).
+        ``salts``: per-row sequence salts for the position-keyed sampling
+        RNG. Defaults to arange(rows) so rows keep independent noise for
+        direct callers (the engine always passes real sequence salts)."""
+        if salts is None:
+            salts = np.arange(len(np.asarray(tokens)), dtype=np.int32)
         self._mirror(
             "step", tokens=tokens, start_pos=start_pos, chunk_lens=chunk_lens,
             block_tables=block_tables, temp=temp, topk=topk, topp=topp,
             adapter_ids=adapter_ids, mm_embeds=mm_embeds, mm_slot=mm_slot,
             procs=None if procs is None else list(procs), want_top=want_top,
-            first_chunk=first_chunk,
+            first_chunk=first_chunk, salts=salts,
         )
-        step_id = np.int32(self.rng_step & 0x7FFFFFFF)  # int32-safe wrap
-        self.rng_step += 1
         key = (procs is not None, bool(want_top), bool(first_chunk))
         fn = self._step_fns.get(key)
         if fn is None:
@@ -693,7 +795,7 @@ class DeviceRunner:
         args = [
             self.params, self.lora, self.k_cache, self.v_cache,
             d(tokens), d(start_pos), d(chunk_lens), d(block_tables),
-            self.rng, step_id,
+            d(np.asarray(salts, dtype=np.int32)), self.rng,
             d(temp), d(topk), d(topp), d(adapter_ids),
             d(mm_embeds), d(mm_slot),
         ]
@@ -711,38 +813,93 @@ class DeviceRunner:
             toks, logp, self.k_cache, self.v_cache = out
         return self._get_all(toks, logp, topv, topi)
 
-    def run_decode(
-        self, tokens, start_pos, active, block_tables, temp, topk, topp,
-        adapter_ids, want_logprobs=False, procs=None,
-    ):
-        """Fused multi-step decode. ``procs``: optional (minp, rep, pres,
-        freq, bias_ids, bias_vals) slot arrays → the processor program.
-        Returns ([B, K] tokens, [B, K] logprobs, top_vals | None,
-        top_ids | None) as numpy."""
-        if self.use_megakernel:
-            # Compile-failure safety net: each table-width bucket's
-            # megakernel program compiles lazily at its first dispatch —
-            # if Mosaic rejects it on this jaxlib/chip (or the shape trips
-            # a VMEM/SMEM limit), demote to the XLA decode path instead of
-            # poisoning serving. Single-process only by construction
-            # (megakernel requires mesh is None), so no SPMD follower can
-            # diverge. NARROW by design: only compile/lowering-shaped
-            # errors, and only at (width, program-variant) combinations
-            # that have never succeeded — a transient device error during
-            # steady-state serving propagates to the engine loop instead
-            # of permanently demoting the fast path (ADVICE r5).
-            key = (
-                int(np.asarray(block_tables).shape[1]),
-                bool(want_logprobs),
-                procs is not None,
+    # -- device-resident decode slot state ---------------------------------
+
+    def _log_transfer(self, kind: str, n: int) -> None:
+        if len(self.transfer_log) >= self._transfer_log_cap:
+            del self.transfer_log[: self._transfer_log_cap // 2]
+        self.transfer_log.append((kind, n))
+
+    def sync_slots(self, slots, rows: Dict[str, Any]) -> None:
+        """Scatter dirty slot rows into the device-resident decode state —
+        the ONLY H2D path for pos/active/sampling/processor params after
+        engine start. ``rows[k][i]`` lands at ``slot_state[k][slots[i]]``.
+        Row counts are pow2-padded (repeating row 0 — idempotent) so the
+        scatter compiles per bucket, not per count."""
+        slots = [int(s) for s in slots]
+        if not slots:
+            return
+        rows = {k: np.asarray(v) for k, v in rows.items()}
+        if set(rows) != set(self.slot_state):
+            raise ValueError(
+                f"slot sync rows {sorted(rows)} != state fields "
+                f"{sorted(self.slot_state)}"
             )
+        self._mirror("slot_sync", slots=np.asarray(slots, np.int32),
+                     rows=rows)
+        R = _next_pow2(len(slots))
+        idx = np.asarray(slots + [slots[0]] * (R - len(slots)), np.int32)
+        padded = {
+            k: np.concatenate([v, np.repeat(v[:1], R - len(slots), axis=0)])
+            if R > len(slots) else v
+            for k, v in rows.items()
+        }
+        d = self._dev
+        self.slot_state = _scatter_state_rows(
+            self.slot_state, d(idx), {k: d(v) for k, v in padded.items()}
+        )
+        self._log_transfer("slot_sync", len(slots))
+
+    def sync_tables(self, slots, rows) -> None:
+        """Scatter dirty block-table rows (full table width) into the
+        device-resident table. Called only when a slot's table actually
+        changed (admission, block append, preempt) — steady-state decode
+        ticks never re-upload tables."""
+        slots = [int(s) for s in slots]
+        if not slots:
+            return
+        rows = np.asarray(rows, np.int32)
+        self._mirror("table_sync", slots=np.asarray(slots, np.int32),
+                     rows=rows)
+        R = _next_pow2(len(slots))
+        idx = np.asarray(slots + [slots[0]] * (R - len(slots)), np.int32)
+        if R > len(slots):
+            rows = np.concatenate(
+                [rows, np.repeat(rows[:1], R - len(slots), axis=0)]
+            )
+        d = self._dev
+        self.slot_tables = _scatter_table_rows(
+            self.slot_tables, d(idx), d(rows)
+        )
+        self._log_transfer("table_sync", len(slots))
+
+    def decode_dispatch(self, nb: int, want_logprobs: bool = False,
+                        use_procs: bool = False) -> "_DecodeHandles":
+        """ENQUEUE one fused decode burst over the device-resident slot
+        state and return un-materialized result handles. No host arrays
+        are read or written: the block table is sliced on device to the
+        ``nb`` width bucket, tokens/pos come from the previous burst's
+        donated carry, and the outputs start their D2H copies
+        asynchronously. Pair with :meth:`decode_read` (leader) — followers
+        dispatch and drop the handles.
+
+        Megakernel compile-failure safety net: each (width bucket, program
+        variant) compiles lazily at its first dispatch — if Mosaic rejects
+        it on this jaxlib/chip, demote to the XLA decode path instead of
+        poisoning serving. NARROW by design: only compile/lowering-shaped
+        errors, and only at combinations that have never succeeded
+        (_mk_proven_keys, marked at first successful readback)."""
+        nb = int(nb)
+        self._mirror(
+            "decode_state", nb=nb, want_logprobs=bool(want_logprobs),
+            use_procs=bool(use_procs),
+        )
+        if self.use_megakernel:
+            key = (nb, bool(want_logprobs), bool(use_procs))
             try:
-                out = self._run_decode_inner(
-                    tokens, start_pos, active, block_tables, temp, topk,
-                    topp, adapter_ids, want_logprobs, procs,
+                return self._decode_dispatch_inner(
+                    nb, want_logprobs, use_procs, mk_key=key
                 )
-                self._mk_proven_keys.add(key)
-                return out
             except Exception as exc:
                 if (
                     key in self._mk_proven_keys
@@ -755,66 +912,129 @@ class DeviceRunner:
                     "the XLA decode path for this engine", *key,
                 )
                 self.use_megakernel = False
-                self._decode_fn = self._build_decode_fn(want_logprobs=False)
-                self._decode_fn_logprobs = self._build_decode_fn(
-                    want_logprobs=True
-                )
-                self._decode_procs_fns = {}
-        return self._run_decode_inner(
-            tokens, start_pos, active, block_tables, temp, topk, topp,
-            adapter_ids, want_logprobs, procs,
-        )
+                self._decode_state_fns = {}
+        return self._decode_dispatch_inner(nb, want_logprobs, use_procs)
 
-    def _run_decode_inner(
-        self, tokens, start_pos, active, block_tables, temp, topk, topp,
-        adapter_ids, want_logprobs=False, procs=None,
-    ):
-        self._mirror(
-            "decode", tokens=tokens, start_pos=start_pos, active=active,
-            block_tables=block_tables, temp=temp, topk=topk, topp=topp,
-            adapter_ids=adapter_ids, want_logprobs=want_logprobs,
-            procs=None if procs is None else list(procs),
-        )
-        step_id = np.int32(self.rng_step & 0x7FFFFFFF)  # int32-safe wrap
-        self.rng_step += 1
+    def _decode_dispatch_inner(self, nb, want_logprobs, use_procs,
+                               mk_key=None) -> "_DecodeHandles":
+        variant = (bool(want_logprobs), bool(use_procs))
+        fn = self._decode_state_fns.get(variant)
+        if fn is None:
+            fn = self._build_decode_fn(
+                want_logprobs=variant[0], want_procs=variant[1]
+            )
+            self._decode_state_fns[variant] = fn
+        st = self.slot_state
+        tables_nb = self.slot_tables[:, :nb]
         topv = topi = None
-        d = self._dev
-        if procs is not None:
-            fn = self._decode_procs_fns.get(want_logprobs)
-            if fn is None:
-                fn = self._build_decode_fn(want_logprobs, want_procs=True)
-                self._decode_procs_fns[want_logprobs] = fn
-            st = self.ensure_proc_state()
-            minp, rep, pres, freq, bias_ids, bias_vals = procs
+        base = (
+            self.params, self.lora, self.k_cache, self.v_cache,
+            st["tokens"], st["pos"], st["active"], tables_nb, st["salts"],
+            self.rng, st["temp"], st["topk"], st["topp"], st["adapter_ids"],
+        )
+        if use_procs:
+            ps = self.ensure_proc_state()
             out = fn(
-                self.params, self.lora, self.k_cache, self.v_cache,
-                d(tokens), d(start_pos), d(active), d(block_tables),
-                self.rng, step_id, d(temp), d(topk), d(topp), d(adapter_ids),
-                d(minp), d(rep), d(pres), d(freq),
-                d(bias_ids), d(bias_vals),
-                st.out_counts, st.prompt_mask,
+                *base, st["minp"], st["rep"], st["pres"], st["freq"],
+                st["bias_ids"], st["bias_vals"],
+                ps.out_counts, ps.prompt_mask,
             )
             from dynamo_tpu.ops import logits_process as lp
 
             if want_logprobs:
-                toks, logp, topv, topi, self.k_cache, self.v_cache, counts = out
+                (toks, logp, topv, topi, self.k_cache, self.v_cache,
+                 counts, carry_tok, carry_pos) = out
             else:
-                toks, logp, self.k_cache, self.v_cache, counts = out
+                (toks, logp, self.k_cache, self.v_cache, counts,
+                 carry_tok, carry_pos) = out
             self.proc_state = lp.ProcState(
-                out_counts=counts, prompt_mask=st.prompt_mask
+                out_counts=counts, prompt_mask=ps.prompt_mask
             )
         else:
-            fn = self._decode_fn_logprobs if want_logprobs else self._decode_fn
-            out = fn(
-                self.params, self.lora, self.k_cache, self.v_cache,
-                d(tokens), d(start_pos), d(active), d(block_tables),
-                self.rng, step_id, d(temp), d(topk), d(topp), d(adapter_ids),
-            )
+            out = fn(*base)
             if want_logprobs:
-                toks, logp, topv, topi, self.k_cache, self.v_cache = out
+                (toks, logp, topv, topi, self.k_cache, self.v_cache,
+                 carry_tok, carry_pos) = out
             else:
-                toks, logp, self.k_cache, self.v_cache = out
-        return self._get_all(toks, logp, topv, topi)
+                (toks, logp, self.k_cache, self.v_cache,
+                 carry_tok, carry_pos) = out
+        # Install the carry as the next burst's input — tokens/pos never
+        # travel through the host on the decode hot loop.
+        self.slot_state = dict(
+            self.slot_state, tokens=carry_tok, pos=carry_pos
+        )
+        self._log_transfer("decode", nb)
+        return _DecodeHandles(
+            toks=toks, logp=logp, topv=topv, topi=topi, mk_key=mk_key
+        )
+
+    def decode_read(self, handles: "_DecodeHandles"):
+        """Blocking readback half of decode_dispatch. Returns ([S, K]
+        tokens, [S, K] logprobs, top_vals | None, top_ids | None) numpy."""
+        out = self._get_all(
+            handles.toks, handles.logp, handles.topv, handles.topi
+        )
+        if handles.mk_key is not None:
+            # The megakernel program for this (width, variant) both
+            # compiled AND executed — arm propagate-don't-demote for it.
+            self._mk_proven_keys.add(handles.mk_key)
+        return out
+
+    def run_decode(
+        self, tokens, start_pos, active, block_tables, temp, topk, topp,
+        adapter_ids, want_logprobs=False, procs=None, salts=None,
+    ):
+        """Synchronous convenience form (tests, tools): seed the slot state
+        from host arrays, dispatch one burst, read it back. The serving
+        engine drives sync_slots/decode_dispatch/decode_read directly.
+        ``procs``: optional (minp, rep, pres, freq, bias_ids, bias_vals)
+        slot arrays → the processor program. Returns ([B, K] tokens,
+        [B, K] logprobs, top_vals | None, top_ids | None) as numpy."""
+        S = len(np.asarray(tokens))
+        if procs is not None:
+            minp, rep, pres, freq, bias_ids, bias_vals = procs
+        else:
+            from dynamo_tpu.ops.logits_process import MAX_BIAS_SLOTS
+
+            minp = np.zeros(S, np.float32)
+            rep = np.ones(S, np.float32)
+            pres = np.zeros(S, np.float32)
+            freq = np.zeros(S, np.float32)
+            bias_ids = np.full((S, MAX_BIAS_SLOTS), -1, np.int32)
+            bias_vals = np.zeros((S, MAX_BIAS_SLOTS), np.float32)
+        self.sync_slots(
+            list(range(S)),
+            {
+                "tokens": np.asarray(tokens, np.int32),
+                "pos": np.asarray(start_pos, np.int32),
+                "active": np.asarray(active, np.int32),
+                "temp": np.asarray(temp, np.float32),
+                "topk": np.asarray(topk, np.int32),
+                "topp": np.asarray(topp, np.float32),
+                "adapter_ids": np.asarray(adapter_ids, np.int32),
+                # arange default keeps rows' noise independent for direct
+                # callers (the engine supplies real sequence salts).
+                "salts": (
+                    np.arange(S, dtype=np.int32) if salts is None
+                    else np.asarray(salts, np.int32)
+                ),
+                "minp": np.asarray(minp, np.float32),
+                "rep": np.asarray(rep, np.float32),
+                "pres": np.asarray(pres, np.float32),
+                "freq": np.asarray(freq, np.float32),
+                "bias_ids": np.asarray(bias_ids, np.int32),
+                "bias_vals": np.asarray(bias_vals, np.float32),
+            },
+        )
+        tables = np.asarray(block_tables, np.int32)
+        nb = tables.shape[1]
+        full = np.zeros((S, self.slot_tables.shape[1]), np.int32)
+        full[:, : min(nb, full.shape[1])] = tables[:, : full.shape[1]]
+        self.sync_tables(list(range(S)), full)
+        handles = self.decode_dispatch(
+            nb, want_logprobs=want_logprobs, use_procs=procs is not None
+        )
+        return self.decode_read(handles)
 
     def run_spec(self, tokens, start_pos, chunk_lens, block_tables,
                  adapter_ids, temp=None, topk=None, topp=None):

@@ -75,6 +75,14 @@ class NgramSpecDecoder:
         dispatches)."""
         e = self.e
         args = e.args
+        # Drain the pipelined decode window first: proposals index
+        # all_tokens and the verify dispatch reads/writes host-visible
+        # pos/tables, so the spec tick must see fully-reconciled state
+        # (and must not interleave with a device burst whose carry it
+        # would invalidate). The spec dispatch itself bypasses the
+        # device-resident carry — the slots it advances are re-synced via
+        # the dirty marks below.
+        await e._drain_inflight()
         occupied = [s for s in e._slots if s is not None]
         if not occupied:
             return True
@@ -122,6 +130,9 @@ class NgramSpecDecoder:
             e._topp.copy(),
         )
         e.steps += 1
+        # The verify dispatch occupied the device: the window before the
+        # next fused-decode dispatch is not host-injected gap.
+        e._t_last_ready = None
         for seq in list(active):
             if seq.slot < 0:
                 continue  # finished by an earlier emit in this loop
@@ -134,4 +145,9 @@ class NgramSpecDecoder:
             e._emit_burst(
                 seq, emitted, np.zeros(n, dtype=np.float32),
             )
+            if seq.slot >= 0:
+                # The verify dispatch advanced this slot outside the
+                # decode carry — resync pos/tokens before the next fused
+                # decode burst reads the device-resident state.
+                e._dirty_state.add(slot)
         return True

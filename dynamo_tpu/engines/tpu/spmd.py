@@ -38,8 +38,21 @@ def follow(runner: Any, follower: SpmdFollower) -> None:
             logger.info("SPMD follower: leader closed the channel")
             return
         try:
-            if op == "decode":
-                runner.run_decode(**args)
+            if op == "decode_state":
+                # Dispatch only — the leader owns the readback (reap). The
+                # follower's state carry (tokens/pos) advances inside the
+                # dispatch, so the dispatch/reap split stays lockstep: both
+                # processes issue the identical program from identical
+                # device state, and the follower never blocks on results.
+                runner.decode_dispatch(
+                    int(args["nb"]),
+                    want_logprobs=bool(args["want_logprobs"]),
+                    use_procs=bool(args["use_procs"]),
+                )
+            elif op == "slot_sync":
+                runner.sync_slots(list(args["slots"]), dict(args["rows"]))
+            elif op == "table_sync":
+                runner.sync_tables(list(args["slots"]), args["rows"])
             elif op == "step":
                 runner.run_step(**args)
             elif op == "spec":

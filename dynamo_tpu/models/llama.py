@@ -492,12 +492,20 @@ def forward_paged(
             )
             x2 = x[:, 0]
             cos1, sin1 = cos[:, 0], sin[:, 0]
+            # Per-row history page counts (the kernel's scalar-prefetch
+            # loop bound): one derivation per STEP, shared by every layer,
+            # instead of recomputing from start_pos inside each layer call.
+            from dynamo_tpu.ops.pallas.fused_layer import history_pcounts
+
+            pcounts = history_pcounts(
+                start_pos, k_cache[0].shape[1], block_tables.shape[1]
+            )
             k_out, v_out = [], []
             for l in range(c.n_layers):
                 x2, k_n, v_n = fused_decoder_layer(
                     x2, cos1, sin1, params["layers"][l],
                     k_cache[l], v_cache[l], block_tables, start_pos,
-                    eps=c.rms_norm_eps, sm_scale=sm,
+                    eps=c.rms_norm_eps, sm_scale=sm, pcounts=pcounts,
                 )
                 k_out.append(
                     write_chunk_to_cache(
@@ -680,6 +688,8 @@ def decode_multi(
     proc_params: Optional[Any] = None,  # logits_process.ProcParams
     proc_state: Optional[Any] = None,  # logits_process.ProcState
     num_top_logprobs: int = 0,  # >0 → also return top-N alternatives/step
+    salts: Optional[jnp.ndarray] = None,  # [B] per-sequence sampling salt
+    want_carry: bool = False,  # also return the device-resident carry
 ) -> Tuple[jnp.ndarray, ...]:
     """``num_steps`` fused decode iterations in ONE dispatch (lax.scan over
     single-token forward+sample steps). Minimizes host↔device round trips —
@@ -692,15 +702,27 @@ def decode_multi(
     penalties/bias are applied before sampling and generated-token counts
     are carried through the scan.
 
+    RNG: with ``salts`` the per-step sampling key for row b is derived from
+    (rng, salts[b], position-of-sampled-token) — see
+    ops/sampling.fold_row_keys. Noise then depends only on (seed, sequence,
+    token index), never on dispatch order, which is the determinism
+    contract the pipelined decode scheduler relies on. Without salts the
+    legacy per-dispatch split keys are used (profiling scripts).
+
     Returns (tokens [B, num_steps], logprobs [B, num_steps], k_cache,
-    v_cache[, proc_state]). With ``num_top_logprobs`` = N > 0 the tuple
-    gains (top_vals [B, num_steps, N], top_ids [B, num_steps, N]) right
-    after the logprobs entry — the per-step top-N alternatives that back
-    the OpenAI ``top_logprobs`` surface.
+    v_cache[, proc_state][, carry_tokens [B], carry_pos [B]]). With
+    ``num_top_logprobs`` = N > 0 the tuple gains (top_vals
+    [B, num_steps, N], top_ids [B, num_steps, N]) right after the logprobs
+    entry — the per-step top-N alternatives that back the OpenAI
+    ``top_logprobs`` surface. With ``want_carry`` the final carry (last
+    sampled token and advanced position per row) comes last — device
+    arrays the runner feeds straight into the next burst without a host
+    round trip.
     """
     from dynamo_tpu.ops import logits_process as lp
     from dynamo_tpu.ops.sampling import (
         compute_logprobs,
+        fold_row_keys,
         sample_tokens,
         top_logprobs as top_logprobs_op,
     )
@@ -718,7 +740,21 @@ def decode_multi(
         )
         if proc_params is not None:
             logits = lp.apply(logits, proc_params, st)
-        nxt = sample_tokens(logits, step_rng, temperature, top_k, top_p, min_p)
+        if salts is not None:
+            # The sampled token's index is pos + 1 (pos counts the tokens
+            # before the current input token; the input occupies index pos)
+            # — the same index the prefill program folds for the first
+            # generated token, so preemption-by-recompute redraws
+            # identical noise.
+            row_keys = fold_row_keys(rng, salts, pos + 1)
+            nxt = sample_tokens(
+                logits, None, temperature, top_k, top_p, min_p,
+                row_keys=row_keys,
+            )
+        else:
+            nxt = sample_tokens(
+                logits, step_rng, temperature, top_k, top_p, min_p
+            )
         nxt = jnp.where(active > 0, nxt, toks)
         if want_logprobs:
             logp = compute_logprobs(logits, nxt)
@@ -737,14 +773,15 @@ def decode_multi(
             return (nxt, pos, k_c, v_c, st), ys
         return (nxt, pos, k_c, v_c), ys
 
-    rngs = jax.random.split(rng, num_steps)
+    xs = None if salts is not None else jax.random.split(rng, num_steps)
     if proc_state is not None:
-        (_, _, k_cache, v_cache, proc_state), ys = jax.lax.scan(
-            one, (tokens, start_pos, k_cache, v_cache, proc_state), rngs
+        (fin_toks, fin_pos, k_cache, v_cache, proc_state), ys = jax.lax.scan(
+            one, (tokens, start_pos, k_cache, v_cache, proc_state), xs,
+            length=num_steps,
         )
     else:
-        (_, _, k_cache, v_cache), ys = jax.lax.scan(
-            one, (tokens, start_pos, k_cache, v_cache), rngs
+        (fin_toks, fin_pos, k_cache, v_cache), ys = jax.lax.scan(
+            one, (tokens, start_pos, k_cache, v_cache), xs, length=num_steps
         )
     toks, logps = ys[0], ys[1]
     out: Tuple[jnp.ndarray, ...] = (toks.T, logps.T)
@@ -754,4 +791,6 @@ def decode_multi(
     out = out + (k_cache, v_cache)
     if proc_state is not None:
         out = out + (proc_state,)
+    if want_carry:
+        out = out + (fin_toks, fin_pos)
     return out

@@ -503,6 +503,18 @@ def _fused_layer_kernel(
     )
 
 
+def history_pcounts(
+    start_pos: jnp.ndarray, block_size: int, table_width: int
+) -> jnp.ndarray:
+    """Per-row history page count for the decode megakernel's dynamic page
+    loop, clamped to the table width so a row can never index past its
+    table (the causal mask already hides any positions beyond it). Exposed
+    so the per-step caller (models/llama.py forward_paged) derives it ONCE
+    and shares it across all layers instead of recomputing per layer."""
+    start32 = start_pos.astype(jnp.int32)
+    return jnp.minimum((start32 + block_size - 1) // block_size, table_width)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("eps", "sm_scale", "batch_block", "interpret"),
@@ -521,15 +533,17 @@ def fused_decoder_layer(
     sm_scale: float,
     batch_block: int = 4,
     interpret: Optional[bool] = None,
+    pcounts: Optional[jnp.ndarray] = None,  # [B] int32 (history_pcounts)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run one fused decoder layer. Returns (x_out [B, d], k_new [B, KH, D],
     v_new [B, KH, D]); the caller scatters k_new/v_new into the pools
     (ops/attention.write_chunk_to_cache) AFTER the call — the kernel
     attends to history pages plus the in-register current token. Rows
     whose history is shorter than the table width skip their dead pages
-    via the scalar-prefetched per-row page counts; the table width P may
-    be anything (one compiled program per distinct P — callers should
-    bucket widths, see engines/tpu/engine.py::table_width_bucket)."""
+    via the scalar-prefetched per-row page counts (``pcounts``, derived
+    per step via :func:`history_pcounts` when not supplied); the table
+    width P may be anything (one compiled program per distinct P — callers
+    should bucket widths, see engines/tpu/engine.py::table_width_bucket)."""
     if interpret is None:
         # CPU (tests, dryruns): Mosaic doesn't lower there — emulate.
         interpret = jax.default_backend() != "tpu"
@@ -562,9 +576,9 @@ def fused_decoder_layer(
     start32 = start_pos.astype(jnp.int32)
     # Per-row history page count: the scalar-prefetch operand that bounds
     # the kernel's dynamic page loop and gates every page DMA per row.
-    # Clamped to the table width so a row can never index past its table
-    # (the causal mask already hides any positions beyond it).
-    pcounts = jnp.minimum((start32 + BS - 1) // BS, P)
+    if pcounts is None:
+        pcounts = history_pcounts(start32, BS, P)
+    pcounts = pcounts.astype(jnp.int32)
 
     out = pl.pallas_call(
         kernel,

@@ -21,15 +21,40 @@ NEG_INF = -1e30
 SAMPLE_WIDTH = 64  # candidates considered by top-k/top-p filtering
 
 
+def fold_row_keys(
+    rng: jax.Array,  # single base PRNG key
+    salts: jnp.ndarray,  # [B] int — per-sequence salt (admission order)
+    positions: jnp.ndarray,  # [B] int — index of the token being sampled
+) -> jax.Array:
+    """Per-row sampling keys: fold (sequence salt, token index) into the
+    engine's base key. This makes the sampling noise for a given token a
+    pure function of (engine seed, sequence, position) — independent of
+    dispatch count or batch composition — which is what lets the pipelined
+    decode path (engines/tpu/engine.py) speculatively dispatch burst N+1
+    before burst N's stop conditions are known, and lets preemption-by-
+    recompute regenerate an identical continuation."""
+    def one(s, p):
+        return jax.random.fold_in(jax.random.fold_in(rng, s), p)
+
+    return jax.vmap(one)(
+        salts.astype(jnp.uint32), positions.astype(jnp.uint32)
+    )
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] float
-    rng: jax.Array,  # single PRNG key
+    rng: jax.Array,  # single PRNG key (ignored when row_keys given)
     temperature: jnp.ndarray,  # [B] float; <=0 means greedy
     top_k: jnp.ndarray,  # [B] int; <=0 means off
     top_p: jnp.ndarray,  # [B] float; >=1 means off
     min_p: jnp.ndarray = None,  # [B] float; <=0/None means off
+    row_keys: jax.Array = None,  # [B] per-row keys (fold_row_keys)
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B]. Fully vectorized, static shapes."""
+    """Returns sampled token ids [B]. Fully vectorized, static shapes.
+
+    With ``row_keys``, each row draws its gumbel noise from its own key so
+    the sample depends only on that row's (key, logits, params) — batch
+    layout and the other rows' state cannot perturb it."""
     B, V = logits.shape
     W = min(SAMPLE_WIDTH, V)
 
@@ -69,7 +94,12 @@ def sample_tokens(
         keep_mp = probs >= jnp.clip(min_p, 0.0, 1.0)[:, None] * probs[:, :1]
         keep = keep & keep_mp
     masked = jnp.where(keep, top_logits, NEG_INF)
-    gumbel = jax.random.gumbel(rng, (B, W), dtype=jnp.float32)
+    if row_keys is not None:
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (W,), dtype=jnp.float32)
+        )(row_keys)
+    else:
+        gumbel = jax.random.gumbel(rng, (B, W), dtype=jnp.float32)
     choice_rank = jnp.argmax(masked + gumbel, axis=-1)  # [B]
     sampled = jnp.take_along_axis(top_idx, choice_rank[:, None], axis=-1)[:, 0]
 

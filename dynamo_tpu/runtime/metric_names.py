@@ -41,12 +41,21 @@ ENGINE_DECODE_STEPS = engine_gauge("decode_steps")
 ENGINE_PREFILL_TOKENS = engine_gauge("prefill_tokens")
 ENGINE_GENERATED_TOKENS = engine_gauge("generated_tokens")
 ENGINE_SLEEP_LEVEL = engine_gauge("sleep_level")
+ENGINE_PIPELINE_DEPTH = engine_gauge("pipeline_depth")
+ENGINE_INFLIGHT_BURSTS = engine_gauge("inflight_bursts")
+ENGINE_PREEMPTIONS = engine_gauge("preemptions")
 
 # -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
 ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
 ENGINE_BATCH_OCCUPANCY = f"{ENGINE_PREFIX}_batch_occupancy"
 ENGINE_STEP_PREFILL_TOKENS = f"{ENGINE_PREFIX}_prefill_tokens_per_step"
 ENGINE_STEP_DECODE_TOKENS = f"{ENGINE_PREFIX}_decode_tokens_per_step"
+# Decode-tick pipelining (engines/tpu/engine.py dispatch/reap split):
+# host_gap = device wait injected by the host between a burst's readback
+# completing and the next dispatch (0 when another burst was already in
+# flight); inflight_depth = bursts in flight at each dispatch.
+ENGINE_HOST_GAP = f"{ENGINE_PREFIX}_host_gap_seconds"
+ENGINE_INFLIGHT_DEPTH = f"{ENGINE_PREFIX}_inflight_depth"
 
 # -- router (router/router.py KvRouter + router/scheduler.py) ----------------
 ROUTER_PREFIX = "dynamo_tpu_router"
@@ -131,8 +140,13 @@ ALL_ENGINE = (
     ENGINE_PREFILL_TOKENS,
     ENGINE_GENERATED_TOKENS,
     ENGINE_SLEEP_LEVEL,
+    ENGINE_PIPELINE_DEPTH,
+    ENGINE_INFLIGHT_BURSTS,
+    ENGINE_PREEMPTIONS,
     ENGINE_STEP_DURATION,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_STEP_PREFILL_TOKENS,
     ENGINE_STEP_DECODE_TOKENS,
+    ENGINE_HOST_GAP,
+    ENGINE_INFLIGHT_DEPTH,
 )

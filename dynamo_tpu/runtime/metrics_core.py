@@ -192,6 +192,17 @@ class Histogram(_Metric):
     def count(self, **labels: object) -> int:
         return sum(self._counts.get(self._key(labels), ()))
 
+    def snapshot_total(self, **labels: object) -> Tuple[int, float]:
+        """(observation count, value sum) for one label key — the cheap
+        aggregate programmatic consumers (bench.py host-gap reporting)
+        read without parsing the rendered exposition."""
+        key = self._key(labels)
+        with self._lock:
+            return (
+                sum(self._counts.get(key, ())),
+                self._sums.get(key, 0.0),
+            )
+
     def render(self, openmetrics: bool = False) -> List[str]:
         lines = [
             f"# HELP {self.name} {self.help}",

@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--decode-steps", type=int, default=8,
                         help="fused decode iterations per device dispatch")
+    parser.add_argument("--pipeline-depth", type=int, default=2,
+                        help="decode bursts in flight on the device (2 = "
+                        "double-buffered dispatch/reap, 1 = synchronous; "
+                        "docs/design_docs/decode_pipelining.md)")
     parser.add_argument("--lora-dir", default=None,
                         help="directory of PEFT LoRA adapters to serve "
                         "(ref: lib/llm/src/lora.rs)")
@@ -223,6 +227,7 @@ async def main() -> None:
         prefill_chunk=args.prefill_chunk,
         enable_prefix_caching=not args.no_prefix_caching,
         decode_steps=args.decode_steps,
+        pipeline_depth=args.pipeline_depth,
         lora_dir=args.lora_dir,
         spec_mode=args.speculative,
         spec_k=args.spec_k,

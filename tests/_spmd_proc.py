@@ -47,6 +47,10 @@ mesh = make_mesh(MeshConfig(tp=8), jax.devices())
 args = JaxEngineArgs(
     config=cfg, block_size=4, num_kv_blocks=32, max_num_seqs=2,
     max_model_len=64, decode_steps=4, prefill_chunk=16, seed=7,
+    # Default 2 exercises the pipelined dispatch/reap split over the SPMD
+    # mirror channel (slot_sync / table_sync / decode_state ops); the test
+    # can pin 1 to compare depths.
+    pipeline_depth=int(os.environ.get("SPMD_PIPELINE_DEPTH", "2")),
 )
 runner = DeviceRunner(args, mesh=mesh, topology=topo)
 

@@ -616,9 +616,10 @@ async def test_scaling_adapter_drives_gd_replicas():
         assert gd["spec"]["services"]["backend"]["replicas"] == 3
         assert op.adapter_scales == 1
         sa = fake.store[(SA_PLURAL, "demo-backend")]
-        # status.replicas reports OBSERVED capacity (pre-scale spec here:
-        # no GD ready status yet), never the just-written desired count.
-        assert sa["status"]["replicas"] == 1
+        # status.replicas reports OBSERVED readiness only: no GD ready
+        # status exists yet, so the adapter reports 0 — never the desired
+        # spec (which this reconcile just wrote: phantom capacity).
+        assert sa["status"]["replicas"] == 0
         assert sa["status"]["selector"] == "dynamo-tpu.io/deployment=demo"
         assert sa["status"].get("lastScaleTime")
 
@@ -841,6 +842,136 @@ async def test_leader_election_single_winner_and_takeover():
         await b.stop()
         await c1.close()
         await c2.close()
+        await runner.cleanup()
+
+
+async def test_leader_election_clock_skew_cannot_steal_live_lease():
+    """A live holder whose clock is skewed far into the past keeps its
+    lease: staleness is judged by the LOCAL observation timer (renewTime
+    unchanged for a full lease duration), never by comparing our wall
+    clock against the remote timestamp (client-go semantics). Once the
+    holder actually stops renewing, the candidate takes over."""
+    import time as _time
+
+    from dynamo_tpu.deploy import leader as leader_mod
+    from dynamo_tpu.deploy.leader import LeaderElector
+
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    c1, c2 = KubeClient(url), KubeClient(url)
+    holder = LeaderElector(c1, identity="op-skewed", lease_duration_s=0.6)
+    cand = LeaderElector(c2, identity="op-candidate", lease_duration_s=0.6)
+    real_now = leader_mod._now_rfc3339
+    try:
+        # The holder writes renewTimes 10 s in the past (skewed clock) but
+        # RENEWS on every tick — the lease is live.
+        def skewed_now():
+            t = _time.time() - 10.0
+            base = _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime(t))
+            return f"{base}.{int((t % 1) * 1e6):06d}Z"
+
+        leader_mod._now_rfc3339 = skewed_now
+        assert await holder.try_acquire_once()
+        leader_mod._now_rfc3339 = real_now
+
+        # Candidate polls across > lease_duration while the holder keeps
+        # renewing: by wall-clock age the lease looks 10 s stale on every
+        # read, but the observed renewTime keeps CHANGING, so the
+        # candidate must never steal it.
+        for _ in range(4):
+            leader_mod._now_rfc3339 = skewed_now
+            assert await holder.try_acquire_once()  # renew (skewed stamp)
+            leader_mod._now_rfc3339 = real_now
+            assert not await cand.try_acquire_once(), (
+                "candidate stole a live (skew-stamped) lease"
+            )
+            await asyncio.sleep(0.25)
+
+        # Holder crashes (stops renewing): after the lease duration of
+        # UNCHANGED observation the candidate legitimately takes over.
+        assert not await cand.try_acquire_once()  # restart observation
+        await asyncio.sleep(0.8)
+        assert await cand.try_acquire_once()
+        assert cand.is_leader
+    finally:
+        leader_mod._now_rfc3339 = real_now
+        await holder.stop()
+        await cand.stop()
+        await c1.close()
+        await c2.close()
+        await runner.cleanup()
+
+
+async def test_leader_graceful_release_requires_holder_precondition():
+    """stop()'s graceful release must re-check the holder: if a peer took
+    the lease over after our last renew, our release patch must become a
+    no-op instead of wiping the peer's claim."""
+    from dynamo_tpu.deploy.leader import PLURAL as LEASE_PLURAL
+    from dynamo_tpu.deploy.leader import LeaderElector
+
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    c1 = KubeClient(url)
+    a = LeaderElector(c1, identity="op-a", lease_duration_s=1.0)
+    try:
+        assert await a.try_acquire_once()
+        # A peer steals the lease behind a's back (e.g. a's renews stalled
+        # past the deadline and op-b legitimately took over).
+        lease = fake.store[(LEASE_PLURAL, a.name)]
+        lease["spec"]["holderIdentity"] = "op-b"
+        fake.bump(lease)
+
+        await a.stop()
+        spec = fake.store[(LEASE_PLURAL, a.name)]["spec"]
+        assert spec["holderIdentity"] == "op-b", (
+            "graceful release clobbered a peer's live claim"
+        )
+    finally:
+        await a.stop()
+        await c1.close()
+        await runner.cleanup()
+
+
+async def test_adapter_reports_zero_not_phantom_capacity_before_ready():
+    """Before the GD publishes a ready count, repeated adapter reconciles
+    must keep reporting 0 (or the last KNOWN ready count) — never the
+    just-patched desired spec, which would feed an HPA phantom capacity."""
+    fake = FakeApiServer()
+    runner, url = await _start_fake(fake)
+    client = KubeClient(url)
+    op = K8sGraphOperator(client, watch_timeout_s=1.0)
+    try:
+        fake.apply(GD_PLURAL, "ph", gd_spec(1))
+        fake.apply(SA_PLURAL, "ph-backend", {
+            "replicas": 3,
+            "dgdRef": {"name": "ph", "serviceName": "backend"},
+        })
+        # First pass writes desired=3 into the GD spec...
+        await op.reconcile_adapters_once()
+        assert fake.store[(GD_PLURAL, "ph")]["spec"]["services"]["backend"][
+            "replicas"] == 3
+        assert fake.store[(SA_PLURAL, "ph-backend")]["status"]["replicas"] == 0
+        # ...and a SECOND pass (spec now == desired, still nothing ready)
+        # is exactly where the old fallback echoed the desired count.
+        await op.reconcile_adapters_once()
+        assert fake.store[(SA_PLURAL, "ph-backend")]["status"]["replicas"] == 0
+
+        # Partial readiness flows through as-is...
+        gd = fake.store[(GD_PLURAL, "ph")]
+        gd.setdefault("status", {})["services"] = {"backend": {"ready": 2}}
+        fake.bump(gd)
+        await op.reconcile_adapters_once()
+        assert fake.store[(SA_PLURAL, "ph-backend")]["status"]["replicas"] == 2
+
+        # ...and if the ready count disappears (status rebuild), the
+        # adapter holds the last KNOWN ready count rather than the spec.
+        gd = fake.store[(GD_PLURAL, "ph")]
+        gd["status"]["services"] = {}
+        fake.bump(gd)
+        await op.reconcile_adapters_once()
+        assert fake.store[(SA_PLURAL, "ph-backend")]["status"]["replicas"] == 2
+    finally:
+        await op.stop()
         await runner.cleanup()
 
 
