@@ -82,6 +82,13 @@ async def run_depth(depth: int):
         wall = time.monotonic() - t0
         bursts = max(engine.steps - steps0, 1)
         g1, s1 = engine.step_metrics.host_gap_stats()
+        # Micro-time the per-reap stats-snapshot publish on THIS engine
+        # (real slot count, real pool) for the observability-overhead
+        # accounting below.
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            engine._publish_stats()
+        stats_publish_us = (time.perf_counter() - t0) / 1000 * 1e6
         return {
             "pipeline_depth": depth,
             "tokens": toks,
@@ -92,9 +99,66 @@ async def run_depth(depth: int):
                 1000 * (s1 - s0) / max(g1 - g0, 1), 3
             ),
             "toks_per_s": round(toks / wall, 1),
+            "stats_publish_us": round(stats_publish_us, 3),
         }
     finally:
         await engine.stop()
+
+
+def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
+    """Measure the device-plane observability cost a steady-state decode
+    burst actually pays, by micro-timing the exact hot-path operations:
+
+      - 1 watched_jit cache-hit dispatch wrapper (2 _cache_size C calls +
+        2 perf_counter reads) per burst,
+      - ~4 flight-recorder appends per burst (engine dispatch + reap,
+        runner decode + its transfer_log mirror),
+      - 1 stats-snapshot publish per reap (``stats_publish_us``, measured
+        against the run's real engine in run_depth).
+
+    Everything else (HBM ledger, metric rendering, compile bookkeeping)
+    runs at scrape/compile time, off the tick path. The acceptance bar is
+    overhead < 1% of the measured steady-state burst wall time."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.runtime.device_observe import FlightRecorder, watched_jit
+
+    N = 20_000
+    # watched wrapper delta: wrapped vs raw cache-hit dispatch of the same
+    # trivial compiled program (device work subtracts out).
+    raw = jax.jit(lambda x: x)
+    wrapped = watched_jit("prof.overhead_probe", jax.jit(lambda x: x))
+    x = jnp.zeros(8)
+    raw(x), wrapped(x)  # compile both outside the timed window
+    t0 = _time.perf_counter()
+    for _ in range(N):
+        raw(x)
+    t_raw = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    for _ in range(N):
+        wrapped(x)
+    t_wrapped = _time.perf_counter() - t0
+    watch_us = max(0.0, (t_wrapped - t_raw) / N * 1e6)
+
+    fr = FlightRecorder("prof")
+    t0 = _time.perf_counter()
+    for i in range(N):
+        fr.record("dispatch", nb=8, occupancy=4, inflight=2)
+    record_us = (_time.perf_counter() - t0) / N * 1e6
+
+    per_burst_us = watch_us + 4 * record_us + stats_publish_us
+    return {
+        "watched_dispatch_us": round(watch_us, 3),
+        "flight_record_us": round(record_us, 3),
+        "stats_publish_us": round(stats_publish_us, 3),
+        "per_burst_us": round(per_burst_us, 3),
+        "overhead_pct_of_burst": round(
+            100 * per_burst_us / 1000 / max(wall_per_burst_ms, 1e-9), 4
+        ),
+    }
 
 
 async def main():
@@ -102,7 +166,10 @@ async def main():
     out = {"backend": None, "runs": []}
     import jax
 
+    from dynamo_tpu.runtime.device_observe import global_compile_watcher
+
     out["backend"] = jax.default_backend()
+    compile_before = global_compile_watcher().totals()
     for _ in range(rounds):
         d1 = await run_depth(1)
         d2 = await run_depth(2)
@@ -111,12 +178,29 @@ async def main():
         )
         out["runs"].append({"depth1": d1, "depth2": d2})
     r = out["runs"][-1]
+    compile_after = global_compile_watcher().totals()
+    out["compile"] = {
+        "programs": compile_after["programs"],
+        "compiles": compile_after["compiles"] - compile_before["compiles"],
+        "compile_s": round(
+            compile_after["compile_seconds"]
+            - compile_before["compile_seconds"], 2
+        ),
+        "storms": compile_after["storms"] - compile_before["storms"],
+    }
+    out["observe_overhead"] = observe_overhead(
+        r["depth2"]["wall_per_burst_ms"],
+        r["depth2"]["stats_publish_us"],
+    )
     out["summary"] = {
         "host_gap_ms_d1": r["depth1"]["host_gap_ms"],
         "host_gap_ms_d2": r["depth2"]["host_gap_ms"],
         "wall_per_burst_ms_d1": r["depth1"]["wall_per_burst_ms"],
         "wall_per_burst_ms_d2": r["depth2"]["wall_per_burst_ms"],
         "overlap_win_ms_per_burst": r["depth1"]["hidden_host_ms_per_burst"],
+        "observe_overhead_pct": out["observe_overhead"][
+            "overhead_pct_of_burst"
+        ],
     }
     print(json.dumps(out))
 

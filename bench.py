@@ -140,6 +140,11 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         qwen3_8b_config,
     )
     from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.device_observe import global_compile_watcher
+
+    # Per-leg compile deltas: the watcher is process-global, so snapshot
+    # BEFORE the leg's engine exists (its programs compile during warmup).
+    compile_before = global_compile_watcher().totals()
 
     cfg = {
         "qwen2.5-0.5b": qwen2_500m_config,
@@ -249,6 +254,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         print(f"[{model_name}] warmup wave...", flush=True)
     t0 = time.monotonic()
     await run_wave(concurrency, offset=10_000)
+    engine.hbm.snapshot()  # sample the post-warmup ledger (peak tracking)
     if VERBOSE:
         print(f"[{model_name}] warmup done in {time.monotonic()-t0:.1f}s; "
               f"stats={engine.stats()}", flush=True)
@@ -258,6 +264,20 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     wall = time.monotonic() - t0
     await engine.stop()
     stats = engine.stats()
+    # Device-plane regressions this leg: compile time/program count (a
+    # recompile storm shows up as compile_s exploding while tok/s sags)
+    # and the HBM ledger's footprint (accounting drift / unplanned growth).
+    hbm_bytes = engine.hbm.total_bytes()
+    hbm_peak_bytes = engine.hbm.peak_bytes
+    compile_after = global_compile_watcher().totals()
+    compile_s = round(
+        compile_after["compile_seconds"] - compile_before["compile_seconds"], 2
+    )
+    compiles = compile_after["compiles"] - compile_before["compiles"]
+    # Process-CUMULATIVE distinct watched sites (program names are reused
+    # across legs, so a per-leg delta would read ~0 after leg 1).
+    compiled_programs = compile_after["programs"]
+    recompile_storms = compile_after["storms"] - compile_before["storms"]
     # Host-gap aggregate: mean host-injected device wait per decode
     # dispatch (0 when the next burst was already in flight) — the number
     # the pipeline_depth knob exists to shrink.
@@ -302,6 +322,15 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         "p50_itl_ms": round(1000 * itls[len(itls) // 2], 2),
         "pipeline_depth": stats.get("pipeline_depth"),
         "host_gap_ms": host_gap_ms,
+        "compile_s": compile_s,
+        # compiles = this leg's compilation events (signatures);
+        # compiled_programs = process-cumulative distinct watched sites;
+        # recompile_storms = this leg's budget violations.
+        "compiles": compiles,
+        "compiled_programs": compiled_programs,
+        "recompile_storms": recompile_storms,
+        "hbm_ledger_bytes": hbm_bytes,
+        "hbm_ledger_peak_bytes": hbm_peak_bytes,
         "anchor_toks_per_sec": round(
             _anchor_toks_per_sec(cfg, concurrency, avg_ctx, quant), 1
         ),
@@ -662,6 +691,14 @@ async def run_bench():
         "p50_itl_ms": primary["p50_itl_ms"],
         "pipeline_depth": primary["pipeline_depth"],
         "host_gap_ms": primary["host_gap_ms"],
+        # Device-plane trajectory (ISSUE 4): compile + memory regressions
+        # are perf regressions the tok/s headline can hide for one run.
+        "compile_s": primary["compile_s"],
+        "compiles": primary["compiles"],
+        "compiled_programs": primary["compiled_programs"],
+        "recompile_storms": primary["recompile_storms"],
+        "hbm_ledger_bytes": primary["hbm_ledger_bytes"],
+        "hbm_ledger_peak_bytes": primary["hbm_ledger_peak_bytes"],
         "mfu": primary["mfu"],
         "hbm_util": primary["hbm_util"],
         "n_chips": jax.device_count(),

@@ -12,7 +12,12 @@ import asyncio
 import sys
 
 from dynamo_tpu import config
-from dynamo_tpu.cli.run import add_run_args, main_run
+from dynamo_tpu.cli.run import (
+    add_observe_args,
+    add_run_args,
+    main_observe,
+    main_run,
+)
 
 # One source of truth for service kinds (deploy specs use the same table);
 # the CLI adds hyphen aliases and the deploy controller itself.
@@ -59,6 +64,12 @@ def main(argv=None) -> None:
     sub = parser.add_subparsers(dest="command", required=True)
     run_p = sub.add_parser("run", help="drive a local engine (text/stdin/batch/http)")
     add_run_args(run_p)
+    observe_p = sub.add_parser(
+        "observe",
+        help="snapshot a running worker's device plane "
+        "(/debug/memory /debug/compiles /debug/flight)",
+    )
+    add_observe_args(observe_p)
     sub.add_parser("env", help="print the environment-variable registry")
     args = parser.parse_args(argv)
 
@@ -66,6 +77,8 @@ def main(argv=None) -> None:
         cmd_env()
     elif args.command == "run":
         asyncio.run(main_run(args))
+    elif args.command == "observe":
+        asyncio.run(main_observe(args))
 
 
 if __name__ == "__main__":

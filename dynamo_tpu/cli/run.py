@@ -194,6 +194,115 @@ async def run_http(pipeline, card: ModelDeploymentCard, args) -> None:
         await service.stop(grace_period=5)
 
 
+# -- observe: device-plane snapshot of a running worker ----------------------
+
+
+def add_observe_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="system-server host of the running worker")
+    parser.add_argument("--port", type=int, default=None,
+                        help="system-server port (default: DYN_TPU_SYSTEM_PORT)")
+    parser.add_argument("--flight-limit", type=int, default=24,
+                        help="newest flight-recorder events to show")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw endpoint JSON instead of tables")
+
+
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "?"
+    # Negative values are meaningful (unaccounted_bytes < 0 = the ledger
+    # overcounts the allocator) — keep the sign visible.
+    sign, n = ("-", -n) if n < 0 else ("", n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return (
+                f"{sign}{int(n)} B" if unit == "B" else f"{sign}{n:.1f} {unit}"
+            )
+        n /= 1024
+    return f"{sign}{n:.1f} TiB"
+
+
+async def main_observe(args) -> None:
+    """One-shot pretty snapshot of /debug/memory, /debug/compiles and
+    /debug/flight from a running worker's system server — the operator's
+    'what is the device plane doing right now' view without curl + jq."""
+    import aiohttp
+
+    from dynamo_tpu import config
+
+    port = args.port if args.port is not None else config.SYSTEM_PORT.get()
+    base = f"http://{args.host}:{port}"
+    async with aiohttp.ClientSession() as session:
+        async def get(path):
+            async with session.get(base + path) as r:
+                if r.status != 200:
+                    raise SystemExit(
+                        f"GET {base}{path} -> {r.status}: {await r.text()}"
+                    )
+                return await r.json()
+
+        try:
+            memory = await get("/debug/memory")
+            compiles = await get("/debug/compiles")
+            flight = await get(f"/debug/flight?limit={args.flight_limit}")
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"cannot reach system server at {base}: {exc}")
+
+    if args.json:
+        print(json.dumps(
+            {"memory": memory, "compiles": compiles, "flight": flight},
+            indent=2,
+        ))
+        return
+
+    print(f"== device memory ({base}/debug/memory)")
+    for source, cats in (memory.get("sources") or {}).items():
+        print(f"  [{source}]")
+        for category, nbytes in sorted(cats.items()):
+            print(f"    {category:<16} {_fmt_bytes(nbytes):>12}")
+    print(f"  ledger total       {_fmt_bytes(memory.get('ledger_total_bytes')):>12}")
+    if "device_bytes_in_use" in memory:
+        print(f"  device in use      {_fmt_bytes(memory['device_bytes_in_use']):>12}")
+        print(f"  unaccounted        {_fmt_bytes(memory['unaccounted_bytes']):>12}")
+    hwc = memory.get("host_weight_cache") or {}
+    for tier, usage in hwc.items():
+        print(
+            f"  weight cache {tier:<5} {_fmt_bytes(usage.get('bytes')):>12}"
+            f"  ({usage.get('entries', 0)} entries)"
+        )
+
+    print(f"\n== compiled programs ({base}/debug/compiles)")
+    header = f"  {'program':<32} {'compiles':>8} {'sigs':>6} {'storms':>6} {'seconds':>9}"
+    print(header)
+    for name, st in (compiles.get("programs") or {}).items():
+        print(
+            f"  {name:<32} {st['compiles']:>8} {st['signatures']:>6} "
+            f"{st['storms']:>6} {st['compile_seconds']:>9.2f}"
+        )
+    totals = compiles.get("totals") or {}
+    print(
+        f"  {'TOTAL':<32} {totals.get('compiles', 0):>8} "
+        f"{totals.get('signatures', 0):>6} {totals.get('storms', 0):>6} "
+        f"{totals.get('compile_seconds', 0.0):>9.2f}"
+    )
+
+    print(f"\n== flight recorder (newest {args.flight_limit}; {base}/debug/flight)")
+    events = flight.get("events") or []
+    if not events:
+        print("  (no events)")
+    for ev in events:
+        extras = {
+            k: v for k, v in ev.items()
+            if k not in ("seq", "t_mono", "ring", "kind")
+        }
+        detail = " ".join(f"{k}={v}" for k, v in extras.items())
+        print(
+            f"  {ev.get('t_mono', 0):>14.3f} {ev.get('ring', '?'):<7} "
+            f"{ev.get('kind', '?'):<12} {detail}"
+        )
+
+
 async def main_run(args) -> None:
     configure_logging()
     from dynamo_tpu.llm.entrypoint import build_local_pipeline

@@ -94,6 +94,11 @@ KV_QUANT_AUTO_CTX = env_int(
     "ctx<=160 from scale DMAs, wins beyond a few hundred tokens and "
     "doubles pool capacity)",
 )
+FLIGHT_DUMP_DIR = env_str(
+    "DYN_TPU_FLIGHT_DUMP_DIR", "",
+    "Directory for engine flight-recorder JSON dumps on tick abort "
+    "(empty = system temp dir)",
+)
 LOG_LEVEL = env_str("DYN_TPU_LOG", "info", "Log level (trace|debug|info|warn|error)")
 LOG_JSON = env_bool("DYN_TPU_LOG_JSON", False, "Emit JSONL structured logs")
 HTTP_HOST = env_str("DYN_TPU_HTTP_HOST", "0.0.0.0", "Frontend HTTP bind host")

@@ -63,6 +63,20 @@ class BlockPool:
     def usage(self) -> float:
         return self.active_blocks / self.num_blocks if self.num_blocks else 0.0
 
+    def bytes_breakdown(self, block_bytes: int) -> Dict[str, int]:
+        """Structural byte accounting for the HBM ledger / GET
+        /debug/memory: pool-state block counts × per-block KV bytes. The
+        pool itself is the single source of truth for which physical
+        blocks hold live vs reusable-cached vs free content, so this is
+        the only place the split can be computed without tearing."""
+        block_bytes = int(block_bytes)
+        return {
+            "active_bytes": self.active_blocks * block_bytes,
+            "cached_bytes": self.cached_blocks * block_bytes,
+            "free_bytes": len(self._free) * block_bytes,
+            "total_bytes": self.num_blocks * block_bytes,
+        }
+
     # -- prefix reuse ------------------------------------------------------
 
     def contains(self, block_hash: int) -> bool:

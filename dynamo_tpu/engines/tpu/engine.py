@@ -48,6 +48,12 @@ from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
 from dynamo_tpu.parallel.mesh import AxisNames
 from dynamo_tpu.parallel.sharding import ShardingRules, param_shardings, shard_params
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.device_observe import (
+    FlightRecorder,
+    HbmLedger,
+    dump_flight,
+    tree_device_bytes,
+)
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
@@ -335,6 +341,41 @@ class JaxEngine:
 
         self.step_metrics = EngineStepMetrics()
 
+        # Device-plane observability (runtime/device_observe.py):
+        # - flight: the tick loop's single-writer event ring (admit,
+        #   preempt, dispatch, reap, spec tick, KV transfers, abort). The
+        #   runner owns a second ring for device-thread events; the system
+        #   server merges both at GET /debug/flight.
+        # - hbm: structural byte ledger over live device state, sampled at
+        #   scrape/snapshot time only (never on the tick path).
+        self.flight = FlightRecorder("engine")
+        runner = self.runner
+        self.hbm = HbmLedger()
+        self.hbm.register(
+            "kv_cache",
+            lambda: tree_device_bytes((runner.k_cache, runner.v_cache)),
+        )
+        self.hbm.register("params", lambda: tree_device_bytes(runner.params))
+        self.hbm.register(
+            "slot_state", lambda: tree_device_bytes(runner.slot_state)
+        )
+        self.hbm.register(
+            "slot_tables", lambda: tree_device_bytes(runner.slot_tables)
+        )
+        self.hbm.register("lora", lambda: tree_device_bytes(runner.lora))
+        self.hbm.register(
+            "proc_state", lambda: tree_device_bytes(runner.proc_state)
+        )
+
+        self._last_flight_dump = float("-inf")  # abort-dump rate limiter
+
+        # stats() snapshot: the system-server thread scrapes stats while
+        # the tick loop mutates _slots/_inflight/pool counters — a live
+        # read can tear (kv_usage from before a reap, inflight_bursts from
+        # after). The loop REPLACES this dict wholesale at reap/admission/
+        # idle boundaries; readers get one consistent generation.
+        self._stats_cache: Optional[Dict[str, Any]] = None
+
     # -- device-state delegates (DeviceRunner owns the mechanism) ---------
 
     @property
@@ -445,6 +486,21 @@ class JaxEngine:
         self._transfer_executor.shutdown(wait=False)
 
     def stats(self) -> Dict[str, Any]:
+        """Engine stats for /engine/stats and metric scrapes. While the
+        scheduler loop is running, returns the snapshot it published at
+        the last reap/admission boundary (see _publish_stats) — a cross-
+        thread caller can never observe kv_usage and inflight_bursts from
+        different tick generations. With no loop running (tests, stopped
+        engine) the state is quiescent and computed live."""
+        task = self._loop_task
+        if task is not None and not task.done() and self._stats_cache is not None:
+            return dict(self._stats_cache)
+        return self._compute_stats()
+
+    def _publish_stats(self) -> None:
+        self._stats_cache = self._compute_stats()
+
+    def _compute_stats(self) -> Dict[str, Any]:
         out = {
             "active_seqs": sum(1 for s in self._slots if s is not None),
             "waiting": len(self._waiting),
@@ -470,6 +526,15 @@ class JaxEngine:
     @property
     def num_total_blocks(self) -> int:
         return self.args.num_kv_blocks
+
+    def kv_pool_bytes_breakdown(self) -> Dict[str, int]:
+        """Pool-state KV byte split (active/cached/free × per-block bytes)
+        for GET /debug/memory — the HBM ledger's kv_cache category is the
+        allocation's total footprint; this is how much of it holds live vs
+        reusable vs dead content."""
+        total = tree_device_bytes((self.runner.k_cache, self.runner.v_cache))
+        per_block = total // max(self.args.num_kv_blocks, 1)
+        return self.pool.bytes_breakdown(per_block)
 
     def clear_kv_blocks(self) -> int:
         """Flush the reusable prefix cache (ref: clear_kv_blocks.rs route).
@@ -524,6 +589,8 @@ class JaxEngine:
             return
         self._sleep_requested = None
         await self._device(self._do_wake)
+        self.flight.record("wake")
+        self._publish_stats()
         # Release a sleep() caller whose request we just cancelled.
         self._sleep_event.set()
         self._wake.set()
@@ -628,6 +695,7 @@ class JaxEngine:
                     # next decode dispatch is device-busy time, not
                     # host-injected gap — don't observe it.
                     self._t_last_ready = None
+                    self._publish_stats()
                 active = (
                     any(s is not None for s in self._slots)
                     or bool(self._inflight)
@@ -641,6 +709,7 @@ class JaxEngine:
                 elif not admitted:
                     # Idle: request inter-arrival time is not host gap.
                     self._t_last_ready = None
+                    self._publish_stats()
                     self._wake.clear()
                     try:
                         await asyncio.wait_for(self._wake.wait(), timeout=0.05)
@@ -705,6 +774,7 @@ class JaxEngine:
         while self._waiting:
             seq = self._waiting.popleft()
             seq.queue.put_nowait(BackendOutput(error=err, finish_reason=reason))
+        self._publish_stats()
 
     def _fail_terminally(self, exc: Exception) -> None:
         self._failure = f"{type(exc).__name__}: {exc}"
@@ -755,6 +825,10 @@ class JaxEngine:
         self._admitter._install(
             seq, prep, slot, first_token, first_logprob, first_top
         )
+        self.flight.record(
+            "admit", request_id=seq.request.request_id, slot=slot,
+            prompt=len(seq.prompt), cached_blocks=prep.matched,
+        )
 
     def _sampling_of(self, req: PreprocessedRequest) -> Tuple[float, int, float]:
         return self._admitter._sampling_of(req)
@@ -771,6 +845,7 @@ class JaxEngine:
         """Handle a pending sleep request / asleep state. Returns True when
         this tick is consumed (the main loop should ``continue``)."""
         if self._sleep_level > 0:  # asleep: idle until wake() or stop()
+            self._publish_stats()
             self._wake.clear()
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout=0.05)
@@ -798,6 +873,8 @@ class JaxEngine:
             await self._device(self._do_sleep, level)
         finally:
             self._sleep_inflight = False
+        self.flight.record("sleep", level=level)
+        self._publish_stats()
         self._sleep_event.set()
         return True
 
@@ -858,7 +935,13 @@ class JaxEngine:
         return self._spec.eligible(active)
 
     async def _spec_tick(self) -> bool:
-        return await self._spec.tick()
+        handled = await self._spec.tick()
+        if handled:
+            self.flight.record(
+                "spec_tick", proposed=self.spec_proposed,
+                accepted=self.spec_accepted,
+            )
+        return handled
 
     async def _decode_tick(self) -> None:
         """Pipelined decode tick: top the in-flight window up to
@@ -952,6 +1035,10 @@ class JaxEngine:
                 occupancy=len(active),
             )
         )
+        self.flight.record(
+            "dispatch", nb=nb_bucket, occupancy=len(active),
+            inflight=len(self._inflight),
+        )
         return True
 
     def _build_state_sync(self):
@@ -1019,6 +1106,12 @@ class JaxEngine:
             time.monotonic() - rec.t_dispatch, rec.occupancy,
             self.generated_tokens - gen0,
         )
+        self.flight.record(
+            "reap", occupancy=rec.occupancy,
+            tokens=self.generated_tokens - gen0,
+            dur_ms=round(1000 * (self._t_last_ready - rec.t_dispatch), 3),
+        )
+        self._publish_stats()
 
     async def _drain_inflight(self) -> None:
         """Barrier: reap every in-flight burst. Required before any event
@@ -1033,6 +1126,30 @@ class JaxEngine:
         was emitted; marking all slots dirty rolls the device state back to
         the scheduler's view, and the position-keyed sampling RNG makes the
         retried bursts regenerate the identical tokens."""
+        aborted = len(self._inflight)
+        self.flight.record("abort", inflight=aborted)
+        # Post-mortem: persist both event rings (tick loop + device thread)
+        # before the retry path overwrites the history that led here.
+        # Rate-limited: a flapping device fails ticks repeatedly, and one
+        # bounded dump per window captures the episode — an unbounded
+        # stream of files (each a blocking write on this loop) would not.
+        now = time.monotonic()
+        path = None
+        if now - self._last_flight_dump >= 30.0 and (
+            self.flight.total or self.runner.flight.total
+        ):
+            path = dump_flight(
+                {"engine": self.flight, "runner": self.runner.flight},
+                reason="abort_inflight",
+            )
+            if path:
+                # Stamp only on SUCCESS: a transiently unwritable dump dir
+                # must not consume the rate-limit window for the episode.
+                self._last_flight_dump = now
+        logger.error(
+            "aborted %d in-flight burst(s)%s", aborted,
+            f"; flight recorder dumped to {path}" if path else "",
+        )
         self._inflight.clear()
         self._dirty_state.update(range(self.args.max_num_seqs))
         self._dirty_tables.update(range(self.args.max_num_seqs))
@@ -1049,6 +1166,7 @@ class JaxEngine:
         # Don't let the failure + retry-backoff window masquerade as host
         # gap on the next dispatch.
         self._t_last_ready = None
+        self._publish_stats()
 
     def _emit_burst(
         self, seq: _Sequence, toks: np.ndarray, logps: np.ndarray,
@@ -1165,6 +1283,10 @@ class JaxEngine:
         before letting allocation fail), so the recompute — whose sampling
         keys are position-salted — regenerates the identical stream."""
         logger.warning("preempting request %s (KV pool exhausted)", seq.request.request_id)
+        self.flight.record(
+            "preempt", request_id=seq.request.request_id, slot=seq.slot,
+            blocks=len(seq.block_ids),
+        )
         self.pool.release(seq.block_ids, seq.block_hashes)
         slot = seq.slot
         self._slots[slot] = None
@@ -1254,6 +1376,9 @@ class JaxEngine:
                 self._transfer_executor,
                 self.runner.gather_blocks_readback, kd, vd,
             )
+            self.flight.record(
+                "kv_export", blocks=len(found), bytes=int(k.nbytes + v.nbytes)
+            )
             return found, k, v
         finally:
             if pinned_ids:
@@ -1304,6 +1429,7 @@ class JaxEngine:
             self.pool.commit(b, h, par)
             # imported blocks start unreferenced (cached): release our pin
             self.pool.release([b], [h])
+        self.flight.record("kv_import", blocks=len(ids))
         return len(ids)
 
     # -- checkpoint / restore (the chrek/CRIU fast-cold-start role) --------
@@ -1321,6 +1447,10 @@ class JaxEngine:
         return await kv_checkpoint.load_checkpoint(self, ckpt_dir)
 
     def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
+        self.flight.record(
+            "finish", request_id=seq.request.request_id, reason=reason.value,
+            generated=len(seq.generated),
+        )
         self.pool.release(seq.block_ids, seq.block_hashes)
         seq.block_ids = []
         seq.block_hashes = []

@@ -30,6 +30,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dynamo_tpu.models import llama
 from dynamo_tpu.ops.sampling import compute_logprobs, fold_row_keys, sample_tokens
 from dynamo_tpu.parallel.sharding import ShardingRules, shard_params
+from dynamo_tpu.runtime.device_observe import (
+    FlightRecorder,
+    global_compile_watcher,
+    watched_jit,
+)
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -39,8 +44,7 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-@jax.jit
-def _scatter_state_rows(state, idx, rows):
+def _scatter_state_rows_impl(state, idx, rows):
     """Write ``rows[k][i]`` into ``state[k][idx[i]]`` for every slot-state
     field — ONE device program per row-count bucket, so a dirty-slot sync
     costs a single small H2D + dispatch regardless of how many per-slot
@@ -55,9 +59,18 @@ def _scatter_state_rows(state, idx, rows):
     return {k: state[k].at[idx].set(rows[k]) for k in state}
 
 
-@jax.jit
-def _scatter_table_rows(tables, idx, rows):
+_scatter_state_rows = watched_jit(
+    "runner.scatter_state_rows", jax.jit(_scatter_state_rows_impl)
+)
+
+
+def _scatter_table_rows_impl(tables, idx, rows):
     return tables.at[idx].set(rows)
+
+
+_scatter_table_rows = watched_jit(
+    "runner.scatter_table_rows", jax.jit(_scatter_table_rows_impl)
+)
 
 
 @dataclass
@@ -74,8 +87,7 @@ class _DecodeHandles:
     mk_key: Optional[Tuple[int, bool, bool]] = None
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_blocks(cache, idx, blocks):
+def _scatter_blocks_impl(cache, idx, blocks):
     """cache ← blocks [L, n, BS, KH, D] at idx [n]. Works on all layouts:
     stacked [L, NB, BS, KH, D], per-layer tuple of [NB, BS, KH, D], or
     per-layer int8 {"q8", "s"} pools (blocks arrive in the dequantized
@@ -97,6 +109,12 @@ def _scatter_blocks(cache, idx, blocks):
     return cache.at[:, idx].set(blocks.astype(cache.dtype))
 
 
+_scatter_blocks = watched_jit(
+    "runner.scatter_blocks",
+    functools.partial(jax.jit, donate_argnums=(0,))(_scatter_blocks_impl),
+)
+
+
 # The wire/checkpoint format for exported KV blocks is always DENSE:
 # int8 pools are dequantized to KV_QUANT_WIRE_DTYPE by _gather_blocks;
 # non-quantized pools ship in their storage dtype (casting would perturb
@@ -113,8 +131,7 @@ def kv_wire_itemsize(storage_dtype, kv_cache_dtype: "str | None") -> int:
     return jnp.dtype(storage_dtype).itemsize
 
 
-@jax.jit
-def _gather_blocks(cache, idx):
+def _gather_blocks_impl(cache, idx):
     """[L, n, BS, KH, D] of blocks idx [n], from any cache layout, as ONE
     device program (a per-layer host gather would pay L dispatch RTTs).
     Int8 pools are dequantized to KV_QUANT_WIRE_DTYPE — the wire/checkpoint
@@ -131,6 +148,9 @@ def _gather_blocks(cache, idx):
     if isinstance(cache, (tuple, list)):
         return jnp.stack([one(c) for c in cache])
     return cache[:, idx]
+
+
+_gather_blocks = watched_jit("runner.gather_blocks", jax.jit(_gather_blocks_impl))
 
 
 def _is_kernel_compile_error(exc: BaseException) -> bool:
@@ -365,6 +385,23 @@ class DeviceRunner:
         # block_tables); bounded ring so serving never grows it unbounded.
         self.transfer_log: List[Tuple[str, int]] = []
         self._transfer_log_cap = 4096
+        # Device-thread flight ring: transfer syncs, decode dispatches, and
+        # megakernel arm/prove/demote transitions. Separate ring from the
+        # engine's (single-writer contract — this one is written from the
+        # device-executor thread); /debug/flight merges them by timestamp.
+        self.flight = FlightRecorder("runner")
+
+        # Expected distinct-signature budget for the width-bucketed decode
+        # and spec-verify programs: pow2 table widths give ~log2(cap)+1
+        # buckets per program object; 2× + margin tolerates legitimate
+        # re-specialization (LoRA stack restacks change operand shapes on
+        # the same jit object). Crossing it means dispatch widths stopped
+        # bucketing — the recompile-storm signal.
+        width_buckets = max(int(args.max_blocks_per_seq), 1).bit_length() + 1
+        self._decode_sig_budget = 2 * width_buckets + 4
+        watcher = global_compile_watcher()
+        for prog in ("runner.decode_state", "runner.spec_verify"):
+            watcher.set_budget(prog, self._decode_sig_budget)
 
         # State-path decode programs, keyed (want_logprobs, use_procs).
         # The logprob-free variant skips a full-vocab log-softmax per fused
@@ -392,6 +429,7 @@ class DeviceRunner:
         # families isn't worth the machinery — the XLA path keeps serving
         # and the demotion is logged loudly.
         self._mk_proven_keys: set = set()
+        self._mk_armed_logged: set = set()  # flight "mk_arm" once per key
         self._spec_fn: Optional[Any] = None  # speculative verify program
         self.sleep_level = 0
         self.host_params: Optional[Any] = None
@@ -605,7 +643,9 @@ class DeviceRunner:
             toks, logp = self._constrain_out(toks, logp)
             return toks, logp, k_cache, v_cache
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        return watched_jit(
+            "runner.prefill_step", jax.jit(step, donate_argnums=(2, 3))
+        )
 
     def _build_decode_fn(self, want_logprobs: bool = False,
                          want_procs: bool = False):
@@ -650,7 +690,11 @@ class DeviceRunner:
                 carry = self._constrain_out(*out[-2:])
                 return small + out[-4:-2] + carry
 
-            return jax.jit(step, donate_argnums=(2, 3, 4, 5))
+            return watched_jit(
+                "runner.decode_state",
+                jax.jit(step, donate_argnums=(2, 3, 4, 5)),
+                budget=self._decode_sig_budget,
+            )
 
         from dynamo_tpu.ops import logits_process as lp
 
@@ -680,7 +724,11 @@ class DeviceRunner:
             return small + (out[-5], out[-4], st.out_counts) + carry
 
         # donate caches + tokens/pos carry + the token-count array.
-        return jax.jit(step_p, donate_argnums=(2, 3, 4, 5, 20))
+        return watched_jit(
+            "runner.decode_state",
+            jax.jit(step_p, donate_argnums=(2, 3, 4, 5, 20)),
+            budget=self._decode_sig_budget,
+        )
 
     def _build_spec_fn(self):
         cfg = self.config
@@ -707,7 +755,11 @@ class DeviceRunner:
             emitted, counts = self._constrain_out(emitted, counts)
             return emitted, counts, k_cache, v_cache
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        return watched_jit(
+            "runner.spec_verify",
+            jax.jit(step, donate_argnums=(2, 3)),
+            budget=self._decode_sig_budget,
+        )
 
     # -- logits-processor device state ------------------------------------
 
@@ -819,6 +871,9 @@ class DeviceRunner:
         if len(self.transfer_log) >= self._transfer_log_cap:
             del self.transfer_log[: self._transfer_log_cap // 2]
         self.transfer_log.append((kind, n))
+        # Same events, typed + timestamped, in the device-thread flight
+        # ring (transfer_log stays as the tests' raw H2D count assertion).
+        self.flight.record(kind, n=n)
 
     def sync_slots(self, slots, rows: Dict[str, Any]) -> None:
         """Scatter dirty slot rows into the device-resident decode state —
@@ -896,6 +951,14 @@ class DeviceRunner:
         )
         if self.use_megakernel:
             key = (nb, bool(want_logprobs), bool(use_procs))
+            if key not in self._mk_proven_keys and key not in self._mk_armed_logged:
+                # Fallback armed for a never-proven (width, variant): a
+                # compile-shaped failure here demotes instead of raising.
+                self._mk_armed_logged.add(key)
+                self.flight.record(
+                    "mk_arm", width=nb, logprobs=bool(want_logprobs),
+                    procs=bool(use_procs),
+                )
             try:
                 return self._decode_dispatch_inner(
                     nb, want_logprobs, use_procs, mk_key=key
@@ -910,6 +973,10 @@ class DeviceRunner:
                     "megakernel decode failed to compile/lower at table "
                     "width %d (logprobs=%s, procs=%s) — falling back to "
                     "the XLA decode path for this engine", *key,
+                )
+                self.flight.record(
+                    "mk_demote", width=nb, logprobs=bool(want_logprobs),
+                    procs=bool(use_procs), error=type(exc).__name__,
                 )
                 self.use_megakernel = False
                 self._decode_state_fns = {}
@@ -977,7 +1044,12 @@ class DeviceRunner:
         if handles.mk_key is not None:
             # The megakernel program for this (width, variant) both
             # compiled AND executed — arm propagate-don't-demote for it.
-            self._mk_proven_keys.add(handles.mk_key)
+            if handles.mk_key not in self._mk_proven_keys:
+                self._mk_proven_keys.add(handles.mk_key)
+                self.flight.record(
+                    "mk_prove", width=handles.mk_key[0],
+                    logprobs=handles.mk_key[1], procs=handles.mk_key[2],
+                )
         return out
 
     def run_decode(

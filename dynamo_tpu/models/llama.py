@@ -225,12 +225,20 @@ def unstack_layer_params(layers, n_layers: int):
     splits: Dict[Tuple[Any, ...], Any] = {}
 
     def split_leaf(a):
+        from dynamo_tpu.runtime.device_observe import watched_jit
+
         a = jnp.asarray(a)
         key = (a.shape, a.dtype)
         if key not in splits:
-            splits[key] = jax.jit(
-                lambda x: tuple(x[l] for l in range(n_layers)),
-                donate_argnums=(0,),
+            # One watch name for every leaf-shaped split program: the
+            # signature count legitimately tracks distinct leaf shapes, so
+            # the site is unbudgeted (load-time only, never a hot path).
+            splits[key] = watched_jit(
+                "llama.unstack_layer_split",
+                jax.jit(
+                    lambda x: tuple(x[l] for l in range(n_layers)),
+                    donate_argnums=(0,),
+                ),
             )
         return splits[key](a)
 

@@ -182,3 +182,34 @@ def _try_save(cache_dir: str, key: str, params: Any) -> None:
         logger.exception(
             "weight cache write to %s failed; serving uncached", cache_dir
         )
+
+
+def _dir_bytes(root: Optional[str]) -> Dict[str, int]:
+    """{"bytes", "entries"} for one cache tier directory (0s when absent)."""
+    total = 0
+    entries = 0
+    if root and os.path.isdir(root):
+        for name in os.listdir(root):
+            path = os.path.join(root, name)
+            if not os.path.isdir(path):
+                continue
+            entries += 1
+            for dirpath, _dirs, files in os.walk(path):
+                for fname in files:
+                    try:
+                        total += os.stat(os.path.join(dirpath, fname)).st_size
+                    except OSError:
+                        pass
+    return {"bytes": total, "entries": entries}
+
+
+def cache_usage(
+    *,
+    cache_dir: str = DEFAULT_CACHE_DIR,
+    shm_dir: Optional[str] = SHM_CACHE_DIR,
+) -> Dict[str, Dict[str, int]]:
+    """Host-side weight-cache tier usage for GET /debug/memory. The shm
+    tier is RAM the kernel page cache holds on the worker's behalf (GMS
+    role) — invisible to device memory_stats but very much part of the
+    process's memory story on a shared host."""
+    return {"shm": _dir_bytes(shm_dir), "disk": _dir_bytes(cache_dir)}

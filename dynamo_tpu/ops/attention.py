@@ -23,6 +23,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.runtime.device_observe import watched_jit
+
 logger = logging.getLogger(__name__)
 
 NEG_INF = -1e30
@@ -119,8 +121,7 @@ def paged_attention(
     )
 
 
-@partial(jax.jit, static_argnames=("sm_scale", "logit_cap"))
-def _paged_attention_xla(
+def _paged_attention_xla_impl(
     q, k_cache, v_cache, block_tables, start_pos, chunk_lens,
     window=0, *, sm_scale=None, logit_cap: float = 0.0,
 ):
@@ -165,6 +166,14 @@ def _paged_attention_xla(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bcght,btgd->bcghd", probs, v.astype(jnp.float32))
     return out.reshape(B, C, n_heads, head_dim).astype(q.dtype)
+
+
+_paged_attention_xla = watched_jit(
+    "ops.paged_attention_xla",
+    partial(jax.jit, static_argnames=("sm_scale", "logit_cap"))(
+        _paged_attention_xla_impl
+    ),
+)
 
 
 def dense_chunk_attention(

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu.runtime.device_observe import watched_jit
+
 # Matches the OpenAI API contract (300 logit_bias entries max), so the
 # protocol-level validation and the engine capacity agree exactly.
 MAX_BIAS_SLOTS = 300
@@ -138,12 +140,17 @@ def record_tokens(
     return state._replace(out_counts=counts)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _reset_row(state: ProcState, slot: jnp.ndarray, hot: jnp.ndarray,
-               counts_row: jnp.ndarray):
+def _reset_row_impl(state: ProcState, slot: jnp.ndarray, hot: jnp.ndarray,
+                    counts_row: jnp.ndarray):
     counts = state.out_counts.at[slot].set(counts_row)
     mask = state.prompt_mask.at[slot].set(hot)
     return ProcState(out_counts=counts, prompt_mask=mask)
+
+
+_reset_row = watched_jit(
+    "ops.proc_reset_row",
+    functools.partial(jax.jit, donate_argnums=(0,))(_reset_row_impl),
+)
 
 
 def prompt_hot(tokens, vocab: int) -> np.ndarray:
@@ -171,10 +178,15 @@ def reset_slot(
     return _reset_row(state, jnp.int32(slot), jnp.asarray(hot), jnp.asarray(counts))
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _count_one(state: ProcState, slot: jnp.ndarray, token: jnp.ndarray):
+def _count_one_impl(state: ProcState, slot: jnp.ndarray, token: jnp.ndarray):
     counts = state.out_counts.at[slot, token].add(1, mode="drop")
     return state._replace(out_counts=counts)
+
+
+_count_one = watched_jit(
+    "ops.proc_count_one",
+    functools.partial(jax.jit, donate_argnums=(0,))(_count_one_impl),
+)
 
 
 def count_token(state: ProcState, slot: int, token: int) -> ProcState:

@@ -19,6 +19,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_FRONTEND,
     ALL_KVBM,
     ALL_ROUTER,
+    ALL_RUNTIME,
 )
 from dynamo_tpu.runtime.pipeline import (
     MapRequestOperator,
@@ -35,6 +36,7 @@ __all__ = [
     "ALL_FRONTEND",
     "ALL_KVBM",
     "ALL_ROUTER",
+    "ALL_RUNTIME",
     "AsyncEngine",
     "Client",
     "Component",

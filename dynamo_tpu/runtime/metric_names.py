@@ -80,6 +80,22 @@ KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL = (
 )
 KVBM_FAILED_LOADS_TOTAL = f"{KVBM_PREFIX}_failed_loads_total"
 
+# -- device/runtime plane (runtime/device_observe.py) ------------------------
+RUNTIME_PREFIX = "dynamo_tpu_runtime"
+# Compile telemetry (watched_jit / CompileWatcher): every jax.jit site.
+RUNTIME_COMPILES_TOTAL = f"{RUNTIME_PREFIX}_compiles_total"
+RUNTIME_COMPILE_SIGNATURES = f"{RUNTIME_PREFIX}_compile_signatures"
+RUNTIME_COMPILE_SECONDS = f"{RUNTIME_PREFIX}_compile_seconds"
+RUNTIME_RECOMPILE_STORMS_TOTAL = f"{RUNTIME_PREFIX}_recompile_storms_total"
+# HBM ledger (structural byte accounting + device.memory_stats mirror).
+RUNTIME_HBM_BYTES = f"{RUNTIME_PREFIX}_hbm_bytes"
+RUNTIME_HBM_DEVICE_BYTES = f"{RUNTIME_PREFIX}_hbm_device_bytes"
+# Flight recorder rings (engine tick loop + device-thread runner).
+RUNTIME_FLIGHT_EVENTS_TOTAL = f"{RUNTIME_PREFIX}_flight_events_total"
+RUNTIME_FLIGHT_OVERWRITTEN_TOTAL = f"{RUNTIME_PREFIX}_flight_overwritten_total"
+# On-demand jax.profiler captures (POST /debug/profile).
+RUNTIME_PROFILER_CAPTURES_TOTAL = f"{RUNTIME_PREFIX}_profiler_captures_total"
+
 # -- disagg (disagg/handlers.py DecodeHandler) -------------------------------
 DISAGG_PREFIX = "dynamo_tpu_disagg"
 DISAGG_TRANSFERS_TOTAL = f"{DISAGG_PREFIX}_transfers_total"
@@ -127,6 +143,18 @@ ALL_DISAGG = (
     DISAGG_BLOCKS_PULLED_TOTAL,
     DISAGG_BYTES_PULLED_TOTAL,
     DISAGG_TRANSFER_DURATION,
+)
+
+ALL_RUNTIME = (
+    RUNTIME_COMPILES_TOTAL,
+    RUNTIME_COMPILE_SIGNATURES,
+    RUNTIME_COMPILE_SECONDS,
+    RUNTIME_RECOMPILE_STORMS_TOTAL,
+    RUNTIME_HBM_BYTES,
+    RUNTIME_HBM_DEVICE_BYTES,
+    RUNTIME_FLIGHT_EVENTS_TOTAL,
+    RUNTIME_FLIGHT_OVERWRITTEN_TOTAL,
+    RUNTIME_PROFILER_CAPTURES_TOTAL,
 )
 
 ALL_ENGINE = (

@@ -15,6 +15,14 @@ Debug surface (serving-plane observability tentpole):
   GET  /debug/requests/{id}  one ordered lifecycle timeline
   GET  /debug/traces         the process tracer's finished-span ring
 
+Device-plane debug surface (runtime/device_observe.py):
+  GET  /debug/memory         HBM ledger categories + pool byte split +
+                             device.memory_stats() + host weight-cache tiers
+  GET  /debug/compiles       per-program compile telemetry (watched_jit)
+  GET  /debug/flight         merged flight-recorder rings (?limit=, ?kind=)
+  POST /debug/profile        {"action": "start"|"stop"|"status", "dir"?,
+                             "seconds"?} — on-demand jax.profiler capture
+
 This is the TPU build's analog of the reference's axum system server; the
 engine registers its callbacks via ``attach_engine`` (the reference's
 engine-routes registry, system_status_server.rs /engine/{*path} handler).
@@ -119,6 +127,12 @@ class SystemStatusServer:
         self._lora_list: Optional[Callable[[], List[str]]] = None
         self._lora_load: Optional[Callable[[str, str], Awaitable[None]]] = None
         self._lora_unload: Optional[Callable[[str], Awaitable[None]]] = None
+        # Device-plane debug sources: flight-recorder rings (name →
+        # snapshot fn) and HBM-ledger samplers (name → category dict fn).
+        self._flight_sources: List[Tuple[str, Callable[[], List[Any]]]] = []
+        self._memory_sources: List[Tuple[str, Callable[[], Dict[str, int]]]] = []
+        self._profile_timers: set = set()  # strong refs to auto-stop tasks
+        self._runtime_metrics_registered = False
         self._runner: Optional[web.AppRunner] = None
 
     # -- registration ------------------------------------------------------
@@ -140,9 +154,34 @@ class SystemStatusServer:
         self._lora_load = load_fn
         self._lora_unload = unload_fn
 
+    def register_flight(
+        self, name: str, fn: Callable[[], List[Any]]
+    ) -> None:
+        """fn returns a FlightRecorder snapshot (list of event dicts);
+        /debug/flight merges every registered ring by timestamp."""
+        self._flight_sources.append((name, fn))
+
+    def register_memory(
+        self, name: str, fn: Callable[[], Dict[str, int]]
+    ) -> None:
+        """fn returns {category: bytes}; /debug/memory groups by source.
+        Sources named ``*_detail`` are informational breakdowns of bytes
+        another source already accounts for — shown, but excluded from
+        ``ledger_total_bytes`` (no double counting)."""
+        self._memory_sources.append((name, fn))
+
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        # Device-plane runtime families (compile watcher + profiler) are
+        # process-global like the lifecycle/tracer rings: every system
+        # server exposes them. Guarded so a stop()/start() cycle doesn't
+        # register the source twice.
+        if not self._runtime_metrics_registered:
+            from dynamo_tpu.runtime.device_observe import render_runtime_metrics
+
+            self.register_metrics(render_runtime_metrics)
+            self._runtime_metrics_registered = True
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
@@ -150,6 +189,10 @@ class SystemStatusServer:
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/requests/{id}", self._debug_request)
         app.router.add_get("/debug/traces", self._debug_traces)
+        app.router.add_get("/debug/memory", self._debug_memory)
+        app.router.add_get("/debug/compiles", self._debug_compiles)
+        app.router.add_get("/debug/flight", self._debug_flight)
+        app.router.add_post("/debug/profile", self._debug_profile)
         app.router.add_route("*", "/engine/{path:.*}", self._engine)
         app.router.add_get("/v1/loras", self._loras_list)
         app.router.add_post("/v1/loras", self._loras_load)
@@ -260,6 +303,164 @@ class SystemStatusServer:
             spans = [s for s in spans if s.trace_id == want]
         return web.json_response({"spans": [s.to_dict() for s in spans]})
 
+    # -- device-plane debug surface (runtime/device_observe.py) ------------
+
+    async def _debug_memory(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.device_observe import device_memory_stats
+
+        sources: Dict[str, Dict[str, int]] = {}
+        total = 0
+        for name, fn in self._memory_sources:
+            try:
+                snap = fn()
+            except Exception as exc:
+                snap = {"error": f"{type(exc).__name__}: {exc}"}  # type: ignore[dict-item]
+            sources[name] = snap
+            if not name.endswith("_detail"):
+                total += sum(
+                    v for v in snap.values() if isinstance(v, int) and v > 0
+                )
+        body: Dict[str, Any] = {
+            "sources": sources,
+            "ledger_total_bytes": total,
+            "devices": device_memory_stats(),
+        }
+        try:
+            from dynamo_tpu.models.weight_cache import cache_usage
+
+            # os.walk over the disk cache tiers off the event loop — this
+            # loop also runs the engine tick; a cold/NFS cache walk here
+            # would stall token streaming for the duration of the scrape.
+            body["host_weight_cache"] = await asyncio.get_running_loop(
+            ).run_in_executor(None, cache_usage)
+        except Exception:  # keep the route alive without the models stack
+            body["host_weight_cache"] = None
+        # Cross-check where the backend reports real allocator numbers
+        # (TPU does; CPU memory_stats is None): unaccounted = allocator
+        # in-use minus everything the structural ledger can name. Only
+        # computed for a SINGLE reporting device: the ledger counts each
+        # logical array once, while N devices hold N physical copies of
+        # replicated state — the naive multi-device difference would
+        # report that replication as a phantom leak.
+        reporting = [
+            d for d in body["devices"]
+            if isinstance(d, dict) and d.get("memory_stats")
+        ]
+        in_use = sum(
+            d["memory_stats"].get("bytes_in_use", 0) for d in reporting
+        )
+        if in_use:
+            body["device_bytes_in_use"] = in_use
+            if len(reporting) == 1:
+                body["unaccounted_bytes"] = in_use - total
+            else:
+                body["unaccounted_note"] = (
+                    "multi-device: ledger bytes are logical (counted "
+                    "once) while allocator bytes include per-device "
+                    "replicas; no drift number computed"
+                )
+        return web.json_response(body)
+
+    async def _debug_compiles(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.device_observe import global_compile_watcher
+
+        return web.json_response(global_compile_watcher().snapshot())
+
+    async def _debug_flight(self, request: web.Request) -> web.Response:
+        """Merged flight-recorder rings, timestamp-ordered. Query params:
+        ?limit=N (newest N after the merge), ?kind=dispatch (filter)."""
+        events: List[Any] = []
+        rings = []
+        for name, fn in self._flight_sources:
+            rings.append(name)
+            try:
+                events.extend(fn())
+            except Exception:
+                logger.exception("flight source %s failed", name)
+        want_kind = request.query.get("kind")
+        if want_kind:
+            events = [e for e in events if e.get("kind") == want_kind]
+        events.sort(key=lambda e: e.get("t_mono", 0.0))
+        try:
+            limit = int(request.query.get("limit", "0"))
+        except ValueError:
+            limit = 0
+        if limit > 0:
+            events = events[-limit:]
+        return web.json_response({"rings": rings, "events": events})
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.device_observe import global_profiler
+
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        action = str(body.get("action", "status"))
+        profiler = global_profiler()
+        if action == "start":
+            # Validate BEFORE starting the trace: a bad 'seconds' after
+            # start_trace would 500 while leaving an orphaned capture
+            # active (and nothing to ever stop it).
+            seconds: Optional[float] = None
+            if body.get("seconds") is not None:
+                try:
+                    seconds = float(body["seconds"])
+                except (TypeError, ValueError):
+                    seconds = float("nan")
+                # NaN fails the 0 < s check; inf would never fire.
+                if not 0 < seconds < float("inf"):
+                    return web.json_response(
+                        {"error": f"bad seconds {body['seconds']!r} "
+                                  "(need a positive finite number)"},
+                        status=400,
+                    )
+            result = profiler.start(body.get("dir"))
+            if result.get("ok") and seconds:
+                # Bounded capture: auto-stop keeps an operator's one-shot
+                # POST from tracing forever when the stop call never comes.
+                capture_gen = result.get("generation")
+
+                async def _auto_stop() -> None:
+                    await asyncio.sleep(seconds)
+                    # Only stop OUR capture generation: a manual stop +
+                    # fresh start during the sleep (even into the same
+                    # dir) must not have ITS capture killed by this stale
+                    # timer.
+                    status = profiler.status()
+                    if (
+                        not status.get("active")
+                        or status.get("generation") != capture_gen
+                    ):
+                        return
+                    logger.info(
+                        "auto-stopped profiler capture: %s", profiler.stop()
+                    )
+
+                # Hold a strong reference: the loop keeps only weak task
+                # refs, and a GC'd timer would leave the capture unbounded.
+                task = asyncio.get_running_loop().create_task(_auto_stop())
+                self._profile_timers.add(task)
+                task.add_done_callback(self._profile_timers.discard)
+                result["auto_stop_s"] = seconds
+            # A degraded (profiler-unavailable) start is the documented
+            # graceful no-op — 200 with degraded:true, not an error; 409
+            # is reserved for "a capture is already active".
+            status = 200 if result.get("ok") or result.get("degraded") else 409
+            return web.json_response(result, status=status)
+        if action == "stop":
+            result = profiler.stop()
+            status = 200 if result.get("ok") or result.get("degraded") else 409
+            return web.json_response(result, status=status)
+        if action == "status":
+            return web.json_response(profiler.status())
+        return web.json_response(
+            {"error": f"unknown action {action!r} (start|stop|status)"},
+            status=400,
+        )
+
     async def _engine(self, request: web.Request) -> web.Response:
         path = request.match_info["path"].strip("/")
         handler = self._engine_routes.get(path)
@@ -347,7 +548,13 @@ def engine_stats_prometheus(stats: Dict[str, Any]) -> str:
 def attach_engine(server: SystemStatusServer, engine: Any) -> None:
     """Register the native engine's admin surface on the system server
     (ref: the engine-routes registry in system_status_server.rs plus vllm
-    handlers sleep/wake and LoRA load/unload)."""
+    handlers sleep/wake and LoRA load/unload). Tolerant of partial engines
+    (the mocker, stubs): each route/metric source registers only when the
+    engine exposes the matching surface, so a plain mock worker still gets
+    /health, the /debug/* plane, and whatever stats it can report."""
+
+    def has(name: str) -> bool:
+        return callable(getattr(engine, name, None))
 
     async def _stats(body: Dict[str, Any]):
         return 200, engine.stats()
@@ -382,26 +589,57 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
             return 400, {"error": repr(exc)}
         return 200, {"restored_blocks": n}
 
-    server.register_engine_route("stats", _stats)
-    server.register_engine_route("sleep", _sleep)
-    server.register_engine_route("wake", _wake)
-    server.register_engine_route("clear_kv_blocks", _clear)
-    server.register_engine_route("checkpoint", _checkpoint)
-    server.register_engine_route("restore", _restore)
+    if has("stats"):
+        server.register_engine_route("stats", _stats)
+    if has("sleep"):
+        server.register_engine_route("sleep", _sleep)
+    if has("wake"):
+        server.register_engine_route("wake", _wake)
+    if has("clear_kv_blocks"):
+        server.register_engine_route("clear_kv_blocks", _clear)
+    if has("save_checkpoint"):
+        server.register_engine_route("checkpoint", _checkpoint)
+    if has("load_checkpoint"):
+        server.register_engine_route("restore", _restore)
 
     def _engine_health():
         failure = getattr(engine, "_failure", None)
         if failure is not None:
             return False, f"engine failed: {failure}"
-        if engine.sleep_level > 0:
-            return True, f"asleep (level {engine.sleep_level})"
+        level = getattr(engine, "sleep_level", 0)
+        if level > 0:
+            return True, f"asleep (level {level})"
         return True, "serving"
 
     server.register_health("engine", _engine_health)
-    server.register_metrics(lambda: engine_stats_prometheus(engine.stats()))
+    if has("stats"):
+        server.register_metrics(
+            lambda: engine_stats_prometheus(engine.stats())
+        )
     step_metrics = getattr(engine, "step_metrics", None)
     if step_metrics is not None:
         step_metrics.register_metrics(server)
+
+    # Device-plane sources (JaxEngine; mocks without them still attach):
+    # flight rings → /debug/flight (+ per-kind event counters on /metrics),
+    # HBM ledger → /debug/memory (+ per-category byte gauges).
+    flight = getattr(engine, "flight", None)
+    if flight is not None:
+        server.register_flight(flight.name, flight.snapshot)
+        server.register_metrics(flight.registry.render)
+    runner_flight = getattr(getattr(engine, "runner", None), "flight", None)
+    if runner_flight is not None:
+        server.register_flight(runner_flight.name, runner_flight.snapshot)
+        server.register_metrics(runner_flight.registry.render)
+    hbm = getattr(engine, "hbm", None)
+    if hbm is not None:
+        server.register_memory("engine", hbm.snapshot)
+        server.register_metrics(hbm.registry.render)
+    pool_breakdown = getattr(engine, "kv_pool_bytes_breakdown", None)
+    if pool_breakdown is not None:
+        # Informational split of the ledger's kv_cache bytes (active vs
+        # reusable-cached vs free) — "_detail" keeps it out of the total.
+        server.register_memory("kv_pool_detail", pool_breakdown)
 
     async def _load(name: str, path: str) -> None:
         # Disk I/O + stacking + host→device transfer off the event loop —
@@ -424,4 +662,5 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
         else:
             engine.unload_lora(name)
 
-    server.register_loras(engine.lora_names, _load, _unload)
+    if has("lora_names") and has("load_lora") and has("unload_lora"):
+        server.register_loras(engine.lora_names, _load, _unload)

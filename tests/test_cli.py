@@ -79,3 +79,40 @@ def test_service_delegation_help():
     res = run_cli(["mocker", "--help"])
     assert res.returncode == 0
     assert "--model-name" in res.stdout
+
+
+async def test_observe_snapshot_against_live_worker(capsys):
+    """`dynamo-tpu observe` fetches /debug/memory, /debug/compiles and
+    /debug/flight from a running worker's system server and pretty-prints
+    them (in-process: a subprocess would pay a full engine compile)."""
+    import argparse
+
+    from dynamo_tpu.cli.run import add_observe_args, main_observe
+    from dynamo_tpu.runtime.system_server import (
+        SystemStatusServer,
+        attach_engine,
+    )
+    from tests.test_jax_engine import make_engine, req, run_one
+
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        await run_one(engine, req(range(10, 20), max_tokens=3))
+        parser = argparse.ArgumentParser()
+        add_observe_args(parser)
+        args = parser.parse_args(["--port", str(server.port)])
+        await main_observe(args)
+        out = capsys.readouterr().out
+        assert "device memory" in out and "kv_cache" in out
+        assert "compiled programs" in out and "runner.decode_state" in out
+        assert "flight recorder" in out and "dispatch" in out
+
+        args = parser.parse_args(["--port", str(server.port), "--json"])
+        await main_observe(args)
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"memory", "compiles", "flight"}
+    finally:
+        await server.stop()
+        await engine.stop()

@@ -58,14 +58,33 @@ def test_all_family_tuples_are_canonical_and_exported():
         v for v in vars(mn).values()
         if isinstance(v, str) and v.startswith("dynamo_tpu_")
     }
-    for family in ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_DISAGG",
-                   "ALL_ENGINE"):
+    families = ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_DISAGG",
+                "ALL_ENGINE", "ALL_RUNTIME")
+    for family in families:
         tup = getattr(rt, family)
         assert tup and isinstance(tup, tuple)
         for name in tup:
             assert name in defined, f"{family} contains undefined {name}"
     # families don't collide
-    all_names = [n for f in ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM",
-                             "ALL_DISAGG", "ALL_ENGINE")
-                 for n in getattr(rt, f)]
+    all_names = [n for f in families for n in getattr(rt, f)]
     assert len(all_names) == len(set(all_names))
+
+
+def test_runtime_family_covers_device_observe_emitters():
+    """Every metric runtime/device_observe.py registers must be pinned in
+    ALL_RUNTIME (the device-plane tentpole's lint anchor)."""
+    from dynamo_tpu.runtime import metric_names as mn
+    from dynamo_tpu.runtime.device_observe import (
+        CompileWatcher,
+        FlightRecorder,
+        HbmLedger,
+        ProfilerControl,
+    )
+
+    emitted = set()
+    for obj in (
+        CompileWatcher(), HbmLedger(), FlightRecorder("lint"),
+        ProfilerControl(),
+    ):
+        emitted.update(m.name for m in obj.registry._metrics)
+    assert emitted == set(mn.ALL_RUNTIME)

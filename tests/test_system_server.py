@@ -258,6 +258,120 @@ async def test_metrics_openmetrics_negotiation_renders_exemplars():
         await server.stop()
 
 
+async def test_every_debug_route_returns_json_against_mock_engine():
+    """Every static /debug/* route the server registers must answer 200
+    with well-formed JSON even when the attached engine exposes no
+    device-plane state (mock engines, partial attaches) — the operator's
+    snapshot tooling (dynamo-tpu observe) must never 500 on a plain
+    worker."""
+    from dynamo_tpu.engines.mock import MockEngine, MockEngineArgs
+
+    engine = MockEngine(MockEngineArgs())
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    # MockEngine lacks the LoRA/flight/hbm surface — attach_engine must
+    # cope, registering only what exists.
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        app = server._runner.app  # noqa: SLF001 - route table introspection
+        debug_paths = sorted(
+            r.resource.canonical
+            for r in app.router.routes()
+            if r.method == "GET"
+            and r.resource.canonical.startswith("/debug/")
+            and "{" not in r.resource.canonical
+        )
+        assert set(debug_paths) == {
+            "/debug/requests", "/debug/traces", "/debug/memory",
+            "/debug/compiles", "/debug/flight",
+        }
+        for path in debug_paths:
+            status, body = await _get(server.port, path)
+            assert status == 200, (path, body)
+            assert isinstance(body, dict), path
+        status, body = await _post(
+            server.port, "/debug/profile", {"action": "status"}
+        )
+        assert status == 200 and "active" in body
+        status, body = await _post(
+            server.port, "/debug/profile", {"action": "bogus"}
+        )
+        assert status == 400
+        # Bad 'seconds' must be rejected BEFORE any capture starts (an
+        # after-start failure would orphan an unbounded trace).
+        status, body = await _post(
+            server.port, "/debug/profile",
+            {"action": "start", "seconds": "60s"},
+        )
+        assert status == 400 and "seconds" in body["error"]
+        status, body = await _post(
+            server.port, "/debug/profile", {"action": "status"}
+        )
+        assert status == 200 and body["active"] is False
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
+async def test_debug_device_routes_reflect_live_engine():
+    """After serving one request, /debug/memory shows the ledger's real
+    categories, /debug/compiles shows the watched decode program, and
+    /debug/flight carries the merged engine+runner event history."""
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        await run_one(engine, req(range(10, 22), max_tokens=4))
+
+        status, body = await _get(server.port, "/debug/memory")
+        assert status == 200
+        cats = body["sources"]["engine"]
+        assert cats["kv_cache"] > 0 and cats["params"] > 0
+        assert body["ledger_total_bytes"] >= cats["kv_cache"] + cats["params"]
+        split = body["sources"]["kv_pool_detail"]
+        assert (
+            split["active_bytes"] + split["cached_bytes"]
+            + split["free_bytes"] == split["total_bytes"]
+        )
+        assert isinstance(body["devices"], list) and body["devices"]
+
+        status, body = await _get(server.port, "/debug/compiles")
+        assert status == 200
+        progs = body["programs"]
+        assert "runner.decode_state" in progs
+        assert progs["runner.decode_state"]["budget"] is not None
+        assert body["totals"]["compiles"] >= 1
+
+        status, body = await _get(server.port, "/debug/flight")
+        assert status == 200
+        assert set(body["rings"]) == {"engine", "runner"}
+        kinds = {e["kind"] for e in body["events"]}
+        assert {"admit", "dispatch", "reap", "finish", "decode"} <= kinds
+        ts = [e["t_mono"] for e in body["events"]]
+        assert ts == sorted(ts)  # merged across rings by timestamp
+
+        # filters: ?kind= and ?limit=
+        status, body = await _get(server.port, "/debug/flight?kind=reap&limit=2")
+        assert status == 200
+        assert body["events"]
+        assert all(e["kind"] == "reap" for e in body["events"])
+        assert len(body["events"]) <= 2
+
+        # metrics surface the flight/ledger families with real samples
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{server.port}/metrics") as r:
+                text = await r.text()
+        from dynamo_tpu.runtime import metric_names as mn
+
+        assert f'{mn.RUNTIME_FLIGHT_EVENTS_TOTAL}{{ring="engine",kind="admit"}}' in text
+        assert mn.RUNTIME_HBM_BYTES + '{category="kv_cache"}' in text
+        assert mn.RUNTIME_COMPILES_TOTAL in text
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
 async def test_metrics_merges_duplicate_families_across_sources():
     """Two same-kind subsystem objects (each a private metrics_core
     registry) registered on one server must not emit duplicate # HELP/
