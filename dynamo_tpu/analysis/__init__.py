@@ -1,0 +1,70 @@
+"""dynlint: repo-native static analysis for the serving stack's invariants.
+
+PRs 1-4 grew conventions that nothing enforced at rest: every ``jax.jit``
+site wrapped in ``watched_jit`` (compile telemetry, PR 4), a decode hot
+loop that moves zero host bytes and never blocks on device sync (PR 3),
+flight-recorder rings with exactly one writer thread each (PR 4), and a
+single canonical metric-name registry (PR 1). Runtime tests only catch a
+violation when a test happens to drive the bad path; this package turns
+the conventions into machine-checked AST rules that fail tier-1 before a
+recompile storm or a torn ring write ever reaches a TPU — the same move
+real serving stacks make once invariants outnumber reviewers (the
+reference Dynamo gates its Rust core on clippy; JAX ships its own
+leak-checker / debug tooling).
+
+Five passes (docs/design_docs/static_analysis.md has the catalog):
+
+  DYN001  jit-discipline     every jax.jit construction is wrapped in
+                             watched_jit and not rebuilt per call/loop
+  DYN002  hot-path purity    nothing reachable from the decode hot loop
+                             blocks on device sync, logs above DEBUG, or
+                             takes an unlisted lock
+  DYN003  silent-swallow     no broad ``except: pass`` — narrow it or
+                             record the failure
+  DYN004  metric closure     constructor metric names <-> metric_names
+                             ALL_* tuples, both directions
+  DYN005  single-writer      flight-recorder appends attributable to the
+          rings              ring's one owning class
+
+Ships three ways: ``dynamo-tpu lint`` (analysis/cli.py), the tier-1 gate
+(tests/test_dynlint.py, zero non-baselined findings over dynamo_tpu/),
+and library use::
+
+    from dynamo_tpu.analysis import run_lint
+    findings = run_lint()          # defaults: this package, repo config
+
+Intentionally importable without jax/numpy — the linter must run (and
+fail fast) on machines where the serving deps don't.
+"""
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    all_rules,
+    load_baseline,
+    partition_new,
+    register_rule,
+    run_lint,
+    save_baseline,
+)
+from dynamo_tpu.analysis.config import LintConfig, repo_config
+
+# Importing the rules package registers the five passes.
+from dynamo_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "ModuleInfo",
+    "Project",
+    "Rule",
+    "all_rules",
+    "load_baseline",
+    "partition_new",
+    "register_rule",
+    "repo_config",
+    "run_lint",
+    "save_baseline",
+]

@@ -1,0 +1,176 @@
+"""``dynamo-tpu lint``: run the dynlint passes from the command line.
+
+Exit codes: 0 = no non-baselined findings, 1 = new findings (or an
+unreadable baseline), 2 = bad invocation. Deliberately jax-free and
+synchronous — the lint gate must run on a CPU-only CI box in well under
+the tier-1 five-second budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    all_rules,
+    load_baseline,
+    partition_new,
+    run_lint_detailed,
+    save_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def add_lint_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--root", default=None,
+        help="directory to lint (default: the installed dynamo_tpu "
+        "package). A foreign tree runs only the portable rules "
+        "(DYN001/DYN003) — the hot-path/metric/ring configs describe "
+        "this repo's layout",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="grandfathered-findings JSON (default: the checked-in "
+        "analysis/baseline.json); pass an empty string to disable",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="text: one finding per line; json: machine-readable report",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline file from the current findings and "
+        "exit 0 (review the diff!)",
+    )
+
+
+def main_lint(args) -> int:
+    rule_ids: Optional[List[str]] = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rule_ids) - set(all_rules())
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(sorted(all_rules()))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    foreign = args.root is not None and (
+        os.path.realpath(args.root) != os.path.realpath(PACKAGE_ROOT)
+    )
+    config = None
+    if foreign:
+        from dynamo_tpu.analysis.config import portable_config
+
+        config = portable_config()
+        disabled = {"DYN002", "DYN004", "DYN005"}
+        asked_disabled = sorted(set(rule_ids or ()) & disabled)
+        if asked_disabled:
+            # Explicitly requested rules must not silently no-op into a
+            # false 'clean'.
+            print(
+                f"rule(s) {', '.join(asked_disabled)} are disabled for a "
+                "foreign --root (their configs describe the dynamo_tpu "
+                "package layout); run them via the library API with your "
+                "own LintConfig",
+                file=sys.stderr,
+            )
+            return 2
+    result = run_lint_detailed(args.root, config, rule_ids)
+    findings = result.findings
+
+    if args.write_baseline:
+        if not args.baseline:
+            print(
+                "--write-baseline needs a --baseline PATH (refusing to "
+                "guess a destination)",
+                file=sys.stderr,
+            )
+            return 2
+        if foreign and (
+            os.path.realpath(args.baseline)
+            == os.path.realpath(DEFAULT_BASELINE)
+        ):
+            print(
+                "refusing to overwrite the checked-in package baseline "
+                "from a foreign --root; pass an explicit --baseline PATH",
+                file=sys.stderr,
+            )
+            return 2
+        save_baseline(findings, args.baseline)
+        print(
+            f"baseline written: {len(findings)} finding(s) grandfathered "
+            f"-> {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline_keys = []
+    if args.baseline:
+        try:
+            baseline_keys = load_baseline(args.baseline)
+        except FileNotFoundError:
+            baseline_keys = []
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"unreadable baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 1
+    new, grandfathered = partition_new(findings, baseline_keys)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "new": [f.to_dict() for f in new],
+                "grandfathered": [f.to_dict() for f in grandfathered],
+                "suppressed": [
+                    {**f.to_dict(), "reason": reason}
+                    for f, reason in result.suppressed
+                ],
+                "ok": not new,
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+        if grandfathered:
+            print(
+                f"({len(grandfathered)} grandfathered finding(s) in the "
+                "baseline not shown)",
+                file=sys.stderr,
+            )
+        summary = (
+            "dynlint: clean"
+            if not new
+            else f"dynlint: {len(new)} new finding(s)"
+        )
+        print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+def _print_findings(findings: List[Finding]) -> None:  # pragma: no cover
+    for f in findings:
+        print(f.render())
+
+
+def main(argv=None) -> None:  # pragma: no cover - exercised via cli.__main__
+    parser = argparse.ArgumentParser("dynamo-tpu lint")
+    add_lint_args(parser)
+    raise SystemExit(main_lint(parser.parse_args(argv)))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,156 @@
+"""dynlint configuration: the repo's invariants as data.
+
+``repo_config()`` is THE statement of what PRs 1-4 promised; fixtures and
+tests build narrower configs pointing at their own trees. Paths are posix,
+relative to the linted root (for the repo config: the ``dynamo_tpu``
+package directory)."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class JitDisciplineConfig:
+    """DYN001. ``builder_name_re``: enclosing functions allowed to
+    construct jits (cached program builders); anything else needs either
+    module level, a memo-guard (``if key not in cache`` / ``is None``
+    ancestor test), or a reasoned suppression."""
+
+    watch_wrapper: str = "watched_jit"
+    builder_name_re: str = r"^(__init__|_?build_\w*|_?make_\w*)$"
+
+    def is_builder(self, name: str) -> bool:
+        return re.match(self.builder_name_re, name) is not None
+
+
+@dataclass(frozen=True)
+class HotPathConfig:
+    """DYN002. ``roots``: (module rel path, qualname) the decode hot loop
+    enters through. ``scope``: modules whose functions participate in the
+    name-based call graph — the decode plane, deliberately excluding
+    runtime/metrics_core.py (its histogram lock is a PR 3/4 decision: one
+    uncontended lock per observe, render pays the rest). ``boundaries``:
+    sanctioned host-transfer funnels where traversal and bans stop
+    (the pipelined readback helper IS the one allowed sync point).
+    ``device_roots``: names that hold device arrays — np.asarray/float/int
+    over an expression touching one of these is a blocking device sync."""
+
+    roots: FrozenSet[Tuple[str, str]] = frozenset(
+        {
+            ("engines/tpu/engine.py", "JaxEngine._decode_tick"),
+            ("engines/tpu/runner.py", "DeviceRunner.sync_slots"),
+            ("engines/tpu/runner.py", "DeviceRunner.sync_tables"),
+            ("engines/tpu/runner.py", "DeviceRunner.decode_dispatch"),
+            ("engines/tpu/runner.py", "DeviceRunner.decode_read"),
+        }
+    )
+    scope: FrozenSet[str] = frozenset(
+        {
+            "engines/tpu/engine.py",
+            "engines/tpu/runner.py",
+            "engines/metrics.py",
+            "runtime/device_observe.py",
+        }
+    )
+    boundaries: FrozenSet[Tuple[str, str]] = frozenset(
+        {
+            # The one sanctioned blocking readback: overlapped D2H copies
+            # at reap.
+            ("engines/tpu/runner.py", "DeviceRunner._get_all"),
+            # Program-CREATION helper: runs once per (program, variant)
+            # under a double-checked creation lock, never on a steady
+            # dispatch (WatchedJit.__call__ is lock-free).
+            ("runtime/device_observe.py", "watched_jit"),
+        }
+    )
+    device_roots: FrozenSet[str] = frozenset(
+        {
+            "slot_state",
+            "slot_tables",
+            "k_cache",
+            "v_cache",
+            "carry_tok",
+            "carry_pos",
+            "handles",
+            "proc_state",
+        }
+    )
+    # Lock attributes the hot path may take (none today; metrics_core is
+    # out of scope rather than whitelisted so the list stays honest).
+    allowed_locks: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class SilentSwallowConfig:
+    """DYN003. Exception names considered 'broad': catching one of these
+    (alone or in a tuple) with a do-nothing body is a silent swallow."""
+
+    broad_names: FrozenSet[str] = frozenset({"Exception", "BaseException"})
+
+
+@dataclass(frozen=True)
+class MetricClosureConfig:
+    """DYN004. ``metric_names_rel``: the single module allowed to define
+    metric names (loaded by file path — no package import, the linter
+    stays jax-free). ``constructor_methods`` / ``constructor_classes``:
+    call shapes that register a metric family. ``dynamic_emitters``:
+    helper functions whose non-literal call covers every name the helper
+    itself defined in the names module (the system server renders the
+    engine stats dict through ``engine_gauge(key)`` instead of
+    constructing gauge objects)."""
+
+    prefix: str = "dynamo_tpu_"
+    metric_names_rel: str = "runtime/metric_names.py"
+    constructor_methods: FrozenSet[str] = frozenset(
+        {"counter", "gauge", "histogram"}
+    )
+    constructor_classes: FrozenSet[str] = frozenset(
+        {"Counter", "Gauge", "Histogram"}
+    )
+    dynamic_emitters: FrozenSet[str] = frozenset({"engine_gauge"})
+
+
+@dataclass(frozen=True)
+class RingWriterConfig:
+    """DYN005. ``owners``: ring name -> (module rel path, owning class).
+    Appends (``<recv>.flight.record(...)``) must resolve to ``self.flight``
+    inside the owning class; anything else is a cross-thread write the
+    single-writer ring contract cannot survive."""
+
+    ring_attrs: FrozenSet[str] = frozenset({"flight"})
+    recorder_class: str = "FlightRecorder"
+    owners: Dict[str, Tuple[str, str]] = field(
+        default_factory=lambda: {
+            "engine": ("engines/tpu/engine.py", "JaxEngine"),
+            "runner": ("engines/tpu/runner.py", "DeviceRunner"),
+        }
+    )
+
+
+@dataclass(frozen=True)
+class LintConfig:
+    jit: JitDisciplineConfig = field(default_factory=JitDisciplineConfig)
+    hot_path: Optional[HotPathConfig] = field(default_factory=HotPathConfig)
+    swallow: SilentSwallowConfig = field(default_factory=SilentSwallowConfig)
+    metrics: Optional[MetricClosureConfig] = field(
+        default_factory=MetricClosureConfig
+    )
+    rings: Optional[RingWriterConfig] = field(default_factory=RingWriterConfig)
+
+
+def repo_config() -> LintConfig:
+    """The dynamo_tpu package's invariants (defaults above ARE the repo
+    config; fixtures construct their own)."""
+    return LintConfig()
+
+
+def portable_config() -> LintConfig:
+    """Rules meaningful on ANY tree: DYN001 (jit discipline) and DYN003
+    (silent swallow). The repo-specific passes — hot-path roots, the
+    metric-name registry, ring ownership — are tied to dynamo_tpu's
+    layout and would only emit config-mismatch noise on a foreign
+    ``--root``; they are disabled here."""
+    return LintConfig(hot_path=None, metrics=None, rings=None)

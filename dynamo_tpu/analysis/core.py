@@ -1,0 +1,437 @@
+"""dynlint framework: file walker, finding model, suppressions, baseline.
+
+Layers (each rule only sees the two below it):
+
+  Project        parsed package: ModuleInfo per file (source, AST, parent
+                 links, suppression table), built once and shared by all
+                 rules — the walk + parse is the dominant cost and the
+                 tier-1 gate budgets the whole run under 5 s.
+  Rule           one registered pass; ``check(project, config)`` yields
+                 Findings. Registration is declarative (``@register_rule``)
+                 so the CLI/tests enumerate passes without importing them
+                 by name.
+  Finding        (rule, path, line, message); baseline identity drops the
+                 line so grandfathered findings survive unrelated edits to
+                 the same file.
+
+Suppressions: ``# dynlint: disable=DYN001[,DYN002][ -- reason]`` on the
+finding's line, on any line of the multi-line statement that starts there,
+or on a standalone comment line directly above. Rules with
+``requires_reason`` (DYN003) reject reason-less suppressions — the
+suppression stays visible as a finding until someone writes down why the
+swallow is intentional.
+
+This module must not import jax/numpy (see package docstring).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple, Type
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dynlint:\s*disable=(?P<rules>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$"
+)
+
+# A line that is ONLY a suppression comment applies to the next line.
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    rule: str
+    path: str  # posix path relative to the linted root
+    line: int
+    message: str
+    # Last line of the anchoring statement: a trailing suppression comment
+    # anywhere in a multi-line statement covers the finding. Not part of
+    # identity/ordering (line drift already excluded from key()).
+    end_line: int = field(default=0, compare=False)
+
+    @staticmethod
+    def at(
+        module: "ModuleInfo", node: ast.AST, rule: str, message: str
+    ) -> "Finding":
+        line = getattr(node, "lineno", 0) or 0
+        end = getattr(node, "end_lineno", line) or line
+        # Suppressions may trail the enclosing STATEMENT's closing paren,
+        # not just the flagged expression — cover its full span. But only
+        # for expression nodes: climbing from an ExceptHandler would span
+        # the whole try statement, letting one reasoned suppression
+        # silently grandfather a SIBLING broad handler; and a def/class is
+        # its own statement (never cover whole bodies).
+        stmt = node
+        if not isinstance(node, (ast.stmt, ast.excepthandler)):
+            for anc in module.ancestors(node):
+                if isinstance(anc, ast.stmt):
+                    stmt = anc
+                    break
+        if not isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            end = max(end, getattr(stmt, "end_lineno", end) or end)
+        return Finding(
+            rule=rule,
+            path=module.rel,
+            line=line,
+            message=message,
+            end_line=end,
+        )
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with unrelated edits, so
+        grandfathering matches on (rule, path, message) as a multiset."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Suppression:
+    rules: Set[str]
+    reason: Optional[str]
+
+
+class ModuleInfo:
+    """One parsed source file plus the derived indexes every rule needs:
+    parent links (ast has none), line→suppression table, and lazy
+    qualname helpers."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.suppressions = self._scan_suppressions()
+
+    # -- structure helpers --------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted context of a node: 'Class.method[.inner]' or '<module>'."""
+        parts: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(anc.name)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    # -- suppressions -------------------------------------------------------
+
+    def _scan_suppressions(self) -> Dict[int, Suppression]:
+        table: Dict[int, Suppression] = {}
+        for lineno, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            }
+            sup = Suppression(rules=rules, reason=m.group("reason"))
+            if _COMMENT_ONLY_RE.match(line) and line.lstrip().startswith("#"):
+                table[lineno + 1] = sup  # standalone: guards the next line
+            else:
+                table[lineno] = sup  # trailing: guards its own line
+        return table
+
+    def suppression_for_span(
+        self, start: int, end: int, rule: str
+    ) -> Optional[Suppression]:
+        """Suppression covering ``rule`` anywhere in [start, end]: a
+        trailing comment on any spanned line, or a standalone comment
+        directly above ``start`` (already shifted in the table)."""
+        for lineno in range(start, max(start, end) + 1):
+            sup = self.suppressions.get(lineno)
+            if sup is not None and rule in sup.rules:
+                return sup
+        return None
+
+
+class Project:
+    """All parsed modules under one root directory (non-recursive into
+    __pycache__/hidden dirs). A file that fails to parse is itself a
+    finding (DYN000) — a syntax error must fail the gate, not silently
+    shrink the rule coverage."""
+
+    def __init__(self, root: str, modules: List[ModuleInfo],
+                 errors: List[Finding]) -> None:
+        self.root = root
+        self.modules = modules
+        self.errors = errors
+        self._by_rel = {m.rel: m for m in modules}
+
+    @classmethod
+    def load(cls, root: str) -> "Project":
+        root = os.path.abspath(root)
+        modules: List[ModuleInfo] = []
+        errors: List[Finding] = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        source = f.read()
+                    modules.append(ModuleInfo(path, rel, source))
+                except (SyntaxError, ValueError, OSError) as exc:
+                    errors.append(
+                        Finding(
+                            rule="DYN000",
+                            path=rel,
+                            line=getattr(exc, "lineno", 0) or 0,
+                            message=f"unparseable module: {exc}",
+                        )
+                    )
+        return cls(root, modules, errors)
+
+    def module(self, rel: str) -> Optional[ModuleInfo]:
+        return self._by_rel.get(rel)
+
+
+# -- AST utilities shared by rules -------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute chains over Names; None for anything whose
+    base isn't a plain name (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_attr(node: ast.AST) -> Optional[str]:
+    """Final attribute/name of a (possibly complex) reference expression:
+    ``self.runner.decode_read`` -> 'decode_read', ``foo`` -> 'foo'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """Every Name id and Attribute attr mentioned in a subtree — the cheap
+    'does this expression touch X' test rules use for root tracking."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+# -- rule registry ------------------------------------------------------------
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``title`` and implement check().
+    ``requires_reason``: inline suppressions must carry '-- reason'."""
+
+    id: str = "DYN000"
+    title: str = ""
+    requires_reason: bool = False
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    return dict(_REGISTRY)
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Tuple[str, str, str]]:
+    """Baseline file -> list of finding keys (multiset semantics: two
+    identical grandfathered findings need two entries)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("findings", [])
+    return [(e["rule"], e["path"], e["message"]) for e in entries]
+
+
+def save_baseline(findings: Iterable[Finding], path: str) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "message": f.message}
+        for f in sorted(findings)
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "dynlint grandfathered findings; regenerate with "
+                    "`dynamo-tpu lint --write-baseline` and REVIEW the "
+                    "diff — a growing baseline is a failing invariant."
+                ),
+                "findings": entries,
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+
+
+def partition_new(
+    findings: Iterable[Finding], baseline_keys: Iterable[Tuple[str, str, str]]
+) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered): each baseline key absorbs ONE matching
+    finding (multiset match) so a second copy of a grandfathered bug is
+    still new."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for key in baseline_keys:
+        budget[key] = budget.get(key, 0) + 1
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sorted(findings):
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# -- entry point --------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, Optional[str]]] = field(
+        default_factory=list
+    )
+
+
+def run_lint(
+    root: Optional[str] = None,
+    config=None,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the registered passes over ``root`` and return the surviving
+    findings, sorted. Defaults lint this installed dynamo_tpu package
+    under the repo config."""
+    return run_lint_detailed(root, config, rule_ids).findings
+
+
+def run_lint_detailed(
+    root: Optional[str] = None,
+    config=None,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> LintResult:
+    from dynamo_tpu.analysis.config import repo_config
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if config is None:
+        config = repo_config()
+    project = Project.load(root)
+    wanted = set(rule_ids) if rule_ids is not None else None
+    findings: List[Finding] = list(project.errors)
+    suppressed: List[Tuple[Finding, Optional[str]]] = []
+    for rule_id, rule_cls in sorted(all_rules().items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        rule = rule_cls()
+        for finding in rule.check(project, config):
+            module = project.module(finding.path)
+            sup = (
+                module.suppression_for_span(
+                    finding.line, finding.end_line or finding.line, rule_id
+                )
+                if module is not None
+                else None
+            )
+            if sup is None:
+                findings.append(finding)
+                continue
+            if rule.requires_reason and not sup.reason:
+                findings.append(
+                    Finding(
+                        rule=finding.rule,
+                        path=finding.path,
+                        line=finding.line,
+                        message=(
+                            finding.message
+                            + " [suppression needs a reason: "
+                            "'# dynlint: disable="
+                            + rule_id
+                            + " -- why']"
+                        ),
+                    )
+                )
+            else:
+                suppressed.append((finding, sup.reason))
+    # The over-approximate call graph can reach the same node through two
+    # paths; findings are a set, not a trace log.
+    return LintResult(findings=sorted(set(findings)), suppressed=suppressed)

@@ -1,0 +1,9 @@
+"""The five dynlint passes. Importing this package registers them."""
+
+from dynamo_tpu.analysis.rules import (  # noqa: F401
+    hot_path,
+    jit_discipline,
+    metric_closure,
+    ring_writers,
+    silent_swallow,
+)

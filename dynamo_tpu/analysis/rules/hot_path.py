@@ -1,0 +1,278 @@
+"""DYN002 hot-path purity: nothing reachable from the decode hot loop may
+block on a device sync, log above DEBUG on the steady path, or take an
+unlisted lock.
+
+PR 3's contract: steady-state decode ticks move ZERO host bytes and the
+only blocking readback is the pipelined ``_get_all`` funnel at reap. The
+runtime transfer-counting tests prove it for the paths they drive; this
+pass proves it for every path that EXISTS, by walking a conservative
+name-based call graph from the configured roots.
+
+Call graph: within the configured module scope, every ``Name`` or
+terminal-``Attribute`` reference that matches an indexed function name is
+an edge — deliberately over-approximate (a function *referenced* on the
+hot path can be *called* there; ``self._device(self._dispatch_on_device,
+...)`` style executor indirection must not hide callees). Boundary
+functions (the sanctioned readback funnel) stop both traversal and bans.
+
+Banned inside reachable functions:
+  * ``jax.device_get(...)``, ``.block_until_ready()``, ``.item()``,
+    ``.tolist()`` — unconditional device syncs;
+  * ``np.asarray/np.array/float/int`` over an expression touching a
+    configured device-state root (host conversion of a device array);
+  * logging above DEBUG outside an ``except`` handler (error paths may
+    speak; the steady path may not);
+  * ``with <lock>`` / ``.acquire()`` on locks not in the whitelist.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    dotted_name,
+    names_in,
+    register_rule,
+    terminal_attr,
+)
+
+_LOG_ABOVE_DEBUG = {"info", "warning", "warn", "error", "exception", "critical"}
+_SYNC_ATTRS = {"item", "tolist"}
+_CONVERTERS = {"float", "int"}
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+@dataclass
+class _Func:
+    module: ModuleInfo
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    qualname: str
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.rel, self.qualname)
+
+
+def _index_scope(project: Project, scope) -> Dict[str, List[_Func]]:
+    """name -> candidate functions across the scope modules (methods index
+    under their bare name so attribute references resolve)."""
+    index: Dict[str, List[_Func]] = {}
+    for module in project.modules:
+        if module.rel not in scope:
+            continue
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = _Func(module, node, module.qualname(node))
+                index.setdefault(node.name, []).append(f)
+    return index
+
+
+def _local_bindings(func: _Func) -> Set[str]:
+    """Names bound inside the function (params + any Store) — a Load of
+    one of these is a local value, not a reference to a project function
+    that happens to share its name."""
+    bound: Set[str] = set()
+    args = func.node.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + [args.vararg, args.kwarg]
+    ):
+        if a is not None:
+            bound.add(a.arg)
+    for node in ast.walk(func.node):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+    return bound
+
+
+def _edges(func: _Func, index: Dict[str, List[_Func]]) -> Iterator[_Func]:
+    """Over-approximate callees, but only from positions that can invoke:
+    the func of a Call, or a Name/Attribute passed as a call argument
+    (executor indirection: ``self._device(self.runner.decode_read, ...)``
+    must not hide callees). A plain attribute/name LOAD (``stop =
+    req.stop``) is data flow, not a call — edging on it drowns the graph
+    in same-name coincidences."""
+    own_name = getattr(func.node, "name", None)
+    local = _local_bindings(func)
+
+    def candidates(ref: ast.AST) -> Iterator[_Func]:
+        if isinstance(ref, ast.Attribute):
+            name = ref.attr
+        elif isinstance(ref, ast.Name):
+            if ref.id in local:
+                return
+            name = ref.id
+        else:
+            return
+        if name == own_name or name not in index:
+            return
+        yield from index[name]
+
+    for node in ast.walk(func.node):
+        if not isinstance(node, ast.Call):
+            continue
+        yield from candidates(node.func)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            yield from candidates(arg)
+
+
+def _in_except_handler(module: ModuleInfo, node: ast.AST) -> bool:
+    return any(
+        isinstance(anc, ast.ExceptHandler) for anc in module.ancestors(node)
+    )
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    name = terminal_attr(expr)
+    return name is not None and "lock" in name.lower()
+
+
+@register_rule
+class HotPathPurityRule(Rule):
+    id = "DYN002"
+    title = "decode hot path must not sync, log, or lock"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.hot_path
+        if cfg is None:
+            return
+        index = _index_scope(project, cfg.scope)
+        # Resolve roots to functions (a missing root is itself a finding:
+        # a rename must update the invariant, not silently drop coverage).
+        queue: List[_Func] = []
+        seen: Set[Tuple[str, str]] = set()
+        all_funcs = {
+            f.key: f for funcs in index.values() for f in funcs
+        }
+        for rel, qual in sorted(cfg.roots):
+            f = all_funcs.get((rel, qual))
+            if f is None:
+                yield Finding(
+                    rule=self.id,
+                    path=rel,
+                    line=1,
+                    message=(
+                        f"configured hot-path root {qual!r} not found — "
+                        "update analysis/config.py to track the rename"
+                    ),
+                )
+                continue
+            queue.append(f)
+            seen.add(f.key)
+        while queue:
+            func = queue.pop()
+            if func.key in cfg.boundaries:
+                continue
+            yield from self._check_function(func, cfg)
+            for callee in _edges(func, index):
+                if callee.key not in seen:
+                    seen.add(callee.key)
+                    queue.append(callee)
+
+    def _check_function(self, func: _Func, cfg) -> Iterator[Finding]:
+        module = func.module
+        where = f"hot-path function {func.qualname!r} ({module.rel})"
+        for node in ast.walk(func.node):
+            # Skip nested defs? No: nested functions run on the hot path
+            # too (dispatch closures) — they stay in the walk.
+            if isinstance(node, ast.Call):
+                yield from self._check_call(module, node, where, cfg)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    tgt = ctx.func if isinstance(ctx, ast.Call) else ctx
+                    if _looks_like_lock(tgt) and (
+                        terminal_attr(tgt) not in cfg.allowed_locks
+                    ):
+                        yield Finding.at(
+                            module, node, self.id,
+                            f"lock acquired in {where} — the tick thread "
+                            "must never wait on another thread; whitelist "
+                            "it in analysis/config.py only with a "
+                            "measured bound",
+                        )
+
+    def _check_call(
+        self, module: ModuleInfo, node: ast.Call, where: str, cfg
+    ) -> Iterator[Finding]:
+        fn = node.func
+        dotted = dotted_name(fn)
+        attr = fn.attr if isinstance(fn, ast.Attribute) else None
+
+        if dotted == "jax.device_get":
+            yield Finding.at(
+                module, node, self.id,
+                f"jax.device_get in {where} — blocking D2H sync; route "
+                "readbacks through the pipelined funnel "
+                "(DeviceRunner._get_all)",
+            )
+            return
+        if attr == "block_until_ready":
+            yield Finding.at(
+                module, node, self.id,
+                f".block_until_ready() in {where} — blocking device sync "
+                "on the hot path",
+            )
+            return
+        if attr in _SYNC_ATTRS and isinstance(fn, ast.Attribute):
+            # .item()/.tolist() also exist on host numpy arrays — only a
+            # receiver touching device state is a sync.
+            touched = names_in(fn.value) & cfg.device_roots
+            if touched:
+                yield Finding.at(
+                    module, node, self.id,
+                    f".{attr}() over device state "
+                    f"({', '.join(sorted(touched))}) in {where} — "
+                    "synchronous device readback on the hot path",
+                )
+                return
+        if attr == "acquire" and isinstance(fn, ast.Attribute) and (
+            _looks_like_lock(fn.value)
+            and terminal_attr(fn.value) not in cfg.allowed_locks
+        ):
+            yield Finding.at(
+                module, node, self.id,
+                f"lock .acquire() in {where} — the tick thread must "
+                "never wait on another thread",
+            )
+            return
+
+        # Device-array host conversions: only when the argument expression
+        # touches a known device-state root (host numpy mirrors convert
+        # freely — that's the dirty-slot sync working as designed).
+        is_np = dotted in _NP_CONVERTERS
+        is_cast = isinstance(fn, ast.Name) and fn.id in _CONVERTERS
+        if (is_np or is_cast) and node.args:
+            touched = names_in(node.args[0]) & cfg.device_roots
+            if touched:
+                what = dotted if is_np else fn.id  # type: ignore[union-attr]
+                yield Finding.at(
+                    module, node, self.id,
+                    f"{what}() over device state "
+                    f"({', '.join(sorted(touched))}) in {where} — host "
+                    "conversion of a device array blocks the tick; keep "
+                    "it on device or reap through the funnel",
+                )
+                return
+
+        # Logging above DEBUG on the steady path.
+        if (
+            attr in _LOG_ABOVE_DEBUG
+            and isinstance(fn, ast.Attribute)
+            and terminal_attr(fn.value) in {"logger", "logging", "log"}
+            and not _in_except_handler(module, node)
+        ):
+            yield Finding.at(
+                module, node, self.id,
+                f"logger.{attr}() on the steady path in {where} — "
+                "formatting + handler I/O on the tick thread; use DEBUG, "
+                "the flight recorder, or move it into the error path",
+            )

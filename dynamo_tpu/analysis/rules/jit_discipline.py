@@ -1,0 +1,150 @@
+"""DYN001 jit-discipline: every ``jax.jit`` construction is (a) wrapped in
+``watched_jit`` so /debug/compiles attributes its cache growth, and (b)
+built once — at module level, in a recognized builder function, or behind
+a memo guard — never per call or per loop iteration.
+
+(b) is the trace-time half of PR 4's recompile-storm detector: a jit
+object constructed inside a per-call body starts with an empty compile
+cache EVERY call, so each dispatch pays a full trace+XLA compile that the
+runtime signature-budget watcher (which is per jit object) can never see
+accumulate.
+
+Recognized safe construction contexts:
+  * module level (constant program objects);
+  * an enclosing function whose name matches the builder pattern
+    (``__init__``, ``_build_*``, ``make_*`` — cached-program factories);
+  * a memo guard: the construction sits under an ``if`` whose test is a
+    cache-miss check (``key not in cache`` / ``x is None``), the idiom
+    llama.py's donated unstack splitter uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    dotted_name,
+    register_rule,
+)
+
+
+def _jit_references(module: ModuleInfo) -> List[ast.AST]:
+    """Nodes referring to the jit transform itself: ``jax.jit`` attributes
+    plus bare names bound by ``from jax import jit``."""
+    jit_aliases = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    jit_aliases.add(alias.asname or alias.name)
+    refs: List[ast.AST] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Attribute) and dotted_name(node) == "jax.jit":
+            refs.append(node)
+        elif isinstance(node, ast.Name) and node.id in jit_aliases:
+            refs.append(node)
+    return refs
+
+
+def _is_watch_call(node: ast.AST, wrapper: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    return name == wrapper
+
+
+def _memo_guarded(module: ModuleInfo, node: ast.AST) -> bool:
+    """True when an ancestor ``if`` test is a cache-miss check: a
+    ``not in`` membership test or an ``is None`` comparison."""
+    for anc in module.ancestors(node):
+        if not isinstance(anc, ast.If):
+            continue
+        for sub in ast.walk(anc.test):
+            if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.NotIn, ast.Is)) for op in sub.ops
+            ):
+                return True
+    return False
+
+
+@register_rule
+class JitDisciplineRule(Rule):
+    id = "DYN001"
+    title = "jax.jit sites must be watched_jit-wrapped and built once"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.jit
+        for module in project.modules:
+            if module.rel.startswith("analysis/"):
+                continue  # the linter itself manipulates jit names in text
+            for ref in _jit_references(module):
+                yield from self._check_ref(module, ref, cfg)
+
+    def _check_ref(
+        self, module: ModuleInfo, ref: ast.AST, cfg
+    ) -> Iterator[Finding]:
+        watched = False
+        in_loop = False
+        decorated: Optional[ast.AST] = None
+        prev: ast.AST = ref
+        for anc in module.ancestors(ref):
+            if _is_watch_call(anc, cfg.watch_wrapper) and (
+                prev in anc.args
+                or prev in [kw.value for kw in anc.keywords]
+            ):
+                watched = True
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop = True
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and prev in getattr(anc, "decorator_list", ()):
+                decorated = anc
+            prev = anc
+
+        if decorated is not None:
+            yield Finding.at(
+                module, ref, self.id,
+                f"decorator jit on {module.qualname(decorated)!r} cannot be "
+                f"watched — jit the implementation and assign through "
+                f"{cfg.watch_wrapper}(name, ...) so /debug/compiles sees "
+                f"this program",
+            )
+            return  # a decorator is module-scoped; skip the context checks
+        if not watched:
+            yield Finding.at(
+                module, ref, self.id,
+                f"un-watched jax.jit in {module.qualname(ref)} — wrap the "
+                f"jitted callable in {cfg.watch_wrapper}(name, ...) "
+                f"(compile telemetry + recompile-storm budget)",
+            )
+        if in_loop:
+            yield Finding.at(
+                module, ref, self.id,
+                f"jax.jit constructed inside a loop in "
+                f"{module.qualname(ref)} — each iteration builds a fresh "
+                f"program object with an empty compile cache (recompile "
+                f"storm at trace time); hoist it",
+            )
+            return
+        fn = module.enclosing_function(ref)
+        if fn is None:
+            return  # module level: constant program object
+        if cfg.is_builder(fn.name):
+            return
+        if _memo_guarded(module, ref):
+            return
+        yield Finding.at(
+            module, ref, self.id,
+            f"jax.jit constructed in per-call body "
+            f"{module.qualname(fn)!r} — every call rebuilds the program "
+            f"and repays the XLA compile; hoist to module level, a "
+            f"builder ({cfg.builder_name_re}), or a memo guard",
+        )

@@ -1,0 +1,217 @@
+"""DYN004 metric-name closure: both directions of the PR 1 contract.
+
+Forward: every metric name reaching a Counter/Gauge/Histogram constructor
+resolves to a member of a ``metric_names.ALL_*`` tuple — a string literal
+at a constructor site is an emitter bypassing the registry (the runtime
+half, test_metric_names_lint.py's grep, catches the literal; this pass
+additionally catches a CONSTANT that was never pinned into a family).
+
+Reverse: every ``ALL_*`` entry has at least one constructor site — a name
+with no emitter is a dead dashboard series waiting to page someone.
+Names defined through a configured dynamic emitter (``engine_gauge``)
+are covered by any non-literal call of that emitter (the system server
+renders the engine stats dict straight to Prometheus text).
+
+The names module is loaded BY FILE PATH (no package import): it is
+dependency-free by design and the linter must run without jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register_rule,
+)
+
+
+def _load_names_module(path: str):
+    spec = importlib.util.spec_from_file_location("_dynlint_metric_names", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def _registry(names_mod, names_ast: ast.Module, dynamic_emitters) -> Tuple[
+    Dict[str, str], Set[str], Dict[str, Set[str]], Set[str]
+]:
+    """(const name -> value, family-member values, family name -> values,
+    dynamically-emitted values)."""
+    consts: Dict[str, str] = {
+        k: v
+        for k, v in vars(names_mod).items()
+        if isinstance(v, str) and not k.startswith("_")
+    }
+    families: Dict[str, Set[str]] = {}
+    members: Set[str] = set()
+    for k, v in vars(names_mod).items():
+        if k.startswith("ALL_") and isinstance(v, tuple):
+            vals = {x for x in v if isinstance(x, str)}
+            families[k] = vals
+            members |= vals
+    # Constants whose defining expression is a dynamic-emitter call are
+    # rendered generically (no per-name constructor object exists).
+    dynamic: Set[str] = set()
+    for node in ast.walk(names_ast):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        if (
+            isinstance(val, ast.Call)
+            and isinstance(val.func, ast.Name)
+            and val.func.id in dynamic_emitters
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in consts:
+                    dynamic.add(consts[tgt.id])
+    return consts, members, families, dynamic
+
+
+def _constructor_name_arg(node: ast.Call, cfg) -> Optional[ast.AST]:
+    """First positional arg when the call shape is a metric constructor."""
+    fn = node.func
+    is_ctor = (
+        isinstance(fn, ast.Attribute) and fn.attr in cfg.constructor_methods
+    ) or (isinstance(fn, ast.Name) and fn.id in cfg.constructor_classes)
+    if not is_ctor or not node.args:
+        return None
+    return node.args[0]
+
+
+@register_rule
+class MetricClosureRule(Rule):
+    id = "DYN004"
+    title = "metric names close over the metric_names registry"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.metrics
+        if cfg is None:
+            return
+        names_module = project.module(cfg.metric_names_rel)
+        if names_module is None:
+            yield Finding(
+                rule=self.id,
+                path=cfg.metric_names_rel,
+                line=1,
+                message="metric-names module missing from the linted tree",
+            )
+            return
+        try:
+            names_mod = _load_names_module(
+                os.path.join(project.root, cfg.metric_names_rel)
+            )
+        except Exception as exc:
+            # The names module is executed by path; it must stay
+            # dependency-free. A load failure is a finding, not a crash —
+            # same contract as Project.load's DYN000.
+            yield Finding(
+                rule=self.id,
+                path=cfg.metric_names_rel,
+                line=1,
+                message=(
+                    f"metric-names module failed to load ({exc!r}) — it is "
+                    "executed by file path and must stay dependency-free"
+                ),
+            )
+            return
+        consts, members, families, dynamic = _registry(
+            names_mod, names_module.tree, cfg.dynamic_emitters
+        )
+        covered: Set[str] = set()
+        dynamic_emitter_called = False
+        sites: List[Tuple[ModuleInfo, ast.Call, ast.AST]] = []
+        for module in project.modules:
+            if module.rel == cfg.metric_names_rel:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in cfg.dynamic_emitters
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    dynamic_emitter_called = True
+                arg = _constructor_name_arg(node, cfg)
+                if arg is not None:
+                    sites.append((module, node, arg))
+
+        for module, node, arg in sites:
+            yield from self._check_site(
+                module, node, arg, consts, members, covered, cfg
+            )
+
+        if dynamic_emitter_called:
+            covered |= dynamic
+        for family, values in sorted(families.items()):
+            for value in sorted(values - covered):
+                yield Finding(
+                    rule=self.id,
+                    path=cfg.metric_names_rel,
+                    line=self._def_line(names_module, value, consts),
+                    message=(
+                        f"dead metric name {value!r} in {family} — no "
+                        "constructor site (and no dynamic emitter) "
+                        "registers this family; delete it or wire the "
+                        "emitter"
+                    ),
+                )
+
+    def _check_site(
+        self, module: ModuleInfo, node: ast.Call, arg: ast.AST,
+        consts: Dict[str, str], members: Set[str], covered: Set[str], cfg,
+    ) -> Iterator[Finding]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not arg.value.startswith(cfg.prefix):
+                return  # not one of ours (tests, third-party registries)
+            covered.add(arg.value)
+            yield Finding.at(
+                module, node, self.id,
+                f"literal metric name {arg.value!r} at a constructor site "
+                f"in {module.qualname(node)} — import the constant from "
+                "the metric-names registry",
+            )
+            return
+        # mn.X / metric_names.X / bare X resolved through the registry.
+        const_name = None
+        if isinstance(arg, ast.Attribute):
+            const_name = arg.attr
+        elif isinstance(arg, ast.Name):
+            const_name = arg.id
+        if const_name is None or const_name not in consts:
+            return  # dynamic expression — the runtime half covers it
+        value = consts[const_name]
+        if not value.startswith(cfg.prefix):
+            return
+        covered.add(value)
+        if value not in members:
+            yield Finding.at(
+                module, node, self.id,
+                f"metric {const_name} ({value!r}) constructed in "
+                f"{module.qualname(node)} but pinned in no ALL_* family — "
+                "add it to the matching tuple in the metric-names "
+                "registry",
+            )
+
+    @staticmethod
+    def _def_line(
+        names_module: ModuleInfo, value: str, consts: Dict[str, str]
+    ) -> int:
+        """Line of the constant's assignment in metric_names.py (best
+        effort: the first assignment whose target resolves to ``value``)."""
+        rev = {v: k for k, v in consts.items()}
+        want = rev.get(value)
+        for node in ast.walk(names_module.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == want:
+                        return node.lineno
+        return 1

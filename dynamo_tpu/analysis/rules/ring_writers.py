@@ -1,0 +1,140 @@
+"""DYN005 single-writer rings: every flight-recorder append site is
+statically attributable to the ring's one owning class.
+
+The FlightRecorder contract (PR 4): ``record`` is lock-free O(1) append
+into a preallocated slot, sound ONLY because exactly one thread writes
+each ring — the engine tick loop owns the "engine" ring, the device
+thread owns the "runner" ring. A second writer tears the index/slot pair
+and the post-mortem you need is the one that gets corrupted.
+
+Statically enforced as ownership-by-class:
+  * ring constructions ``self.<attr> = FlightRecorder("<name>")`` must
+    appear in the configured owning class for that name (unknown ring
+    names are findings — new rings register an owner in
+    analysis/config.py before they exist);
+  * append sites ``<recv>.<attr>.record(...)`` must be ``self.<attr>``
+    inside the owning class. Reaching through another object
+    (``self.runner.flight.record(...)``) is a cross-thread write by
+    construction and is flagged at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register_rule,
+)
+
+
+def _ring_constructions(
+    module: ModuleInfo, cfg
+) -> Iterator[Tuple[ast.AST, str, Optional[str], str]]:
+    """(node, ring name, class name or None, attr) for every
+    ``self.<attr> = FlightRecorder("<name>")``."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        if not (
+            isinstance(val, ast.Call)
+            and isinstance(val.func, (ast.Name, ast.Attribute))
+            and (
+                val.func.id
+                if isinstance(val.func, ast.Name)
+                else val.func.attr
+            )
+            == cfg.recorder_class
+        ):
+            continue
+        ring = None
+        if val.args and isinstance(val.args[0], ast.Constant):
+            ring = val.args[0].value
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and tgt.attr in cfg.ring_attrs
+            ):
+                cls = module.enclosing_class(node)
+                yield node, str(ring), cls.name if cls else None, tgt.attr
+
+
+@register_rule
+class RingWriterRule(Rule):
+    id = "DYN005"
+    title = "flight-recorder rings have exactly one owning class"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.rings
+        if cfg is None:
+            return
+        owners: Dict[str, Tuple[str, str]] = dict(cfg.owners)
+        for module in project.modules:
+            if module.rel.startswith("analysis/"):
+                continue
+            for node, ring, cls, _attr in _ring_constructions(module, cfg):
+                owner = owners.get(ring)
+                if owner is None:
+                    yield Finding.at(
+                        module, node, self.id,
+                        f"flight ring {ring!r} constructed in "
+                        f"{module.qualname(node)} has no registered owner "
+                        "— map it to its one writer class in "
+                        "analysis/config.py",
+                    )
+                elif owner != (module.rel, cls):
+                    yield Finding.at(
+                        module, node, self.id,
+                        f"flight ring {ring!r} constructed in "
+                        f"{module.rel}:{cls} but owned by "
+                        f"{owner[0]}:{owner[1]} — a second constructor "
+                        "means a second writer thread",
+                    )
+            yield from self._check_appends(module, owners, cfg)
+
+    def _check_appends(
+        self, module: ModuleInfo, owners: Dict[str, Tuple[str, str]], cfg
+    ) -> Iterator[Finding]:
+        if module.rel.startswith("analysis/"):
+            return
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr in cfg.ring_attrs
+            ):
+                continue
+            recv = node.func.value.value  # expr before `.flight.record`
+            ctx = module.qualname(node)
+            if not (isinstance(recv, ast.Name) and recv.id == "self"):
+                yield Finding.at(
+                    module, node, self.id,
+                    f"flight-ring append through a foreign object in "
+                    f"{ctx} — only the owning class may append to its "
+                    "ring (single-writer contract); emit an event on "
+                    "YOUR ring or route through the owner's thread",
+                )
+                continue
+            cls = module.enclosing_class(node)
+            cls_name = cls.name if cls else None
+            owning = {
+                ring
+                for ring, (rel, owner_cls) in owners.items()
+                if rel == module.rel and owner_cls == cls_name
+            }
+            if not owning:
+                yield Finding.at(
+                    module, node, self.id,
+                    f"flight-ring append in {ctx} but "
+                    f"{module.rel}:{cls_name} owns no registered ring — "
+                    "register the ring's owner in analysis/config.py",
+                )

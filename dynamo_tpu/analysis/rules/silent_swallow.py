@@ -1,0 +1,78 @@
+"""DYN003 silent-swallow: no broad ``except: pass`` anywhere in the
+package. A bare/``Exception``-wide handler whose body does nothing makes
+a real failure (a wedged checkpoint write, a dead event plane, a leaked
+lease) indistinguishable from success — the flight recorder and request
+timelines exist precisely so failures leave a trace.
+
+A handler passes when it either narrows the exception (OSError,
+asyncio.CancelledError, ...) or DOES something: logs, records a flight
+event, re-raises, returns a degraded value. Intentionally-broad
+swallows carry ``# dynlint: disable=DYN003 -- <why>`` — this rule
+requires the reason (core enforces it via ``requires_reason``)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    register_rule,
+    terminal_attr,
+)
+
+
+def _broad_names(handler: ast.ExceptHandler) -> "list[str]":
+    """Names of caught broad exceptions; [''] for a bare except."""
+    t = handler.type
+    if t is None:
+        return ["<bare>"]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        name = terminal_attr(e)
+        if name is not None:
+            out.append(name)
+    return out
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """Body does nothing observable: only pass/``...``/docstring."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, ast.Continue):
+            continue  # loop-swallow: just as silent as pass
+        return False
+    return True
+
+
+@register_rule
+class SilentSwallowRule(Rule):
+    id = "DYN003"
+    title = "no silent broad exception swallows"
+    requires_reason = True
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        broad = config.swallow.broad_names
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                names = _broad_names(node)
+                hit = [
+                    n for n in names if n == "<bare>" or n in broad
+                ]
+                if not hit or not _is_silent(node):
+                    continue
+                caught = ", ".join(names)
+                yield Finding.at(
+                    module, node, self.id,
+                    f"silent broad swallow (except {caught}: pass) in "
+                    f"{module.qualname(node)} — narrow the exception or "
+                    "record the failure (flight recorder / log)",
+                )

@@ -70,6 +70,17 @@ def main(argv=None) -> None:
         "(/debug/memory /debug/compiles /debug/flight)",
     )
     add_observe_args(observe_p)
+    # Lazy import: lint is jax-free and must stay that way (it runs on
+    # boxes where the serving deps don't), so it can't ride cli.run's
+    # imports.
+    from dynamo_tpu.analysis.cli import add_lint_args
+
+    lint_p = sub.add_parser(
+        "lint",
+        help="run the dynlint static-analysis passes over the package "
+        "(exit 1 on non-baselined findings)",
+    )
+    add_lint_args(lint_p)
     sub.add_parser("env", help="print the environment-variable registry")
     args = parser.parse_args(argv)
 
@@ -79,6 +90,10 @@ def main(argv=None) -> None:
         asyncio.run(main_run(args))
     elif args.command == "observe":
         asyncio.run(main_observe(args))
+    elif args.command == "lint":
+        from dynamo_tpu.analysis.cli import main_lint
+
+        raise SystemExit(main_lint(args))
 
 
 if __name__ == "__main__":

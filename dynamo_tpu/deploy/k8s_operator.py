@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional
 from dynamo_tpu.deploy.controller import GraphController
 from dynamo_tpu.deploy.k8s_client import KubeApiError, KubeClient
 from dynamo_tpu.deploy.spec import GraphDeployment
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -557,10 +558,7 @@ class K8sGraphOperator:
             for t in pending:
                 t.cancel()
             for t in pending:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+                await reap_task(t, "k8s-operator watch", logger)
 
     def start(self) -> None:
         self._stop.clear()
@@ -572,17 +570,11 @@ class K8sGraphOperator:
         self._stop.set()
         for t in list(self._ckpt_tasks.values()):
             t.cancel()
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(t, "checkpoint job", logger)
         self._ckpt_tasks = {}
         for t in self._tasks:
             t.cancel()
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(t, "k8s-operator run loop", logger)
         self._tasks = []
         if self.leader_elector is not None:
             # Release the lease only AFTER the run loop has fully exited:

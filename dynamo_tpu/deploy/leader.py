@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Optional, Tuple
 
 from dynamo_tpu.deploy.k8s_client import KubeApiError
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -212,10 +213,7 @@ class LeaderElector:
         self._stop.set()
         if self._task is not None:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, f"leader-{self.name} loop", logger)
             self._task = None
         if self.is_leader:
             # Graceful release: zero the holder so a peer takes over at its
@@ -247,6 +245,11 @@ class LeaderElector:
                         "leader election %s: graceful release failed (%s)",
                         self.name, exc,
                     )
-            except Exception:
-                pass
+            except Exception as exc:
+                # Release is best-effort (the lease expires on its own),
+                # but the failure must not be invisible.
+                logger.debug(
+                    "leader election %s: graceful release errored (%s)",
+                    self.name, exc,
+                )
             self._become(False)

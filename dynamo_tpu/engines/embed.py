@@ -18,6 +18,7 @@ import numpy as np
 
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.device_observe import watched_jit
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -51,7 +52,12 @@ class EmbeddingEngine:
             if params is not None
             else llama.init_params(config, jax.random.PRNGKey(seed))
         )
-        self._encode = jax.jit(functools.partial(llama.encode, config=config))
+        # Signature count tracks the pow2 (batch, length) buckets —
+        # bounded by design, so the default budget is plenty.
+        self._encode = watched_jit(
+            "embed.encode",
+            jax.jit(functools.partial(llama.encode, config=config)),
+        )
         self.embedded_texts = 0
 
     def _embed_batch(self, token_lists: List[List[int]]) -> np.ndarray:

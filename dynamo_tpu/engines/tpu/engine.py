@@ -1282,6 +1282,7 @@ class JaxEngine:
         Only ever reached with an empty pipeline (_dispatch_burst drains
         before letting allocation fail), so the recompute — whose sampling
         keys are position-salted — regenerates the identical stream."""
+        # dynlint: disable=DYN002 -- preemption is a capacity event, not a steady-state tick: it fires at most once per pool exhaustion and operators page on it
         logger.warning("preempting request %s (KV pool exhausted)", seq.request.request_id)
         self.flight.record(
             "preempt", request_id=seq.request.request_id, slot=seq.slot,

@@ -804,6 +804,7 @@ class DeviceRunner:
             if a is not None and hasattr(a, "copy_to_host_async"):
                 try:
                     a.copy_to_host_async()
+                # dynlint: disable=DYN003 -- best-effort prefetch: device_get below is the real (reported) readback, and a per-array log here would spam every reap on backends without async copies
                 except Exception:
                     pass
         return tuple(

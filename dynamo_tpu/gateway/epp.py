@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 from aiohttp import web
 
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
@@ -186,9 +187,6 @@ class EndpointPicker:
     async def stop(self) -> None:
         if self._sweeper is not None:
             self._sweeper.cancel()
-            try:
-                await self._sweeper
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._sweeper, "epp session sweeper", logger)
         if self._runner is not None:
             await self._runner.cleanup()

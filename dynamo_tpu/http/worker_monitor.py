@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 from dynamo_tpu.router.protocols import LoadSnapshot, load_topic
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -76,10 +77,7 @@ class WorkerLoadMonitor:
             self._sub = None
         if self._task is not None:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, "worker-load monitor pump", logger)
             self._task = None
 
     async def _pump(self) -> None:

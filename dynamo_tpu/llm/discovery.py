@@ -24,6 +24,7 @@ from dynamo_tpu.router import KvRouter, KvRouterConfig
 from dynamo_tpu.runtime.component import Endpoint, RouterMode
 from dynamo_tpu.runtime.discovery import MODELS_PREFIX, model_key
 from dynamo_tpu.runtime.pipeline import build_pipeline
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -105,10 +106,7 @@ class ModelWatcher:
             await self._watch.aclose()
         if self._task is not None:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._task, "model-watcher", logger)
         for slug in list(self._models):
             await self._remove_model(slug)
 

@@ -180,8 +180,7 @@ def _quick_gelu(x):
     return x * jax.nn.sigmoid(1.702 * x)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def encode_images(
+def _encode_images_impl(
     params: Dict[str, Any],
     images: jnp.ndarray,  # [N, H, W, 3] uint8 or pre-normalized float
     config: VisionEncoderConfig,
@@ -189,7 +188,10 @@ def encode_images(
 ) -> jnp.ndarray:
     """[N, n_patches, out_dim] LLM-space patch embeddings (class token
     dropped, LLaVA-style), or [N, n_patches+1, d_model] with
-    ``raw_hidden`` (the CLIPVisionModel last_hidden_state for parity)."""
+    ``raw_hidden`` (the CLIPVisionModel last_hidden_state for parity).
+
+    Jitted + watched as ``encode_images`` below (DYN001: a decorator jit
+    is invisible to /debug/compiles)."""
     c = config
     N = images.shape[0]
     p = c.patch_size
@@ -233,3 +235,13 @@ def encode_images(
     if raw_hidden:
         return x
     return x[:, 1:] @ params["out_proj"]  # patches only, LLM space
+
+
+from dynamo_tpu.runtime.device_observe import watched_jit  # noqa: E402
+
+# Signatures track distinct [N, H, W] image batch shapes; the media
+# pipeline normalizes to one resolution, so the default budget holds.
+encode_images = watched_jit(
+    "multimodal.encode_images",
+    functools.partial(jax.jit, static_argnums=(2, 3))(_encode_images_impl),
+)

@@ -515,11 +515,7 @@ def history_pcounts(
     return jnp.minimum((start32 + block_size - 1) // block_size, table_width)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("eps", "sm_scale", "batch_block", "interpret"),
-)
-def fused_decoder_layer(
+def _fused_decoder_layer_impl(
     x: jnp.ndarray,  # [B, d] bf16 residual
     cos: jnp.ndarray,  # [B, D] f32
     sin: jnp.ndarray,  # [B, D] f32
@@ -605,3 +601,18 @@ def fused_decoder_layer(
         k_pool, v_pool,
     )
     return out
+
+
+# Jitted + watched program object (DYN001): the megakernel's signature
+# count tracks (pow2 table-width bucket × variant) — exactly what the
+# runner budgets via set_budget, and what a per-request width leak would
+# blow through (the recompile-storm signal the runtime detector pages on).
+from dynamo_tpu.runtime.device_observe import watched_jit  # noqa: E402
+
+fused_decoder_layer = watched_jit(
+    "pallas.fused_decoder_layer",
+    functools.partial(
+        jax.jit,
+        static_argnames=("eps", "sm_scale", "batch_block", "interpret"),
+    )(_fused_decoder_layer_impl),
+)

@@ -285,10 +285,7 @@ def _decode_kernel(
                 o_ref[j, h] = out.astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "batch_block", "logit_cap")
-)
-def paged_attention_decode_kernel(
+def _paged_attention_decode_kernel_impl(
     q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
     k_cache,  # [num_blocks, block_size, KH, D] — or {"q8", "s"} int8 pool
     v_cache,
@@ -416,11 +413,7 @@ def paged_attention_decode_kernel(
     return out.reshape(B, C, n_heads, head_dim)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("sm_scale", "interpret", "pages_per_step", "logit_cap"),
-)
-def paged_attention_kernel(
+def _paged_attention_kernel_impl(
     q: jnp.ndarray,  # [B, C, n_heads, head_dim]
     k_cache,  # [num_blocks, block_size, KH, D] — or {"q8", "s"} int8 pool
     v_cache,
@@ -528,3 +521,26 @@ def paged_attention_kernel(
     )
     out = out.reshape(B, n_kv_heads, C, G, head_dim).transpose(0, 2, 1, 3, 4)
     return out.reshape(B, C, n_heads, head_dim)
+
+
+# Jitted + watched program objects (DYN001): decorator jits are invisible
+# to /debug/compiles; wrapping the jitted impls here gives the pallas
+# attention plane compile telemetry and a storm budget keyed on the pow2
+# table-width buckets the runner dispatches.
+from dynamo_tpu.runtime.device_observe import watched_jit  # noqa: E402
+
+paged_attention_decode_kernel = watched_jit(
+    "pallas.paged_attention_decode",
+    functools.partial(
+        jax.jit,
+        static_argnames=("sm_scale", "interpret", "batch_block", "logit_cap"),
+    )(_paged_attention_decode_kernel_impl),
+)
+
+paged_attention_kernel = watched_jit(
+    "pallas.paged_attention",
+    functools.partial(
+        jax.jit,
+        static_argnames=("sm_scale", "interpret", "pages_per_step", "logit_cap"),
+    )(_paged_attention_kernel_impl),
+)

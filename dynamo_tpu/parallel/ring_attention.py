@@ -102,6 +102,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", *, causal: bool = True):
     """Jitted [B, T, H, D] ring attention with T sharded over ``axis``."""
     spec = P(None, axis, None, None)
     from dynamo_tpu.parallel.sharding import shard_map_unchecked
+    from dynamo_tpu.runtime.device_observe import watched_jit
 
     fn = shard_map_unchecked(
         functools.partial(ring_attention, axis=axis, causal=causal),
@@ -109,4 +110,4 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", *, causal: bool = True):
         (spec, spec, spec),
         spec,
     )
-    return jax.jit(fn)
+    return watched_jit("parallel.ring_attention", jax.jit(fn))

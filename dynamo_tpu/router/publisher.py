@@ -20,6 +20,7 @@ from dynamo_tpu.router.protocols import (
     kv_sync_topic,
     load_topic,
 )
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -142,10 +143,7 @@ class KvEventPublisher:
     async def close(self) -> None:
         if self._sync_task is not None:
             self._sync_task.cancel()
-            try:
-                await self._sync_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._sync_task, "kv-event sync task", logger)
             self._sync_task = None
         if self._task is not None and not self._task.done():
             self._queue.put_nowait(None)

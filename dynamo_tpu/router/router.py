@@ -31,6 +31,7 @@ from dynamo_tpu.router.protocols import (
 )
 from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
 from dynamo_tpu.runtime import lifecycle
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
@@ -173,10 +174,7 @@ class KvRouter:
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(task, "router subscription pump", logger)
         self._tasks = []
         self._subs = []
 

@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.discovery import (
     instance_prefix,
 )
 from dynamo_tpu.runtime.engine import AsyncEngine, as_engine
+from dynamo_tpu.runtime.tasks import reap_task
 
 if TYPE_CHECKING:
     from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -239,10 +240,7 @@ class Client:
             self._watch = None
         if self._watch_task is not None:
             self._watch_task.cancel()
-            try:
-                await self._watch_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._watch_task, "endpoint watch", logger)
             self._watch_task = None
 
     # -- routing ----------------------------------------------------------

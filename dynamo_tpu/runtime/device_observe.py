@@ -307,8 +307,8 @@ def tree_device_bytes(tree: Any) -> int:
         if nb is not None:
             try:
                 total += int(nb)
-            except Exception:
-                pass
+            except (TypeError, ValueError):
+                pass  # exotic nbytes (property raising, non-numeric)
     return total
 
 

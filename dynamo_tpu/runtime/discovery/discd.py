@@ -29,6 +29,7 @@ from dynamo_tpu.runtime.discovery import (
     _WATCH_CLOSED,
 )
 from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -86,10 +87,7 @@ class DiscdServer:
     async def stop(self) -> None:
         if self._sweeper is not None:
             self._sweeper.cancel()
-            try:
-                await self._sweeper
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._sweeper, "discd lease sweeper", logger)
         if self.snapshot_path and self._dirty:
             self._save_snapshot()
         if self._server is not None:
@@ -405,10 +403,7 @@ class DiscdDiscovery:
         self._closed = True
         if self._pump is not None:
             self._pump.cancel()
-            try:
-                await self._pump
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._pump, "discd event pump", logger)
         if self._fw is not None:
             self._fw.close()
             self._fw = None

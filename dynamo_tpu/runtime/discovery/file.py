@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.discovery import (
     WatchEvent,
     _WATCH_CLOSED,
 )
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -223,8 +224,5 @@ class FileDiscovery:
         self._closed = True
         if self._poll_task is not None:
             self._poll_task.cancel()
-            try:
-                await self._poll_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._poll_task, "file-discovery poll", logger)
             self._poll_task = None

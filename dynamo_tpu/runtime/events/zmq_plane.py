@@ -22,6 +22,7 @@ import zmq
 import zmq.asyncio
 
 from dynamo_tpu.runtime.events import Subscription, _SUB_CLOSED, topic_matches
+from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -217,17 +218,16 @@ class EventBroker:
                 logger.exception("event replay request failed")
                 try:
                     await self._rep.send(msgpack.packb({"error": "replay failed"}))
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # The requester already sees a timeout; the socket
+                    # state is what matters here.
+                    logger.debug("replay error reply also failed: %s", exc)
 
     async def close(self) -> None:
         for task in (self._task, self._replay_task):
             if task is not None:
                 task.cancel()
-                try:
-                    await task
-                except (asyncio.CancelledError, Exception):
-                    pass
+                await reap_task(task, "event-broker pump", logger)
         self._xsub.close(0)
         self._xpub.close(0)
         if self._rep is not None:
@@ -323,10 +323,7 @@ class ZmqEventPlane:
     async def close(self) -> None:
         for _, sub, sock, task in list(self._subs):
             task.cancel()
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(task, "zmq subscription pump", logger)
             sock.close(0)
         self._subs.clear()
         self._pub.close(0)

@@ -29,6 +29,9 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from dynamo_tpu import config
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 SLOW_REQUEST_S = config.env_float(
     "DYN_TPU_SLOW_REQUEST_S", 30.0,
@@ -191,7 +194,9 @@ class RequestLifecycle:
                         while len(self._slow) > self.max_slow:
                             self._slow.popitem(last=False)
         except Exception:
-            pass
+            # Timeline capture must never break serving — but a capture
+            # bug must not be invisible either.
+            logger.debug("request-timeline capture failed", exc_info=True)
 
     def get(self, request_id: str) -> Optional[RequestTimeline]:
         with self._lock:

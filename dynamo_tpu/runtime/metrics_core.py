@@ -27,6 +27,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
 LabelKey = Tuple[str, ...]
 
 DEFAULT_BUCKETS = (
@@ -275,7 +279,8 @@ class MetricsRegistry:
             try:
                 fn()
             except Exception:  # a broken sampler must not break the scrape
-                pass
+                logger.debug("metrics render hook %r failed", fn,
+                             exc_info=True)
         lines: List[str] = []
         for m in self._metrics:
             lines.extend(m.render(openmetrics=openmetrics))

@@ -27,7 +27,7 @@ from typing import Any, AsyncIterator, Dict, Optional, Tuple
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
-from dynamo_tpu.runtime.tasks import TaskTracker
+from dynamo_tpu.runtime.tasks import TaskTracker, reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -121,10 +121,7 @@ class TcpRequestPlane:
                 ctx.stop_generating(reason="connection-closed")
                 task.cancel()
             for task, _ in list(streams.values()):
-                try:
-                    await task
-                except (asyncio.CancelledError, Exception):
-                    pass
+                await reap_task(task, "ingress stream", logger)
             fw.close()
             self._ingress_writers.discard(writer)
 
@@ -263,10 +260,7 @@ class _ClientConn:
             self._fw.close()
         if self._pump is not None:
             self._pump.cancel()
-            try:
-                await self._pump
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(self._pump, "tcp client pump", logger)
 
 
 class _TcpClientEngine:

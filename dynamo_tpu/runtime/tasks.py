@@ -16,6 +16,36 @@ from typing import Any, Callable, Coroutine, Optional, Set
 logger = logging.getLogger(__name__)
 
 
+async def reap_task(
+    task: Optional["asyncio.Future"],
+    what: str = "task",
+    log: Optional[logging.Logger] = None,
+) -> Optional[BaseException]:
+    """Await a (usually just-cancelled) background task at shutdown.
+
+    Cancellation is the expected outcome. A real exception is returned
+    and recorded at DEBUG — the task's own failure path already reported
+    it when it happened; this is only the reaper's receipt (DYN003: a
+    broad swallow must leave a trace)."""
+    if task is None:
+        return None
+    try:
+        # shield: a cancellation of the REAPER (the shutdown path itself
+        # sits under wait_for somewhere) must not be mistaken for — or
+        # converted into — the task's own cancellation. A bare `await
+        # task` would forward the reaper's cancel into the task and then
+        # swallow it, making the shutdown path uncancellable.
+        await asyncio.shield(task)
+    except asyncio.CancelledError:
+        if task.cancelled():
+            return None
+        raise  # reaper cancelled; keep unwinding cooperatively
+    except Exception as exc:
+        (log or logger).debug("%s ended with %r at shutdown", what, exc)
+        return exc
+    return None
+
+
 class TaskTracker:
     def __init__(self, name: str = "tracker") -> None:
         self.name = name

@@ -25,7 +25,9 @@ from dynamo_tpu.models.config import (
 from dynamo_tpu.parallel import MeshConfig, make_mesh
 from dynamo_tpu.router import KvEventPublisher, LoadPublisher
 from dynamo_tpu.runtime.distributed import DistributedRuntime
-from dynamo_tpu.utils.logging import configure_logging
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger(__name__)
 
 BUILTIN_CONFIGS = {
     "tiny": tiny_config,
@@ -394,8 +396,13 @@ async def main() -> None:
             # warm checkpoint with an empty one.
             try:
                 await engine.save_checkpoint(args.kv_checkpoint_dir)
-            except Exception:
-                pass  # shutdown best-effort; next start just runs cold
+            except Exception as exc:
+                # Shutdown best-effort; next start just runs cold — but a
+                # persistently failing checkpoint dir should be findable.
+                logger.warning(
+                    "KV checkpoint save failed on shutdown "
+                    "(next start runs cold): %s", exc,
+                )
         if system_server is not None:
             await system_server.stop()
         if kvbm is not None:

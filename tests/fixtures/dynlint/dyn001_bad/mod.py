@@ -1,0 +1,22 @@
+"""DYN001 bad fixture: un-watched, per-call, in-loop, and decorator jits."""
+
+import functools
+
+import jax
+
+
+def hot_call(fn, xs):
+    step = jax.jit(fn)  # un-watched AND rebuilt per call
+    return step(xs)
+
+
+def loopy(fns, x):
+    outs = []
+    for f in fns:
+        outs.append(jax.jit(f)(x))  # constructed inside a loop
+    return outs
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def decorated(x, n):
+    return x * n

@@ -1,0 +1,33 @@
+"""DYN001 good fixture: every construction context the rule blesses."""
+
+import functools
+
+import jax
+
+from telemetry import watched_jit  # parsed, never imported
+
+# Module level: a constant program object.
+add_one = watched_jit("fixture.add_one", jax.jit(lambda x: x + 1))
+
+_programs = {}
+
+
+class Engine:
+    def __init__(self):
+        self._fn = watched_jit("fixture.engine", jax.jit(lambda x: x * 2))
+
+    def _build_step(self, k):
+        # Builder-named factory (cached by the caller).
+        return watched_jit(
+            "fixture.step",
+            functools.partial(jax.jit, static_argnums=(1,))(
+                lambda x, n: x + n
+            ),
+            budget=4,
+        )
+
+    def lookup(self, key):
+        # Memo guard: constructed only on cache miss.
+        if key not in _programs:
+            _programs[key] = watched_jit("fixture.memo", jax.jit(lambda x: x))
+        return _programs[key]

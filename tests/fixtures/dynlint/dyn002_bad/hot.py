@@ -1,0 +1,32 @@
+"""DYN002 bad fixture: every banned pattern, reachable from Engine.tick
+(including through executor indirection)."""
+
+import logging
+import threading
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def tick(self):
+        self._device(self.dispatch)  # executor indirection still an edge
+        logger.info("ticked")  # log above DEBUG on the steady path
+        with self._lock:  # unlisted lock
+            self.n += 1
+
+    def _device(self, fn):
+        return fn()
+
+    def dispatch(self):
+        x = self.fn()
+        x.block_until_ready()  # blocking device sync
+        host = np.asarray(self.slot_state["tokens"])  # device conversion
+        pos = int(self.slot_state["pos"][0])  # scalar device readback
+        return jax.device_get(x), host, pos

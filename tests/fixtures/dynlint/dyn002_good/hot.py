@@ -1,0 +1,32 @@
+"""DYN002 good fixture: host mirrors convert freely, DEBUG logging is
+fine, error paths may speak, and the boundary funnel may sync."""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class Engine:
+    def tick(self):
+        rows = self.dispatch()
+        return self.read(rows)
+
+    def dispatch(self):
+        # Host-mirror numpy work: not device state.
+        idx = np.asarray(self._dirty, dtype=np.int64)
+        count = int(self._pos[0])
+        logger.debug("dispatching %d rows", count)
+        try:
+            return self.fn(idx)
+        except Exception:
+            logger.exception("dispatch failed")  # error path may log
+            raise
+
+    def read(self, handles):
+        return self._get_all(handles)
+
+    def _get_all(self, handles):
+        # Boundary function (configured): the sanctioned sync point.
+        return np.asarray(self.slot_state["tokens"])

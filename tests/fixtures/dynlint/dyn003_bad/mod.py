@@ -1,0 +1,33 @@
+"""DYN003 bad fixture: silent broad swallows, including a reason-less
+suppression (which must NOT silence the rule)."""
+
+import asyncio
+
+
+def bare(fn):
+    try:
+        fn()
+    except:  # noqa: E722
+        pass
+
+
+def broad(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+async def tuple_swallow(task):
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+def reasonless(fn):
+    try:
+        fn()
+    # dynlint: disable=DYN003
+    except Exception:
+        pass

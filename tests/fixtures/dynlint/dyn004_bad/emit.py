@@ -1,0 +1,11 @@
+"""DYN004 bad fixture emitters: a literal name at a constructor site and
+a constructed-but-unpinned constant."""
+
+import names as mn
+
+
+class Metrics:
+    def __init__(self, registry):
+        self.live = registry.counter(mn.LIVE, "fine")
+        self.literal = registry.gauge("dynamo_tpu_fix_literal", "bad")
+        self.unpinned = registry.histogram(mn.UNPINNED, "bad")

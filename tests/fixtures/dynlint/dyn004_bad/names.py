@@ -1,0 +1,8 @@
+"""DYN004 bad fixture's name registry: one live name, one dead name."""
+
+PREFIX = "dynamo_tpu_fix"
+LIVE = f"{PREFIX}_live_total"
+DEAD = f"{PREFIX}_dead_total"
+UNPINNED = f"{PREFIX}_unpinned_total"  # constructed but in no family
+
+ALL_FIX = (LIVE, DEAD)

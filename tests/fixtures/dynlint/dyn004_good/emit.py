@@ -1,0 +1,13 @@
+"""DYN004 good fixture emitters: constant-named constructor plus a
+dynamic emitter rendering the stats dict."""
+
+import names as mn
+from names import fix_gauge
+
+
+class Metrics:
+    def __init__(self, registry):
+        self.live = registry.counter(mn.LIVE, "fine")
+
+    def render(self, stats):
+        return [(fix_gauge(key), value) for key, value in stats.items()]

@@ -1,0 +1,13 @@
+"""DYN004 good fixture registry: every name pinned and emitted — one via
+a constructor, one via the dynamic emitter."""
+
+
+def fix_gauge(key):
+    return f"dynamo_tpu_fix_{key}"
+
+
+PREFIX = "dynamo_tpu_fix"
+LIVE = f"{PREFIX}_live_total"
+DYNAMIC = fix_gauge("dynamic")
+
+ALL_FIX = (LIVE, DYNAMIC)

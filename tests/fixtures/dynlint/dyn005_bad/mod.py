@@ -1,0 +1,25 @@
+"""DYN005 bad fixture: unregistered ring, wrong-class construction, and
+a foreign-object append."""
+
+from telemetry import FlightRecorder  # parsed, never imported
+
+
+class Owner:
+    def __init__(self):
+        self.flight = FlightRecorder("ring")
+
+    def work(self):
+        self.flight.record("work")
+
+
+class Impostor:
+    def __init__(self):
+        self.flight = FlightRecorder("ring")  # second constructor
+
+    def boot(self):
+        self.flight = FlightRecorder("rogue")  # unregistered ring name
+
+
+class Foreign:
+    def poke(self, owner):
+        owner.flight.record("poke")  # cross-object (cross-thread) append

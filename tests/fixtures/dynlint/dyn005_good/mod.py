@@ -1,0 +1,17 @@
+"""DYN005 good fixture: the owning class constructs and appends; other
+classes only read."""
+
+from telemetry import FlightRecorder  # parsed, never imported
+
+
+class Owner:
+    def __init__(self):
+        self.flight = FlightRecorder("ring")
+
+    def work(self):
+        self.flight.record("work", n=1)
+
+
+class Reader:
+    def snapshot(self, owner):
+        return owner.flight.snapshot()  # reads are thread-safe

@@ -116,3 +116,97 @@ async def test_observe_snapshot_against_live_worker(capsys):
     finally:
         await server.stop()
         await engine.stop()
+
+
+# -- lint --------------------------------------------------------------------
+
+
+def test_lint_clean_over_package():
+    """`dynamo-tpu lint` over the shipped package: zero non-baselined
+    findings, exit 0."""
+    res = run_cli(["lint"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "dynlint: clean" in res.stderr
+
+
+def test_lint_json_format():
+    res = run_cli(["lint", "--format", "json"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["ok"] is True and doc["new"] == []
+
+
+def test_lint_detects_and_baselines_new_findings(tmp_path):
+    """Exit 1 on a fresh finding; --write-baseline grandfathers it; the
+    baselined run exits 0 again."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "bad.py").write_text(
+        "def f(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    baseline = tmp_path / "baseline.json"
+
+    res = run_cli(["lint", "--root", str(tree), "--baseline", ""])
+    assert res.returncode == 1
+    assert "DYN003" in res.stdout and "bad.py" in res.stdout
+
+    res = run_cli(
+        ["lint", "--root", str(tree), "--baseline", str(baseline),
+         "--write-baseline"]
+    )
+    assert res.returncode == 0 and baseline.exists()
+
+    res = run_cli(["lint", "--root", str(tree), "--baseline", str(baseline)])
+    assert res.returncode == 0
+    assert "grandfathered" in res.stderr
+
+
+def test_lint_rejects_unknown_rule():
+    res = run_cli(["lint", "--rules", "DYN999"])
+    assert res.returncode == 2
+    assert "unknown rule" in res.stderr
+
+
+def test_lint_foreign_root_runs_portable_rules_only():
+    """A --root outside the package must not drown in repo-config
+    mismatch noise (hot-path roots, metric registry, ring owners): a
+    clean foreign tree exits 0 under the portable rules."""
+    good = os.path.join(
+        os.path.dirname(__file__), "fixtures", "dynlint", "dyn003_good"
+    )
+    res = run_cli(["lint", "--root", good, "--baseline", ""])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "dynlint: clean" in res.stderr
+
+
+def test_lint_foreign_root_rejects_repo_scoped_rules(tmp_path):
+    """Explicitly asking for a repo-config rule on a foreign tree must
+    error, not silently report clean."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "ok.py").write_text("x = 1\n")
+    res = run_cli(
+        ["lint", "--root", str(tree), "--baseline", "", "--rules", "DYN004"]
+    )
+    assert res.returncode == 2
+    assert "disabled for a foreign --root" in res.stderr
+
+
+def test_lint_write_baseline_refuses_foreign_clobber(tmp_path):
+    """--write-baseline from a foreign --root must never overwrite the
+    checked-in package baseline (explicitly or via the default)."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "ok.py").write_text("x = 1\n")
+    res = run_cli(["lint", "--root", str(tree), "--write-baseline"])
+    assert res.returncode == 2
+    assert "refusing" in res.stderr
+    res = run_cli(
+        ["lint", "--root", str(tree), "--baseline", "", "--write-baseline"]
+    )
+    assert res.returncode == 2
+    assert "needs a --baseline PATH" in res.stderr

@@ -1,0 +1,325 @@
+"""dynlint: the tier-1 gate for the repo's static invariants, plus golden
+fixtures for each of the five passes (known-bad trees must trip, known-good
+trees must pass), suppression semantics, and baseline round-trips.
+
+Everything here is AST-only — no jax import, no device, and the full
+package run is budgeted under five seconds (the acceptance bar for
+running inside tier-1 on CPU)."""
+
+import json
+import os
+import time
+
+from dynamo_tpu.analysis import (
+    Finding,
+    LintConfig,
+    load_baseline,
+    partition_new,
+    run_lint,
+    save_baseline,
+)
+from dynamo_tpu.analysis.cli import DEFAULT_BASELINE
+from dynamo_tpu.analysis.config import (
+    HotPathConfig,
+    MetricClosureConfig,
+    RingWriterConfig,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "dynlint")
+PKG = os.path.join(os.path.dirname(__file__), "..", "dynamo_tpu")
+
+
+def lint_fixture(tree, config=None, rules=None):
+    return run_lint(os.path.join(FIXTURES, tree), config, rules)
+
+
+# -- the gate ----------------------------------------------------------------
+
+
+def test_package_has_zero_non_baselined_findings_under_five_seconds():
+    """THE invariant: `dynamo-tpu lint` over dynamo_tpu/ is clean modulo
+    the checked-in baseline, and fast enough to live in tier-1."""
+    t0 = time.monotonic()
+    findings = run_lint(os.path.abspath(PKG))
+    elapsed = time.monotonic() - t0
+    new, _old = partition_new(findings, load_baseline(DEFAULT_BASELINE))
+    assert not new, "new dynlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert elapsed < 5.0, f"analyzer took {elapsed:.2f}s (budget 5s)"
+
+
+def test_finding_count_matches_checked_in_baseline():
+    """The baseline is exact, not an upper bound: a FIXED grandfathered
+    finding must be removed from baseline.json (shrinking debt stays
+    visible in review, same as growing it)."""
+    findings = run_lint(os.path.abspath(PKG))
+    keys = load_baseline(DEFAULT_BASELINE)
+    new, grandfathered = partition_new(findings, keys)
+    assert not new
+    assert len(grandfathered) == len(keys), (
+        "baseline entries no longer observed — regenerate with "
+        "`dynamo-tpu lint --write-baseline`"
+    )
+
+
+# -- DYN001 jit discipline ---------------------------------------------------
+
+
+def test_dyn001_bad_fixture():
+    findings = lint_fixture("dyn001_bad", rules=["DYN001"])
+    msgs = [f.message for f in findings]
+    assert any("un-watched" in m and "hot_call" in m for m in msgs)
+    assert any("per-call body" in m and "hot_call" in m for m in msgs)
+    assert any("inside a loop" in m and "loopy" in m for m in msgs)
+    assert any("decorator jit" in m and "decorated" in m for m in msgs)
+    assert all(f.rule == "DYN001" for f in findings)
+    assert len(findings) == 5  # loopy is both un-watched and in-loop
+
+
+def test_dyn001_good_fixture():
+    assert lint_fixture("dyn001_good", rules=["DYN001"]) == []
+
+
+# -- DYN002 hot-path purity --------------------------------------------------
+
+
+def _hot_cfg():
+    return LintConfig(
+        hot_path=HotPathConfig(
+            roots=frozenset({("hot.py", "Engine.tick")}),
+            scope=frozenset({"hot.py"}),
+            boundaries=frozenset({("hot.py", "Engine._get_all")}),
+            device_roots=frozenset({"slot_state"}),
+        ),
+        metrics=None,
+        rings=None,
+    )
+
+
+def test_dyn002_bad_fixture():
+    findings = lint_fixture("dyn002_bad", _hot_cfg(), rules=["DYN002"])
+    msgs = [f.message for f in findings]
+    assert any("logger.info" in m for m in msgs)
+    assert any("lock acquired" in m for m in msgs)
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("np.asarray() over device state" in m for m in msgs)
+    assert any("int() over device state" in m for m in msgs)
+    assert any("jax.device_get" in m for m in msgs)
+    # dispatch is reached THROUGH the executor indirection, so all six
+    # banned patterns must be present.
+    assert len(findings) == 6
+
+
+def test_dyn002_good_fixture():
+    assert lint_fixture("dyn002_good", _hot_cfg(), rules=["DYN002"]) == []
+
+
+def test_dyn002_missing_root_is_a_finding():
+    cfg = LintConfig(
+        hot_path=HotPathConfig(
+            roots=frozenset({("hot.py", "Engine.renamed_tick")}),
+            scope=frozenset({"hot.py"}),
+        ),
+        metrics=None,
+        rings=None,
+    )
+    findings = lint_fixture("dyn002_good", cfg, rules=["DYN002"])
+    assert len(findings) == 1 and "not found" in findings[0].message
+
+
+# -- DYN003 silent swallow ---------------------------------------------------
+
+
+def test_dyn003_bad_fixture():
+    findings = lint_fixture("dyn003_bad", rules=["DYN003"])
+    by_func = {f.message.split(" in ")[1].split(" ")[0] for f in findings}
+    assert {"bare", "broad", "tuple_swallow", "reasonless"} <= by_func
+    reasonless = [f for f in findings if "reasonless" in f.message]
+    assert len(reasonless) == 1
+    assert "suppression needs a reason" in reasonless[0].message
+
+
+def test_dyn003_good_fixture():
+    assert lint_fixture("dyn003_good", rules=["DYN003"]) == []
+
+
+def test_dyn003_suppression_requires_reason(tmp_path):
+    src = (
+        "def f(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except Exception:\n"
+        "        pass{}\n"
+    )
+    mod = tmp_path / "m.py"
+
+    mod.write_text(src.format("  # dynlint: disable=DYN003"))
+    findings = run_lint(str(tmp_path), rule_ids=["DYN003"])
+    assert findings and "needs a reason" in findings[0].message
+
+    mod.write_text(src.format("  # dynlint: disable=DYN003 -- probe only"))
+    assert run_lint(str(tmp_path), rule_ids=["DYN003"]) == []
+
+
+# -- DYN004 metric closure ---------------------------------------------------
+
+
+def _metrics_cfg(dynamic=()):
+    return LintConfig(
+        hot_path=None,
+        rings=None,
+        metrics=MetricClosureConfig(
+            metric_names_rel="names.py",
+            dynamic_emitters=frozenset(dynamic),
+        ),
+    )
+
+
+def test_dyn004_bad_fixture():
+    findings = lint_fixture("dyn004_bad", _metrics_cfg(), rules=["DYN004"])
+    msgs = [f.message for f in findings]
+    assert any("literal metric name 'dynamo_tpu_fix_literal'" in m for m in msgs)
+    assert any("dead metric name 'dynamo_tpu_fix_dead_total'" in m for m in msgs)
+    assert any(
+        "UNPINNED" in m and "no ALL_* family" in m for m in msgs
+    )
+    assert len(findings) == 3
+
+
+def test_dyn004_good_fixture():
+    assert (
+        lint_fixture(
+            "dyn004_good", _metrics_cfg(dynamic=("fix_gauge",)),
+            rules=["DYN004"],
+        )
+        == []
+    )
+
+
+def test_dyn004_good_fixture_without_dynamic_emitter_flags_dead_name():
+    """The dynamic-emitter escape hatch is earned, not assumed: without
+    it the dynamically-rendered name counts as dead."""
+    findings = lint_fixture("dyn004_good", _metrics_cfg(), rules=["DYN004"])
+    assert len(findings) == 1
+    assert "dynamo_tpu_fix_dynamic" in findings[0].message
+
+
+# -- DYN005 single-writer rings ----------------------------------------------
+
+
+def _rings_cfg():
+    return LintConfig(
+        hot_path=None,
+        metrics=None,
+        rings=RingWriterConfig(owners={"ring": ("mod.py", "Owner")}),
+    )
+
+
+def test_dyn005_bad_fixture():
+    findings = lint_fixture("dyn005_bad", _rings_cfg(), rules=["DYN005"])
+    msgs = [f.message for f in findings]
+    assert any("no registered owner" in m and "rogue" in m for m in msgs)
+    assert any("second constructor" in m and "Impostor" in m for m in msgs)
+    assert any("foreign object" in m and "Foreign.poke" in m for m in msgs)
+
+
+def test_dyn005_good_fixture():
+    assert lint_fixture("dyn005_good", _rings_cfg(), rules=["DYN005"]) == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_trailing_and_standalone_suppressions(tmp_path):
+    (tmp_path / "a.py").write_text(
+        "import jax\n"
+        "f = jax.jit(lambda x: x)  # dynlint: disable=DYN001 -- fixture\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "import jax\n"
+        "# dynlint: disable=DYN001 -- fixture\n"
+        "g = jax.jit(lambda x: x)\n"
+    )
+    (tmp_path / "c.py").write_text(
+        "import jax\n"
+        "h = jax.jit(\n"
+        "    lambda x: x,\n"
+        ")  # dynlint: disable=DYN001 -- trailing on a multi-line statement\n"
+    )
+    assert run_lint(str(tmp_path), rule_ids=["DYN001"]) == []
+
+
+def test_suppression_does_not_leak_to_sibling_handlers(tmp_path):
+    """A reasoned suppression on one handler must not grandfather a
+    SIBLING broad swallow in the same try statement."""
+    (tmp_path / "a.py").write_text(
+        "def f(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except BaseException:\n"
+        "        pass\n"
+        "    # dynlint: disable=DYN003 -- probing an optional backend\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    findings = run_lint(str(tmp_path), rule_ids=["DYN003"])
+    assert len(findings) == 1
+    assert "BaseException" in findings[0].message
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    (tmp_path / "a.py").write_text(
+        "import jax\n"
+        "f = jax.jit(lambda x: x)  # dynlint: disable=DYN003 -- wrong rule\n"
+    )
+    findings = run_lint(str(tmp_path), rule_ids=["DYN001"])
+    assert len(findings) == 1 and findings[0].rule == "DYN001"
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    bad = os.path.join(FIXTURES, "dyn003_bad")
+    findings = run_lint(bad, rule_ids=["DYN003"])
+    assert findings
+
+    path = tmp_path / "baseline.json"
+    save_baseline(findings, str(path))
+    keys = load_baseline(str(path))
+    new, old = partition_new(findings, keys)
+    assert new == [] and len(old) == len(findings)
+
+    # A FRESH copy of a grandfathered finding is still new (multiset).
+    extra = Finding(
+        rule="DYN003", path=findings[0].path, line=999,
+        message=findings[0].message,
+    )
+    new, _ = partition_new(findings + [extra], keys)
+    assert len(new) == 1
+
+    doc = json.loads(path.read_text())
+    assert {"rule", "path", "message"} <= set(doc["findings"][0])
+
+
+def test_unparseable_module_is_a_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def nope(:\n")
+    findings = run_lint(str(tmp_path))
+    assert any(
+        f.rule == "DYN000" and "unparseable" in f.message for f in findings
+    )
+
+
+def test_dyn004_unloadable_names_module_is_a_finding(tmp_path):
+    """The names module is executed by path; a heavy/broken import in it
+    must surface as a finding, not crash the lint (the gate runs on
+    jax-free boxes by design)."""
+    (tmp_path / "runtime").mkdir()
+    (tmp_path / "runtime" / "metric_names.py").write_text(
+        "import not_a_real_dependency\n"
+    )
+    findings = run_lint(str(tmp_path), rule_ids=["DYN004"])
+    assert len(findings) == 1
+    assert "failed to load" in findings[0].message
+    assert "dependency-free" in findings[0].message

@@ -1,9 +1,15 @@
-"""Static lint: every emitted Prometheus name comes from
+"""Metric-name lint: every emitted Prometheus name comes from
 runtime/metric_names.py (ref: metrics/prometheus_names.rs rationale —
 dashboards, the planner's scrape source, and emitters must never drift).
 
-Any ``dynamo_tpu_*`` string literal outside metric_names.py is an emitter
-bypassing the canonical constants and fails this test.
+Two halves over ONE name registry (runtime/metric_names.py):
+  * runtime half (here): any ``dynamo_tpu_*`` string literal outside
+    metric_names.py fails, and the live device-observe emitters must
+    cover exactly ALL_RUNTIME;
+  * static half (dynamo_tpu/analysis rule DYN004, asserted clean below):
+    constructor sites resolve into ALL_* families and every family entry
+    has an emitter — see tests/test_dynlint.py for the rule's own
+    fixtures.
 """
 
 import os
@@ -20,6 +26,7 @@ DEFINING_FILE = os.path.join("runtime", "metric_names.py")
 # Non-metric literals that legitimately share the prefix.
 ALLOWED_LITERALS = {
     '"dynamo_tpu_context"',  # runtime/context.py ContextVar name
+    '"dynamo_tpu_"',  # analysis/config.py: DYN004's name-prefix config
 }
 
 
@@ -88,3 +95,14 @@ def test_runtime_family_covers_device_observe_emitters():
     ):
         emitted.update(m.name for m in obj.registry._metrics)
     assert emitted == set(mn.ALL_RUNTIME)
+
+
+def test_static_metric_closure_is_clean():
+    """The static half (dynlint DYN004) over the same registry: every
+    constructor site's name is pinned in an ALL_* family and every family
+    entry has an emitter. Rule fixtures live in tests/test_dynlint.py;
+    this asserts the PACKAGE satisfies the closure."""
+    from dynamo_tpu.analysis import run_lint
+
+    findings = run_lint(os.path.abspath(PKG), rule_ids=["DYN004"])
+    assert findings == [], "\n".join(f.render() for f in findings)

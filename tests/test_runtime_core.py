@@ -221,6 +221,46 @@ async def test_tracker_drain_waits_for_guards():
         tracker.guard()
 
 
+async def test_reap_task_swallows_task_cancellation_only():
+    """reap_task (the DYN003 shutdown idiom) absorbs the TASK's
+    cancellation and real failures (returned, debug-logged), but
+    re-raises when the REAPER itself is cancelled — the shutdown path
+    must stay cooperatively cancellable (e.g. under wait_for)."""
+    from dynamo_tpu.runtime.tasks import reap_task
+
+    loop = asyncio.get_running_loop()
+
+    # Task cancelled by us: swallowed.
+    t = loop.create_task(asyncio.sleep(30))
+    t.cancel()
+    assert await reap_task(t, "t") is None
+
+    # Task failed: exception returned, not raised.
+    async def boom():
+        raise ValueError("nope")
+
+    t = loop.create_task(boom())
+    await asyncio.sleep(0)
+    exc = await reap_task(t, "t")
+    assert isinstance(exc, ValueError)
+
+    # Reaper cancelled while the task is still running: re-raised, task
+    # untouched.
+    release = asyncio.Event()
+    t = loop.create_task(release.wait())
+    reaper = loop.create_task(reap_task(t, "t"))
+    await asyncio.sleep(0)
+    reaper.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await reaper
+    assert not t.cancelled() and not t.done()
+    release.set()
+    await t
+
+    # None is a no-op.
+    assert await reap_task(None) is None
+
+
 async def test_draining_endpoint_refuses_new_requests():
     drt = DistributedRuntime.detached()
     ep = drt.namespace("ns").component("w").endpoint("gen")
