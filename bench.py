@@ -624,6 +624,22 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
             ),
             "blocks_pulled": decode_handler.blocks_pulled,
             "transfer_failures": decode_handler.transfer_failures,
+            # Wire-format v2 telemetry: serialized bytes actually pulled,
+            # split by wire dtype (int8 pools ship {q8, scales} ≈ 0.53x
+            # the dense bf16 bytes), and the measured per-(src prefill
+            # worker → this decode worker) bandwidth EWMA the router's
+            # link-cost model consumes.
+            "wire_bytes": decode_handler.bytes_pulled,
+            "wire_bytes_by_dtype": dict(decode_handler.wire_bytes_by_dtype),
+            "wire_dtype": max(
+                decode_handler.wire_bytes_by_dtype,
+                key=decode_handler.wire_bytes_by_dtype.get,
+                default=None,
+            ),
+            "link_bandwidth_mb_per_s": {
+                str(src): round(bw / 1e6, 1)
+                for src, bw in decode_handler.link_bandwidth().items()
+            },
         }
     finally:
         for s in served:

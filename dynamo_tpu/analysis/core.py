@@ -118,9 +118,19 @@ class ModuleInfo:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self._parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
+        # ONE walk builds both the parent links and the flat node list.
+        # Rules iterate ``nodes`` instead of re-running ast.walk per rule —
+        # the tree is only ever traversed once per file (the analyzer's 5s
+        # tier-1 budget is mostly ast.walk overhead otherwise).
+        nodes: List[ast.AST] = [self.tree]
+        i = 0
+        while i < len(nodes):
+            parent = nodes[i]
+            i += 1
             for child in ast.iter_child_nodes(parent):
                 self._parents[child] = parent
+                nodes.append(child)
+        self.nodes: List[ast.AST] = nodes
         self.suppressions = self._scan_suppressions()
 
     # -- structure helpers --------------------------------------------------

@@ -66,7 +66,7 @@ def _index_scope(project: Project, scope) -> Dict[str, List[_Func]]:
     for module in project.modules:
         if module.rel not in scope:
             continue
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 f = _Func(module, node, module.qualname(node))
                 index.setdefault(node.name, []).append(f)

@@ -37,13 +37,13 @@ def _jit_references(module: ModuleInfo) -> List[ast.AST]:
     """Nodes referring to the jit transform itself: ``jax.jit`` attributes
     plus bare names bound by ``from jax import jit``."""
     jit_aliases = set()
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.ImportFrom) and node.module == "jax":
             for alias in node.names:
                 if alias.name == "jit":
                     jit_aliases.add(alias.asname or alias.name)
     refs: List[ast.AST] = []
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.Attribute) and dotted_name(node) == "jax.jit":
             refs.append(node)
         elif isinstance(node, ast.Name) and node.id in jit_aliases:

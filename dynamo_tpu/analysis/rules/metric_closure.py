@@ -130,7 +130,7 @@ class MetricClosureRule(Rule):
         for module in project.modules:
             if module.rel == cfg.metric_names_rel:
                 continue
-            for node in ast.walk(module.tree):
+            for node in module.nodes:
                 if not isinstance(node, ast.Call):
                     continue
                 if (

@@ -37,7 +37,7 @@ def _ring_constructions(
 ) -> Iterator[Tuple[ast.AST, str, Optional[str], str]]:
     """(node, ring name, class name or None, attr) for every
     ``self.<attr> = FlightRecorder("<name>")``."""
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if not isinstance(node, ast.Assign):
             continue
         val = node.value
@@ -104,7 +104,7 @@ class RingWriterRule(Rule):
     ) -> Iterator[Finding]:
         if module.rel.startswith("analysis/"):
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)

@@ -60,7 +60,7 @@ class SilentSwallowRule(Rule):
     def check(self, project: Project, config) -> Iterator[Finding]:
         broad = config.swallow.broad_names
         for module in project.modules:
-            for node in ast.walk(module.tree):
+            for node in module.nodes:
                 if not isinstance(node, ast.ExceptHandler):
                     continue
                 names = _broad_names(node)

@@ -18,6 +18,14 @@ from dynamo_tpu.disagg.handlers import (
     pack_array,
     unpack_array,
 )
+from dynamo_tpu.disagg.wire import (
+    WIRE_VERSION,
+    KvWireBlocks,
+    pack_kv,
+    unpack_kv,
+    unpack_reply,
+    wire_block_bytes,
+)
 from dynamo_tpu.disagg.prefill_router import PrefillRouter
 
 __all__ = [

@@ -9,10 +9,20 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.disagg.wire import (
+    WIRE_VERSION,
+    KvWireBlocks,
+    pack_array,
+    pack_kv,
+    reply_wire_nbytes,
+    unpack_array,
+    unpack_reply,
+    wire_block_bytes,
+)
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     DisaggregatedParams,
@@ -25,6 +35,38 @@ from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# Wire dtypes a v2 importer can install (every cell of the interop matrix
+# lands in engine.import_blocks_wire_async). Advertised in the pull
+# request's ``wire.accept`` so exporters ship pool-native.
+ACCEPT_WIRE_DTYPES = ("int8", "bfloat16", "float32", "float16")
+
+# EWMA weight for per-(src, dst) observed transfer bandwidth. One pull is a
+# noisy sample (chunking, event-loop contention); 0.25 converges in a few
+# pulls without letting one outlier swing the router's link-cost view.
+LINK_BW_EWMA_ALPHA = 0.25
+
+# Forget a source's bandwidth after this long without a pull from it.
+# Without the TTL, a departed prefill worker's entry would be republished
+# in every load report FOREVER — resurrecting the pairs the scheduler's
+# remove_worker purged and leaking dead-worker gauge series.
+LINK_BW_TTL_S = 600.0
+
+
+def _engine_wire_dtype(engine: Any) -> str:
+    """Pool-native wire dtype tag of an engine's KV pool."""
+    if getattr(engine.args, "kv_cache_dtype", None) == "int8":
+        return "int8"
+    return str(np.dtype(engine.args.config.dtype).name)
+
+
+def _engine_wire_block_bytes(engine: Any, wire_dtype: str) -> int:
+    """Per-block wire bytes of an engine's export (k+v, scales included)."""
+    cfg = engine.args.config
+    return wire_block_bytes(
+        cfg.n_layers, engine.args.block_size, cfg.n_kv_heads, cfg.head_dim_,
+        wire_dtype,
+    )
 
 
 class DisaggMetrics:
@@ -53,24 +95,49 @@ class DisaggMetrics:
         self.bytes_pulled = self.registry.counter(
             mn.DISAGG_BYTES_PULLED_TOTAL, "KV bytes pulled over the wire"
         )
+        self.kv_wire_bytes = self.registry.counter(
+            mn.DISAGG_KV_WIRE_BYTES_TOTAL,
+            "Serialized KV payload bytes pulled, by wire dtype — int8 vs "
+            "dense is THE transfer-bound disagg lever",
+            ["dtype"],
+        )
         self.transfer_duration = self.registry.histogram(
             mn.DISAGG_TRANSFER_DURATION,
             "Wall time of one KV pull (request-scoped, chunks included)",
         )
+        self.link_bandwidth = self.registry.gauge(
+            mn.DISAGG_LINK_BANDWIDTH,
+            "EWMA of observed KV transfer bandwidth per (src prefill "
+            "worker, dst decode worker) pair — the router's link-cost "
+            "input",
+            ["src", "dst"],
+        )
+        self._link_source = None
+        self._dst_label = "local"
+        self._link_srcs: set = set()
+        self.registry.on_render(self._sample_links)
+
+    def watch_links(self, bandwidth_fn, dst_label: str) -> None:
+        """Sample ``bandwidth_fn()`` (src worker id → bytes/s EWMA) into
+        the per-pair gauge at scrape time; series for sources that aged
+        out of the EWMA table are dropped."""
+        self._link_source = bandwidth_fn
+        self._dst_label = dst_label
+
+    def _sample_links(self) -> None:
+        if self._link_source is None:
+            return
+        live = set()
+        for src, bw in self._link_source().items():
+            label = str(src)
+            live.add(label)
+            self.link_bandwidth.set(bw, src=label, dst=self._dst_label)
+        for gone in self._link_srcs - live:
+            self.link_bandwidth.remove(src=gone, dst=self._dst_label)
+        self._link_srcs = live
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics=openmetrics)
-
-
-def pack_array(a) -> Dict[str, Any]:
-    arr = np.asarray(a)
-    return {"b": arr.tobytes(), "shape": list(arr.shape), "dtype": str(arr.dtype)}
-
-
-def unpack_array(d: Dict[str, Any]) -> np.ndarray:
-    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
-
-    return np.frombuffer(d["b"], dtype=np.dtype(d["dtype"])).reshape(d["shape"])
 
 
 class PrefillHandler:
@@ -110,6 +177,7 @@ class PrefillHandler:
                 error="prefill produced no token", finish_reason=FinishReason.ERROR
             )
             return
+        wire_dtype = _engine_wire_dtype(self._engine)
         yield BackendOutput(
             token_ids=first.token_ids,
             logprobs=first.logprobs,
@@ -121,6 +189,13 @@ class PrefillHandler:
                     "block_hashes": hashes,
                     "block_size": block_size,
                     "first_token": first.token_ids[0],
+                    # Transfer-cost inputs for link-aware decode placement
+                    # (router/scheduler.py TransferContext): what one
+                    # overlap-miss block costs on the wire from THIS worker.
+                    "wire_dtype": wire_dtype,
+                    "block_bytes": _engine_wire_block_bytes(
+                        self._engine, wire_dtype
+                    ),
                 },
             ),
             finish_reason=FinishReason.LENGTH,
@@ -151,40 +226,78 @@ class KvTransferHandler:
         self._engine = engine
         self.chunk_bytes = chunk_bytes or KV_CHUNK_BYTES
 
-    def _blocks_per_chunk(self) -> int:
-        from dynamo_tpu.engines.tpu.runner import kv_wire_itemsize
+    def _negotiate_wire_dtype(self, request: Any) -> Optional[str]:
+        """Wire dtype this reply ships, or None for the v1 dense schema.
 
-        cfg = self._engine.args.config
-        itemsize = kv_wire_itemsize(
-            cfg.dtype, getattr(self._engine.args, "kv_cache_dtype", None)
+        A request without a ``wire`` envelope comes from a v1 importer:
+        answer in the v1 shape (dense ``k``/``v``, int8 pools dequantized)
+        so old decode workers keep interoperating. A v2 importer gets the
+        pool-native form unless its ``accept`` list vetoes it — then the
+        exporter ships a dense dtype the importer DID list (for any pool
+        form, not just int8), falling back to the pool's dense dtype when
+        the accept list names nothing we can produce."""
+        wire_req = request.get("wire") or {}
+        if int(wire_req.get("version") or 1) < WIRE_VERSION:
+            return None
+        native = _engine_wire_dtype(self._engine)
+        accept = wire_req.get("accept")
+        if not accept or native in accept:
+            return native
+        for cand in ("bfloat16", "float32", "float16"):
+            if cand in accept:
+                return cand
+        return (
+            str(np.dtype(self._engine.args.config.dtype).name)
+            if native == "int8" else native
         )
-        block_bytes = (
-            2 * cfg.n_layers * self._engine.args.block_size
-            * cfg.n_kv_heads * cfg.head_dim_ * itemsize
-        )
+
+    def _blocks_per_chunk(self, wire_dtype: Optional[str] = None) -> int:
+        """Chunk sizing by the bytes THIS reply actually ships: v1 replies
+        (wire_dtype None) densify int8 pools to the v1 bf16 wire, so they
+        must be sized by the dense block, not the pool-native one."""
+        if wire_dtype is None:
+            wire_dtype = (
+                "bfloat16" if _engine_wire_dtype(self._engine) == "int8"
+                else str(np.dtype(self._engine.args.config.dtype).name)
+            )
+        block_bytes = _engine_wire_block_bytes(self._engine, wire_dtype)
         return max(1, self.chunk_bytes // max(block_bytes, 1))
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         hashes: List[int] = list(request.get("block_hashes") or [])
-        per = self._blocks_per_chunk()
+        wire_dtype = self._negotiate_wire_dtype(request)
+        per = self._blocks_per_chunk(wire_dtype)
         sent_any = False
         for off in range(0, len(hashes), per):
             chunk = hashes[off : off + per]
-            found, k, v = await self._engine.export_blocks_async(chunk)
-            if not found:
-                break  # chain broken (evicted): stop at the last good run
-            sent_any = True
-            done = off + per >= len(hashes) or len(found) < len(chunk)
-            yield {
-                "found": found,
-                "k": pack_array(k),
-                "v": pack_array(v),
-                "done": done,
-            }
+            if wire_dtype is None:
+                # v1 importer: dense k/v fields.
+                found, k, v = await self._engine.export_blocks_async(chunk)
+                if not found:
+                    break  # chain broken (evicted): stop at last good run
+                sent_any = True
+                done = off + per >= len(hashes) or len(found) < len(chunk)
+                yield {
+                    "found": found,
+                    "k": pack_array(k),
+                    "v": pack_array(v),
+                    "done": done,
+                }
+            else:
+                found, wire = await self._engine.export_blocks_wire_async(chunk)
+                if not found:
+                    break
+                if wire.dtype != wire_dtype:
+                    # negotiated down: ship the dense dtype the importer
+                    # accepted (dequant or cast)
+                    wire = KvWireBlocks.dense(*wire.to_dense(wire_dtype))
+                sent_any = True
+                done = off + per >= len(hashes) or len(found) < len(chunk)
+                yield {"found": found, "kv": pack_kv(wire), "done": done}
             if len(found) < len(chunk):
                 return
         if not sent_any:
-            yield {"found": [], "k": None, "v": None, "done": True}
+            yield {"found": [], "kv": None, "k": None, "v": None, "done": True}
 
 
 class DecodeHandler:
@@ -192,11 +305,17 @@ class DecodeHandler:
     disaggregated_params), then generate normally — prefix-cached admission
     picks up the imported blocks (ref: DecodeWorkerHandler handlers.py:1254)."""
 
-    def __init__(self, engine: Any, kv_client_factory=None) -> None:
+    def __init__(
+        self, engine: Any, kv_client_factory=None,
+        *, worker_id: Optional[int] = None,
+    ) -> None:
         self._engine = engine
         # async () -> Client for the prefill component's "kv" endpoint
         self._kv_client_factory = kv_client_factory
         self._kv_client = None
+        # This worker's identity — the ``dst`` of every (src prefill
+        # worker, dst decode worker) bandwidth pair it measures.
+        self.worker_id = worker_id
         # Observability for the fallback path: a transfer failure silently
         # converting into a second full prefill is a 2× cost bug that MUST
         # be visible in metrics (r3 review finding).
@@ -204,13 +323,47 @@ class DecodeHandler:
         self.transfer_failures = 0
         self.blocks_pulled = 0
         self.bytes_pulled = 0
+        # Serialized KV payload bytes by wire dtype (the kv_wire_bytes_total
+        # counter's host-side mirror; bench reads this).
+        self.wire_bytes_by_dtype: Dict[str, int] = {}
         self.transfer_seconds = 0.0  # summed per-pull elapsed (can overlap)
         # Window edges for aggregate-rate math: concurrent pulls overlap,
         # so bytes / (last_end - first_start) is the honest achieved rate
         # while summed per-pull seconds would understate it.
         self.transfer_first_start = 0.0
         self.transfer_last_end = 0.0
+        # src prefill worker id → (EWMA pull bandwidth B/s, last-pull
+        # monotonic). Seeds the router's link-cost model via load reports
+        # (router/publisher.py link_bandwidth_fn); entries not refreshed
+        # within LINK_BW_TTL_S age out so a departed prefill worker stops
+        # being republished (and can't resurrect scheduler-purged pairs).
+        self._link_bw: Dict[int, Tuple[float, float]] = {}
         self.metrics = DisaggMetrics()
+        self.metrics.watch_links(
+            self.link_bandwidth,
+            str(worker_id) if worker_id is not None else "local",
+        )
+
+    def link_bandwidth(self) -> Dict[int, float]:
+        """src prefill worker id → EWMA observed transfer bandwidth, B/s
+        (sources without a pull in LINK_BW_TTL_S are pruned)."""
+        now = time.monotonic()
+        self._link_bw = {
+            src: (bw, at) for src, (bw, at) in self._link_bw.items()
+            if now - at < LINK_BW_TTL_S
+        }
+        return {src: bw for src, (bw, _) in self._link_bw.items()}
+
+    def _observe_link(self, src: int, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 0:
+            return
+        bw = nbytes / seconds
+        prev = self._link_bw.get(src)
+        self._link_bw[src] = (
+            bw if prev is None
+            else LINK_BW_EWMA_ALPHA * bw + (1 - LINK_BW_EWMA_ALPHA) * prev[0],
+            time.monotonic(),
+        )
 
     def register_metrics(self, server: Any) -> None:
         """Expose this handler's transfer families on a SystemStatusServer."""
@@ -241,6 +394,7 @@ class DecodeHandler:
         if not self.transfer_first_start:
             self.transfer_first_start = t0
         imported = 0
+        pulled_bytes = 0
         # The block every chunk chains from: the last resident block before
         # the missing run, then the tail of each imported chunk.
         anchor = hashes[missing_from - 1] if missing_from > 0 else None
@@ -250,22 +404,36 @@ class DecodeHandler:
             # with the next chunk's network read instead of waiting for one
             # monolithic payload.
             async for reply in self._kv_client.direct(
-                {"op": "export", "block_hashes": want}, dp.worker_id
+                {
+                    "op": "export",
+                    "block_hashes": want,
+                    # Schema v2 negotiation: ship pool-native (int8 stays
+                    # int8 on the wire); v1 exporters ignore this and reply
+                    # dense.
+                    "wire": {
+                        "version": WIRE_VERSION,
+                        "accept": list(ACCEPT_WIRE_DTYPES),
+                    },
+                }, dp.worker_id
             ):
                 found = reply.get("found") or []
-                if not found:
+                wire = unpack_reply(reply)
+                if not found or wire is None:
                     break
-                k = unpack_array(reply["k"])
-                v = unpack_array(reply["v"])
-                n = await self._engine.import_blocks_async(
-                    found, k, v, anchor_parent=anchor
+                n = await self._engine.import_blocks_wire_async(
+                    found, wire, anchor_parent=anchor
                 )
                 imported += n
                 self.blocks_pulled += n
-                chunk_bytes = len(reply["k"]["b"]) + len(reply["v"]["b"])
+                chunk_bytes = reply_wire_nbytes(reply)
+                pulled_bytes += chunk_bytes
                 self.bytes_pulled += chunk_bytes
+                self.wire_bytes_by_dtype[wire.dtype] = (
+                    self.wire_bytes_by_dtype.get(wire.dtype, 0) + chunk_bytes
+                )
                 self.metrics.blocks_pulled.inc(n)
                 self.metrics.bytes_pulled.inc(chunk_bytes)
+                self.metrics.kv_wire_bytes.inc(chunk_bytes, dtype=wire.dtype)
                 if n < len(found):
                     # Pool dry mid-chunk: anchoring later chunks on an
                     # uninstalled hash would commit children whose parent
@@ -291,6 +459,9 @@ class DecodeHandler:
         now = time.monotonic()
         self.transfer_seconds += now - t0
         self.transfer_last_end = now
+        # Per-(src, dst) bandwidth: this pull's achieved rate feeds the
+        # EWMA the router's link-cost model consumes via load reports.
+        self._observe_link(dp.worker_id, pulled_bytes, now - t0)
         # Exemplar: a transfer-latency spike on a dashboard resolves to the
         # trace (and thus the /debug/requests timeline) that caused it.
         self.metrics.transfer_duration.observe(now - t0, trace_id=trace_id)

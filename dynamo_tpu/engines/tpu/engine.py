@@ -1348,50 +1348,68 @@ class JaxEngine:
     # device array work runs on the executor thread, which also serializes it
     # with decode steps (the caches are donated through every step).
 
-    async def export_blocks_async(self, block_hashes: List[int]):
-        """Copy committed blocks out of HBM, addressed by content hash.
-
-        Returns (found_hashes, k_blocks, v_blocks) with arrays shaped
-        [n, L, block_size, KH, D]. The prefill side of disaggregated P/D
+    async def export_blocks_wire_async(self, block_hashes: List[int]):
+        """Copy committed blocks out of HBM in POOL-NATIVE wire form
+        (disagg/wire.py KvWireBlocks): quantized pools ship {q8, scales}
+        without ever materializing the dense form — half the readback and
+        half the wire; dense pools ship their storage dtype. Returns
+        (found_hashes, wire) — the prefill side of disaggregated P/D
         (ref: kv_router/prefill_router.rs bootstrap → NIXL read; here the
         transfer is host-staged DCN, SURVEY §2.5 TPU-equivalent note).
         Stops at the first miss: only a leading run of the chain is useful.
         Found blocks are pinned across the device copy so eviction can't
-        recycle them mid-gather.
-        """
-        ids: List[int] = []
-        found: List[int] = []
+        recycle them mid-gather."""
         matched, pinned_ids = self.pool.pin_prefix(block_hashes)
         try:
             ids = pinned_ids
             found = list(block_hashes[:matched])
             if not ids:
-                return [], None, None
+                return [], None
 
             # Two-phase: enqueue on the device thread (cheap), read back on
             # the transfer thread — decode ticks interleave with the copy.
-            kd, vd = await self._device(
-                self.runner.gather_blocks_dispatch, ids
+            handles = await self._device(
+                self.runner.gather_blocks_wire_dispatch, ids
             )
-            k, v = await asyncio.get_running_loop().run_in_executor(
+            wire = await asyncio.get_running_loop().run_in_executor(
                 self._transfer_executor,
-                self.runner.gather_blocks_readback, kd, vd,
+                self.runner.gather_blocks_wire_readback, handles,
             )
+            # ``bytes`` is the ACTUAL serialized wire size (payload +
+            # scales), not a post-dequant figure — the flight ring and the
+            # bench read this as the transfer-plane cost.
             self.flight.record(
-                "kv_export", blocks=len(found), bytes=int(k.nbytes + v.nbytes)
+                "kv_export", blocks=len(found), bytes=int(wire.nbytes),
+                dtype=wire.dtype,
             )
-            return found, k, v
+            return found, wire
         finally:
             if pinned_ids:
                 self.pool.release(pinned_ids, block_hashes[: len(pinned_ids)])
 
-    async def import_blocks_async(
-        self, block_hashes: List[int], k_blocks, v_blocks,
+    async def export_blocks_async(self, block_hashes: List[int]):
+        """Dense-form export: (found_hashes, k_blocks, v_blocks) shaped
+        [n, L, block_size, KH, D]. Kept for consumers that want dense
+        arrays regardless of the pool encoding (checkpoint interop, the
+        v1 transfer schema); quantized pools dequantize host-side to the
+        v1 wire dtype. The transfer path proper should use
+        export_blocks_wire_async."""
+        found, wire = await self.export_blocks_wire_async(block_hashes)
+        if wire is None:
+            return found, None, None
+        k, v = wire.to_dense()
+        return found, k, v
+
+    async def import_blocks_wire_async(
+        self, block_hashes: List[int], wire,
         *, anchor_parent: Optional[int] = None,
     ) -> int:
-        """Insert transferred blocks into the pool as cached (committed)
-        content, so normal prefix-cached admission reuses them. Returns how
-        many were installed (stops when the pool is dry).
+        """Insert transferred wire blocks (KvWireBlocks) into the pool as
+        cached (committed) content, so normal prefix-cached admission
+        reuses them. Returns how many were installed (stops when the pool
+        is dry). All four interop cells land here: int8 wire installs
+        verbatim into int8 pools and dequantizes on device into dense
+        pools; dense wire requantizes on device into int8 pools.
 
         ``anchor_parent``: hash the FIRST block chains from when the caller
         knows the preceding block (mid-tree restore, suffix transfer whose
@@ -1416,11 +1434,9 @@ class JaxEngine:
         if not ids:
             return 0
 
+        sub = wire.take(sel)
         try:
-            await self._device(
-                self.runner.scatter_blocks, ids,
-                np.asarray(k_blocks)[sel], np.asarray(v_blocks)[sel],
-            )
+            await self._device(self.runner.scatter_blocks_wire, ids, sub)
         except Exception:
             for b in ids:
                 self.pool.release([b], [])  # data never landed; just free
@@ -1430,8 +1446,26 @@ class JaxEngine:
             self.pool.commit(b, h, par)
             # imported blocks start unreferenced (cached): release our pin
             self.pool.release([b], [h])
-        self.flight.record("kv_import", blocks=len(ids))
+        self.flight.record(
+            "kv_import", blocks=len(ids), bytes=int(sub.nbytes),
+            dtype=sub.dtype,
+        )
         return len(ids)
+
+    async def import_blocks_async(
+        self, block_hashes: List[int], k_blocks, v_blocks,
+        *, anchor_parent: Optional[int] = None,
+    ) -> int:
+        """Dense-form import (v1 surface): wraps the arrays as a dense
+        wire payload and funnels through import_blocks_wire_async so the
+        pin/scatter/commit/rollback invariants live in ONE place."""
+        from dynamo_tpu.disagg.wire import KvWireBlocks
+
+        return await self.import_blocks_wire_async(
+            block_hashes,
+            KvWireBlocks.dense(np.asarray(k_blocks), np.asarray(v_blocks)),
+            anchor_parent=anchor_parent,
+        )
 
     # -- checkpoint / restore (the chrek/CRIU fast-cold-start role) --------
     # Logic lives in engines/tpu/kv_checkpoint.py; these stay as the

@@ -115,20 +115,15 @@ _scatter_blocks = watched_jit(
 )
 
 
-# The wire/checkpoint format for exported KV blocks is always DENSE:
-# int8 pools are dequantized to KV_QUANT_WIRE_DTYPE by _gather_blocks;
-# non-quantized pools ship in their storage dtype (casting would perturb
-# fp32 test configs). Chunk sizing on the transfer path must use
-# kv_wire_itemsize(), not a literal.
+# The DENSE wire/checkpoint dtype: int8 pools are dequantized to this by
+# _gather_blocks when a dense export is requested (v1 importers, the
+# checkpoint path); non-quantized pools ship in their storage dtype
+# (casting would perturb fp32 test configs). The TRANSFER path prefers the
+# pool-native wire form (gather_blocks_wire_* below + disagg/wire.py
+# schema v2) — quantized pools then ship {q8, scales} without ever
+# materializing the dense form. Chunk sizing on the transfer path must use
+# disagg/wire.py::wire_block_bytes(), not a dtype literal.
 KV_QUANT_WIRE_DTYPE = jnp.bfloat16
-
-
-def kv_wire_itemsize(storage_dtype, kv_cache_dtype: "str | None") -> int:
-    """Bytes per element of exported KV blocks for a pool with the given
-    storage dtype and kv_cache_dtype engine setting."""
-    if kv_cache_dtype == "int8":
-        return jnp.dtype(KV_QUANT_WIRE_DTYPE).itemsize
-    return jnp.dtype(storage_dtype).itemsize
 
 
 def _gather_blocks_impl(cache, idx):
@@ -151,6 +146,44 @@ def _gather_blocks_impl(cache, idx):
 
 
 _gather_blocks = watched_jit("runner.gather_blocks", jax.jit(_gather_blocks_impl))
+
+
+def _gather_blocks_q8_impl(cache, idx):
+    """Pool-native gather of a QUANTIZED cache: (q8 [L, n, BS, KH, D] int8,
+    s [L, n, KH, BS] f32) of blocks idx, with NO dequantization — half the
+    HBM readback and half the wire of the dense form. One device program
+    (same dispatch-RTT argument as _gather_blocks)."""
+    q8 = jnp.stack([c["q8"][idx] for c in cache])
+    s = jnp.stack([c["s"][idx] for c in cache])
+    return q8, s
+
+
+_gather_blocks_q8 = watched_jit(
+    "runner.gather_blocks_q8", jax.jit(_gather_blocks_q8_impl)
+)
+
+
+def _scatter_blocks_q8_impl(cache, idx, q8, s):
+    """cache ← quantized wire blocks (q8 [L, n, BS, KH, D], s [L, n, KH, BS])
+    at idx. Quantized pools take them VERBATIM (an int8→int8 transfer is
+    bit-exact); dense pools dequantize on device — either way the int8
+    payload rides H2D at half the dense width."""
+    from dynamo_tpu.ops.kv_quant import dequantize_pages
+
+    def one(c, q8_l, s_l):
+        if isinstance(c, dict):
+            return {"q8": c["q8"].at[idx].set(q8_l), "s": c["s"].at[idx].set(s_l)}
+        return c.at[idx].set(dequantize_pages(q8_l, s_l, c.dtype))
+
+    if isinstance(cache, (tuple, list)):
+        return tuple(one(c, q8[l], s[l]) for l, c in enumerate(cache))
+    return cache.at[:, idx].set(dequantize_pages(q8, s, cache.dtype))
+
+
+_scatter_blocks_q8 = watched_jit(
+    "runner.scatter_blocks_q8",
+    functools.partial(jax.jit, donate_argnums=(0,))(_scatter_blocks_q8_impl),
+)
 
 
 def _is_kernel_compile_error(exc: BaseException) -> bool:
@@ -1143,6 +1176,22 @@ class DeviceRunner:
 
     # -- block transfer (disagg / checkpoint) ------------------------------
 
+    def pool_quantized(self) -> bool:
+        """Is the KV pool stored quantized ({q8, s} per layer)?"""
+        from dynamo_tpu.ops.kv_quant import is_quantized_pool
+
+        kc = self.k_cache
+        if isinstance(kc, (tuple, list)):
+            kc = kc[0]
+        return is_quantized_pool(kc)
+
+    def kv_wire_dtype(self) -> str:
+        """Pool-native wire dtype tag (disagg/wire.py schema): "int8" for
+        quantized pools, the storage dtype name otherwise."""
+        if self.pool_quantized():
+            return "int8"
+        return str(jnp.dtype(self.config.dtype).name)
+
     def gather_blocks_dispatch(self, ids: List[int]):
         """ENQUEUE the block gather and return the (not-yet-read) device
         arrays. Runs on the device-executor thread but only pays dispatch
@@ -1191,6 +1240,79 @@ class DeviceRunner:
         )
         self.k_cache = _scatter_blocks(self.k_cache, idx, k_sel)
         self.v_cache = _scatter_blocks(self.v_cache, idx, v_sel)
+
+    # -- pool-native wire transfer (disagg/wire.py schema v2) --------------
+
+    def gather_blocks_wire_dispatch(self, ids: List[int]):
+        """ENQUEUE a pool-native gather and return un-read device handles.
+        Quantized pools ship {q8, scales} WITHOUT dequantizing — half the
+        readback and half the wire; dense pools reuse the dense dispatch.
+        Same two-phase contract as gather_blocks_dispatch (readback on the
+        transfer thread keeps decode ticks flowing)."""
+        if not self.pool_quantized():
+            k, v = self.gather_blocks_dispatch(ids)  # mirrors "gather"
+            return ("dense", self.kv_wire_dtype(), k, v)
+        self._mirror("gather_wire", ids=np.asarray(ids, dtype=np.int32))
+        idx = self._dev(np.asarray(ids, dtype=np.int32))
+        kq, ks = _gather_blocks_q8(self.k_cache, idx)
+        vq, vs = _gather_blocks_q8(self.v_cache, idx)
+        if self.multihost:
+            kq, ks, vq, vs = self._constrain_out(kq, ks, vq, vs)
+        return (
+            "q8", "int8",
+            kq.swapaxes(0, 1), ks.swapaxes(0, 1),
+            vq.swapaxes(0, 1), vs.swapaxes(0, 1),
+        )
+
+    @staticmethod
+    def gather_blocks_wire_readback(handles):
+        """Blocking readback half of gather_blocks_wire_dispatch — call
+        from a transfer executor, never the device thread. Returns
+        disagg/wire.py KvWireBlocks."""
+        from dynamo_tpu.disagg.wire import KvWireBlocks
+
+        if handles[0] == "dense":
+            _, dtype, k, v = handles
+            return KvWireBlocks(
+                dtype=dtype,
+                k=np.asarray(jax.device_get(k)),
+                v=np.asarray(jax.device_get(v)),
+            )
+        _, dtype, kq, ks, vq, vs = handles
+        return KvWireBlocks(
+            dtype=dtype,
+            k=np.asarray(jax.device_get(kq)),
+            v=np.asarray(jax.device_get(vq)),
+            k_scale=np.asarray(jax.device_get(ks)),
+            v_scale=np.asarray(jax.device_get(vs)),
+        )
+
+    def gather_blocks_wire(self, ids: List[int]):
+        """Synchronous convenience form (SPMD followers, tests)."""
+        return self.gather_blocks_wire_readback(
+            self.gather_blocks_wire_dispatch(ids)
+        )
+
+    def scatter_blocks_wire(self, ids: List[int], wire) -> None:
+        """Install wire blocks (KvWireBlocks) into HBM at ``ids``. Dense
+        payloads reuse scatter_blocks (which requantizes into int8 pools on
+        device); quantized payloads ship int8 over H2D and install verbatim
+        (int8 pool) or dequantize on device (dense pool)."""
+        if not wire.quantized:
+            self.scatter_blocks(ids, wire.k, wire.v)
+            return
+        self._mirror(
+            "scatter_wire", ids=np.asarray(ids, dtype=np.int32),
+            k_q8=np.asarray(wire.k), k_s=np.asarray(wire.k_scale),
+            v_q8=np.asarray(wire.v), v_s=np.asarray(wire.v_scale),
+        )
+        idx = self._dev(np.asarray(ids, dtype=np.int32))
+        kq = self._dev(np.asarray(wire.k).swapaxes(0, 1))
+        ks = self._dev(np.asarray(wire.k_scale).swapaxes(0, 1))
+        vq = self._dev(np.asarray(wire.v).swapaxes(0, 1))
+        vs = self._dev(np.asarray(wire.v_scale).swapaxes(0, 1))
+        self.k_cache = _scatter_blocks_q8(self.k_cache, idx, kq, ks)
+        self.v_cache = _scatter_blocks_q8(self.v_cache, idx, vq, vs)
 
     # -- sleep / wake device transitions -----------------------------------
 

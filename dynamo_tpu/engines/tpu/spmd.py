@@ -59,9 +59,24 @@ def follow(runner: Any, follower: SpmdFollower) -> None:
                 runner.run_spec(**args)
             elif op == "gather":
                 runner.gather_blocks(list(args["ids"]))
+            elif op == "gather_wire":
+                # Pool-native gather: the follower joins the collective;
+                # only the leader reads the result back.
+                runner.gather_blocks_wire(list(args["ids"]))
             elif op == "scatter":
                 runner.scatter_blocks(
                     list(args["ids"]), args["k_blocks"], args["v_blocks"]
+                )
+            elif op == "scatter_wire":
+                from dynamo_tpu.disagg.wire import KvWireBlocks
+
+                runner.scatter_blocks_wire(
+                    list(args["ids"]),
+                    KvWireBlocks(
+                        dtype="int8",
+                        k=args["k_q8"], v=args["v_q8"],
+                        k_scale=args["k_s"], v_scale=args["v_s"],
+                    ),
                 )
             elif op == "proc_reset":
                 runner.proc_reset_slot(

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import msgpack
 import numpy as np
 
+from dynamo_tpu.disagg.wire import dense_tier_block
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -208,12 +209,15 @@ class KvConnectorWorker:
                 if self._metrics is not None:
                     self._metrics.failed_loads.inc()
                 continue
-            self._put(engine_block_id, blk[0], blk[1])
+            # The shared tier may hold quantized wire-form blocks (native
+            # engine offload); the external-engine seam hands over dense.
+            bk, bv = dense_tier_block(blk)
+            self._put(engine_block_id, bk, bv)
             n += 1
             if self._metrics is not None:
                 self._metrics.onboard_blocks.inc()
                 self._metrics.onboard_bytes.inc(
-                    int(blk[0].nbytes) + int(blk[1].nbytes)
+                    int(bk.nbytes) + int(bv.nbytes)
                 )
         for rid in touched:
             if rid not in self._failed_loads:

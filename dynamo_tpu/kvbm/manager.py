@@ -196,19 +196,30 @@ class TieredKvManager:
         todo = [h for h in hashes if not self.tier.contains(h)]
         if not todo:
             return
-        # export_blocks_async stops at the first device miss; exporting one
-        # by one keeps it simple and each block is a single chain element.
+        # Wire-form export (disagg/wire.py): quantized pools offload their
+        # {q8, scales} form verbatim — G2/G3 hold half the dense footprint
+        # and onboarding restores bit-exact pool content. The export stops
+        # at the first device miss; exporting one by one keeps it simple
+        # and each block is a single chain element.
         for h in todo:
-            found, k, v = await self._engine.export_blocks_async([h])
+            found, wire = await self._engine.export_blocks_wire_async([h])
             if not found:
                 continue  # evicted before we got to it; write-through missed
-            self.tier.put(h, k[0], v[0])
+            if wire.quantized:
+                self.tier.put(
+                    h, wire.k[0], wire.v[0], wire.k_scale[0], wire.v_scale[0]
+                )
+            else:
+                self.tier.put(h, wire.k[0], wire.v[0])
             if self.remote is not None:
-                # G4 write-behind: the shared store absorbs it asynchronously.
-                self.remote.put(h, k[0], v[0])
+                # G4 write-behind: the shared store absorbs it
+                # asynchronously. The remote tier stays dense (it serves
+                # engines of ANY pool dtype).
+                dk, dv = wire.to_dense()
+                self.remote.put(h, dk[0], dv[0])
             self.offloaded += 1
             self.metrics.offload_blocks.inc()
-            self.metrics.offload_bytes.inc(int(k[0].nbytes) + int(v[0].nbytes))
+            self.metrics.offload_bytes.inc(int(wire.nbytes))
 
     # -- onboard (G2/G3 → G1) ------------------------------------------------
 
@@ -227,7 +238,10 @@ class TieredKvManager:
         """Bring a leading run of blocks onto the device (before prefill).
         Returns how many blocks were installed."""
         assert self._engine is not None
-        ks, vs, run = [], [], []
+        from dynamo_tpu.disagg.wire import tier_block_wire
+
+        run: List[int] = []
+        blocks: List[tuple] = []
         for h in block_hashes:
             blk = self.tier.get(h)
             if blk is None and self.remote is not None:
@@ -235,24 +249,39 @@ class TieredKvManager:
                 # in the host tier for next time).
                 blk = await self.remote.get_async(h)
                 if blk is not None:
-                    self.tier.put(h, blk[0], blk[1])
+                    self.tier.put(h, *blk)
             if blk is None:
                 break
             run.append(h)
-            ks.append(blk[0])
-            vs.append(blk[1])
+            blocks.append(blk)
         if not run:
             return 0
-        import numpy as np
 
-        installed = await self._engine.import_blocks_async(
-            run, np.stack(ks), np.stack(vs)
-        )
+        # Install in uniform-form sub-runs (a tier can hold a mix of dense
+        # and quantized blocks across engine-dtype generations); each
+        # sub-run after the first anchors on its predecessor's tail so the
+        # chain stays parent-linked.
+        installed = 0
+        anchor = None
+        i = 0
+        while i < len(run):
+            j = i + 1
+            while j < len(run) and len(blocks[j]) == len(blocks[i]):
+                j += 1
+            wire = tier_block_wire(blocks[i:j])
+            n = await self._engine.import_blocks_wire_async(
+                run[i:j], wire, anchor_parent=anchor
+            )
+            installed += n
+            self.metrics.onboard_bytes.inc(
+                int(wire.nbytes * (n / max(len(wire), 1)))
+            )
+            if n < j - i:
+                break  # pool dry mid-run
+            anchor = run[j - 1]
+            i = j
         self.onboarded += installed
         self.metrics.onboard_blocks.inc(installed)
-        if installed:
-            per_block = int(ks[0].nbytes) + int(vs[0].nbytes)
-            self.metrics.onboard_bytes.inc(installed * per_block)
         return installed
 
     def register_metrics(self, server: Any) -> None:

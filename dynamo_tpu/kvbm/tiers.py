@@ -1,16 +1,25 @@
 """Storage tiers: host RAM (G2) and local disk (G3).
 
 Reference parity: lib/llm/src/block_manager/storage/{mod,disk}.rs + the
-pinned-host pool. Blocks are content-addressed (chained hash → (k, v) numpy
-arrays of shape [L, block_size, KH, D]); each tier is LRU-bounded and spills
+pinned-host pool. Blocks are content-addressed (chained hash → arrays of
+shape [L, block_size, KH, D]); each tier is LRU-bounded and spills
 evictions down to the next tier when one is attached.
+
+Block forms: a tier entry is a tuple of arrays —
+  (k, v)                        dense, any dtype
+  (k_q8, v_q8, k_scale, v_scale) pool-native quantized (int8 payloads +
+                                 [L, KH, BS] f32 scales, disagg/wire.py)
+Quantized offload stores the wire form VERBATIM, so G2/G3 hold half the
+dense footprint and onboarding re-installs bit-exact pool content.
+Consumers that need dense arrays funnel through
+disagg/wire.py::dense_tier_block.
 """
 
 from __future__ import annotations
 
 import os
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -19,7 +28,8 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-Block = Tuple[np.ndarray, np.ndarray]  # (k, v)
+# (k, v) dense or (k_q8, v_q8, k_scale, v_scale) quantized
+Block = Tuple[np.ndarray, ...]
 
 
 @dataclass
@@ -67,32 +77,34 @@ class HostTier:
     def contains(self, block_hash: int) -> bool:
         return block_hash in self._blocks
 
-    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, block_hash: int, *arrays: np.ndarray) -> None:
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
             return
-        k, v = np.asarray(k), np.asarray(v)
+        blk: Block = tuple(np.asarray(a) for a in arrays)
         if self._staging is not None:
-            if not self._staging.put(block_hash, k, v):
+            if not self._staging.put(block_hash, *blk):
                 # Arena full: skip G2, spill straight down.
                 self.stats.evicted += 1
                 if self.next_tier is not None:
-                    self.next_tier.put(block_hash, k, v)
+                    self.next_tier.put(block_hash, *blk)
                 return
             self._blocks[block_hash] = None  # payload lives in the arena
         else:
-            self._blocks[block_hash] = (k, v)
+            self._blocks[block_hash] = blk
         self.stats.stored += 1
         while len(self._blocks) > self.capacity:
             h, blk = self._blocks.popitem(last=False)
             if self._staging is not None:
                 blk = self._staging.get(h)
-                spill = None if blk is None else (np.array(blk[0]), np.array(blk[1]))
+                spill = (
+                    None if blk is None else tuple(np.array(a) for a in blk)
+                )
                 self._staging.pop(h)
                 blk = spill
             self.stats.evicted += 1
             if self.next_tier is not None and blk is not None:
-                self.next_tier.put(h, blk[0], blk[1])  # G2 → G3 spill
+                self.next_tier.put(h, *blk)  # G2 → G3 spill
 
     def get(self, block_hash: int) -> Optional[Block]:
         if block_hash in self._blocks:
@@ -103,7 +115,7 @@ class HostTier:
                     # Copies, not views: a later put() on this tier can evict
                     # the block and recycle its arena region while the caller
                     # still holds the arrays (onboard chains do exactly this).
-                    blk = (np.array(blk[0]), np.array(blk[1]))
+                    blk = tuple(np.array(a) for a in blk)
             else:
                 blk = self._blocks[block_hash]
             if blk is not None:
@@ -113,7 +125,7 @@ class HostTier:
         if self.next_tier is not None:
             lower = self.next_tier.get(block_hash)
             if lower is not None:
-                self.put(block_hash, lower[0], lower[1])  # promote G3 → G2
+                self.put(block_hash, *lower)  # promote G3 → G2
                 return lower
         return None
 
@@ -122,6 +134,13 @@ class HostTier:
             for h in list(self._blocks):
                 self._staging.pop(h)
         self._blocks.clear()
+
+
+def _npz_safe(a: np.ndarray) -> np.ndarray:
+    """bf16 lacks npz support → view as uint16 (dtype remembered aside)."""
+    if a.dtype.str == "<V2" or "bfloat16" in str(a.dtype):
+        return a.view(np.uint16)
+    return a
 
 
 class DiskTier:
@@ -152,19 +171,23 @@ class DiskTier:
     def contains(self, block_hash: int) -> bool:
         return block_hash in self._lru
 
-    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, block_hash: int, *arrays: np.ndarray) -> None:
         if block_hash in self._lru:
             self._lru.move_to_end(block_hash)
             return
         path = self._path(block_hash)
-        # bf16 lacks npz support → view as uint16 and remember the dtype.
-        kk, vv = np.asarray(k), np.asarray(v)
-        np.savez(
-            path,
-            k=kk.view(np.uint16) if kk.dtype.str == "<V2" or "bfloat16" in str(kk.dtype) else kk,
-            v=vv.view(np.uint16) if vv.dtype.str == "<V2" or "bfloat16" in str(vv.dtype) else vv,
-            dtype=str(kk.dtype),
-        )
+        blk = tuple(np.asarray(a) for a in arrays)
+        fields = {
+            "k": _npz_safe(blk[0]),
+            "v": _npz_safe(blk[1]),
+            "dtype": str(blk[0].dtype),
+        }
+        if len(blk) == 4:
+            # Quantized wire form: int8 payloads + f32 scales, stored as-is
+            # (half the dense spool footprint).
+            fields["k_scale"] = blk[2]
+            fields["v_scale"] = blk[3]
+        np.savez(path, **fields)
         self._lru[block_hash] = path
         self.stats.stored += 1
         while len(self._lru) > self.capacity:
@@ -189,13 +212,17 @@ class DiskTier:
 
                     k = k.view(ml_dtypes.bfloat16)
                     v = v.view(ml_dtypes.bfloat16)
+                if "k_scale" in z.files:
+                    blk: Block = (k, v, z["k_scale"], z["v_scale"])
+                else:
+                    blk = (k, v)
         except (FileNotFoundError, OSError, KeyError):
             self._lru.pop(block_hash, None)
             self.stats.misses += 1
             return None
         self._lru.move_to_end(block_hash)
         self.stats.hits += 1
-        return k, v
+        return blk
 
     def clear(self) -> None:
         for _, path in self._lru.items():

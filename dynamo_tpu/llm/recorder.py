@@ -10,11 +10,17 @@ Format: JSONL, one event per line:
   {"kind": "end",     "rid", "ts"}            (normal end)
   {"kind": "error",   "rid", "ts", "message"} (stream raised)
 Payloads must be JSON-serializable (dataclasses with to_dict are handled).
+Binary buffers — the KV wire payloads of a disagg transfer stream
+(disagg/wire.py pack_array: bytes / memoryview fields) — are encoded as
+``{"__b64__": "<base64>"}`` markers and restored bit-exact by
+load_recording, so a captured transfer replays through unpack_reply and a
+disagg transfer bug stays debuggable OFFLINE.
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import time
 from dataclasses import dataclass, field
@@ -25,14 +31,35 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+_B64_KEY = "__b64__"
+
 
 def _jsonable(obj: Any) -> Any:
+    """Recursive JSON-safe encoding; bytes-like values (KV wire buffers)
+    become base64 markers instead of json.dumps' lossy default=str."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return {_B64_KEY: base64.b64encode(bytes(obj)).decode("ascii")}
     if hasattr(obj, "to_dict"):
-        return obj.to_dict()
+        return _jsonable(obj.to_dict())
     if hasattr(obj, "__dataclass_fields__"):
         import dataclasses
 
-        return dataclasses.asdict(obj)
+        return _jsonable(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def _from_jsonable(obj: Any) -> Any:
+    """Inverse of _jsonable's container walk: restore base64 markers."""
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {_B64_KEY}:
+            return base64.b64decode(obj[_B64_KEY])
+        return {k: _from_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_jsonable(v) for v in obj]
     return obj
 
 
@@ -108,11 +135,13 @@ def load_recording(path: str) -> List[RecordedStream]:
             rid = doc.get("rid", "")
             kind = doc.get("kind")
             if kind == "request":
-                streams[rid] = RecordedStream(request=doc.get("payload"), rid=rid)
+                streams[rid] = RecordedStream(
+                    request=_from_jsonable(doc.get("payload")), rid=rid
+                )
                 order.append(rid)
                 t0[rid] = doc.get("ts", 0.0)
             elif kind == "item" and rid in streams:
-                streams[rid].items.append(doc.get("payload"))
+                streams[rid].items.append(_from_jsonable(doc.get("payload")))
                 streams[rid].offsets_s.append(
                     max(doc.get("ts", 0.0) - t0.get(rid, 0.0), 0.0)
                 )

@@ -16,7 +16,13 @@ from dynamo_tpu.router.protocols import (
     load_topic,
 )
 from dynamo_tpu.router.indexer import KvIndexer
-from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler, WorkerState
+from dynamo_tpu.router.scheduler import (
+    KvRouterConfig,
+    KvScheduler,
+    LinkCostModel,
+    TransferContext,
+    WorkerState,
+)
 from dynamo_tpu.router.publisher import KvEventPublisher, LoadPublisher
 from dynamo_tpu.router.router import KvRouter
 
@@ -30,6 +36,8 @@ __all__ = [
     "KvIndexer",
     "KvRouterConfig",
     "KvScheduler",
+    "LinkCostModel",
+    "TransferContext",
     "WorkerState",
     "KvEventPublisher",
     "LoadPublisher",

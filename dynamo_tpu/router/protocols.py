@@ -74,13 +74,23 @@ class LoadSnapshot:
     active_blocks: int = 0
     total_blocks: int = 0
     generated_tokens: int = 0  # cumulative, for throughput estimation
+    # src prefill worker id → EWMA observed KV-pull bandwidth (bytes/s)
+    # measured at THIS worker's transfer path (disagg/handlers.py). Feeds
+    # the router's per-(src, dst) link-cost model.
+    link_bandwidth: Optional[Dict[int, float]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "LoadSnapshot":
-        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+        snap = cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+        if snap.link_bandwidth:
+            # JSON planes stringify int map keys; normalize on ingest.
+            snap.link_bandwidth = {
+                int(k): float(v) for k, v in snap.link_bandwidth.items()
+            }
+        return snap
 
     @property
     def worker(self) -> WorkerKey:

@@ -166,6 +166,7 @@ class LoadPublisher:
         dp_rank: int = 0,
         total_blocks: int = 0,
         interval_s: float = 1.0,
+        link_bandwidth_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self._plane = event_plane
         self._topic = load_topic(namespace, component)
@@ -174,6 +175,11 @@ class LoadPublisher:
         self._stats_fn = stats_fn
         self._total_blocks = total_blocks
         self.interval_s = interval_s
+        # () -> {src prefill worker id: bytes/s} — the decode handler's
+        # measured pull bandwidths, carried to the router's link-cost model
+        # on every load report. Late-bindable (the handler is usually
+        # constructed after the publisher).
+        self.link_bandwidth_fn = link_bandwidth_fn
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
 
@@ -181,6 +187,7 @@ class LoadPublisher:
         s = self._stats_fn()
         total = self._total_blocks or s.get("total_blocks", 0)
         free = s.get("free_blocks", 0)
+        link_bw = self.link_bandwidth_fn() if self.link_bandwidth_fn else None
         return LoadSnapshot(
             worker_id=self.worker_id,
             dp_rank=self.dp_rank,
@@ -189,6 +196,7 @@ class LoadPublisher:
             active_blocks=max(total - free, 0),
             total_blocks=total,
             generated_tokens=s.get("generated_tokens", 0),
+            link_bandwidth=link_bw or None,
         )
 
     async def publish_once(self) -> None:

@@ -29,7 +29,11 @@ from dynamo_tpu.router.protocols import (
     kv_sync_topic,
     load_topic,
 )
-from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.router.scheduler import (
+    KvRouterConfig,
+    KvScheduler,
+    TransferContext,
+)
 from dynamo_tpu.runtime import lifecycle
 from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
@@ -82,7 +86,14 @@ class RouterMetrics:
             mn.ROUTER_KV_EVENTS_TOTAL,
             "KV cache events applied to the router index",
         )
+        self.link_bandwidth = self.registry.gauge(
+            mn.ROUTER_LINK_BANDWIDTH,
+            "Per-(src, dst) transfer-bandwidth EWMA the link-cost term is "
+            "acting on (measured pairs only; unmeasured quote the seed)",
+            ["src", "dst"],
+        )
         self._gauge_workers: set = set()
+        self._gauge_links: set = set()
         self.registry.on_render(self._sample_workers)
 
     def _sample_workers(self) -> None:
@@ -97,6 +108,14 @@ class RouterMetrics:
             self.worker_load.remove(worker=gone)
             self.worker_kv_usage.remove(worker=gone)
         self._gauge_workers = labels
+        links = set()
+        for (src, dst), bw in self._scheduler.link_costs.pairs().items():
+            pair = (str(src), _worker_label(dst))
+            links.add(pair)
+            self.link_bandwidth.set(bw, src=pair[0], dst=pair[1])
+        for src, dst in self._gauge_links - links:
+            self.link_bandwidth.remove(src=src, dst=dst)
+        self._gauge_links = links
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics=openmetrics)
@@ -244,17 +263,22 @@ class KvRouter:
         candidates: Optional[Sequence[WorkerKey]] = None,
         *,
         lora_name: Optional[str] = None,
+        transfer: Optional[Any] = None,  # scheduler.TransferContext
     ) -> Tuple[Optional[WorkerKey], int]:
         """Returns (worker, overlap_blocks) — ref: kv_router.rs:501.
         ``lora_name`` salts the hash space the same way the engine does
         (tokens/blocks.py adapter_salt) so overlap is only predicted against
-        same-adapter blocks."""
+        same-adapter blocks. ``transfer`` prices each candidate's
+        overlap-miss pull over the (src, candidate) link — NetKV-style
+        network-aware decode placement."""
         hashes = compute_block_hashes(
             token_ids, self.block_size, salt=adapter_salt(lora_name)
         )
         overlaps = self.indexer.find_matches(hashes)
         request_blocks = max(len(hashes), 1)
-        worker = self.scheduler.select_worker(request_blocks, overlaps, candidates)
+        worker = self.scheduler.select_worker(
+            request_blocks, overlaps, candidates, transfer=transfer
+        )
         overlap = overlaps.scores.get(worker, 0) if worker is not None else 0
         if worker is None:
             self.metrics.decisions.inc(reason="no_worker")
@@ -310,7 +334,8 @@ class KvRouter:
                 else getattr(request, "lora_name", None)
             )
             worker, overlap = self.find_best_match(
-                token_ids, candidates, lora_name=lora
+                token_ids, candidates, lora_name=lora,
+                transfer=_transfer_context_of(request),
             )
             if worker is None:
                 return None
@@ -344,6 +369,30 @@ class KvRouter:
 
         client.set_kv_picker(picker)
         client.set_stream_done_callback(on_done)
+
+
+def _transfer_context_of(request: Any) -> Optional[TransferContext]:
+    """Disagg decode placement: a request carrying bootstrap metadata names
+    the prefill worker its KV must be pulled FROM and what one block costs
+    on the wire (disagg/handlers.py PrefillHandler). No metadata → no link
+    term (aggregated routing is unchanged)."""
+    dp = (
+        request.get("disaggregated_params")
+        if isinstance(request, dict)
+        else getattr(request, "disaggregated_params", None)
+    )
+    if dp is None:
+        return None
+    if isinstance(dp, dict):
+        worker_id = dp.get("worker_id")
+        info = dp.get("kv_transfer") or {}
+    else:
+        worker_id = getattr(dp, "worker_id", None)
+        info = getattr(dp, "kv_transfer", None) or {}
+    block_bytes = info.get("block_bytes")
+    if worker_id is None or not block_bytes:
+        return None
+    return TransferContext(src=int(worker_id), bytes_per_block=int(block_bytes))
 
 
 def _token_ids_of(request: Any) -> Optional[Sequence[int]]:

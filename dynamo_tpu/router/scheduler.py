@@ -11,6 +11,20 @@ then pick the minimum, or softmax-sample over −logit/temperature when
 ``router_temperature > 0`` (scheduler.rs softmax_sample :426). In-flight
 requests routed between load reports are tracked locally (sequence.rs's
 active-sequence prediction, simplified to block deltas with TTL decay).
+
+Link-cost extension (the NetKV/FlowKV decode-placement insight, PAPERS.md):
+when a request carries KV that must be PULLED from a source worker (disagg
+decode placement), prefix overlap is not free compute avoided — every
+overlap-miss block also rides the (src → candidate) link. The logit gains
+an estimated transfer cost in block-equivalents:
+
+    logit += link_cost_weight × prefill_blocks_per_s
+             × (miss_blocks × bytes_per_block) / bandwidth(src, candidate)
+
+so a high-overlap candidate behind a slow link LOSES to a low-overlap
+candidate on a fast one whenever re-prefilling is cheaper than the wire.
+Per-pair bandwidth is an EWMA seeded from the decode workers' own measured
+pull rates (disagg/handlers.py), shipped router-ward in load reports.
 """
 
 from __future__ import annotations
@@ -38,6 +52,82 @@ class KvRouterConfig:
     inflight_ttl_s: float = 30.0
     # Soft-skip workers above this KV usage unless all are (busy gating).
     busy_kv_usage: float = 0.95
+    # -- link-cost term (disagg decode placement) --------------------------
+    # Multiplier on the transfer-cost block-equivalents; 0 disables the
+    # term entirely (pure overlap+load cost, the pre-link behavior).
+    link_cost_weight: float = 1.0
+    # Converts estimated transfer SECONDS into the logit's block units: how
+    # many blocks a worker prefills per second. The default is deliberately
+    # conservative (a modest chip at a 16-token block); the planner's
+    # observed rates can overwrite it at runtime.
+    prefill_blocks_per_s: float = 64.0
+    # Seed bandwidth for never-measured (src, dst) pairs. Intra-cluster
+    # DCN-class default; measured EWMAs replace it after the first pull.
+    default_link_bandwidth: float = 1e9
+    # EWMA weight for bandwidth observations folded in from load reports.
+    link_ewma_alpha: float = 0.25
+
+
+class LinkCostModel:
+    """Per-(src worker id, dst WorkerKey) transfer-bandwidth EWMA.
+
+    Measured at the decode workers' pull paths (disagg/handlers.py), shipped
+    here via LoadSnapshot.link_bandwidth, and read by select_worker to price
+    a candidate's overlap-miss transfer. Unobserved pairs quote the seed
+    default — optimistic, so the link term only demotes a candidate once a
+    slow link has actually been SEEN (a never-used pair shouldn't lose to
+    speculation)."""
+
+    def __init__(self, default_bandwidth: float = 1e9, alpha: float = 0.25) -> None:
+        self.default_bandwidth = float(default_bandwidth)
+        self.alpha = float(alpha)
+        self._bw: Dict[Tuple[int, WorkerKey], float] = {}
+
+    def observe(self, src: int, dst: WorkerKey, bytes_per_s: float) -> None:
+        if bytes_per_s <= 0:
+            return
+        key = (src, dst)
+        prev = self._bw.get(key)
+        self._bw[key] = (
+            bytes_per_s if prev is None
+            else self.alpha * bytes_per_s + (1 - self.alpha) * prev
+        )
+
+    def set_bandwidth(self, src: int, dst: WorkerKey, bytes_per_s: float) -> None:
+        """Pin a pair's bandwidth directly (operator override, tests)."""
+        self._bw[(src, dst)] = float(bytes_per_s)
+
+    def bandwidth(self, src: int, dst: WorkerKey) -> float:
+        return self._bw.get((src, dst), self.default_bandwidth)
+
+    def seconds(self, src: int, dst: WorkerKey, nbytes: int) -> float:
+        """Estimated wire seconds to move ``nbytes`` src → dst. Pulling
+        from yourself is free (the blocks are already resident)."""
+        if nbytes <= 0 or dst[0] == src:
+            return 0.0
+        return nbytes / max(self.bandwidth(src, dst), 1e-9)
+
+    def pairs(self) -> Dict[Tuple[int, WorkerKey], float]:
+        """Measured pairs (for the router's per-pair gauges)."""
+        return dict(self._bw)
+
+    def drop_worker(self, worker: WorkerKey) -> None:
+        self._bw = {
+            k: v for k, v in self._bw.items()
+            if k[1] != worker and k[0] != worker[0]
+        }
+
+
+@dataclass
+class TransferContext:
+    """Disagg placement context for one selection: KV for every
+    overlap-miss block must be pulled from ``src`` (the prefill worker that
+    computed it), at ``bytes_per_block`` serialized wire bytes per block
+    (pool-native: int8 payload + scales, or dense — the prefill worker
+    advertises it in the bootstrap's kv_transfer metadata)."""
+
+    src: int
+    bytes_per_block: int
 
 
 @dataclass
@@ -65,6 +155,9 @@ class KvScheduler:
         self.config = config or KvRouterConfig()
         self._workers: Dict[WorkerKey, WorkerState] = {}
         self._rand = random.Random(seed)
+        self.link_costs = LinkCostModel(
+            self.config.default_link_bandwidth, self.config.link_ewma_alpha
+        )
 
     # -- state maintenance -------------------------------------------------
 
@@ -73,6 +166,10 @@ class KvScheduler:
         state.snapshot = snapshot
         state.inflight_blocks = 0  # report supersedes the prediction
         state.report_gen += 1
+        # Fold the worker's measured pull bandwidths (src → B/s, observed
+        # at ITS end of each link) into the shared link-cost model.
+        for src, bw in (snapshot.link_bandwidth or {}).items():
+            self.link_costs.observe(int(src), snapshot.worker, float(bw))
 
     def report_generation(self, worker: WorkerKey) -> int:
         state = self._workers.get(worker)
@@ -83,6 +180,7 @@ class KvScheduler:
 
     def remove_worker(self, worker: WorkerKey) -> None:
         self._workers.pop(worker, None)
+        self.link_costs.drop_worker(worker)
 
     def workers(self) -> List[WorkerKey]:
         return sorted(self._workers)
@@ -104,9 +202,14 @@ class KvScheduler:
         request_blocks: int,
         overlaps: OverlapScores,
         candidates: Optional[Sequence[WorkerKey]] = None,
+        *,
+        transfer: Optional[TransferContext] = None,
     ) -> Optional[WorkerKey]:
         """Pick the worker with the lowest predicted cost. ``candidates``
-        restricts the choice to live instances (router-side instance map)."""
+        restricts the choice to live instances (router-side instance map).
+        ``transfer`` (disagg decode placement) adds the estimated wire cost
+        of pulling each candidate's overlap-miss blocks from the source
+        worker, so a prefix-overlap win never beats a slow link blindly."""
         cfg = self.config
         pool: List[WorkerKey] = list(candidates) if candidates is not None else self.workers()
         if not pool:
@@ -126,6 +229,15 @@ class KvScheduler:
             prefill = max(request_blocks - overlap, 0)
             decode = self._workers[w].decode_blocks(cfg.inflight_ttl_s)
             logit = cfg.overlap_score_weight * prefill + decode
+            if transfer is not None and cfg.link_cost_weight > 0:
+                # Overlap-miss blocks must also CROSS the (src → w) link:
+                # estimated seconds × prefill-rate = block-equivalents.
+                wire_s = self.link_costs.seconds(
+                    transfer.src, w, prefill * transfer.bytes_per_block
+                )
+                logit += (
+                    cfg.link_cost_weight * cfg.prefill_blocks_per_s * wire_s
+                )
             logits.append((w, logit, overlap))
 
         chosen = self._sample(logits, cfg.router_temperature)

@@ -127,42 +127,46 @@ class Arena:
 
 
 class BlockStagingPool:
-    """Arena-backed (k, v) block store for the KVBM host tier.
+    """Arena-backed block store for the KVBM host tier.
 
-    Bounds the host tier's memory to exactly ``capacity_bytes`` no matter
-    how many blocks pass through, replacing per-block numpy allocations."""
+    A block is a tuple of arrays — (k, v) dense, or the quantized wire form
+    (k_q8, v_q8, k_scale, v_scale) whose int8 payloads halve the arena
+    bytes a block occupies (kvbm/tiers.py block forms). Bounds the host
+    tier's memory to exactly ``capacity_bytes`` no matter how many blocks
+    pass through, replacing per-block numpy allocations."""
 
     def __init__(self, capacity_bytes: int) -> None:
         self.arena = Arena(capacity_bytes)
-        self._meta: Dict[int, tuple] = {}  # hash → (kr, vr, dtype, shape)
+        # hash → tuple of (region, dtype, shape) per stored array
+        self._meta: Dict[int, tuple] = {}
 
-    def put(self, block_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+    def put(self, block_hash: int, *arrays: np.ndarray) -> bool:
         if block_hash in self._meta:
             return True
-        try:
-            kr = self.arena.store(k)
-        except ArenaExhausted:
-            return False
-        try:
-            vr = self.arena.store(v)
-        except ArenaExhausted:
-            self.arena.free(kr)
-            return False
-        self._meta[block_hash] = (kr, vr, k.dtype, k.shape)
+        regions = []
+        for a in arrays:
+            try:
+                regions.append((self.arena.store(a), a.dtype, a.shape))
+            except ArenaExhausted:
+                for r, _, _ in regions:
+                    self.arena.free(r)
+                return False
+        self._meta[block_hash] = tuple(regions)
         return True
 
     def get(self, block_hash: int):
         meta = self._meta.get(block_hash)
         if meta is None:
             return None
-        kr, vr, dtype, shape = meta
-        return self.arena.view(kr, dtype, shape), self.arena.view(vr, dtype, shape)
+        return tuple(
+            self.arena.view(r, dtype, shape) for r, dtype, shape in meta
+        )
 
     def pop(self, block_hash: int) -> None:
         meta = self._meta.pop(block_hash, None)
         if meta is not None:
-            self.arena.free(meta[0])
-            self.arena.free(meta[1])
+            for r, _, _ in meta:
+                self.arena.free(r)
 
     def __contains__(self, block_hash: int) -> bool:
         return block_hash in self._meta

@@ -64,6 +64,9 @@ ROUTER_OVERLAP_BLOCKS = f"{ROUTER_PREFIX}_overlap_blocks"
 ROUTER_WORKER_LOAD_BLOCKS = f"{ROUTER_PREFIX}_worker_load_blocks"
 ROUTER_WORKER_KV_USAGE = f"{ROUTER_PREFIX}_worker_kv_usage"
 ROUTER_KV_EVENTS_TOTAL = f"{ROUTER_PREFIX}_kv_events_total"
+# Link-cost model input: EWMA transfer bandwidth per (src prefill worker,
+# dst decode worker) pair, as the scheduler's select_worker sees it.
+ROUTER_LINK_BANDWIDTH = f"{ROUTER_PREFIX}_link_bandwidth_bytes_per_s"
 
 # -- KVBM (kvbm/manager.py TieredKvManager + kvbm/connector.py) --------------
 KVBM_PREFIX = "dynamo_tpu_kvbm"
@@ -104,7 +107,13 @@ DISAGG_TRANSFERS_TOTAL = f"{DISAGG_PREFIX}_transfers_total"
 DISAGG_TRANSFER_FAILURES_TOTAL = f"{DISAGG_PREFIX}_transfer_failures_total"
 DISAGG_BLOCKS_PULLED_TOTAL = f"{DISAGG_PREFIX}_blocks_pulled_total"
 DISAGG_BYTES_PULLED_TOTAL = f"{DISAGG_PREFIX}_bytes_pulled_total"
+# Serialized KV payload bytes by wire dtype (disagg/wire.py schema v2):
+# int8-on-the-wire vs densified is THE transfer-bound disagg lever.
+DISAGG_KV_WIRE_BYTES_TOTAL = f"{DISAGG_PREFIX}_kv_wire_bytes_total"
 DISAGG_TRANSFER_DURATION = f"{DISAGG_PREFIX}_transfer_duration_seconds"
+# Observed per-(src, dst) transfer bandwidth EWMA, measured at the decode
+# worker's pull path and folded into the router via load reports.
+DISAGG_LINK_BANDWIDTH = f"{DISAGG_PREFIX}_link_bandwidth_bytes_per_s"
 
 ALL_FRONTEND = (
     FRONTEND_REQUESTS_TOTAL,
@@ -122,6 +131,7 @@ ALL_ROUTER = (
     ROUTER_WORKER_LOAD_BLOCKS,
     ROUTER_WORKER_KV_USAGE,
     ROUTER_KV_EVENTS_TOTAL,
+    ROUTER_LINK_BANDWIDTH,
 )
 
 ALL_KVBM = (
@@ -142,7 +152,9 @@ ALL_DISAGG = (
     DISAGG_TRANSFER_FAILURES_TOTAL,
     DISAGG_BLOCKS_PULLED_TOTAL,
     DISAGG_BYTES_PULLED_TOTAL,
+    DISAGG_KV_WIRE_BYTES_TOTAL,
     DISAGG_TRANSFER_DURATION,
+    DISAGG_LINK_BANDWIDTH,
 )
 
 ALL_RUNTIME = (

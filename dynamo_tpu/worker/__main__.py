@@ -352,7 +352,13 @@ async def main() -> None:
                 .client()
             )
 
-        handler = DecodeHandler(engine, kv_client_factory=_kv_client)
+        handler = DecodeHandler(
+            engine, kv_client_factory=_kv_client, worker_id=instance_id
+        )
+        # Load reports carry this worker's measured per-src pull bandwidth
+        # so the router's link-cost model prices decode placement with the
+        # links as they actually perform.
+        load_pub.link_bandwidth_fn = handler.link_bandwidth
         served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
         await register_llm(runtime, card, endpoint, instance_id)
     load_pub.start()

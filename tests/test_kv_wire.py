@@ -1,0 +1,493 @@
+"""KV wire format v2 (disagg/wire.py): zero-copy packing, pool-native
+quantized transfer, the full int8↔bf16 interop matrix with attention
+parity against a never-exported oracle, wire-bytes halving, handler dtype
+negotiation (v1 compat), and offline record/replay of transfer streams."""
+
+import asyncio
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.disagg import DecodeHandler, KvTransferHandler
+from dynamo_tpu.disagg.wire import (
+    KvWireBlocks,
+    pack_array,
+    pack_kv,
+    reply_wire_nbytes,
+    unpack_array,
+    unpack_kv,
+    unpack_reply,
+    wire_block_bytes,
+)
+from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.config import tiny_config
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import collect
+from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+
+# head_dim 64 (n_heads 2 × 64 = d_model 128): the realistic scale overhead
+# regime — f32 scales are 4/64 of the payload, so the quantized wire is
+# (1 + 4/64)/2 ≈ 0.53x the dense bf16 wire.
+def wire_cfg(**over):
+    base = dict(n_heads=2, n_kv_heads=2)
+    base.update(over)
+    return tiny_config(**base)
+
+
+def make_engine(**over):
+    defaults = dict(
+        config=wire_cfg(),
+        block_size=4,
+        num_kv_blocks=64,
+        max_num_seqs=4,
+        max_model_len=128,
+        prefill_chunk=32,
+        decode_steps=4,
+    )
+    defaults.update(over)
+    return JaxEngine(JaxEngineArgs(**defaults))
+
+
+def req(tokens, max_tokens=8):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pack_array: zero-copy serialization
+# ---------------------------------------------------------------------------
+
+
+def test_pack_array_zero_copy():
+    """A contiguous array is packed WITHOUT copying: the buffer is a
+    memoryview over the array's own memory, for f32 and bfloat16 alike."""
+    import ml_dtypes
+
+    for dtype in (np.float32, ml_dtypes.bfloat16, np.int8):
+        a = np.arange(64, dtype=np.float32).astype(dtype).reshape(4, 16)
+        d = pack_array(a)
+        assert isinstance(d["b"], memoryview)
+        assert len(d["b"]) == a.nbytes  # len == nbytes (uint8-cast view)
+        back = unpack_array(d)
+        assert np.shares_memory(back, a), dtype
+        np.testing.assert_array_equal(
+            np.asarray(back, np.float32), np.asarray(a, np.float32)
+        )
+
+
+def test_pack_array_copies_only_when_strided():
+    a = np.arange(64, dtype=np.float32).reshape(4, 16)
+    d = pack_array(a[:, ::2])  # non-contiguous: a copy is REQUIRED
+    back = unpack_array(d)
+    assert not np.shares_memory(back, a)
+    np.testing.assert_array_equal(back, a[:, ::2])
+
+
+def test_pack_array_survives_msgpack():
+    import msgpack
+
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    raw = msgpack.packb(pack_array(a), use_bin_type=True)
+    back = unpack_array(msgpack.unpackb(raw, raw=False))
+    np.testing.assert_array_equal(back, a)
+
+
+# ---------------------------------------------------------------------------
+# Interop matrix: attention parity vs the never-exported oracle (ops level)
+# ---------------------------------------------------------------------------
+
+
+def _pool(quantized: bool, NB, BS, KH, D, dtype=jnp.bfloat16):
+    if quantized:
+        return {
+            "q8": jnp.zeros((NB, BS, KH, D), jnp.int8),
+            "s": jnp.zeros((NB, KH, BS), jnp.float32),
+        }
+    return jnp.zeros((NB, BS, KH, D), dtype)
+
+
+@pytest.mark.parametrize("src_q", [False, True], ids=["src-bf16", "src-int8"])
+@pytest.mark.parametrize("dst_q", [False, True], ids=["dst-bf16", "dst-int8"])
+def test_interop_matrix_attention_parity(src_q, dst_q):
+    """Each cell: fill a src pool through the production write path,
+    wire-gather → pack → unpack → wire-scatter into a dst pool of the
+    other (or same) form, then compare attention outputs on the dst pool
+    against the NEVER-exported src oracle."""
+    from dynamo_tpu.engines.tpu.runner import (
+        _gather_blocks,
+        _gather_blocks_q8,
+        _scatter_blocks,
+        _scatter_blocks_q8,
+    )
+    from dynamo_tpu.ops.attention import _paged_attention_xla, write_chunk_to_cache
+
+    B, KH, G, D, BS, P = 2, 2, 2, 64, 8, 3
+    H = KH * G
+    NB = B * P + 2
+    rng = np.random.default_rng(11)
+    hist = jnp.asarray(
+        rng.standard_normal((B, BS * P, KH, D)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.permutation(NB)[: B * P].reshape(B, P).astype(np.int32)
+    )
+    start = jnp.asarray([5, 17], jnp.int32)
+    lens = jnp.asarray([4, 3], jnp.int32)
+    zero = jnp.zeros((B,), jnp.int32)
+    full = jnp.full((B,), BS * P, jnp.int32)
+
+    def fill(quantized, f):
+        return write_chunk_to_cache(
+            _pool(quantized, NB, BS, KH, D), hist * f, tables, zero, full
+        )
+
+    src_k, src_v = fill(src_q, 1.0), fill(src_q, 0.5)
+    q = jnp.asarray(
+        rng.standard_normal((B, 4, H, D)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    oracle = _paged_attention_xla(q, src_k, src_v, tables, start, lens)
+
+    # wire-gather every block (module-level layered form: 1-layer tuples)
+    idx = jnp.arange(NB, dtype=jnp.int32)
+    if src_q:
+        kq, ks = _gather_blocks_q8((src_k,), idx)
+        vq, vs = _gather_blocks_q8((src_v,), idx)
+        wire = KvWireBlocks(
+            dtype="int8",
+            k=np.asarray(kq.swapaxes(0, 1)), v=np.asarray(vq.swapaxes(0, 1)),
+            k_scale=np.asarray(ks.swapaxes(0, 1)),
+            v_scale=np.asarray(vs.swapaxes(0, 1)),
+        )
+    else:
+        kd = _gather_blocks((src_k,), idx)
+        vd = _gather_blocks((src_v,), idx)
+        wire = KvWireBlocks.dense(
+            np.asarray(kd.swapaxes(0, 1)), np.asarray(vd.swapaxes(0, 1))
+        )
+
+    wire = unpack_kv(pack_kv(wire))  # serialization round trip
+    assert wire.quantized == src_q
+
+    dst_k, dst_v = (_pool(dst_q, NB, BS, KH, D),), (_pool(dst_q, NB, BS, KH, D),)
+    if wire.quantized:
+        dst_k = _scatter_blocks_q8(
+            dst_k, idx, jnp.asarray(wire.k).swapaxes(0, 1),
+            jnp.asarray(wire.k_scale).swapaxes(0, 1),
+        )
+        dst_v = _scatter_blocks_q8(
+            dst_v, idx, jnp.asarray(wire.v).swapaxes(0, 1),
+            jnp.asarray(wire.v_scale).swapaxes(0, 1),
+        )
+    else:
+        dst_k = _scatter_blocks(dst_k, idx, jnp.asarray(wire.k).swapaxes(0, 1))
+        dst_v = _scatter_blocks(dst_v, idx, jnp.asarray(wire.v).swapaxes(0, 1))
+
+    out = _paged_attention_xla(q, dst_k[0], dst_v[0], tables, start, lens)
+    err = float(
+        jnp.abs(out.astype(jnp.float32) - oracle.astype(jnp.float32)).max()
+    )
+    assert err < 0.06, (src_q, dst_q, err)
+
+    if src_q and dst_q:
+        # int8 → int8 is BIT-EXACT: the dst pool holds the same q8/s words.
+        np.testing.assert_array_equal(
+            np.asarray(dst_k[0]["q8"]), np.asarray(src_k["q8"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dst_k[0]["s"]), np.asarray(src_k["s"])
+        )
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: wire bytes halved, int8→int8 exact continuation
+# ---------------------------------------------------------------------------
+
+
+async def test_int8_wire_bytes_at_most_055x_of_bf16():
+    """Acceptance: an int8-pool export's wire bytes (payload + scales) are
+    ≤ 0.55x the bf16 dense wire for the same blocks — both as KvWireBlocks
+    accounting and as actually-serialized payload bytes."""
+    cfg = wire_cfg(dtype=jnp.bfloat16)
+    e8 = make_engine(config=cfg, kv_cache_dtype="int8", seed=7)
+    eb = make_engine(config=cfg, seed=7)
+    try:
+        prompt = list(range(40, 56))  # 4 full blocks
+        for e in (e8, eb):
+            await collect(e.generate(req(prompt, max_tokens=2), Context()))
+        hashes = compute_block_hashes(prompt, 4)
+
+        found8, wire8 = await e8.export_blocks_wire_async(hashes)
+        foundb, wireb = await eb.export_blocks_wire_async(hashes)
+        assert found8 == hashes and foundb == hashes
+        assert wire8.dtype == "int8" and wire8.k.dtype == np.int8
+        assert wireb.dtype == "bfloat16"
+
+        ratio = wire8.nbytes / wireb.nbytes
+        assert ratio <= 0.55, ratio
+
+        ser8 = reply_wire_nbytes({"kv": pack_kv(wire8)})
+        serb = reply_wire_nbytes({"kv": pack_kv(wireb)})
+        assert ser8 == wire8.nbytes and serb == wireb.nbytes
+        assert ser8 / serb <= 0.55
+
+        # and the ONE sizing helper agrees with reality
+        c = e8.args.config
+        assert wire8.nbytes == len(hashes) * wire_block_bytes(
+            c.n_layers, 4, c.n_kv_heads, c.head_dim_, "int8"
+        )
+
+        # the flight ring records ACTUAL wire bytes + dtype, not the old
+        # post-dequant figure
+        exports = [
+            e for e in e8.flight.snapshot() if e["kind"] == "kv_export"
+        ]
+        assert exports
+        assert exports[-1]["bytes"] == wire8.nbytes
+        assert exports[-1]["dtype"] == "int8"
+    finally:
+        await e8.stop()
+        await eb.stop()
+
+
+async def test_engine_interop_int8_to_int8_exact():
+    """int8 → int8 transfers install the exporter's q8/s words verbatim:
+    the importer's greedy continuation is EXACTLY the exporter's."""
+    e1 = make_engine(kv_cache_dtype="int8", seed=7)
+    e2 = make_engine(kv_cache_dtype="int8", seed=7)
+    try:
+        prompt = list(range(40, 56))
+        out1 = await collect(e1.generate(req(prompt, max_tokens=6), Context()))
+        toks1 = [t for o in out1 for t in o.token_ids]
+
+        hashes = compute_block_hashes(prompt, 4)
+        found, wire = await e1.export_blocks_wire_async(hashes)
+        assert found == hashes and wire.quantized
+
+        installed = await e2.import_blocks_wire_async(found, wire)
+        assert installed == len(hashes)
+        assert e2.pool.match_prefix(hashes) == len(hashes)
+
+        prefill_before = e2.prefill_tokens
+        out2 = await collect(e2.generate(req(prompt, max_tokens=6), Context()))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert e2.prefill_tokens - prefill_before < len(prompt)
+        assert toks2 == toks1
+    finally:
+        await e1.stop()
+        await e2.stop()
+
+
+async def test_engine_interop_cross_dtype_cells():
+    """int8 → dense and dense → int8: imported content lands within quant
+    error of the exporter's dense view, and the prefix cache hits."""
+    for src_dtype, dst_dtype in (("int8", None), (None, "int8")):
+        e1 = make_engine(kv_cache_dtype=src_dtype, seed=9)
+        e2 = make_engine(kv_cache_dtype=dst_dtype, seed=9)
+        try:
+            prompt = list(range(60, 76))
+            await collect(e1.generate(req(prompt, max_tokens=2), Context()))
+            hashes = compute_block_hashes(prompt, 4)
+
+            found, wire = await e1.export_blocks_wire_async(hashes)
+            assert found == hashes
+            oracle_k, oracle_v = wire.to_dense(np.float32)
+
+            installed = await e2.import_blocks_wire_async(found, wire)
+            assert installed == len(hashes)
+            assert e2.pool.match_prefix(hashes) == len(hashes)
+
+            # dst pool content parity (dense re-export of what landed)
+            found2, k2, v2 = await e2.export_blocks_async(hashes)
+            assert found2 == hashes
+            err = max(
+                float(np.abs(np.asarray(k2, np.float32) - np.asarray(oracle_k, np.float32)).max()),
+                float(np.abs(np.asarray(v2, np.float32) - np.asarray(oracle_v, np.float32)).max()),
+            )
+            scale = float(np.abs(np.asarray(oracle_k, np.float32)).max()) or 1.0
+            assert err / scale < 0.02, (src_dtype, dst_dtype, err)
+        finally:
+            await e1.stop()
+            await e2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Handler negotiation: v2 pool-native + v1 dense compatibility
+# ---------------------------------------------------------------------------
+
+
+async def test_transfer_handler_negotiates_v2_and_v1():
+    engine = make_engine(kv_cache_dtype="int8", seed=5)
+    try:
+        prompt = list(range(30, 46))
+        await collect(engine.generate(req(prompt, max_tokens=2), Context()))
+        hashes = compute_block_hashes(prompt, 4)
+        handler = KvTransferHandler(engine)
+
+        # v2 importer: pool-native int8 payload in the kv envelope
+        replies = []
+        async for r in handler.generate(
+            {"block_hashes": hashes, "wire": {"version": 2, "accept": ["int8"]}},
+            Context(),
+        ):
+            replies.append(r)
+        assert replies and replies[-1]["done"]
+        wire = unpack_reply(replies[0])
+        assert wire is not None and wire.quantized
+
+        # v2 importer that VETOES int8: densified reply
+        async for r in handler.generate(
+            {"block_hashes": hashes, "wire": {"version": 2, "accept": ["float32"]}},
+            Context(),
+        ):
+            w = unpack_reply(r)
+            assert w is not None and not w.quantized
+            break
+
+        # v1 importer (no wire envelope): legacy dense k/v fields
+        async for r in handler.generate({"block_hashes": hashes}, Context()):
+            assert "kv" not in r or r.get("kv") is None
+            assert r.get("k") is not None
+            dense = unpack_array(r["k"])
+            assert "int8" not in str(dense.dtype)
+            break
+
+        # accept is authoritative for DENSE encodings too: an importer
+        # that only lists bfloat16 gets bfloat16, not the pool's float32
+        async for r in handler.generate(
+            {"block_hashes": hashes,
+             "wire": {"version": 2, "accept": ["bfloat16"]}},
+            Context(),
+        ):
+            w = unpack_reply(r)
+            assert w is not None and w.dtype == "bfloat16"
+            break
+    finally:
+        await engine.stop()
+
+
+def test_link_bandwidth_entries_age_out():
+    """A departed prefill worker's bandwidth entry must stop being
+    republished (it would resurrect scheduler-purged link pairs forever)."""
+    from dynamo_tpu.disagg import handlers as h
+
+    dh = DecodeHandler(engine=None, worker_id=2)
+    dh._observe_link(7, 1 << 20, 1.0)
+    assert dh.link_bandwidth() == {7: pytest.approx(float(1 << 20))}
+    # age the entry past the TTL
+    bw, at = dh._link_bw[7]
+    dh._link_bw[7] = (bw, at - h.LINK_BW_TTL_S - 1)
+    assert dh.link_bandwidth() == {}
+    assert 7 not in dh._link_bw  # pruned, not just hidden
+    # gauge series for the aged-out source is removed at next scrape
+    dh._observe_link(8, 1 << 20, 1.0)
+    text = dh.metrics.render()
+    assert 'src="8"' in text and 'src="7"' not in text
+
+
+async def test_disagg_e2e_int8_engines_wire_counted():
+    """Full pull between two int8 engines through the real endpoints: the
+    decode handler counts int8 wire bytes and measures link bandwidth, and
+    the continuation matches the exporter's (bit-exact pool transfer)."""
+    from dynamo_tpu.llm.protocols.common import DisaggregatedParams
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime.detached()
+    e1 = make_engine(kv_cache_dtype="int8", seed=3)
+    e2 = make_engine(kv_cache_dtype="int8", seed=3)
+    ns = rt.namespace("twire")
+    served = []
+    try:
+        prompt = list(range(80, 96))
+        out1 = await collect(e1.generate(req(prompt, max_tokens=6), Context()))
+        toks1 = [t for o in out1 for t in o.token_ids]
+
+        pc = ns.component("prefill")
+        served.append(
+            await pc.endpoint("kv").serve_endpoint(
+                KvTransferHandler(e1).generate, instance_id=1
+            )
+        )
+
+        async def kv_client():
+            return await pc.endpoint("kv").client()
+
+        handler = DecodeHandler(e2, kv_client_factory=kv_client, worker_id=2)
+        hashes = compute_block_hashes(prompt, 4)
+        dp = DisaggregatedParams(
+            worker_id=1, prefilled_tokens=len(prompt),
+            kv_transfer={"block_hashes": hashes, "block_size": 4},
+        )
+        pulled = await handler._pull_blocks(dp)
+        assert pulled == len(hashes)
+        assert set(handler.wire_bytes_by_dtype) == {"int8"}
+        assert handler.wire_bytes_by_dtype["int8"] == handler.bytes_pulled > 0
+        assert 1 in handler.link_bandwidth()  # (src=1 → dst) EWMA seeded
+        assert handler.link_bandwidth()[1] > 0
+
+        out2 = await collect(e2.generate(req(prompt, max_tokens=6), Context()))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert toks2 == toks1
+    finally:
+        for s in served:
+            await s.shutdown(grace_period=1)
+        await e1.stop()
+        await e2.stop()
+        await rt.shutdown(grace_period=1)
+
+
+# ---------------------------------------------------------------------------
+# Recorder: v2 KV payloads replay offline
+# ---------------------------------------------------------------------------
+
+
+async def test_recorder_replays_v2_kv_payloads(tmp_path):
+    """A recorded transfer stream (binary wire buffers included) loads back
+    bit-exact and replays through unpack_reply — disagg transfer bugs stay
+    debuggable offline."""
+    from dynamo_tpu.llm.recorder import ReplayEngine, StreamRecorder, load_recording
+
+    rng = np.random.default_rng(2)
+    wire = KvWireBlocks(
+        dtype="int8",
+        k=rng.integers(-127, 127, size=(2, 1, 4, 2, 8), dtype=np.int8),
+        v=rng.integers(-127, 127, size=(2, 1, 4, 2, 8), dtype=np.int8),
+        k_scale=rng.random((2, 1, 2, 4)).astype(np.float32),
+        v_scale=rng.random((2, 1, 2, 4)).astype(np.float32),
+    )
+    reply = {"found": [11, 22], "kv": pack_kv(wire), "done": True}
+
+    class FakeExporter:
+        async def generate(self, request, context):
+            yield reply
+
+    path = str(tmp_path / "xfer.jsonl")
+    rec = StreamRecorder(path)
+    got = []
+    async for item in rec.generate(
+        {"op": "export", "block_hashes": [11, 22]}, Context(), FakeExporter()
+    ):
+        got.append(item)
+    assert len(got) == 1
+
+    streams = load_recording(path)
+    assert len(streams) == 1
+    assert streams[0].request["block_hashes"] == [11, 22]
+    replay = ReplayEngine(streams)
+    replayed = []
+    async for item in replay.generate(streams[0].request, Context()):
+        replayed.append(item)
+    back = unpack_reply(replayed[0])
+    assert back is not None and back.quantized
+    np.testing.assert_array_equal(back.k, wire.k)
+    np.testing.assert_array_equal(back.v_scale, wire.v_scale)
+    assert replayed[0]["found"] == [11, 22]

@@ -109,6 +109,63 @@ async def test_write_through_offload_and_onboard(tmp_path):
         await engine.stop()
 
 
+async def test_quantized_offload_halves_tier_footprint(tmp_path):
+    """An int8-pool engine offloads the pool-native wire form: the tier
+    holds int8 payloads + scales (≈ half the dense bytes), disk spill
+    round-trips them, and onboarding restores a bit-exact continuation."""
+    engine = make_engine(kv_cache_dtype="int8")
+    disk = DiskTier(str(tmp_path))
+    host = HostTier(64, next_tier=disk)
+    kvbm = TieredKvManager(host)
+    kvbm.attach(engine)
+    try:
+        prompt_a = list(range(100, 116))  # 4 blocks
+        out_a = await collect(engine.generate(req(prompt_a), Context()))
+        toks_a = [t for o in out_a for t in o.token_ids]
+        await asyncio.sleep(0.2)
+        assert kvbm.offloaded > 0
+
+        from dynamo_tpu.tokens.blocks import compute_block_hashes
+
+        hashes_a = compute_block_hashes(prompt_a, 4)
+        blk = host.get(hashes_a[0])
+        assert blk is not None and len(blk) == 4  # quantized 4-tuple
+        k_q8, v_q8, k_s, v_s = blk
+        assert k_q8.dtype == np.int8 and k_s.dtype == np.float32
+        cfg = engine.args.config
+        dense_bytes = (
+            2 * cfg.n_layers * 4 * cfg.n_kv_heads * cfg.head_dim_
+            * np.dtype(np.float32).itemsize
+        )
+        quant_bytes = (
+            k_q8.nbytes + v_q8.nbytes + k_s.nbytes + v_s.nbytes
+        )
+        assert quant_bytes < 0.55 * dense_bytes, (quant_bytes, dense_bytes)
+
+        # disk spill keeps the quantized form
+        disk.put(0xDEAD, *blk)
+        back = disk.get(0xDEAD)
+        assert back is not None and len(back) == 4
+        np.testing.assert_array_equal(back[0], k_q8)
+        np.testing.assert_array_equal(back[2], k_s)
+
+        # evict from the device pool, rerun: onboard restores bit-exact KV
+        for i in range(4):
+            await collect(
+                engine.generate(req(range(200 + 20 * i, 212 + 20 * i)), Context())
+            )
+        assert engine.pool.match_prefix(hashes_a) < len(hashes_a)
+        prefill_before = engine.prefill_tokens
+        out_b = await collect(engine.generate(req(prompt_a), Context()))
+        toks_b = [t for o in out_b for t in o.token_ids]
+        assert kvbm.onboarded > 0
+        assert engine.prefill_tokens - prefill_before < len(prompt_a)
+        assert toks_b == toks_a
+    finally:
+        await kvbm.close()
+        await engine.stop()
+
+
 async def test_offload_filter_depth():
     engine = make_engine()
     kvbm = TieredKvManager(HostTier(64), filter=OffloadFilter(min_chain_depth=3))

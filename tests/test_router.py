@@ -119,6 +119,131 @@ class TestScheduler:
         assert picks == {(1, 0), (2, 0)}
 
 
+class TestLinkCost:
+    """Link-cost-aware decode placement (disagg): the (src → dst) wire is
+    part of the cost model, so prefix overlap can't win blindly."""
+
+    BLOCK_BYTES = 1 << 20  # 1 MiB of KV per block on the wire
+
+    def _sched(self):
+        from dynamo_tpu.router import TransferContext  # noqa: F401
+
+        return KvScheduler(KvRouterConfig(), seed=0)
+
+    def test_link_cost_flips_decode_placement(self):
+        """Worker 1 has 10/12 blocks of overlap but sits behind a measured
+        1 MB/s link from the prefill source; worker 2 has NO overlap on a
+        1 GB/s link. Without the link term worker 1 wins; with it, pulling
+        2 MiB at 1 MB/s (~2 s) costs more block-equivalents than worker
+        2's 12-block re-pull at 1 GB/s — the decision flips."""
+        from dynamo_tpu.router import TransferContext
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        overlaps = OverlapScores(scores={(1, 0): 10})
+        transfer = TransferContext(src=7, bytes_per_block=self.BLOCK_BYTES)
+
+        sched = self._sched()
+        sched.link_costs.set_bandwidth(7, (1, 0), 1e6)   # slow link
+        sched.link_costs.set_bandwidth(7, (2, 0), 1e9)   # fast link
+
+        # Control: same state, no transfer context → overlap wins.
+        assert (
+            sched.select_worker(12, overlaps, [(1, 0), (2, 0)]) == (1, 0)
+        )
+        sched2 = self._sched()
+        sched2.link_costs.set_bandwidth(7, (1, 0), 1e6)
+        sched2.link_costs.set_bandwidth(7, (2, 0), 1e9)
+        w = sched2.select_worker(
+            12, overlaps, [(1, 0), (2, 0)], transfer=transfer
+        )
+        assert w == (2, 0), w
+
+    def test_pull_from_source_itself_is_free(self):
+        """A candidate that IS the prefill source pays no wire cost even
+        over an otherwise-slow recorded pair."""
+        from dynamo_tpu.router import TransferContext
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        sched = self._sched()
+        sched.link_costs.set_bandwidth(1, (2, 0), 1e5)  # terrible link
+        w = sched.select_worker(
+            8, OverlapScores(), [(1, 0), (2, 0)],
+            transfer=TransferContext(src=1, bytes_per_block=self.BLOCK_BYTES),
+        )
+        assert w == (1, 0)
+
+    def test_unmeasured_pair_quotes_seed_default(self):
+        """A never-measured pair must NOT be penalized into losing: the
+        seed default is optimistic, so overlap still decides."""
+        from dynamo_tpu.router import TransferContext
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        sched = self._sched()
+        w = sched.select_worker(
+            12, OverlapScores(scores={(1, 0): 10}), [(1, 0), (2, 0)],
+            transfer=TransferContext(src=7, bytes_per_block=self.BLOCK_BYTES),
+        )
+        assert w == (1, 0)
+
+    def test_load_reports_fold_bandwidth_ewma(self):
+        """LoadSnapshot.link_bandwidth lands in the scheduler's link-cost
+        model as an EWMA per (src, reporting worker), including stringified
+        map keys from JSON planes."""
+        sched = self._sched()
+        sched.update_load(
+            LoadSnapshot(
+                worker_id=2, total_blocks=100,
+                link_bandwidth={"7": 1e6},  # JSON-stringified src key
+            )
+        )
+        assert sched.link_costs.bandwidth(7, (2, 0)) == pytest.approx(1e6)
+        sched.update_load(
+            LoadSnapshot(
+                worker_id=2, total_blocks=100, link_bandwidth={7: 3e6}
+            )
+        )
+        # EWMA, not replacement: 0.25·3e6 + 0.75·1e6
+        assert sched.link_costs.bandwidth(7, (2, 0)) == pytest.approx(1.5e6)
+        # unrelated pair still quotes the seed default
+        assert sched.link_costs.bandwidth(7, (3, 0)) == pytest.approx(
+            sched.config.default_link_bandwidth
+        )
+
+    def test_remove_worker_drops_link_pairs(self):
+        sched = self._sched()
+        sched.link_costs.set_bandwidth(7, (2, 0), 1e6)
+        sched.add_worker((2, 0))
+        sched.remove_worker((2, 0))
+        assert not sched.link_costs.pairs()
+
+    def test_transfer_context_extracted_from_request(self):
+        """The picker derives (src, block_bytes) from the disagg bootstrap
+        metadata in both dict- and dataclass-shaped requests; requests
+        without it route with no link term."""
+        from dynamo_tpu.llm.protocols.common import DisaggregatedParams
+        from dynamo_tpu.router.router import _transfer_context_of
+
+        dp = DisaggregatedParams(
+            worker_id=5, prefilled_tokens=16,
+            kv_transfer={"block_hashes": [1], "block_bytes": 4096,
+                         "wire_dtype": "int8"},
+        )
+        req_obj = PreprocessedRequest(
+            token_ids=[1, 2], sampling=SamplingOptions(),
+            stop=StopConditions(), disaggregated_params=dp,
+        )
+        ctx = _transfer_context_of(req_obj)
+        assert ctx is not None and ctx.src == 5 and ctx.bytes_per_block == 4096
+        ctx = _transfer_context_of(req_obj.to_dict())
+        assert ctx is not None and ctx.src == 5 and ctx.bytes_per_block == 4096
+        req_obj.disaggregated_params = None
+        assert _transfer_context_of(req_obj) is None
+        # v1 prefill worker: bootstrap without block_bytes → no link term
+        dp.kv_transfer = {"block_hashes": [1]}
+        req_obj.disaggregated_params = dp
+        assert _transfer_context_of(req_obj) is None
+
+
 def _req(tokens, max_tokens=4):
     return PreprocessedRequest(
         token_ids=list(tokens),
