@@ -122,6 +122,34 @@ def _anchor_toks_per_sec(cfg, batch: int, avg_ctx: float, quant: str | None) -> 
     return batch / step_s
 
 
+def _fault_activity_start() -> dict:
+    from dynamo_tpu.runtime import faults
+
+    return faults.activity_snapshot()
+
+
+def _fault_plane_record(activity_before: dict) -> dict:
+    """Fault-plane counters for one leg (deltas since the leg started):
+    a chaos-free bench run must show zero retries, breaker opens, and
+    migrations — a nonzero here is a self-healing path activating
+    SPURIOUSLY, which is itself a perf regression (every retry is wire
+    time, every migration a re-prefill)."""
+    from dynamo_tpu.runtime import faults
+
+    snap = faults.plane_snapshot()
+    delta = {
+        k: v - activity_before.get(k, 0)
+        for k, v in snap["activity"].items()
+    }
+    return {
+        "armed": snap["armed"],
+        "injections": snap["injections"],
+        "pull_retries": delta.get("pull_retries", 0),
+        "breaker_opens": delta.get("breaker_opens", 0),
+        "migrations": delta.get("migrations", 0),
+    }
+
+
 async def run_leg(model_name: str, quant: str | None, spec: str | None,
                   concurrency: int | None = None, requests: int | None = None,
                   kv_quant: str | None = None, isl: int | None = None,
@@ -145,6 +173,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     # Per-leg compile deltas: the watcher is process-global, so snapshot
     # BEFORE the leg's engine exists (its programs compile during warmup).
     compile_before = global_compile_watcher().totals()
+    fault_activity0 = _fault_activity_start()
 
     cfg = {
         "qwen2.5-0.5b": qwen2_500m_config,
@@ -336,6 +365,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         ),
         "mfu": round(toks_per_sec * flops_per_tok / V5E_PEAK_BF16, 4),
         "hbm_util": round(toks_per_sec / roofline, 4),
+        "fault_plane": _fault_plane_record(fault_activity0),
         **(
             {
                 "spec_proposed": stats.get("spec_proposed", 0),
@@ -389,6 +419,7 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
 
     import dataclasses
 
+    fault_activity0 = _fault_activity_start()
     cfg = qwen2_500m_config()
     if n_layers:
         cfg = dataclasses.replace(cfg, n_layers=n_layers)
@@ -597,6 +628,7 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
                     "device stalls (overlap is asserted by "
                     "tests/test_disagg.py::test_export_readback_overlaps_decode)"
                 ),
+                "fault_plane": _fault_plane_record(fault_activity0),
             }
 
         res, wall = await run_wave(gen, requests)
@@ -640,6 +672,12 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
                 str(src): round(bw / 1e6, 1)
                 for src, bw in decode_handler.link_bandwidth().items()
             },
+            # Chaos-free proof: retries/breaker/migration counters must be
+            # zero when no fault plan is armed (self-healing sat idle).
+            "fault_plane": _fault_plane_record(fault_activity0),
+            "pull_retries": decode_handler.pull_retries,
+            "breaker_opens": decode_handler.breaker_opens,
+            "pull_fallbacks": decode_handler.pull_fallbacks,
         }
     finally:
         for s in served:

@@ -12,7 +12,7 @@ real serving stacks make once invariants outnumber reviewers (the
 reference Dynamo gates its Rust core on clippy; JAX ships its own
 leak-checker / debug tooling).
 
-Five passes (docs/design_docs/static_analysis.md has the catalog):
+Six passes (docs/design_docs/static_analysis.md has the catalog):
 
   DYN001  jit-discipline     every jax.jit construction is wrapped in
                              watched_jit and not rebuilt per call/loop
@@ -25,6 +25,8 @@ Five passes (docs/design_docs/static_analysis.md has the catalog):
                              ALL_* tuples, both directions
   DYN005  single-writer      flight-recorder appends attributable to the
           rings              ring's one owning class
+  DYN006  fault-point        fault_point() names <-> fault_names
+          closure            ALL_FAULT_POINTS, both directions
 
 Ships three ways: ``dynamo-tpu lint`` (analysis/cli.py), the tier-1 gate
 (tests/test_dynlint.py, zero non-baselined findings over dynamo_tpu/),
@@ -51,7 +53,7 @@ from dynamo_tpu.analysis.core import (
 )
 from dynamo_tpu.analysis.config import LintConfig, repo_config
 
-# Importing the rules package registers the five passes.
+# Importing the rules package registers the six passes.
 from dynamo_tpu.analysis import rules as _rules  # noqa: F401
 
 __all__ = [

@@ -53,6 +53,11 @@ class HotPathConfig:
             "engines/tpu/runner.py",
             "engines/metrics.py",
             "runtime/device_observe.py",
+            # The fault plane's tick seams (fault_point at dispatch/reap)
+            # are IN the hot loop — the disabled-plane path must stay a
+            # bare flag check, and this scope entry makes the linter walk
+            # through faults.py to prove it.
+            "runtime/faults.py",
         }
     )
     boundaries: FrozenSet[Tuple[str, str]] = frozenset(
@@ -126,8 +131,25 @@ class RingWriterConfig:
         default_factory=lambda: {
             "engine": ("engines/tpu/engine.py", "JaxEngine"),
             "runner": ("engines/tpu/runner.py", "DeviceRunner"),
+            # Faultline rings (PR 7): pull retry/breaker history, stream
+            # migrations, canary transitions — each single-writer on its
+            # owner's event loop.
+            "disagg": ("disagg/handlers.py", "DecodeHandler"),
+            "migration": ("llm/migration.py", "Migration"),
+            "health": ("runtime/health.py", "CanaryHealthChecker"),
         }
     )
+
+
+@dataclass(frozen=True)
+class FaultPointConfig:
+    """DYN006. ``fault_names_rel``: the single module allowed to declare
+    fault-point names (loaded by file path — no package import, the
+    linter stays jax-free). ``call_names``: the functions whose first
+    argument is a point name (``fault_point`` and any alias)."""
+
+    fault_names_rel: str = "runtime/fault_names.py"
+    call_names: FrozenSet[str] = frozenset({"fault_point"})
 
 
 @dataclass(frozen=True)
@@ -139,6 +161,9 @@ class LintConfig:
         default_factory=MetricClosureConfig
     )
     rings: Optional[RingWriterConfig] = field(default_factory=RingWriterConfig)
+    faults: Optional[FaultPointConfig] = field(
+        default_factory=FaultPointConfig
+    )
 
 
 def repo_config() -> LintConfig:
@@ -150,7 +175,7 @@ def repo_config() -> LintConfig:
 def portable_config() -> LintConfig:
     """Rules meaningful on ANY tree: DYN001 (jit discipline) and DYN003
     (silent swallow). The repo-specific passes — hot-path roots, the
-    metric-name registry, ring ownership — are tied to dynamo_tpu's
-    layout and would only emit config-mismatch noise on a foreign
-    ``--root``; they are disabled here."""
-    return LintConfig(hot_path=None, metrics=None, rings=None)
+    metric-name registry, ring ownership, the fault-point registry — are
+    tied to dynamo_tpu's layout and would only emit config-mismatch noise
+    on a foreign ``--root``; they are disabled here."""
+    return LintConfig(hot_path=None, metrics=None, rings=None, faults=None)

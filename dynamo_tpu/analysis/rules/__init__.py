@@ -1,6 +1,7 @@
-"""The five dynlint passes. Importing this package registers them."""
+"""The six dynlint passes. Importing this package registers them."""
 
 from dynamo_tpu.analysis.rules import (  # noqa: F401
+    fault_points,
     hot_path,
     jit_discipline,
     metric_closure,

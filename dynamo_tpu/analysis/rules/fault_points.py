@@ -1,0 +1,185 @@
+"""DYN006 fault-point closure: both directions of the faultline contract.
+
+Forward: the first argument of every ``fault_point(...)`` call statically
+resolves to a member of ``fault_names.ALL_FAULT_POINTS``. A string literal
+at a seam is a name the registry can silently drift from (import the
+constant); a constant that is not a declared point is a typo that would
+make a chaos plan silently never fire; a dynamic expression cannot be
+closed at all — every one is a finding.
+
+Reverse: every declared point has at least one seam. A dead point is chaos
+coverage that quietly stopped existing — a plan targeting it arms fine and
+injects nothing.
+
+Mirror of DYN004 (metric closure): the names module is loaded BY FILE
+PATH (no package import) — it is dependency-free by design and the linter
+must run without jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register_rule,
+)
+
+
+def _load_names_module(path: str):
+    spec = importlib.util.spec_from_file_location("_dynlint_fault_names", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def _registry(names_mod) -> Tuple[Dict[str, str], Set[str]]:
+    """(const name → value, declared point values)."""
+    consts: Dict[str, str] = {
+        k: v
+        for k, v in vars(names_mod).items()
+        if isinstance(v, str) and not k.startswith("_")
+    }
+    members: Set[str] = set()
+    for k, v in vars(names_mod).items():
+        if k.startswith("ALL_") and isinstance(v, tuple):
+            members |= {x for x in v if isinstance(x, str)}
+    return consts, members
+
+
+def _is_fault_point_call(node: ast.Call, cfg) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in cfg.call_names
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in cfg.call_names
+    return False
+
+
+@register_rule
+class FaultPointClosureRule(Rule):
+    id = "DYN006"
+    title = "fault-point names close over the fault_names registry"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.faults
+        if cfg is None:
+            return
+        names_module = project.module(cfg.fault_names_rel)
+        if names_module is None:
+            yield Finding(
+                rule=self.id,
+                path=cfg.fault_names_rel,
+                line=1,
+                message="fault-names module missing from the linted tree",
+            )
+            return
+        try:
+            names_mod = _load_names_module(
+                os.path.join(project.root, cfg.fault_names_rel)
+            )
+        except Exception as exc:
+            yield Finding(
+                rule=self.id,
+                path=cfg.fault_names_rel,
+                line=1,
+                message=(
+                    f"fault-names module failed to load ({exc!r}) — it is "
+                    "executed by file path and must stay dependency-free"
+                ),
+            )
+            return
+        consts, members = _registry(names_mod)
+        covered: Set[str] = set()
+        sites: List[Tuple[ModuleInfo, ast.Call]] = []
+        for module in project.modules:
+            if module.rel == cfg.fault_names_rel:
+                continue
+            for node in module.nodes:
+                if isinstance(node, ast.Call) and _is_fault_point_call(
+                    node, cfg
+                ):
+                    sites.append((module, node))
+
+        for module, node in sites:
+            yield from self._check_site(module, node, consts, members, covered)
+
+        for value in sorted(members - covered):
+            yield Finding(
+                rule=self.id,
+                path=cfg.fault_names_rel,
+                line=self._def_line(names_module, value, consts),
+                message=(
+                    f"dead fault point {value!r} — declared but installed "
+                    "at no seam; a chaos plan targeting it would inject "
+                    "nothing. Install the point or delete the entry"
+                ),
+            )
+
+    def _check_site(
+        self,
+        module: ModuleInfo,
+        node: ast.Call,
+        consts: Dict[str, str],
+        members: Set[str],
+        covered: Set[str],
+    ) -> Iterator[Finding]:
+        if not node.args:
+            yield Finding.at(
+                module, node, self.id,
+                f"fault_point() without a point name in "
+                f"{module.qualname(node)}",
+            )
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            covered.add(arg.value)
+            yield Finding.at(
+                module, node, self.id,
+                f"literal fault-point name {arg.value!r} in "
+                f"{module.qualname(node)} — import the constant from "
+                "runtime/fault_names.py so the registry cannot drift",
+            )
+            return
+        # fault_names.X / fn.X / bare X resolved through the registry.
+        const_name: Optional[str] = None
+        if isinstance(arg, ast.Attribute):
+            const_name = arg.attr
+        elif isinstance(arg, ast.Name):
+            const_name = arg.id
+        if const_name is None or const_name not in consts:
+            yield Finding.at(
+                module, node, self.id,
+                f"fault-point name in {module.qualname(node)} does not "
+                "statically resolve into runtime/fault_names.py — use a "
+                "declared constant, not a computed expression",
+            )
+            return
+        value = consts[const_name]
+        covered.add(value)
+        if value not in members:
+            yield Finding.at(
+                module, node, self.id,
+                f"fault point {const_name} ({value!r}) used in "
+                f"{module.qualname(node)} but pinned in no ALL_* tuple — "
+                "add it to ALL_FAULT_POINTS in runtime/fault_names.py",
+            )
+
+    @staticmethod
+    def _def_line(
+        names_module: ModuleInfo, value: str, consts: Dict[str, str]
+    ) -> int:
+        rev = {v: k for k, v in consts.items()}
+        want = rev.get(value)
+        for node in ast.walk(names_module.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == want:
+                        return node.lineno
+        return 1

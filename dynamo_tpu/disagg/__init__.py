@@ -11,7 +11,9 @@ after which ordinary prefix-cached admission reuses them, and the partial
 tail block is recomputed locally (cheap).
 """
 
+from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
 from dynamo_tpu.disagg.handlers import (
+    CircuitBreaker,
     DecodeHandler,
     KvTransferHandler,
     PrefillHandler,
@@ -29,10 +31,13 @@ from dynamo_tpu.disagg.wire import (
 from dynamo_tpu.disagg.prefill_router import PrefillRouter
 
 __all__ = [
+    "CircuitBreaker",
     "DecodeHandler",
+    "DisaggTransferError",
     "KvTransferHandler",
     "PrefillHandler",
     "PrefillRouter",
+    "classify_failure",
     "pack_array",
     "unpack_array",
 ]

@@ -7,12 +7,14 @@ content-addressed KV blocks instead of NIXL descriptors.
 
 from __future__ import annotations
 
+import asyncio
 import os
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
 from dynamo_tpu.disagg.wire import (
     WIRE_VERSION,
     KvWireBlocks,
@@ -29,8 +31,10 @@ from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     PreprocessedRequest,
 )
-from dynamo_tpu.runtime import lifecycle
+from dynamo_tpu.runtime import fault_names, lifecycle
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.device_observe import FlightRecorder
+from dynamo_tpu.runtime.faults import fault_point, note_activity
 from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
@@ -51,6 +55,103 @@ LINK_BW_EWMA_ALPHA = 0.25
 # in every load report FOREVER — resurrecting the pairs the scheduler's
 # remove_worker purged and leaking dead-worker gauge series.
 LINK_BW_TTL_S = 600.0
+
+# -- self-healing pull knobs (env-overridable; ctor args win) ----------------
+# Bounded retry: attempts per pull (1 = the old single-shot behavior).
+PULL_MAX_ATTEMPTS = int(os.environ.get("DYN_TPU_PULL_ATTEMPTS", 3))
+# Exponential backoff between attempts: base × 2^(attempt-1), capped.
+PULL_BACKOFF_BASE_S = float(os.environ.get("DYN_TPU_PULL_BACKOFF_S", 0.05))
+PULL_BACKOFF_CAP_S = 2.0
+# Per-ATTEMPT timeout when the request carries no deadline; with a
+# deadline, each attempt gets min(this, time remaining) so a dead wire
+# can never eat the whole request budget.
+PULL_DEFAULT_TIMEOUT_S = float(os.environ.get("DYN_TPU_PULL_TIMEOUT_S", 30.0))
+# Circuit breaker: consecutive pull failures from one src before the
+# (src → this worker) pair opens, and how long it stays priced out of
+# placement before the next pull is admitted as the half-open probe.
+BREAKER_OPEN_AFTER = int(os.environ.get("DYN_TPU_BREAKER_OPEN_AFTER", 3))
+BREAKER_COOLDOWN_S = float(os.environ.get("DYN_TPU_BREAKER_COOLDOWN_S", 30.0))
+
+
+class CircuitBreaker:
+    """Per-(src prefill worker) pull breaker.
+
+    closed → open after ``open_after`` consecutive failures; open →
+    half_open when ``allow()`` is first called after ``cooldown_s`` (that
+    caller IS the probe; concurrent pulls fail fast until it resolves);
+    half_open → closed on probe success, → open (fresh cooldown) on probe
+    failure. ``advertised()`` is True only while open AND inside the
+    cooldown window — that is the interval load reports carry the src in
+    ``link_faults`` so the router prices the pair out of disagg placement;
+    after the window the pair becomes placeable again and the first pull
+    probes it.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        open_after: int = BREAKER_OPEN_AFTER,
+        cooldown_s: float = BREAKER_COOLDOWN_S,
+        *,
+        clock=time.monotonic,
+        on_transition=None,  # (old_state, new_state) -> None
+    ) -> None:
+        self.open_after = open_after
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._on_transition = on_transition
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+
+    def _transition(self, new_state: str) -> None:
+        if new_state == self.state:
+            return
+        old, self.state = self.state, new_state
+        if new_state == self.OPEN:
+            self.opened_at = self._clock()
+        if self._on_transition is not None:
+            self._on_transition(old, new_state)
+
+    def allow(self) -> bool:
+        """May a pull from this src proceed right now?"""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if self._clock() - self.opened_at >= self.cooldown_s:
+                self._transition(self.HALF_OPEN)
+                return True  # this caller is the probe
+            return False
+        return False  # half-open: a probe is already in flight
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._transition(self.CLOSED)
+
+    def abort_probe(self) -> None:
+        """The half-open probe was cancelled without resolving (client
+        disconnect mid-pull): return to OPEN with a fresh cooldown.
+        Without this the breaker wedges in HALF_OPEN forever — allow()
+        never admits another probe and advertised() never prices the
+        pair out. Not a failure: cancellation says nothing about the
+        link, so the consecutive count is untouched."""
+        if self.state == self.HALF_OPEN:
+            self._transition(self.OPEN)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN or (
+            self.state == self.CLOSED
+            and self.consecutive_failures >= self.open_after
+        ):
+            self._transition(self.OPEN)
+
+    def advertised(self) -> bool:
+        return (
+            self.state == self.OPEN
+            and self._clock() - self.opened_at < self.cooldown_s
+        )
 
 
 def _engine_wire_dtype(engine: Any) -> str:
@@ -86,8 +187,27 @@ class DisaggMetrics:
         )
         self.transfer_failures = self.registry.counter(
             mn.DISAGG_TRANSFER_FAILURES_TOTAL,
-            "Failed KV pulls — each one IS the 2x-cost path: the decode "
-            "worker falls back to a second full local prefill",
+            "Failed KV pull attempts by classified kind (timeout vs "
+            "connection vs decode). An attempt that exhausts retries IS "
+            "the 2x-cost path: a second full local prefill",
+            ["error_kind"],
+        )
+        self.pull_retries = self.registry.counter(
+            mn.DISAGG_PULL_RETRIES_TOTAL,
+            "Retried pull attempts (anchor-resume: only the not-yet-"
+            "imported tail re-rides the wire)",
+        )
+        self.breaker_transitions = self.registry.counter(
+            mn.DISAGG_BREAKER_TRANSITIONS_TOTAL,
+            "Per-src circuit-breaker transitions; an open breaker is "
+            "advertised in load reports and prices the (src, this "
+            "worker) pair out of disagg placement",
+            ["src", "to"],
+        )
+        self.breaker_open = self.registry.gauge(
+            mn.DISAGG_BREAKER_OPEN,
+            "1 while the pull breaker for a src prefill worker is open",
+            ["src"],
         )
         self.blocks_pulled = self.registry.counter(
             mn.DISAGG_BLOCKS_PULLED_TOTAL, "KV blocks imported from prefill"
@@ -115,7 +235,10 @@ class DisaggMetrics:
         self._link_source = None
         self._dst_label = "local"
         self._link_srcs: set = set()
+        self._breaker_source = None
+        self._breaker_srcs: set = set()
         self.registry.on_render(self._sample_links)
+        self.registry.on_render(self._sample_breakers)
 
     def watch_links(self, bandwidth_fn, dst_label: str) -> None:
         """Sample ``bandwidth_fn()`` (src worker id → bytes/s EWMA) into
@@ -123,6 +246,11 @@ class DisaggMetrics:
         out of the EWMA table are dropped."""
         self._link_source = bandwidth_fn
         self._dst_label = dst_label
+
+    def watch_breakers(self, states_fn) -> None:
+        """Sample ``states_fn()`` (src worker id → CircuitBreaker) into the
+        per-src open gauge at scrape time; departed srcs drop."""
+        self._breaker_source = states_fn
 
     def _sample_links(self) -> None:
         if self._link_source is None:
@@ -135,6 +263,20 @@ class DisaggMetrics:
         for gone in self._link_srcs - live:
             self.link_bandwidth.remove(src=gone, dst=self._dst_label)
         self._link_srcs = live
+
+    def _sample_breakers(self) -> None:
+        if self._breaker_source is None:
+            return
+        live = set()
+        for src, breaker in self._breaker_source().items():
+            label = str(src)
+            live.add(label)
+            self.breaker_open.set(
+                0 if breaker.state == CircuitBreaker.CLOSED else 1, src=label
+            )
+        for gone in self._breaker_srcs - live:
+            self.breaker_open.remove(src=gone)
+        self._breaker_srcs = live
 
     def render(self, openmetrics: bool = False) -> str:
         return self.registry.render(openmetrics=openmetrics)
@@ -270,6 +412,10 @@ class KvTransferHandler:
         sent_any = False
         for off in range(0, len(hashes), per):
             chunk = hashes[off : off + per]
+            # Chaos seam: an export failing mid-stream kills this reply
+            # stream; the puller classifies it and retries from its last
+            # imported anchor.
+            fault_point(fault_names.DISAGG_KV_EXPORT, off=off)
             if wire_dtype is None:
                 # v1 importer: dense k/v fields.
                 found, k, v = await self._engine.export_blocks_async(chunk)
@@ -308,6 +454,12 @@ class DecodeHandler:
     def __init__(
         self, engine: Any, kv_client_factory=None,
         *, worker_id: Optional[int] = None,
+        fallback_local_prefill: bool = True,
+        pull_attempts: Optional[int] = None,
+        pull_timeout_s: Optional[float] = None,
+        breaker_open_after: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
+        backoff_base_s: Optional[float] = None,
     ) -> None:
         self._engine = engine
         # async () -> Client for the prefill component's "kv" endpoint
@@ -316,11 +468,26 @@ class DecodeHandler:
         # This worker's identity — the ``dst`` of every (src prefill
         # worker, dst decode worker) bandwidth pair it measures.
         self.worker_id = worker_id
+        # Strict disagg: with fallback disabled, a terminally-failed pull
+        # raises DisaggTransferError (MIGRATABLE) instead of silently
+        # re-prefilling — the frontend re-dispatches to another worker.
+        self.fallback_local_prefill = fallback_local_prefill
+        self.pull_attempts = pull_attempts or PULL_MAX_ATTEMPTS
+        self.pull_timeout_s = pull_timeout_s or PULL_DEFAULT_TIMEOUT_S
+        self.backoff_base_s = (
+            PULL_BACKOFF_BASE_S if backoff_base_s is None else backoff_base_s
+        )
+        self._breaker_open_after = breaker_open_after or BREAKER_OPEN_AFTER
+        self._breaker_cooldown_s = breaker_cooldown_s or BREAKER_COOLDOWN_S
         # Observability for the fallback path: a transfer failure silently
         # converting into a second full prefill is a 2× cost bug that MUST
         # be visible in metrics (r3 review finding).
         self.transfers = 0
         self.transfer_failures = 0
+        self.transfer_failures_by_kind: Dict[str, int] = {}
+        self.pull_retries = 0
+        self.pull_fallbacks = 0  # pulls that gave up (the real 2× path)
+        self.breaker_opens = 0
         self.blocks_pulled = 0
         self.bytes_pulled = 0
         # Serialized KV payload bytes by wire dtype (the kv_wire_bytes_total
@@ -338,11 +505,18 @@ class DecodeHandler:
         # within LINK_BW_TTL_S age out so a departed prefill worker stops
         # being republished (and can't resurrect scheduler-purged pairs).
         self._link_bw: Dict[int, Tuple[float, float]] = {}
+        # src prefill worker id → CircuitBreaker over pulls from it.
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        # Retry/breaker history for post-mortems. Single writer: every
+        # record happens on the handler's event loop (DYN005 owner
+        # "disagg").
+        self.flight = FlightRecorder("disagg", capacity=512)
         self.metrics = DisaggMetrics()
         self.metrics.watch_links(
             self.link_bandwidth,
             str(worker_id) if worker_id is not None else "local",
         )
+        self.metrics.watch_breakers(lambda: dict(self._breakers))
 
     def link_bandwidth(self) -> Dict[int, float]:
         """src prefill worker id → EWMA observed transfer bandwidth, B/s
@@ -368,104 +542,273 @@ class DecodeHandler:
     def register_metrics(self, server: Any) -> None:
         """Expose this handler's transfer families on a SystemStatusServer."""
         server.register_metrics(self.metrics.render)
+        server.register_flight(self.flight.name, self.flight.snapshot)
+
+    # -- circuit breaker ----------------------------------------------------
+
+    def _breaker_for(self, src: int) -> CircuitBreaker:
+        breaker = self._breakers.get(src)
+        if breaker is None:
+            def on_transition(old: str, new: str, _src=src) -> None:
+                self.flight.record(
+                    "breaker", src=_src, frm=old, to=new,
+                )
+                self.metrics.breaker_transitions.inc(src=str(_src), to=new)
+                if new == CircuitBreaker.OPEN:
+                    self.breaker_opens += 1
+                    note_activity("breaker_opens")
+
+            breaker = CircuitBreaker(
+                self._breaker_open_after, self._breaker_cooldown_s,
+                on_transition=on_transition,
+            )
+            self._breakers[src] = breaker
+        return breaker
+
+    def open_breaker_srcs(self) -> List[int]:
+        """src prefill worker ids whose breaker is inside its open window —
+        published in load reports (LoadSnapshot.link_faults) so the
+        router's LinkCostModel prices the (src, this worker) pair out of
+        disagg placement until the half-open probe window. Non-int keys
+        (a bootstrap that omitted worker_id breakers under None) are not
+        publishable as link pairs and are excluded — the router could
+        neither normalize nor match them."""
+        return sorted(
+            src for src, b in self._breakers.items()
+            if isinstance(src, int) and b.advertised()
+        )
+
+    def _first_missing(self, hashes: List[int]) -> Optional[int]:
+        """Index of the first block NOT resident in the pool, or None when
+        the whole chain is already installed. Recomputed before every
+        attempt: blocks committed by a failed attempt stay committed, so a
+        retry resumes from the last imported anchor instead of re-pulling
+        (anchor-resume — the wire only ever carries the missing tail)."""
+        pool = self._engine.pool
+        for i, h in enumerate(hashes):
+            if not pool.contains(h):
+                return i
+        return None
+
+    def _attempt_timeout(self, context: Optional[Context]) -> Optional[float]:
+        """Per-attempt wall budget: the configured timeout, shrunk to the
+        request's remaining Context deadline when it carries one."""
+        remaining = context.time_remaining() if context is not None else None
+        if remaining is None:
+            return self.pull_timeout_s
+        return min(self.pull_timeout_s, remaining)
+
+    async def _pull_once(
+        self,
+        want: List[int],
+        anchor: Optional[int],
+        src: Optional[int],
+        acct: Dict[str, int],
+    ) -> None:
+        """One pull attempt over the missing tail. Chunked: each reply is a
+        bounded slice, imported as it lands — device scatters and the
+        decode loop's ticks interleave with the next chunk's network read
+        instead of waiting for one monolithic payload. Wire bytes are
+        accounted at RECEIPT (a chunk that lands but fails to import still
+        crossed the network — the accounting the anchor-resume tests
+        assert), blocks at successful import. Progress accumulates into
+        ``acct`` IN PLACE (not a return value): a raising attempt's
+        partial imports/bytes must survive into the pull's totals, and
+        ``self.bytes_pulled`` deltas can't be used — concurrent pulls
+        would attribute each other's bytes to their own link."""
+        if self._kv_client is None:
+            self._kv_client = await self._kv_client_factory()
+        async for reply in self._kv_client.direct(
+            {
+                "op": "export",
+                "block_hashes": want,
+                # Schema v2 negotiation: ship pool-native (int8 stays
+                # int8 on the wire); v1 exporters ignore this and reply
+                # dense.
+                "wire": {
+                    "version": WIRE_VERSION,
+                    "accept": list(ACCEPT_WIRE_DTYPES),
+                },
+            }, src
+        ):
+            found = reply.get("found") or []
+            wire = unpack_reply(reply)
+            if not found or wire is None:
+                break
+            chunk_bytes = reply_wire_nbytes(reply)
+            acct["bytes"] += chunk_bytes
+            self.bytes_pulled += chunk_bytes
+            self.wire_bytes_by_dtype[wire.dtype] = (
+                self.wire_bytes_by_dtype.get(wire.dtype, 0) + chunk_bytes
+            )
+            self.metrics.bytes_pulled.inc(chunk_bytes)
+            self.metrics.kv_wire_bytes.inc(chunk_bytes, dtype=wire.dtype)
+            # Chaos seams: the wire dying with this chunk received but not
+            # imported, and the import (device scatter) itself failing.
+            fault_point(fault_names.DISAGG_PULL_CHUNK, src=src)
+            fault_point(fault_names.DISAGG_KV_IMPORT, src=src)
+            n = await self._engine.import_blocks_wire_async(
+                found, wire, anchor_parent=anchor
+            )
+            acct["blocks"] += n
+            self.blocks_pulled += n
+            self.metrics.blocks_pulled.inc(n)
+            if n < len(found):
+                # Pool dry mid-chunk: anchoring later chunks on an
+                # uninstalled hash would commit children whose parent
+                # never committed (pool invariant) and every further
+                # chunk would transfer + scatter into a full pool.
+                logger.warning(
+                    "KV pool dry after importing %d/%d blocks of a "
+                    "chunk; stopping the pull early", n, len(found),
+                )
+                break
+            anchor = found[-1]
+            if reply.get("done", True):
+                break
 
     async def _pull_blocks(
-        self, dp: DisaggregatedParams, trace_id: Optional[str] = None
+        self,
+        dp: DisaggregatedParams,
+        context: Optional[Context] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         info = dp.kv_transfer or {}
         hashes = list(info.get("block_hashes") or [])
         if not hashes or self._kv_client_factory is None:
             return 0
         # Skip blocks already resident (earlier transfer or shared prefix).
-        missing_from = 0
-        pool = self._engine.pool
-        for i, h in enumerate(hashes):
-            if not pool.contains(h):
-                missing_from = i
-                break
-        else:
+        if self._first_missing(hashes) is None:
             return 0
-        want = hashes[missing_from:]
-        if self._kv_client is None:
-            self._kv_client = await self._kv_client_factory()
+        src = dp.worker_id
+        breaker = self._breaker_for(src)
+        if not breaker.allow():
+            # Fail fast: the (src → me) link is open-circuit. No wire time
+            # is spent; either re-prefill locally or hand the stream back
+            # for migration to a worker with a working link.
+            self.flight.record("pull_rejected", src=src, state=breaker.state)
+            self.pull_fallbacks += 1
+            if not self.fallback_local_prefill:
+                raise DisaggTransferError(
+                    f"pull breaker for prefill worker {src} is "
+                    f"{breaker.state}; local prefill fallback disabled"
+                )
+            return 0
         self.transfers += 1
         self.metrics.transfers.inc()
         t0 = time.monotonic()
         if not self.transfer_first_start:
             self.transfer_first_start = t0
-        imported = 0
-        pulled_bytes = 0
-        # The block every chunk chains from: the last resident block before
-        # the missing run, then the tail of each imported chunk.
-        anchor = hashes[missing_from - 1] if missing_from > 0 else None
-        try:
-            # Chunked pull: each reply is a bounded slice, imported as it
-            # lands — device scatters and the decode loop's ticks interleave
-            # with the next chunk's network read instead of waiting for one
-            # monolithic payload.
-            async for reply in self._kv_client.direct(
-                {
-                    "op": "export",
-                    "block_hashes": want,
-                    # Schema v2 negotiation: ship pool-native (int8 stays
-                    # int8 on the wire); v1 exporters ignore this and reply
-                    # dense.
-                    "wire": {
-                        "version": WIRE_VERSION,
-                        "accept": list(ACCEPT_WIRE_DTYPES),
-                    },
-                }, dp.worker_id
-            ):
-                found = reply.get("found") or []
-                wire = unpack_reply(reply)
-                if not found or wire is None:
-                    break
-                n = await self._engine.import_blocks_wire_async(
-                    found, wire, anchor_parent=anchor
+        self.flight.record("pull_start", src=src, blocks=len(hashes))
+        # Per-PULL progress, mutated inside _pull_once so a raising
+        # attempt's partial imports survive, and isolated from concurrent
+        # pulls (which share self.bytes_pulled).
+        acct = {"blocks": 0, "bytes": 0}
+        last_error: Optional[BaseException] = None
+        attempt = 0
+        while True:
+            attempt += 1
+            missing_from = self._first_missing(hashes)
+            if missing_from is None:
+                break  # everything landed
+            want = hashes[missing_from:]
+            # The block the next chunk chains from: the last resident
+            # block before the missing run (imports from the FAILED
+            # attempt included — that is the resume point).
+            anchor = hashes[missing_from - 1] if missing_from > 0 else None
+            timeout = self._attempt_timeout(context)
+            try:
+                if timeout is not None and timeout <= 0:
+                    raise asyncio.TimeoutError(
+                        "request deadline exhausted before the pull"
+                    )
+                await asyncio.wait_for(
+                    self._pull_once(want, anchor, src, acct), timeout
                 )
-                imported += n
-                self.blocks_pulled += n
-                chunk_bytes = reply_wire_nbytes(reply)
-                pulled_bytes += chunk_bytes
-                self.bytes_pulled += chunk_bytes
-                self.wire_bytes_by_dtype[wire.dtype] = (
-                    self.wire_bytes_by_dtype.get(wire.dtype, 0) + chunk_bytes
+                breaker.record_success()
+                break
+            except asyncio.CancelledError:
+                # Cancellation resolves nothing about the link: if this
+                # attempt was the half-open probe, hand the breaker back
+                # to OPEN (a wedged HALF_OPEN admits no further probes).
+                breaker.abort_probe()
+                raise
+            except Exception as exc:
+                kind = classify_failure(exc)
+                last_error = exc
+                self.transfer_failures += 1
+                self.transfer_failures_by_kind[kind] = (
+                    self.transfer_failures_by_kind.get(kind, 0) + 1
                 )
-                self.metrics.blocks_pulled.inc(n)
-                self.metrics.bytes_pulled.inc(chunk_bytes)
-                self.metrics.kv_wire_bytes.inc(chunk_bytes, dtype=wire.dtype)
-                if n < len(found):
-                    # Pool dry mid-chunk: anchoring later chunks on an
-                    # uninstalled hash would commit children whose parent
-                    # never committed (pool invariant) and every further
-                    # chunk would transfer + scatter into a full pool.
-                    logger.warning(
-                        "KV pool dry after importing %d/%d blocks of a "
-                        "chunk; stopping the pull early", n, len(found),
+                self.metrics.transfer_failures.inc(error_kind=kind)
+                breaker.record_failure()
+                self.flight.record(
+                    "pull_error", src=src, attempt=attempt,
+                    error_kind=kind, error=f"{type(exc).__name__}: {exc}",
+                )
+                remaining = (
+                    context.time_remaining() if context is not None else None
+                )
+                if (
+                    attempt >= self.pull_attempts
+                    or not breaker.allow()
+                    or (remaining is not None and remaining <= 0)
+                ):
+                    logger.exception(
+                        "KV pull from prefill worker %s failed terminally "
+                        "(%s, attempt %d/%d) after %d blocks",
+                        src, kind, attempt, self.pull_attempts,
+                        acct["blocks"],
                     )
                     break
-                anchor = found[-1]
-                if reply.get("done", True):
-                    break
-        except Exception:
-            self.transfer_failures += 1
-            self.metrics.transfer_failures.inc()
-            logger.exception(
-                "KV pull from prefill worker %s failed after %d blocks; "
-                "decoding with local prefill (fallback #%d — a recurring "
-                "fallback means every request pays prefill TWICE)",
-                dp.worker_id, imported, self.transfer_failures,
-            )
+                self.pull_retries += 1
+                self.metrics.pull_retries.inc()
+                note_activity("pull_retries")
+                delay = min(
+                    self.backoff_base_s * 2 ** (attempt - 1),
+                    PULL_BACKOFF_CAP_S,
+                )
+                if remaining is not None:
+                    delay = min(delay, remaining)
+                logger.warning(
+                    "KV pull from prefill worker %s failed (%s, attempt "
+                    "%d/%d); resuming from anchor after %d imported blocks "
+                    "in %.3fs",
+                    src, kind, attempt, self.pull_attempts,
+                    acct["blocks"], delay,
+                )
+                if delay > 0:
+                    await asyncio.sleep(delay)
         now = time.monotonic()
         self.transfer_seconds += now - t0
         self.transfer_last_end = now
         # Per-(src, dst) bandwidth: this pull's achieved rate feeds the
         # EWMA the router's link-cost model consumes via load reports.
-        self._observe_link(dp.worker_id, pulled_bytes, now - t0)
+        self._observe_link(src, acct["bytes"], now - t0)
         # Exemplar: a transfer-latency spike on a dashboard resolves to the
         # trace (and thus the /debug/requests timeline) that caused it.
         self.metrics.transfer_duration.observe(now - t0, trace_id=trace_id)
-        return imported
+        self.flight.record(
+            "pull_done", src=src, blocks=acct["blocks"],
+            bytes=acct["bytes"], attempts=attempt,
+            ok=last_error is None or self._first_missing(hashes) is None,
+        )
+        if last_error is not None and self._first_missing(hashes) is not None:
+            # Terminal failure: the chain is still incomplete.
+            self.pull_fallbacks += 1
+            if not self.fallback_local_prefill:
+                raise DisaggTransferError(
+                    f"KV pull from prefill worker {src} failed after "
+                    f"{attempt} attempt(s): {last_error!r}; local prefill "
+                    "fallback disabled"
+                ) from last_error
+            logger.warning(
+                "decoding with local prefill after failed pull from "
+                "worker %s (fallback #%d — a recurring fallback means "
+                "every request pays prefill TWICE)",
+                src, self.pull_fallbacks,
+            )
+        return acct["blocks"]
 
     async def generate(
         self, request: Any, context: Context
@@ -479,6 +822,7 @@ class DecodeHandler:
             t0 = time.monotonic()
             pulled = await self._pull_blocks(
                 req.disaggregated_params,
+                context=context,
                 trace_id=lifecycle.trace_id_of(context),
             )
             lifecycle.record(

@@ -47,7 +47,9 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.sampling import compute_logprobs, sample_tokens
 from dynamo_tpu.parallel.mesh import AxisNames
 from dynamo_tpu.parallel.sharding import ShardingRules, param_shardings, shard_params
+from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.runtime.device_observe import (
     FlightRecorder,
     HbmLedger,
@@ -1014,6 +1016,11 @@ class JaxEngine:
         want_procs = any(self._uses_procs[s.slot] for s in active)
         had_inflight = bool(self._inflight)
         t0 = time.monotonic()
+        # Chaos seam, deliberately AFTER the sync payloads were built (the
+        # dirty sets are already cleared): recovery must resync every slot
+        # from the mirrors (_abort_inflight), and the position-keyed RNG
+        # must regenerate identical tokens on the retried burst.
+        fault_point(fault_names.ENGINE_TICK_DISPATCH)
         handles = await self._device(
             self._dispatch_on_device, nb_bucket, want_logprobs, want_procs,
             state_sync, table_sync,
@@ -1083,6 +1090,9 @@ class JaxEngine:
         reaped while this one was in flight) is dropped — its slot was
         deactivated and its device pos reset by the dirty-slot sync, and
         its speculative KV writes landed in reserved lookahead blocks."""
+        # Chaos seam: a reap failure drops an in-flight burst whose device
+        # carry ran ahead of emission — the abort path must roll back.
+        fault_point(fault_names.ENGINE_TICK_REAP)
         rec = self._inflight.popleft()
         toks, logps, topv, topi = await self._device(
             self.runner.decode_read, rec.handles

@@ -24,6 +24,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.runtime import fault_names
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -78,6 +80,9 @@ class HostTier:
         return block_hash in self._blocks
 
     def put(self, block_hash: int, *arrays: np.ndarray) -> None:
+        # Chaos seam: offload callers (kvbm/manager.py burst loop) log and
+        # drop the burst; the block simply stays un-offloaded.
+        fault_point(fault_names.KVBM_TIER_WRITE, tier=self.name)
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
             return
@@ -107,6 +112,10 @@ class HostTier:
                 self.next_tier.put(h, *blk)  # G2 → G3 spill
 
     def get(self, block_hash: int) -> Optional[Block]:
+        # Chaos seam: onboard callers (engines/tpu/admission.py) catch and
+        # fall back to local prefill — an injected read failure costs
+        # recompute, never correctness.
+        fault_point(fault_names.KVBM_TIER_READ, tier=self.name)
         if block_hash in self._blocks:
             self._blocks.move_to_end(block_hash)
             if self._staging is not None:
@@ -172,6 +181,7 @@ class DiskTier:
         return block_hash in self._lru
 
     def put(self, block_hash: int, *arrays: np.ndarray) -> None:
+        fault_point(fault_names.KVBM_TIER_WRITE, tier=self.name)
         if block_hash in self._lru:
             self._lru.move_to_end(block_hash)
             return
@@ -199,6 +209,7 @@ class DiskTier:
                 pass
 
     def get(self, block_hash: int) -> Optional[Block]:
+        fault_point(fault_names.KVBM_TIER_READ, tier=self.name)
         path = self._lru.get(block_hash)
         if path is None:
             self.stats.misses += 1

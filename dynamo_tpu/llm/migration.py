@@ -7,20 +7,45 @@ PreprocessedRequest with the tokens accumulated so far appended to the
 prompt, and send it to another worker, up to ``migration_limit`` times. The
 new worker's prefix cache makes the re-prefill cheap; the client stream never
 observes the failure.
+
+Two budgets bound a pathological loop:
+
+  * ``migration_limit`` — attempt count (the reference's knob);
+  * ``max_reprefill_tokens`` — TOTAL prompt+carried tokens re-prefilled
+    across all migrations of one stream. Attempt counts alone don't bound
+    cost: a 100k-token prompt that dies late in generation re-prefills
+    prompt+tail every time, so three "cheap" retries can cost more compute
+    than the request itself. The token cap prices the retries in the unit
+    that matters.
+
+Covered failure classes (``MIGRATABLE``): transport disconnects, vanished
+instances, connection errors, deadline/timeout aborts (the disagg pull
+timeout surfaces here), and mid-disagg transfer failures
+(``DisaggTransferError`` from a strict decode handler — it subclasses
+ConnectionError, imported here only to label the metric reason).
+
+Every migration emits a flight-recorder event and a
+``dynamo_tpu_migration_*`` metric (runtime/metric_names.py ALL_MIGRATION).
 """
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, List, Union
+import asyncio
+import os
+from typing import Any, AsyncIterator, List, Optional, Union
 
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     FinishReason,
     PreprocessedRequest,
 )
+from dynamo_tpu.runtime import metric_names as mn
 from dynamo_tpu.runtime.component import NoInstancesError
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.device_observe import FlightRecorder
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.faults import note_activity
+from dynamo_tpu.runtime.metrics_core import MetricsRegistry
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -33,12 +58,91 @@ except ImportError:  # pragma: no cover
         pass
 
 
-MIGRATABLE = (StreamDisconnectedError, NoInstancesError, ConnectionError)
+try:
+    from dynamo_tpu.disagg.errors import DisaggTransferError
+except ImportError:  # pragma: no cover
+
+    class DisaggTransferError(ConnectionError):  # type: ignore[no-redef]
+        pass
+
+
+# NOTE: asyncio.TimeoutError is a DISTINCT class from builtin TimeoutError
+# until Python 3.11 — both must be listed. DisaggTransferError subclasses
+# ConnectionError (already migratable); it is named for reason labeling.
+MIGRATABLE = (
+    StreamDisconnectedError,
+    NoInstancesError,
+    ConnectionError,
+    TimeoutError,
+    asyncio.TimeoutError,
+)
+
+# Default total re-prefill budget across all migrations of one stream.
+DEFAULT_REPREFILL_CAP = int(
+    os.environ.get("DYN_TPU_MIGRATION_REPREFILL_CAP", 131072)
+)
+
+
+def _failure_reason(exc: BaseException) -> str:
+    """Metric label for what killed the stream."""
+    if isinstance(exc, DisaggTransferError):
+        return "disagg"
+    if isinstance(exc, NoInstancesError):
+        return "no_instances"
+    if isinstance(exc, (TimeoutError, asyncio.TimeoutError)):
+        return "timeout"
+    if isinstance(exc, ConnectionError):
+        return "connection"
+    return "other"
+
+
+class MigrationMetrics:
+    """Canonical migration families (runtime/metric_names.py
+    ALL_MIGRATION). ``render`` plugs into SystemStatusServer's
+    ``register_metrics`` seam."""
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        self.migrations = self.registry.counter(
+            mn.MIGRATION_MIGRATIONS_TOTAL,
+            "Live streams re-dispatched to another worker, by failure "
+            "reason",
+            ["reason"],
+        )
+        self.exhausted = self.registry.counter(
+            mn.MIGRATION_EXHAUSTED_TOTAL,
+            "Streams failed after exhausting the migration budget "
+            "(attempt limit or re-prefill token cap) — each one reached "
+            "the client as an error",
+        )
+        self.reprefill_tokens = self.registry.counter(
+            mn.MIGRATION_REPREFILL_TOKENS_TOTAL,
+            "Prompt+carried tokens re-prefilled by migrations (the cost "
+            "the re-prefill cap bounds)",
+        )
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
 
 
 class Migration:
-    def __init__(self, migration_limit: int = 3) -> None:
+    def __init__(
+        self,
+        migration_limit: int = 3,
+        *,
+        max_reprefill_tokens: Optional[int] = DEFAULT_REPREFILL_CAP,
+    ) -> None:
         self.migration_limit = migration_limit
+        # None = uncapped (attempt count only — the pre-cap behavior).
+        self.max_reprefill_tokens = max_reprefill_tokens
+        self.metrics = MigrationMetrics()
+        # Migration history for post-mortems (DYN005 owner "migration";
+        # single writer: the frontend pipeline's event loop).
+        self.flight = FlightRecorder("migration", capacity=256)
+
+    def register_metrics(self, server: Any) -> None:
+        server.register_metrics(self.metrics.render)
+        server.register_flight(self.flight.name, self.flight.snapshot)
 
     async def generate(
         self, request: Any, context: Context, next: AsyncEngine
@@ -49,6 +153,7 @@ class Migration:
             req = PreprocessedRequest.from_dict(dict(request))
         generated: List[int] = []
         migrations = 0
+        reprefilled = 0  # total tokens re-prefilled by migrations so far
 
         while True:
             finished = False
@@ -65,19 +170,56 @@ class Migration:
                 if finished or context.stopped:
                     return
                 migrations += 1
-                if migrations > self.migration_limit:
+                # The rebuilt request re-prefills its whole prompt plus
+                # everything generated so far — charge it BEFORE
+                # dispatching so the cap is a true bound, not a postmortem.
+                next_reprefill = len(req.token_ids) + len(generated)
+                reason = _failure_reason(exc)
+                if migrations > self.migration_limit or (
+                    self.max_reprefill_tokens is not None
+                    and reprefilled + next_reprefill
+                    > self.max_reprefill_tokens
+                ):
+                    over_cap = migrations <= self.migration_limit
+                    self.metrics.exhausted.inc()
+                    self.flight.record(
+                        "exhausted", request=req.request_id, reason=reason,
+                        migrations=migrations - 1,
+                        reprefilled=reprefilled,
+                        over=("reprefill_cap" if over_cap else "attempts"),
+                    )
                     logger.error(
-                        "request %s exceeded migration limit (%d): %r",
-                        req.request_id, self.migration_limit, exc,
+                        "request %s exceeded migration budget (%s; %d "
+                        "attempts, %d tokens re-prefilled): %r",
+                        req.request_id,
+                        "re-prefill cap" if over_cap else "attempt limit",
+                        migrations - 1, reprefilled, exc,
+                    )
+                    detail = (
+                        f"{reprefilled} re-prefilled tokens (cap "
+                        f"{self.max_reprefill_tokens})"
+                        if over_cap
+                        else f"{self.migration_limit} migrations"
                     )
                     yield BackendOutput(
-                        error=f"stream failed after {self.migration_limit} migrations: {exc}",
+                        error=f"stream failed after {detail}: {exc}",
                         finish_reason=FinishReason.ERROR,
                     )
                     return
+                reprefilled += next_reprefill
+                self.metrics.migrations.inc(reason=reason)
+                self.metrics.reprefill_tokens.inc(next_reprefill)
+                note_activity("migrations")
+                self.flight.record(
+                    "migrate", request=req.request_id, attempt=migrations,
+                    reason=reason, carried=len(generated),
+                    reprefill=next_reprefill,
+                )
                 logger.warning(
-                    "migrating request %s (attempt %d/%d) after %r with %d tokens carried",
-                    req.request_id, migrations, self.migration_limit, exc, len(generated),
+                    "migrating request %s (attempt %d/%d, %s) after %r "
+                    "with %d tokens carried",
+                    req.request_id, migrations, self.migration_limit,
+                    reason, exc, len(generated),
                 )
                 req = _carry_tokens(req, generated)
                 generated = []  # now embedded in the prompt; don't carry twice

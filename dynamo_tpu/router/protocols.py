@@ -78,6 +78,10 @@ class LoadSnapshot:
     # measured at THIS worker's transfer path (disagg/handlers.py). Feeds
     # the router's per-(src, dst) link-cost model.
     link_bandwidth: Optional[Dict[int, float]] = None
+    # src prefill worker ids whose pull circuit breaker at THIS worker is
+    # open — the router prices those (src, this worker) pairs out of
+    # disagg decode placement until the breaker's half-open window.
+    link_faults: Optional[List[int]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -90,6 +94,8 @@ class LoadSnapshot:
             snap.link_bandwidth = {
                 int(k): float(v) for k, v in snap.link_bandwidth.items()
             }
+        if snap.link_faults:
+            snap.link_faults = [int(s) for s in snap.link_faults]
         return snap
 
     @property

@@ -167,6 +167,7 @@ class LoadPublisher:
         total_blocks: int = 0,
         interval_s: float = 1.0,
         link_bandwidth_fn: Optional[Callable[[], dict]] = None,
+        link_faults_fn: Optional[Callable[[], list]] = None,
     ) -> None:
         self._plane = event_plane
         self._topic = load_topic(namespace, component)
@@ -180,6 +181,9 @@ class LoadPublisher:
         # on every load report. Late-bindable (the handler is usually
         # constructed after the publisher).
         self.link_bandwidth_fn = link_bandwidth_fn
+        # () -> [src worker ids with an open pull breaker] — prices those
+        # pairs out of disagg placement router-side.
+        self.link_faults_fn = link_faults_fn
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
 
@@ -188,6 +192,7 @@ class LoadPublisher:
         total = self._total_blocks or s.get("total_blocks", 0)
         free = s.get("free_blocks", 0)
         link_bw = self.link_bandwidth_fn() if self.link_bandwidth_fn else None
+        link_faults = self.link_faults_fn() if self.link_faults_fn else None
         return LoadSnapshot(
             worker_id=self.worker_id,
             dp_rank=self.dp_rank,
@@ -197,6 +202,7 @@ class LoadPublisher:
             total_blocks=total,
             generated_tokens=s.get("generated_tokens", 0),
             link_bandwidth=link_bw or None,
+            link_faults=list(link_faults) if link_faults else None,
         )
 
     async def publish_once(self) -> None:

@@ -78,10 +78,20 @@ class LinkCostModel:
     slow link has actually been SEEN (a never-used pair shouldn't lose to
     speculation)."""
 
+    # Effective bandwidth quoted for a pair whose breaker is open: low
+    # enough that any realistic transfer estimate dwarfs re-prefill cost
+    # (pricing the pair out), finite so the logit math stays well-formed
+    # and an all-faulted candidate set still produces a choice.
+    FAULT_BANDWIDTH = 1e3
+
     def __init__(self, default_bandwidth: float = 1e9, alpha: float = 0.25) -> None:
         self.default_bandwidth = float(default_bandwidth)
         self.alpha = float(alpha)
         self._bw: Dict[Tuple[int, WorkerKey], float] = {}
+        # (src, dst) pairs whose decode-side pull breaker is open — set
+        # from LoadSnapshot.link_faults, cleared when a report stops
+        # carrying the src (breaker closed or half-open window reached).
+        self._faults: set = set()
 
     def observe(self, src: int, dst: WorkerKey, bytes_per_s: float) -> None:
         if bytes_per_s <= 0:
@@ -98,7 +108,29 @@ class LinkCostModel:
         self._bw[(src, dst)] = float(bytes_per_s)
 
     def bandwidth(self, src: int, dst: WorkerKey) -> float:
+        if (src, dst) in self._faults:
+            return self.FAULT_BANDWIDTH
         return self._bw.get((src, dst), self.default_bandwidth)
+
+    def set_fault(self, src: int, dst: WorkerKey, faulted: bool) -> None:
+        """Mark/clear a (src, dst) pair as breaker-open. A faulted pair
+        quotes FAULT_BANDWIDTH regardless of its measured EWMA — the EWMA
+        survives, so a healed pair resumes at its last honest estimate."""
+        if faulted:
+            self._faults.add((src, dst))
+        else:
+            self._faults.discard((src, dst))
+
+    def sync_faults(self, dst: WorkerKey, srcs) -> None:
+        """Replace dst's faulted-src set with what its load report carries
+        (the report is authoritative for its own breakers)."""
+        want = {int(s) for s in srcs}
+        self._faults = {
+            (s, d) for (s, d) in self._faults if d != dst
+        } | {(s, dst) for s in want}
+
+    def faulted(self, src: int, dst: WorkerKey) -> bool:
+        return (src, dst) in self._faults
 
     def seconds(self, src: int, dst: WorkerKey, nbytes: int) -> float:
         """Estimated wire seconds to move ``nbytes`` src → dst. Pulling
@@ -114,6 +146,10 @@ class LinkCostModel:
     def drop_worker(self, worker: WorkerKey) -> None:
         self._bw = {
             k: v for k, v in self._bw.items()
+            if k[1] != worker and k[0] != worker[0]
+        }
+        self._faults = {
+            k for k in self._faults
             if k[1] != worker and k[0] != worker[0]
         }
 
@@ -170,6 +206,12 @@ class KvScheduler:
         # at ITS end of each link) into the shared link-cost model.
         for src, bw in (snapshot.link_bandwidth or {}).items():
             self.link_costs.observe(int(src), snapshot.worker, float(bw))
+        # Breaker advertisement: the report's link_faults is authoritative
+        # for this worker's pairs — carried srcs are priced out, absent
+        # srcs (breaker closed / probe window) are restored.
+        self.link_costs.sync_faults(
+            snapshot.worker, snapshot.link_faults or ()
+        )
 
     def report_generation(self, worker: WorkerKey) -> int:
         state = self._workers.get(worker)

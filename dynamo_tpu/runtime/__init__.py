@@ -16,8 +16,10 @@ from dynamo_tpu.runtime.engine import AsyncEngine, as_engine, collect
 from dynamo_tpu.runtime.metric_names import (
     ALL_DISAGG,
     ALL_ENGINE,
+    ALL_FAULTS,
     ALL_FRONTEND,
     ALL_KVBM,
+    ALL_MIGRATION,
     ALL_ROUTER,
     ALL_RUNTIME,
 )
@@ -33,8 +35,10 @@ from dynamo_tpu.runtime.tasks import TaskTracker
 __all__ = [
     "ALL_DISAGG",
     "ALL_ENGINE",
+    "ALL_FAULTS",
     "ALL_FRONTEND",
     "ALL_KVBM",
+    "ALL_MIGRATION",
     "ALL_ROUTER",
     "ALL_RUNTIME",
     "AsyncEngine",

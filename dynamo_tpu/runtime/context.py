@@ -109,6 +109,20 @@ class Context:
     # -- cancellation -----------------------------------------------------
 
     @property
+    def deadline(self) -> Optional[float]:
+        """Absolute monotonic deadline, or None. Operators that spend
+        bounded sub-budgets (the disagg pull timeout) derive them from
+        ``time_remaining`` so a slow transfer can never eat the whole
+        request budget."""
+        return self._deadline
+
+    def time_remaining(self) -> Optional[float]:
+        """Seconds left until the deadline (None = unbounded, 0 = past)."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    @property
     def stopped(self) -> bool:
         if self._deadline is not None and time.monotonic() > self._deadline:
             self.stop_generating(reason="deadline")

@@ -30,8 +30,10 @@ from dynamo_tpu.runtime.component import (
     Namespace,
     ServedEndpoint,
 )
+from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.discovery import DiscoveryBackend, Lease, MemoryDiscovery
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.events import EventPlane, MemoryEventPlane
 from dynamo_tpu.runtime.tasks import TaskTracker
@@ -203,10 +205,15 @@ class DistributedRuntime:
             except asyncio.TimeoutError:
                 pass
             try:
+                # Chaos seam: a failed renewal is absorbed by the TTL
+                # budget (interval = ttl/3, so two consecutive misses
+                # still beat expiry); sustained failure expires the lease
+                # and watchers observe the instance Delete.
+                fault_point(fault_names.DISCOVERY_LEASE_RENEW)
                 await keep_alive(self._lease)
             except asyncio.CancelledError:
                 raise
-            except Exception as exc:  # pragma: no cover - backend hiccups
+            except Exception as exc:
                 logger.warning("lease keep-alive failed: %r", exc)
 
     async def _serve(

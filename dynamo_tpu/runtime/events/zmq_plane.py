@@ -21,7 +21,9 @@ import msgpack
 import zmq
 import zmq.asyncio
 
+from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.events import Subscription, _SUB_CLOSED, topic_matches
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
@@ -279,6 +281,10 @@ class ZmqEventPlane:
         self._subs: List[Tuple[str, Subscription, zmq.Socket, asyncio.Task]] = []
 
     async def publish(self, topic: str, payload: Any) -> None:
+        # Chaos seam: publishers (KV events, load reports) must tolerate a
+        # lost publish — their pumps log and continue; the router heals via
+        # event-id gap detection + snapshot resync.
+        fault_point(fault_names.NET_ZMQ_SEND, topic=topic)
         await self._pub.send_multipart(
             [topic.encode(), msgpack.packb(payload, use_bin_type=True)]
         )
@@ -296,6 +302,7 @@ class ZmqEventPlane:
             try:
                 while True:
                     raw_topic, raw_payload = await sock.recv_multipart()
+                    fault_point(fault_names.NET_ZMQ_RECV, topic=topic)
                     t = raw_topic.decode()
                     if topic_matches(topic, t):
                         queue.put_nowait((t, msgpack.unpackb(

@@ -1,0 +1,62 @@
+"""Canonical fault-point names: ONE place declares every injection seam.
+
+Mirror of runtime/metric_names.py for the fault plane (runtime/faults.py):
+``fault_point(...)`` call sites import these constants, and the dynlint
+DYN006 pass closes the loop in both directions — a point name used at a
+seam must be declared here, and a declared point must have at least one
+seam (a dead point is chaos coverage that silently stopped existing).
+
+This module is loaded BY FILE PATH by the linter (no package import) and
+must stay dependency-free — constants and tuples only.
+
+Naming scheme: ``<subsystem>.<operation>[.<phase>]``.
+"""
+
+from __future__ import annotations
+
+# -- request/event planes (runtime/network/tcp.py, runtime/events/zmq_plane.py)
+NET_TCP_SEND = "net.tcp.send"
+NET_TCP_RECV = "net.tcp.recv"
+NET_ZMQ_SEND = "net.zmq.send"
+NET_ZMQ_RECV = "net.zmq.recv"
+
+# -- disaggregated KV transfer (disagg/handlers.py) ---------------------------
+# One pull-side hit per received chunk, BEFORE the chunk is imported: an
+# injection here models the wire dying mid-transfer with N chunks landed.
+DISAGG_PULL_CHUNK = "disagg.pull.chunk"
+# Export side: one hit per chunk gathered by the KvTransferHandler.
+DISAGG_KV_EXPORT = "disagg.kv.export"
+# Import side: one hit per chunk handed to the engine's scatter path.
+DISAGG_KV_IMPORT = "disagg.kv.import"
+
+# -- engine decode tick (engines/tpu/engine.py) -------------------------------
+# Dispatch: after the sync payloads are built, before the device call — the
+# adversarial spot, because the dirty-slot sets were already cleared and
+# recovery must resync them from the mirrors (_abort_inflight).
+ENGINE_TICK_DISPATCH = "engine.tick.dispatch"
+# Reap: before the oldest in-flight burst's readback.
+ENGINE_TICK_REAP = "engine.tick.reap"
+
+# -- discovery / health (runtime/distributed.py, runtime/health.py) -----------
+DISCOVERY_LEASE_RENEW = "discovery.lease.renew"
+HEALTH_CANARY = "health.canary"
+
+# -- KVBM storage tiers (kvbm/tiers.py) ---------------------------------------
+KVBM_TIER_READ = "kvbm.tier.read"
+KVBM_TIER_WRITE = "kvbm.tier.write"
+
+ALL_FAULT_POINTS = (
+    NET_TCP_SEND,
+    NET_TCP_RECV,
+    NET_ZMQ_SEND,
+    NET_ZMQ_RECV,
+    DISAGG_PULL_CHUNK,
+    DISAGG_KV_EXPORT,
+    DISAGG_KV_IMPORT,
+    ENGINE_TICK_DISPATCH,
+    ENGINE_TICK_REAP,
+    DISCOVERY_LEASE_RENEW,
+    HEALTH_CANARY,
+    KVBM_TIER_READ,
+    KVBM_TIER_WRITE,
+)

@@ -21,6 +21,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Set
 
+from dynamo_tpu.runtime import fault_names
+from dynamo_tpu.runtime.device_observe import FlightRecorder
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -74,6 +77,11 @@ class CanaryHealthChecker:
         self._activity: Dict[int, float] = {}  # last successful traffic
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
+        # Transition history: which worker went dark when, and what error
+        # tripped it — the question the on-call asks first. Single writer:
+        # every record happens on the checker's loop (DYN005 owner
+        # "health").
+        self.flight = FlightRecorder("health", capacity=256)
         client.set_instance_filter(self.is_healthy)
 
     # -- routing integration ----------------------------------------------
@@ -103,6 +111,9 @@ class CanaryHealthChecker:
         if instance is None:
             return h.healthy
         try:
+            # Chaos seam: an injected canary failure must trip the same
+            # exclusion/re-admission machinery a hung worker does.
+            fault_point(fault_names.HEALTH_CANARY, instance=instance_id)
             stream = self.client.direct(self._payload_for(instance), instance_id)
 
             async def _consume():
@@ -115,12 +126,20 @@ class CanaryHealthChecker:
             h.last_error = f"{type(exc).__name__}: {exc}"
             if h.consecutive_failures >= self.failure_threshold and h.healthy:
                 h.healthy = False
+                self.flight.record(
+                    "unhealthy", instance=instance_id,
+                    failures=h.consecutive_failures, error=h.last_error,
+                )
                 logger.warning(
                     "instance %#x marked UNHEALTHY after %d canary failures (%s)",
                     instance_id, h.consecutive_failures, h.last_error,
                 )
             return h.healthy
         if not h.healthy:
+            self.flight.record(
+                "recovered", instance=instance_id,
+                after_failures=h.consecutive_failures,
+            )
             logger.info("instance %#x recovered (canary ok)", instance_id)
         h.consecutive_failures = 0
         h.healthy = True

@@ -102,9 +102,19 @@ RUNTIME_PROFILER_CAPTURES_TOTAL = f"{RUNTIME_PREFIX}_profiler_captures_total"
 # -- disagg (disagg/handlers.py DecodeHandler) -------------------------------
 DISAGG_PREFIX = "dynamo_tpu_disagg"
 DISAGG_TRANSFERS_TOTAL = f"{DISAGG_PREFIX}_transfers_total"
-# Each failure IS the 2×-cost path: the decode worker falls back to a
-# second full local prefill of the same prompt.
+# One failed pull ATTEMPT, labeled by classified error_kind (timeout vs
+# connection vs decode vs other). Attempts retry with anchor-resume; a
+# pull that exhausts retries is the 2×-cost path (second full prefill).
 DISAGG_TRANSFER_FAILURES_TOTAL = f"{DISAGG_PREFIX}_transfer_failures_total"
+# Retried pull attempts (attempt 2+). Anchor-resume means a retry only
+# moves the not-yet-imported tail, so retries are cheap but visible.
+DISAGG_PULL_RETRIES_TOTAL = f"{DISAGG_PREFIX}_pull_retries_total"
+# Per-src circuit breaker: state transitions {src, to∈open|half_open|
+# closed} and a 0/1 open gauge per src. An open breaker is advertised in
+# load reports and prices the (src, this worker) pair out of disagg
+# placement (router/scheduler.py LinkCostModel.set_fault).
+DISAGG_BREAKER_TRANSITIONS_TOTAL = f"{DISAGG_PREFIX}_breaker_transitions_total"
+DISAGG_BREAKER_OPEN = f"{DISAGG_PREFIX}_breaker_open"
 DISAGG_BLOCKS_PULLED_TOTAL = f"{DISAGG_PREFIX}_blocks_pulled_total"
 DISAGG_BYTES_PULLED_TOTAL = f"{DISAGG_PREFIX}_bytes_pulled_total"
 # Serialized KV payload bytes by wire dtype (disagg/wire.py schema v2):
@@ -114,6 +124,23 @@ DISAGG_TRANSFER_DURATION = f"{DISAGG_PREFIX}_transfer_duration_seconds"
 # Observed per-(src, dst) transfer bandwidth EWMA, measured at the decode
 # worker's pull path and folded into the router via load reports.
 DISAGG_LINK_BANDWIDTH = f"{DISAGG_PREFIX}_link_bandwidth_bytes_per_s"
+
+# -- migration (llm/migration.py Migration) ----------------------------------
+MIGRATION_PREFIX = "dynamo_tpu_migration"
+# Re-dispatches of a live stream to another worker, by failure reason
+# (connection | timeout | no_instances | disagg | other).
+MIGRATION_MIGRATIONS_TOTAL = f"{MIGRATION_PREFIX}_migrations_total"
+# Streams that failed AFTER exhausting the migration budget (attempt
+# limit or the re-prefill token cap) — each one reached the client.
+MIGRATION_EXHAUSTED_TOTAL = f"{MIGRATION_PREFIX}_exhausted_total"
+# Prompt+carried tokens re-prefilled by migrations (the cost the
+# re-prefill cap bounds).
+MIGRATION_REPREFILL_TOKENS_TOTAL = f"{MIGRATION_PREFIX}_reprefill_tokens_total"
+
+# -- fault plane (runtime/faults.py FaultPlane) ------------------------------
+FAULTS_PREFIX = "dynamo_tpu_faults"
+FAULTS_ARMED = f"{FAULTS_PREFIX}_armed"
+FAULTS_INJECTIONS_TOTAL = f"{FAULTS_PREFIX}_injections_total"
 
 ALL_FRONTEND = (
     FRONTEND_REQUESTS_TOTAL,
@@ -150,11 +177,25 @@ ALL_KVBM = (
 ALL_DISAGG = (
     DISAGG_TRANSFERS_TOTAL,
     DISAGG_TRANSFER_FAILURES_TOTAL,
+    DISAGG_PULL_RETRIES_TOTAL,
+    DISAGG_BREAKER_TRANSITIONS_TOTAL,
+    DISAGG_BREAKER_OPEN,
     DISAGG_BLOCKS_PULLED_TOTAL,
     DISAGG_BYTES_PULLED_TOTAL,
     DISAGG_KV_WIRE_BYTES_TOTAL,
     DISAGG_TRANSFER_DURATION,
     DISAGG_LINK_BANDWIDTH,
+)
+
+ALL_MIGRATION = (
+    MIGRATION_MIGRATIONS_TOTAL,
+    MIGRATION_EXHAUSTED_TOTAL,
+    MIGRATION_REPREFILL_TOKENS_TOTAL,
+)
+
+ALL_FAULTS = (
+    FAULTS_ARMED,
+    FAULTS_INJECTIONS_TOTAL,
 )
 
 ALL_RUNTIME = (

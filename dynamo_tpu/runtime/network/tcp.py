@@ -24,8 +24,10 @@ import itertools
 import os
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
+from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
 from dynamo_tpu.runtime.tasks import TaskTracker, reap_task
 from dynamo_tpu.utils.logging import get_logger
@@ -221,6 +223,11 @@ class _ClientConn:
                     frame = await fr.recv()
                     if frame is None:
                         break
+                    # Chaos seam: a fault here models the connection dying
+                    # mid-stream — the finally below fans out "disconnect"
+                    # to every stream, surfacing StreamDisconnectedError
+                    # (the migration trigger) exactly like a real RST.
+                    fault_point(fault_names.NET_TCP_RECV)
                     header, payload = frame
                     q = self._queues.get(header.get("stream"))
                     if q is None:
@@ -252,6 +259,7 @@ class _ClientConn:
 
     async def send(self, header: Any, payload: Any = None) -> None:
         assert self._fw is not None
+        fault_point(fault_names.NET_TCP_SEND)
         await self._fw.send(header, payload)
 
     async def close(self) -> None:

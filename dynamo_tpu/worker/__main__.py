@@ -356,9 +356,10 @@ async def main() -> None:
             engine, kv_client_factory=_kv_client, worker_id=instance_id
         )
         # Load reports carry this worker's measured per-src pull bandwidth
-        # so the router's link-cost model prices decode placement with the
-        # links as they actually perform.
+        # (link-cost placement) and its open pull breakers (a FAILING link
+        # is priced out of placement, not just a slow one).
         load_pub.link_bandwidth_fn = handler.link_bandwidth
+        load_pub.link_faults_fn = handler.open_breaker_srcs
         served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
         await register_llm(runtime, card, endpoint, instance_id)
     load_pub.start()

@@ -1,0 +1,8 @@
+"""DYN006 bad fixture registry: one live point, one dead point, one
+constant used at a seam but pinned in no ALL_* tuple."""
+
+LIVE = "fix.live"
+DEAD = "fix.dead"
+UNPINNED = "fix.unpinned"
+
+ALL_FAULT_POINTS = (LIVE, DEAD)

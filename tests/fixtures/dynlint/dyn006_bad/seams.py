@@ -1,0 +1,16 @@
+"""DYN006 bad fixture seams: a literal name, an unpinned constant, and a
+computed expression — each a closure break; DEAD has no seam at all."""
+
+import names as fn
+from names import UNPINNED
+
+
+def point_name():
+    return "fix." + "computed"
+
+
+def serve(fault_point):
+    fault_point(fn.LIVE)  # fine: declared + pinned
+    fault_point("fix.literal")  # literal → finding
+    fault_point(UNPINNED)  # constant not in ALL_FAULT_POINTS → finding
+    fault_point(point_name())  # dynamic → finding

@@ -1,0 +1,6 @@
+"""DYN006 good fixture registry: every point declared AND installed."""
+
+LIVE = "fix.live"
+OTHER = "fix.other"
+
+ALL_FAULT_POINTS = (LIVE, OTHER)

@@ -1,0 +1,10 @@
+"""DYN006 good fixture seams: every call resolves through the registry,
+both import styles."""
+
+import names as fn
+from names import OTHER
+
+
+def serve(fault_point):
+    fault_point(fn.LIVE, detail=1)
+    fault_point(OTHER)

@@ -1,5 +1,5 @@
 """dynlint: the tier-1 gate for the repo's static invariants, plus golden
-fixtures for each of the five passes (known-bad trees must trip, known-good
+fixtures for each of the six passes (known-bad trees must trip, known-good
 trees must pass), suppression semantics, and baseline round-trips.
 
 Everything here is AST-only — no jax import, no device, and the full
@@ -20,6 +20,7 @@ from dynamo_tpu.analysis import (
 )
 from dynamo_tpu.analysis.cli import DEFAULT_BASELINE
 from dynamo_tpu.analysis.config import (
+    FaultPointConfig,
     HotPathConfig,
     MetricClosureConfig,
     RingWriterConfig,
@@ -226,6 +227,54 @@ def test_dyn005_bad_fixture():
 
 def test_dyn005_good_fixture():
     assert lint_fixture("dyn005_good", _rings_cfg(), rules=["DYN005"]) == []
+
+
+# -- DYN006 fault-point closure ----------------------------------------------
+
+
+def _faults_cfg():
+    return LintConfig(
+        hot_path=None,
+        metrics=None,
+        rings=None,
+        faults=FaultPointConfig(fault_names_rel="names.py"),
+    )
+
+
+def test_dyn006_bad_fixture():
+    findings = lint_fixture("dyn006_bad", _faults_cfg(), rules=["DYN006"])
+    msgs = [f.message for f in findings]
+    assert any("literal fault-point name 'fix.literal'" in m for m in msgs)
+    assert any("dead fault point 'fix.dead'" in m for m in msgs)
+    assert any("UNPINNED" in m and "no ALL_* tuple" in m for m in msgs)
+    assert any("does not statically resolve" in m for m in msgs)
+    assert all(f.rule == "DYN006" for f in findings)
+    assert len(findings) == 4
+
+
+def test_dyn006_good_fixture():
+    assert lint_fixture("dyn006_good", _faults_cfg(), rules=["DYN006"]) == []
+
+
+def test_dyn006_unloadable_names_module_is_a_finding(tmp_path):
+    (tmp_path / "runtime").mkdir()
+    (tmp_path / "runtime" / "fault_names.py").write_text(
+        "import not_a_real_dependency\n"
+    )
+    findings = run_lint(str(tmp_path), rule_ids=["DYN006"])
+    assert len(findings) == 1
+    assert "failed to load" in findings[0].message
+
+
+def test_dyn006_package_registry_matches_plane_validation():
+    """Both enforcement halves read the SAME tuple: the runtime half
+    (FaultRule rejecting undeclared points at arm time) and the static
+    half (DYN006) cannot drift apart."""
+    from dynamo_tpu.runtime.fault_names import ALL_FAULT_POINTS
+    from dynamo_tpu.runtime.faults import FaultRule
+
+    for point in ALL_FAULT_POINTS:
+        FaultRule(point=point)  # every declared point arms
 
 
 # -- suppressions ------------------------------------------------------------

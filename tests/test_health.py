@@ -88,6 +88,67 @@ class TestCanary:
         out = await collect(client.direct({"x": 1}, 0))
         assert out[0]["finish_reason"] == "stop"
 
+    async def test_injected_canary_faults_evict_then_first_pass_readmits(self):
+        """faultline seam: injected canary failures must drive the same
+        exclusion machinery as a hung worker — the sick instance stops
+        receiving routed traffic, transitions land on the health flight
+        ring, and the FIRST passing canary re-admits it."""
+        from dynamo_tpu.runtime import fault_names as fn
+        from dynamo_tpu.runtime import faults
+
+        drt = DistributedRuntime.detached()
+        ep = drt.namespace("health5").component("backend").endpoint("generate")
+        calls = []
+
+        def worker(wid):
+            async def handler(request, context):
+                calls.append(wid)
+                yield {"token_ids": [wid], "finish_reason": "stop"}
+            return handler
+
+        await ep.serve_endpoint(worker(0), instance_id=0)
+        await ep.serve_endpoint(worker(1), instance_id=1)
+        client = await ep.client()
+        await client.wait_for_instances()
+        checker = CanaryHealthChecker(
+            client, interval_s=0.1, timeout_s=0.5, failure_threshold=2,
+            canary_wait_time_s=0.0,
+        )
+        # Canary probes alternate instance 0, 1 per sweep; fail ONLY
+        # instance 1's probes (hits 2 and 4), twice → threshold.
+        plan = faults.FaultPlan(rules=(
+            faults.FaultRule(
+                point=fn.HEALTH_CANARY, at=(2, 4), kind="timeout",
+            ),
+        ))
+        try:
+            with faults.armed(plan):
+                await checker.check_all()  # strike 1 on instance 1
+                await checker.check_all()  # strike 2 → unhealthy
+            assert checker.unhealthy_ids() == {1}
+            events = checker.flight.snapshot()
+            assert [e["kind"] for e in events] == ["unhealthy"]
+            assert events[0]["instance"] == 1 and events[0]["failures"] == 2
+            # Routed traffic excludes the sick worker entirely.
+            calls.clear()
+            for _ in range(6):
+                out = await collect(client.generate({"x": 1}, Context()))
+                assert out[0]["token_ids"] == [0]
+            assert set(calls) == {0}
+            # Plan disarmed (fault cleared): the FIRST passing canary
+            # re-admits the worker and records the recovery.
+            await checker.check_all()
+            assert checker.unhealthy_ids() == set()
+            kinds = [e["kind"] for e in checker.flight.snapshot()]
+            assert kinds == ["unhealthy", "recovered"]
+            calls.clear()
+            for _ in range(8):
+                await collect(client.generate({"x": 1}, Context()))
+            assert set(calls) == {0, 1}  # back in rotation
+        finally:
+            faults.disarm()
+            await drt.shutdown(grace_period=1)
+
     async def test_worker_metadata_payload_preferred(self):
         drt = DistributedRuntime.detached()
         ep = drt.namespace("health3").component("backend").endpoint("generate")

@@ -212,9 +212,60 @@ class TestLinkCost:
     def test_remove_worker_drops_link_pairs(self):
         sched = self._sched()
         sched.link_costs.set_bandwidth(7, (2, 0), 1e6)
+        sched.link_costs.set_fault(7, (2, 0), True)
         sched.add_worker((2, 0))
         sched.remove_worker((2, 0))
         assert not sched.link_costs.pairs()
+        assert not sched.link_costs.faulted(7, (2, 0))
+
+    def test_open_breaker_prices_pair_out_of_placement(self):
+        """A load report advertising an open pull breaker (link_faults)
+        flips the placement decision away from the higher-overlap worker —
+        a FAILING link is demoted harder than a slow one — and the next
+        report without the advertisement restores it (the measured EWMA
+        survives the fault window)."""
+        from dynamo_tpu.router import TransferContext
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        overlaps = OverlapScores(scores={(1, 0): 10})
+        transfer = TransferContext(src=7, bytes_per_block=self.BLOCK_BYTES)
+        sched = self._sched()
+        # Both links fast and measured: overlap decides.
+        sched.update_load(LoadSnapshot(
+            worker_id=1, total_blocks=100, link_bandwidth={7: 1e9},
+        ))
+        sched.update_load(LoadSnapshot(
+            worker_id=2, total_blocks=100, link_bandwidth={7: 1e9},
+        ))
+        assert sched.select_worker(
+            12, overlaps, [(1, 0), (2, 0)], transfer=transfer
+        ) == (1, 0)
+        # Worker 1's breaker for src 7 opens: the pair quotes
+        # FAULT_BANDWIDTH and the decision flips to the no-overlap worker.
+        sched.update_load(LoadSnapshot(
+            worker_id=1, total_blocks=100, link_bandwidth={7: 1e9},
+            link_faults=[7],
+        ))
+        assert sched.link_costs.faulted(7, (1, 0))
+        assert sched.select_worker(
+            12, overlaps, [(1, 0), (2, 0)], transfer=transfer
+        ) == (2, 0)
+        # Breaker closes (report stops carrying the src): the pair
+        # resumes at its surviving EWMA and overlap wins again.
+        sched.update_load(LoadSnapshot(
+            worker_id=1, total_blocks=100, link_bandwidth={7: 1e9},
+        ))
+        assert not sched.link_costs.faulted(7, (1, 0))
+        assert sched.select_worker(
+            12, overlaps, [(1, 0), (2, 0)], transfer=transfer
+        ) == (1, 0)
+
+    def test_stringified_link_faults_normalized(self):
+        sched = self._sched()
+        sched.update_load(LoadSnapshot.from_dict({
+            "worker_id": 2, "total_blocks": 100, "link_faults": ["7"],
+        }))
+        assert sched.link_costs.faulted(7, (2, 0))
 
     def test_transfer_context_extracted_from_request(self):
         """The picker derives (src, block_bytes) from the disagg bootstrap
