@@ -133,7 +133,9 @@ def _fault_plane_record(activity_before: dict) -> dict:
     a chaos-free bench run must show zero retries, breaker opens, and
     migrations — a nonzero here is a self-healing path activating
     SPURIOUSLY, which is itself a perf regression (every retry is wire
-    time, every migration a re-prefill)."""
+    time, every migration a re-prefill). The overload-plane counters
+    (sheds / brownout transitions / deadline expiries) extend the same
+    contract: under-capacity legs must record ZERO for all three."""
     from dynamo_tpu.runtime import faults
 
     snap = faults.plane_snapshot()
@@ -147,6 +149,9 @@ def _fault_plane_record(activity_before: dict) -> dict:
         "pull_retries": delta.get("pull_retries", 0),
         "breaker_opens": delta.get("breaker_opens", 0),
         "migrations": delta.get("migrations", 0),
+        "sheds": delta.get("sheds", 0),
+        "brownout_transitions": delta.get("brownout_transitions", 0),
+        "deadline_expired": delta.get("deadline_expired", 0),
     }
 
 
@@ -687,6 +692,186 @@ async def run_disagg_leg(isl: int = 512, osl: int = 64, concurrency: int = 4,
         await rt.shutdown()
 
 
+async def run_overload_leg(isl: int = 64, osl: int = 32,
+                           concurrency: int = 16):
+    """Overload-armor measurement (ISSUE 8): an OPEN-LOOP arrival ramp
+    through the admission controller, calibrated against the engine's own
+    measured capacity. Two sub-legs share one engine + controller config:
+
+      * ``under_capacity`` (0.5× the calibrated request rate) — the
+        zero-spurious-activation contract: NO sheds, NO brownout
+        transitions, NO deadline expiries (same contract as the PR 7
+        chaos-free fault-plane check);
+      * ``over_capacity`` (4× the calibrated rate) — the armor working:
+        queue depth stays bounded at the configured cap, the excess sheds
+        with typed reasons, deadline-carrying requests that expire
+        mid-queue are shed before prefill, and every ADMITTED stream
+        completes with its full output.
+    """
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.overload import (
+        OverloadConfig,
+        OverloadController,
+        OverloadShedError,
+    )
+
+    fault_activity0 = _fault_activity_start()
+    cfg = qwen2_500m_config()
+    engine = JaxEngine(
+        JaxEngineArgs(
+            config=cfg,
+            block_size=64,
+            num_kv_blocks=2048,
+            max_num_seqs=concurrency,
+            max_model_len=isl + osl + 64,
+            prefill_chunk=64,
+            prefill_batch=concurrency,
+            decode_steps=16,
+        )
+    )
+    rng = np.random.default_rng(11)
+
+    def mk_req(i):
+        return PreprocessedRequest(
+            token_ids=rng.integers(10, cfg.vocab_size - 10, size=isl).tolist(),
+            request_id=f"ovl-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+
+    async def run_one(req, ctrl=None, deadline_s=None):
+        """→ ('ok', tokens) | ('shed', reason) | ('error', kind)."""
+        ctx = Context(
+            deadline=(time.monotonic() + deadline_s) if deadline_s else None
+        )
+        ticket = None
+        try:
+            if ctrl is not None:
+                ticket = await ctrl.admit(ctx, request_id=req.request_id)
+            n = 0
+            async for out in engine.generate(req, ctx):
+                if out.error:
+                    return ("error", out.error_kind or "other")
+                n += len(out.token_ids or [])
+            return ("ok", n)
+        except OverloadShedError as exc:
+            return ("shed", exc.reason)
+        finally:
+            if ticket is not None:
+                ctrl.release(ticket)
+
+    try:
+        # Calibrate: one closed-loop wave → sustainable requests/sec
+        # (also triggers every compile so the ramp measures serving, not
+        # XLA).
+        await asyncio.gather(*(run_one(mk_req(10_000 + i)) for i in range(concurrency)))
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *(run_one(mk_req(20_000 + i)) for i in range(2 * concurrency))
+        )
+        calib_wall = time.monotonic() - t0
+        assert all(r[0] == "ok" for r in results)
+        capacity_rps = (2 * concurrency) / calib_wall
+
+        async def ramp(rate_rps, n_requests, ctrl, deadline_s):
+            tasks = []
+            interval = 1.0 / rate_rps
+            for i in range(n_requests):
+                tasks.append(
+                    asyncio.ensure_future(
+                        run_one(mk_req(30_000 + i), ctrl, deadline_s)
+                    )
+                )
+                await asyncio.sleep(interval)
+            outcomes = await asyncio.gather(*tasks)
+            counts: dict = {}
+            for kind, detail in outcomes:
+                key = kind if kind == "ok" else f"{kind}:{detail}"
+                counts[key] = counts.get(key, 0) + 1
+            return counts, outcomes
+
+        def mk_ctrl():
+            return OverloadController(
+                OverloadConfig(
+                    max_concurrency=concurrency,
+                    max_queue_depth=2 * concurrency,
+                    max_queue_delay_s=20.0,
+                )
+            )
+
+        # Deadlines scale with MEASURED service time so the leg is about
+        # the armor, not the host's speed: generous under capacity
+        # (nothing may expire), ~2 service waves over capacity (the
+        # queue tail expires, the admitted head completes).
+        service_s = calib_wall
+        # Under capacity: nothing may activate. No deadlines — the
+        # zero-spurious contract must hold on any hardware.
+        under_ctrl = mk_ctrl()
+        under_counts, under_out = await ramp(
+            capacity_rps * 0.5, 2 * concurrency, under_ctrl,
+            deadline_s=None,
+        )
+        under_snap = under_ctrl.snapshot()
+
+        # 4× capacity: bounded queue, typed sheds, admitted work intact.
+        over_ctrl = mk_ctrl()
+        over_counts, over_out = await ramp(
+            capacity_rps * 4.0, 6 * concurrency, over_ctrl,
+            deadline_s=max(15.0, 2.5 * service_s),
+        )
+        over_snap = over_ctrl.snapshot()
+        ok_complete = all(
+            detail == osl for kind, detail in over_out if kind == "ok"
+        )
+        return {
+            "model": cfg.name,
+            "isl": isl,
+            "osl": osl,
+            "concurrency": concurrency,
+            "calibrated_capacity_rps": round(capacity_rps, 2),
+            "under_capacity": {
+                "offered_x": 0.5,
+                "outcomes": under_counts,
+                "sheds": sum(under_snap["sheds"].values()),
+                "brownout_transitions": sum(
+                    under_snap["transitions"].values()
+                ),
+                "deadline_expired": under_snap["deadline_expired"],
+                "peak_queue_depth": under_snap["peak_queue_depth"],
+                # THE contract: zero activations off the saturation path.
+                "zero_spurious": (
+                    not under_snap["sheds"] and not under_snap["transitions"]
+                ),
+            },
+            "over_capacity": {
+                "offered_x": 4.0,
+                "outcomes": over_counts,
+                "sheds_by_reason": over_snap["sheds"],
+                "deadline_expired": over_snap["deadline_expired"],
+                "peak_queue_depth": over_snap["peak_queue_depth"],
+                "queue_bounded": (
+                    over_snap["peak_queue_depth"] <= 2 * concurrency
+                ),
+                "admitted_streams_complete": ok_complete,
+                "engine_deadline_sheds": engine.deadline_sheds,
+            },
+            "fault_plane": _fault_plane_record(fault_activity0),
+        }
+    finally:
+        await engine.stop()
+        import gc
+
+        del engine
+        gc.collect()
+
+
 async def run_bench():
     model_name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
     quant = os.environ.get("BENCH_QUANT") or None
@@ -829,6 +1014,21 @@ async def run_bench():
                 out["disagg"]["onhost"] = {
                     "error": f"{type(exc).__name__}: {exc}"
                 }
+
+    if (
+        os.environ.get("BENCH_OVERLOAD", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        # Overload-armor leg (ISSUE 8): open-loop ramp past calibrated
+        # capacity; the under-capacity sub-leg carries the
+        # zero-spurious-activation contract (no sheds, no brownout
+        # transitions), the 4x sub-leg proves bounded queueing + typed
+        # shedding. Never kills the headline.
+        try:
+            out["overload"] = await run_overload_leg()
+        except Exception as exc:
+            out["overload"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(out))
 
 

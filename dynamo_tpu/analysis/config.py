@@ -137,6 +137,9 @@ class RingWriterConfig:
             "disagg": ("disagg/handlers.py", "DecodeHandler"),
             "migration": ("llm/migration.py", "Migration"),
             "health": ("runtime/health.py", "CanaryHealthChecker"),
+            # Overload plane (PR 8): admission sheds + brownout state
+            # transitions; single writer: the frontend's event loop.
+            "overload": ("runtime/overload.py", "OverloadController"),
         }
     )
 

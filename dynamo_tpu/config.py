@@ -116,4 +116,30 @@ ROUTER_OVERLAP_WEIGHT = env_float(
 MIGRATION_LIMIT = env_int(
     "DYN_TPU_MIGRATION_LIMIT", 3, "Max per-request migrations to new workers on stream death"
 )
+# -- overload armor (runtime/overload.py; docs/design_docs/overload_control.md)
+OVERLOAD_MAX_CONCURRENCY = env_int(
+    "DYN_TPU_OVERLOAD_MAX_CONCURRENCY", 256,
+    "Frontend streams generating concurrently; excess queues (EDF)",
+)
+OVERLOAD_MAX_QUEUE = env_int(
+    "DYN_TPU_OVERLOAD_MAX_QUEUE", 1024,
+    "Bounded admission queue depth; beyond it requests shed 429",
+)
+OVERLOAD_MAX_QUEUE_DELAY_S = env_float(
+    "DYN_TPU_OVERLOAD_MAX_QUEUE_DELAY_S", 30.0,
+    "Shed when predicted queue delay exceeds this (429 + Retry-After)",
+)
+OVERLOAD_DEFAULT_DEADLINE_S = env_float(
+    "DYN_TPU_OVERLOAD_DEFAULT_DEADLINE_S", 0.0,
+    "Deadline stamped on requests that carry none (0 = unbounded)",
+)
+OVERLOAD_ITL_SLA_MS = env_float(
+    "DYN_TPU_OVERLOAD_ITL_SLA_MS", 0.0,
+    "p50 ITL SLA driving healthy->brownout->shed (0 = brownout disabled; "
+    "admission caps still enforce)",
+)
+OVERLOAD_BROWNOUT_MAX_TOKENS = env_int(
+    "DYN_TPU_OVERLOAD_BROWNOUT_MAX_TOKENS", 256,
+    "max_tokens clamp applied while browned out",
+)
 GRACE_PERIOD = env_float("DYN_TPU_GRACE_PERIOD", 30.0, "Graceful-shutdown drain seconds")

@@ -60,12 +60,24 @@ class Admitter:
         limit = min(len(free_slots), e.args.prefill_batch)
         while e._waiting and len(batch) < limit:
             seq = e._waiting[0]
+            # Expired/cancelled work sheds AT DEQUEUE, before any pool or
+            # prefill spend — deadline expiries surface as a typed error
+            # (overload armor: an already-dead request must never reach
+            # the device).
             if seq.context.stopped:
                 e._waiting.popleft()
-                seq.queue.put_nowait(
-                    BackendOutput(finish_reason=FinishReason.CANCELLED)
-                )
+                e._shed_expired(seq)
                 continue
+            # Backpressure: past the high watermark, admitting trades one
+            # queued request for a preemption storm against the running
+            # ones — hold the queue and let decode drain instead. Only
+            # with live occupants: an idle engine always admits (the
+            # watermark measures contention, not fit).
+            if (
+                e.pool.usage >= e.args.admit_kv_high_watermark
+                and any(s is not None for s in e._slots)
+            ):
+                break
             has_mm = bool((seq.request.extra or {}).get("mm_embeds"))
             if has_mm and batch:
                 break  # multimodal rows carry their own embed arrays: solo batch

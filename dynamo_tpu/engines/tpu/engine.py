@@ -49,7 +49,7 @@ from dynamo_tpu.parallel.mesh import AxisNames
 from dynamo_tpu.parallel.sharding import ShardingRules, param_shardings, shard_params
 from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.faults import fault_point
+from dynamo_tpu.runtime.faults import fault_point, note_activity
 from dynamo_tpu.runtime.device_observe import (
     FlightRecorder,
     HbmLedger,
@@ -74,6 +74,13 @@ class JaxEngineArgs:
     max_model_len: int = 1024
     prefill_chunk: int = 512  # max tokens per prefill step (chunked prefill)
     watermark: float = 0.01
+    # Admission backpressure (overload armor): refuse NEW admissions while
+    # pool occupancy (active blocks only — reusable cached blocks don't
+    # count) is at or past this fraction and sequences are running.
+    # Admitting into a near-full pool doesn't serve the request faster —
+    # it trades one queued request for a preemption storm that re-prefills
+    # running ones. 1.0 disables (the pre-PR 8 behavior).
+    admit_kv_high_watermark: float = 0.95
     # Batched prefill: pack up to this many admissions into ONE device
     # dispatch ([Bp, C] with per-row start/len). B=1 prefill wastes the MXU
     # (measured: B=8 costs only ~1.4× B=1 on a v5e) and serial admission was
@@ -268,6 +275,14 @@ class JaxEngine:
         self._sleep_event = asyncio.Event()
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Brownout lever (runtime/overload.py): under pressure speculative
+        # decode burns decode ticks on rejected proposals — the overload
+        # controller suspends it without tearing down the engine.
+        self._spec_suspended = False
+        # Requests shed at admission dequeue because their deadline had
+        # already expired (observability; bench reads the activity
+        # counter, tests read this).
+        self.deadline_sheds = 0
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -434,8 +449,9 @@ class JaxEngine:
         # pipelined fallback would advance 2 bursts between proposal
         # points — halving the lookup cadence and skipping right over
         # n-gram matches. Spec is itself a latency path; it keeps the
-        # synchronous tick it was tuned for.
-        if self.args.spec_mode:
+        # synchronous tick it was tuned for. A brownout-suspended spec
+        # engine decodes on the fused path and gets its pipelining back.
+        if self.args.spec_mode and not self._spec_suspended:
             return 1
         return max(1, int(getattr(self.args, "pipeline_depth", 1) or 1))
 
@@ -517,6 +533,12 @@ class JaxEngine:
             "pipeline_depth": self._pipeline_depth(),
             "inflight_bursts": len(self._inflight),
             "preemptions": self.preemptions,
+            # Overload plane inputs: queue depth + the admission refusal
+            # watermark ride load reports router-ward (LoadSnapshot), and
+            # deadline sheds are the proof expired work never prefilled.
+            "queue_depth": len(self._waiting),
+            "kv_high_watermark": self.args.admit_kv_high_watermark,
+            "deadline_sheds": self.deadline_sheds,
         }
         if self.args.spec_mode:
             out["spec_proposed"] = self.spec_proposed
@@ -703,7 +725,7 @@ class JaxEngine:
                     or bool(self._inflight)
                 )
                 if active:
-                    if self.args.spec_mode == "ngram":
+                    if self.args.spec_mode == "ngram" and not self._spec_suspended:
                         if not await self._spec_tick():
                             await self._decode_tick()
                     else:
@@ -842,6 +864,44 @@ class JaxEngine:
         seq.block_ids = []
         seq.block_hashes = []
         self._waiting.appendleft(seq)
+
+    def set_spec_suspended(self, suspended: bool) -> None:
+        """Brownout lever: park/restore speculative decode without
+        touching the engine args (runtime/overload.py wires this to the
+        healthy↔brownout transitions). Takes effect at the next tick;
+        in-flight proposals finish normally."""
+        suspended = bool(suspended)
+        if suspended == self._spec_suspended:
+            return
+        self._spec_suspended = suspended
+        if self.args.spec_mode:
+            self.flight.record("spec_suspend", on=suspended)
+        self._wake.set()
+
+    def _shed_expired(self, seq: _Sequence) -> None:
+        """Finish a waiting sequence that stopped BEFORE admission. A
+        deadline expiry is a typed, client-visible error (the request's
+        budget is gone — admitting it would burn prefill on work nobody
+        is waiting for); a plain cancellation stays a quiet CANCELLED."""
+        if seq.context.stop_reason == "deadline":
+            self.deadline_sheds += 1
+            note_activity("deadline_expired")
+            self.flight.record(
+                "deadline_shed", request_id=seq.request.request_id,
+                queued_s=round(seq.context.elapsed, 3),
+            )
+            seq.queue.put_nowait(
+                BackendOutput(
+                    error="deadline expired before admission "
+                    "(shed at dequeue, no prefill spent)",
+                    error_kind="timeout",
+                    finish_reason=FinishReason.ERROR,
+                )
+            )
+        else:
+            seq.queue.put_nowait(
+                BackendOutput(finish_reason=FinishReason.CANCELLED)
+            )
 
     async def _sleep_tick(self) -> bool:
         """Handle a pending sleep request / asleep state. Returns True when

@@ -66,9 +66,15 @@ async def main() -> None:
         canary_timeout_s=args.canary_timeout,
     )
     await watcher.start()
+    # Overload armor on by default: bounded EDF admission + (when an ITL
+    # SLA is configured) the brownout state machine. Knobs:
+    # DYN_TPU_OVERLOAD_* (docs/design_docs/overload_control.md).
+    from dynamo_tpu.runtime.overload import OverloadController, config_from_env
+
     service = HttpService(
         manager, host=args.host, port=args.http_port,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
+        overload=OverloadController(config_from_env()),
     )
     port = await service.start()
     print(f"frontend listening on {args.host}:{port}", flush=True)

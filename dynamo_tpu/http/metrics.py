@@ -98,7 +98,10 @@ class RequestTimer:
     exemplar, and first-token/done lifecycle events are stamped onto the
     request's /debug timeline."""
 
-    def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str) -> None:
+    def __init__(
+        self, metrics: FrontendMetrics, model: str, endpoint: str,
+        *, itl_observer=None,
+    ) -> None:
         self._m = metrics
         self._model = model
         self._endpoint = endpoint
@@ -107,6 +110,10 @@ class RequestTimer:
         self._done = False
         self._request_id: Optional[str] = None
         self._trace_id: Optional[str] = None
+        # Optional tap on the same deltas the ITL histogram observes —
+        # the overload controller's brownout machine reads its p50 SLA
+        # signal here (runtime/overload.py observe_itl).
+        self._itl_observer = itl_observer
         self._m.inflight.labels(model, endpoint).inc()
 
     def bind_context(self, context) -> None:
@@ -141,6 +148,8 @@ class RequestTimer:
                 )
         else:
             self._m.itl.labels(self._model).observe(now - self._last_token)
+            if self._itl_observer is not None:
+                self._itl_observer(now - self._last_token)
         self._last_token = now
         self._m.output_tokens.labels(self._model).inc(count)
 

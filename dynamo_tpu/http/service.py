@@ -35,9 +35,50 @@ from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
 from dynamo_tpu.http.model_manager import ModelManager
 from dynamo_tpu.http.worker_monitor import BusyThresholds
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.overload import (
+    AdmissionTicket,
+    OverloadController,
+    OverloadShedError,
+)
 from dynamo_tpu.runtime.tasks import TaskTracker
 
 logger = logging.getLogger(__name__)
+
+
+def _error_kind_of(exc: BaseException) -> Optional[str]:
+    """Structured ``error_kind`` for an exception the pipeline raised —
+    only for failure classes with a meaningful taxonomy label (transfer,
+    transport, deadline); generic programming errors stay unlabeled
+    rather than masquerading as ``decode``."""
+    from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
+    from dynamo_tpu.runtime.component import NoInstancesError
+
+    if isinstance(exc, DisaggTransferError):
+        return "disagg"
+    if isinstance(exc, NoInstancesError):
+        return "no_instances"
+    if isinstance(exc, (ConnectionError, TimeoutError, asyncio.TimeoutError)):
+        return classify_failure(exc)
+    return None
+
+
+def _status_of_kind(kind: Optional[str]) -> int:
+    """HTTP status for a terminal engine error carrying ``error_kind``:
+    an expired budget is the client's 504, an upstream worker/link
+    failure a 502 — neither is the frontend's own 500."""
+    if kind == "timeout":
+        return 504
+    if kind in ("connection", "disagg", "no_instances"):
+        return 502
+    return 500
+
+
+def _err_type_of_kind(kind: Optional[str]) -> str:
+    if kind == "timeout":
+        return "deadline_exceeded"
+    if kind in ("connection", "disagg", "no_instances"):
+        return "upstream_error"
+    return "internal_error"
 
 
 class HttpService:
@@ -52,6 +93,7 @@ class HttpService:
         metrics: Optional[FrontendMetrics] = None,
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
+        overload: Optional[OverloadController] = None,
     ) -> None:
         # TLS termination (ref: service_v2.rs enable_tls + rustls config).
         self._ssl_context = None
@@ -66,6 +108,10 @@ class HttpService:
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
+        # Overload armor (runtime/overload.py): bounded EDF admission +
+        # brownout. None = unguarded (the pre-PR 8 behavior); the frontend
+        # entrypoint constructs one by default.
+        self.overload = overload
         self.tracker = TaskTracker("http")
         # model name → busy thresholds (ref: busy_threshold.rs; checked
         # against the model's WorkerLoadMonitor when one is attached)
@@ -92,6 +138,7 @@ class HttpService:
         app.router.add_post("/v1/responses", self._responses)
         app.router.add_post("/v1/images/generations", self._images)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
+        app.router.add_get("/debug/overload", self._debug_overload)
         app.router.add_get("/openapi.json", self._openapi)
         return app
 
@@ -127,17 +174,50 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def _metrics_route(self, request: web.Request) -> web.Response:
-        if "application/openmetrics-text" in request.headers.get("Accept", ""):
+        openmetrics = "application/openmetrics-text" in request.headers.get(
+            "Accept", ""
+        )
+        if openmetrics:
             # OpenMetrics exposition carries trace-id exemplars on the TTFT
             # and request-duration histograms (see http/metrics.py).
+            body = self.metrics.render(openmetrics=True)
+            if self.overload is not None:
+                # Splice the overload families in BEFORE the # EOF
+                # terminator prometheus_client already appended.
+                extra = self.overload.metrics.render(openmetrics=True)
+                stripped = body.rstrip()
+                if stripped.endswith(b"# EOF"):
+                    stripped = stripped[: -len(b"# EOF")].rstrip()
+                body = stripped + b"\n" + extra.encode() + b"\n# EOF\n"
             return web.Response(
-                body=self.metrics.render(openmetrics=True),
-                content_type="application/openmetrics-text",
+                body=body, content_type="application/openmetrics-text",
             )
-        return web.Response(body=self.metrics.render(), content_type="text/plain")
+        body = self.metrics.render()
+        if self.overload is not None:
+            # The frontend's controller is the one that actually admits
+            # and sheds — its families must be on THIS scrape surface.
+            body = body + self.overload.metrics.render().encode() + b"\n"
+        return web.Response(body=body, content_type="text/plain")
 
     async def _models_route(self, request: web.Request) -> web.Response:
         return web.json_response(model_list(self.models.openai_model_list()))
+
+    async def _debug_overload(self, request: web.Request) -> web.Response:
+        """Overload-plane snapshot + the 'overload' flight ring (the
+        frontend has no system server; this is its /debug/flight slice)."""
+        if self.overload is None:
+            return web.json_response({"enabled": False})
+        try:
+            limit = int(request.query.get("limit", 256))
+        except ValueError:
+            limit = 256
+        return web.json_response(
+            {
+                "enabled": True,
+                **self.overload.snapshot(),
+                "events": self.overload.flight.snapshot(limit=limit),
+            }
+        )
 
     async def _busy_threshold_list(self, request: web.Request) -> web.Response:
         """(ref: busy_threshold.rs GET — list configured thresholds)"""
@@ -248,10 +328,30 @@ class HttpService:
                 OpenAIError(f"model '{model}' not found", status=404,
                             err_type="not_found_error")
             )
-        timer = RequestTimer(self.metrics, model, "responses")
-        ctx = Context(baggage={"model": model})
+        # The Responses API rides the same chat generation pipeline, so it
+        # gets the same overload armor: client deadline, EDF admission,
+        # and the brownout output clamp (a saturating burst must not
+        # tunnel past the plane through this endpoint).
+        deadline, derr = self._parse_deadline(request, body)
+        if derr is not None:
+            return derr
+        timer = RequestTimer(
+            self.metrics, model, "responses",
+            itl_observer=(
+                self.overload.observe_itl if self.overload is not None else None
+            ),
+        )
+        ctx = Context(baggage={"model": model}, deadline=deadline)
         stream = bool(body.get("stream", False))
         rid = gen_id("resp")
+        ticket: Optional[AdmissionTicket] = None
+        if self.overload is not None:
+            self.overload.apply_default_deadline(ctx)
+            try:
+                ticket = await self.overload.admit(ctx)
+            except OverloadShedError as exc:
+                timer.done(exc.status)
+                return _shed_response(exc)
 
         def envelope(status: str, output=None, usage=None) -> Dict[str, Any]:
             resp: Dict[str, Any] = {
@@ -262,12 +362,21 @@ class HttpService:
                 resp["usage"] = usage
             return resp
 
+        ok = False
         try:
+            if self.overload is not None:
+                clamped = self.overload.clamp_max_tokens(
+                    chat_body.get("max_tokens")
+                )
+                if clamped is not None and clamped != chat_body.get("max_tokens"):
+                    chat_body["max_tokens"] = clamped
             with self.tracker.guard():
                 if stream:
-                    return await self._responses_stream(
+                    resp = await self._responses_stream(
                         request, chat_body, entry, ctx, timer, envelope
                     )
+                    ok = True
+                    return resp
                 text_parts: list = []
                 prompt_tokens = 0
                 completion_tokens = 0
@@ -279,14 +388,18 @@ class HttpService:
                         continue
                     out: PostprocessedOutput = item
                     if out.error:
-                        raise OpenAIError(out.error, status=500,
-                                          err_type="internal_error")
+                        ekind = getattr(out, "error_kind", None)
+                        raise OpenAIError(
+                            out.error, status=_status_of_kind(ekind),
+                            err_type=_err_type_of_kind(ekind), kind=ekind,
+                        )
                     if out.text:
                         text_parts.append(out.text)
                     if out.token_ids:
                         completion_tokens += len(out.token_ids)
                         timer.on_token(len(out.token_ids))
                 timer.done(200)
+                ok = True
                 return web.json_response(
                     envelope(
                         "completed",
@@ -317,10 +430,19 @@ class HttpService:
             timer.done(499)
             raise
         except Exception as exc:
+            error_kind = _error_kind_of(exc)
             logger.exception("responses failed")
-            timer.done(500)
-            return _error_response(OpenAIError(str(exc), status=500,
-                                               err_type="internal_error"))
+            status = _status_of_kind(error_kind)
+            timer.done(status)
+            return _error_response(
+                OpenAIError(
+                    str(exc), status=status,
+                    err_type=_err_type_of_kind(error_kind), kind=error_kind,
+                )
+            )
+        finally:
+            if ticket is not None:
+                self.overload.release(ticket, ok=ok)
 
     async def _responses_stream(
         self, request: web.Request, chat_body, entry, ctx: Context,
@@ -366,7 +488,13 @@ class HttpService:
                 if out.error:
                     await send(
                         "error",
-                        {"message": out.error, "code": "internal_error"},
+                        {
+                            "message": out.error,
+                            "code": _err_type_of_kind(
+                                getattr(out, "error_kind", None)
+                            ),
+                            "error_kind": getattr(out, "error_kind", None),
+                        },
                     )
                     # Terminal event so SDK consumers waiting on a final
                     # response.* event resolve instead of hanging.
@@ -610,14 +738,45 @@ class HttpService:
             )
             resp.headers["Retry-After"] = "1"
             return resp
-        timer = RequestTimer(self.metrics, model, endpoint)
+        # Client deadline (overload armor): header wins over the body key;
+        # the budget lands in Context.deadline and rides the request plane
+        # end to end — engine admission sheds it expired, the disagg pull
+        # timeouts shrink to it.
+        deadline, err = self._parse_deadline(request, body)
+        if err is not None:
+            return err
+        timer = RequestTimer(
+            self.metrics, model, endpoint,
+            itl_observer=(
+                self.overload.observe_itl if self.overload is not None else None
+            ),
+        )
         baggage: Dict[str, Any] = {"model": model}
         if traceparent:
             baggage["traceparent"] = traceparent
-        ctx = Context(baggage=baggage)
+        ctx = Context(baggage=baggage, deadline=deadline)
+        ticket: Optional[AdmissionTicket] = None
+        if self.overload is not None:
+            self.overload.apply_default_deadline(ctx)
+            try:
+                ticket = await self.overload.admit(ctx)
+            except OverloadShedError as exc:
+                timer.done(exc.status)
+                return _shed_response(exc)
         from dynamo_tpu.utils.tracing import span
 
+        ok = False
         try:
+            if self.overload is not None:
+                # Brownout output clamp: under pressure nobody gets an
+                # unbounded completion (no-op while healthy). Inside the
+                # try so NOTHING between admit and release can leak the
+                # admission slot.
+                clamped = self.overload.clamp_max_tokens(
+                    body.get("max_tokens")
+                )
+                if clamped is not None and clamped != body.get("max_tokens"):
+                    body["max_tokens"] = clamped
             with self.tracker.guard(), span(
                 f"http.{endpoint}", ctx, model=model, stream=stream
             ):
@@ -626,8 +785,15 @@ class HttpService:
                 # lifecycle timeline the request's trace id.
                 timer.bind_context(ctx)
                 if stream:
-                    return await self._stream_response(request, body, entry, ctx, kind, timer)
-                return await self._unary_response(body, entry, ctx, kind, timer, n)
+                    resp = await self._stream_response(
+                        request, body, entry, ctx, kind, timer
+                    )
+                else:
+                    resp = await self._unary_response(
+                        body, entry, ctx, kind, timer, n
+                    )
+                ok = True
+                return resp
         except OpenAIError as exc:
             timer.done(exc.status)
             return _error_response(exc)
@@ -636,9 +802,47 @@ class HttpService:
             timer.done(499)
             raise
         except Exception as exc:
+            # Typed upstream failures (strict-disagg transfer death, a
+            # worker link dropping, a deadline blown inside the stack)
+            # carry their taxonomy label instead of a bare 500.
+            error_kind = _error_kind_of(exc)
             logger.exception("generation failed")
-            timer.done(500)
-            return _error_response(OpenAIError(str(exc), status=500, err_type="internal_error"))
+            status = _status_of_kind(error_kind)
+            timer.done(status)
+            return _error_response(
+                OpenAIError(
+                    str(exc), status=status,
+                    err_type=_err_type_of_kind(error_kind), kind=error_kind,
+                )
+            )
+        finally:
+            if ticket is not None:
+                self.overload.release(ticket, ok=ok)
+
+    def _parse_deadline(self, request: web.Request, body: Dict[str, Any]):
+        """(absolute monotonic deadline | None, error response | None).
+        ``x-dynamo-deadline-ms`` header wins; the ``deadline_ms`` body key
+        is accepted for clients that can't set headers and is stripped
+        either way so it never reaches preprocessing."""
+        raw = request.headers.get("x-dynamo-deadline-ms")
+        body_raw = body.pop("deadline_ms", None)
+        if raw is None:
+            raw = body_raw
+        if raw is None:
+            return None, None
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            ms = -1.0
+        if ms <= 0 or not ms == ms:  # rejects NaN too
+            return None, _error_response(
+                OpenAIError(
+                    "'deadline_ms' must be a positive number of "
+                    "milliseconds (header x-dynamo-deadline-ms or body "
+                    "key deadline_ms)"
+                )
+            )
+        return time.monotonic() + ms / 1000.0, None
 
     # -- unary -------------------------------------------------------------
 
@@ -662,7 +866,11 @@ class HttpService:
                 continue  # other annotations are streaming-only
             out: PostprocessedOutput = item
             if out.error:
-                raise OpenAIError(out.error, status=500, err_type="internal_error")
+                kind = getattr(out, "error_kind", None)
+                raise OpenAIError(
+                    out.error, status=_status_of_kind(kind),
+                    err_type=_err_type_of_kind(kind), kind=kind,
+                )
             if out.text:
                 text_parts.append(out.text)
             if out.token_ids:
@@ -859,8 +1067,18 @@ class HttpService:
                     continue
                 out: PostprocessedOutput = item
                 if out.error:
-                    await _sse_send(response, {"error": {"message": out.error, "type": "internal_error"}})
-                    status = 500
+                    # Terminal typed SSE error event (headers are long
+                    # sent): error_kind lets an SDK distinguish a
+                    # migration-exhausted link failure from a real bug.
+                    kind = getattr(out, "error_kind", None)
+                    frame: Dict[str, Any] = {
+                        "message": out.error,
+                        "type": _err_type_of_kind(kind),
+                    }
+                    if kind:
+                        frame["error_kind"] = kind
+                    await _sse_send(response, {"error": frame})
+                    status = _status_of_kind(kind)
                     break
                 completion_tokens = out.cumulative_tokens or completion_tokens
                 if out.token_ids:
@@ -997,14 +1215,19 @@ class HttpService:
             status = 499
         except Exception as exc:
             # Headers already sent: report in-band on the SSE stream; a second
-            # HTTP response is impossible at this point.
+            # HTTP response is impossible at this point. Typed: a strict-mode
+            # DisaggTransferError (no Migration operator to absorb it) lands
+            # here and must not read as a dropped stream or anonymous 500.
+            error_kind = _error_kind_of(exc)
             logger.exception("engine failed mid-stream")
-            status = 500
+            status = _status_of_kind(error_kind)
+            frame = {
+                "message": str(exc), "type": _err_type_of_kind(error_kind),
+            }
+            if error_kind:
+                frame["error_kind"] = error_kind
             with _suppress_conn_errors():
-                await _sse_send(
-                    response,
-                    {"error": {"message": str(exc), "type": "internal_error"}},
-                )
+                await _sse_send(response, {"error": frame})
         finally:
             timer.done(status)
             if audit_parts is not None:
@@ -1025,6 +1248,24 @@ class HttpService:
 
 def _error_response(exc: OpenAIError) -> web.Response:
     return web.json_response(exc.to_body(), status=exc.status)
+
+
+def _shed_response(exc: OverloadShedError) -> web.Response:
+    """Typed overload shed: 429 (load) / 503 (brownout) / 504 (dead
+    deadline), with Retry-After carrying the predicted drain time."""
+    dead = exc.reason == "deadline_expired"
+    resp = _error_response(
+        OpenAIError(
+            str(exc), status=exc.status,
+            err_type="deadline_exceeded" if dead else "overloaded",
+            kind="timeout" if dead else exc.reason,
+        )
+    )
+    if exc.retry_after is not None:
+        resp.headers["Retry-After"] = str(
+            max(1, int(exc.retry_after + 0.999))
+        )
+    return resp
 
 
 async def _prepend(first, rest):

@@ -53,6 +53,7 @@ class Backend:
             if out.error:
                 yield PostprocessedOutput(
                     error=out.error,
+                    error_kind=getattr(out, "error_kind", None),
                     finish_reason=FinishReason.ERROR,
                     cumulative_tokens=cumulative,
                 )

@@ -201,8 +201,12 @@ class Migration:
                         if over_cap
                         else f"{self.migration_limit} migrations"
                     )
+                    # Typed terminal error: the frontend renders the kind
+                    # as a structured SSE error event / JSON error_kind
+                    # instead of a bare 500 (http/service.py taxonomy).
                     yield BackendOutput(
                         error=f"stream failed after {detail}: {exc}",
+                        error_kind=reason,
                         finish_reason=FinishReason.ERROR,
                     )
                     return

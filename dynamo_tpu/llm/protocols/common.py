@@ -122,6 +122,12 @@ class BackendOutput:
     logprobs: Optional[List[List[TokenLogprob]]] = None  # per new token, top-N
     disaggregated_params: Optional[DisaggregatedParams] = None
     error: Optional[str] = None
+    # Structured failure taxonomy riding with ``error``: the PR 7
+    # classify_failure labels (timeout | connection | decode | other) plus
+    # the migration reasons (disagg | no_instances). The frontend maps it
+    # to a typed HTTP status / terminal SSE error event instead of a bare
+    # 500 (docs/design_docs/overload_control.md, error taxonomy section).
+    error_kind: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -153,6 +159,7 @@ class PostprocessedOutput:
     cumulative_tokens: int = 0
     logprobs: Optional[List[List[TokenLogprob]]] = None
     error: Optional[str] = None
+    error_kind: Optional[str] = None  # see BackendOutput.error_kind
 
 
 class RequestPhase(str, Enum):

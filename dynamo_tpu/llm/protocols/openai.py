@@ -26,15 +26,25 @@ from dynamo_tpu.llm.protocols.common import (
 
 
 class OpenAIError(Exception):
-    """Maps to an OpenAI-style error JSON body with an HTTP status."""
+    """Maps to an OpenAI-style error JSON body with an HTTP status.
 
-    def __init__(self, message: str, status: int = 400, err_type: str = "invalid_request_error") -> None:
+    ``kind`` carries the structured failure taxonomy (the PR 7
+    classify_failure labels plus migration reasons) into the body as
+    ``error_kind`` — a client distinguishing "worker link died" from
+    "payload was garbage" retries differently."""
+
+    def __init__(
+        self, message: str, status: int = 400,
+        err_type: str = "invalid_request_error",
+        kind: Optional[str] = None,
+    ) -> None:
         super().__init__(message)
         self.status = status
         self.err_type = err_type
+        self.kind = kind
 
     def to_body(self) -> Dict[str, Any]:
-        return {
+        body: Dict[str, Any] = {
             "error": {
                 "message": str(self),
                 "type": self.err_type,
@@ -42,6 +52,9 @@ class OpenAIError(Exception):
                 "code": None,
             }
         }
+        if self.kind:
+            body["error"]["error_kind"] = self.kind
+        return body
 
 
 def parse_n(req: Dict[str, Any]) -> int:

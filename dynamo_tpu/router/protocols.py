@@ -74,6 +74,15 @@ class LoadSnapshot:
     active_blocks: int = 0
     total_blocks: int = 0
     generated_tokens: int = 0  # cumulative, for throughput estimation
+    # Engine admission-queue depth (waiting + backpressure-held). The
+    # scheduler charges it as extra load so a deep queue deflects new
+    # placements BEFORE the worker's KV usage shows the pain.
+    queue_depth: int = 0
+    # The worker's admission refusal threshold (engine
+    # admit_kv_high_watermark): at/above this KV usage the worker is
+    # SATURATED — it will hold new admissions rather than preempt — so
+    # the router soft-skips it the way busy gating does (< 1.0 enables).
+    kv_high_watermark: float = 1.0
     # src prefill worker id → EWMA observed KV-pull bandwidth (bytes/s)
     # measured at THIS worker's transfer path (disagg/handlers.py). Feeds
     # the router's per-(src, dst) link-cost model.

@@ -168,6 +168,7 @@ class LoadPublisher:
         interval_s: float = 1.0,
         link_bandwidth_fn: Optional[Callable[[], dict]] = None,
         link_faults_fn: Optional[Callable[[], list]] = None,
+        kv_high_watermark: float = 1.0,
     ) -> None:
         self._plane = event_plane
         self._topic = load_topic(namespace, component)
@@ -184,6 +185,11 @@ class LoadPublisher:
         # () -> [src worker ids with an open pull breaker] — prices those
         # pairs out of disagg placement router-side.
         self.link_faults_fn = link_faults_fn
+        # This worker's admission refusal threshold, advertised so the
+        # router can deflect placements away once usage reaches it
+        # (overload backpressure). The stats dict's own value wins when
+        # the engine reports one.
+        self.kv_high_watermark = kv_high_watermark
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
 
@@ -201,6 +207,10 @@ class LoadPublisher:
             active_blocks=max(total - free, 0),
             total_blocks=total,
             generated_tokens=s.get("generated_tokens", 0),
+            queue_depth=s.get("queue_depth", s.get("waiting", 0)),
+            kv_high_watermark=float(
+                s.get("kv_high_watermark", self.kv_high_watermark)
+            ),
             link_bandwidth=link_bw or None,
             link_faults=list(link_faults) if link_faults else None,
         )

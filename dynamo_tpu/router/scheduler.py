@@ -52,6 +52,12 @@ class KvRouterConfig:
     inflight_ttl_s: float = 30.0
     # Soft-skip workers above this KV usage unless all are (busy gating).
     busy_kv_usage: float = 0.95
+    # Overload deflection: block-equivalents charged per request sitting
+    # in a candidate's admission queue (LoadSnapshot.queue_depth). A
+    # queued request is work the candidate has ACCEPTED but not started —
+    # deeper queue, later start, regardless of current KV usage. 0
+    # disables (pre-overload-plane behavior).
+    queue_depth_weight: float = 4.0
     # -- link-cost term (disagg decode placement) --------------------------
     # Multiplier on the transfer-cost block-equivalents; 0 disables the
     # term entirely (pure overlap+load cost, the pre-link behavior).
@@ -185,6 +191,20 @@ class WorkerState:
     def kv_usage(self) -> float:
         return self.snapshot.kv_usage if self.snapshot else 0.0
 
+    def queue_depth(self) -> int:
+        return self.snapshot.queue_depth if self.snapshot else 0
+
+    def saturated(self) -> bool:
+        """At/above the worker's advertised admission high watermark:
+        the engine will HOLD new admissions (backpressure) rather than
+        preempt, so a request routed here queues behind the watermark
+        instead of prefilling. Workers that never advertised a watermark
+        (< 1.0) are never considered saturated."""
+        if self.snapshot is None:
+            return False
+        wm = self.snapshot.kv_high_watermark
+        return wm < 1.0 and self.snapshot.kv_usage >= wm
+
 
 class KvScheduler:
     def __init__(self, config: Optional[KvRouterConfig] = None, *, seed: Optional[int] = None) -> None:
@@ -264,6 +284,13 @@ class KvScheduler:
         ]
         if not_busy:
             pool = not_busy
+        # Overload deflection: a worker at its advertised admission
+        # high watermark holds new work (engine backpressure) — prefer
+        # any unsaturated candidate; when ALL are saturated the least-
+        # loaded still wins below (shedding is the frontend's job).
+        unsaturated = [w for w in pool if not self._workers[w].saturated()]
+        if unsaturated:
+            pool = unsaturated
 
         logits: List[Tuple[WorkerKey, float, int]] = []
         for w in pool:
@@ -271,6 +298,10 @@ class KvScheduler:
             prefill = max(request_blocks - overlap, 0)
             decode = self._workers[w].decode_blocks(cfg.inflight_ttl_s)
             logit = cfg.overlap_score_weight * prefill + decode
+            if cfg.queue_depth_weight > 0:
+                # Accepted-but-unstarted work delays this placement the
+                # same way resident decode blocks do.
+                logit += cfg.queue_depth_weight * self._workers[w].queue_depth()
             if transfer is not None and cfg.link_cost_weight > 0:
                 # Overlap-miss blocks must also CROSS the (src → w) link:
                 # estimated seconds × prefill-rate = block-equivalents.

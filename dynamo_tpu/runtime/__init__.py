@@ -20,6 +20,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_FRONTEND,
     ALL_KVBM,
     ALL_MIGRATION,
+    ALL_OVERLOAD,
     ALL_ROUTER,
     ALL_RUNTIME,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "ALL_FRONTEND",
     "ALL_KVBM",
     "ALL_MIGRATION",
+    "ALL_OVERLOAD",
     "ALL_ROUTER",
     "ALL_RUNTIME",
     "AsyncEngine",

@@ -122,6 +122,27 @@ class Context:
             return None
         return max(0.0, self._deadline - time.monotonic())
 
+    def set_deadline(self, deadline: Optional[float]) -> None:
+        """(Re)arm the absolute monotonic deadline after construction —
+        the overload plane stamps a default budget onto deadline-less
+        requests this way. Arms the same wake-up timer the constructor
+        would, so ``wait_stopped`` waiters observe the new deadline."""
+        if self._deadline_handle is not None:
+            self._deadline_handle.cancel()
+            self._deadline_handle = None
+        self._deadline = deadline
+        if deadline is None or self._stop_event.is_set():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            delay = max(0.0, deadline - time.monotonic())
+            self._deadline_handle = loop.call_later(
+                delay, self.stop_generating, "deadline"
+            )
+
     @property
     def stopped(self) -> bool:
         if self._deadline is not None and time.monotonic() > self._deadline:

@@ -45,6 +45,13 @@ HEALTH_CANARY = "health.canary"
 KVBM_TIER_READ = "kvbm.tier.read"
 KVBM_TIER_WRITE = "kvbm.tier.write"
 
+# -- overload plane (runtime/overload.py) -------------------------------------
+# One hit per QUEUED admission attempt, before the EDF wait: an injected
+# timeout here expires exactly that request's queue budget — the
+# deterministic mid-queue-expiry schedule the saturation tests replay
+# (wall-clock deadline races can't).
+OVERLOAD_ADMIT = "overload.admit"
+
 ALL_FAULT_POINTS = (
     NET_TCP_SEND,
     NET_TCP_RECV,
@@ -59,4 +66,5 @@ ALL_FAULT_POINTS = (
     HEALTH_CANARY,
     KVBM_TIER_READ,
     KVBM_TIER_WRITE,
+    OVERLOAD_ADMIT,
 )

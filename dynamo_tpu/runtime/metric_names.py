@@ -44,6 +44,12 @@ ENGINE_SLEEP_LEVEL = engine_gauge("sleep_level")
 ENGINE_PIPELINE_DEPTH = engine_gauge("pipeline_depth")
 ENGINE_INFLIGHT_BURSTS = engine_gauge("inflight_bursts")
 ENGINE_PREEMPTIONS = engine_gauge("preemptions")
+# Overload plane inputs (engine admission backpressure): waiting-queue
+# depth + the admission refusal watermark (ride load reports router-ward)
+# and requests shed at dequeue with an expired deadline.
+ENGINE_QUEUE_DEPTH = engine_gauge("queue_depth")
+ENGINE_KV_HIGH_WATERMARK = engine_gauge("kv_high_watermark")
+ENGINE_DEADLINE_SHEDS = engine_gauge("deadline_sheds")
 
 # -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
 ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
@@ -142,6 +148,26 @@ FAULTS_PREFIX = "dynamo_tpu_faults"
 FAULTS_ARMED = f"{FAULTS_PREFIX}_armed"
 FAULTS_INJECTIONS_TOTAL = f"{FAULTS_PREFIX}_injections_total"
 
+# -- overload plane (runtime/overload.py OverloadController) -----------------
+OVERLOAD_PREFIX = "dynamo_tpu_overload"
+# Brownout state machine: 0 healthy, 1 brownout (max_tokens clamped,
+# speculative decode off), 2 shed (new admissions refused 503).
+OVERLOAD_STATE = f"{OVERLOAD_PREFIX}_state"
+OVERLOAD_TRANSITIONS_TOTAL = f"{OVERLOAD_PREFIX}_transitions_total"
+# Admissions refused, by reason (queue_full | predicted_delay |
+# deadline_expired | brownout_shed) — every shed reached a client as a
+# typed 429/503/504 + Retry-After.
+OVERLOAD_SHED_TOTAL = f"{OVERLOAD_PREFIX}_shed_total"
+OVERLOAD_ADMITTED_TOTAL = f"{OVERLOAD_PREFIX}_admitted_total"
+# Bounded EDF admission queue: live depth and the wait granted requests
+# actually paid (the predicted-delay shed keeps the tail of this
+# histogram inside max_queue_delay_s).
+OVERLOAD_QUEUE_DEPTH = f"{OVERLOAD_PREFIX}_queue_depth"
+OVERLOAD_QUEUE_DELAY = f"{OVERLOAD_PREFIX}_queue_delay_seconds"
+# Requests whose deadline expired before admission (dead on arrival or
+# expired mid-queue) — shed before any prefill work.
+OVERLOAD_DEADLINE_EXPIRED_TOTAL = f"{OVERLOAD_PREFIX}_deadline_expired_total"
+
 ALL_FRONTEND = (
     FRONTEND_REQUESTS_TOTAL,
     FRONTEND_INFLIGHT,
@@ -198,6 +224,16 @@ ALL_FAULTS = (
     FAULTS_INJECTIONS_TOTAL,
 )
 
+ALL_OVERLOAD = (
+    OVERLOAD_STATE,
+    OVERLOAD_TRANSITIONS_TOTAL,
+    OVERLOAD_SHED_TOTAL,
+    OVERLOAD_ADMITTED_TOTAL,
+    OVERLOAD_QUEUE_DEPTH,
+    OVERLOAD_QUEUE_DELAY,
+    OVERLOAD_DEADLINE_EXPIRED_TOTAL,
+)
+
 ALL_RUNTIME = (
     RUNTIME_COMPILES_TOTAL,
     RUNTIME_COMPILE_SIGNATURES,
@@ -224,6 +260,9 @@ ALL_ENGINE = (
     ENGINE_PIPELINE_DEPTH,
     ENGINE_INFLIGHT_BURSTS,
     ENGINE_PREEMPTIONS,
+    ENGINE_QUEUE_DEPTH,
+    ENGINE_KV_HIGH_WATERMARK,
+    ENGINE_DEADLINE_SHEDS,
     ENGINE_STEP_DURATION,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_STEP_PREFILL_TOKENS,

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
+import time
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 import msgpack
@@ -111,9 +112,22 @@ class HttpRequestPlane:
             )
             return response
         engine, tracker = entry
+        # Deadline propagation (parity with the TCP plane's ctx envelope):
+        # the header carries REMAINING seconds — monotonic clocks don't
+        # cross hosts — re-anchored onto this host's clock.
+        deadline_hdr = request.headers.get("X-Dynamo-Deadline-S")
+        try:
+            deadline_s = float(deadline_hdr) if deadline_hdr is not None else None
+        except ValueError:
+            deadline_s = None
         ctx = Context(
             id=request.headers.get("X-Request-Id") or None,
             baggage=_baggage_from(request.headers),
+            deadline=(
+                time.monotonic() + deadline_s
+                if deadline_s is not None
+                else None
+            ),
         )
         try:
             if tracker.draining:
@@ -194,6 +208,11 @@ class _HttpClientEngine:
             headers["X-Dynamo-Baggage"] = ",".join(
                 f"{k}={v}" for k, v in context.baggage.items()
             )
+        remaining = context.time_remaining()
+        if remaining is not None:
+            # Relative, not absolute: the worker re-anchors onto its own
+            # monotonic clock (same contract as the TCP plane).
+            headers["X-Dynamo-Deadline-S"] = f"{remaining:.6f}"
         body = msgpack.packb(request, default=_msgpack_default, use_bin_type=True)
         try:
             resp = await session.post(self._url, data=body, headers=headers)

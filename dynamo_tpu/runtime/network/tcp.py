@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
+import time
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 from dynamo_tpu.runtime import fault_names
@@ -94,8 +95,19 @@ class TcpRequestPlane:
                 sid = header.get("stream")
                 if ftype == "req":
                     ctx_info = header.get("ctx") or {}
+                    # Deadline propagation: the wire carries REMAINING
+                    # seconds (monotonic clocks don't cross hosts); the
+                    # server re-anchors it so engine admission and the
+                    # disagg pull timeouts see the client's real budget.
+                    deadline_s = ctx_info.get("deadline_s")
                     ctx = Context(
-                        id=ctx_info.get("id"), baggage=ctx_info.get("baggage") or {}
+                        id=ctx_info.get("id"),
+                        baggage=ctx_info.get("baggage") or {},
+                        deadline=(
+                            time.monotonic() + float(deadline_s)
+                            if deadline_s is not None
+                            else None
+                        ),
                     )
                     task = loop.create_task(
                         self._run_stream(fw, sid, header, payload, ctx),
@@ -285,12 +297,20 @@ class _TcpClientEngine:
         except OSError as exc:
             raise StreamDisconnectedError(f"connect {self._addr}: {exc}") from exc
         sid, q = conn.open_stream()
+        ctx_env: Dict[str, Any] = {
+            "id": context.id, "baggage": context.baggage,
+        }
+        remaining = context.time_remaining()
+        if remaining is not None:
+            # Relative, not absolute: the receiving host re-anchors onto
+            # its own monotonic clock.
+            ctx_env["deadline_s"] = remaining
         await conn.send(
             {
                 "type": "req",
                 "stream": sid,
                 "key": self._key,
-                "ctx": {"id": context.id, "baggage": context.baggage},
+                "ctx": ctx_env,
             },
             request,
         )

@@ -373,6 +373,29 @@ async def main() -> None:
                 print(f"restored {n} warm KV blocks", flush=True)
             except Exception as exc:
                 print(f"KV checkpoint restore failed: {exc}", flush=True)
+    # Worker-side overload plane: KV-pool-occupancy-driven brownout that
+    # suspends speculative decode before admission backpressure turns
+    # into a preemption storm (the engine's admit_kv_high_watermark does
+    # the refusing; this re-arms spec when pressure clears). The
+    # evaluate cadence rides the load-report task below.
+    from dynamo_tpu.runtime.overload import OverloadController, config_from_env
+
+    overload = OverloadController(
+        config_from_env(),
+        occupancy_source=lambda: engine.pool.usage,
+    )
+    overload.on_transition(
+        lambda _old, new: engine.set_spec_suspended(new > 0)
+    )
+
+    async def overload_eval_loop() -> None:
+        while True:
+            await asyncio.sleep(load_pub.interval_s)
+            overload.evaluate()
+
+    overload_task = asyncio.get_running_loop().create_task(
+        overload_eval_loop(), name="overload-eval"
+    )
     system_server = None
     if args.system_port is not None:
         from dynamo_tpu.runtime.system_server import (
@@ -382,6 +405,7 @@ async def main() -> None:
 
         system_server = SystemStatusServer(port=args.system_port)
         attach_engine(system_server, engine)
+        overload.register_metrics(system_server)
         if kvbm is not None:
             kvbm.register_metrics(system_server)
         if hasattr(handler, "register_metrics"):
@@ -412,6 +436,10 @@ async def main() -> None:
                 )
         if system_server is not None:
             await system_server.stop()
+        overload_task.cancel()
+        from dynamo_tpu.runtime.tasks import reap_task
+
+        await reap_task(overload_task, "overload eval loop", logger)
         if kvbm is not None:
             await kvbm.close()
         await load_pub.close()

@@ -433,6 +433,103 @@ async def test_breaker_opens_fails_fast_and_heals_on_probe():
         await rt.shutdown(grace_period=1)
 
 
+async def test_half_open_admits_exactly_one_probe_under_concurrency():
+    """N concurrent pulls arriving exactly at cooldown expiry: the
+    allow() winner IS the probe (breaker → HALF_OPEN, one wire attempt);
+    every other pull fails fast with zero wire time while the probe is
+    unresolved. The probe's success then closes the breaker. The PR 7
+    state machine claims this; this drives it through real concurrent
+    DecodeHandler pulls, not just sequential allow() calls."""
+
+    class _GatedClient:
+        """Wraps the pooled kv client: every direct() blocks on the gate
+        (so the probe stays in flight while the others arrive) and
+        counts wire attempts."""
+
+        def __init__(self, inner, gate):
+            self.inner = inner
+            self.gate = gate
+            self.calls = 0
+
+        async def direct(self, request, src):
+            self.calls += 1
+            await self.gate.wait()
+            async for reply in self.inner.direct(request, src):
+                yield reply
+
+    rt = DistributedRuntime.detached()
+    prefill_engine = make_engine(seed=11)
+    decode_engine = make_engine(seed=11, num_kv_blocks=128)
+    served = []
+    try:
+        pipeline, handler, served = await _serve_disagg(
+            rt, prefill_engine, decode_engine, seed_ns="fl-halfopen",
+            pull_attempts=1, breaker_open_after=1,
+            breaker_cooldown_s=60.0, backoff_base_s=0.0,
+        )
+        # Four distinct prefilled prompts → four dp bootstraps whose
+        # blocks the decode pool is missing.
+        dps = []
+        for i in range(4):
+            prompt = list(range(100 + 20 * i, 120 + 20 * i))
+            outs = await collect(
+                PrefillHandler(prefill_engine, 1).generate(
+                    req(prompt, max_tokens=4), Context()
+                )
+            )
+            dps.append(outs[0].disaggregated_params)
+        # Open the breaker: one terminally-failing pull (open_after=1).
+        plan = faults.FaultPlan(rules=(
+            faults.FaultRule(
+                point=fn.DISAGG_PULL_CHUNK, every=1, kind="connection",
+            ),
+        ))
+        with faults.armed(plan):
+            assert await handler._pull_blocks(dps[0]) == 0
+        breaker = handler._breakers[1]
+        assert breaker.state == CircuitBreaker.OPEN
+        # Cooldown elapses (deterministic rewind, no wall-clock sleep).
+        breaker.opened_at -= 120.0
+        # Gate the wire so the probe stays unresolved while the rest land.
+        gate = asyncio.Event()
+        gated = _GatedClient(handler._kv_client, gate)
+        handler._kv_client = gated
+        transfers_before = handler.transfers
+        rejected_before = sum(
+            1 for e in handler.flight.snapshot() if e["kind"] == "pull_rejected"
+        )
+        probe = asyncio.ensure_future(handler._pull_blocks(dps[0]))
+        losers = [
+            asyncio.ensure_future(handler._pull_blocks(dp)) for dp in dps[1:]
+        ]
+        # Let every task run to its breaker decision (the losers resolve;
+        # the probe parks on the gate).
+        await asyncio.sleep(0.05)
+        assert breaker.state == CircuitBreaker.HALF_OPEN
+        assert all(f.done() and f.result() == 0 for f in losers)
+        assert not probe.done()
+        assert gated.calls == 1  # exactly ONE wire attempt: the probe
+        rejected = [
+            e for e in handler.flight.snapshot()
+            if e["kind"] == "pull_rejected"
+        ]
+        assert len(rejected) - rejected_before == 3
+        assert all(e["state"] == "half_open" for e in rejected[-3:])
+        # Release the wire: the probe completes, imports, and closes.
+        gate.set()
+        pulled = await probe
+        assert pulled > 0
+        assert breaker.state == CircuitBreaker.CLOSED
+        # Only the probe counted as a transfer; the losers spent nothing.
+        assert handler.transfers == transfers_before + 1
+    finally:
+        for s in served:
+            await s.shutdown(grace_period=1)
+        await prefill_engine.stop()
+        await decode_engine.stop()
+        await rt.shutdown(grace_period=1)
+
+
 async def test_strict_handler_raises_migratable_on_breaker_rejection():
     """fallback_local_prefill=False: a terminally-failed pull surfaces as
     DisaggTransferError (MIGRATABLE) instead of silently re-prefilling."""

@@ -66,7 +66,8 @@ def test_all_family_tuples_are_canonical_and_exported():
         if isinstance(v, str) and v.startswith("dynamo_tpu_")
     }
     families = ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_DISAGG",
-                "ALL_ENGINE", "ALL_RUNTIME", "ALL_MIGRATION", "ALL_FAULTS")
+                "ALL_ENGINE", "ALL_RUNTIME", "ALL_MIGRATION", "ALL_FAULTS",
+                "ALL_OVERLOAD")
     for family in families:
         tup = getattr(rt, family)
         assert tup and isinstance(tup, tuple)
