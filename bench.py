@@ -25,7 +25,8 @@ Knob reference (env): BENCH_ISL/OSL/CONCURRENCY/REQUESTS, BENCH_MODEL
 BENCH_BLOCK_SIZE/KV_BLOCKS/PREFILL_CHUNK/PREFILL_BATCH/DECODE_STEPS,
 BENCH_USE_KERNEL, BENCH_SPEC=ngram (speculative decoding),
 BENCH_PIPELINE_DEPTH (decode-tick pipelining; 2 default, 1 = synchronous),
-BENCH_SECONDARY=0 (skip the 8B-int8 leg).
+BENCH_SECONDARY=0 (skip the 8B-int8 leg), BENCH_DISAGG=0 / BENCH_OVERLOAD=0
+/ BENCH_DRAIN=0 (skip the disagg / overload-armor / SIGTERM-drain legs).
 """
 
 from __future__ import annotations
@@ -872,6 +873,187 @@ async def run_overload_leg(isl: int = 64, osl: int = 32,
         gc.collect()
 
 
+async def run_drain_leg(isl: int = 64, osl: int = 48, concurrency: int = 8):
+    """Rolling-restart measurement (ISSUE 9): SIGTERM a worker mid-load and
+    prove users never see it. Two in-process engines (same seed/config —
+    the rolling-restart fleet invariant) serve one Migration-wrapped client
+    wave; mid-wave the process SIGTERMs ITSELF, the loop signal handler
+    triggers the source's DrainController, live decodes hand off to the
+    peer over the wire-v2 path, and the record carries the contract:
+    ``dropped_requests == 0``, handoff bytes, re-prefill tokens (only the
+    fallback rung pays any), and the worst mid-stream stall a client saw.
+    """
+    import signal as _signal
+
+    from dynamo_tpu.disagg import HandoffHandler
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.drain import DrainController
+
+    fault_activity0 = _fault_activity_start()
+    cfg = qwen2_500m_config()
+
+    def mk_engine():
+        return JaxEngine(
+            JaxEngineArgs(
+                config=cfg,
+                block_size=64,
+                num_kv_blocks=2048,
+                max_num_seqs=concurrency,
+                max_model_len=isl + osl + 64,
+                prefill_chunk=64,
+                prefill_batch=concurrency,
+                decode_steps=8,
+            )
+        )
+
+    source, peer = mk_engine(), mk_engine()
+
+    class _LocalHandoffClient:
+        """Controller-facing view of the peer's handoff endpoint."""
+
+        def __init__(self, handlers):
+            self._handlers = handlers
+
+        @property
+        def instance_ids(self):
+            return sorted(self._handlers)
+
+        def direct(self, request, instance_id, context=None):
+            return self._handlers[instance_id].generate(
+                request, context or Context()
+            )
+
+        async def close(self):
+            pass
+
+    handoff_client = _LocalHandoffClient({2: HandoffHandler(peer)})
+
+    async def handoff_client_factory():
+        return handoff_client
+
+    controller = DrainController(
+        source,
+        worker_id=1,
+        handoff_client_factory=handoff_client_factory,
+        deadline_s=60.0,
+    )
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(_signal.SIGTERM, controller.trigger)
+
+    class _DrainAwareClient:
+        """Stands in for the KV router: places on the source until its
+        draining bit flips, then on the peer — exactly what KvScheduler
+        does once the draining load report lands."""
+
+        async def generate(self, request, context):
+            eng = peer if source.draining else source
+            async for out in eng.generate(request, context):
+                yield out
+
+    mig = Migration(migration_limit=3)
+    client = _DrainAwareClient()
+    rng = np.random.default_rng(23)
+
+    def mk_req(i):
+        return PreprocessedRequest(
+            token_ids=rng.integers(10, cfg.vocab_size - 10, size=isl).tolist(),
+            request_id=f"drain-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+
+    async def run_one(req):
+        """→ (tokens, max inter-output stall seconds, error|None)."""
+        n = 0
+        last = time.monotonic()
+        stall = 0.0
+        try:
+            async for out in mig.generate(req, Context(), client):
+                now = time.monotonic()
+                stall = max(stall, now - last)
+                last = now
+                err = out.get("error") if isinstance(out, dict) else out.error
+                if err:
+                    return (n, stall, str(err))
+                toks = (
+                    out.get("token_ids") if isinstance(out, dict)
+                    else out.token_ids
+                )
+                n += len(toks or [])
+        except Exception as exc:
+            return (n, stall, f"{type(exc).__name__}: {exc}")
+        return (n, stall, None)
+
+    try:
+        # Warm both engines (compiles must not masquerade as drain stall).
+        await asyncio.gather(
+            *(collect_silent(source, mk_req(10_000 + i)) for i in range(2)),
+            *(collect_silent(peer, mk_req(20_000 + i)) for i in range(2)),
+        )
+        reprefill0 = mig.metrics.reprefill_tokens.value()
+        t0 = time.monotonic()
+        tasks = [
+            asyncio.ensure_future(run_one(mk_req(i)))
+            for i in range(2 * concurrency)
+        ]
+        # Let the first wave reach steady decode, then kill the worker.
+        await asyncio.sleep(1.0)
+        os.kill(os.getpid(), _signal.SIGTERM)
+        results = await asyncio.gather(*tasks)
+        await controller.drain()  # join (SIGTERM already triggered it)
+        wall = time.monotonic() - t0
+        dropped = sum(1 for _n, _s, err in results if err is not None)
+        short = sum(1 for n, _s, err in results if err is None and n != osl)
+        status = controller.status()
+        return {
+            "model": cfg.name,
+            "isl": isl,
+            "osl": osl,
+            "concurrency": concurrency,
+            "streams": len(results),
+            "wall_s": round(wall, 3),
+            # THE contract: a planned restart drops nothing.
+            "dropped_requests": dropped + short,
+            "handed_off": status["handoffs"],
+            "handoff_bytes": status["handoff_bytes"],
+            "reprefill_fallbacks": status["reprefill_fallbacks"],
+            "requeued": status["requeued"],
+            # Tokens the fallback rung re-prefilled (handoffs pay ZERO).
+            "reprefill_tokens": int(
+                mig.metrics.reprefill_tokens.value() - reprefill0
+            ),
+            "max_midstream_stall_s": round(
+                max((s for _n, s, _e in results), default=0.0), 3
+            ),
+            "drain_duration_s": status.get("duration_s"),
+            "fault_plane": _fault_plane_record(fault_activity0),
+        }
+    finally:
+        loop.remove_signal_handler(_signal.SIGTERM)
+        await source.stop()
+        await peer.stop()
+        import gc
+
+        del source, peer
+        gc.collect()
+
+
+async def collect_silent(engine, req):
+    """Drain one warmup stream, ignoring its outputs."""
+    from dynamo_tpu.runtime.context import Context
+
+    async for _ in engine.generate(req, Context()):
+        pass
+
+
 async def run_bench():
     model_name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
     quant = os.environ.get("BENCH_QUANT") or None
@@ -1029,6 +1211,19 @@ async def run_bench():
             out["overload"] = await run_overload_leg()
         except Exception as exc:
             out["overload"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if (
+        os.environ.get("BENCH_DRAIN", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        # Drain leg (ISSUE 9): SIGTERM a worker mid-load; dropped==0,
+        # handoff bytes, re-prefill tokens, worst mid-stream stall.
+        # Never kills the headline; skipped-exit-0 contract untouched.
+        try:
+            out["drain"] = await run_drain_leg()
+        except Exception as exc:
+            out["drain"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(out))
 
 

@@ -140,6 +140,12 @@ class RingWriterConfig:
             # Overload plane (PR 8): admission sheds + brownout state
             # transitions; single writer: the frontend's event loop.
             "overload": ("runtime/overload.py", "OverloadController"),
+            # Drain plane (PR 9): handoff/fallback/requeue history; single
+            # writer: the draining worker's event loop.
+            "drain": ("runtime/drain.py", "DrainController"),
+            # KVBM integrity events (tier corruption); single writer: the
+            # manager's event loop (onboard + offload spill paths).
+            "kvbm": ("kvbm/manager.py", "TieredKvManager"),
         }
     )
 
@@ -152,7 +158,7 @@ class FaultPointConfig:
     argument is a point name (``fault_point`` and any alias)."""
 
     fault_names_rel: str = "runtime/fault_names.py"
-    call_names: FrozenSet[str] = frozenset({"fault_point"})
+    call_names: FrozenSet[str] = frozenset({"fault_point", "fault_payload"})
 
 
 @dataclass(frozen=True)

@@ -13,8 +13,10 @@ import sys
 
 from dynamo_tpu import config
 from dynamo_tpu.cli.run import (
+    add_drain_args,
     add_observe_args,
     add_run_args,
+    main_drain,
     main_observe,
     main_run,
 )
@@ -70,6 +72,12 @@ def main(argv=None) -> None:
         "(/debug/memory /debug/compiles /debug/flight)",
     )
     add_observe_args(observe_p)
+    drain_p = sub.add_parser(
+        "drain",
+        help="live-handoff drain a running worker (POST /drain; in-flight "
+        "decodes migrate to peers with zero re-prefill)",
+    )
+    add_drain_args(drain_p)
     # Lazy import: lint is jax-free and must stay that way (it runs on
     # boxes where the serving deps don't), so it can't ride cli.run's
     # imports.
@@ -90,6 +98,8 @@ def main(argv=None) -> None:
         asyncio.run(main_run(args))
     elif args.command == "observe":
         asyncio.run(main_observe(args))
+    elif args.command == "drain":
+        asyncio.run(main_drain(args))
     elif args.command == "lint":
         from dynamo_tpu.analysis.cli import main_lint
 

@@ -208,6 +208,63 @@ def add_observe_args(parser: argparse.ArgumentParser) -> None:
                         help="dump the raw endpoint JSON instead of tables")
 
 
+def add_drain_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="system-server host of the running worker")
+    parser.add_argument("--port", type=int, default=None,
+                        help="system-server port (default: DYN_TPU_SYSTEM_PORT)")
+    parser.add_argument("--deadline-s", type=float, default=None,
+                        help="drain budget override (default: the worker's "
+                        "DYN_TPU_DRAIN_DEADLINE_S)")
+    parser.add_argument("--status", action="store_true",
+                        help="report drain state only; do not trigger")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw status JSON")
+
+
+async def main_drain(args) -> None:
+    """Operator-facing drain trigger: POST /drain on a running worker's
+    system server and wait for the live-handoff drain to finish (the same
+    path SIGTERM and the k8s preStop hook take). With --status, report
+    the current state without triggering."""
+    import aiohttp
+
+    from dynamo_tpu import config
+
+    port = args.port if args.port is not None else config.SYSTEM_PORT.get()
+    base = f"http://{args.host}:{port}"
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=10)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        try:
+            if args.status:
+                resp = await session.get(f"{base}/drain")
+            else:
+                body = {}
+                if args.deadline_s is not None:
+                    body["deadline_s"] = args.deadline_s
+                resp = await session.post(f"{base}/drain", json=body)
+            async with resp:
+                if resp.status != 200:
+                    raise SystemExit(
+                        f"{'GET' if args.status else 'POST'} {base}/drain -> "
+                        f"{resp.status}: {await resp.text()}"
+                    )
+                status = await resp.json()
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"cannot reach system server at {base}: {exc}")
+
+    if args.json:
+        print(json.dumps(status, indent=2))
+        return
+    print(f"state: {status.get('state')}")
+    for key in (
+        "handoffs", "reprefill_fallbacks", "requeued", "peer_refusals",
+        "handoff_bytes", "live_relays", "checkpointed", "duration_s",
+    ):
+        if key in status:
+            print(f"  {key:<20} {status[key]}")
+
+
 def _fmt_bytes(n) -> str:
     if not isinstance(n, (int, float)):
         return "?"

@@ -143,3 +143,15 @@ OVERLOAD_BROWNOUT_MAX_TOKENS = env_int(
     "max_tokens clamp applied while browned out",
 )
 GRACE_PERIOD = env_float("DYN_TPU_GRACE_PERIOD", 30.0, "Graceful-shutdown drain seconds")
+DRAIN_DEADLINE_S = env_float(
+    "DYN_TPU_DRAIN_DEADLINE_S", 30.0,
+    "Live-handoff drain budget (SIGTERM / POST /drain / preStop): handoffs "
+    "not completed by then fall back to re-prefill migration",
+)
+DRAIN_HANDOFF_CONCURRENCY = env_int(
+    "DYN_TPU_DRAIN_HANDOFF_CONCURRENCY", 4,
+    "Concurrent handoff ships per drain: detach/export serialize at the "
+    "engine's reconciled boundary, but the peer accept-ack round trips "
+    "are independent — pipelining them keeps a full worker's drain "
+    "inside the deadline on a slow link",
+)

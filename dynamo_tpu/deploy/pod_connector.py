@@ -91,6 +91,23 @@ def render_pod(
         container["resources"] = {
             "limits": {TPU_RESOURCE: str(svc.chips_per_host)}
         }
+    if svc.system_port > 0:
+        # Rolling-restart contract (runtime/drain.py): on pod deletion the
+        # kubelet runs preStop and BLOCKS on its response — GET
+        # /drain?start=1 returns only when the live-handoff drain finished
+        # (every in-flight decode handed to a peer or migrated), so users
+        # never observe the restart. SIGTERM afterwards is a no-op drain
+        # re-trigger (idempotent). httpGet because the preStop action
+        # cannot POST; the worker treats start=1 as the trigger.
+        container["ports"].append({"containerPort": svc.system_port})
+        container["lifecycle"] = {
+            "preStop": {
+                "httpGet": {
+                    "path": "/drain?start=1",
+                    "port": svc.system_port,
+                }
+            }
+        }
     spec: Dict[str, Any] = {
         "restartPolicy": "Never",  # the reconcile loop owns recreation
         "containers": [container],
@@ -99,6 +116,9 @@ def render_pod(
         "hostname": pod_name,
         "subdomain": dep.name,
     }
+    if svc.system_port > 0:
+        # Budget = preStop drain + SIGTERM finally-path shutdown margin.
+        spec["terminationGracePeriodSeconds"] = int(svc.drain_deadline_s) + 15
     if node_selector:
         spec["nodeSelector"] = node_selector
     body = {

@@ -73,6 +73,15 @@ class ServiceSpec:
     tpu_topology: str = ""  # gke nodeSelector topology value
     node_selector: Dict[str, str] = field(default_factory=dict)
     port: int = 0  # containerPort + coordinator port for multihost groups
+    # System-server port of the service's worker process (--system-port).
+    # > 0 wires the rolling-restart contract into the pod: a preStop
+    # httpGet hook hits GET /drain?start=1 (the kubelet blocks on the
+    # response, which is the live-handoff drain completing) and the pod's
+    # terminationGracePeriodSeconds is sized to drain_deadline_s + margin.
+    system_port: int = 0
+    # Drain budget advertised to k8s (DYN_TPU_DRAIN_DEADLINE_S should
+    # match); only meaningful with system_port > 0.
+    drain_deadline_s: float = 30.0
 
     def resolved_command(self) -> List[str]:
         if self.command:
@@ -130,6 +139,8 @@ class GraphDeployment:
                     k: str(v) for k, v in (s.get("node_selector") or {}).items()
                 },
                 port=int(s.get("port", 0)),
+                system_port=int(s.get("system_port", 0)),
+                drain_deadline_s=float(s.get("drain_deadline_s", 30.0)),
             )
         dep = cls(
             name=doc.get("name", "deployment"),

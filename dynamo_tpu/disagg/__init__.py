@@ -12,6 +12,14 @@ tail block is recomputed locally (cheap).
 """
 
 from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
+from dynamo_tpu.disagg.handoff import (
+    HANDOFF_ENDPOINT,
+    HandoffHandler,
+    HandoffRefused,
+    HandoffTicket,
+    pack_handoff,
+    unpack_handoff,
+)
 from dynamo_tpu.disagg.handlers import (
     CircuitBreaker,
     DecodeHandler,
@@ -34,10 +42,16 @@ __all__ = [
     "CircuitBreaker",
     "DecodeHandler",
     "DisaggTransferError",
+    "HANDOFF_ENDPOINT",
+    "HandoffHandler",
+    "HandoffRefused",
+    "HandoffTicket",
     "KvTransferHandler",
     "PrefillHandler",
     "PrefillRouter",
     "classify_failure",
     "pack_array",
+    "pack_handoff",
     "unpack_array",
+    "unpack_handoff",
 ]

@@ -1,0 +1,178 @@
+"""Live-handoff tickets: zero-re-prefill migration of an in-flight decode.
+
+The PR 7 migration path re-prefills the full prompt + carried tokens on the
+new worker — correct, but it recomputes KV the old worker already holds.
+FlowKV's observation (PAPERS.md) is that in-flight KV can ride a
+low-latency transfer instead of being recomputed: a draining worker
+detaches each live decode at a reconciled burst boundary, ships a
+:class:`HandoffTicket` (prompt + generated tokens, position, sampling
+params, arrival RNG salt, committed block chain) plus the sequence's KV
+blocks in the wire-v2 pool-native form (disagg/wire.py — int8 pools ship
+int8), and the peer installs the blocks VERBATIM and resumes decode at the
+exact next token. Bit-identical continuation falls out of the PR 3
+``fold_in(seed, salt, token_index)`` sampling keys: the ticket carries the
+arrival salt and the position, so the adopted stream draws the same noise
+the never-migrated stream would — with **zero re-prefilled tokens**.
+
+The wire payload covers positions ``0..pos-1``: every committed
+(complete, prefix-cached) block followed by the partially-filled tail
+block. The peer installs committed blocks as shared cache content and the
+tail rows as private blocks of the adopted sequence.
+
+This module is numpy-only (no jax): the ticket + payload pack/unpack ride
+the same msgpack-friendly dicts as the KV wire, so the recorder and
+offline tooling can replay handoffs without a device runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.disagg.wire import KvWireBlocks, pack_kv, unpack_kv
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+HANDOFF_ENDPOINT = "handoff"
+HANDOFF_VERSION = 1
+
+
+class HandoffRefused(Exception):
+    """The peer cannot adopt this ticket (capacity, shape/seed mismatch,
+    itself draining). NOT migratable by design: the source worker absorbs
+    a refusal by trying the next peer or falling down the drain ladder —
+    the client stream never sees it."""
+
+
+@dataclass
+class HandoffTicket:
+    """Everything a peer needs to resume a live decode mid-token.
+
+    ``pos`` is the number of positions whose KV is resident (the decode
+    input token ``all_tokens[-1]`` has NOT written its KV yet — it is the
+    next decode input, exactly as on the source). ``n_blocks`` counts the
+    wire payload's rows: ``len(committed_hashes)`` shared-cache blocks
+    followed by the private tail rows covering ``pos``."""
+
+    request: Dict[str, Any]  # PreprocessedRequest.to_dict()
+    generated: List[int]  # tokens already streamed to the client
+    salt: int  # arrival-order sampling salt (RNG continuity)
+    hash_salt: int  # adapter/mm prefix-cache salt
+    pos: int
+    committed_hashes: List[int] = field(default_factory=list)
+    n_blocks: int = 0
+    # Compatibility stamp: continuation is only bit-identical on an engine
+    # with the same weights/layout/sampling seed. A mismatching peer
+    # refuses and the source falls down the ladder.
+    model: str = ""
+    block_size: int = 0
+    n_layers: int = 0
+    n_kv_heads: int = 0
+    head_dim: int = 0
+    seed: int = 0
+    version: int = HANDOFF_VERSION
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "HandoffTicket":
+        return cls(**{
+            k: v for k, v in d.items() if k in cls.__dataclass_fields__
+        })
+
+
+def pack_handoff(ticket: HandoffTicket, wire: Optional[KvWireBlocks]) -> Dict[str, Any]:
+    """One handoff request message (msgpack/in-proc friendly)."""
+    return {
+        "handoff": ticket.to_dict(),
+        "kv": pack_kv(wire) if wire is not None else None,
+    }
+
+
+def unpack_handoff(d: Dict[str, Any]):
+    """Inverse of pack_handoff → (HandoffTicket, KvWireBlocks | None)."""
+    ticket = HandoffTicket.from_dict(d["handoff"])
+    kv = d.get("kv")
+    return ticket, (unpack_kv(kv) if kv else None)
+
+
+class HandoffHandler:
+    """Peer side of a live handoff: serve the worker's ``handoff``
+    endpoint. The reply stream is ``{"accepted": ...}`` first (the source's
+    go/no-go for releasing its own copy), then the continuation's
+    BackendOutput dicts — tokens generated AFTER the handoff point only
+    (everything before it already reached the client through the source).
+    """
+
+    def __init__(self, engine: Any) -> None:
+        self._engine = engine
+
+    def _validate(self, ticket: HandoffTicket, wire) -> None:
+        e = self._engine
+        cfg = e.config
+        for key, theirs, ours in (
+            # A mismatched wire format must refuse, not install blocks
+            # under stale semantics — from_dict drops unknown fields, so
+            # without this row a future-version ticket could pass every
+            # shape check and still resume a corrupted continuation.
+            ("version", ticket.version, HANDOFF_VERSION),
+            ("model", ticket.model, cfg.name),
+            ("block_size", ticket.block_size, e.args.block_size),
+            ("n_layers", ticket.n_layers, cfg.n_layers),
+            ("n_kv_heads", ticket.n_kv_heads, cfg.n_kv_heads),
+            ("head_dim", ticket.head_dim, cfg.head_dim_),
+            # Same seed or the fold_in(seed, salt, pos) keys diverge and
+            # the continuation stops being the stream the client was
+            # already reading — refuse rather than silently fork it.
+            ("seed", ticket.seed, e.args.seed),
+        ):
+            if theirs != ours:
+                raise HandoffRefused(
+                    f"ticket {key}={theirs!r} does not match engine {ours!r}"
+                )
+        prompt = list(ticket.request.get("token_ids") or [])
+        if not prompt:
+            raise HandoffRefused("ticket carries an empty prompt")
+        n_tokens = len(prompt) + len(ticket.generated)
+        if ticket.pos != n_tokens - 1:
+            raise HandoffRefused(
+                f"ticket pos {ticket.pos} inconsistent with "
+                f"{n_tokens} prompt+generated tokens"
+            )
+        if n_tokens >= e.args.max_model_len:
+            raise HandoffRefused(
+                f"{n_tokens} tokens exceed max_model_len {e.args.max_model_len}"
+            )
+        need_blocks = -(-ticket.pos // e.args.block_size)  # ceil
+        if ticket.n_blocks != need_blocks:
+            raise HandoffRefused(
+                f"ticket n_blocks {ticket.n_blocks} != ceil(pos/block_size) "
+                f"{need_blocks}"
+            )
+        if wire is None or len(wire) != ticket.n_blocks:
+            raise HandoffRefused(
+                f"wire payload has {0 if wire is None else len(wire)} rows, "
+                f"ticket names {ticket.n_blocks}"
+            )
+        if len(ticket.committed_hashes) > ticket.n_blocks:
+            raise HandoffRefused("more committed hashes than wire rows")
+        lora = ticket.request.get("lora_name")
+        if lora and lora not in getattr(e, "_lora_index", {}):
+            raise HandoffRefused(f"LoRA adapter {lora!r} not loaded here")
+
+    async def generate(
+        self, request: Any, context: Any
+    ) -> AsyncIterator[dict]:
+        try:
+            ticket, wire = unpack_handoff(dict(request))
+            self._validate(ticket, wire)
+            seq = await self._engine.adopt_handoff(ticket, wire, context)
+        except HandoffRefused as exc:
+            logger.warning("handoff refused: %s", exc)
+            yield {"accepted": False, "reason": str(exc)}
+            return
+        yield {"accepted": True}
+        async for out in self._engine.stream_adopted(seq):
+            yield out.to_dict()

@@ -54,50 +54,67 @@ class Admitter:
         e = self.e
 
         free_slots = [i for i, s in enumerate(e._slots) if s is None]
+        # Pending handoff adoptions (drain plane) hold a slot reservation:
+        # adopt_handoff already promised the peer capacity, and the
+        # scheduler loop installs adoptions before admission each tick —
+        # local admission taking the last free slot would strand an
+        # adopted LIVE stream (client mid-decode) behind the whole queue.
+        for _ in e._adoptions:
+            if free_slots:
+                free_slots.pop()
         if not free_slots or not e._waiting:
             return 0
         batch: List[Tuple[Any, Any]] = []
         limit = min(len(free_slots), e.args.prefill_batch)
-        while e._waiting and len(batch) < limit:
-            seq = e._waiting[0]
-            # Expired/cancelled work sheds AT DEQUEUE, before any pool or
-            # prefill spend — deadline expiries surface as a typed error
-            # (overload armor: an already-dead request must never reach
-            # the device).
-            if seq.context.stopped:
+        # The dual of the reservation above: while this batch is being
+        # prepared/prefilled (both await), adopt_handoff must count its
+        # slots-to-be as taken (engine._admitting) or it accepts a
+        # handoff into a slot this batch is about to install into.
+        try:
+            while e._waiting and len(batch) < limit:
+                seq = e._waiting[0]
+                # Expired/cancelled work sheds AT DEQUEUE, before any pool
+                # or prefill spend — deadline expiries surface as a typed
+                # error (overload armor: an already-dead request must
+                # never reach the device).
+                if seq.context.stopped:
+                    e._waiting.popleft()
+                    e._shed_expired(seq)
+                    continue
+                # Backpressure: past the high watermark, admitting trades
+                # one queued request for a preemption storm against the
+                # running ones — hold the queue and let decode drain
+                # instead. Only with live occupants: an idle engine always
+                # admits (the watermark measures contention, not fit).
+                if (
+                    e.pool.usage >= e.args.admit_kv_high_watermark
+                    and any(s is not None for s in e._slots)
+                ):
+                    break
+                has_mm = bool((seq.request.extra or {}).get("mm_embeds"))
+                if has_mm and batch:
+                    break  # multimodal rows carry their own embed arrays: solo batch
                 e._waiting.popleft()
-                e._shed_expired(seq)
-                continue
-            # Backpressure: past the high watermark, admitting trades one
-            # queued request for a preemption storm against the running
-            # ones — hold the queue and let decode drain instead. Only
-            # with live occupants: an idle engine always admits (the
-            # watermark measures contention, not fit).
-            if (
-                e.pool.usage >= e.args.admit_kv_high_watermark
-                and any(s is not None for s in e._slots)
-            ):
-                break
-            has_mm = bool((seq.request.extra or {}).get("mm_embeds"))
-            if has_mm and batch:
-                break  # multimodal rows carry their own embed arrays: solo batch
-            e._waiting.popleft()
-            try:
-                prep = await e._prepare_admission(seq)
-            except asyncio.CancelledError:
-                e._waiting.appendleft(seq)
-                raise
-            except Exception as exc:
-                e._contain_admission_failure([seq], exc)
-                return len(batch) if not batch else await e._finish_admission(batch)
-            if prep is None:  # pool dry; seq was requeued to the front
-                break
-            batch.append((seq, prep))
-            if has_mm:
-                break
-        if not batch:
-            return 0
-        return await e._finish_admission(batch)
+                e._admitting = len(batch) + 1
+                try:
+                    prep = await e._prepare_admission(seq)
+                except asyncio.CancelledError:
+                    e._waiting.appendleft(seq)
+                    raise
+                except Exception as exc:
+                    e._contain_admission_failure([seq], exc)
+                    return len(batch) if not batch else await e._finish_admission(batch)
+                if prep is None:  # pool dry; seq was requeued to the front
+                    break
+                batch.append((seq, prep))
+                e._admitting = len(batch)
+                if has_mm:
+                    break
+            if not batch:
+                return 0
+            return await e._finish_admission(batch)
+        finally:
+            e._admitting = 0
 
     async def _finish_admission(self, batch: "List[Tuple[Any, Any]]") -> int:
         e = self.e
@@ -374,47 +391,17 @@ class Admitter:
                 seq.block_hashes.append(prep.hashes[i])
                 if e.kvbm is not None:
                     e.kvbm.notify_commit(prep.hashes[i], i + 1)
-        seq.slot = slot
-        e._slots[slot] = seq
-        e._pos[slot] = len(prompt)
-        e._block_tables[slot, :] = 0
-        e._block_tables[slot, : len(prep.ids)] = prep.ids
-        e._temp[slot], e._topk[slot], e._topp[slot] = prep.sp
-        e._adapter_ids[slot] = prep.adapter_id
-        e._salts[slot] = seq.salt
-        e._tok_mirror[slot] = int(first_token)
-        # Installation mutates every per-slot field the device-resident
-        # decode state reads — reconcile at the next dispatch. Installs
-        # only ever happen behind the scheduler's drain barrier, so no
-        # in-flight burst can be holding this slot stale-active.
-        e._dirty_state.add(slot)
-        e._dirty_tables.add(slot)
-        # Logits-processor slot state: neutral unless this occupant asks —
-        # stale device bookkeeping from a previous occupant is harmless
-        # under neutral params (identity transform).
-        p = prep.procs
-        e._uses_procs[slot] = p is not None
-        if p is None:
-            e._minp[slot] = 0.0
-            e._rep[slot] = 1.0
-            e._pres[slot] = 0.0
-            e._freq[slot] = 0.0
-            e._bias_ids[slot, :] = -1
-            e._bias_vals[slot, :] = 0.0
-        else:
-            from dynamo_tpu.ops import logits_process as lp
-
-            e._minp[slot] = p.minp
-            e._rep[slot] = p.rep
-            e._pres[slot] = p.pres
-            e._freq[slot] = p.freq
-            e._bias_ids[slot] = p.bias_ids
-            e._bias_vals[slot] = p.bias_vals
-            # Original prompt only in the mask; prior generated tokens (a
-            # preempted sequence being re-admitted) restore output counts.
-            e.runner.proc_reset_slot(
-                slot, seq.request.token_ids, seq.generated
-            )
+        # Per-slot device state: ONE shared implementation with the
+        # drain plane's _install_adopted (engine._set_slot_state) — any
+        # new per-slot sampling field must land there, not here.
+        e._set_slot_state(
+            seq, slot, pos=len(prompt), block_ids=prep.ids, sp=prep.sp,
+            adapter_id=prep.adapter_id, procs=prep.procs,
+            tok_mirror=int(first_token),
+        )
+        if prep.procs is not None:
+            # The freshly sampled first token is not in seq.generated yet
+            # (emit below appends it): count it on the device now.
             e.runner.proc_count(slot, first_token)
         e._emit_token(seq, first_token, first_logprob, first_top)
 

@@ -172,6 +172,10 @@ class _Sequence:
     # (incrementally indexed up to ngram_upto).
     ngram_index: Dict[tuple, int] = field(default_factory=dict)
     ngram_upto: int = 0
+    # Live-handoff drain: position snapshot taken when the sequence is
+    # detached from its slot (= len(all_tokens) - 1 at the reconciled
+    # boundary); also the resume position an adopted sequence installs at.
+    detach_pos: int = -1
 
 
 @dataclass
@@ -283,6 +287,21 @@ class JaxEngine:
         # already expired (observability; bench reads the activity
         # counter, tests read this).
         self.deadline_sheds = 0
+        # Live-handoff drain plane (runtime/drain.py): while draining, new
+        # generate() calls refuse with a typed migratable error, admission
+        # holds, and the DrainController detaches/exports live decodes.
+        # Detach requests and adoptions are serviced by the scheduler loop
+        # behind its drain barrier (the only place slot state may mutate
+        # with bursts reconciled).
+        self._draining = False
+        self._detach_requests: "collections.deque" = collections.deque()
+        self._adoptions: "collections.deque[_Sequence]" = collections.deque()
+        # Sequences in an in-flight admission batch (popped from _waiting,
+        # slot not yet taken) — adopt_handoff counts them or it promises a
+        # peer capacity the batch is about to install into.
+        self._admitting = 0
+        self.handoffs_exported = 0
+        self.handoffs_adopted = 0
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -533,6 +552,9 @@ class JaxEngine:
             "pipeline_depth": self._pipeline_depth(),
             "inflight_bursts": len(self._inflight),
             "preemptions": self.preemptions,
+            # Drain plane: rides load reports so KvScheduler stops placing
+            # new work here the moment the report lands.
+            "draining": 1 if self._draining else 0,
             # Overload plane inputs: queue depth + the admission refusal
             # watermark ride load reports router-ward (LoadSnapshot), and
             # deadline sheds are the proof expired work never prefilled.
@@ -634,6 +656,17 @@ class JaxEngine:
         self, request: Any, context: Context
     ) -> AsyncIterator[BackendOutput]:
         await self.start()
+        if self._draining:
+            # Typed, MIGRATABLE refusal: the router stops placing work here
+            # the moment the draining load report lands, but a request that
+            # raced the report must bounce fast so the frontend's Migration
+            # re-dispatches it to a serving worker (the "typed requeue"
+            # rung of the drain ladder).
+            from dynamo_tpu.runtime.drain import WorkerDrainingError
+
+            raise WorkerDrainingError(
+                "worker is draining; re-dispatch to another instance"
+            )
         if isinstance(request, dict):
             request = PreprocessedRequest.from_dict(request)
         prompt = list(request.token_ids)
@@ -678,10 +711,23 @@ class JaxEngine:
         self._next_salt = (self._next_salt + 1) & 0x7FFFFFFF
         self._waiting.append(seq)
         self._wake.set()
+        async for out in self._stream_outputs(seq):
+            yield out
+
+    async def _stream_outputs(
+        self, seq: _Sequence
+    ) -> AsyncIterator[BackendOutput]:
+        """Drain a sequence's output queue to its consumer. An exception
+        object on the queue RAISES out of the stream — the drain plane's
+        fallback ladder uses this to surface a typed migratable error
+        (handoff failed / worker draining) through the serving handler so
+        the frontend's Migration re-dispatches the request."""
         while True:
             out = await seq.queue.get()
             if out is None:
                 return
+            if isinstance(out, BaseException):
+                raise out
             yield out
             if out.finish_reason is not None:
                 return
@@ -694,6 +740,12 @@ class JaxEngine:
                 if self._sleep_requested is not None or self._sleep_level > 0:
                     if await self._sleep_tick():
                         continue
+                # Drain plane: detaches and adoptions mutate slot state, so
+                # they ride the same reconciled boundary admission does —
+                # every in-flight burst reaped first.
+                if self._detach_requests or self._adoptions:
+                    await self._drain_inflight()
+                    self._service_drain_queues()
                 # Admission installs into slots and allocates pool blocks —
                 # both must see fully-reconciled state, so drain the
                 # pipeline first. Gated on a free slot actually existing:
@@ -798,6 +850,19 @@ class JaxEngine:
         while self._waiting:
             seq = self._waiting.popleft()
             seq.queue.put_nowait(BackendOutput(error=err, finish_reason=reason))
+        # Drain-plane stragglers: unresolved detach requests surface as an
+        # error (the controller falls back down its ladder); adopted-but-
+        # uninstalled sequences release their blocks and end their streams.
+        while self._detach_requests:
+            _rid, fut = self._detach_requests.popleft()
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("engine stopped during handoff detach")
+                )
+        while self._adoptions:
+            seq = self._adoptions.popleft()
+            self.pool.release(seq.block_ids, seq.block_hashes)
+            seq.queue.put_nowait(BackendOutput(error=err, finish_reason=reason))
         self._publish_stats()
 
     def _fail_terminally(self, exc: Exception) -> None:
@@ -830,6 +895,11 @@ class JaxEngine:
         return self.__dict__["_admitter_obj"]
 
     async def _admit_batch(self) -> int:
+        if self._draining:
+            # Draining: the controller sheds the waiting queue with typed
+            # requeue errors; admitting one more prefill would just create
+            # another live stream to hand off.
+            return 0
         return await self._admitter._admit_batch()
 
     async def _finish_admission(self, batch) -> int:
@@ -1537,9 +1607,341 @@ class JaxEngine:
             anchor_parent=anchor_parent,
         )
 
+    # -- live-handoff drain (runtime/drain.py DrainController) -------------
+    #
+    # Threading contract: every method here runs on the event-loop thread.
+    # Slot/pool mutation happens ONLY inside _service_drain_queues, which
+    # the scheduler loop calls behind its drain barrier — so detach and
+    # adoption observe the same fully-reconciled state admission does, and
+    # the position-keyed sampling RNG makes the continuation bit-identical.
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting new work: generate() refuses with a typed
+        migratable error, the admission loop holds, and load reports carry
+        ``draining`` so the router deflects placement immediately."""
+        if not self._draining:
+            self._draining = True
+            self.flight.record("drain_begin")
+            self._publish_stats()
+            self._wake.set()
+
+    def end_drain(self) -> None:
+        """Abort a drain and return to serving (operator rollback)."""
+        if self._draining:
+            self._draining = False
+            self.flight.record("drain_end")
+            self._publish_stats()
+            self._wake.set()
+
+    def active_request_ids(self) -> List[str]:
+        return [
+            s.request.request_id for s in self._slots if s is not None
+        ]
+
+    def has_waiting(self) -> bool:
+        return bool(self._waiting)
+
+    def shed_waiting_for_drain(self, exc_factory) -> int:
+        """Fail every not-yet-admitted request with a typed migratable
+        error (``exc_factory(request_id) -> BaseException``) — the drain
+        ladder's "typed requeue" rung: nothing was computed, so the
+        frontend re-dispatches the request whole to a serving worker."""
+        n = 0
+        while self._waiting:
+            seq = self._waiting.popleft()
+            self.flight.record(
+                "drain_requeue", request_id=seq.request.request_id
+            )
+            seq.queue.put_nowait(exc_factory(seq.request.request_id))
+            n += 1
+        if n:
+            self._publish_stats()
+        return n
+
+    async def detach_for_handoff(self, request_id: str) -> Optional[_Sequence]:
+        """Pull a live sequence out of its slot at the next reconciled
+        burst boundary. Returns None when the stream already finished.
+        The detached sequence keeps its pool blocks (and its output queue —
+        the client is still attached to it); decode for it stops until a
+        peer adopts it or the caller fails it down the ladder."""
+        await self.start()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._detach_requests.append((request_id, fut))
+        self._wake.set()
+        return await fut
+
+    def _service_drain_queues(self) -> None:
+        """Scheduler-loop half of detach/adopt (behind the drain barrier)."""
+        while self._detach_requests:
+            rid, fut = self._detach_requests.popleft()
+            if fut.done():
+                continue
+            seq = next(
+                (
+                    s for s in self._slots
+                    if s is not None and s.request.request_id == rid
+                ),
+                None,
+            )
+            if seq is None:
+                fut.set_result(None)  # finished while the request queued
+                continue
+            slot = seq.slot
+            seq.detach_pos = int(self._pos[slot])
+            self._slots[slot] = None
+            self._pos[slot] = 0
+            self._tok_mirror[slot] = 0
+            self._dirty_state.add(slot)
+            seq.slot = -1
+            self.flight.record(
+                "handoff_detach", request_id=rid, pos=seq.detach_pos,
+                blocks=len(seq.block_ids),
+            )
+            fut.set_result(seq)
+        while self._adoptions:
+            slot = self._free_slot()
+            if slot is None:
+                break  # at capacity; retry once a finish frees a slot
+            self._install_adopted(self._adoptions.popleft(), slot)
+        self._publish_stats()
+
+    async def export_detached(self, seq: _Sequence):
+        """Gather a detached sequence's resident KV in pool-native wire
+        form. Returns (HandoffTicket, KvWireBlocks): every committed block
+        plus the partial tail rows covering ``detach_pos`` — the peer
+        resumes with ZERO re-prefilled tokens."""
+        from dynamo_tpu.disagg.handoff import HandoffTicket
+
+        args = self.args
+        pos = seq.detach_pos
+        n_blocks = -(-pos // args.block_size)  # ceil; pos >= 1 always
+        ids = seq.block_ids[:n_blocks]
+        committed = seq.block_hashes[: min(len(seq.block_hashes), n_blocks)]
+        # Chaos seam: the draining worker failing to read its own pool —
+        # the ladder must absorb this as a re-prefill fallback.
+        fault_point(fault_names.DRAIN_HANDOFF_EXPORT)
+        handles = await self._device(
+            self.runner.gather_blocks_wire_dispatch, ids
+        )
+        wire = await asyncio.get_running_loop().run_in_executor(
+            self._transfer_executor,
+            self.runner.gather_blocks_wire_readback, handles,
+        )
+        self.handoffs_exported += 1
+        self.flight.record(
+            "handoff_export", request_id=seq.request.request_id,
+            blocks=len(ids), bytes=int(wire.nbytes), dtype=wire.dtype,
+        )
+        cfg = self.config
+        ticket = HandoffTicket(
+            request=seq.request.to_dict(),
+            generated=list(seq.generated),
+            salt=seq.salt,
+            hash_salt=seq.hash_salt,
+            pos=pos,
+            committed_hashes=list(committed),
+            n_blocks=n_blocks,
+            model=cfg.name,
+            block_size=args.block_size,
+            n_layers=cfg.n_layers,
+            n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim_,
+            seed=args.seed,
+        )
+        return ticket, wire
+
+    def release_detached(self, seq: _Sequence) -> None:
+        """Free a detached sequence's pool blocks (after the peer accepted
+        the handoff, or before failing it down the ladder)."""
+        self.pool.release(seq.block_ids, seq.block_hashes)
+        seq.block_ids = []
+        seq.block_hashes = []
+
+    def fail_detached(self, seq: _Sequence, exc: BaseException) -> None:
+        """Surface ``exc`` through the sequence's output stream (the
+        serving handler raises it; a migratable type makes the frontend
+        re-dispatch with the already-streamed tokens carried — the PR 7
+        re-prefill rung of the drain ladder)."""
+        if seq.block_ids:
+            self.release_detached(seq)
+        seq.queue.put_nowait(exc)
+
+    async def adopt_handoff(self, ticket, wire, context: Context) -> _Sequence:
+        """Peer side: install a HandoffTicket's blocks and queue the
+        sequence for slot installation at the scheduler's next reconciled
+        boundary. Raises HandoffRefused when this engine cannot take it
+        (capacity, pool pressure, draining itself)."""
+        from dynamo_tpu.disagg.handoff import HandoffRefused
+
+        await self.start()
+        if self._draining:
+            raise HandoffRefused("peer is itself draining")
+        if self._failure is not None:
+            raise HandoffRefused(f"peer engine failed: {self._failure}")
+        live = sum(1 for s in self._slots if s is not None)
+        earmarked = len(self._adoptions) + self._admitting
+        if live + earmarked >= self.args.max_num_seqs:
+            raise HandoffRefused(
+                f"no free slot ({live} live + {len(self._adoptions)} "
+                f"pending adoptions + {self._admitting} admitting of "
+                f"{self.args.max_num_seqs})"
+            )
+        # Chaos seam: the receiving worker dying mid-adoption — the source
+        # absorbs it by trying the next peer or falling down the ladder.
+        fault_point(fault_names.DRAIN_HANDOFF_IMPORT)
+        committed = list(ticket.committed_hashes)
+        n_committed = len(committed)
+        if n_committed:
+            # Shared-cache rows install through the proven disagg path
+            # (pin/scatter/commit/rollback in ONE place), then pin for the
+            # adopted sequence exactly like prefix-cached admission.
+            await self.import_blocks_wire_async(
+                committed, wire.take(list(range(n_committed)))
+            )
+        matched, ids = (
+            self.pool.pin_prefix(committed) if committed else (0, [])
+        )
+        tail_ids: List[int] = []
+        try:
+            if matched < n_committed:
+                raise HandoffRefused(
+                    f"pool pressure: only {matched}/{n_committed} committed "
+                    "blocks resident after import"
+                )
+            tail_rows = list(range(n_committed, ticket.n_blocks))
+            for _ in tail_rows:
+                b = self.pool.alloc()
+                if b is None:
+                    raise HandoffRefused("pool dry for private tail blocks")
+                tail_ids.append(b)
+            if tail_ids:
+                await self._device(
+                    self.runner.scatter_blocks_wire, tail_ids,
+                    wire.take(tail_rows),
+                )
+        except Exception:
+            self.pool.release(ids + tail_ids, committed[:matched])
+            raise
+        req = PreprocessedRequest.from_dict(dict(ticket.request))
+        prompt = list(req.token_ids)
+        seq = _Sequence(
+            request=req,
+            context=context,
+            queue=asyncio.Queue(),
+            prompt=prompt,
+            all_tokens=prompt + list(ticket.generated),
+            generated=list(ticket.generated),
+            # RNG continuity: the ORIGINAL arrival salt, not a fresh one —
+            # fold_in(seed, salt, pos) then draws the identical noise the
+            # source would have drawn for every remaining token.
+            salt=int(ticket.salt),
+            hash_salt=int(ticket.hash_salt),
+            detach_pos=int(ticket.pos),
+        )
+        seq.block_ids = ids + tail_ids
+        seq.block_hashes = committed[:matched]
+        self._adoptions.append(seq)
+        self._wake.set()
+        self.handoffs_adopted += 1
+        self.flight.record(
+            "handoff_adopt", request_id=req.request_id, pos=seq.detach_pos,
+            blocks=len(seq.block_ids), carried=len(seq.generated),
+        )
+        return seq
+
+    def _set_slot_state(
+        self, seq: _Sequence, slot: int, *, pos: int, block_ids: Any,
+        sp: Tuple[float, int, float], adapter_id: int, procs: Any,
+        tok_mirror: int,
+    ) -> None:
+        """Every per-slot field the device-resident decode state reads,
+        set for a new occupant. ONE implementation shared by
+        Admitter._install (fresh admission) and _install_adopted (live
+        handoff) — the two MUST stay field-for-field identical, or an
+        adopted sequence samples with stale state from the slot's
+        previous occupant and the bit-identical-continuation guarantee
+        breaks.
+
+        Mutates every field the device-resident decode state reads —
+        reconcile at the next dispatch (_dirty_state/_dirty_tables).
+        Installs only ever happen behind the scheduler's drain barrier,
+        so no in-flight burst can be holding this slot stale-active.
+        """
+        seq.slot = slot
+        self._slots[slot] = seq
+        self._pos[slot] = pos
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, : len(block_ids)] = block_ids
+        self._temp[slot], self._topk[slot], self._topp[slot] = sp
+        self._adapter_ids[slot] = adapter_id
+        self._salts[slot] = seq.salt
+        self._tok_mirror[slot] = int(tok_mirror)
+        self._dirty_state.add(slot)
+        self._dirty_tables.add(slot)
+        # Logits-processor slot state: neutral unless this occupant asks —
+        # stale device bookkeeping from a previous occupant is harmless
+        # under neutral params (identity transform).
+        self._uses_procs[slot] = procs is not None
+        if procs is None:
+            self._minp[slot] = 0.0
+            self._rep[slot] = 1.0
+            self._pres[slot] = 0.0
+            self._freq[slot] = 0.0
+            self._bias_ids[slot, :] = -1
+            self._bias_vals[slot, :] = 0.0
+        else:
+            self._minp[slot] = procs.minp
+            self._rep[slot] = procs.rep
+            self._pres[slot] = procs.pres
+            self._freq[slot] = procs.freq
+            self._bias_ids[slot] = procs.bias_ids
+            self._bias_vals[slot] = procs.bias_vals
+            # Exact penalty state: original prompt only in the mask;
+            # generated tokens restore the output counts (re-admitted
+            # preemption and adopted handoff both carry them).
+            self.runner.proc_reset_slot(
+                slot, seq.request.token_ids, seq.generated
+            )
+
+    def _install_adopted(self, seq: _Sequence, slot: int) -> None:
+        """Slot installation for an adopted sequence — Admitter._install
+        minus prefill and minus the first-token emit (everything up to the
+        handoff point already reached the client through the source)."""
+        req = seq.request
+        self._set_slot_state(
+            seq, slot, pos=seq.detach_pos, block_ids=seq.block_ids,
+            sp=self._sampling_of(req),
+            adapter_id=self._lora_index.get(req.lora_name or "", 0),
+            procs=self._procs_of(req),
+            # seq.generated already holds the handoff token: the source
+            # counted it at emit, proc_reset_slot restores that count.
+            tok_mirror=seq.all_tokens[-1],
+        )
+        seq.next_token = seq.all_tokens[-1]
+        self.flight.record(
+            "handoff_install", request_id=req.request_id, slot=slot,
+            pos=seq.detach_pos,
+        )
+
+    def stream_adopted(
+        self, seq: _Sequence
+    ) -> AsyncIterator[BackendOutput]:
+        """Continuation outputs of an adopted sequence (handoff handler)."""
+        return self._stream_outputs(seq)
+
     # -- checkpoint / restore (the chrek/CRIU fast-cold-start role) --------
     # Logic lives in engines/tpu/kv_checkpoint.py; these stay as the
     # engine's public surface (system server + worker shutdown use them).
+
+    def record_ckpt_corruption(self, detail: str) -> None:
+        """Flight-ring note for a CRC-failed checkpoint restore (called by
+        kv_checkpoint.py; the append lives here so the engine stays the
+        ring's single writer)."""
+        self.flight.record("ckpt_corrupt", detail=detail)
 
     async def save_checkpoint(self, ckpt_dir: str) -> Dict[str, Any]:
         from dynamo_tpu.engines.tpu import kv_checkpoint

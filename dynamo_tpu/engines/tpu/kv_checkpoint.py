@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import uuid
+import zipfile
 from typing import Any, Dict, List
 
 import numpy as np
@@ -24,6 +25,10 @@ import numpy as np
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+class _CorruptCheckpoint(Exception):
+    """CRC mismatch in a checkpoint data file (internal control flow)."""
 
 
 def read_manifest(ckpt_dir: str):
@@ -47,9 +52,17 @@ async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
         # pointing at the OLD data — never a mismatched pair (same
         # atomic-publish rule as models/weight_cache.py save_params).
         data_name = f"kv_blocks-{uuid.uuid4().hex[:12]}.npz" if ids else ""
+        crc = {}
         if ids:
             def gather_and_write():
+                from dynamo_tpu.kvbm.integrity import array_crc32
+
                 k, v = engine.runner.gather_blocks(ids)
+                # Per-array CRC32 stamped into the manifest: a restore
+                # verifies before installing, so a corrupt/truncated data
+                # file is a counted miss, never silently-garbage KV.
+                crc["k"] = array_crc32(k)
+                crc["v"] = array_crc32(v)
                 # Disk write stays off the event loop (multi-GB stall).
                 np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)
 
@@ -62,6 +75,7 @@ async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
             "n_kv_heads": engine.config.n_kv_heads,
             "head_dim": engine.config.head_dim_,
             "data": data_name,
+            "crc": crc,
             "blocks": [{"hash": h, "parent": p} for h, p, _ in snap],
         }
         tmp = os.path.join(ckpt_dir, f".manifest-{uuid.uuid4().hex[:8]}")
@@ -103,12 +117,48 @@ async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
     if not blocks:
         return 0
     data_name = manifest.get("data") or "kv_blocks.npz"
+    want_crc = manifest.get("crc") or {}
 
     def read():  # disk read off the event loop
-        data = np.load(os.path.join(ckpt_dir, data_name))
-        return data["k"], data["v"]
+        from dynamo_tpu.kvbm.integrity import array_crc32
 
-    k_all, v_all = await engine._device(read)
+        data = np.load(os.path.join(ckpt_dir, data_name))
+        k, v = data["k"], data["v"]
+        # Verify BEFORE anything lands in the pool. Manifests written
+        # before the CRC stamp (no "crc" field) restore unverified.
+        for name, arr in (("k", k), ("v", v)):
+            want = want_crc.get(name)
+            if want is None:
+                continue
+            got = array_crc32(arr)
+            if got != int(want):
+                raise _CorruptCheckpoint(
+                    f"{data_name}:{name} CRC mismatch "
+                    f"(manifest {want}, file {got})"
+                )
+        return k, v
+
+    try:
+        k_all, v_all = await engine._device(read)
+    except (
+        _CorruptCheckpoint, OSError, ValueError, KeyError,
+        zipfile.BadZipFile,
+    ) as exc:
+        # Corrupt or truncated data file: a counted miss — the worker
+        # starts cold instead of crashing (or worse, attending over
+        # garbage KV). A truncated npz raises BadZipFile (a plain
+        # Exception, NOT an OSError); OSError/ValueError cover the rest.
+        from dynamo_tpu.kvbm.integrity import note_corruption
+
+        note_corruption("checkpoint")
+        note_fn = getattr(engine, "record_ckpt_corruption", None)
+        if note_fn is not None:
+            note_fn(f"{type(exc).__name__}: {exc}")
+        logger.warning(
+            "KV checkpoint %s failed integrity/read (%s); restoring "
+            "nothing — next requests prefill cold", ckpt_dir, exc,
+        )
+        return 0
     index_of = {b["hash"]: i for i, b in enumerate(blocks)}
 
     # Parents-first install order (chains form a forest).

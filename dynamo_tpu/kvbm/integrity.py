@@ -1,0 +1,61 @@
+"""Persisted-KV integrity: CRC32 of every array, corruption = counted miss.
+
+Two surfaces persist KV across process lifetimes — the warm-cache
+checkpoint (engines/tpu/kv_checkpoint.py, the chrek/CRIU role) and the
+KVBM disk tier's per-block npz spills (kvbm/tiers.py G3). Both now stamp a
+CRC32 per array at write time and verify at read time: a corrupt or
+truncated file becomes a COUNTED miss (the lint-pinned
+``dynamo_tpu_kvbm_restore_corruption_total{source}`` counter plus a flight
+event at the owning ring), never a crash and never silently-garbage KV
+attending into live sequences.
+
+The counter is process-global (one registry, one series per source) so the
+checkpoint path — which runs with or without a TieredKvManager — and every
+tier instance share it; ``attach_engine`` registers the render on the
+system server.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict
+
+import numpy as np
+
+from dynamo_tpu.runtime import metric_names as mn
+from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+_REGISTRY = MetricsRegistry()
+RESTORE_CORRUPTION = _REGISTRY.counter(
+    mn.KVBM_RESTORE_CORRUPTION_TOTAL,
+    "Persisted KV (checkpoint arrays, disk-tier npz spills) whose CRC32 "
+    "failed on restore — counted as a miss, never installed",
+    ["source"],
+)
+
+
+def array_crc32(a: np.ndarray) -> int:
+    """CRC32 over an array's raw bytes (dtype-agnostic: bf16 and friends
+    hash through a uint8 view of their own buffer)."""
+    arr = np.ascontiguousarray(a)
+    # No .tobytes(): the uint8 view feeds zlib through the buffer
+    # protocol in place — a copy would double peak RSS for the multi-GB
+    # checkpoint arrays at exactly the moment (drain/shutdown) memory
+    # pressure is highest.
+    return zlib.crc32(arr.view(np.uint8).reshape(-1)) & 0xFFFFFFFF
+
+
+def note_corruption(source: str, n: int = 1) -> None:
+    RESTORE_CORRUPTION.inc(n, source=source)
+
+
+def corruption_counts() -> Dict[str, int]:
+    """source → corruption count (bench/tests; scrape-free)."""
+    return {
+        str(key[0]): int(value)
+        for key, value in RESTORE_CORRUPTION._values.items()
+    }
+
+
+def render_integrity_metrics(openmetrics: bool = False) -> str:
+    return _REGISTRY.render(openmetrics=openmetrics)

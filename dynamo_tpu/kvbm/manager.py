@@ -141,11 +141,23 @@ class TieredKvManager:
         self.remote = remote
         self.filter = filter or OffloadFilter()
         self.metrics = metrics or KvbmMetrics()
+        # Tier integrity events for /debug/flight (DYN005 owner "kvbm";
+        # single writer: the manager's event loop — tier reads only ever
+        # happen on it, from onboard and the offload spill path).
+        from dynamo_tpu.runtime.device_observe import FlightRecorder
+
+        self.flight = FlightRecorder("kvbm", capacity=128)
         self.metrics.watch_tier(getattr(top_tier, "name", "host"), top_tier)
         if top_tier.next_tier is not None:
             self.metrics.watch_tier(
                 getattr(top_tier.next_tier, "name", "disk"), top_tier.next_tier
             )
+            if hasattr(top_tier.next_tier, "on_corruption"):
+                tier_name = getattr(top_tier.next_tier, "name", "disk")
+                top_tier.next_tier.on_corruption = (
+                    lambda block_hash, detail, _t=tier_name:
+                    self._note_tier_corruption(_t, block_hash, detail)
+                )
         if remote is not None:
             self.metrics.watch_tier("remote", remote)
         # hash → chain depth, queued for offload
@@ -163,6 +175,14 @@ class TieredKvManager:
         engine.kvbm = self (see engines/tpu/engine.py)."""
         self._engine = engine
         engine.kvbm = self
+
+    def _note_tier_corruption(
+        self, tier: str, block_hash: int, detail: str
+    ) -> None:
+        self.flight.record(
+            "tier_corrupt", tier=tier, block=f"{block_hash:016x}",
+            detail=detail,
+        )
 
     def notify_commit(self, block_hash: int, chain_depth: int) -> None:
         if self.filter.admit(chain_depth, block_hash) and not self.tier.contains(block_hash):
@@ -287,6 +307,7 @@ class TieredKvManager:
     def register_metrics(self, server: Any) -> None:
         """Expose this manager's metric families on a SystemStatusServer."""
         server.register_metrics(self.metrics.render)
+        server.register_flight(self.flight.name, self.flight.snapshot)
 
     def stats(self) -> Dict[str, Any]:
         out = {

@@ -17,15 +17,18 @@ disagg/wire.py::dense_tier_block.
 
 from __future__ import annotations
 
+import io
 import os
+import zipfile
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu.kvbm.integrity import array_crc32, note_corruption
 from dynamo_tpu.runtime import fault_names
-from dynamo_tpu.runtime.faults import fault_point
+from dynamo_tpu.runtime.faults import fault_payload, fault_point
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -40,10 +43,13 @@ class TierStats:
     misses: int = 0
     stored: int = 0
     evicted: int = 0
+    # CRC-failed / unreadable persisted blocks, each ALSO counted a miss.
+    corrupt: int = 0
 
     def to_dict(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
-                "stored": self.stored, "evicted": self.evicted}
+                "stored": self.stored, "evicted": self.evicted,
+                "corrupt": self.corrupt}
 
 
 class HostTier:
@@ -153,7 +159,13 @@ def _npz_safe(a: np.ndarray) -> np.ndarray:
 
 
 class DiskTier:
-    """G3: one .npz file per block under a spool directory, LRU-bounded."""
+    """G3: one .npz file per block under a spool directory, LRU-bounded.
+
+    Every array in a spill carries a CRC32 (``crc_*`` fields) verified on
+    read: a corrupt or truncated file is a COUNTED miss (TierStats.corrupt
+    + dynamo_tpu_kvbm_restore_corruption_total{source="disk"} + the
+    manager's flight ring via ``on_corruption``) and the entry is dropped
+    — never a crash, never garbage KV onboarded into the pool."""
 
     name = "disk"
 
@@ -163,6 +175,9 @@ class DiskTier:
         os.makedirs(root, exist_ok=True)
         self._lru: "OrderedDict[int, str]" = OrderedDict()
         self.stats = TierStats()
+        # (block_hash, detail) -> None; TieredKvManager wires this to its
+        # flight ring so corruption shows up in /debug/flight.
+        self.on_corruption: Optional[Callable[[int, str], None]] = None
         # Recover existing spool contents (checkpoint/resume of the cache).
         for fname in sorted(os.listdir(root)):
             if fname.endswith(".npz"):
@@ -181,8 +196,10 @@ class DiskTier:
         return block_hash in self._lru
 
     def put(self, block_hash: int, *arrays: np.ndarray) -> None:
-        fault_point(fault_names.KVBM_TIER_WRITE, tier=self.name)
         if block_hash in self._lru:
+            # Duplicate spill: still ONE seam hit per put (stable chaos
+            # schedules), but there is no payload to corrupt.
+            fault_point(fault_names.KVBM_TIER_WRITE, tier=self.name)
             self._lru.move_to_end(block_hash)
             return
         path = self._path(block_hash)
@@ -191,13 +208,29 @@ class DiskTier:
             "k": _npz_safe(blk[0]),
             "v": _npz_safe(blk[1]),
             "dtype": str(blk[0].dtype),
+            # Per-array CRC32 of the stored (npz-safe) form; verified on
+            # every read before the block can onboard.
+            "crc_k": np.uint32(array_crc32(_npz_safe(blk[0]))),
+            "crc_v": np.uint32(array_crc32(_npz_safe(blk[1]))),
         }
         if len(blk) == 4:
             # Quantized wire form: int8 payloads + f32 scales, stored as-is
             # (half the dense spool footprint).
             fields["k_scale"] = blk[2]
             fields["v_scale"] = blk[3]
-        np.savez(path, **fields)
+            fields["crc_k_scale"] = np.uint32(array_crc32(blk[2]))
+            fields["crc_v_scale"] = np.uint32(array_crc32(blk[3]))
+        # Serialize to memory first: the chaos seam can then corrupt the
+        # SERIALIZED bytes (kind="corrupt" — modeling silent disk/page
+        # damage) or raise (connection/timeout/error kinds), exactly one
+        # hit per put either way.
+        buf = io.BytesIO()
+        np.savez(buf, **fields)
+        raw = fault_payload(
+            fault_names.KVBM_TIER_WRITE, buf.getvalue(), tier=self.name
+        )
+        with open(path, "wb") as f:
+            f.write(raw)
         self._lru[block_hash] = path
         self.stats.stored += 1
         while len(self._lru) > self.capacity:
@@ -208,16 +241,64 @@ class DiskTier:
             except FileNotFoundError:
                 pass
 
+    def _note_corruption(self, block_hash: int, path: str, detail: str) -> None:
+        """Corruption is a counted miss: metric + stats + manager flight
+        event, entry dropped, file removed (it can never verify again)."""
+        self.stats.corrupt += 1
+        note_corruption(self.name)
+        logger.warning(
+            "disk-tier block %016x failed integrity (%s); dropping %s",
+            block_hash, detail, path,
+        )
+        if self.on_corruption is not None:
+            self.on_corruption(block_hash, detail)
+        self._lru.pop(block_hash, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
     def get(self, block_hash: int) -> Optional[Block]:
-        fault_point(fault_names.KVBM_TIER_READ, tier=self.name)
         path = self._lru.get(block_hash)
         if path is None:
+            fault_point(fault_names.KVBM_TIER_READ, tier=self.name)
             self.stats.misses += 1
             return None
         try:
-            with np.load(path, allow_pickle=False) as z:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            # Vanished/unreadable file: plain miss (the pre-CRC contract);
+            # a transient IO error must not burn the entry as corrupt.
+            # Still one seam hit per get — otherwise every later hit
+            # number shifts and a chaos schedule pinned with at=(n,)
+            # fires on the wrong call.
+            fault_point(fault_names.KVBM_TIER_READ, tier=self.name)
+            self._lru.pop(block_hash, None)
+            self.stats.misses += 1
+            return None
+        # Chaos seam (one hit per get, same as the miss path): raising
+        # kinds model IO failure and PROPAGATE to the onboard caller, as
+        # before; kind="corrupt" flips a bit of the bytes just read —
+        # which the CRC check below must catch.
+        raw = fault_payload(fault_names.KVBM_TIER_READ, raw, tier=self.name)
+        try:
+            with np.load(io.BytesIO(raw), allow_pickle=False) as z:
                 dtype = str(z["dtype"])
                 k, v = z["k"], z["v"]
+                for field, arr in (
+                    ("crc_k", k), ("crc_v", v),
+                    ("crc_k_scale", z["k_scale"] if "k_scale" in z.files else None),
+                    ("crc_v_scale", z["v_scale"] if "v_scale" in z.files else None),
+                ):
+                    # Pre-CRC spills (no crc_* fields) read unverified.
+                    if arr is not None and field in z.files:
+                        if array_crc32(arr) != int(z[field]):
+                            self._note_corruption(
+                                block_hash, path, f"{field} mismatch"
+                            )
+                            self.stats.misses += 1
+                            return None
                 if "bfloat16" in dtype:
                     import ml_dtypes
 
@@ -227,8 +308,12 @@ class DiskTier:
                     blk: Block = (k, v, z["k_scale"], z["v_scale"])
                 else:
                     blk = (k, v)
-        except (FileNotFoundError, OSError, KeyError):
-            self._lru.pop(block_hash, None)
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile) as exc:
+            # Truncated/garbled npz: same counted-miss contract as a CRC
+            # mismatch (np.load surfaces these shapes for partial writes).
+            self._note_corruption(
+                block_hash, path, f"{type(exc).__name__}: {exc}"
+            )
             self.stats.misses += 1
             return None
         self._lru.move_to_end(block_hash)

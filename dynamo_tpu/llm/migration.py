@@ -66,6 +66,14 @@ except ImportError:  # pragma: no cover
         pass
 
 
+try:
+    from dynamo_tpu.runtime.drain import WorkerDrainingError
+except ImportError:  # pragma: no cover
+
+    class WorkerDrainingError(ConnectionError):  # type: ignore[no-redef]
+        pass
+
+
 # NOTE: asyncio.TimeoutError is a DISTINCT class from builtin TimeoutError
 # until Python 3.11 — both must be listed. DisaggTransferError subclasses
 # ConnectionError (already migratable); it is named for reason labeling.
@@ -85,6 +93,10 @@ DEFAULT_REPREFILL_CAP = int(
 
 def _failure_reason(exc: BaseException) -> str:
     """Metric label for what killed the stream."""
+    if isinstance(exc, WorkerDrainingError):
+        # Planned churn (rolling restart / scale-down), not a fault: the
+        # worker refused or handed back the stream while draining.
+        return "drain"
     if isinstance(exc, DisaggTransferError):
         return "disagg"
     if isinstance(exc, NoInstancesError):

@@ -91,6 +91,10 @@ class LoadSnapshot:
     # open — the router prices those (src, this worker) pairs out of
     # disagg decode placement until the breaker's half-open window.
     link_faults: Optional[List[int]] = None
+    # Live-handoff drain (runtime/drain.py): True while the worker is
+    # draining — it refuses new work with a typed migratable error, so the
+    # scheduler must stop placing anything here immediately.
+    draining: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

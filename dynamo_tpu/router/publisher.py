@@ -213,6 +213,10 @@ class LoadPublisher:
             ),
             link_bandwidth=link_bw or None,
             link_faults=list(link_faults) if link_faults else None,
+            # Drain bit: the engine's stats carry it (JaxEngine sets
+            # ``draining`` the moment begin_drain runs; the controller
+            # also force-publishes so routers see it within one RTT).
+            draining=bool(s.get("draining", 0)),
         )
 
     async def publish_once(self) -> None:

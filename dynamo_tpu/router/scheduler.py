@@ -194,6 +194,12 @@ class WorkerState:
     def queue_depth(self) -> int:
         return self.snapshot.queue_depth if self.snapshot else 0
 
+    def draining(self) -> bool:
+        """The worker advertised a live-handoff drain: it refuses every
+        new admission with a typed migratable error, so placing work
+        there just costs the stream a bounce."""
+        return bool(self.snapshot is not None and self.snapshot.draining)
+
     def saturated(self) -> bool:
         """At/above the worker's advertised admission high watermark:
         the engine will HOLD new admissions (backpressure) rather than
@@ -278,6 +284,17 @@ class KvScheduler:
             return None
         for w in pool:
             self.add_worker(w)
+
+        # Drain deflection FIRST (stronger than busy gating): a draining
+        # worker refuses new work outright. When every candidate is
+        # draining (full-fleet rolling restart mid-wave), the least-loaded
+        # still wins below — the typed refusal + frontend migration is the
+        # backstop, not a silent placement failure.
+        not_draining = [
+            w for w in pool if not self._workers[w].draining()
+        ]
+        if not_draining:
+            pool = not_draining
 
         not_busy = [
             w for w in pool if self._workers[w].kv_usage() < cfg.busy_kv_usage

@@ -15,6 +15,7 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime, LocalRequestPlane
 from dynamo_tpu.runtime.engine import AsyncEngine, as_engine, collect
 from dynamo_tpu.runtime.metric_names import (
     ALL_DISAGG,
+    ALL_DRAIN,
     ALL_ENGINE,
     ALL_FAULTS,
     ALL_FRONTEND,
@@ -35,6 +36,7 @@ from dynamo_tpu.runtime.tasks import TaskTracker
 
 __all__ = [
     "ALL_DISAGG",
+    "ALL_DRAIN",
     "ALL_ENGINE",
     "ALL_FAULTS",
     "ALL_FRONTEND",

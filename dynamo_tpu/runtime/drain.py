@@ -1,0 +1,691 @@
+"""Drain plane: zero-re-prefill live handoff + coordinated rolling restarts.
+
+Reference parity: the reference Dynamo treats planned worker churn (rolling
+upgrades, spot preemption, planner scale-down) as a first-class serving
+event — request migration plus CRIU checkpointing keep streams alive across
+restarts (docs/fault_tolerance/). The TPU-native equivalent is this state
+machine:
+
+    serving ──trigger──▶ draining ──streams resolved──▶ drained
+
+Triggers: SIGTERM (worker/__main__.py loop signal handler), ``POST /drain``
+on the system server, or the k8s preStop hook (deploy/pod_connector.py).
+Draining does, in order:
+
+  1. **Stop new placement.** ``engine.begin_drain()`` flips the
+     ``LoadSnapshot.draining`` bit (force-published immediately) so
+     ``KvScheduler`` deflects placement; racing arrivals bounce with a
+     typed :class:`WorkerDrainingError` (migratable — the frontend
+     re-dispatches).
+  2. **Live-hand-off every in-flight decode** to a peer chosen via the PR 6
+     ``LinkCostModel`` (fastest measured link first; unmeasured peers quote
+     the optimistic seed): a ``HandoffTicket`` + the sequence's KV blocks
+     ride the wire-v2 int8 path, the peer installs them VERBATIM and
+     resumes at the exact token — zero re-prefilled tokens, bit-identical
+     continuation (the ticket carries the PR 3 sampling salt). The source
+     then relays the peer's continuation to the still-attached client.
+  3. **Fall down a ladder** when a peer refuses or the transfer fails:
+     handoff → PR 7 re-prefill migration (a migratable error surfaces
+     through the stream; the frontend re-dispatches with the streamed
+     tokens carried) → typed requeue (never-admitted requests re-dispatch
+     whole). Every rung is counted (``dynamo_tpu_drain_streams_total``).
+  4. **Checkpoint the warm prefix cache** (engines/tpu/kv_checkpoint.py)
+     so the restarted worker serves shared-prefix traffic without
+     re-prefilling, then report drained (the worker main releases its
+     lease/endpoints and exits).
+
+Everything is bounded by a drain deadline (DYN_TPU_DRAIN_DEADLINE_S —
+the k8s terminationGracePeriod's budget): at expiry, unresolved handoffs
+and relays are cut down to the re-prefill rung, which is always safe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# State machine values (also the dynamo_tpu_drain_state gauge).
+SERVING, DRAINING, DRAINED = 0, 1, 2
+_STATE_NAMES = {SERVING: "serving", DRAINING: "draining", DRAINED: "drained"}
+
+
+class WorkerDrainingError(ConnectionError):
+    """Typed migratable refusal/fallback: the worker is draining (or a
+    handoff failed mid-drain) and the frontend should re-dispatch the
+    request — with its streamed tokens carried — to a serving worker.
+    Subclasses ConnectionError so the PR 7 MIGRATABLE set already covers
+    it; llm/migration.py labels the reason ``drain``."""
+
+
+class DrainMetrics:
+    """Canonical drain families (runtime/metric_names.py ALL_DRAIN)."""
+
+    def __init__(self) -> None:
+        # Deferred imports: keep this module cheap to import from the
+        # network planes (tcp err-kind mapping) — same pattern as
+        # runtime/faults.py FaultPlane.
+        from dynamo_tpu.runtime import metric_names as mn
+        from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.state = self.registry.gauge(
+            mn.DRAIN_STATE,
+            "Drain state machine: 0 serving, 1 draining, 2 drained",
+        )
+        self.drains = self.registry.counter(
+            mn.DRAIN_DRAINS_TOTAL, "Completed drains"
+        )
+        self.streams = self.registry.counter(
+            mn.DRAIN_STREAMS_TOTAL,
+            "In-flight streams resolved by draining, by ladder rung: "
+            "handoff (live KV moved, zero re-prefill) | reprefill "
+            "(migratable error; the frontend re-prefills elsewhere) | "
+            "requeue (never admitted; re-dispatched whole)",
+            ["outcome"],
+        )
+        self.handoff_bytes = self.registry.counter(
+            mn.DRAIN_HANDOFF_BYTES_TOTAL,
+            "Serialized wire bytes of exported handoff KV (payload + "
+            "scales, pool-native dtype)",
+        )
+        self.peer_refusals = self.registry.counter(
+            mn.DRAIN_PEER_REFUSALS_TOTAL,
+            "Peer adoptions refused (capacity, shape/seed mismatch, peer "
+            "draining) — each walks the source down the peer list/ladder",
+        )
+        self.duration = self.registry.histogram(
+            mn.DRAIN_DURATION, "Wall time of one full drain"
+        )
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
+
+
+class DrainController:
+    """Orchestrates one worker's drain. Lives on the worker's event loop;
+    every engine interaction rides the engine's own drain-safe surface
+    (detach/export/adopt happen at the scheduler's reconciled boundary).
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        worker_id: Optional[int] = None,
+        handoff_client_factory: Optional[Callable[[], Any]] = None,
+        load_publisher: Optional[Any] = None,
+        checkpoint_dir: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        on_drained: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        from dynamo_tpu import config
+        from dynamo_tpu.runtime.device_observe import FlightRecorder
+
+        self.engine = engine
+        self.worker_id = worker_id
+        # async () -> Client for the component's "handoff" endpoint; None
+        # (prefill workers, tests) skips the handoff rung entirely.
+        self._handoff_client_factory = handoff_client_factory
+        self._load_publisher = load_publisher
+        self.checkpoint_dir = checkpoint_dir
+        self.deadline_s = (
+            deadline_s if deadline_s is not None
+            else config.DRAIN_DEADLINE_S.get()
+        )
+        self._on_drained = on_drained
+        self._clock = clock
+        self.state = SERVING
+        self.metrics = DrainMetrics()
+        self.metrics.state.set(SERVING)
+        # Drain history for post-mortems (DYN005 owner "drain"; single
+        # writer: the worker's event loop).
+        self.flight = FlightRecorder("drain", capacity=256)
+        # Peer choice: per-(this worker, peer) transfer bandwidth EWMA —
+        # the PR 6 LinkCostModel, seeded optimistic and fed by the
+        # handoffs themselves (accept-ack latency over wire bytes).
+        from dynamo_tpu.router.scheduler import LinkCostModel
+
+        self.link_costs = LinkCostModel()
+        self._drain_task: Optional[asyncio.Task] = None
+        self._relays: set = set()
+        # Ship phase (peer ranking + accept-ack round trips) runs as
+        # bounded-concurrency tasks: detach/export serialize at the
+        # engine's reconciled boundary, but a full worker's worth of peer
+        # RTTs done strictly one-by-one would blow the deadline on a slow
+        # link and cut every late stream down to the re-prefill rung.
+        self.ship_concurrency = max(1, config.DRAIN_HANDOFF_CONCURRENCY.get())
+        self._ships: set = set()
+        self._ship_sem: Optional[asyncio.Semaphore] = None
+        self._started_at: Optional[float] = None
+        self._finished_at: Optional[float] = None
+        self.checkpointed = False
+        # Host-side mirrors (bench/tests read these without a scrape).
+        self.handoffs = 0
+        self.reprefill_fallbacks = 0
+        self.requeued = 0
+        self.peer_refusals = 0
+        self.handoff_bytes = 0
+
+    # -- surface -----------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        out = {
+            "state": _STATE_NAMES[self.state],
+            "deadline_s": self.deadline_s,
+            "handoffs": self.handoffs,
+            "reprefill_fallbacks": self.reprefill_fallbacks,
+            "requeued": self.requeued,
+            "peer_refusals": self.peer_refusals,
+            "handoff_bytes": self.handoff_bytes,
+            "checkpointed": self.checkpointed,
+            "live_relays": len(self._relays),
+        }
+        if self._started_at is not None:
+            end = self._finished_at or self._clock()
+            out["duration_s"] = round(end - self._started_at, 3)
+        return out
+
+    def register_metrics(self, server: Any) -> None:
+        server.register_metrics(self.metrics.render)
+        server.register_flight(self.flight.name, self.flight.snapshot)
+
+    def trigger(self, deadline_s: Optional[float] = None) -> "asyncio.Task":
+        """Start the drain (idempotent — signal handler, POST /drain and
+        preStop may all fire; the first wins) and return its task."""
+        if self._drain_task is None:
+            if deadline_s is not None:
+                self.deadline_s = float(deadline_s)
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._run(), name="drain-controller"
+            )
+        elif deadline_s is not None and float(deadline_s) != self.deadline_s:
+            # _run captured its deadline at start; a silent drop here
+            # would look like a successful extension to the operator.
+            logger.warning(
+                "drain already running with deadline %.1fs; override "
+                "%.1fs ignored", self.deadline_s, float(deadline_s),
+            )
+        return self._drain_task
+
+    async def drain(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:
+        """Trigger (if not already) and await completion. Shielded: one
+        awaiter's cancellation (an aborted HTTP request) must not abort
+        the drain every other trigger is relying on."""
+        task = self.trigger(deadline_s)
+        await asyncio.shield(task)
+        return self.status()
+
+    # -- the drain ---------------------------------------------------------
+
+    def _requeue_exc(self, request_id: str) -> WorkerDrainingError:
+        return WorkerDrainingError(
+            f"worker draining before admission of {request_id}; re-dispatch"
+        )
+
+    async def _run(self) -> None:
+        engine = self.engine
+        self._started_at = t0 = self._clock()
+        deadline = t0 + self.deadline_s
+        self.state = DRAINING
+        self.metrics.state.set(DRAINING)
+        self.flight.record(
+            "drain_start", deadline_s=self.deadline_s,
+            active=len(engine.active_request_ids()),
+        )
+        logger.warning(
+            "drain started (deadline %.1fs, %d active streams)",
+            self.deadline_s, len(engine.active_request_ids()),
+        )
+        engine.begin_drain()
+        if self._load_publisher is not None:
+            # Don't wait for the next report cadence: the router must stop
+            # placing work here NOW.
+            try:
+                await self._load_publisher.publish_once()
+            except Exception:
+                logger.exception("draining load report failed to publish")
+        self._note_requeued(
+            engine.shed_waiting_for_drain(self._requeue_exc)
+        )
+        client = None
+        if self._handoff_client_factory is not None:
+            try:
+                client = await self._handoff_client_factory()
+            except Exception:
+                logger.exception(
+                    "handoff client unavailable; draining without the "
+                    "handoff rung"
+                )
+        try:
+            while self._clock() < deadline:
+                rids = engine.active_request_ids()
+                if not rids and not engine.has_waiting():
+                    break
+                for rid in rids:
+                    if self._clock() >= deadline:
+                        break
+                    await self._handoff_one(client, rid, deadline)
+                # Requests that raced begin_drain into the waiting queue.
+                self._note_requeued(
+                    engine.shed_waiting_for_drain(self._requeue_exc)
+                )
+            # In-flight ship tasks must resolve (relay or fallback)
+            # before the deadline sweep: their seqs are detached and no
+            # longer visible to active_request_ids.
+            await self._await_ships(deadline)
+            # Deadline (or no peers): anything still live falls to the
+            # re-prefill rung — always safe, never a dropped stream.
+            for rid in engine.active_request_ids():
+                try:
+                    seq = await engine.detach_for_handoff(rid)
+                except Exception:
+                    logger.exception(
+                        "deadline detach of %s failed; stream rides the "
+                        "engine shutdown path", rid,
+                    )
+                    continue
+                if seq is not None:
+                    self._fallback(seq, "drain deadline exceeded")
+            self._note_requeued(
+                engine.shed_waiting_for_drain(self._requeue_exc)
+            )
+            await self._await_relays(deadline)
+            await self._checkpoint()
+        finally:
+            # Normally empty here; non-empty means the try body raised —
+            # cancel stragglers (each falls back) before the client dies
+            # under them.
+            if self._ships:
+                for t in list(self._ships):
+                    t.cancel()
+                await asyncio.gather(
+                    *list(self._ships), return_exceptions=True
+                )
+            if client is not None:
+                try:
+                    await client.close()
+                except Exception:
+                    logger.exception("handoff client close failed")
+            self._finished_at = self._clock()
+            self.state = DRAINED
+            self.metrics.state.set(DRAINED)
+            self.metrics.drains.inc()
+            self.metrics.duration.observe(self._finished_at - t0)
+            self.flight.record(
+                "drain_done",
+                handoffs=self.handoffs,
+                reprefill=self.reprefill_fallbacks,
+                requeued=self.requeued,
+                duration_ms=round(1000 * (self._finished_at - t0), 1),
+            )
+            logger.warning(
+                "drain finished in %.2fs: %d handed off, %d re-prefill "
+                "fallbacks, %d requeued",
+                self._finished_at - t0, self.handoffs,
+                self.reprefill_fallbacks, self.requeued,
+            )
+            if self._on_drained is not None:
+                try:
+                    self._on_drained()
+                except Exception:
+                    logger.exception("on_drained callback failed")
+
+    def _note_requeued(self, n: int) -> None:
+        if n:
+            self.requeued += n
+            self.metrics.streams.inc(n, outcome="requeue")
+            from dynamo_tpu.runtime.faults import note_activity
+
+            note_activity("drain_requeues", n)
+
+    async def _handoff_one(self, client, rid: str, deadline: float) -> None:
+        """Serial phase of one handoff: detach + device export (both
+        serialize at the engine's reconciled boundary anyway), then hand
+        the network ship phase to a bounded-concurrency task so the next
+        sequence's export overlaps this one's peer round trips."""
+        engine = self.engine
+        if self._ship_sem is None:
+            self._ship_sem = asyncio.Semaphore(self.ship_concurrency)
+        # Acquire BEFORE detach/export: the semaphore bounds not just the
+        # peer round trips but how many exported wire payloads sit in
+        # host RAM at once — detaching a full worker and serializing its
+        # whole pool while ships queue would OOM the drain, dropping
+        # every stream the plane exists to preserve.
+        try:
+            await asyncio.wait_for(
+                self._ship_sem.acquire(),
+                timeout=max(0.05, deadline - self._clock()),
+            )
+        except asyncio.TimeoutError:
+            return  # still attached; the deadline sweep falls it back
+        held = True
+        try:
+            try:
+                seq = await engine.detach_for_handoff(rid)
+            except Exception as exc:
+                logger.warning("detach of %s failed: %r", rid, exc)
+                return
+            if seq is None:
+                return  # finished while we were getting to it
+            if seq.context.stopped:
+                # Client already gone: nothing to preserve.
+                engine.release_detached(seq)
+                seq.queue.put_nowait(None)
+                return
+            # From here the seq is detached: EVERY path must resolve it
+            # (relay, fallback, or requeue) — an unhandled exception would
+            # leave the client stream hanging on a queue nobody feeds.
+            peers: List[int] = []
+            try:
+                if client is not None:
+                    peers = [
+                        i for i in client.instance_ids if i != self.worker_id
+                    ]
+            except Exception as exc:
+                # Discovery dying mid-drain must not strand the stream.
+                self._fallback(seq, f"peer discovery failed: {exc!r}")
+                return
+            if not peers:
+                self._fallback(seq, "no handoff peers available")
+                return
+            try:
+                ticket, wire = await asyncio.wait_for(
+                    engine.export_detached(seq),
+                    timeout=max(0.05, deadline - self._clock()),
+                )
+            except Exception as exc:
+                self._fallback(seq, f"export failed: {exc!r}")
+                return
+            task = asyncio.get_running_loop().create_task(
+                self._ship_one(client, seq, ticket, wire, peers, deadline),
+                name=f"drain-ship:{rid}",
+            )
+            held = False  # the ship task releases the slot when it resolves
+            self._ships.add(task)
+            task.add_done_callback(self._ships.discard)
+        finally:
+            if held:
+                self._ship_sem.release()
+
+    async def _ship_one(
+        self, client, seq, ticket, wire, peers: List[int], deadline: float
+    ) -> None:
+        """Network phase of one handoff: rank peers, ship, fall back.
+        Owns a detached seq — no exit path may strand it — and the ship
+        semaphore slot _handoff_one acquired (released on resolve, which
+        also caps the exported payloads buffered in host RAM)."""
+        rid = seq.request.request_id
+        resolved = False
+        try:
+            from dynamo_tpu.disagg.handoff import pack_handoff
+
+            payload = pack_handoff(ticket, wire)
+            nbytes = int(wire.nbytes)
+            src = self.worker_id if self.worker_id is not None else 0
+            # NetKV's decode-instance selection by network distance:
+            # fastest measured (src → peer) link first; never-measured
+            # peers quote the optimistic seed so a new peer isn't
+            # penalized by speculation.
+            ranked = sorted(
+                peers,
+                key=lambda p: (
+                    self.link_costs.seconds(src, (p, 0), nbytes), p
+                ),
+            )
+            for peer in ranked:
+                if self._clock() >= deadline:
+                    break
+                try:
+                    accepted = await asyncio.wait_for(
+                        self._try_peer(client, seq, payload, nbytes, peer),
+                        timeout=max(0.05, deadline - self._clock()),
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    self.peer_refusals += 1
+                    self.metrics.peer_refusals.inc()
+                    self.flight.record(
+                        "peer_error", request_id=rid, peer=peer,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+                    continue
+                if accepted:
+                    # The relay task owns the stream now: nothing
+                    # below may fall back on it.
+                    resolved = True
+                    self.handoffs += 1
+                    self.handoff_bytes += nbytes
+                    self.metrics.streams.inc(outcome="handoff")
+                    self.metrics.handoff_bytes.inc(nbytes)
+                    from dynamo_tpu.runtime.faults import note_activity
+
+                    note_activity("drain_handoffs")
+                    self.flight.record(
+                        "handoff", request_id=rid, peer=peer,
+                        bytes=nbytes, blocks=ticket.n_blocks,
+                        carried=len(ticket.generated),
+                    )
+                    return
+            resolved = True
+            self._fallback(seq, "every peer refused the handoff")
+        except asyncio.CancelledError:
+            # Deadline (or drain teardown) cut the ship mid-flight: the
+            # re-prefill rung is always safe — _try_peer's BaseException
+            # path already closed the peer stream, reaping any ghost.
+            if not resolved:
+                self._fallback(seq, "drain deadline cut the handoff")
+            raise
+        except Exception as exc:
+            # Packaging/ranking/accounting machinery failing must walk
+            # the ladder, never strand the detached stream.
+            if not resolved:
+                self._fallback(seq, f"handoff machinery failed: {exc!r}")
+        finally:
+            self._ship_sem.release()
+
+    async def _close_quietly(self, it: Any) -> None:
+        """Best-effort aclose of a handoff/continuation stream. Closing
+        propagates cancellation to the peer's handler context, so a peer
+        that already adopted before the source gave up on it reaps the
+        ghost sequence instead of decoding it to max_tokens with no
+        consumer. Bounded: a dead wire must not hang the drain."""
+        aclose = getattr(it, "aclose", None)
+        if aclose is None:
+            return
+        try:
+            await asyncio.wait_for(aclose(), timeout=1.0)
+        except BaseException as exc:
+            # The close is compensation on an already-failing path; a
+            # dead wire here is expected (the dropped connection itself
+            # cancels the peer's handler) — note it and move on.
+            logger.debug("handoff stream close failed: %r", exc)
+
+    async def _try_peer(
+        self, client: Any, seq: Any, payload: dict, nbytes: int, peer: int
+    ) -> bool:
+        """Ship the ticket to one peer. True = accepted (a relay task now
+        owns the stream and the source's block copy is released); False =
+        typed refusal. Transport errors raise to the caller."""
+        # Child context: cancelling the original client stream cancels the
+        # peer continuation too (the tcp plane forwards the stop).
+        ctx = seq.context.child()
+        stream = client.direct(payload, peer, context=ctx)
+        it = stream.__aiter__()
+        t0 = self._clock()
+        try:
+            first = await it.__anext__()
+        except StopAsyncIteration:
+            raise ConnectionError(f"peer {peer:#x} closed the handoff stream")
+        except BaseException:
+            # Ambiguous outcome (deadline cancel, transport death mid
+            # accept-ack): the peer may ALREADY have adopted. Close the
+            # stream before walking the ladder — the cancel reaches the
+            # peer and reaps any adopted ghost, so falling back to
+            # re-prefill cannot leave two engines decoding one request.
+            await self._close_quietly(it)
+            raise
+        if not (isinstance(first, dict) and first.get("accepted")):
+            reason = (
+                first.get("reason", "unspecified")
+                if isinstance(first, dict) else repr(first)
+            )
+            self.peer_refusals += 1
+            self.metrics.peer_refusals.inc()
+            self.flight.record(
+                "peer_refusal", request_id=seq.request.request_id,
+                peer=peer, reason=reason,
+            )
+            await self._close_quietly(it)
+            return False
+        # The accept-ack round trip carried the whole ticket: observe the
+        # achieved (src → peer) bandwidth for the next seq's peer ranking.
+        elapsed = self._clock() - t0
+        src = self.worker_id if self.worker_id is not None else 0
+        if elapsed > 0 and nbytes > 0:
+            self.link_costs.observe(src, (peer, 0), nbytes / elapsed)
+        # Peer owns the KV now; free the source copy.
+        self.engine.release_detached(seq)
+        task = asyncio.get_running_loop().create_task(
+            self._relay(seq, it, peer),
+            name=f"drain-relay:{seq.request.request_id}",
+        )
+        self._relays.add(task)
+        task.add_done_callback(self._relays.discard)
+        return True
+
+    async def _relay(self, seq: Any, it: Any, peer: int) -> None:
+        """Pipe the peer's continuation into the still-attached client
+        stream. On relay failure, a MIGRATABLE error surfaces instead —
+        the frontend re-dispatches (to the peer, most likely, whose cache
+        is now warm with this very sequence)."""
+        from dynamo_tpu.llm.protocols.common import BackendOutput
+
+        rid = seq.request.request_id
+        try:
+            while True:
+                try:
+                    item = await it.__anext__()
+                except StopAsyncIteration:
+                    raise WorkerDrainingError(
+                        f"peer {peer:#x} continuation ended without a "
+                        "finish; re-dispatch"
+                    )
+                out = (
+                    BackendOutput.from_dict(item)
+                    if isinstance(item, dict) else item
+                )
+                seq.queue.put_nowait(out)
+                if out.finish_reason is not None:
+                    self.flight.record(
+                        "relay_done", request_id=rid, peer=peer,
+                    )
+                    return
+        except asyncio.CancelledError:
+            seq.queue.put_nowait(
+                WorkerDrainingError(
+                    "drain deadline cut the relay; re-dispatch with "
+                    "carried tokens"
+                )
+            )
+            # Stop the peer's continuation too: the client is about to
+            # re-dispatch, and an unconsumed peer stream would decode to
+            # max_tokens for nobody.
+            await self._close_quietly(it)
+            raise
+        except Exception as exc:
+            mig = (
+                exc
+                if isinstance(exc, (ConnectionError, TimeoutError))
+                else WorkerDrainingError(
+                    f"handoff relay to peer {peer:#x} failed: {exc!r}"
+                )
+            )
+            self.flight.record(
+                "relay_error", request_id=rid, peer=peer,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            seq.queue.put_nowait(mig)
+            await self._close_quietly(it)
+
+    def _fallback(self, seq: Any, reason: str) -> None:
+        """The PR 7 re-prefill rung: a migratable error surfaces through
+        the stream; the frontend re-dispatches with the streamed tokens
+        carried (Migration accumulated them), re-prefilling on a serving
+        worker."""
+        rid = seq.request.request_id
+        self.reprefill_fallbacks += 1
+        self.metrics.streams.inc(outcome="reprefill")
+        from dynamo_tpu.runtime.faults import note_activity
+
+        note_activity("drain_fallbacks")
+        self.flight.record("fallback", request_id=rid, reason=reason)
+        logger.warning(
+            "handoff of %s fell back to re-prefill migration: %s",
+            rid, reason,
+        )
+        self.engine.fail_detached(
+            seq,
+            WorkerDrainingError(
+                f"worker draining; handoff unavailable ({reason}) — "
+                "re-dispatch with carried tokens"
+            ),
+        )
+
+    async def _await_ships(self, deadline: float) -> None:
+        """Ship tasks (peer ranking + accept-ack) must resolve their
+        detached seqs before the deadline sweep; at the deadline they are
+        cancelled and each falls back to the re-prefill rung."""
+        if not self._ships:
+            return
+        remaining = max(0.0, deadline - self._clock())
+        done, pending = await asyncio.wait(
+            list(self._ships), timeout=remaining
+        )
+        if pending:
+            for task in pending:
+                task.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
+            self.flight.record("ships_cut", count=len(pending))
+
+    async def _await_relays(self, deadline: float) -> None:
+        """Relays (source → client piping of peer continuations) must
+        finish before the process exits; at the deadline they are cut —
+        the relay's cancellation path pushes a migratable error, and the
+        frontend re-dispatches to the peer, whose cache is warm."""
+        if not self._relays:
+            return
+        remaining = max(0.0, deadline - self._clock())
+        done, pending = await asyncio.wait(
+            list(self._relays), timeout=remaining
+        )
+        for task in pending:
+            task.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+            self.flight.record("relays_cut", count=len(pending))
+
+    async def _checkpoint(self) -> None:
+        engine = self.engine
+        if not self.checkpoint_dir:
+            return
+        if getattr(engine.pool, "cached_blocks", 0) <= 0:
+            return
+        try:
+            result = await engine.save_checkpoint(self.checkpoint_dir)
+            self.checkpointed = True
+            self.flight.record(
+                "checkpoint", blocks=result.get("blocks"),
+                path=self.checkpoint_dir,
+            )
+        except Exception:
+            logger.exception(
+                "warm-KV checkpoint failed during drain (next start runs "
+                "cold)"
+            )

@@ -45,6 +45,16 @@ HEALTH_CANARY = "health.canary"
 KVBM_TIER_READ = "kvbm.tier.read"
 KVBM_TIER_WRITE = "kvbm.tier.write"
 
+# -- drain plane (runtime/drain.py, engines/tpu/engine.py) --------------------
+# Export side of a live handoff: one hit per detached sequence, BEFORE the
+# device gather — an injection models the draining worker failing to read
+# its own pool (the ladder must fall through to re-prefill migration).
+DRAIN_HANDOFF_EXPORT = "drain.handoff.export"
+# Import side: one hit per adoption attempt on the PEER, before any pool
+# mutation — an injection models the receiving worker refusing/dying, which
+# the source must absorb by trying the next peer or falling down the ladder.
+DRAIN_HANDOFF_IMPORT = "drain.handoff.import"
+
 # -- overload plane (runtime/overload.py) -------------------------------------
 # One hit per QUEUED admission attempt, before the EDF wait: an injected
 # timeout here expires exactly that request's queue budget — the
@@ -66,5 +76,7 @@ ALL_FAULT_POINTS = (
     HEALTH_CANARY,
     KVBM_TIER_READ,
     KVBM_TIER_WRITE,
+    DRAIN_HANDOFF_EXPORT,
+    DRAIN_HANDOFF_IMPORT,
     OVERLOAD_ADMIT,
 )

@@ -69,6 +69,27 @@ _KINDS = {
     "error": InjectedError,
 }
 
+# Data-mutating kind: instead of raising, a firing "corrupt" rule flips one
+# bit of the payload passing through a ``fault_payload`` seam (deterministic:
+# bit 0 of the middle byte), modeling silent storage/wire corruption. Only
+# seams that carry a payload (``fault_payload``) can apply it; at a plain
+# ``fault_point`` a firing corrupt rule is recorded in the trace but mutates
+# nothing (there is nothing to mutate).
+CORRUPT_KIND = "corrupt"
+
+
+def corrupt_bytes(data: bytes, flip: int = 0) -> bytes:
+    """The deterministic corruption transform: bit ``flip % 8`` of the
+    middle byte. Exposed so tests can predict the exact corrupted form.
+    ``flip`` distinguishes stacked applications on one hit — the flip is
+    an involution, so two rules flipping the SAME bit would silently
+    restore the pristine payload while the trace claims two injections."""
+    if not data:
+        return data
+    buf = bytearray(data)
+    buf[len(buf) // 2] ^= 1 << (flip % 8)
+    return bytes(buf)
+
 
 @dataclass(frozen=True)
 class FaultRule:
@@ -91,9 +112,10 @@ class FaultRule:
                 "runtime/fault_names.py (DYN006 closes call sites over "
                 "the same registry)"
             )
-        if self.kind not in _KINDS:
+        if self.kind not in _KINDS and self.kind != CORRUPT_KIND:
             raise ValueError(
-                f"unknown fault kind {self.kind!r} (one of {sorted(_KINDS)})"
+                f"unknown fault kind {self.kind!r} "
+                f"(one of {sorted([*_KINDS, CORRUPT_KIND])})"
             )
         # Tolerate list specs from JSON plans.
         if not isinstance(self.at, tuple):
@@ -194,12 +216,26 @@ class FaultPlane:
             self._injections.set_total(n, point=point)
 
     def hit(self, name: str, info: Dict[str, Any]) -> None:
+        self._eval(name, info, None)
+
+    def hit_payload(self, name: str, data: bytes, info: Dict[str, Any]) -> bytes:
+        """Payload-carrying hit (``fault_payload`` seams): raising kinds
+        raise exactly like ``hit``; a firing "corrupt" rule returns the
+        deterministically bit-flipped payload instead."""
+        out = self._eval(name, info, data)
+        return data if out is None else out
+
+    def _eval(
+        self, name: str, info: Dict[str, Any], data: Optional[bytes]
+    ) -> Optional[bytes]:
         n = self.hits.get(name, 0) + 1
         self.hits[name] = n
         rules = self._rules.get(name)
         if not rules:
-            return
+            return None
         rng = self._rng.get(name)
+        corrupted: Optional[bytes] = None
+        n_corrupt = 0
         for idx, rule, state in rules:
             fire = n in rule.at
             if rule.every and n % rule.every == 0:
@@ -216,10 +252,26 @@ class FaultPlane:
             state.fired += 1
             self.injected[name] = self.injected.get(name, 0) + 1
             self.trace.append((name, n, idx, rule.kind))
+            if rule.kind == CORRUPT_KIND:
+                # Mutate-and-continue: later raising rules on the same hit
+                # still evaluate (a plan may corrupt AND kill one point).
+                # At a payload-less seam there is nothing to mutate — the
+                # trace entry still records the scheduled fire.
+                if data is not None:
+                    # Stacked corrupt rules on one hit flip DIFFERENT bits
+                    # (flip=0, 1, …): corrupt_bytes is an involution, so
+                    # re-flipping bit 0 would restore the pristine payload
+                    # while the trace claims two injections.
+                    corrupted = corrupt_bytes(
+                        data if corrupted is None else corrupted, n_corrupt
+                    )
+                    n_corrupt += 1
+                continue
             raise _KINDS[rule.kind](
                 f"injected {rule.kind} fault at {name} "
                 f"(hit {n}, rule {idx}{', ' + repr(info) if info else ''})"
             )
+        return corrupted
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -244,6 +296,18 @@ def fault_point(name: str, **info: Any) -> None:
     plane = _PLANE
     if plane is not None:
         plane.hit(name, info)
+
+
+def fault_payload(name: str, data: bytes, **info: Any) -> bytes:
+    """Payload-carrying seam variant: behaves exactly like ``fault_point``
+    for raising kinds, and additionally lets a "corrupt" rule flip one bit
+    of ``data`` (deterministically) before returning it. One hit per call —
+    a seam uses EITHER fault_point OR fault_payload, never both, so hit
+    schedules stay stable. Disabled cost: a None check, data untouched."""
+    plane = _PLANE
+    if plane is None:
+        return data
+    return plane.hit_payload(name, data, info)
 
 
 def arm(plan: FaultPlan) -> FaultPlane:

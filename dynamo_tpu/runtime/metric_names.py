@@ -50,6 +50,10 @@ ENGINE_PREEMPTIONS = engine_gauge("preemptions")
 ENGINE_QUEUE_DEPTH = engine_gauge("queue_depth")
 ENGINE_KV_HIGH_WATERMARK = engine_gauge("kv_high_watermark")
 ENGINE_DEADLINE_SHEDS = engine_gauge("deadline_sheds")
+# Drain plane input: 1 while the engine refuses new admissions because a
+# live handoff drain is in progress (rides load reports router-ward so
+# KvScheduler stops placing work here immediately).
+ENGINE_DRAINING = engine_gauge("draining")
 
 # -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
 ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
@@ -88,6 +92,10 @@ KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL = (
     f"{KVBM_PREFIX}_pool_pressure_truncations_total"
 )
 KVBM_FAILED_LOADS_TOTAL = f"{KVBM_PREFIX}_failed_loads_total"
+# Integrity: persisted KV (checkpoint manifest arrays, disk-tier npz
+# spills) whose CRC32 did not match on restore — counted as a miss, never
+# installed, never a crash. Labeled by source (checkpoint | disk).
+KVBM_RESTORE_CORRUPTION_TOTAL = f"{KVBM_PREFIX}_restore_corruption_total"
 
 # -- device/runtime plane (runtime/device_observe.py) ------------------------
 RUNTIME_PREFIX = "dynamo_tpu_runtime"
@@ -148,6 +156,26 @@ FAULTS_PREFIX = "dynamo_tpu_faults"
 FAULTS_ARMED = f"{FAULTS_PREFIX}_armed"
 FAULTS_INJECTIONS_TOTAL = f"{FAULTS_PREFIX}_injections_total"
 
+# -- drain plane (runtime/drain.py DrainController) ---------------------------
+DRAIN_PREFIX = "dynamo_tpu_drain"
+# State machine: 0 serving, 1 draining, 2 drained.
+DRAIN_STATE = f"{DRAIN_PREFIX}_state"
+# Completed drains (a worker usually drains once per life; a counter so
+# aborted/retried drains are visible across restarts of the controller).
+DRAIN_DRAINS_TOTAL = f"{DRAIN_PREFIX}_drains_total"
+# In-flight streams resolved by the drain, by ladder rung: handoff (live
+# KV moved, zero re-prefill), reprefill (fell back to PR 7 migration —
+# the frontend re-prefills on another worker), requeue (never admitted;
+# typed migratable refusal re-dispatches it whole).
+DRAIN_STREAMS_TOTAL = f"{DRAIN_PREFIX}_streams_total"
+# Serialized wire bytes of exported handoff KV (payload + scales).
+DRAIN_HANDOFF_BYTES_TOTAL = f"{DRAIN_PREFIX}_handoff_bytes_total"
+# Peer adoptions refused (capacity, shape/seed mismatch, peer draining) —
+# each refusal walks the source further down the peer list / ladder.
+DRAIN_PEER_REFUSALS_TOTAL = f"{DRAIN_PREFIX}_peer_refusals_total"
+# Wall time of one full drain (trigger -> drained).
+DRAIN_DURATION = f"{DRAIN_PREFIX}_duration_seconds"
+
 # -- overload plane (runtime/overload.py OverloadController) -----------------
 OVERLOAD_PREFIX = "dynamo_tpu_overload"
 # Brownout state machine: 0 healthy, 1 brownout (max_tokens clamped,
@@ -198,6 +226,7 @@ ALL_KVBM = (
     KVBM_TIER_EVICTIONS_TOTAL,
     KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL,
     KVBM_FAILED_LOADS_TOTAL,
+    KVBM_RESTORE_CORRUPTION_TOTAL,
 )
 
 ALL_DISAGG = (
@@ -222,6 +251,15 @@ ALL_MIGRATION = (
 ALL_FAULTS = (
     FAULTS_ARMED,
     FAULTS_INJECTIONS_TOTAL,
+)
+
+ALL_DRAIN = (
+    DRAIN_STATE,
+    DRAIN_DRAINS_TOTAL,
+    DRAIN_STREAMS_TOTAL,
+    DRAIN_HANDOFF_BYTES_TOTAL,
+    DRAIN_PEER_REFUSALS_TOTAL,
+    DRAIN_DURATION,
 )
 
 ALL_OVERLOAD = (
@@ -263,6 +301,7 @@ ALL_ENGINE = (
     ENGINE_QUEUE_DEPTH,
     ENGINE_KV_HIGH_WATERMARK,
     ENGINE_DEADLINE_SHEDS,
+    ENGINE_DRAINING,
     ENGINE_STEP_DURATION,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_STEP_PREFILL_TOKENS,

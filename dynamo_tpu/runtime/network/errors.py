@@ -1,0 +1,49 @@
+"""Transport-agnostic typed-error classification for request planes.
+
+Every request plane (tcp, http) ships stream failures as ``err`` frames
+carrying a ``kind`` so TYPED remote failures re-raise as the matching
+exception class on the client instead of a flat RuntimeError:
+connection/timeout errors and drain refusals (WorkerDrainingError,
+"endpoint draining") must stay MIGRATABLE across the wire, or the drain
+ladder's typed-requeue rung dead-ends at the frontend. Old peers that
+omit ``kind`` keep the RuntimeError behavior.
+
+Shared here (not private to one plane) so the classification pair cannot
+drift between transports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def err_kind(exc: BaseException) -> str:
+    """Classify a server-side handler failure for the err frame's ``kind``
+    (the client re-raises the matching type — migratability must survive
+    the wire). Name-based where importing the class would cycle."""
+    if type(exc).__name__ == "WorkerDrainingError":
+        return "draining"
+    if type(exc).__name__ == "NoInstancesError":
+        return "no_instances"
+    if isinstance(exc, (TimeoutError, asyncio.TimeoutError)):
+        return "timeout"
+    if isinstance(exc, ConnectionError):
+        return "connection"
+    return "other"
+
+
+def err_exception(kind: str, message: str) -> BaseException:
+    """Client-side inverse of err_kind."""
+    if kind == "draining":
+        from dynamo_tpu.runtime.drain import WorkerDrainingError
+
+        return WorkerDrainingError(message)
+    if kind == "no_instances":
+        from dynamo_tpu.runtime.component import NoInstancesError
+
+        return NoInstancesError(message)
+    if kind == "timeout":
+        return TimeoutError(message)
+    if kind == "connection":
+        return ConnectionError(message)
+    return RuntimeError(message)

@@ -131,7 +131,11 @@ class HttpRequestPlane:
         )
         try:
             if tracker.draining:
-                await response.write(_pack_frame("err", "draining"))
+                await response.write(_pack_frame(
+                    "err",
+                    {"message": "endpoint draining; re-dispatch",
+                     "kind": "draining"},
+                ))
                 return response
             from dynamo_tpu.utils.tracing import span
 
@@ -152,7 +156,14 @@ class HttpRequestPlane:
         except Exception as exc:
             logger.exception("http stream handler failed")
             try:
-                await response.write(_pack_frame("err", repr(exc)))
+                # Typed err (parity with the TCP plane): connection/timeout
+                # failures and drain refusals must stay migratable across
+                # the wire.
+                from dynamo_tpu.runtime.network.errors import err_kind
+
+                await response.write(_pack_frame(
+                    "err", {"message": repr(exc), "kind": err_kind(exc)}
+                ))
             except (ConnectionError, RuntimeError):
                 pass
         return response
@@ -258,6 +269,16 @@ class _HttpClientEngine:
                         clean_end = True
                         return
                     elif kind == "err":
+                        from dynamo_tpu.runtime.network.errors import (
+                            err_exception,
+                        )
+
+                        if isinstance(payload, dict):
+                            raise err_exception(
+                                payload.get("kind", "other"),
+                                payload.get("message", "remote error"),
+                            )
+                        # Old peer: bare string payload.
                         raise RuntimeError(payload)
             # Stream ended without an "end" frame: the worker vanished.
             if not context.stopped:

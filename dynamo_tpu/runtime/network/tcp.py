@@ -10,11 +10,18 @@ Frame headers:
   {"type": "cancel", "stream": id}                                     (client→server)
   {"type": "item",   "stream": id}  payload=response item              (server→client)
   {"type": "end",    "stream": id}                                     stream done
-  {"type": "err",    "stream": id, "message": str}                     stream failed
+  {"type": "err",    "stream": id, "message": str, "kind": str}        stream failed
 
 A dropped connection cancels every stream riding it — on the client side this
 surfaces as StreamDisconnectedError, the trigger for request migration
 (ref: migration.rs no-responder handling).
+
+``err`` frames carry a ``kind`` so TYPED remote failures re-raise as the
+matching exception class on the client instead of a flat RuntimeError:
+connection/timeout errors and drain refusals (WorkerDrainingError,
+"endpoint draining") must stay MIGRATABLE across the wire, or the drain
+ladder's typed-requeue rung dead-ends at the frontend. Old peers that omit
+``kind`` keep the RuntimeError behavior.
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
+from dynamo_tpu.runtime.network.errors import err_exception, err_kind
 from dynamo_tpu.runtime.tasks import TaskTracker, reap_task
 from dynamo_tpu.utils.logging import get_logger
 
@@ -156,7 +164,11 @@ class TcpRequestPlane:
         engine, tracker = entry
         try:
             if tracker.draining:
-                await fw.send({"type": "err", "stream": sid, "message": "draining"})
+                await fw.send({
+                    "type": "err", "stream": sid,
+                    "message": "endpoint draining; re-dispatch",
+                    "kind": "draining",
+                })
                 return
             from dynamo_tpu.utils.tracing import span
 
@@ -175,7 +187,10 @@ class TcpRequestPlane:
         except Exception as exc:
             logger.exception("stream %s handler failed", sid)
             with _suppress_conn():
-                await fw.send({"type": "err", "stream": sid, "message": repr(exc)})
+                await fw.send({
+                    "type": "err", "stream": sid, "message": repr(exc),
+                    "kind": err_kind(exc),
+                })
 
     # -- client side -------------------------------------------------------
 
@@ -250,7 +265,13 @@ class _ClientConn:
                     elif ftype == "end":
                         q.put_nowait(("end", None))
                     elif ftype == "err":
-                        q.put_nowait(("err", header.get("message", "remote error")))
+                        q.put_nowait((
+                            "err",
+                            (
+                                header.get("message", "remote error"),
+                                header.get("kind", "other"),
+                            ),
+                        ))
             finally:
                 self.closed = True
                 for q in self._queues.values():
@@ -329,7 +350,8 @@ class _TcpClientEngine:
                 elif kind == "end":
                     return
                 elif kind == "err":
-                    raise RuntimeError(payload)
+                    message, ekind = payload
+                    raise err_exception(ekind, message)
                 elif kind == "disconnect":
                     raise StreamDisconnectedError(
                         f"worker connection lost: {self._addr}"

@@ -131,6 +131,10 @@ class SystemStatusServer:
         # snapshot fn) and HBM-ledger samplers (name → category dict fn).
         self._flight_sources: List[Tuple[str, Callable[[], List[Any]]]] = []
         self._memory_sources: List[Tuple[str, Callable[[], Dict[str, int]]]] = []
+        # Drain plane (runtime/drain.py): (start_fn(deadline_s) -> awaitable
+        # status dict, status_fn() -> dict). Registered by register_drain.
+        self._drain_start: Optional[Callable[..., Awaitable[Dict[str, Any]]]] = None
+        self._drain_status: Optional[Callable[[], Dict[str, Any]]] = None
         self._profile_timers: set = set()  # strong refs to auto-stop tasks
         self._runtime_metrics_registered = False
         self._runner: Optional[web.AppRunner] = None
@@ -153,6 +157,17 @@ class SystemStatusServer:
         self._lora_list = list_fn
         self._lora_load = load_fn
         self._lora_unload = unload_fn
+
+    def register_drain(
+        self,
+        start_fn: Callable[..., Awaitable[Dict[str, Any]]],
+        status_fn: Callable[[], Dict[str, Any]],
+    ) -> None:
+        """Wire the drain controller: ``POST /drain`` (and the preStop's
+        ``GET /drain?start=1``) awaits ``start_fn(deadline_s=...)``;
+        ``GET /drain`` returns ``status_fn()``."""
+        self._drain_start = start_fn
+        self._drain_status = status_fn
 
     def register_flight(
         self, name: str, fn: Callable[[], List[Any]]
@@ -193,6 +208,8 @@ class SystemStatusServer:
         app.router.add_get("/debug/compiles", self._debug_compiles)
         app.router.add_get("/debug/flight", self._debug_flight)
         app.router.add_post("/debug/profile", self._debug_profile)
+        app.router.add_get("/drain", self._drain_get)
+        app.router.add_post("/drain", self._drain_post)
         app.router.add_route("*", "/engine/{path:.*}", self._engine)
         app.router.add_get("/v1/loras", self._loras_list)
         app.router.add_post("/v1/loras", self._loras_load)
@@ -461,6 +478,49 @@ class SystemStatusServer:
             status=400,
         )
 
+    # -- drain plane (runtime/drain.py) ------------------------------------
+
+    async def _drain_get(self, request: web.Request) -> web.Response:
+        """Drain status — or, with ``?start=1``, trigger-and-wait. The
+        mutating GET exists for the k8s preStop hook, whose httpGet action
+        only issues GETs; kubelet blocks on the response, which is exactly
+        the preStop contract (pod deletion proceeds once drained)."""
+        if self._drain_status is None:
+            return web.json_response(
+                {"error": "no drain controller registered"}, status=404
+            )
+        if request.query.get("start") in ("1", "true", "yes"):
+            return await self._start_drain({})
+        return web.json_response(self._drain_status())
+
+    async def _drain_post(self, request: web.Request) -> web.Response:
+        if self._drain_start is None:
+            return web.json_response(
+                {"error": "no drain controller registered"}, status=404
+            )
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        return await self._start_drain(body if isinstance(body, dict) else {})
+
+    async def _start_drain(self, body: Dict[str, Any]) -> web.Response:
+        deadline_s: Optional[float] = None
+        if body.get("deadline_s") is not None:
+            try:
+                deadline_s = float(body["deadline_s"])
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": f"bad deadline_s {body['deadline_s']!r}"},
+                    status=400,
+                )
+        try:
+            status = await self._drain_start(deadline_s=deadline_s)
+        except Exception as exc:
+            logger.exception("drain failed")
+            return web.json_response({"error": repr(exc)}, status=500)
+        return web.json_response(status)
+
     async def _engine(self, request: web.Request) -> web.Response:
         path = request.match_info["path"].strip("/")
         handler = self._engine_routes.get(path)
@@ -616,6 +676,14 @@ def attach_engine(server: SystemStatusServer, engine: Any) -> None:
         server.register_metrics(
             lambda: engine_stats_prometheus(engine.stats())
         )
+    if has("save_checkpoint") or has("load_checkpoint"):
+        # Persisted-KV integrity counter (kvbm/integrity.py): process-
+        # global, one registration per server — checkpoint CRC failures
+        # and disk-tier spill failures land in the same family under
+        # distinct source labels.
+        from dynamo_tpu.kvbm.integrity import render_integrity_metrics
+
+        server.register_metrics(render_integrity_metrics)
     step_metrics = getattr(engine, "step_metrics", None)
     if step_metrics is not None:
         step_metrics.register_metrics(server)

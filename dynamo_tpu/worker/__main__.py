@@ -2,7 +2,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import random
+import signal
 
 import jax
 
@@ -338,11 +340,15 @@ async def main() -> None:
     served_ctl = await component.endpoint("control").serve_endpoint(
         control, instance_id=instance_id
     )
+    served_handoff = None
+    handoff_client_factory = None
     if args.is_prefill_worker:
         handler = PrefillHandler(engine, instance_id)
         served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
         # Prefill workers are found via their component endpoint, not the
-        # model registry (ref: prefill_router.rs activate).
+        # model registry (ref: prefill_router.rs activate). Their in-flight
+        # work is one bounded prefill each, so drain skips the handoff rung
+        # (typed requeue re-dispatches whole requests).
     else:
         async def _kv_client():
             return await (
@@ -362,6 +368,21 @@ async def main() -> None:
         load_pub.link_faults_fn = handler.open_breaker_srcs
         served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
         await register_llm(runtime, card, endpoint, instance_id)
+        # Live-handoff plane (rolling restarts): serve adoptions from
+        # draining peers, and reach peers' handoff endpoints when WE drain.
+        from dynamo_tpu.disagg import HANDOFF_ENDPOINT, HandoffHandler
+
+        served_handoff = await component.endpoint(HANDOFF_ENDPOINT).serve_endpoint(
+            HandoffHandler(engine).generate, instance_id=instance_id
+        )
+
+        async def handoff_client_factory():
+            return await (
+                runtime.namespace(args.namespace)
+                .component(args.component)
+                .endpoint(HANDOFF_ENDPOINT)
+                .client()
+            )
     load_pub.start()
     await engine.start()
     if args.kv_checkpoint_dir:
@@ -396,6 +417,44 @@ async def main() -> None:
     overload_task = asyncio.get_running_loop().create_task(
         overload_eval_loop(), name="overload-eval"
     )
+    # Drain plane: SIGTERM (k8s pod deletion), POST /drain, or the preStop
+    # hook triggers a live-handoff drain; the worker exits once drained.
+    from dynamo_tpu.runtime.drain import DrainController
+
+    shutdown = asyncio.Event()
+    drain_controller = DrainController(
+        engine,
+        worker_id=instance_id,
+        handoff_client_factory=handoff_client_factory,
+        load_publisher=load_pub,
+        checkpoint_dir=args.kv_checkpoint_dir,
+        on_drained=shutdown.set,
+    )
+
+    loop = asyncio.get_running_loop()
+
+    def start_drain(sig_name: str) -> None:
+        if drain_controller.state == 0:
+            print(f"{sig_name}: draining (live handoff)...", flush=True)
+        drain_controller.trigger()
+
+    sigint_count = 0
+
+    def on_sigint() -> None:
+        nonlocal sigint_count
+        sigint_count += 1
+        if sigint_count >= 2:
+            # Second ^C: the operator means NOW. Skip every drain step.
+            print("second SIGINT: forcing exit", flush=True)
+            os._exit(130)
+        start_drain("SIGINT")
+
+    # Loop signal handlers, NOT signal.signal: the previous bare
+    # `asyncio.Event().wait()` meant SIGTERM killed the process without
+    # ever running the finally block — no KV checkpoint, no graceful
+    # endpoint shutdown, every live stream dropped.
+    loop.add_signal_handler(signal.SIGTERM, start_drain, "SIGTERM")
+    loop.add_signal_handler(signal.SIGINT, on_sigint)
     system_server = None
     if args.system_port is not None:
         from dynamo_tpu.runtime.system_server import (
@@ -406,6 +465,10 @@ async def main() -> None:
         system_server = SystemStatusServer(port=args.system_port)
         attach_engine(system_server, engine)
         overload.register_metrics(system_server)
+        drain_controller.register_metrics(system_server)
+        system_server.register_drain(
+            drain_controller.drain, drain_controller.status
+        )
         if kvbm is not None:
             kvbm.register_metrics(system_server)
         if hasattr(handler, "register_metrics"):
@@ -420,9 +483,13 @@ async def main() -> None:
         flush=True,
     )
     try:
-        await asyncio.Event().wait()
+        await shutdown.wait()
     finally:
-        if args.kv_checkpoint_dir and engine.pool.cached_blocks > 0:
+        if (
+            args.kv_checkpoint_dir
+            and engine.pool.cached_blocks > 0
+            and not drain_controller.checkpointed
+        ):
             # Guarded: a drained/slept worker must not clobber a previous
             # warm checkpoint with an empty one.
             try:
@@ -447,6 +514,8 @@ async def main() -> None:
         await served.shutdown(grace_period=config.GRACE_PERIOD.get())
         await served_ctl.shutdown(grace_period=5)
         await served_kv.shutdown(grace_period=5)
+        if served_handoff is not None:
+            await served_handoff.shutdown(grace_period=5)
         await engine.stop()
         await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
 

@@ -1,5 +1,6 @@
 """DYN006 bad fixture seams: a literal name, an unpinned constant, and a
-computed expression — each a closure break; DEAD has no seam at all."""
+computed expression — each a closure break; DEAD has no seam at all. The
+payload-carrying alias (fault_payload) is closed over the same registry."""
 
 import names as fn
 from names import UNPINNED
@@ -9,8 +10,9 @@ def point_name():
     return "fix." + "computed"
 
 
-def serve(fault_point):
+def serve(fault_point, fault_payload):
     fault_point(fn.LIVE)  # fine: declared + pinned
     fault_point("fix.literal")  # literal → finding
     fault_point(UNPINNED)  # constant not in ALL_FAULT_POINTS → finding
     fault_point(point_name())  # dynamic → finding
+    fault_payload("fix.payload_literal", b"data")  # literal via the alias → finding

@@ -1,10 +1,10 @@
 """DYN006 good fixture seams: every call resolves through the registry,
-both import styles."""
+both import styles and both call names (fault_point + fault_payload)."""
 
 import names as fn
 from names import OTHER
 
 
-def serve(fault_point):
+def serve(fault_point, fault_payload):
     fault_point(fn.LIVE, detail=1)
-    fault_point(OTHER)
+    return fault_payload(OTHER, b"data")

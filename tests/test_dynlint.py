@@ -248,8 +248,10 @@ def test_dyn006_bad_fixture():
     assert any("dead fault point 'fix.dead'" in m for m in msgs)
     assert any("UNPINNED" in m and "no ALL_* tuple" in m for m in msgs)
     assert any("does not statically resolve" in m for m in msgs)
+    # The payload-carrying alias is closed over the same registry.
+    assert any("fix.payload_literal" in m for m in msgs)
     assert all(f.rule == "DYN006" for f in findings)
-    assert len(findings) == 4
+    assert len(findings) == 5
 
 
 def test_dyn006_good_fixture():
