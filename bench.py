@@ -26,7 +26,8 @@ BENCH_BLOCK_SIZE/KV_BLOCKS/PREFILL_CHUNK/PREFILL_BATCH/DECODE_STEPS,
 BENCH_USE_KERNEL, BENCH_SPEC=ngram (speculative decoding),
 BENCH_PIPELINE_DEPTH (decode-tick pipelining; 2 default, 1 = synchronous),
 BENCH_SECONDARY=0 (skip the 8B-int8 leg), BENCH_DISAGG=0 / BENCH_OVERLOAD=0
-/ BENCH_DRAIN=0 (skip the disagg / overload-armor / SIGTERM-drain legs).
+/ BENCH_DRAIN=0 / BENCH_CRASH=0 (skip the disagg / overload-armor /
+SIGTERM-drain / kill-9-crash legs).
 """
 
 from __future__ import annotations
@@ -1046,6 +1047,233 @@ async def run_drain_leg(isl: int = 64, osl: int = 48, concurrency: int = 8):
         gc.collect()
 
 
+async def run_crash_leg(isl: int = 64, osl: int = 48, concurrency: int = 8,
+                        config_fn=None):
+    """Crash-plane measurement (ISSUE 10): an UNPLANNED worker death
+    mid-load — no drain, no handoff, the worker simply goes silent the way
+    a kill -9'd process does. The liveness tracker (missed load reports)
+    declares it dead, evicts it, and aborts its in-flight streams with the
+    typed worker_lost error; Migration re-prefills them on the peer. The
+    record carries the contract: ``lost_requests == 0``, the measured
+    detection-to-abort latency (bounded by dead_after × interval, nothing
+    TCP), the re-prefilled tokens the unplanned path paid (unlike drain's
+    zero-re-prefill handoff), and the warm-restart numbers — checkpoint
+    restore wall time + the prefill tokens a shared-prefix request costs
+    on the restarted worker (near-zero = warm rejoin works)."""
+    import tempfile
+
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import qwen2_500m_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.liveness import (
+        LivenessConfig,
+        LivenessTracker,
+        WorkerLostError,
+    )
+
+    fault_activity0 = _fault_activity_start()
+    cfg = (config_fn or qwen2_500m_config)()
+
+    def mk_engine():
+        return JaxEngine(
+            JaxEngineArgs(
+                config=cfg,
+                # Small blocks so the warm shared prefix commits several
+                # cache blocks: the restore half of the record
+                # (restored_blocks / warm_prefill_tokens) needs a
+                # non-empty checkpoint even at small ISL.
+                block_size=16,
+                num_kv_blocks=2048,
+                max_num_seqs=concurrency,
+                max_model_len=isl + osl + 64,
+                prefill_chunk=64,
+                prefill_batch=concurrency,
+                decode_steps=8,
+            )
+        )
+
+    source, peer = mk_engine(), mk_engine()
+    rt = DistributedRuntime.detached()
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-crash-ckpt-")
+
+    class _Crashable:
+        """Engine front that goes SILENT when killed — exactly what the
+        frontend observes of a kill -9'd worker (no FIN, no error)."""
+
+        def __init__(self, engine):
+            self.engine = engine
+            self.dead = asyncio.Event()
+
+        async def generate(self, request, context):
+            async for out in self.engine.generate(request, context):
+                if self.dead.is_set():
+                    await asyncio.Event().wait()  # never returns
+                yield out
+
+    crash_src = _Crashable(source)
+    ep = rt.namespace("bench").component("backend").endpoint("generate")
+    served = [
+        await ep.serve_endpoint(crash_src.generate, instance_id=1),
+        await ep.serve_endpoint(peer.generate, instance_id=2),
+    ]
+    client = await ep.client()
+    await client.wait_for_instances()
+    client.enable_stream_aborts()
+
+    kill_at = [0.0]
+    detection = {}
+
+    def on_dead(wid, _inc):
+        # Order matters: evict BEFORE abort so migration re-dispatches
+        # land on the peer, never back on the corpse.
+        client.evict_instance(wid)
+        n = client.abort_instance(
+            wid, WorkerLostError(f"worker {wid} dead (missed reports)")
+        )
+        detection["latency_s"] = time.monotonic() - kill_at[0]
+        detection["aborted_streams"] = n
+
+    tracker = LivenessTracker(
+        LivenessConfig(interval_s=0.1, suspect_after=2, dead_after=4),
+        on_dead=on_dead,
+    )
+    alive = {1: True, 2: True}
+
+    async def liveness_loop():
+        while True:
+            for wid, ok in alive.items():
+                if ok:
+                    tracker.observe_report(wid, 1000 + wid)
+            tracker.evaluate()
+            await asyncio.sleep(0.05)
+
+    liveness_task = asyncio.ensure_future(liveness_loop())
+
+    mig = Migration(migration_limit=3)
+    rng = np.random.default_rng(29)
+    shared_prefix = rng.integers(10, cfg.vocab_size - 10, size=isl).tolist()
+
+    def mk_req(i, prefix=None):
+        toks = list(prefix) if prefix else rng.integers(
+            10, cfg.vocab_size - 10, size=isl
+        ).tolist()
+        return PreprocessedRequest(
+            token_ids=toks,
+            request_id=f"crash-{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+
+    async def run_one(req):
+        n = 0
+        last = time.monotonic()
+        stall = 0.0
+        try:
+            async for out in mig.generate(req, Context(), client):
+                now = time.monotonic()
+                stall = max(stall, now - last)
+                last = now
+                err = out.get("error") if isinstance(out, dict) else out.error
+                if err:
+                    return (n, stall, str(err))
+                toks = (
+                    out.get("token_ids") if isinstance(out, dict)
+                    else out.token_ids
+                )
+                n += len(toks or [])
+        except Exception as exc:
+            return (n, stall, f"{type(exc).__name__}: {exc}")
+        return (n, stall, None)
+
+    try:
+        # Warm both engines; seed the source's prefix cache with the
+        # shared prefix so the restart checkpoint carries something warm.
+        await asyncio.gather(
+            collect_silent(source, mk_req(10_000, prefix=shared_prefix)),
+            collect_silent(source, mk_req(10_001)),
+            collect_silent(peer, mk_req(20_000)),
+            collect_silent(peer, mk_req(20_001)),
+        )
+        await source.save_checkpoint(ckpt_dir)
+
+        reprefill0 = mig.metrics.reprefill_tokens.value()
+        t0 = time.monotonic()
+        tasks = [
+            asyncio.ensure_future(run_one(mk_req(i)))
+            for i in range(2 * concurrency)
+        ]
+        await asyncio.sleep(1.0)  # first wave mid-decode
+        # kill -9: the source goes silent and its reports stop. Nothing
+        # cooperative happens from here on.
+        alive[1] = False
+        crash_src.dead.set()
+        kill_at[0] = time.monotonic()
+        results = await asyncio.gather(*tasks)
+        wall = time.monotonic() - t0
+        lost = sum(1 for n, _s, err in results if err is not None or n != osl)
+
+        # Warm restart: a fresh engine restores the dead worker's
+        # checkpoint, then serves a shared-prefix request.
+        restarted = mk_engine()
+        try:
+            t_r = time.monotonic()
+            restored_blocks = await restarted.load_checkpoint(ckpt_dir)
+            restore_ms = (time.monotonic() - t_r) * 1000
+            await collect_silent(
+                restarted, mk_req(30_000, prefix=shared_prefix)
+            )
+            warm_prefill_tokens = restarted.stats().get("prefill_tokens", 0)
+        finally:
+            await restarted.stop()
+
+        return {
+            "model": cfg.name,
+            "isl": isl,
+            "osl": osl,
+            "concurrency": concurrency,
+            "streams": len(results),
+            "wall_s": round(wall, 3),
+            # THE contract: an unplanned death loses nothing.
+            "lost_requests": lost,
+            "detection_ms": round(detection.get("latency_s", 0.0) * 1000, 1),
+            "detection_budget_ms": int(
+                tracker.config.detection_budget_s * 1000
+            ),
+            "aborted_streams": detection.get("aborted_streams", 0),
+            "reprefill_tokens": int(
+                mig.metrics.reprefill_tokens.value() - reprefill0
+            ),
+            "max_midstream_stall_s": round(
+                max((s for _n, s, _e in results), default=0.0), 3
+            ),
+            "restore_ms": round(restore_ms, 1),
+            "restored_blocks": restored_blocks,
+            "warm_prefill_tokens": int(warm_prefill_tokens),
+            "fault_plane": _fault_plane_record(fault_activity0),
+        }
+    finally:
+        liveness_task.cancel()
+        from dynamo_tpu.runtime.tasks import reap_task
+
+        await reap_task(liveness_task, "bench liveness loop")
+        for s in served:
+            await s.shutdown(grace_period=1)
+        await rt.shutdown(grace_period=1)
+        await source.stop()
+        await peer.stop()
+        import gc
+
+        del source, peer
+        gc.collect()
+
+
 async def collect_silent(engine, req):
     """Drain one warmup stream, ignoring its outputs."""
     from dynamo_tpu.runtime.context import Context
@@ -1224,6 +1452,21 @@ async def run_bench():
             out["drain"] = await run_drain_leg()
         except Exception as exc:
             out["drain"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if (
+        os.environ.get("BENCH_CRASH", "1") != "0"
+        and model_name == "qwen2.5-0.5b"
+        and jax.default_backend() == "tpu"
+    ):
+        # Crash leg (ISSUE 10): a worker goes silent mid-load (the kill -9
+        # shape); lost_requests must be 0, detection latency bounded by the
+        # missed-report budget, re-prefilled tokens + warm-restart
+        # restore_ms recorded. Never kills the headline; skipped-exit-0
+        # contract untouched.
+        try:
+            out["crash"] = await run_crash_leg()
+        except Exception as exc:
+            out["crash"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(out))
 
 

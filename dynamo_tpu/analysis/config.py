@@ -146,6 +146,10 @@ class RingWriterConfig:
             # KVBM integrity events (tier corruption); single writer: the
             # manager's event loop (onboard + offload spill paths).
             "kvbm": ("kvbm/manager.py", "TieredKvManager"),
+            # Crash plane (PR 10): worker suspect/dead/rejoin transitions
+            # + stale-incarnation drops; single writer: the consuming
+            # frontend's event loop (worker_monitor pump + evaluate task).
+            "liveness": ("runtime/liveness.py", "LivenessTracker"),
         }
     )
 

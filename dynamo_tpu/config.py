@@ -142,6 +142,34 @@ OVERLOAD_BROWNOUT_MAX_TOKENS = env_int(
     "DYN_TPU_OVERLOAD_BROWNOUT_MAX_TOKENS", 256,
     "max_tokens clamp applied while browned out",
 )
+# -- crash plane (runtime/liveness.py; docs/design_docs/fault_tolerance.md)
+LOAD_REPORT_INTERVAL_S = env_float(
+    "DYN_TPU_LOAD_REPORT_INTERVAL_S", 1.0,
+    "Worker load-report publish cadence (router/publisher.py "
+    "LoadPublisher). The liveness detection budget is denominated in "
+    "these intervals, so shrinking it tightens dead-worker detection",
+)
+LIVENESS_INTERVAL_S = env_float(
+    "DYN_TPU_LIVENESS_INTERVAL_S", 1.0,
+    "Expected worker load-report cadence the frontend's liveness tracker "
+    "judges missed intervals against (match the LoadPublisher interval)",
+)
+LIVENESS_SUSPECT_AFTER = env_int(
+    "DYN_TPU_LIVENESS_SUSPECT_AFTER", 2,
+    "Missed load-report intervals before a worker is SUSPECT",
+)
+LIVENESS_DEAD_AFTER = env_int(
+    "DYN_TPU_LIVENESS_DEAD_AFTER", 5,
+    "Missed load-report intervals before a worker is DEAD: drop_worker "
+    "reconciliation runs and its in-flight streams abort into migration "
+    "(detection-to-migration is bounded by dead_after x interval)",
+)
+WORKER_ID = env_int(
+    "DYN_TPU_WORKER_ID", 0,
+    "Stable worker identity across restarts (0 = random per start). A "
+    "restarted worker re-registers under the SAME id with a fresh "
+    "incarnation so warm rejoin and incarnation fencing line up",
+)
 GRACE_PERIOD = env_float("DYN_TPU_GRACE_PERIOD", 30.0, "Graceful-shutdown drain seconds")
 DRAIN_DEADLINE_S = env_float(
     "DYN_TPU_DRAIN_DEADLINE_S", 30.0,

@@ -108,6 +108,23 @@ def render_pod(
                 }
             }
         }
+        # Probe split (crash plane, runtime/system_server.py): /healthz is
+        # liveness ONLY — the event loop turns; restarting would not help
+        # a slow KV-checkpoint restore and would instead crash-loop it.
+        # /readyz gates traffic: 503 until the worker restored its warm
+        # cache and registered (and again while draining), so the kubelet
+        # keeps an un-warm or departing worker out of Service endpoints
+        # without ever killing it.
+        container["livenessProbe"] = {
+            "httpGet": {"path": "/healthz", "port": svc.system_port},
+            "periodSeconds": 5,
+            "failureThreshold": 3,
+        }
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/readyz", "port": svc.system_port},
+            "periodSeconds": 2,
+            "failureThreshold": 1,
+        }
     spec: Dict[str, Any] = {
         "restartPolicy": "Never",  # the reconcile loop owns recreation
         "containers": [container],

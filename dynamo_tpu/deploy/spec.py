@@ -78,6 +78,10 @@ class ServiceSpec:
     # httpGet hook hits GET /drain?start=1 (the kubelet blocks on the
     # response, which is the live-handoff drain completing) and the pod's
     # terminationGracePeriodSeconds is sized to drain_deadline_s + margin.
+    # It also renders the crash-plane probe split: livenessProbe /healthz
+    # (process-up only; a restore in progress is NOT a reason to restart)
+    # and readinessProbe /readyz (warm restore + registration done —
+    # traffic only past this gate).
     system_port: int = 0
     # Drain budget advertised to k8s (DYN_TPU_DRAIN_DEADLINE_S should
     # match); only meaningful with system_port > 0.

@@ -35,6 +35,11 @@ from dynamo_tpu.runtime import fault_names, lifecycle
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.device_observe import FlightRecorder
 from dynamo_tpu.runtime.faults import fault_point, note_activity
+from dynamo_tpu.runtime.liveness import (
+    StaleIncarnationError,
+    note_stale_drop,
+    process_incarnation,
+)
 from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
@@ -331,6 +336,11 @@ class PrefillHandler:
                     "block_hashes": hashes,
                     "block_size": block_size,
                     "first_token": first.token_ids[0],
+                    # Incarnation fencing: the decode worker's pull only
+                    # trusts replies stamped with THIS incarnation — a
+                    # restarted prefill worker no longer holds the
+                    # promised blocks, and a zombie's pool is stale.
+                    "incarnation": process_incarnation(),
                     # Transfer-cost inputs for link-aware decode placement
                     # (router/scheduler.py TransferContext): what one
                     # overlap-miss block costs on the wire from THIS worker.
@@ -409,6 +419,9 @@ class KvTransferHandler:
         hashes: List[int] = list(request.get("block_hashes") or [])
         wire_dtype = self._negotiate_wire_dtype(request)
         per = self._blocks_per_chunk(wire_dtype)
+        # Every reply chunk carries the exporter's incarnation so the
+        # importer can fence a zombie/restarted exporter's payload.
+        inc = process_incarnation()
         sent_any = False
         for off in range(0, len(hashes), per):
             chunk = hashes[off : off + per]
@@ -428,6 +441,7 @@ class KvTransferHandler:
                     "k": pack_array(k),
                     "v": pack_array(v),
                     "done": done,
+                    "inc": inc,
                 }
             else:
                 found, wire = await self._engine.export_blocks_wire_async(chunk)
@@ -439,11 +453,13 @@ class KvTransferHandler:
                     wire = KvWireBlocks.dense(*wire.to_dense(wire_dtype))
                 sent_any = True
                 done = off + per >= len(hashes) or len(found) < len(chunk)
-                yield {"found": found, "kv": pack_kv(wire), "done": done}
+                yield {"found": found, "kv": pack_kv(wire), "done": done,
+                       "inc": inc}
             if len(found) < len(chunk):
                 return
         if not sent_any:
-            yield {"found": [], "kv": None, "k": None, "v": None, "done": True}
+            yield {"found": [], "kv": None, "k": None, "v": None,
+                   "done": True, "inc": inc}
 
 
 class DecodeHandler:
@@ -604,6 +620,7 @@ class DecodeHandler:
         anchor: Optional[int],
         src: Optional[int],
         acct: Dict[str, int],
+        expect_inc: Optional[int] = None,
     ) -> None:
         """One pull attempt over the missing tail. Chunked: each reply is a
         bounded slice, imported as it lands — device scatters and the
@@ -631,6 +648,22 @@ class DecodeHandler:
                 },
             }, src
         ):
+            # Incarnation fence: the bootstrap named the incarnation that
+            # computed (and promised) these blocks. A reply stamped with
+            # any OTHER incarnation — a zombie's late chunks, or a
+            # restarted exporter whose pool no longer holds them — is
+            # counted and dropped, never scattered into our pool.
+            reply_inc = reply.get("inc")
+            if (
+                expect_inc and reply_inc is not None
+                and reply_inc != expect_inc
+            ):
+                note_stale_drop("pull_reply")
+                raise StaleIncarnationError(
+                    f"KV pull reply from prefill worker {src} carries "
+                    f"incarnation {reply_inc}, bootstrap promised "
+                    f"{expect_inc} — the worker restarted; re-prefill"
+                )
             found = reply.get("found") or []
             wire = unpack_reply(reply)
             if not found or wire is None:
@@ -681,6 +714,7 @@ class DecodeHandler:
         if self._first_missing(hashes) is None:
             return 0
         src = dp.worker_id
+        expect_inc = info.get("incarnation")
         breaker = self._breaker_for(src)
         if not breaker.allow():
             # Fail fast: the (src → me) link is open-circuit. No wire time
@@ -723,7 +757,8 @@ class DecodeHandler:
                         "request deadline exhausted before the pull"
                     )
                 await asyncio.wait_for(
-                    self._pull_once(want, anchor, src, acct), timeout
+                    self._pull_once(want, anchor, src, acct, expect_inc),
+                    timeout,
                 )
                 breaker.record_success()
                 break

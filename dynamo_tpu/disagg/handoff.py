@@ -173,6 +173,12 @@ class HandoffHandler:
             logger.warning("handoff refused: %s", exc)
             yield {"accepted": False, "reason": str(exc)}
             return
-        yield {"accepted": True}
+        # The ack carries the adopter's incarnation: the source fences a
+        # zombie peer's late ack (runtime/liveness.py) — releasing the
+        # source KV copy on a dead incarnation's promise would lose the
+        # stream.
+        from dynamo_tpu.runtime.liveness import process_incarnation
+
+        yield {"accepted": True, "inc": process_incarnation()}
         async for out in self._engine.stream_adopted(seq):
             yield out.to_dict()

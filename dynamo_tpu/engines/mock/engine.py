@@ -13,8 +13,13 @@ Semantics:
     blocks are skipped, exactly like a real paged engine;
   - each decode tick appends one token per running sequence with a simulated
     inter-token latency;
-  - generated tokens are a deterministic PRNG stream seeded by the prompt, so
-    tests can assert reproducibility;
+  - generated tokens are a deterministic function of the whole token PREFIX
+    (a per-token hash fold), so tests can assert reproducibility AND a
+    migrated/handed-off continuation (the frontend re-dispatches prompt +
+    already-streamed tokens, llm/migration.py _carry_tokens) produces
+    exactly the tokens a never-migrated oracle would — the same
+    prefix-determinism contract the real engine's fold_in(seed, salt, pos)
+    sampling keys give (crash-plane soak relies on this);
   - KV events (stored/removed) are emitted for router indexing.
 """
 
@@ -39,6 +44,28 @@ from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+_RNG_SEED = 0x9E3779B97F4A7C15
+
+
+def _fold_token(state: int, token: int) -> int:
+    """One step of the prefix hash fold: state_{p+1} = H(state_p || token).
+    Folding the same token sequence from _RNG_SEED always lands on the
+    same state, no matter how the sequence was split between 'prompt' and
+    'generated' — the property migration carry needs."""
+    return int.from_bytes(
+        hashlib.blake2b(
+            state.to_bytes(8, "little") + int(token).to_bytes(8, "little"),
+            digest_size=8,
+        ).digest(),
+        "little",
+    )
+
+
+def _fold_tokens(state: int, tokens) -> int:
+    for t in tokens:
+        state = _fold_token(state, t)
+    return state
 
 
 @dataclass
@@ -131,12 +158,7 @@ class MockEngine:
             if self.args.enable_prefix_caching
             else [],
             all_tokens=prompt,
-            rng_state=int.from_bytes(
-                hashlib.blake2b(
-                    b"".join(t.to_bytes(4, "little") for t in prompt), digest_size=8
-                ).digest(),
-                "little",
-            ),
+            rng_state=_fold_tokens(_RNG_SEED, prompt),
         )
         self._waiting.append(seq)
         self._wake.set()
@@ -291,14 +313,19 @@ class MockEngine:
         if self.args.echo:
             idx = len(seq.generated) % len(seq.request.token_ids)
             return seq.request.token_ids[idx]
-        # xorshift64* PRNG: deterministic per prompt.
-        x = seq.rng_state or 0x9E3779B97F4A7C15
+        # Prefix-keyed: rng_state is a hash fold of EVERY token so far
+        # (prompt + generated), so token p depends only on tokens[:p].
+        # A carried re-dispatch (prompt + streamed tokens) therefore
+        # continues the oracle's exact stream — xorshift64* whitens the
+        # fold state into a token.
+        x = seq.rng_state or _RNG_SEED
         x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
         x ^= x >> 7
         x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
-        seq.rng_state = x
         # Avoid emitting special/eos tokens (ids 0..3 in the tiny tokenizer).
-        return 4 + (x % (self.args.vocab_size - 4))
+        token = 4 + (x % (self.args.vocab_size - 4))
+        seq.rng_state = _fold_token(seq.rng_state, token)
+        return token
 
     def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
         if seq.held_hashes:

@@ -7,6 +7,20 @@ explicitly: weights via models/weight_cache.py (GMS tiers), the warmed KV
 prefix cache via these functions. A restored worker serves shared-prefix
 traffic without re-prefilling.
 
+Crash-plane contract (ISSUE 10): restore can NEVER be the reason a worker
+fails to come up. Every failure mode resolves to a logged cold start with
+a counted outcome (runtime/liveness.py ``restore_outcome_total``):
+
+  * the manifest carries a **compatibility stamp** (model, block layout,
+    engine sampling seed — the seed gates bit-identical continuation the
+    same way handoff tickets do); a mismatched stamp skips the restore
+    (``cold_mismatch``), it does not raise;
+  * every block row carries its own CRC32, so partial corruption drops
+    ONLY the bad blocks (and their now-unreachable children) — the rest
+    restore (``partial``); a fully unreadable archive is ``cold_corrupt``;
+  * anything else (including the ``restore.load`` chaos seam) is
+    ``cold_error``.
+
 Split from the engine monolith: the engine exposes thin
 save_checkpoint/load_checkpoint delegates; all manifest/order logic lives
 here.
@@ -16,19 +30,23 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import uuid
 import zipfile
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from dynamo_tpu.runtime import fault_names
+from dynamo_tpu.runtime.faults import fault_point
+from dynamo_tpu.runtime.liveness import note_restore
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
 class _CorruptCheckpoint(Exception):
-    """CRC mismatch in a checkpoint data file (internal control flow)."""
+    """Whole-archive integrity failure (internal control flow)."""
 
 
 def read_manifest(ckpt_dir: str):
@@ -39,9 +57,36 @@ def read_manifest(ckpt_dir: str):
         return None
 
 
+def _stamp_of(engine: Any) -> Dict[str, Any]:
+    """The compatibility stamp: restored KV is only valid on an engine
+    with the same weights/layout, and only bit-identically continuable
+    with the same sampling seed (the fold_in(seed, salt, pos) keys)."""
+    return {
+        "model": engine.config.name,
+        "block_size": engine.args.block_size,
+        "n_layers": engine.config.n_layers,
+        "n_kv_heads": engine.config.n_kv_heads,
+        "head_dim": engine.config.head_dim_,
+        "seed": getattr(engine.args, "seed", 0),
+    }
+
+
+def stamp_mismatch(manifest: Dict[str, Any], engine: Any) -> Optional[str]:
+    """First mismatching stamp field as ``"key: theirs != ours"``, or
+    None when compatible. Manifests older than the seed stamp (no "seed"
+    key) only check the fields they carry."""
+    for key, ours in _stamp_of(engine).items():
+        if key == "seed" and "seed" not in manifest:
+            continue  # pre-stamp manifest: seedless, shape-checked only
+        theirs = manifest.get(key)
+        if theirs != ours:
+            return f"{key}: checkpoint {theirs!r} != engine {ours!r}"
+    return None
+
+
 async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
     """Persist the warm prefix cache: every committed KV block plus its
-    hash-chain metadata."""
+    hash-chain metadata, CRC-stamped per block row."""
     os.makedirs(ckpt_dir, exist_ok=True)
     snap = engine.pool.snapshot_committed()
     hashes = [h for h, _, _ in snap]
@@ -52,31 +97,31 @@ async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
         # pointing at the OLD data — never a mismatched pair (same
         # atomic-publish rule as models/weight_cache.py save_params).
         data_name = f"kv_blocks-{uuid.uuid4().hex[:12]}.npz" if ids else ""
-        crc = {}
+        crc_k: List[int] = []
+        crc_v: List[int] = []
         if ids:
             def gather_and_write():
                 from dynamo_tpu.kvbm.integrity import array_crc32
 
                 k, v = engine.runner.gather_blocks(ids)
-                # Per-array CRC32 stamped into the manifest: a restore
-                # verifies before installing, so a corrupt/truncated data
-                # file is a counted miss, never silently-garbage KV.
-                crc["k"] = array_crc32(k)
-                crc["v"] = array_crc32(v)
+                # Per-BLOCK CRC32 stamped into the manifest: restore
+                # verifies row by row, so partial corruption drops only
+                # the bad blocks instead of the whole warm cache.
+                for i in range(len(ids)):
+                    crc_k.append(array_crc32(k[i]))
+                    crc_v.append(array_crc32(v[i]))
                 # Disk write stays off the event loop (multi-GB stall).
                 np.savez(os.path.join(ckpt_dir, data_name), k=k, v=v)
 
             await engine._device(gather_and_write)
         manifest = {
-            "version": 1,
-            "model": engine.config.name,
-            "block_size": engine.args.block_size,
-            "n_layers": engine.config.n_layers,
-            "n_kv_heads": engine.config.n_kv_heads,
-            "head_dim": engine.config.head_dim_,
+            "version": 2,
+            **_stamp_of(engine),
             "data": data_name,
-            "crc": crc,
-            "blocks": [{"hash": h, "parent": p} for h, p, _ in snap],
+            "blocks": [
+                {"hash": h, "parent": p, "crc_k": ck, "crc_v": cv}
+                for (h, p, _), ck, cv in zip(snap, crc_k, crc_v)
+            ],
         }
         tmp = os.path.join(ckpt_dir, f".manifest-{uuid.uuid4().hex[:8]}")
         with open(tmp, "w") as f:
@@ -96,45 +141,81 @@ async def save_checkpoint(engine: Any, ckpt_dir: str) -> Dict[str, Any]:
 
 
 async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
-    """Restore a save_checkpoint() capture into the pool as cached content.
-    Returns the number of blocks installed (stops early when the pool is
-    dry); raises ValueError on a shape/model mismatch."""
-    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-        manifest = json.load(f)
-    for key, ours in (
-        ("model", engine.config.name),
-        ("block_size", engine.args.block_size),
-        ("n_layers", engine.config.n_layers),
-        ("n_kv_heads", engine.config.n_kv_heads),
-        ("head_dim", engine.config.head_dim_),
-    ):
-        if manifest.get(key) != ours:
-            raise ValueError(
-                f"checkpoint {key}={manifest.get(key)!r} does not match "
-                f"engine {key}={ours!r}"
-            )
+    """Restore a save_checkpoint() capture into the pool as cached
+    content. Returns the number of blocks installed. NEVER raises on a
+    bad checkpoint: a mismatched stamp, a corrupt/truncated archive, an
+    empty directory, or the restore machinery failing outright all
+    resolve to a logged, metric-counted cold start (0 blocks) — a crash
+    loop here would turn one bad file into an unserving fleet."""
+    t0 = time.monotonic()
+    try:
+        return await _load_checkpoint(engine, ckpt_dir, t0)
+    except Exception as exc:
+        # The restore machinery itself failed (the restore.load chaos
+        # seam injects exactly this): cold start, counted, never a crash.
+        note_restore("cold_error", time.monotonic() - t0)
+        logger.warning(
+            "KV checkpoint restore from %s failed (%s: %s); starting cold",
+            ckpt_dir, type(exc).__name__, exc,
+        )
+        return 0
+
+
+async def _load_checkpoint(engine: Any, ckpt_dir: str, t0: float) -> int:
+    # Chaos seam: one hit per restore attempt, before anything is read —
+    # an injected error proves the cold_error path (counted cold start).
+    fault_point(fault_names.RESTORE_LOAD, dir=ckpt_dir)
+    manifest = read_manifest(ckpt_dir)
+    if manifest is None:
+        # Empty/fresh checkpoint dir: the normal first boot.
+        note_restore("empty", time.monotonic() - t0)
+        return 0
+    mismatch = stamp_mismatch(manifest, engine)
+    if mismatch is not None:
+        # A different model/layout/seed wrote this checkpoint (image
+        # upgrade, config change): its KV is not ours to install.
+        note_restore("cold_mismatch", time.monotonic() - t0)
+        logger.warning(
+            "KV checkpoint %s stamp mismatch (%s); starting cold",
+            ckpt_dir, mismatch,
+        )
+        return 0
     blocks = manifest.get("blocks", [])
     if not blocks:
+        note_restore("empty", time.monotonic() - t0)
         return 0
     data_name = manifest.get("data") or "kv_blocks.npz"
-    want_crc = manifest.get("crc") or {}
+    legacy_crc = manifest.get("crc") or {}
+
+    corrupt_rows: List[int] = []
 
     def read():  # disk read off the event loop
         from dynamo_tpu.kvbm.integrity import array_crc32
 
         data = np.load(os.path.join(ckpt_dir, data_name))
         k, v = data["k"], data["v"]
-        # Verify BEFORE anything lands in the pool. Manifests written
-        # before the CRC stamp (no "crc" field) restore unverified.
-        for name, arr in (("k", k), ("v", v)):
-            want = want_crc.get(name)
-            if want is None:
+        if len(k) != len(blocks) or len(v) != len(blocks):
+            raise _CorruptCheckpoint(
+                f"{data_name} holds {len(k)}/{len(v)} rows for "
+                f"{len(blocks)} manifest blocks"
+            )
+        # Verify BEFORE anything lands in the pool. v2 manifests carry a
+        # CRC per block row — only the bad rows (and their chain
+        # descendants) are dropped; v1 manifests fall back to the
+        # whole-array CRC (all-or-nothing); older ones restore unverified.
+        for i, b in enumerate(blocks):
+            want_k, want_v = b.get("crc_k"), b.get("crc_v")
+            if want_k is None and want_v is None:
                 continue
-            got = array_crc32(arr)
-            if got != int(want):
+            if (want_k is not None and array_crc32(k[i]) != int(want_k)) or (
+                want_v is not None and array_crc32(v[i]) != int(want_v)
+            ):
+                corrupt_rows.append(i)
+        for name, arr in (("k", k), ("v", v)):
+            want = legacy_crc.get(name)
+            if want is not None and array_crc32(arr) != int(want):
                 raise _CorruptCheckpoint(
-                    f"{data_name}:{name} CRC mismatch "
-                    f"(manifest {want}, file {got})"
+                    f"{data_name}:{name} CRC mismatch (manifest {want})"
                 )
         return k, v
 
@@ -144,13 +225,14 @@ async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
         _CorruptCheckpoint, OSError, ValueError, KeyError,
         zipfile.BadZipFile,
     ) as exc:
-        # Corrupt or truncated data file: a counted miss — the worker
-        # starts cold instead of crashing (or worse, attending over
-        # garbage KV). A truncated npz raises BadZipFile (a plain
+        # Fully corrupt or truncated data file: a counted miss — the
+        # worker starts cold instead of crashing (or worse, attending
+        # over garbage KV). A truncated npz raises BadZipFile (a plain
         # Exception, NOT an OSError); OSError/ValueError cover the rest.
         from dynamo_tpu.kvbm.integrity import note_corruption
 
         note_corruption("checkpoint")
+        note_restore("cold_corrupt", time.monotonic() - t0)
         note_fn = getattr(engine, "record_ckpt_corruption", None)
         if note_fn is not None:
             note_fn(f"{type(exc).__name__}: {exc}")
@@ -159,9 +241,25 @@ async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
             "nothing — next requests prefill cold", ckpt_dir, exc,
         )
         return 0
-    index_of = {b["hash"]: i for i, b in enumerate(blocks)}
+    if corrupt_rows:
+        from dynamo_tpu.kvbm.integrity import note_corruption
 
-    # Parents-first install order (chains form a forest).
+        note_corruption("checkpoint", len(corrupt_rows))
+        note_fn = getattr(engine, "record_ckpt_corruption", None)
+        if note_fn is not None:
+            note_fn(f"{len(corrupt_rows)} block rows failed CRC")
+        logger.warning(
+            "KV checkpoint %s: dropping %d/%d blocks with CRC mismatches "
+            "(their chain descendants become unreachable and drop too)",
+            ckpt_dir, len(corrupt_rows), len(blocks),
+        )
+        bad = set(corrupt_rows)
+        blocks = [b for i, b in enumerate(blocks) if i not in bad]
+    index_of = {b["hash"]: i for i, b in enumerate(manifest.get("blocks", []))}
+
+    # Parents-first install order (chains form a forest). A block whose
+    # parent was CRC-dropped never progresses and is pruned here — a
+    # child must not commit under a parent that never installed.
     placed = set()
     ordered: List[Dict[str, Any]] = []
     pending = list(blocks)
@@ -203,5 +301,17 @@ async def load_checkpoint(engine: Any, ckpt_dir: str) -> int:
             anchor_parent=run[0]["parent"],
         )
         i = j
-    logger.info("restored %d KV blocks from %s", installed, ckpt_dir)
+    total = len(manifest.get("blocks", []))
+    # "partial" means CORRUPTION dropped blocks — the signal operators
+    # alert on. A clean checkpoint that installs fewer than the manifest
+    # lists for capacity reasons (pool dry, resident blocks, a child
+    # pruned under an absent-but-uncorrupt parent) is still "restored";
+    # the installed/total counts are in the log line.
+    note_restore(
+        "partial" if corrupt_rows else "restored",
+        time.monotonic() - t0,
+    )
+    logger.info(
+        "restored %d/%d KV blocks from %s", installed, total, ckpt_dir
+    )
     return installed

@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 from dynamo_tpu.router.protocols import LoadSnapshot, load_topic
+from dynamo_tpu.runtime.liveness import LivenessTracker
 from dynamo_tpu.runtime.tasks import reap_task
 from dynamo_tpu.utils.logging import get_logger
 
@@ -57,6 +58,7 @@ class WorkerLoadMonitor:
         component: str,
         *,
         stale_after_s: float = 10.0,
+        liveness: Optional[LivenessTracker] = None,
     ) -> None:
         self._plane = event_plane
         self._topic = load_topic(namespace, component)
@@ -64,21 +66,50 @@ class WorkerLoadMonitor:
         self._loads: Dict[Tuple[int, int], Tuple[LoadSnapshot, float]] = {}
         self._sub = None
         self._task: Optional[asyncio.Task] = None
+        # Crash plane (runtime/liveness.py): the monitor already consumes
+        # every load report, so it is where missed-report liveness lives —
+        # the pump feeds the tracker (fencing stale incarnations out of
+        # ``_loads`` too) and an evaluation task runs detection sweeps on
+        # a fraction of the report cadence.
+        self.liveness = liveness
+        self._eval_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         self._sub = self._plane.subscribe(self._topic)
         self._task = asyncio.get_running_loop().create_task(
             self._pump(), name=f"worker-monitor:{self._topic}"
         )
+        if self.liveness is not None:
+            self._eval_task = asyncio.get_running_loop().create_task(
+                self._evaluate_loop(), name=f"liveness:{self._topic}"
+            )
 
     async def stop(self) -> None:
         if self._sub is not None:
             await self._sub.aclose()
             self._sub = None
-        if self._task is not None:
-            self._task.cancel()
-            await reap_task(self._task, "worker-load monitor pump", logger)
-            self._task = None
+        for task, what in (
+            (self._task, "worker-load monitor pump"),
+            (self._eval_task, "liveness evaluate loop"),
+        ):
+            if task is not None:
+                task.cancel()
+                await reap_task(task, what, logger)
+        self._task = None
+        self._eval_task = None
+
+    async def _evaluate_loop(self) -> None:
+        # Half the report interval: detection latency error from sweep
+        # granularity stays well inside the missed-report budget.
+        interval = max(self.liveness.config.interval_s / 2.0, 0.01)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.liveness.evaluate()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("liveness evaluation sweep failed")
 
     async def _pump(self) -> None:
         async for _topic, payload in self._sub:
@@ -87,6 +118,23 @@ class WorkerLoadMonitor:
             except Exception:
                 logger.exception("bad load snapshot payload")
                 continue
+            if self.liveness is not None:
+                try:
+                    verdict = self.liveness.observe_report(
+                        snap.worker_id, snap.incarnation
+                    )
+                except Exception:
+                    # The liveness.report chaos seam (or a real tracker
+                    # bug) fired: the report is LOST before admission —
+                    # exactly the condition detection exists for. Drop it;
+                    # enough consecutive losses trip suspect/dead.
+                    logger.debug(
+                        "load report from %#x lost at the liveness seam",
+                        snap.worker_id, exc_info=True,
+                    )
+                    continue
+                if verdict == "stale":
+                    continue  # a zombie incarnation's late publish
             self._loads[(snap.worker_id, snap.dp_rank)] = (snap, time.monotonic())
 
     def fresh_loads(self) -> Dict[Tuple[int, int], LoadSnapshot]:

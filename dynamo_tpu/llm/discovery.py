@@ -35,10 +35,16 @@ async def register_llm(
     card: ModelDeploymentCard,
     endpoint: Endpoint,
     instance_id: int,
+    incarnation: int = 0,
 ) -> str:
     """Publish the model card for a served endpoint instance. Returns the
     discovery key. The card rides the runtime's serving lease, so it vanishes
-    with the worker (liveness, ref: watcher.rs delete handling)."""
+    with the worker (liveness, ref: watcher.rs delete handling).
+
+    ``incarnation`` (runtime/liveness.py process_incarnation) rides the doc
+    so the frontend's liveness tracker fences the registration itself: a
+    restarted worker re-registering under the same instance_id announces
+    its fresh incarnation before its first load report arrives."""
     key = model_key(endpoint.namespace, card.slug, instance_id)
     doc = {
         "card": card.to_dict(),
@@ -48,9 +54,11 @@ async def register_llm(
             "endpoint": endpoint.name,
         },
         "instance_id": instance_id,
+        "incarnation": incarnation,
     }
-    lease = await runtime._lease_for_serving()
-    await runtime.discovery.put(key, doc, lease=lease)
+    # put_leased remembers the doc: a control-plane outage that expires
+    # the lease gets the card re-registered automatically on recovery.
+    await runtime.put_leased(key, doc)
     logger.info("registered model %s at %s", card.name, key)
     return key
 
@@ -73,6 +81,8 @@ class ModelWatcher:
         enable_canary: bool = False,
         canary_interval_s: float = 5.0,
         canary_timeout_s: float = 10.0,
+        enable_liveness: bool = True,
+        liveness_config: Optional[Any] = None,  # runtime.liveness.LivenessConfig
     ) -> None:
         self._runtime = runtime
         self._manager = model_manager
@@ -86,6 +96,10 @@ class ModelWatcher:
         self.enable_canary = enable_canary
         self.canary_interval_s = canary_interval_s
         self.canary_timeout_s = canary_timeout_s
+        # Crash plane: missed-load-report dead-worker detection with the
+        # drop_worker + stream-abort reconciliation (runtime/liveness.py).
+        self.enable_liveness = enable_liveness
+        self._liveness_config = liveness_config
         # model slug → state
         self._models: Dict[str, Dict[str, Any]] = {}
         self._task: Optional[asyncio.Task] = None
@@ -141,6 +155,13 @@ class ModelWatcher:
         state = self._models.get(slug)
         if state is not None:
             state["instances"].add(doc["instance_id"])
+            if state.get("liveness") is not None and doc.get("incarnation"):
+                # Registration is evidence of life AND of identity: seed
+                # the fence/last-seen now so a warm-rejoining worker's old
+                # incarnation is purged before its first load report.
+                state["liveness"].observe_report(
+                    doc["instance_id"], doc["incarnation"]
+                )
             return
         card = ModelDeploymentCard.from_dict(doc["card"])
         ep_info = doc["endpoint"]
@@ -208,11 +229,69 @@ class ModelWatcher:
             )
         pipeline = build_pipeline(operators, client)
         monitor = None
-        if self.enable_busy_monitor:
+        liveness = None
+        if self.enable_liveness:
+            from dynamo_tpu import config as _cfg
+            from dynamo_tpu.runtime.liveness import (
+                LivenessConfig,
+                LivenessTracker,
+                WorkerLostError,
+            )
+
+            liveness = LivenessTracker(
+                self._liveness_config
+                or LivenessConfig(
+                    interval_s=_cfg.LIVENESS_INTERVAL_S.get(),
+                    suspect_after=_cfg.LIVENESS_SUSPECT_AFTER.get(),
+                    dead_after=_cfg.LIVENESS_DEAD_AFTER.get(),
+                )
+            )
+            client.enable_stream_aborts()
+
+            def on_dead(worker_id: int, _inc: int, _router=router,
+                        _client=client, _liveness=liveness) -> None:
+                # The whole crash-recovery fan-out for an unplanned death:
+                # (1) one drop_worker reconciliation (charges, link pairs,
+                # breaker faults, radix entries), (2) routing eviction
+                # ahead of the discovery lease expiring, (3) every
+                # in-flight stream aborted into the migration ladder with
+                # the typed worker_lost reason — all bounded by the
+                # missed-report budget, none of it waiting on TCP.
+                if _router is not None:
+                    _router.drop_worker((worker_id, 0))
+                _client.evict_instance(worker_id)
+                aborted = _client.abort_instance(
+                    worker_id,
+                    WorkerLostError(
+                        f"worker {worker_id:#x} declared dead (missed "
+                        "load reports); re-dispatch with carried tokens"
+                    ),
+                )
+                if aborted:
+                    _liveness.note_streams_aborted(worker_id, aborted)
+
+            def on_rejoin(worker_id: int, _inc: int, _router=router,
+                          _client=client) -> None:
+                # A rejoin: purge whatever state the old incarnation left
+                # so the worker's reports and KV events rebuild from a
+                # clean slate (its restored prefixes arrive via the
+                # re-advertised snapshot) — and give its routing capacity
+                # back. A RESTARTED worker re-PUTs its key (the watch
+                # re-adds fresh transport), but a frozen-and-resumed one
+                # (same incarnation, no new PUT) only comes back through
+                # the revive; without it the eviction would be permanent.
+                if _router is not None:
+                    _router.drop_worker((worker_id, 0))
+                _client.revive_instance(worker_id)
+
+            liveness.add_dead_callback(on_dead)
+            liveness.add_rejoin_callback(on_rejoin)
+        if self.enable_busy_monitor or liveness is not None:
             from dynamo_tpu.http.worker_monitor import WorkerLoadMonitor
 
             monitor = WorkerLoadMonitor(
-                self._runtime.event_plane, ep_info["namespace"], ep_info["component"]
+                self._runtime.event_plane, ep_info["namespace"],
+                ep_info["component"], liveness=liveness,
             )
             await monitor.start()
         health = None
@@ -253,8 +332,11 @@ class ModelWatcher:
             "router": router,
             "monitor": monitor,
             "health": health,
+            "liveness": liveness,
             "instances": {doc["instance_id"]},
         }
+        if liveness is not None and doc.get("incarnation"):
+            liveness.observe_report(doc["instance_id"], doc["incarnation"])
         self._manager.register(
             card.name, pipeline, card, monitor=monitor, health=health,
             admin={"clear_kv": clear_kv},
@@ -274,6 +356,11 @@ class ModelWatcher:
             state["router"].remove_worker((iid, 0))
         if state.get("monitor") is not None and iid is not None:
             state["monitor"].drop_worker(iid)
+        if state.get("liveness") is not None and iid is not None:
+            # Discovery DELETE is the permanent departure: forget the
+            # tracker entry (and its fence) so dead workers don't
+            # accumulate across fleet turnover.
+            state["liveness"].drop(iid)
         if not state["instances"]:
             await self._remove_model(slug)
 

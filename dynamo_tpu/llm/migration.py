@@ -74,6 +74,14 @@ except ImportError:  # pragma: no cover
         pass
 
 
+try:
+    from dynamo_tpu.runtime.liveness import WorkerLostError
+except ImportError:  # pragma: no cover
+
+    class WorkerLostError(ConnectionError):  # type: ignore[no-redef]
+        pass
+
+
 # NOTE: asyncio.TimeoutError is a DISTINCT class from builtin TimeoutError
 # until Python 3.11 — both must be listed. DisaggTransferError subclasses
 # ConnectionError (already migratable); it is named for reason labeling.
@@ -97,6 +105,11 @@ def _failure_reason(exc: BaseException) -> str:
         # Planned churn (rolling restart / scale-down), not a fault: the
         # worker refused or handed back the stream while draining.
         return "drain"
+    if isinstance(exc, WorkerLostError):
+        # The crash plane declared the worker dead (missed load reports)
+        # and aborted the stream proactively — faster than any transport
+        # error would have surfaced.
+        return "worker_lost"
     if isinstance(exc, DisaggTransferError):
         return "disagg"
     if isinstance(exc, NoInstancesError):

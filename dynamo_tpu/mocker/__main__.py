@@ -15,10 +15,20 @@ from dynamo_tpu.utils.logging import configure_logging
 
 async def serve_mocker(args) -> None:
     runtime = DistributedRuntime.from_settings()
+    # Crash plane: the registration + every load report carry this
+    # process's incarnation, and --instance-id pins a stable identity so
+    # a SIGKILLed-and-restarted mocker rejoins as the SAME worker under a
+    # fresh incarnation (the chaos soak's restart contract).
+    from dynamo_tpu.runtime.liveness import process_incarnation
+
+    incarnation = process_incarnation()
     served = []
     cleanup = []
     for rank in range(args.num_workers):
-        instance_id = random.getrandbits(63)
+        instance_id = (
+            args.instance_id + rank if args.instance_id
+            else random.getrandbits(63)
+        )
         kv_pub = KvEventPublisher(
             runtime.event_plane, args.namespace, args.component, instance_id
         )
@@ -60,9 +70,14 @@ async def serve_mocker(args) -> None:
             .endpoint(args.endpoint)
         )
         served.append(
-            await endpoint.serve_endpoint(engine.generate, instance_id=instance_id)
+            await endpoint.serve_endpoint(
+                engine.generate, instance_id=instance_id,
+                metadata={"incarnation": incarnation},
+            )
         )
-        await register_llm(runtime, card, endpoint, instance_id)
+        await register_llm(
+            runtime, card, endpoint, instance_id, incarnation=incarnation
+        )
         load_pub.start()
         await engine.start()
         cleanup.extend([load_pub.close, kv_pub.close, engine.stop])
@@ -87,6 +102,11 @@ def main() -> None:
     parser.add_argument("--endpoint", default="generate")
     parser.add_argument("--num-workers", type=int, default=1,
                         help="mock engine instances in this process")
+    parser.add_argument("--instance-id", type=lambda s: int(s, 0), default=0,
+                        help="stable worker identity (rank offsets for "
+                        "--num-workers > 1; 0 = random). A restarted "
+                        "mocker under the same id rejoins as the same "
+                        "worker with a fresh incarnation (crash plane)")
     parser.add_argument("--block-size", type=int, default=16)
     parser.add_argument("--num-kv-blocks", type=int, default=1024)
     parser.add_argument("--max-num-seqs", type=int, default=32)

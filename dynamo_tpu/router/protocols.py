@@ -95,6 +95,11 @@ class LoadSnapshot:
     # draining — it refuses new work with a typed migratable error, so the
     # scheduler must stop placing anything here immediately.
     draining: bool = False
+    # Incarnation fencing (runtime/liveness.py): the publishing PROCESS's
+    # monotonically fresh incarnation stamp. 0 = an unstamped (pre-crash-
+    # plane) publisher; consumers fence only stamped reports, so mixed
+    # fleets interoperate.
+    incarnation: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

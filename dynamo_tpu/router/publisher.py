@@ -20,7 +20,8 @@ from dynamo_tpu.router.protocols import (
     kv_sync_topic,
     load_topic,
 )
-from dynamo_tpu.runtime.tasks import reap_task
+from dynamo_tpu.runtime.liveness import process_incarnation
+from dynamo_tpu.runtime.tasks import Backoff, reap_task
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -165,10 +166,11 @@ class LoadPublisher:
         *,
         dp_rank: int = 0,
         total_blocks: int = 0,
-        interval_s: float = 1.0,
+        interval_s: Optional[float] = None,
         link_bandwidth_fn: Optional[Callable[[], dict]] = None,
         link_faults_fn: Optional[Callable[[], list]] = None,
         kv_high_watermark: float = 1.0,
+        incarnation: Optional[int] = None,
     ) -> None:
         self._plane = event_plane
         self._topic = load_topic(namespace, component)
@@ -176,6 +178,12 @@ class LoadPublisher:
         self.dp_rank = dp_rank
         self._stats_fn = stats_fn
         self._total_blocks = total_blocks
+        # Cadence is env-tunable (DYN_TPU_LOAD_REPORT_INTERVAL_S): the
+        # liveness detection budget is denominated in these intervals.
+        if interval_s is None:
+            from dynamo_tpu import config as _cfg
+
+            interval_s = _cfg.LOAD_REPORT_INTERVAL_S.get()
         self.interval_s = interval_s
         # () -> {src prefill worker id: bytes/s} — the decode handler's
         # measured pull bandwidths, carried to the router's link-cost model
@@ -190,6 +198,12 @@ class LoadPublisher:
         # (overload backpressure). The stats dict's own value wins when
         # the engine reports one.
         self.kv_high_watermark = kv_high_watermark
+        # Incarnation fence stamp (runtime/liveness.py): consumers use it
+        # to drop a zombie's late reports and to spot a restart. Defaults
+        # to the process incarnation — one worker per process.
+        self.incarnation = (
+            incarnation if incarnation is not None else process_incarnation()
+        )
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
 
@@ -217,6 +231,7 @@ class LoadPublisher:
             # ``draining`` the moment begin_drain runs; the controller
             # also force-publishes so routers see it within one RTT).
             draining=bool(s.get("draining", 0)),
+            incarnation=self.incarnation,
         )
 
     async def publish_once(self) -> None:
@@ -230,13 +245,25 @@ class LoadPublisher:
             )
 
     async def _run(self) -> None:
+        # Publish-failure backoff: an event-plane blip hits EVERY worker's
+        # publisher at once — retrying each at its fixed cadence stampedes
+        # the recovering broker. The jittered schedule de-synchronizes the
+        # herd; the first success resets it. The cap is deliberately BELOW
+        # the liveness death budget (dead_after defaults to 5 intervals;
+        # worst post-recovery delay here is 2 × 1.5 jitter = 3 intervals),
+        # so a brief plane blip can never make healthy workers go silent
+        # past the budget and trigger a fleet-wide false-dead storm.
+        backoff = Backoff(base_s=self.interval_s, cap_s=2 * self.interval_s)
         while not self._stop.is_set():
+            delay = self.interval_s
             try:
                 await self.publish_once()
+                backoff.reset()
             except Exception:
                 logger.exception("failed to publish load snapshot")
+                delay = backoff.next_delay()
             try:
-                await asyncio.wait_for(self._stop.wait(), timeout=self.interval_s)
+                await asyncio.wait_for(self._stop.wait(), timeout=delay)
             except asyncio.TimeoutError:
                 pass
 

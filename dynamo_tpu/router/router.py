@@ -147,6 +147,11 @@ class KvRouter:
 
             self.indexer = ApproxKvIndexer(block_size, prune_config)
         self.scheduler = KvScheduler(config)
+        # drop_worker is the single purge path: the scheduler fans the
+        # radix-index removal out through this callback, so a crash-plane
+        # drop (or a rejoin under a fresh incarnation) reconciles charges,
+        # link pairs, breaker faults AND radix entries in one call.
+        self.scheduler.add_drop_callback(self.indexer.remove_worker)
         self.metrics = RouterMetrics(self.scheduler)
         self._tasks: list = []
         self._subs: list = []
@@ -247,9 +252,14 @@ class KvRouter:
             except Exception:
                 logger.exception("bad load payload")
 
+    def drop_worker(self, worker: WorkerKey) -> None:
+        """Crash-plane reconciliation: one call releases the scheduler's
+        in-flight charges, link pairs/faults, and (via the registered drop
+        callback) the radix index's entries for this worker."""
+        self.scheduler.drop_worker(worker)
+
     def remove_worker(self, worker: WorkerKey) -> None:
-        self.indexer.remove_worker(worker)
-        self.scheduler.remove_worker(worker)
+        self.drop_worker(worker)
 
     def register_metrics(self, server: Any) -> None:
         """Expose this router's metric families on a SystemStatusServer."""

@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dynamo_tpu.router.protocols import LoadSnapshot, WorkerKey
+from dynamo_tpu.runtime.liveness import IncarnationFence
 from dynamo_tpu.tokens.radix import OverlapScores
 from dynamo_tpu.utils.logging import get_logger
 
@@ -220,10 +221,44 @@ class KvScheduler:
         self.link_costs = LinkCostModel(
             self.config.default_link_bandwidth, self.config.link_ewma_alpha
         )
+        # Incarnation fence over load reports: a zombie incarnation's late
+        # publish is counted and dropped, a restarted worker's first fresh
+        # report triggers drop_worker FIRST so old and new state are never
+        # conflated (runtime/liveness.py). Distinct seam label from the
+        # liveness tracker's "load_report": both consume the same topic
+        # (separate subscriptions), so sharing a label would double-count
+        # every zombie packet.
+        self._fence = IncarnationFence("router_load")
+        # Extra purges drop_worker fans out to (the router registers its
+        # radix-indexer removal here, so scheduler.drop_worker stays THE
+        # single reconciliation path for a vanished worker).
+        self._on_drop: List = []
 
     # -- state maintenance -------------------------------------------------
 
-    def update_load(self, snapshot: LoadSnapshot) -> None:
+    def add_drop_callback(self, fn) -> None:
+        """``fn(worker: WorkerKey)`` runs inside every drop_worker."""
+        self._on_drop.append(fn)
+
+    def update_load(self, snapshot: LoadSnapshot) -> bool:
+        """Fold one load report into the cost model. Returns False when
+        the report was FENCED (a stale incarnation's packet — counted,
+        state untouched)."""
+        verdict = self._fence.admit(snapshot.worker, snapshot.incarnation)
+        if verdict == "stale":
+            logger.warning(
+                "dropping stale-incarnation load report from %s "
+                "(incarnation %d < newest %d)", snapshot.worker,
+                snapshot.incarnation, self._fence.newest(snapshot.worker),
+            )
+            return False
+        if verdict == "rejoined":
+            # The worker restarted: purge the previous incarnation's
+            # charges/links/faults/radix before this report seeds the
+            # fresh state (drop_worker also drops the fence entry, so
+            # re-admit the new incarnation afterwards).
+            self.drop_worker(snapshot.worker)
+            self._fence.admit(snapshot.worker, snapshot.incarnation)
         state = self._workers.setdefault(snapshot.worker, WorkerState())
         state.snapshot = snapshot
         state.inflight_blocks = 0  # report supersedes the prediction
@@ -238,6 +273,7 @@ class KvScheduler:
         self.link_costs.sync_faults(
             snapshot.worker, snapshot.link_faults or ()
         )
+        return True
 
     def report_generation(self, worker: WorkerKey) -> int:
         state = self._workers.get(worker)
@@ -246,9 +282,27 @@ class KvScheduler:
     def add_worker(self, worker: WorkerKey) -> None:
         self._workers.setdefault(worker, WorkerState())
 
-    def remove_worker(self, worker: WorkerKey) -> None:
+    def drop_worker(self, worker: WorkerKey) -> None:
+        """THE single reconciliation for a vanished worker (crash, lease
+        expiry, rejoin under a new incarnation): atomically releases its
+        in-flight charges (the WorkerState prediction), its link-cost
+        pairs in BOTH directions, its breaker faults, its incarnation
+        fence entry, and — via registered drop callbacks — the router's
+        radix/popularity entries. Callers must not purge piecemeal; a
+        leak audit (tests/test_liveness.py) asserts zero residue after
+        this one call."""
         self._workers.pop(worker, None)
         self.link_costs.drop_worker(worker)
+        self._fence.drop(worker)
+        for fn in self._on_drop:
+            try:
+                fn(worker)
+            except Exception:
+                logger.exception("drop_worker callback failed for %s", worker)
+
+    def remove_worker(self, worker: WorkerKey) -> None:
+        """Back-compat alias: removal IS the drop_worker reconciliation."""
+        self.drop_worker(worker)
 
     def workers(self) -> List[WorkerKey]:
         return sorted(self._workers)

@@ -20,6 +20,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_FAULTS,
     ALL_FRONTEND,
     ALL_KVBM,
+    ALL_LIVENESS,
     ALL_MIGRATION,
     ALL_OVERLOAD,
     ALL_ROUTER,
@@ -41,6 +42,7 @@ __all__ = [
     "ALL_FAULTS",
     "ALL_FRONTEND",
     "ALL_KVBM",
+    "ALL_LIVENESS",
     "ALL_MIGRATION",
     "ALL_OVERLOAD",
     "ALL_ROUTER",

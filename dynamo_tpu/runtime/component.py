@@ -175,6 +175,17 @@ class Client:
         self._kv_picker = None  # async (request, instances) -> instance_id
         self._on_stream_done = None  # (instance_id, request) -> None
         self._instance_filter = None  # (instance_id) -> bool (health gating)
+        # Crash plane (runtime/liveness.py): per-instance abort handles for
+        # in-flight streams. Opt-in via enable_stream_aborts() — the
+        # abortable iteration races each item against the abort future,
+        # which costs one extra task per item; sessions without liveness
+        # wiring keep the plain fast path.
+        self._abortable = False
+        self._abort_futures: Dict[int, set] = {}
+        # Liveness-evicted instances, kept for revive_instance: a frozen
+        # worker that rejoins under the SAME incarnation never re-PUTs
+        # its key, so the watch alone cannot restore its capacity.
+        self._evicted: Dict[int, Instance] = {}
 
     @property
     def endpoint_path(self) -> str:
@@ -199,6 +210,52 @@ class Client:
         prediction (ref: kv_router sequence.rs free on completion)."""
         self._on_stream_done = callback
 
+    # -- crash plane --------------------------------------------------------
+
+    def enable_stream_aborts(self) -> None:
+        """Arm per-stream abort handles (liveness wiring calls this once)."""
+        self._abortable = True
+
+    def abort_instance(self, instance_id: int, exc: BaseException) -> int:
+        """Fail every in-flight stream routed to ``instance_id`` with
+        ``exc`` RIGHT NOW — the liveness tracker's dead-worker hook. The
+        typed exception (WorkerLostError) surfaces through the stream and
+        the migration ladder re-dispatches immediately instead of the
+        stream hanging until a TCP timeout. Returns streams aborted."""
+        aborted = 0
+        for fut in list(self._abort_futures.get(instance_id, ())):
+            if not fut.done():
+                fut.set_exception(exc)
+                aborted += 1
+        return aborted
+
+    def evict_instance(self, instance_id: int) -> bool:
+        """Drop a dead instance from routing immediately, ahead of its
+        discovery lease expiring. The instance is stashed: a RESTARTED
+        worker re-PUTs its key and the watch re-adds it with fresh
+        transport, but a worker that merely froze past the budget (GC
+        pause, short partition) resumes under the SAME incarnation with
+        no new PUT — revive_instance is the only road back for it."""
+        inst = self._instances.pop(instance_id, None)
+        if inst is not None:
+            self._evicted[instance_id] = inst
+        if not self._instances:
+            self._instances_nonempty.clear()
+        return inst is not None
+
+    def revive_instance(self, instance_id: int) -> bool:
+        """Re-admit a liveness-evicted instance on rejoin. Same-incarnation
+        rejoins (the process survived; its transport is unchanged) get
+        their capacity back here; for a restarted worker the watch PUT
+        overwrites this entry with the fresh transport anyway — at worst
+        the stale address serves one connection error into migration."""
+        inst = self._evicted.pop(instance_id, None)
+        if inst is None or instance_id in self._instances:
+            return False
+        self._instances[instance_id] = inst
+        self._instances_nonempty.set()
+        return True
+
     async def start(self) -> None:
         prefix = instance_prefix(
             self._endpoint.namespace, self._endpoint.component, self._endpoint.name
@@ -210,11 +267,14 @@ class Client:
             if event.kind == EventKind.PUT and event.value is not None:
                 inst = Instance.from_dict(event.value)
                 self._instances[inst.instance_id] = inst
+                # Authoritative re-registration supersedes any stash.
+                self._evicted.pop(inst.instance_id, None)
                 self._instances_nonempty.set()
             elif event.kind == EventKind.DELETE:
                 iid = _instance_id_from_key(event.key)
                 if iid is not None:
                     self._instances.pop(iid, None)
+                    self._evicted.pop(iid, None)
                 if not self._instances:
                     self._instances_nonempty.clear()
 
@@ -294,8 +354,14 @@ class Client:
         try:
             instance = await self._pick(request, instance_id)
             remote = self._runtime.request_plane_client(instance)
-            async for item in remote.generate(request, context):
-                yield item
+            if self._abortable:
+                async for item in self._abortable_iter(
+                    remote, request, context, instance.instance_id
+                ):
+                    yield item
+            else:
+                async for item in remote.generate(request, context):
+                    yield item
         finally:
             # Fires even when _pick itself fails after the KV picker charged
             # the scheduler (the instance may have raced away) — otherwise
@@ -308,6 +374,53 @@ class Client:
                     )
                 except Exception:
                     logger.exception("stream-done callback failed")
+
+    async def _abortable_iter(
+        self, remote: AsyncEngine, request: Any, context: Context, iid: int
+    ) -> AsyncIterator[Any]:
+        """Iterate a remote stream racing every item against this
+        instance's abort handle: when liveness declares the worker dead,
+        ``abort_instance`` fails the handle and the stream raises the
+        typed error immediately — it never waits out a kernel timeout on
+        a socket whose peer no longer exists."""
+        agen = remote.generate(request, context).__aiter__()
+        abort: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._abort_futures.setdefault(iid, set()).add(abort)
+        nxt: Optional[asyncio.Task] = None
+        try:
+            while True:
+                nxt = asyncio.ensure_future(agen.__anext__())
+                await asyncio.wait(
+                    {nxt, abort}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if abort.done() and not nxt.done():
+                    nxt.cancel()
+                    await reap_task(nxt, "aborted stream item", logger)
+                    try:  # reap the dead worker's generator state
+                        await asyncio.wait_for(agen.aclose(), timeout=1.0)
+                    except Exception:
+                        logger.debug("abort-path stream close failed",
+                                     exc_info=True)
+                    abort.result()  # raises the typed abort exception
+                try:
+                    item = nxt.result()
+                except StopAsyncIteration:
+                    return
+                nxt = None
+                yield item
+        finally:
+            if nxt is not None and not nxt.done():
+                nxt.cancel()
+                await reap_task(nxt, "stream item task", logger)
+            handles = self._abort_futures.get(iid)
+            if handles is not None:
+                handles.discard(abort)
+                if not handles:
+                    self._abort_futures.pop(iid, None)
+            if abort.done():
+                abort.exception()  # mark retrieved (late abort after end)
+            else:
+                abort.cancel()
 
     def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):
         """Route to a specific instance (RouterMode::Direct)."""

@@ -287,6 +287,10 @@ class DiscdDiscovery:
         self._watches: Dict[int, asyncio.Queue] = {}
         self._lock = asyncio.Lock()
         self._closed = False
+        # _closed doubles as "connection needs re-establishing" (the pump
+        # sets it on loss); _shutdown is the explicit close() — the only
+        # thing that stops a bootstrap retry loop.
+        self._shutdown = False
 
     async def _ensure(self) -> None:
         if self._fw is not None and not self._closed:
@@ -363,18 +367,35 @@ class DiscdDiscovery:
 
         # The Watch must be returned synchronously (interface parity with the
         # memory backend); fetch the snapshot eagerly in a bootstrap task and
-        # feed everything through the queue.
+        # feed everything through the queue. Bootstrap retries with jittered
+        # exponential backoff: a discd restart disconnects every client at
+        # once, and bare one-shot bootstraps would either die (old behavior)
+        # or stampede the recovering server in lockstep.
         async def bootstrap() -> None:
-            try:
-                rh, snapshot = await self._call({"op": "watch", "prefix": prefix})
-                wid = rh["watch_id"]
-                watch_id_box.append(wid)
-                self._watches[wid] = queue
-                for k, v in sorted((snapshot or {}).items()):
-                    queue.put_nowait(WatchEvent(EventKind.PUT, k, v))
-            except Exception:
-                logger.exception("discd watch bootstrap failed")
-                queue.put_nowait(_WATCH_CLOSED)
+            from dynamo_tpu.runtime.tasks import Backoff
+
+            backoff = Backoff(base_s=0.1, cap_s=5.0)
+            while not self._shutdown:
+                try:
+                    rh, snapshot = await self._call(
+                        {"op": "watch", "prefix": prefix}
+                    )
+                    wid = rh["watch_id"]
+                    watch_id_box.append(wid)
+                    self._watches[wid] = queue
+                    for k, v in sorted((snapshot or {}).items()):
+                        queue.put_nowait(WatchEvent(EventKind.PUT, k, v))
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    delay = backoff.next_delay()
+                    logger.warning(
+                        "discd watch bootstrap for %r failed (%r); "
+                        "retrying in %.2fs", prefix, exc, delay,
+                    )
+                    await asyncio.sleep(delay)
+            queue.put_nowait(_WATCH_CLOSED)
 
         asyncio.get_running_loop().create_task(bootstrap(), name="discd-watch-bootstrap")
 
@@ -401,6 +422,7 @@ class DiscdDiscovery:
 
     async def close(self) -> None:
         self._closed = True
+        self._shutdown = True
         if self._pump is not None:
             self._pump.cancel()
             await reap_task(self._pump, "discd event pump", logger)

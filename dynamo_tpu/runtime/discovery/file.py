@@ -206,7 +206,14 @@ class FileDiscovery:
                 queue.put_nowait(event)
 
     async def _poll_loop(self) -> None:
+        from dynamo_tpu.runtime.tasks import Backoff
+
+        # A scan failure (shared-filesystem blip) hits every watcher at
+        # once; jittered backoff keeps the recovering mount from being
+        # re-polled by the whole fleet in lockstep.
+        backoff = Backoff(base_s=self.poll_interval, cap_s=30 * self.poll_interval)
         while not self._closed and self._watchers:
+            delay = self.poll_interval
             try:
                 current = await asyncio.get_running_loop().run_in_executor(
                     None, self._scan
@@ -216,9 +223,11 @@ class FileDiscovery:
                         self._observe(key, None)
                 for key, value in current.items():
                     self._observe(key, value)
+                backoff.reset()
             except Exception:
                 logger.exception("file discovery poll failed")
-            await asyncio.sleep(self.poll_interval)
+                delay = backoff.next_delay()
+            await asyncio.sleep(delay)
 
     async def close(self) -> None:
         self._closed = True

@@ -116,6 +116,11 @@ class DistributedRuntime:
         self.tracker = TaskTracker("runtime")
         self._served: Dict[str, ServedEndpoint] = {}
         self._serve_trackers: Dict[str, TaskTracker] = {}
+        # Every doc put under the serving lease, kept for re-registration:
+        # after a control-plane outage long enough to expire the lease,
+        # the keep-alive loop re-puts these under a fresh lease so the
+        # worker rejoins discovery without a process restart.
+        self._leased_docs: Dict[str, Dict[str, Any]] = {}
         self._lease: Optional[Lease] = None
         self._shutdown = asyncio.Event()
         self._extra_planes: list = []
@@ -195,12 +200,23 @@ class DistributedRuntime:
 
     async def _keep_alive_loop(self, keep_alive) -> None:
         assert self._lease is not None
+        import time as _time
+
+        from dynamo_tpu.runtime.tasks import Backoff
+
         interval = max(0.5, self._lease.ttl / 3.0)
+        # Failure retries use jittered exponential backoff (capped above
+        # the renewal cadence): a control-plane blip disconnects EVERY
+        # worker's keep-alive at once, and fixed-interval retries would
+        # reconnect as a synchronized herd.
+        backoff = Backoff(base_s=interval / 2, cap_s=4 * interval)
+        down_since: Optional[float] = None
         while not self._shutdown.is_set():
+            delay = interval if down_since is None else backoff.next_delay()
             try:
                 # Waiting on the shutdown event (not a bare sleep) lets
                 # shutdown() proceed immediately instead of stalling a tick.
-                await asyncio.wait_for(self._shutdown.wait(), timeout=interval)
+                await asyncio.wait_for(self._shutdown.wait(), timeout=delay)
                 return
             except asyncio.TimeoutError:
                 pass
@@ -214,7 +230,56 @@ class DistributedRuntime:
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
-                logger.warning("lease keep-alive failed: %r", exc)
+                now = _time.monotonic()
+                if down_since is None:
+                    down_since = now
+                if now - down_since >= self._lease.ttl:
+                    # The lease has (almost certainly) expired mid-outage:
+                    # watchers saw our keys DELETE, and renewing a dead
+                    # lease can never succeed again. Re-establish — fresh
+                    # lease, every leased doc re-put — so the worker
+                    # rejoins discovery the moment the plane recovers.
+                    try:
+                        await self._reregister()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as rexc:
+                        logger.warning(
+                            "discovery re-register failed (still down): %r",
+                            rexc,
+                        )
+                        continue
+                    down_since = None
+                    backoff.reset()
+                else:
+                    logger.warning("lease keep-alive failed: %r", exc)
+                continue
+            if down_since is not None:
+                down_since = None
+                backoff.reset()
+
+    async def _reregister(self) -> None:
+        """Fresh lease + re-put of every lease-attached doc (endpoint
+        instances and model cards) after an outage expired the old one."""
+        lease = await self.discovery.create_lease(config.LEASE_TTL.get())
+        self._lease = lease
+        for key, doc in self._leased_docs.items():
+            await self.discovery.put(key, doc, lease=lease)
+        logger.warning(
+            "re-registered %d discovery docs under fresh lease %s after "
+            "control-plane outage", len(self._leased_docs), lease.id,
+        )
+
+    async def put_leased(self, key: str, doc: Dict[str, Any]) -> None:
+        """Put a discovery doc under the serving lease AND remember it, so
+        the keep-alive loop can re-register it after a control-plane
+        outage expires the lease (endpoint instances, model cards)."""
+        lease = await self._lease_for_serving()
+        self._leased_docs[key] = doc
+        await self.discovery.put(key, doc, lease=lease)
+
+    def forget_leased(self, key: str) -> None:
+        self._leased_docs.pop(key, None)
 
     async def _serve(
         self,
@@ -243,8 +308,7 @@ class DistributedRuntime:
             transport=transport,
             metadata=metadata,
         )
-        lease = await self._lease_for_serving()
-        await self.discovery.put(instance.key, instance.to_dict(), lease=lease)
+        await self.put_leased(instance.key, instance.to_dict())
         served = ServedEndpoint(instance=instance, _runtime=self, _engine=engine)
         self._served[instance.key] = served
         self._serve_trackers[instance.key] = tracker
@@ -253,6 +317,7 @@ class DistributedRuntime:
 
     async def _unserve(self, served: ServedEndpoint, grace_period: float = 30.0) -> None:
         key = served.instance.key
+        self.forget_leased(key)
         # De-register first so routers stop picking us, then drain. A dead
         # discovery plane must not abort the shutdown: the lease expiry (or
         # a discd snapshot-restore sweep) will retire the key for us.

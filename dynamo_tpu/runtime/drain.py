@@ -150,8 +150,14 @@ class DrainController:
         # the PR 6 LinkCostModel, seeded optimistic and fed by the
         # handoffs themselves (accept-ack latency over wire bytes).
         from dynamo_tpu.router.scheduler import LinkCostModel
+        from dynamo_tpu.runtime.liveness import IncarnationFence
 
         self.link_costs = LinkCostModel()
+        # Handoff-ack fencing: accept-acks carry the adopting peer's
+        # incarnation; a stale incarnation's ack (a zombie peer whose
+        # late packets surface after its restart) must read as a refusal
+        # — releasing the source KV copy against it would lose the stream.
+        self._peer_fence = IncarnationFence("handoff_ack")
         self._drain_task: Optional[asyncio.Task] = None
         self._relays: set = set()
         # Ship phase (peer ranking + accept-ack round trips) runs as
@@ -531,9 +537,14 @@ class DrainController:
             # re-prefill cannot leave two engines decoding one request.
             await self._close_quietly(it)
             raise
-        if not (isinstance(first, dict) and first.get("accepted")):
+        stale_ack = (
+            isinstance(first, dict)
+            and self._peer_fence.admit(peer, first.get("inc")) == "stale"
+        )
+        if stale_ack or not (isinstance(first, dict) and first.get("accepted")):
             reason = (
-                first.get("reason", "unspecified")
+                "stale-incarnation ack (zombie peer)" if stale_ack
+                else first.get("reason", "unspecified")
                 if isinstance(first, dict) else repr(first)
             )
             self.peer_refusals += 1

@@ -55,6 +55,18 @@ DRAIN_HANDOFF_EXPORT = "drain.handoff.export"
 # the source must absorb by trying the next peer or falling down the ladder.
 DRAIN_HANDOFF_IMPORT = "drain.handoff.import"
 
+# -- crash plane (runtime/liveness.py, engines/tpu/kv_checkpoint.py) ----------
+# One hit per load report admitted by the liveness tracker: an injected
+# failure models report loss between the wire and the tracker — N
+# consecutive injections must trip the same suspect/dead machinery a
+# crashed worker does (the fake-clock detection tests replay this).
+LIVENESS_REPORT = "liveness.report"
+# One hit at the top of a warm-restart checkpoint restore, before anything
+# is read: an injection models the restore machinery failing outright —
+# which MUST resolve to a logged cold start (counted cold_error), never a
+# crash loop.
+RESTORE_LOAD = "restore.load"
+
 # -- overload plane (runtime/overload.py) -------------------------------------
 # One hit per QUEUED admission attempt, before the EDF wait: an injected
 # timeout here expires exactly that request's queue budget — the
@@ -78,5 +90,7 @@ ALL_FAULT_POINTS = (
     KVBM_TIER_WRITE,
     DRAIN_HANDOFF_EXPORT,
     DRAIN_HANDOFF_IMPORT,
+    LIVENESS_REPORT,
+    RESTORE_LOAD,
     OVERLOAD_ADMIT,
 )

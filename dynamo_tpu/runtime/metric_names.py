@@ -176,6 +176,28 @@ DRAIN_PEER_REFUSALS_TOTAL = f"{DRAIN_PREFIX}_peer_refusals_total"
 # Wall time of one full drain (trigger -> drained).
 DRAIN_DURATION = f"{DRAIN_PREFIX}_duration_seconds"
 
+# -- crash plane (runtime/liveness.py) ---------------------------------------
+LIVENESS_PREFIX = "dynamo_tpu_liveness"
+# Per-worker liveness state machine: 0 alive, 1 suspect (2 missed load
+# reports), 2 dead (drop_worker reconciliation ran, streams aborted).
+LIVENESS_WORKER_STATE = f"{LIVENESS_PREFIX}_worker_state"
+# Last-report-to-declared-dead latency; bounded by dead_after x interval_s
+# by construction (no TCP timeouts anywhere in the path).
+LIVENESS_DETECTION_SECONDS = f"{LIVENESS_PREFIX}_detection_seconds"
+# Packets from a prior worker incarnation dropped at a fencing seam
+# (load_report | router_load | pull_reply | handoff_ack | tcp) — counted,
+# never applied. load_report = the liveness tracker's fence, router_load =
+# the scheduler's (separate subscriptions to one topic; distinct labels so
+# one zombie packet is never double-counted).
+LIVENESS_STALE_DROPS_TOTAL = (
+    f"{LIVENESS_PREFIX}_stale_incarnation_drops_total"
+)
+# Warm-restart KV checkpoint restore: wall time and outcome (restored |
+# partial | empty | cold_mismatch | cold_corrupt | cold_error). Every
+# cold_* is a logged cold start, never a crash loop.
+LIVENESS_RESTORE_SECONDS = f"{LIVENESS_PREFIX}_restore_seconds"
+LIVENESS_RESTORE_OUTCOME_TOTAL = f"{LIVENESS_PREFIX}_restore_outcome_total"
+
 # -- overload plane (runtime/overload.py OverloadController) -----------------
 OVERLOAD_PREFIX = "dynamo_tpu_overload"
 # Brownout state machine: 0 healthy, 1 brownout (max_tokens clamped,
@@ -260,6 +282,14 @@ ALL_DRAIN = (
     DRAIN_HANDOFF_BYTES_TOTAL,
     DRAIN_PEER_REFUSALS_TOTAL,
     DRAIN_DURATION,
+)
+
+ALL_LIVENESS = (
+    LIVENESS_WORKER_STATE,
+    LIVENESS_DETECTION_SECONDS,
+    LIVENESS_STALE_DROPS_TOTAL,
+    LIVENESS_RESTORE_SECONDS,
+    LIVENESS_RESTORE_OUTCOME_TOTAL,
 )
 
 ALL_OVERLOAD = (

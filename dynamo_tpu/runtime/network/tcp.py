@@ -22,6 +22,13 @@ connection/timeout errors and drain refusals (WorkerDrainingError,
 "endpoint draining") must stay MIGRATABLE across the wire, or the drain
 ladder's typed-requeue rung dead-ends at the frontend. Old peers that omit
 ``kind`` keep the RuntimeError behavior.
+
+Incarnation fencing (runtime/liveness.py): every server→client frame is
+stamped with the serving process's incarnation (``inc``). One stream's
+frames must all carry ONE incarnation — a frame claiming a different one
+(a zombie's late packets, or a restarted listener conflated with its
+predecessor) is counted (``stale_incarnation_drops_total{seam="tcp"}``)
+and dropped, never delivered. Old peers that omit ``inc`` skip the check.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ from dynamo_tpu.runtime import fault_names
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.faults import fault_point
+from dynamo_tpu.runtime.liveness import note_stale_drop, process_incarnation
 from dynamo_tpu.runtime.network.codec import FrameReader, FrameWriter
 from dynamo_tpu.runtime.network.errors import err_exception, err_kind
 from dynamo_tpu.runtime.tasks import TaskTracker, reap_task
@@ -162,10 +170,14 @@ class TcpRequestPlane:
                            "message": f"no such endpoint instance: {key}"})
             return
         engine, tracker = entry
+        # Incarnation stamp on every response envelope: the client fences
+        # a stream to ONE serving incarnation, so a zombie's late frames
+        # can never be conflated with a restarted worker's.
+        inc = process_incarnation()
         try:
             if tracker.draining:
                 await fw.send({
-                    "type": "err", "stream": sid,
+                    "type": "err", "stream": sid, "inc": inc,
                     "message": "endpoint draining; re-dispatch",
                     "kind": "draining",
                 })
@@ -175,10 +187,12 @@ class TcpRequestPlane:
             with tracker.guard(), span("endpoint.serve", ctx, endpoint=key) as sp:
                 n_items = 0
                 async for item in engine.generate(request, ctx):
-                    await fw.send({"type": "item", "stream": sid}, item)
+                    await fw.send(
+                        {"type": "item", "stream": sid, "inc": inc}, item
+                    )
                     n_items += 1
                 sp.attributes["items"] = n_items
-            await fw.send({"type": "end", "stream": sid})
+            await fw.send({"type": "end", "stream": sid, "inc": inc})
         except asyncio.CancelledError:
             ctx.stop_generating(reason="client-cancelled")
             raise
@@ -188,8 +202,8 @@ class TcpRequestPlane:
             logger.exception("stream %s handler failed", sid)
             with _suppress_conn():
                 await fw.send({
-                    "type": "err", "stream": sid, "message": repr(exc),
-                    "kind": err_kind(exc),
+                    "type": "err", "stream": sid, "inc": inc,
+                    "message": repr(exc), "kind": err_kind(exc),
                 })
 
     # -- client side -------------------------------------------------------
@@ -260,10 +274,11 @@ class _ClientConn:
                     if q is None:
                         continue
                     ftype = header.get("type")
+                    inc = header.get("inc")
                     if ftype == "item":
-                        q.put_nowait(("item", payload))
+                        q.put_nowait(("item", payload, inc))
                     elif ftype == "end":
-                        q.put_nowait(("end", None))
+                        q.put_nowait(("end", None, inc))
                     elif ftype == "err":
                         q.put_nowait((
                             "err",
@@ -271,11 +286,12 @@ class _ClientConn:
                                 header.get("message", "remote error"),
                                 header.get("kind", "other"),
                             ),
+                            inc,
                         ))
             finally:
                 self.closed = True
                 for q in self._queues.values():
-                    q.put_nowait(("disconnect", None))
+                    q.put_nowait(("disconnect", None, None))
 
         self._pump = asyncio.get_running_loop().create_task(
             pump(), name=f"tcp-client-pump:{self.addr}"
@@ -342,9 +358,25 @@ class _TcpClientEngine:
                 await conn.send({"type": "cancel", "stream": sid})
 
         cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
+        stream_inc: Optional[int] = None
         try:
             while True:
-                kind, payload = await q.get()
+                kind, payload, inc = await q.get()
+                if inc is not None:
+                    # Incarnation fence: the stream belongs to whichever
+                    # incarnation answered FIRST; frames claiming another
+                    # (a zombie's late packets) are counted and dropped —
+                    # a restarted listener cannot continue a stream it
+                    # never held.
+                    if stream_inc is None:
+                        stream_inc = inc
+                    elif inc != stream_inc:
+                        note_stale_drop("tcp")
+                        logger.warning(
+                            "dropping frame from foreign incarnation on "
+                            "stream %d of %s", sid, self._addr,
+                        )
+                        continue
                 if kind == "item":
                     yield payload
                 elif kind == "end":

@@ -121,6 +121,11 @@ class SystemStatusServer:
         self._tracer = tracer
         self._engine_routes: Dict[str, EngineRoute] = {}
         self._health_sources: Dict[str, Callable[[], Tuple[bool, Any]]] = {}
+        # Readiness sources (crash plane): /readyz is 200 only when EVERY
+        # registered source reports ready. Liveness (/healthz, /live) is
+        # process-up only — a restoring worker is alive but NOT ready, so
+        # the kubelet keeps it out of service without restarting it.
+        self._ready_sources: Dict[str, Callable[[], Tuple[bool, Any]]] = {}
         # (render fn, takes-openmetrics-kwarg) — classified once at
         # registration so the scrape path skips per-request reflection.
         self._metrics_sources: List[Tuple[Callable[[], str], bool]] = []
@@ -148,6 +153,14 @@ class SystemStatusServer:
         self, name: str, fn: Callable[[], Tuple[bool, Any]]
     ) -> None:
         self._health_sources[name] = fn
+
+    def register_readiness(
+        self, name: str, fn: Callable[[], Tuple[bool, Any]]
+    ) -> None:
+        """``fn() -> (ready, detail)``; /readyz is 503 until every source
+        is ready. The worker registers its warm-restore + registration
+        gate here (readiness split from liveness, ISSUE 10)."""
+        self._ready_sources[name] = fn
 
     def register_metrics(self, fn: Callable[[], str]) -> None:
         """fn returns Prometheus exposition-format text."""
@@ -194,12 +207,23 @@ class SystemStatusServer:
         # register the source twice.
         if not self._runtime_metrics_registered:
             from dynamo_tpu.runtime.device_observe import render_runtime_metrics
+            from dynamo_tpu.runtime.liveness import render_fence_metrics
 
             self.register_metrics(render_runtime_metrics)
+            # Crash-plane process-global families (stale-incarnation drops
+            # + restore duration/outcome): every process participates in
+            # fencing, so every system server exposes them.
+            self.register_metrics(render_fence_metrics)
             self._runtime_metrics_registered = True
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
+        # Probe split (deploy/pod_connector.py renders both): /healthz =
+        # liveness (the event loop turns — restarting would not help a
+        # slow restore), /readyz = readiness (restore done, endpoints
+        # registered — route traffic here only past this gate).
+        app.router.add_get("/healthz", self._live)
+        app.router.add_get("/readyz", self._ready)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/requests/{id}", self._debug_request)
@@ -249,6 +273,21 @@ class SystemStatusServer:
 
     async def _live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    async def _ready(self, request: web.Request) -> web.Response:
+        details: Dict[str, Any] = {}
+        ready = True
+        for name, fn in self._ready_sources.items():
+            try:
+                ok, detail = fn()
+            except Exception as exc:  # a broken source is a not-ready one
+                ok, detail = False, f"readiness source error: {exc}"
+            details[name] = detail
+            ready = ready and ok
+        return web.json_response(
+            {"status": "ready" if ready else "not_ready", "details": details},
+            status=200 if ready else 503,
+        )
 
     async def _metrics(self, request: web.Request) -> web.Response:
         openmetrics = "application/openmetrics-text" in request.headers.get(

@@ -11,9 +11,56 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Any, Callable, Coroutine, Optional, Set
 
 logger = logging.getLogger(__name__)
+
+
+class Backoff:
+    """Jittered exponential backoff for reconnect/re-register loops.
+
+    A control-plane blip (discd restart, broker hiccup) disconnects every
+    worker at once; bare fixed-interval retries then reconnect as a
+    synchronized herd and flatten the recovering service again. This
+    schedule spreads them: ``base × 2^n`` capped at ``cap``, multiplied by
+    a uniform draw in ``[1 − jitter, 1 + jitter]``. ``reset()`` on the
+    first success so steady-state failures start cheap again.
+
+    Deterministic under a seeded ``rng`` (the fake-clock tests replay the
+    exact delay sequence); the default draws process randomness, which is
+    precisely the de-synchronization production wants."""
+
+    def __init__(
+        self,
+        base_s: float = 0.1,
+        cap_s: float = 15.0,
+        jitter: float = 0.5,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if not (0.0 <= jitter < 1.0):
+            raise ValueError("jitter must be in [0, 1)")
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self._rng = rng or random.Random()
+        self.attempt = 0
+
+    def next_delay(self) -> float:
+        """Delay before the (attempt+1)-th retry; advances the attempt."""
+        raw = min(self.base_s * (2 ** self.attempt), self.cap_s)
+        self.attempt += 1
+        if self.jitter:
+            raw *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
+        return raw
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+    async def sleep(self) -> float:
+        delay = self.next_delay()
+        await asyncio.sleep(delay)
+        return delay
 
 
 async def reap_task(

@@ -261,7 +261,11 @@ async def main() -> None:
         runner = None
 
     name = args.served_model_name or model_config.name
-    instance_id = random.getrandbits(63)
+    # Stable worker identity (crash plane): a restarted worker re-registers
+    # under the SAME id with a fresh process incarnation, so the router's
+    # rejoin purge and the fence line up; 0 keeps the old random-per-start
+    # behavior for ad-hoc workers.
+    instance_id = config.WORKER_ID.get() or random.getrandbits(63)
     kv_pub = KvEventPublisher(
         runtime.event_plane, args.namespace, args.component, instance_id
     )
@@ -319,10 +323,63 @@ async def main() -> None:
         ),
     )
     from dynamo_tpu.disagg import DecodeHandler, KvTransferHandler, PrefillHandler
+    from dynamo_tpu.runtime.liveness import process_incarnation
 
     component = runtime.namespace(args.namespace).component(args.component)
     endpoint = component.endpoint(args.endpoint)
     kv_endpoint = component.endpoint("kv")
+
+    # Crash-plane startup order (docs/design_docs/fault_tolerance.md):
+    # 1. system server UP first — /healthz (liveness: the process turns)
+    #    answers during a long restore while /readyz stays 503, so the
+    #    kubelet neither restarts the pod nor routes traffic at it;
+    # 2. engine start + warm KV checkpoint restore (never-raise: any
+    #    stamp mismatch or corruption is a logged, counted cold start);
+    # 3. endpoints served + model registered under the FRESH incarnation —
+    #    only now does the fleet see the worker at all;
+    # 4. load reports begin (incarnation-stamped) and readiness flips —
+    #    restored prefixes re-advertise via the router's kv-sync snapshot
+    #    pull the moment the registration lands.
+    ready_state: dict = {"ready": False, "detail": "starting"}
+    system_server = None
+    if args.system_port is not None:
+        from dynamo_tpu.runtime.system_server import (
+            SystemStatusServer,
+            attach_engine,
+        )
+
+        system_server = SystemStatusServer(port=args.system_port)
+        attach_engine(system_server, engine)
+
+        def _worker_ready():
+            # Drain-aware through EVERY trigger path (signal, POST /drain,
+            # preStop GET): a draining worker is alive but not ready.
+            dc = ready_state.get("drain_controller")
+            if dc is not None and dc.state != 0:
+                return False, "draining"
+            return ready_state["ready"], ready_state["detail"]
+
+        system_server.register_readiness("worker", _worker_ready)
+        if kvbm is not None:
+            kvbm.register_metrics(system_server)
+        await system_server.start()
+        print(f"system server on :{system_server.port}", flush=True)
+
+    ready_state["detail"] = "starting engine"
+    await engine.start()
+    if args.kv_checkpoint_dir:
+        # Restore BEFORE registering: the model card and the first load
+        # report must describe a worker whose warm cache is already
+        # installed, so a shared-prefix request routed here on the first
+        # report serves without re-prefill. load_checkpoint never raises —
+        # a bad checkpoint is a counted cold start, not a crash loop.
+        ready_state["detail"] = "restoring KV checkpoint"
+        n = await engine.load_checkpoint(args.kv_checkpoint_dir)
+        if n:
+            print(f"restored {n} warm KV blocks", flush=True)
+
+    ready_state["detail"] = "registering endpoints"
+    incarnation = process_incarnation()
     served_kv = await kv_endpoint.serve_endpoint(
         KvTransferHandler(engine).generate, instance_id=instance_id
     )
@@ -344,7 +401,10 @@ async def main() -> None:
     handoff_client_factory = None
     if args.is_prefill_worker:
         handler = PrefillHandler(engine, instance_id)
-        served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
+        served = await endpoint.serve_endpoint(
+            handler.generate, instance_id=instance_id,
+            metadata={"incarnation": incarnation},
+        )
         # Prefill workers are found via their component endpoint, not the
         # model registry (ref: prefill_router.rs activate). Their in-flight
         # work is one bounded prefill each, so drain skips the handoff rung
@@ -366,8 +426,13 @@ async def main() -> None:
         # is priced out of placement, not just a slow one).
         load_pub.link_bandwidth_fn = handler.link_bandwidth
         load_pub.link_faults_fn = handler.open_breaker_srcs
-        served = await endpoint.serve_endpoint(handler.generate, instance_id=instance_id)
-        await register_llm(runtime, card, endpoint, instance_id)
+        served = await endpoint.serve_endpoint(
+            handler.generate, instance_id=instance_id,
+            metadata={"incarnation": incarnation},
+        )
+        await register_llm(
+            runtime, card, endpoint, instance_id, incarnation=incarnation
+        )
         # Live-handoff plane (rolling restarts): serve adoptions from
         # draining peers, and reach peers' handoff endpoints when WE drain.
         from dynamo_tpu.disagg import HANDOFF_ENDPOINT, HandoffHandler
@@ -384,16 +449,6 @@ async def main() -> None:
                 .client()
             )
     load_pub.start()
-    await engine.start()
-    if args.kv_checkpoint_dir:
-        import os
-
-        if os.path.exists(os.path.join(args.kv_checkpoint_dir, "manifest.json")):
-            try:
-                n = await engine.load_checkpoint(args.kv_checkpoint_dir)
-                print(f"restored {n} warm KV blocks", flush=True)
-            except Exception as exc:
-                print(f"KV checkpoint restore failed: {exc}", flush=True)
     # Worker-side overload plane: KV-pool-occupancy-driven brownout that
     # suspends speculative decode before admission backpressure turns
     # into a preemption storm (the engine's admit_kv_high_watermark does
@@ -422,7 +477,7 @@ async def main() -> None:
     from dynamo_tpu.runtime.drain import DrainController
 
     shutdown = asyncio.Event()
-    drain_controller = DrainController(
+    ready_state["drain_controller"] = drain_controller = DrainController(
         engine,
         worker_id=instance_id,
         handoff_client_factory=handoff_client_factory,
@@ -436,6 +491,10 @@ async def main() -> None:
     def start_drain(sig_name: str) -> None:
         if drain_controller.state == 0:
             print(f"{sig_name}: draining (live handoff)...", flush=True)
+        # A draining worker is alive but no longer ready: /readyz flips
+        # 503 so the kubelet pulls it from service while streams hand off.
+        ready_state["ready"] = False
+        ready_state["detail"] = "draining"
         drain_controller.trigger()
 
     sigint_count = 0
@@ -455,31 +514,25 @@ async def main() -> None:
     # endpoint shutdown, every live stream dropped.
     loop.add_signal_handler(signal.SIGTERM, start_drain, "SIGTERM")
     loop.add_signal_handler(signal.SIGINT, on_sigint)
-    system_server = None
-    if args.system_port is not None:
-        from dynamo_tpu.runtime.system_server import (
-            SystemStatusServer,
-            attach_engine,
-        )
-
-        system_server = SystemStatusServer(port=args.system_port)
-        attach_engine(system_server, engine)
+    if system_server is not None:
+        # Late source registration is fine: the server's routes consult
+        # the registries per request (the server itself started before
+        # the restore so /healthz was up the whole time).
         overload.register_metrics(system_server)
         drain_controller.register_metrics(system_server)
         system_server.register_drain(
             drain_controller.drain, drain_controller.status
         )
-        if kvbm is not None:
-            kvbm.register_metrics(system_server)
         if hasattr(handler, "register_metrics"):
             # DecodeHandler exposes the disagg transfer families; the
             # prefill handler has nothing to add.
             handler.register_metrics(system_server)
-        await system_server.start()
-        print(f"system server on :{system_server.port}", flush=True)
+    ready_state["ready"] = True
+    ready_state["detail"] = f"serving (incarnation {incarnation:#x})"
     print(
         f"worker serving {name} as {args.namespace}/{args.component}/"
-        f"{args.endpoint} instance {instance_id:#x}",
+        f"{args.endpoint} instance {instance_id:#x} "
+        f"incarnation {incarnation:#x}",
         flush=True,
     )
     try:

@@ -38,7 +38,13 @@ async def test_checkpoint_restore_roundtrip(tmp_path):
         await engine_b.stop()
 
 
-async def test_restore_rejects_mismatched_shape(tmp_path):
+async def test_restore_skips_mismatched_shape_as_cold_start(tmp_path):
+    """Crash-plane contract (ISSUE 10): a mismatched compatibility stamp
+    is a LOGGED COLD START (0 blocks, counted cold_mismatch), never an
+    exception — a raise here would turn one stale checkpoint into a crash
+    loop on every restart."""
+    from dynamo_tpu.runtime.liveness import RESTORE_OUTCOME
+
     ckpt = str(tmp_path / "ckpt")
     engine_a, _ = make_engine()
     try:
@@ -47,10 +53,12 @@ async def test_restore_rejects_mismatched_shape(tmp_path):
     finally:
         await engine_a.stop()
 
+    before = RESTORE_OUTCOME._values.get(("cold_mismatch",), 0)
     engine_b, _ = make_engine(block_size=8)  # different page size
     try:
-        with pytest.raises(ValueError, match="block_size"):
-            await engine_b.load_checkpoint(ckpt)
+        assert await engine_b.load_checkpoint(ckpt) == 0
+        assert engine_b.pool.cached_blocks == 0
+        assert RESTORE_OUTCOME._values.get(("cold_mismatch",), 0) == before + 1
     finally:
         await engine_b.stop()
 
