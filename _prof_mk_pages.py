@@ -58,6 +58,13 @@ x = jnp.asarray(
     rng.standard_normal((B, cfg.d_model)).astype(np.float32) * 0.3
 ).astype(jnp.bfloat16)
 
+# Sliding window for the windowed-row columns: a fixed 4-page window, so
+# as the table grows the LIVE span per row stays constant — the r11
+# windowed page loop (per-row start at floor((pos−W)/BS)) must hold
+# windowed step time ~flat across widths while the full-attention column
+# keeps tracking the table-filling history.
+WINDOW_TOKENS = 4 * 16
+
 rows = []
 for P in widths:
     NB = B * P + 8
@@ -71,10 +78,11 @@ for P in widths:
     start_pos = jnp.full((B,), P * BS - 1, jnp.int32)
     cos, sin = rope_table(start_pos[:, None], D, cfg.rope_theta)
 
-    def run():
+    def run(window=None):
         return fused_decoder_layer(
             x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
             eps=cfg.rms_norm_eps, sm_scale=D**-0.5, batch_block=4,
+            window=window,
         )
 
     t0 = time.perf_counter()
@@ -88,10 +96,24 @@ for P in widths:
         out = run()
     jax.block_until_ready(out)
     step_ms = (time.perf_counter() - t0) / n * 1000
+
+    # Windowed-row column: same full table, but every row's live span is
+    # the fixed window — pages before floor((pos−W)/BS) are never
+    # streamed, so this column should stay ~flat as P grows.
+    win = jnp.asarray(WINDOW_TOKENS, jnp.int32)
+    jax.block_until_ready(run(win))  # compile the windowed variant
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = run(win)
+    jax.block_until_ready(out)
+    win_step_ms = (time.perf_counter() - t0) / n * 1000
     rows.append(
         {"table_pages": P, "ctx_tokens": P * BS,
          "trace_compile_s": round(compile_s, 3),
-         "step_ms_per_layer": round(step_ms, 3)}
+         "step_ms_per_layer": round(step_ms, 3),
+         "window_tokens": WINDOW_TOKENS,
+         "windowed_step_ms_per_layer": round(win_step_ms, 3),
+         "windowed_vs_full": round(win_step_ms / max(step_ms, 1e-9), 3)}
     )
     print(json.dumps(rows[-1]), flush=True)
 

@@ -109,10 +109,14 @@ def measure(model: str = "llama3-8b", quant: str | None = "int8",
 
     d = jnp.asarray
     salts = np.zeros((batch,), np.int32)
-    dec_fn = runner._decode_state_fns.get((False, False))
+    # Cache key matches the runner's dispatcher: (want_logprobs,
+    # use_procs, use_megakernel) — the decode program the serving path
+    # actually dispatches for plain greedy bursts.
+    dec_key = (False, False, bool(runner.use_megakernel))
+    dec_fn = runner._decode_state_fns.get(dec_key)
     if dec_fn is None:
-        dec_fn = runner._build_decode_fn()
-        runner._decode_state_fns[(False, False)] = dec_fn
+        dec_fn = runner._build_decode_fn(use_megakernel=dec_key[2])
+        runner._decode_state_fns[dec_key] = dec_fn
 
     # The state-path decode program donates tokens/pos (the carry), so
     # hand it FRESH device copies each call — pos stays constant across

@@ -561,6 +561,15 @@ class JaxEngine:
             "queue_depth": len(self._waiting),
             "kv_high_watermark": self.args.admit_kv_high_watermark,
             "deadline_sheds": self.deadline_sheds,
+            # Megakernel coverage: decode bursts on the fused path vs the
+            # XLA fallback (per-variant split nested — flattens into
+            # per-variant gauges on the metrics surface), plus per-key
+            # demotions. A demotion shifts bursts from fused to fallback
+            # HERE, so it can never masquerade as a plain perf regression.
+            "mk_fused_bursts": self.runner.mk_fused_bursts,
+            "mk_fallback_bursts": self.runner.mk_fallback_bursts,
+            "mk_demoted_variants": len(self.runner._mk_demoted_keys),
+            "mk_bursts_by_variant": dict(self.runner.mk_bursts_by_variant),
         }
         if self.args.spec_mode:
             out["spec_proposed"] = self.spec_proposed

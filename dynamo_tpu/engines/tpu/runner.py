@@ -285,17 +285,20 @@ class DeviceRunner:
         self.use_kernel = (
             args.use_kernel if args.use_kernel is not None else backend == "tpu"
         )
-        from dynamo_tpu.ops.pallas.fused_layer import supports as _mk_supports
+        from dynamo_tpu.ops.pallas.fused_layer import (
+            supports_reason as _mk_supports_reason,
+        )
 
+        arch_reason = _mk_supports_reason(
+            args.config, lora=bool(args.lora_dir), quantized_weights=True
+        )
         mk_eligible = (
             args.layered_cache
             and not getattr(args, "kv_cache_dtype", None)
             and args.quantization == "int8"
             and mesh is None
             and args.max_num_seqs % 4 == 0
-            and _mk_supports(
-                args.config, lora=bool(args.lora_dir), quantized_weights=True
-            )
+            and arch_reason is None
         )
         if args.use_megakernel is None:
             self.use_megakernel = backend == "tpu" and mk_eligible
@@ -306,7 +309,8 @@ class DeviceRunner:
                     "use_megakernel=True requested but the configuration is "
                     "ineligible (needs: layered bf16 cache, int8 weights, "
                     "no mesh/LoRA, max_num_seqs %% 4 == 0, supported "
-                    "architecture) — falling back to the XLA decode path"
+                    "architecture%s) — falling back to the XLA decode path",
+                    f"; architecture: {arch_reason}" if arch_reason else "",
                 )
         if self.multihost and mesh is None:
             raise ValueError("multihost topology requires a device mesh")
@@ -436,11 +440,12 @@ class DeviceRunner:
         for prog in ("runner.decode_state", "runner.spec_verify"):
             watcher.set_budget(prog, self._decode_sig_budget)
 
-        # State-path decode programs, keyed (want_logprobs, use_procs).
-        # The logprob-free variant skips a full-vocab log-softmax per fused
-        # step (the common case); processor variants compile lazily on the
-        # first request that uses one.
-        self._decode_state_fns: Dict[Tuple[bool, bool], Any] = {}
+        # State-path decode programs, keyed (want_logprobs, use_procs,
+        # use_megakernel). The logprob-free variant skips a full-vocab
+        # log-softmax per fused step (the common case); processor variants
+        # compile lazily on the first request that uses one; the XLA
+        # (use_megakernel=False) variants back per-key demotions.
+        self._decode_state_fns: Dict[Tuple[bool, bool, bool], Any] = {}
         self._step_fn = self._build_step_fn()
         # (want_procs, want_top, first_chunk) → lazily compiled prefill
         # program variants. first_chunk (fresh prefill, start_pos all 0)
@@ -457,12 +462,24 @@ class DeviceRunner:
         # compile-failure fallback stays armed per combination: a
         # compile-shaped error at an UNPROVEN one demotes; any error at a
         # proven one propagates (it cannot be a compile rejection — that
-        # exact program already compiled and ran). Demotion is engine-wide
-        # on purpose: routing per-width through two compiled program
-        # families isn't worth the machinery — the XLA path keeps serving
-        # and the demotion is logged loudly.
+        # exact program already compiled and ran). Demotion is PER KEY
+        # (r11): only the failing (width bucket, variant) routes to the
+        # XLA decode program — every other bucket/variant (and the base
+        # kernel) stays proven and keeps serving fused, so one pathological
+        # long-context bucket can no longer demote the whole engine off
+        # the roofline path. Demotions are logged loudly + flight-recorded.
         self._mk_proven_keys: set = set()
+        self._mk_demoted_keys: set = set()  # per-(width, variant) demotions
         self._mk_armed_logged: set = set()  # flight "mk_arm" once per key
+        # Decode-burst path accounting (megakernel coverage observability):
+        # how many decode bursts dispatched on the fused path vs the XLA
+        # fallback, total and per variant — surfaced through engine
+        # stats()/metrics so a silent demotion can never masquerade as a
+        # plain perf regression. Written on the device-executor thread,
+        # read by stats snapshots (plain int/dict reads).
+        self.mk_fused_bursts = 0
+        self.mk_fallback_bursts = 0
+        self.mk_bursts_by_variant: Dict[str, int] = {}
         self._spec_fn: Optional[Any] = None  # speculative verify program
         self.sleep_level = 0
         self.host_params: Optional[Any] = None
@@ -681,7 +698,8 @@ class DeviceRunner:
         )
 
     def _build_decode_fn(self, want_logprobs: bool = False,
-                         want_procs: bool = False):
+                         want_procs: bool = False,
+                         use_megakernel: Optional[bool] = None):
         """Fused-decode program over the DEVICE-RESIDENT slot state.
 
         Inputs beyond params/caches are the slot-state arrays (tokens, pos,
@@ -696,7 +714,8 @@ class DeviceRunner:
         """
         cfg = self.config
         use_kernel = self.use_kernel
-        use_megakernel = self.use_megakernel
+        if use_megakernel is None:
+            use_megakernel = self.use_megakernel
         num_steps = self.args.decode_steps
 
         # The logprobs program variants also surface the per-step top-N
@@ -974,17 +993,20 @@ class DeviceRunner:
 
         Megakernel compile-failure safety net: each (width bucket, program
         variant) compiles lazily at its first dispatch — if Mosaic rejects
-        it on this jaxlib/chip, demote to the XLA decode path instead of
-        poisoning serving. NARROW by design: only compile/lowering-shaped
-        errors, and only at combinations that have never succeeded
-        (_mk_proven_keys, marked at first successful readback)."""
+        it on this jaxlib/chip, demote THAT key to the XLA decode path
+        instead of poisoning serving. NARROW by design: only
+        compile/lowering-shaped errors, only at combinations that have
+        never succeeded (_mk_proven_keys, marked at first successful
+        readback), and only the failing (width bucket, variant) key — all
+        other buckets/variants stay proven and keep dispatching fused
+        (_mk_demoted_keys)."""
         nb = int(nb)
         self._mirror(
             "decode_state", nb=nb, want_logprobs=bool(want_logprobs),
             use_procs=bool(use_procs),
         )
-        if self.use_megakernel:
-            key = (nb, bool(want_logprobs), bool(use_procs))
+        key = (nb, bool(want_logprobs), bool(use_procs))
+        if self.use_megakernel and key not in self._mk_demoted_keys:
             if key not in self._mk_proven_keys and key not in self._mk_armed_logged:
                 # Fallback armed for a never-proven (width, variant): a
                 # compile-shaped failure here demotes instead of raising.
@@ -995,7 +1017,7 @@ class DeviceRunner:
                 )
             try:
                 return self._decode_dispatch_inner(
-                    nb, want_logprobs, use_procs, mk_key=key
+                    nb, want_logprobs, use_procs, use_mk=True, mk_key=key
                 )
             except Exception as exc:
                 if (
@@ -1005,24 +1027,35 @@ class DeviceRunner:
                     raise
                 logger.exception(
                     "megakernel decode failed to compile/lower at table "
-                    "width %d (logprobs=%s, procs=%s) — falling back to "
-                    "the XLA decode path for this engine", *key,
+                    "width %d (logprobs=%s, procs=%s) — demoting THIS "
+                    "(width, variant) key to the XLA decode path; other "
+                    "buckets/variants keep the fused path", *key,
                 )
                 self.flight.record(
                     "mk_demote", width=nb, logprobs=bool(want_logprobs),
                     procs=bool(use_procs), error=type(exc).__name__,
                 )
-                self.use_megakernel = False
-                self._decode_state_fns = {}
-        return self._decode_dispatch_inner(nb, want_logprobs, use_procs)
+                self._mk_demoted_keys.add(key)
+        return self._decode_dispatch_inner(
+            nb, want_logprobs, use_procs, use_mk=False
+        )
+
+    def _variant_label(self, nb, want_logprobs, use_procs) -> str:
+        """Prometheus-safe per-variant key for the burst counters."""
+        return (
+            f"w{int(nb)}"
+            + ("_logprobs" if want_logprobs else "")
+            + ("_procs" if use_procs else "")
+        )
 
     def _decode_dispatch_inner(self, nb, want_logprobs, use_procs,
-                               mk_key=None) -> "_DecodeHandles":
-        variant = (bool(want_logprobs), bool(use_procs))
+                               use_mk=False, mk_key=None) -> "_DecodeHandles":
+        variant = (bool(want_logprobs), bool(use_procs), bool(use_mk))
         fn = self._decode_state_fns.get(variant)
         if fn is None:
             fn = self._build_decode_fn(
-                want_logprobs=variant[0], want_procs=variant[1]
+                want_logprobs=variant[0], want_procs=variant[1],
+                use_megakernel=variant[2],
             )
             self._decode_state_fns[variant] = fn
         st = self.slot_state
@@ -1065,6 +1098,17 @@ class DeviceRunner:
             self.slot_state, tokens=carry_tok, pos=carry_pos
         )
         self._log_transfer("decode", nb)
+        # Coverage accounting: the dispatch succeeded on this path. The
+        # per-variant split rides stats()/metrics so a demoted variant
+        # shows up as fallback bursts, never as a silent perf regression.
+        label = self._variant_label(nb, want_logprobs, use_procs)
+        if use_mk:
+            self.mk_fused_bursts += 1
+            self.mk_bursts_by_variant[label] = (
+                self.mk_bursts_by_variant.get(label, 0) + 1
+            )
+        else:
+            self.mk_fallback_bursts += 1
         return _DecodeHandles(
             toks=toks, logp=logp, topv=topv, topi=topi, mk_key=mk_key
         )

@@ -403,6 +403,22 @@ def gemma3_1b_config() -> ModelConfig:
     )
 
 
+def all_presets() -> Dict[str, "ModelConfig"]:
+    """Every named preset, keyed by its ``name``. The megakernel
+    supports-matrix test iterates THIS registry (a new preset is
+    automatically checked against the fused path's supports() gate or
+    the documented-exclusion table — it can never silently drift to the
+    slow decode path), and bench.py's BENCH_MODEL knob resolves from the
+    same names."""
+    presets = [
+        tiny_config(), tiny_moe_config(), mixtral_8x7b_config(),
+        qwen2_500m_config(), llama3_8b_config(), llama3_3b_config(),
+        llama3_70b_config(), qwen3_8b_config(), gemma3_1b_config(),
+        gemma2_2b_config(),
+    ]
+    return {c.name: c for c in presets}
+
+
 def gemma2_2b_config() -> ModelConfig:
     """Gemma-2-2B shape (HF google/gemma-2-2b config.json values)."""
     return ModelConfig(

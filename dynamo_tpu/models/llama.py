@@ -487,7 +487,13 @@ def forward_paged(
             # Fused-layer decode megakernel (ops/pallas/fused_layer.py):
             # one pallas program per layer; the current token's K/V come
             # back as outputs and are scattered AFTER (the kernel attends
-            # history pages + the in-register token).
+            # history pages + the in-register token). Family epilogues
+            # (qk-norm, softcap, post-norms, GeGLU, unit-offset norms,
+            # qkv-bias, sliding windows) run IN-KERNEL: the per-layer
+            # window rides a traced scalar operand (windowed and global
+            # layers share one compiled program) and the per-layer rope
+            # table is selected HERE (Gemma-3 dual-frequency: local table
+            # on windowed layers, unscaled).
             from dynamo_tpu.ops.attention import write_chunk_to_cache
             from dynamo_tpu.ops.pallas.fused_layer import (
                 fused_decoder_layer,
@@ -500,6 +506,8 @@ def forward_paged(
             )
             x2 = x[:, 0]
             cos1, sin1 = cos[:, 0], sin[:, 0]
+            cosl1 = cos_loc[:, 0] if cos_loc is not None else None
+            sinl1 = sin_loc[:, 0] if sin_loc is not None else None
             # Per-row history page counts (the kernel's scalar-prefetch
             # loop bound): one derivation per STEP, shared by every layer,
             # instead of recomputing from start_pos inside each layer call.
@@ -508,12 +516,28 @@ def forward_paged(
             pcounts = history_pcounts(
                 start_pos, k_cache[0].shape[1], block_tables.shape[1]
             )
+            any_window = any(int(w) != 0 for w in win_list)
             k_out, v_out = [], []
             for l in range(c.n_layers):
+                win_l = int(win_list[l])
+                local = cosl1 is not None and win_l > 0
                 x2, k_n, v_n = fused_decoder_layer(
-                    x2, cos1, sin1, params["layers"][l],
+                    x2,
+                    cosl1 if local else cos1,
+                    sinl1 if local else sin1,
+                    params["layers"][l],
                     k_cache[l], v_cache[l], block_tables, start_pos,
                     eps=c.rms_norm_eps, sm_scale=sm, pcounts=pcounts,
+                    # Traced operand (not static) whenever ANY layer is
+                    # windowed, so the model's layers share one compiled
+                    # program per width bucket; window-free models omit
+                    # the operand entirely (identical trace to r6).
+                    window=(
+                        jnp.asarray(win_l, jnp.int32) if any_window else None
+                    ),
+                    act_fn=c.act_fn,
+                    unit_offset=c.rmsnorm_unit_offset,
+                    softcap=float(c.attn_logit_softcap or 0.0),
                 )
                 k_out.append(
                     write_chunk_to_cache(

@@ -3,7 +3,7 @@
 ONE pallas program per decoder layer for the C=1 decode path: RMS-norm →
 int8-streamed qkv (+fused RoPE) → paged attention (history pages + the
 in-register current token) → int8-streamed o-proj → residual → RMS-norm →
-int8-streamed gate/up/silu/mul/down → residual. Weights stay in HBM and
+int8-streamed gate/up/act/mul/down → residual. Weights stay in HBM and
 stream through VMEM tiles with manual double-buffered DMAs; KV pages stream
 in per-(wave, page) steps whose first DMAs are issued during the qkv weight
 stream, so page-issue latency hides under matmul compute.
@@ -11,14 +11,39 @@ stream, so page-issue latency hides under matmul compute.
 History pages are driven by a DYNAMIC page loop (r6): the per-row block
 tables and page counts live in SMEM (scalar-prefetch operands, available
 before the body runs), each batch wave runs a ``fori_loop`` bounded by the
-wave's maximum page count, and every DMA/compute step is gated per row on
-its own scalar-prefetched count. Trace/compile size is therefore
-independent of the table width — long contexts (4k+ tokens) compile the
-same program as short ones — and short rows in a long-context batch skip
-their dead pages entirely (no stream, no mask) instead of streaming-then-
-masking up to the table capacity. Table widths are pow2-bucketed by the
-engine (engines/tpu/engine.py::table_width_bucket), so XLA holds a handful
-of programs per shape, one per bucket.
+wave's live page range, and every DMA/compute step is gated per row on its
+own scalar-prefetched bounds. Trace/compile size is therefore independent
+of the table width — long contexts (4k+ tokens) compile the same program
+as short ones — and short rows in a long-context batch skip their dead
+pages entirely (no stream, no mask) instead of streaming-then-masking up
+to the table capacity. Table widths are pow2-bucketed by the engine
+(engines/tpu/engine.py::table_width_bucket), so XLA holds a handful of
+programs per shape, one per bucket.
+
+Architecture epilogues (r11): the family knobs that used to force the
+~1/3-roofline XLA fallback are now in-kernel, so Qwen3 and Gemma-2/3
+decode on the fused path:
+
+  - **qk-norm** — per-head RMSNorm on the q/k projection columns before
+    RoPE (Qwen3/Gemma-3 order: norm → rope), a few VPU ops on vectors
+    already live in registers plus two [1, D] norm-weight operands;
+  - **attention logit softcap** — ``cap·tanh(s/cap)`` on scores before
+    masking (Gemma-2), a static-float epilogue on both the page loop and
+    the current-token column;
+  - **post-norms** — Gemma-2/3's extra RMSNorms after the attention and
+    FFN blocks; the o-proj phase accumulates into a [B, d] f32 scratch so
+    the full row is normed before the residual add (the FFN side reuses
+    the down-proj accumulator that already exists);
+  - **sliding window** — each row's dynamic page loop STARTS at
+    ``floor((pos−W)/BS)`` instead of page 0 (per-row SMEM page offsets,
+    same predicate style as the page counts) and the boundary page is
+    masked in-kernel, so a windowed row streams strictly fewer pages than
+    full attention — a perf win, not just coverage. The window rides a
+    TRACED scalar operand, so Gemma-3's 5:1 local/global layer mix shares
+    ONE compiled program per width bucket;
+  - **GeGLU / unit-offset RMSNorm / qkv-bias** — a static activation
+    switch (tanh-gelu vs SiLU), ``(1 + w)`` norm weights, and per-column
+    bias adds on the qkv tiles.
 
 Why this exists (r5): the per-layer XLA decode structure leaves the chip at
 ~1/3 of its HBM roofline at the 8B shape — a device trace showed ~490
@@ -31,17 +56,16 @@ residual in VMEM across phases.
 
 Reference parity: plays the role of the fused decode kernels inside the
 engines the reference orchestrates (vLLM/TRT-LLM fused attention+GEMM
-paths); the reference repo itself carries no TPU equivalent.
+paths serve Qwen3/Gemma natively); the reference repo itself carries no
+TPU equivalent.
 
-Scope (v2): C=1 decode, dense FFN, no sliding window, no logit cap, no
-qkv-bias, no qk-norm, no post-norms, no LoRA delta, int8 weights
-({"q8","s"} per ops/quant.py), bf16 KV pools. Context length is NOT a
-scope limit any more: the dynamic page loop serves any table width the
-engine's block tables can describe (the former ``MAX_TABLE_PAGES = 16``
-static-unroll ceiling — 256 tokens at block_size 16 — is gone). The XLA
-path (models/llama.py::decoder_layer) remains the fallback for every
-other configuration and stays the numerics oracle; parity is asserted in
-interpret mode at 256/1k/4k-token contexts and ragged short+long batches
+Scope (v3): C=1 decode, dense FFN, int8 weights ({"q8","s"} per
+ops/quant.py), bf16 KV pools, head_dim a multiple of 128. Excluded (and
+documented in supports_reason): MoE FFNs and LoRA deltas — both fall back
+to the XLA path. The XLA path (models/llama.py::decoder_layer) remains the
+fallback for every other configuration and stays the numerics oracle;
+parity is asserted in interpret mode at 256/1k/4k-token contexts, ragged
+short+long batches, and page-straddling window boundaries
 (tests/test_fused_layer.py, tests/test_zlongctx_fused.py).
 """
 
@@ -57,77 +81,85 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+_SUPPORTED_ACTS = ("silu", "gelu_tanh")
 
-def _tiles_for(d: int, HD: int, KHD: int, F: int):
-    """(TQ, TO, TF) weight-streaming tile widths for these dims."""
-    return min(256, KHD), min(512, d), min(512, F)
+
+def _tiles_for(d: int, HD: int, KHD: int, F: int, D: int):
+    """(TQ, TO, TF) weight-streaming tile widths for these dims, or None
+    when no feasible split exists. Each tile is the LARGEST lane-aligned
+    divisor under the VMEM cap: TQ covers whole heads (multiple of D) and
+    must divide both the q and k/v projection widths so every qkv col tile
+    lives entirely inside one of wq/wk/wv; TO/TF are multiples of the
+    128-lane MXU width dividing d / d_ff (Gemma shapes like d=1152 or
+    d_ff=6912 need 384 — the old min(512, ·) rule rejected them)."""
+
+    def div_tile(n: int, cap: int, step: int) -> Optional[int]:
+        t = (cap // step) * step
+        while t >= step:
+            if n % t == 0:
+                return t
+            t -= step
+        return None
+
+    tq = None
+    t = (256 // D) * D if D else 0
+    while t >= D > 0:
+        if HD % t == 0 and KHD % t == 0:
+            tq = t
+            break
+        t -= D
+    to = div_tile(d, 512, 128)
+    tf = div_tile(F, 512, 128)
+    if tq is None or to is None or tf is None:
+        return None
+    return tq, to, tf
+
+
+def supports_reason(
+    config, *, lora: bool, quantized_weights: bool
+) -> Optional[str]:
+    """Why the megakernel can NOT serve this config (None = it can).
+
+    Every knob the kernel does not implement must surface here — an
+    auto-enabled config can never crash at first decode instead of
+    falling back — and the docs' supports() matrix + the supports-matrix
+    preset test render these exact strings. qk-norm, sliding windows,
+    logit softcap, post-norms, unit-offset RMSNorm, qkv-bias and GeGLU
+    are in-kernel epilogues since r11 and are deliberately absent."""
+    c = config
+    if not quantized_weights:
+        return "weights not int8-quantized (the kernel streams int8 tiles)"
+    if lora:
+        return "LoRA adapters active (per-request delta einsums excluded)"
+    if c.is_moe:
+        return "MoE FFN (routed experts excluded; dense FFN only)"
+    if c.act_fn not in _SUPPORTED_ACTS:
+        return f"unsupported activation {c.act_fn!r} (silu/gelu_tanh only)"
+    D = c.head_dim_
+    if D <= 0 or D % 128 != 0:
+        return f"head_dim {D} not a multiple of the 128-lane MXU width"
+    if (c.n_heads % c.n_kv_heads) != 0:
+        return "n_heads not a multiple of n_kv_heads (GQA grouping)"
+    d, HD, KHD, F = c.d_model, c.n_heads * D, c.n_kv_heads * D, c.d_ff
+    if _tiles_for(d, HD, KHD, F, D) is None:
+        return (
+            "no lane-aligned weight-streaming tile split for "
+            f"(d={d}, HD={HD}, KHD={KHD}, d_ff={F})"
+        )
+    return None
 
 
 def supports(config, *, lora: bool, quantized_weights: bool) -> bool:
-    """Static eligibility of the megakernel for a model config. Every knob
-    the kernel does NOT implement must be gated here — the kernel hardcodes
-    SiLU and plain (non-unit-offset) RMSNorm — and every tiling constraint
-    fused_decoder_layer asserts must hold, so an auto-enabled config can
-    never crash at first decode instead of falling back."""
-    c = config
-    if not (
-        quantized_weights
-        and not lora
-        and not any(int(w) != 0 for w in c.layer_windows())
-        and not c.is_moe
-        and not c.qkv_bias
-        and not c.qk_norm
-        and not c.post_norms
-        and c.act_fn == "silu"
-        and not c.rmsnorm_unit_offset
-        and (c.attn_logit_softcap or 0.0) == 0.0
-        and c.head_dim_ == 128
-        and (c.n_heads % c.n_kv_heads) == 0
-    ):
-        return False
-    d, D = c.d_model, c.head_dim_
-    HD, KHD, F = c.n_heads * D, c.n_kv_heads * D, c.d_ff
-    TQ, TO, TF = _tiles_for(d, HD, KHD, F)
-    return bool(
-        HD % TQ == 0 and KHD % TQ == 0 and TQ % D == 0
-        and d % TO == 0 and F % TF == 0
+    """Static eligibility of the megakernel for a model config — True when
+    :func:`supports_reason` finds nothing to exclude."""
+    return (
+        supports_reason(config, lora=lora, quantized_weights=quantized_weights)
+        is None
     )
 
 
 def _fused_layer_kernel(
-    # SMEM operands (scalar-prefetch: available before the body runs, so
-    # they drive every page DMA's index and the dynamic loop bounds)
-    tables_ref,  # [B, P] int32
-    start_ref,  # [B] int32
-    pcount_ref,  # [B] int32 — history pages per row: ceil(start / BS)
-    # VMEM operands
-    x_ref,  # [B, d] bf16 residual stream
-    cos_ref,  # [B, D] f32 rope table at each row's position
-    sin_ref,  # [B, D] f32
-    anorm_ref,  # [1, d] attn-norm weight
-    mnorm_ref,  # [1, d] mlp-norm weight
-    wqs_ref,  # [1, H*D] f32 — per-output-col int8 scales
-    wks_ref,  # [1, KH*D]
-    wvs_ref,  # [1, KH*D]
-    wos_ref,  # [1, d]
-    wgs_ref,  # [1, F]
-    wus_ref,  # [1, F]
-    wds_ref,  # [1, d]
-    # ANY (HBM) operands
-    wq_ref,  # [d, H*D] int8
-    wk_ref,  # [d, KH*D]
-    wv_ref,  # [d, KH*D]
-    wo_ref,  # [H*D, d]
-    wg_ref,  # [d, F]
-    wu_ref,  # [d, F]
-    wd_ref,  # [F, d]
-    k_pool_ref,  # [NB, BS, KH, D] bf16 (HBM)
-    v_pool_ref,
-    # outputs (VMEM)
-    xo_ref,  # [B, d]
-    kn_ref,  # [B, KH, D] current-token K (post-rope)
-    vn_ref,  # [B, KH, D]
-    *,
+    *refs,
     eps: float,
     sm_scale: float,
     B: int,
@@ -142,7 +174,64 @@ def _fused_layer_kernel(
     TO: int,
     TF: int,
     BQ: int,
+    qk_norm: bool,
+    qkv_bias: bool,
+    post_norms: bool,
+    act_fn: str,
+    softcap: float,
+    unit_offset: bool,
 ):
+    # Positional refs vary with the static epilogue flags; parse in the
+    # exact order _fused_decoder_layer_impl assembles them.
+    it = iter(refs)
+    # SMEM (scalar-prefetch: available before the body runs, so they drive
+    # every page DMA's index and the dynamic loop bounds)
+    tables_ref = next(it)  # [B, P] int32
+    start_ref = next(it)  # [B] int32
+    pcount_ref = next(it)  # [B] int32 — history pages: ceil(start / BS)
+    wlo_ref = next(it)  # [B] int32 — first VISIBLE key index (window low)
+    poff_ref = next(it)  # [B] int32 — first live page: wlo // BS
+    # VMEM
+    x_ref = next(it)  # [B, d] bf16 residual stream
+    cos_ref = next(it)  # [B, D] f32 rope table at each row's position
+    sin_ref = next(it)  # [B, D] f32
+    anorm_ref = next(it)  # [1, d] attn-norm weight
+    mnorm_ref = next(it)  # [1, d] mlp-norm weight
+    qnorm_ref = knorm_ref = None
+    if qk_norm:
+        qnorm_ref = next(it)  # [1, D] per-head q-norm weight
+        knorm_ref = next(it)  # [1, D]
+    bq_ref = bk_ref = bv_ref = None
+    if qkv_bias:
+        bq_ref = next(it)  # [1, H*D]
+        bk_ref = next(it)  # [1, KH*D]
+        bv_ref = next(it)  # [1, KH*D]
+    apost_ref = mpost_ref = None
+    if post_norms:
+        apost_ref = next(it)  # [1, d] post-attention norm weight
+        mpost_ref = next(it)  # [1, d] post-FFN norm weight
+    wqs_ref = next(it)  # [1, H*D] f32 — per-output-col int8 scales
+    wks_ref = next(it)  # [1, KH*D]
+    wvs_ref = next(it)  # [1, KH*D]
+    wos_ref = next(it)  # [1, d]
+    wgs_ref = next(it)  # [1, F]
+    wus_ref = next(it)  # [1, F]
+    wds_ref = next(it)  # [1, d]
+    # ANY (HBM)
+    wq_ref = next(it)  # [d, H*D] int8
+    wk_ref = next(it)  # [d, KH*D]
+    wv_ref = next(it)  # [d, KH*D]
+    wo_ref = next(it)  # [H*D, d]
+    wg_ref = next(it)  # [d, F]
+    wu_ref = next(it)  # [d, F]
+    wd_ref = next(it)  # [F, d]
+    k_pool_ref = next(it)  # [NB, BS, KH, D] bf16 (HBM)
+    v_pool_ref = next(it)
+    # outputs (VMEM)
+    xo_ref = next(it)  # [B, d]
+    kn_ref = next(it)  # [B, KH, D] current-token K (post-rope)
+    vn_ref = next(it)  # [B, KH, D]
+
     G = H // KH
     HD = H * D
     KHD = KH * D
@@ -153,24 +242,36 @@ def _fused_layer_kernel(
     NW = B // BQ  # attention waves
     half = D // 2
 
+    def w1(ref, dtype=jnp.float32):
+        """Norm weight with the family's unit offset applied (Gemma stores
+        w - 1; effective scale is 1 + w)."""
+        w = ref[...].astype(dtype)
+        return w + 1.0 if unit_offset else w
+
+    def capped(s):
+        """Gemma-2 attention logit softcap (static float; 0 = off)."""
+        if softcap > 0.0:
+            return softcap * jnp.tanh(s / softcap)
+        return s
+
     def qkv_src(t):
-        """(weight ref, scale ref, col offset, kind, head offset) for
-        qkv col tile t of the concatenated [d, HD+2*KHD] projection."""
+        """(weight ref, scale ref, bias ref, col offset, kind, head offset)
+        for qkv col tile t of the concatenated [d, HD+2*KHD] projection."""
         off = t * TQ
         if off < HD:
-            return wq_ref, wqs_ref, off, "q", off // D
+            return wq_ref, wqs_ref, bq_ref, off, "q", off // D
         if off < HD + KHD:
             off -= HD
-            return wk_ref, wks_ref, off, "k", off // D
+            return wk_ref, wks_ref, bk_ref, off, "k", off // D
         off -= HD + KHD
-        return wv_ref, wvs_ref, off, "v", off // D
+        return wv_ref, wvs_ref, bv_ref, off, "v", off // D
 
     def body(h_ref, attn4_ref, wsem):
         # ---- phase 0: attn norm (VPU) ----
         xf = x_ref[...].astype(jnp.float32)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
         h_ref[...] = (xf * jax.lax.rsqrt(var + eps)).astype(jnp.bfloat16) * (
-            anorm_ref[...].astype(jnp.bfloat16)
+            w1(anorm_ref, jnp.bfloat16)
         )
 
         def rope(v):  # [B, D] f32
@@ -178,6 +279,19 @@ def _fused_layer_kernel(
             hi = v[:, half:]
             rot = jnp.concatenate([-hi, lo], axis=1)
             return v * cos_ref[...] + rot * sin_ref[...]
+
+        def head_norm(col, wref):
+            """Qwen3/Gemma-3 per-head RMSNorm over head_dim, BEFORE RoPE
+            (HF attention order: norm → rope). col: [B, D] f32."""
+            hv = jnp.mean(col * col, axis=-1, keepdims=True)
+            return col * jax.lax.rsqrt(hv + eps) * w1(wref)
+
+        def wave_lo(w):
+            """Wave's first live page (min over rows; 0 without windows)."""
+            lo = poff_ref[w * BQ]
+            for j in range(1, BQ):
+                lo = jnp.minimum(lo, poff_ref[w * BQ + j])
+            return lo
 
         # ---- phases 1+2 share the page-staging scratch: qkv streaming
         # issues wave 0's first page DMAs so their latency hides under
@@ -199,12 +313,19 @@ def _fused_layer_kernel(
                 )
 
             def row_needs(w, pp, j):
-                """Does row j of wave w have history on page pp? The SAME
-                SMEM-derived predicate gates issue (pp+2), wait (pp) and
-                compute (pp), so conditional start/wait pairs always match
-                — and a short row in a long-context wave does nothing at
-                all for its dead pages (no stream, no mask)."""
-                return pp < pcount_ref[w * BQ + j]
+                """Is page pp LIVE for row j of wave w? Live = inside
+                [poff, pcount): below pcount the row has history there,
+                and at or past poff the page holds at least one key inside
+                the sliding window. The SAME SMEM-derived predicate gates
+                issue (pp+2), wait (pp) and compute (pp), so conditional
+                start/wait pairs always match — and a short OR windowed
+                row does nothing at all for its dead pages (no stream, no
+                mask): windowed layers stream strictly fewer pages than
+                full attention."""
+                b = w * BQ + j
+                return jnp.logical_and(
+                    pp >= poff_ref[b], pp < pcount_ref[b]
+                )
 
             def issue_page(w, pp):
                 slot = pp % 3  # derived here so issue/wait can't desync
@@ -226,16 +347,17 @@ def _fused_layer_kernel(
             # ---- phase 1: qkv weight streaming + fused RoPE ----
             def phase_qkv(wbuf):
                 def w_dma(slot, t):
-                    ref, _, off, _, _ = qkv_src(t)
+                    ref, _, _, off, _, _ = qkv_src(t)
                     return pltpu.make_async_copy(
                         ref.at[:, pl.ds(off, TQ)], wbuf.at[slot],
                         wsem.at[slot],
                     )
 
                 w_dma(0, 0).start()
-                issue_page(0, 0)
+                lo0 = wave_lo(0)
+                issue_page(0, lo0)
                 if P > 1:
-                    issue_page(0, 1)
+                    issue_page(0, lo0 + 1)
 
                 h = h_ref[...]
                 for t in range(NQT):  # static: tile→(ref, head) per tile
@@ -243,17 +365,23 @@ def _fused_layer_kernel(
                     if t + 1 < NQT:
                         w_dma((t + 1) % 2, t + 1).start()
                     w_dma(slot, t).wait()
-                    _, sref, off, kind, h0 = qkv_src(t)
+                    _, sref, bref, off, kind, h0 = qkv_src(t)
                     y = jax.lax.dot_general(
                         h, wbuf[slot], (((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32,
                     ) * sref[0, pl.ds(off, TQ)][None, :]
+                    if qkv_bias:
+                        y = y + bref[0, pl.ds(off, TQ)][None, :]
                     for i in range(HPT):  # rope + scatter per covered head
                         col = y[:, i * D:(i + 1) * D]
                         hh = h0 + i
                         if kind == "q":
+                            if qk_norm:
+                                col = head_norm(col, qnorm_ref)
                             q4_ref[:, hh // G, hh % G, :] = rope(col)
                         elif kind == "k":
+                            if qk_norm:
+                                col = head_norm(col, knorm_ref)
                             kn_ref[:, hh, :] = rope(col).astype(kn_ref.dtype)
                         else:
                             vn_ref[:, hh, :] = col.astype(vn_ref.dtype)
@@ -261,19 +389,20 @@ def _fused_layer_kernel(
             pl.run_scoped(phase_qkv, wbuf=pltpu.VMEM((2, d, TQ), jnp.int8))
 
             # ---- phase 2: paged attention, page-granular flash pipeline.
-            # DYNAMIC page loop per wave: the fori_loop trip count is the
-            # wave's maximum scalar-prefetched page count, so the traced
-            # program holds ONE page-step body per wave regardless of the
-            # table width — trace/compile cost no longer scales with
-            # context length (the old static unroll paid (B/BQ)·P bodies
-            # and capped the table at 16 pages). Batch waves stay a static
-            # unroll: NW = B/BQ is small and fixed by the batch shape, and
-            # static j/kh indices keep the proven static-index style of
+            # DYNAMIC page loop per wave: the fori_loop runs over the
+            # wave's LIVE page range [min poff, max pcount) — scalar-
+            # prefetched bounds, so the traced program holds ONE page-step
+            # body per wave regardless of table width OR window value, and
+            # a windowed wave starts at its first in-window page instead
+            # of page 0. Batch waves stay a static unroll: NW = B/BQ is
+            # small and fixed by the batch shape, and static j/kh indices
+            # keep the proven static-index style of
             # ops/pallas/paged_attention.py inside the loop body. ----
             def att_wave(w):
                 npg = pcount_ref[w * BQ]
                 for j in range(1, BQ):
                     npg = jnp.maximum(npg, pcount_ref[w * BQ + j])
+                lo = wave_lo(w)
 
                 fl_m[...] = jnp.full_like(fl_m, NEG_INF)
                 fl_l[...] = jnp.zeros_like(fl_l)
@@ -286,14 +415,16 @@ def _fused_layer_kernel(
                     for j in range(BQ):
                         b = w * BQ + j
                         start = start_ref[b]
+                        wlo = wlo_ref[b]
                         wait_page(w, pp, j)
 
-                        # Skip rows whose history ends before this page —
-                        # the DMA was never issued (row_needs) and the
-                        # flash state is untouched, so traffic+compute
-                        # track sequence length, not table capacity.
+                        # Skip rows for whom this page is dead (history
+                        # ends before it, or the sliding window starts
+                        # after it) — the DMA was never issued (row_needs)
+                        # and the flash state is untouched, so traffic +
+                        # compute track the LIVE span, not table capacity.
                         @pl.when(row_needs(w, pp, j))
-                        def _(j=j, b=b, start=start):
+                        def _(j=j, b=b, start=start, wlo=wlo):
                             for kh in range(KH):
                                 q = q4_ref[b, kh]  # [G, D]
                                 kpg = pages[slot, j, 0, :, kh, :].astype(
@@ -302,14 +433,22 @@ def _fused_layer_kernel(
                                 vpg = pages[slot, j, 1, :, kh, :].astype(
                                     jnp.float32
                                 )
-                                s = jax.lax.dot_general(
+                                s = capped(jax.lax.dot_general(
                                     q, kpg, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32,
-                                ) * sm_scale  # [G, BS]
+                                ) * sm_scale)  # [G, BS]
                                 t_idx = pp * BS + jax.lax.broadcasted_iota(
                                     jnp.int32, (G, BS), 1
                                 )
-                                s = jnp.where(t_idx < start, s, NEG_INF)
+                                # causal + window: visible history keys
+                                # are t in [wlo, start) — wlo is 0 when
+                                # the layer has no window, and masks the
+                                # straddled boundary page when pos−W
+                                # lands mid-page.
+                                s = jnp.where(
+                                    (t_idx < start) & (t_idx >= wlo),
+                                    s, NEG_INF,
+                                )
                                 m = fl_m[j, kh]
                                 m_new = jnp.maximum(
                                     m, jnp.max(s, -1, keepdims=True)
@@ -329,20 +468,22 @@ def _fused_layer_kernel(
 
                     return carry
 
-                jax.lax.fori_loop(0, npg, page_step, 0)
+                jax.lax.fori_loop(lo, npg, page_step, 0)
 
                 # Next wave's first pages start streaming while this wave
                 # finalizes — the cross-wave analogue of hiding wave 0's
                 # prologue under the qkv weight stream. Every DMA this
                 # wave issued was waited inside the loop (matched
-                # row_needs predicates), so slots 0/1 have no pending
-                # traffic.
+                # row_needs predicates), so no slot has pending traffic.
                 if w + 1 < NW:
-                    issue_page(w + 1, 0)
+                    nlo = wave_lo(w + 1)
+                    issue_page(w + 1, nlo)
                     if P > 1:
-                        issue_page(w + 1, 1)
+                        issue_page(w + 1, nlo + 1)
 
-                # wave finalize: current-token column + normalize + store
+                # wave finalize: current-token column + normalize + store.
+                # The current token (t = start) is always inside the
+                # window (W >= 1), so no extra mask here.
                 for j in range(BQ):
                     b = w * BQ + j
                     for kh in range(KH):
@@ -353,10 +494,10 @@ def _fused_layer_kernel(
                         vcur = vn_ref[pl.ds(b, 1), kh, :].astype(
                             jnp.float32
                         )
-                        s_c = jax.lax.dot_general(
+                        s_c = capped(jax.lax.dot_general(
                             q, kcur, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32,
-                        ) * sm_scale  # [G, 1]
+                        ) * sm_scale)  # [G, 1]
                         m = fl_m[j, kh]
                         m_new = jnp.maximum(m, s_c)
                         alpha = jnp.exp(m - m_new)
@@ -381,8 +522,13 @@ def _fused_layer_kernel(
             psem=pltpu.SemaphoreType.DMA((3, BQ, 2)),
         )
 
-        # ---- phase 3: o-proj streaming + residual ----
-        def phase_o(obuf):
+        # ---- phase 3: o-proj streaming + (post-norm →) residual.
+        # Without post-norms each output tile folds straight into the
+        # residual. WITH them (Gemma-2/3) the RMSNorm needs the FULL
+        # projected row before the residual add, so tiles accumulate into
+        # a [B, d] f32 scratch and the norm+residual run after the
+        # stream. ----
+        def phase_o(obuf, ao_ref):
             def o_dma(slot, t):
                 return pltpu.make_async_copy(
                     wo_ref.at[:, pl.ds(t * TO, TO)], obuf.at[slot],
@@ -400,17 +546,40 @@ def _fused_layer_kernel(
                     attn, obuf[slot], (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 ) * wos_ref[0, pl.ds(t * TO, TO)][None, :]
-                xo_ref[:, pl.ds(t * TO, TO)] = (
-                    x_ref[:, pl.ds(t * TO, TO)].astype(jnp.float32) + y
+                if post_norms:
+                    ao_ref[:, pl.ds(t * TO, TO)] = y
+                else:
+                    xo_ref[:, pl.ds(t * TO, TO)] = (
+                        x_ref[:, pl.ds(t * TO, TO)].astype(jnp.float32) + y
+                    ).astype(xo_ref.dtype)
+            if post_norms:
+                a = ao_ref[...]
+                pv = jnp.mean(a * a, axis=-1, keepdims=True)
+                normed = (a * jax.lax.rsqrt(pv + eps)).astype(
+                    jnp.bfloat16
+                ) * w1(apost_ref, jnp.bfloat16)
+                xo_ref[...] = (
+                    x_ref[...].astype(jnp.float32)
+                    + normed.astype(jnp.float32)
                 ).astype(xo_ref.dtype)
 
-        pl.run_scoped(phase_o, obuf=pltpu.VMEM((2, HD, TO), jnp.int8))
+        if post_norms:
+            pl.run_scoped(
+                phase_o,
+                obuf=pltpu.VMEM((2, HD, TO), jnp.int8),
+                ao_ref=pltpu.VMEM((B, d), jnp.float32),
+            )
+        else:
+            pl.run_scoped(
+                lambda obuf: phase_o(obuf, None),
+                obuf=pltpu.VMEM((2, HD, TO), jnp.int8),
+            )
 
         # ---- phase 4: mlp norm ----
         x2 = xo_ref[...].astype(jnp.float32)
         var2 = jnp.mean(x2 * x2, axis=-1, keepdims=True)
         h_ref[...] = (x2 * jax.lax.rsqrt(var2 + eps)).astype(jnp.bfloat16) * (
-            mnorm_ref[...].astype(jnp.bfloat16)
+            w1(mnorm_ref, jnp.bfloat16)
         )
 
         # ---- phases 5+6: gate/up then down (nested: gu activations stay
@@ -445,9 +614,11 @@ def _fused_layer_kernel(
                     h2, wbuf[slot, 1], (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 ) * wus_ref[0, pl.ds(t * TF, TF)][None, :]
-                gu_ref[:, pl.ds(t * TF, TF)] = (
-                    g * jax.lax.logistic(g) * u
-                ).astype(jnp.bfloat16)
+                if act_fn == "gelu_tanh":  # Gemma GeGLU
+                    act = jax.nn.gelu(g, approximate=True)
+                else:
+                    act = g * jax.lax.logistic(g)
+                gu_ref[:, pl.ds(t * TF, TF)] = (act * u).astype(jnp.bfloat16)
 
             for _t in range(NFT):
                 gu_loop(_t)
@@ -478,9 +649,15 @@ def _fused_layer_kernel(
 
                 for _t in range(NFT):
                     d_loop(_t)
+                mlp = acc_ref[...] * wds_ref[...]
+                if post_norms:
+                    pv = jnp.mean(mlp * mlp, axis=-1, keepdims=True)
+                    mlp = (
+                        (mlp * jax.lax.rsqrt(pv + eps)).astype(jnp.bfloat16)
+                        * w1(mpost_ref, jnp.bfloat16)
+                    ).astype(jnp.float32)
                 xo_ref[...] = (
-                    xo_ref[...].astype(jnp.float32)
-                    + acc_ref[...] * wds_ref[...]
+                    xo_ref[...].astype(jnp.float32) + mlp
                 ).astype(xo_ref.dtype)
 
             pl.run_scoped(
@@ -515,9 +692,26 @@ def history_pcounts(
     return jnp.minimum((start32 + block_size - 1) // block_size, table_width)
 
 
+def window_page_bounds(
+    start_pos: jnp.ndarray, window, block_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(wlo, poff) for a sliding-window layer: ``wlo[b]`` is the first
+    VISIBLE history key index (``max(0, pos − W + 1)``; 0 when the layer
+    is full-attention) and ``poff[b] = wlo // BS`` its page — where each
+    row's dynamic page loop STARTS, so a windowed row streams only pages
+    holding in-window keys. The boundary page (``pos − W`` mid-page) is
+    streamed and masked in-kernel via the same ``wlo``. ``window`` may be
+    a TRACED scalar (0 = full) so one compiled program serves Gemma-3's
+    local/global layer mix."""
+    start32 = start_pos.astype(jnp.int32)
+    w = jnp.asarray(window, jnp.int32)
+    wlo = jnp.where(w > 0, jnp.maximum(start32 - w + 1, 0), 0)
+    return wlo, wlo // block_size
+
+
 def _fused_decoder_layer_impl(
     x: jnp.ndarray,  # [B, d] bf16 residual
-    cos: jnp.ndarray,  # [B, D] f32
+    cos: jnp.ndarray,  # [B, D] f32 (already the layer's local/global table)
     sin: jnp.ndarray,  # [B, D] f32
     lp: Dict[str, Any],  # one layer's params (quantized tree)
     k_pool: jnp.ndarray,  # [NB, BS, KH, D] bf16
@@ -530,6 +724,10 @@ def _fused_decoder_layer_impl(
     batch_block: int = 4,
     interpret: Optional[bool] = None,
     pcounts: Optional[jnp.ndarray] = None,  # [B] int32 (history_pcounts)
+    window: Optional[jnp.ndarray] = None,  # scalar int32 (0/None = full)
+    act_fn: str = "silu",
+    unit_offset: bool = False,
+    softcap: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run one fused decoder layer. Returns (x_out [B, d], k_new [B, KH, D],
     v_new [B, KH, D]); the caller scatters k_new/v_new into the pools
@@ -539,7 +737,16 @@ def _fused_decoder_layer_impl(
     via the scalar-prefetched per-row page counts (``pcounts``, derived
     per step via :func:`history_pcounts` when not supplied); the table
     width P may be anything (one compiled program per distinct P — callers
-    should bucket widths, see engines/tpu/engine.py::table_width_bucket)."""
+    should bucket widths, see engines/tpu/engine.py::table_width_bucket).
+
+    Epilogue knobs: ``window`` is a TRACED scalar (windowed and global
+    layers of one model share a compiled program; per-row live page
+    bounds are derived here via :func:`window_page_bounds` and ride the
+    SMEM scalar-prefetch path like ``pcounts``); the presence of q/k
+    norm weights, qkv biases and post-norm weights in ``lp`` selects the
+    matching in-kernel epilogues; ``act_fn``/``unit_offset``/``softcap``
+    are static switches (one compiled variant per model family, not per
+    layer)."""
     if interpret is None:
         # CPU (tests, dryruns): Mosaic doesn't lower there — emulate.
         interpret = jax.default_backend() != "tpu"
@@ -553,15 +760,21 @@ def _fused_decoder_layer_impl(
     assert B % BQ == 0, (B, BQ)
 
     KHD = KH * D
-    TQ, TO, TF = _tiles_for(d, HD, KHD, F)  # same derivation supports() gates
-    assert HD % TQ == 0 and KHD % TQ == 0 and TQ % D == 0, (HD, KHD, TQ)
-    assert d % TO == 0 and F % TF == 0, (d, TO, F, TF)
+    tiles = _tiles_for(d, HD, KHD, F, D)  # same derivation supports() gates
+    assert tiles is not None, (d, HD, KHD, F, D)
+    TQ, TO, TF = tiles
+
+    qk_norm = "q_norm" in lp
+    qkv_bias = "bq" in lp
+    post_norms = "attn_post_norm" in lp
 
     kernel = functools.partial(
         _fused_layer_kernel,
         eps=eps, sm_scale=sm_scale,
         B=B, d=d, H=H, KH=KH, D=D, F=F, P=P, BS=BS,
         TQ=TQ, TO=TO, TF=TF, BQ=BQ,
+        qk_norm=qk_norm, qkv_bias=qkv_bias, post_norms=post_norms,
+        act_fn=act_fn, softcap=float(softcap), unit_offset=unit_offset,
     )
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)  # noqa: E731
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
@@ -575,10 +788,31 @@ def _fused_decoder_layer_impl(
     if pcounts is None:
         pcounts = history_pcounts(start32, BS, P)
     pcounts = pcounts.astype(jnp.int32)
+    # Sliding-window live range: first visible key + its page, per row
+    # (zeros when the layer has no window — the full-attention case).
+    if window is None:
+        wlo = jnp.zeros_like(start32)
+        poff = jnp.zeros_like(start32)
+    else:
+        wlo, poff = window_page_bounds(start32, window, BS)
+
+    extra_vmem = []
+    if qk_norm:
+        extra_vmem += [two_d(lp["q_norm"]), two_d(lp["k_norm"])]
+    if qkv_bias:
+        extra_vmem += [two_d(lp["bq"]), two_d(lp["bk"]), two_d(lp["bv"])]
+    if post_norms:
+        extra_vmem += [
+            two_d(lp["attn_post_norm"]), two_d(lp["mlp_post_norm"]),
+        ]
 
     out = pl.pallas_call(
         kernel,
-        in_specs=[smem(), smem(), smem()] + [vmem()] * 12 + [hbm()] * 9,
+        in_specs=(
+            [smem()] * 5
+            + [vmem()] * (12 + len(extra_vmem))
+            + [hbm()] * 9
+        ),
         out_specs=(vmem(), vmem(), vmem()),
         out_shape=(
             jax.ShapeDtypeStruct((B, d), x.dtype),
@@ -590,8 +824,11 @@ def _fused_decoder_layer_impl(
         block_tables.astype(jnp.int32),
         start32,
         pcounts,
+        wlo.astype(jnp.int32),
+        poff.astype(jnp.int32),
         x, cos.astype(jnp.float32), sin.astype(jnp.float32),
         two_d(lp["attn_norm"]), two_d(lp["mlp_norm"]),
+        *extra_vmem,
         two_d(lp["wq"]["s"]), two_d(lp["wk"]["s"]), two_d(lp["wv"]["s"]),
         two_d(lp["wo"]["s"]),
         two_d(lp["w_gate"]["s"]), two_d(lp["w_up"]["s"]),
@@ -613,6 +850,9 @@ fused_decoder_layer = watched_jit(
     "pallas.fused_decoder_layer",
     functools.partial(
         jax.jit,
-        static_argnames=("eps", "sm_scale", "batch_block", "interpret"),
+        static_argnames=(
+            "eps", "sm_scale", "batch_block", "interpret",
+            "act_fn", "unit_offset", "softcap",
+        ),
     )(_fused_decoder_layer_impl),
 )

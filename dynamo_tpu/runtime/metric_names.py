@@ -54,6 +54,15 @@ ENGINE_DEADLINE_SHEDS = engine_gauge("deadline_sheds")
 # live handoff drain is in progress (rides load reports router-ward so
 # KvScheduler stops placing work here immediately).
 ENGINE_DRAINING = engine_gauge("draining")
+# Megakernel coverage (decode-path observability): decode bursts that
+# dispatched on the fused megakernel path vs the XLA fallback, and the
+# count of per-(width bucket, variant) compile-failure demotions. The
+# per-variant split rides the nested stats sub-dict (flattened at scrape
+# like the kvbm sub-dict); bench.py records the fused fraction so a
+# silent demotion can never masquerade as a plain perf regression.
+ENGINE_MK_FUSED_BURSTS = engine_gauge("mk_fused_bursts")
+ENGINE_MK_FALLBACK_BURSTS = engine_gauge("mk_fallback_bursts")
+ENGINE_MK_DEMOTED_VARIANTS = engine_gauge("mk_demoted_variants")
 
 # -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
 ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
@@ -332,6 +341,9 @@ ALL_ENGINE = (
     ENGINE_KV_HIGH_WATERMARK,
     ENGINE_DEADLINE_SHEDS,
     ENGINE_DRAINING,
+    ENGINE_MK_FUSED_BURSTS,
+    ENGINE_MK_FALLBACK_BURSTS,
+    ENGINE_MK_DEMOTED_VARIANTS,
     ENGINE_STEP_DURATION,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_STEP_PREFILL_TOKENS,

@@ -15,12 +15,16 @@ from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.quantize import quantize_params
 from dynamo_tpu.ops.attention import write_chunk_to_cache
-from dynamo_tpu.ops.pallas.fused_layer import fused_decoder_layer, supports
+from dynamo_tpu.ops.pallas.fused_layer import (
+    fused_decoder_layer,
+    supports,
+    supports_reason,
+)
 from dynamo_tpu.ops.rope import rope_table
 
 
-def _cfg():
-    return ModelConfig(
+def _cfg(**overrides):
+    base = dict(
         name="fused-test",
         d_model=256,
         n_layers=1,
@@ -32,14 +36,73 @@ def _cfg():
         rope_theta=10000.0,
         dtype=jnp.bfloat16,
     )
+    base.update(overrides)
+    return ModelConfig(**base)
 
 
-def _layer_params(cfg, seed=0):
+def _qwen3_cfg():
+    """Qwen3-shaped knobs at the test miniature: qk-norm, no bias."""
+    return _cfg(name="fused-qwen3", qk_norm=True, rms_norm_eps=1e-6)
+
+
+def _gemma3_cfg(window=24):
+    """Gemma-3-shaped knobs at the test miniature: qk-norm, GeGLU,
+    unit-offset norms, post-norms, query scale, sliding window on every
+    other layer (the n_layers=1 slice used here is the WINDOWED kind)."""
+    return _cfg(
+        name="fused-gemma3",
+        qk_norm=True,
+        act_fn="gelu_tanh",
+        rmsnorm_unit_offset=True,
+        post_norms=True,
+        query_scale=128.0,
+        rms_norm_eps=1e-6,
+        sliding_window=window,
+    )
+
+
+def _gemma2_cfg():
+    """Gemma-2-shaped knobs: softcap + post-norms + GeGLU, no qk-norm."""
+    return _cfg(
+        name="fused-gemma2",
+        act_fn="gelu_tanh",
+        rmsnorm_unit_offset=True,
+        post_norms=True,
+        attn_logit_softcap=30.0,
+        query_scale=128.0,
+        sliding_window=32,
+    )
+
+
+def _layer_params(cfg, seed=0, scramble=False):
     params = llama.init_params(cfg, jax.random.PRNGKey(seed))
     axes = llama.param_logical_axes(cfg)
     qparams, _ = quantize_params(params, axes)
     # one layer, axis 0 stripped
-    return jax.tree.map(lambda a: a[0], qparams["layers"])
+    lp = jax.tree.map(lambda a: a[0], qparams["layers"])
+    if scramble:
+        lp = _scramble_epilogues(lp, seed=seed + 100)
+    return lp
+
+
+def _scramble_epilogues(lp, seed=7):
+    """Replace the init-time NEUTRAL epilogue params (unit norm weights,
+    zero biases — which would hide a missing epilogue entirely) with
+    non-trivial values, so parity actually exercises every epilogue."""
+    r = np.random.default_rng(seed)
+    out = dict(lp)
+    for k in ("q_norm", "k_norm", "attn_post_norm", "mlp_post_norm",
+              "attn_norm", "mlp_norm"):
+        if k in out:
+            out[k] = jnp.asarray(
+                r.uniform(0.5, 1.5, out[k].shape).astype(np.float32)
+            ).astype(out[k].dtype)
+    for k in ("bq", "bk", "bv"):
+        if k in out:
+            out[k] = jnp.asarray(
+                (r.standard_normal(out[k].shape) * 0.1).astype(np.float32)
+            ).astype(out[k].dtype)
+    return out
 
 
 def _setup(cfg, B=8, BS=16, P=2, seed=1, start=None):
@@ -69,18 +132,43 @@ def _setup(cfg, B=8, BS=16, P=2, seed=1, start=None):
     return x, k_pool, v_pool, tables, start_pos
 
 
-def _oracle(cfg, lp, x, k_pool, v_pool, tables, start_pos):
+def _oracle(cfg, lp, x, k_pool, v_pool, tables, start_pos, win=0):
     """XLA decoder_layer on the same inputs (write-then-attend)."""
     B = x.shape[0]
     pos = start_pos[:, None]
     cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
     chunk = jnp.ones((B,), jnp.int32)
     x_out, k_c, v_c = llama.decoder_layer(
-        cfg, lp, {}, jnp.int32(0), x[:, None, :], cos, sin,
+        cfg, lp, {}, jnp.asarray(win, jnp.int32), x[:, None, :], cos, sin,
         k_pool, v_pool, tables, start_pos, chunk,
         use_kernel=False, adapter_ids=None,
     )
     return x_out[:, 0], k_c, v_c
+
+
+def _sm_scale(cfg):
+    return (
+        cfg.query_scale**-0.5
+        if cfg.query_scale is not None
+        else cfg.head_dim_**-0.5
+    )
+
+
+def _fused(cfg, lp, x, k_pool, v_pool, tables, start_pos, win=0,
+           batch_block=4):
+    """fused_decoder_layer with the config's epilogue statics applied —
+    the exact call shape models/llama.py forward_paged makes."""
+    pos = start_pos[:, None]
+    cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+    return fused_decoder_layer(
+        x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
+        eps=cfg.rms_norm_eps, sm_scale=_sm_scale(cfg),
+        batch_block=batch_block, interpret=True,
+        window=(jnp.asarray(win, jnp.int32) if win else None),
+        act_fn=cfg.act_fn,
+        unit_offset=cfg.rmsnorm_unit_offset,
+        softcap=float(cfg.attn_logit_softcap or 0.0),
+    )
 
 
 def test_supports_gate():
@@ -88,6 +176,132 @@ def test_supports_gate():
     assert supports(cfg, lora=False, quantized_weights=True)
     assert not supports(cfg, lora=True, quantized_weights=True)
     assert not supports(cfg, lora=False, quantized_weights=False)
+
+
+def test_supports_no_longer_gates_family_knobs():
+    """The r11 epilogues: every knob the acceptance list names is now
+    in-kernel, so supports() must pass configs carrying ANY mix of them
+    — and still exclude what is genuinely unimplemented (MoE)."""
+    from dynamo_tpu.models.config import tiny_moe_config
+
+    for cfg in (_qwen3_cfg(), _gemma3_cfg(), _gemma2_cfg(),
+                _cfg(qkv_bias=True), _cfg(rmsnorm_unit_offset=True),
+                _cfg(act_fn="gelu_tanh"), _cfg(sliding_window=64),
+                _cfg(attn_logit_softcap=50.0), _cfg(post_norms=True)):
+        assert supports(cfg, lora=False, quantized_weights=True), (
+            cfg.name,
+            supports_reason(cfg, lora=False, quantized_weights=True),
+        )
+    assert not supports(
+        tiny_moe_config(), lora=False, quantized_weights=True
+    )
+
+
+# Presets the megakernel can NOT serve, with the reason fragment that
+# supports_reason must carry. The docs' supports() matrix
+# (docs/design_docs/megakernel_paged_streaming.md) renders this table; a
+# NEW preset must either pass supports() or be added here with a reason —
+# it can never silently drift to the ~1/3-roofline XLA path.
+DOCUMENTED_PRESET_EXCLUSIONS = {
+    "tiny-llama": "head_dim",       # 32: not a multiple of the MXU lane
+    "tiny-moe": "MoE",              # routed experts excluded
+    "mixtral-8x7b": "MoE",
+    "qwen2.5-0.5b": "head_dim",     # 64: not a multiple of the MXU lane
+}
+
+
+def test_supports_matrix_covers_every_preset():
+    """Every named preset in models/config.py (the all_presets registry)
+    either rides the fused path or matches a documented exclusion — new
+    presets can't silently decode on the slow path."""
+    from dynamo_tpu.models.config import all_presets
+
+    presets = all_presets().values()
+    assert len(presets) >= 10  # the registry actually enumerates
+    for cfg in presets:
+        reason = supports_reason(cfg, lora=False, quantized_weights=True)
+        if cfg.name in DOCUMENTED_PRESET_EXCLUSIONS:
+            frag = DOCUMENTED_PRESET_EXCLUSIONS[cfg.name]
+            assert reason is not None and frag in reason, (cfg.name, reason)
+        else:
+            assert reason is None, (
+                f"preset {cfg.name!r} silently drifted off the fused "
+                f"path: {reason} — fix the kernel or document the "
+                "exclusion in DOCUMENTED_PRESET_EXCLUSIONS + the design "
+                "doc matrix"
+            )
+    # The headline families of this PR are affirmatively ON the path.
+    for name in ("qwen3-8b", "gemma-3-1b", "gemma-2-2b", "llama-3-8b"):
+        assert name not in DOCUMENTED_PRESET_EXCLUSIONS
+
+
+def test_window_page_bounds_semantics():
+    """window_page_bounds: wlo is the first VISIBLE key (max(0, pos−W+1)),
+    poff its page — including the straddle case where pos−W lands
+    mid-page (the boundary page is streamed and masked in-kernel)."""
+    from dynamo_tpu.ops.pallas.fused_layer import window_page_bounds
+
+    BS = 16
+    start = jnp.asarray([0, 5, 100, 100, 64, 200], jnp.int32)
+    #                 W: full  windows below
+    wlo, poff = window_page_bounds(start, 0, BS)
+    assert np.all(np.asarray(wlo) == 0) and np.all(np.asarray(poff) == 0)
+
+    wlo, poff = window_page_bounds(start, 40, BS)
+    exp_wlo = np.maximum(np.asarray(start) - 40 + 1, 0)
+    np.testing.assert_array_equal(np.asarray(wlo), exp_wlo)
+    np.testing.assert_array_equal(np.asarray(poff), exp_wlo // BS)
+    # pos=100, W=40 → first visible key 61, mid-page on page 3 (straddle)
+    assert int(wlo[2]) == 61 and int(poff[2]) == 3 and 61 % BS != 0
+    # window covering the whole history → page 0
+    wlo, poff = window_page_bounds(start, 512, BS)
+    assert np.all(np.asarray(poff) == 0)
+
+
+@pytest.mark.parametrize(
+    "mkcfg", [_qwen3_cfg, _gemma3_cfg, _gemma2_cfg],
+    ids=["qwen3", "gemma3", "gemma2"],
+)
+def test_epilogue_parity_short(mkcfg):
+    """Qwen3-/Gemma-shaped configs on the fused path vs the XLA oracle at
+    short contexts, with randomized epilogue params (neutral init values
+    would hide a missing epilogue) and window boundaries that straddle a
+    page edge (pos−W mid-page)."""
+    cfg = mkcfg()
+    win = int(cfg.sliding_window or 0)
+    # starts include: zero history, page edges, mid-page, and (with the
+    # windowed configs) positions whose pos−W lands mid-page.
+    start = [0, 1, 15, 16, 19, 31, 45, 63]
+    _parity(cfg, 8, 4, start, seed=11, win=win, scramble=True)
+
+
+def test_head_dim_256_parity():
+    """head_dim 256 — REAL Gemma-2/3 geometry (supports() now admits
+    D % 128 == 0, so the presets auto-enable): covers the D=256 rope
+    half-split (128), TQ=256/HPT=1 head tiling, and [1, 256] qk-norm
+    weight broadcasting, none of which the D=128 miniatures touch."""
+    cfg = _cfg(
+        name="fused-d256", n_heads=2, n_kv_heads=1, head_dim=256,
+        qk_norm=True, act_fn="gelu_tanh", rmsnorm_unit_offset=True,
+        post_norms=True, query_scale=256.0, sliding_window=24,
+        rms_norm_eps=1e-6,
+    )
+    assert supports(cfg, lora=False, quantized_weights=True), (
+        supports_reason(cfg, lora=False, quantized_weights=True)
+    )
+    start = [0, 15, 19, 31, 45, 48, 55, 63]
+    _parity(cfg, 8, 4, start, seed=31, win=24, scramble=True)
+
+
+def test_window_parity_straddles_page_edge():
+    """The boundary page: pos−W mid-page means the first live page is
+    PARTIALLY masked in-kernel. Windows chosen so wlo % BS != 0 for the
+    interesting rows, on the plain llama-shaped config (window is
+    orthogonal to the other epilogues)."""
+    cfg = _cfg()
+    for win in (17, 40):
+        start = [0, 20, 33, 47, 48, 55, 60, 63]
+        _parity(cfg, 8, 4, start, seed=13 + win, win=win)
 
 
 @pytest.mark.parametrize("P", [1, 2, 3])
@@ -167,19 +381,21 @@ def test_fused_layer_then_write_matches_pool_update():
     )
 
 
-def _parity(cfg, B, P, start, seed=2, batch_block=4):
-    """Fused kernel vs XLA oracle on one shape; returns max relative err."""
-    lp = _layer_params(cfg)
+def _parity(cfg, B, P, start, seed=2, batch_block=4, win=0, scramble=False):
+    """Fused kernel vs XLA oracle on one shape; returns max relative err.
+    ``win`` > 0 runs both paths with that sliding window; ``scramble``
+    randomizes the epilogue params (neutral init values would hide a
+    missing epilogue)."""
+    lp = _layer_params(cfg, scramble=scramble)
     x, k_pool, v_pool, tables, start_pos = _setup(
         cfg, B=B, P=P, seed=seed, start=start
     )
-    ref_x, _, _ = _oracle(cfg, lp, x, k_pool, v_pool, tables, start_pos)
-    pos = start_pos[:, None]
-    cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
-    got_x, _, _ = fused_decoder_layer(
-        x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
-        eps=cfg.rms_norm_eps, sm_scale=cfg.head_dim_**-0.5,
-        batch_block=batch_block, interpret=True,
+    ref_x, _, _ = _oracle(
+        cfg, lp, x, k_pool, v_pool, tables, start_pos, win=win
+    )
+    got_x, _, _ = _fused(
+        cfg, lp, x, k_pool, v_pool, tables, start_pos, win=win,
+        batch_block=batch_block,
     )
     a = np.asarray(got_x, dtype=np.float32)
     b = np.asarray(ref_x, dtype=np.float32)
@@ -246,10 +462,67 @@ async def test_engine_megakernel_matches_xla_decode():
     assert fused == base, (fused, base)
 
 
+@pytest.mark.parametrize("family", ["qwen3", "gemma3"])
+async def test_engine_megakernel_matches_xla_family_shapes(family):
+    """Full engine on CPU (interpret mode): greedy decode with the
+    megakernel ON must match the XLA decode path token-for-token on
+    Qwen3- and Gemma-3-shaped configs — the families this PR moves onto
+    the fused path. The gemma shape mixes a WINDOWED and a GLOBAL layer
+    (sliding_window_pattern=2) so the traced-window-operand program
+    sharing and the dual behavior are both exercised end-to-end, and the
+    coverage counters must show the bursts rode the fused path."""
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.engine import collect
+
+    if family == "qwen3":
+        cfg = _cfg(name="e2e-qwen3", n_layers=2, qk_norm=True)
+    else:
+        cfg = _cfg(
+            name="e2e-gemma3", n_layers=2, qk_norm=True,
+            act_fn="gelu_tanh", rmsnorm_unit_offset=True, post_norms=True,
+            query_scale=128.0, sliding_window=24, sliding_window_pattern=2,
+        )
+        assert cfg.layer_windows() == [24, 0]  # windowed + global mix
+
+    async def run(use_mk):
+        e = JaxEngine(JaxEngineArgs(
+            config=cfg, block_size=16, num_kv_blocks=64, max_num_seqs=4,
+            max_model_len=96, quantization="int8", use_megakernel=use_mk,
+        ))
+        assert e.runner.use_megakernel == use_mk
+        try:
+            req = PreprocessedRequest(
+                token_ids=[3, 4, 5, 6, 7, 8, 9, 10], request_id=f"f{use_mk}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=10),
+            )
+            outs = await collect(e.generate(req, Context()))
+            if use_mk:
+                assert e.runner.mk_fused_bursts > 0, "never dispatched fused"
+                assert not e.runner._mk_demoted_keys
+                assert e.stats()["mk_fused_bursts"] > 0
+            return [t for d in outs for t in d.token_ids]
+        finally:
+            await e.stop()
+
+    base = await run(False)
+    fused = await run(True)
+    assert len(base) == 10
+    assert fused == base, (fused, base)
+
+
 async def test_megakernel_failure_falls_back_to_xla(monkeypatch):
     """If Mosaic rejects the fused kernel at first dispatch (new jaxlib,
-    VMEM limit), the runner demotes to the XLA path and serving continues
-    — a bench/production run never dies on a kernel lowering error."""
+    VMEM limit), the runner demotes that (width, variant) KEY to the XLA
+    path and serving continues — a bench/production run never dies on a
+    kernel lowering error, and the megakernel stays armed for every other
+    bucket/variant."""
     from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
     from dynamo_tpu.llm.protocols.common import (
         PreprocessedRequest,
@@ -281,7 +554,11 @@ async def test_megakernel_failure_falls_back_to_xla(monkeypatch):
         outs = await collect(e.generate(req, Context()))
         toks = [t for d in outs for t in d.token_ids]
         assert len(toks) == 6, toks
-        assert not e.runner.use_megakernel, "runner did not demote"
+        # Per-key demotion: the failing (width, variant) routed to XLA
+        # (and serving continued); the megakernel itself stays armed.
+        assert e.runner._mk_demoted_keys, "runner did not demote the key"
+        assert e.runner.use_megakernel, "engine-wide demotion returned"
+        assert e.runner.mk_fallback_bursts > 0
         assert not any(o.error for o in outs)
     finally:
         await e.stop()

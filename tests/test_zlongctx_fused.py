@@ -16,11 +16,21 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from dynamo_tpu.ops.pallas.fused_layer import fused_decoder_layer
 from dynamo_tpu.ops.rope import rope_table
 
-from test_fused_layer import _cfg, _layer_params, _parity, _setup
+from test_fused_layer import (
+    _cfg,
+    _fused,
+    _gemma3_cfg,
+    _layer_params,
+    _oracle,
+    _parity,
+    _qwen3_cfg,
+    _setup,
+)
 
 
 @pytest.mark.parametrize("ctx", [256, 1024, 4096])
@@ -44,6 +54,130 @@ def test_fused_layer_ragged_batch_parity():
     cfg = _cfg()
     start = [0, 3, 16, 255, 1024, 2047, 4095, 500]
     _parity(cfg, 8, 256, start, seed=3)
+
+
+@pytest.mark.parametrize("ctx", [256, 1024, 4096])
+@pytest.mark.parametrize(
+    "mkcfg", [_qwen3_cfg, _gemma3_cfg], ids=["qwen3", "gemma3"]
+)
+def test_fused_epilogue_long_context_parity(mkcfg, ctx):
+    """Qwen3- and Gemma-3-shaped configs on the fused path at 256/1k/4k-
+    token tables, epilogue params randomized, rows at the context edge,
+    mid-context, near-zero and zero history. The gemma config's window
+    (24) puts pos−W mid-page at the edge rows — the straddled boundary
+    page is masked in-kernel while everything before it is skipped."""
+    cfg = mkcfg()
+    BS = 16
+    P = ctx // BS
+    win = int(cfg.sliding_window or 0)
+    start = [ctx - 1, ctx // 2, 3, 0]
+    _parity(cfg, 4, P, start, seed=17 + ctx, win=win, scramble=True)
+
+
+def test_fused_epilogue_ragged_window_parity():
+    """Short and long rows mixed in one long-context WINDOWED batch: the
+    per-row live page range (poff..pcount) differs per row inside one
+    wave, so skip-below-window, skip-past-history and the masked boundary
+    page all coexist — numerics must hold for every kind."""
+    cfg = _gemma3_cfg(window=100)
+    start = [0, 3, 16, 255, 1024, 2047, 4095, 500]
+    _parity(cfg, 8, 256, start, seed=19, win=100, scramble=True)
+
+
+def test_windowed_rows_stream_only_live_pages():
+    """THE page-step proof: fully-dead pages (before the window's first
+    page, or past the history) are NEVER STREAMED — not streamed-then-
+    masked. Dead pages' pool content is poisoned with NaN: a kernel that
+    streams them cannot hide it (masked scores zero the weights, but
+    0 × NaN = NaN through the p·V accumulate — the XLA oracle, which
+    gathers the full table and masks, is shown to produce NaN on the same
+    poisoned pool). The fused output must be bit-identical to the clean
+    run."""
+    from dynamo_tpu.ops.pallas.fused_layer import (
+        history_pcounts,
+        window_page_bounds,
+    )
+
+    cfg = _cfg()
+    BS, P, B, win = 16, 8, 4, 40
+    lp = _layer_params(cfg)
+    start = [127, 100, 70, 0]
+    x, k_pool, v_pool, tables, start_pos = _setup(
+        cfg, B=B, P=P, seed=23, start=start
+    )
+    clean_x, clean_k, clean_v = _fused(
+        cfg, lp, x, k_pool, v_pool, tables, start_pos, win=win
+    )
+
+    # Poison every page OUTSIDE each row's live range [poff, pcount).
+    wlo, poff = window_page_bounds(start_pos, win, BS)
+    pcounts = history_pcounts(start_pos, BS, P)
+    kp = np.asarray(k_pool, np.float32)
+    vp = np.asarray(v_pool, np.float32)
+    n_dead = 0
+    for b in range(B):
+        for p in range(P):
+            if not (int(poff[b]) <= p < int(pcounts[b])):
+                kp[int(tables[b, p])] = np.nan
+                vp[int(tables[b, p])] = np.nan
+                n_dead += 1
+    assert n_dead > 0
+    kpj = jnp.asarray(kp).astype(k_pool.dtype)
+    vpj = jnp.asarray(vp).astype(v_pool.dtype)
+
+    got_x, got_k, got_v = _fused(
+        cfg, lp, x, kpj, vpj, tables, start_pos, win=win
+    )
+    assert np.isfinite(np.asarray(got_x, np.float32)).all()
+    np.testing.assert_array_equal(
+        np.asarray(got_x, np.float32), np.asarray(clean_x, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_k, np.float32), np.asarray(clean_k, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_v, np.float32), np.asarray(clean_v, np.float32)
+    )
+
+    # Self-validation: a stream-then-mask implementation CANNOT pass this
+    # test — the XLA oracle (which gathers the whole table and masks)
+    # produces NaN on the same poisoned pool.
+    ref_x, _, _ = _oracle(
+        cfg, lp, x, kpj, vpj, tables, start_pos, win=win
+    )
+    assert np.isnan(np.asarray(ref_x, np.float32)).any(), (
+        "poison did not reach the stream-and-mask path; the proof is void"
+    )
+
+
+def test_window_value_shares_one_compiled_program():
+    """The window rides a TRACED scalar operand: Gemma-3's 5:1
+    local/global layer mix (window W on some layers, 0 on others) must
+    share ONE compiled program per width bucket — the jit cache grows on
+    the first windowed call and stays flat across window VALUES."""
+    cfg = _gemma3_cfg()
+    lp = _layer_params(cfg)
+    x, k_pool, v_pool, tables, start_pos = _setup(
+        cfg, B=4, P=8, seed=29, start=[0, 1, 2, 3]
+    )
+    s0 = fused_decoder_layer._cache_size()
+    for win in (24, 0, 512, 7):
+        # win=0 still passes the operand (jnp scalar), as forward_paged
+        # does for a model with ANY windowed layer.
+        pos = start_pos[:, None]
+        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+        fused_decoder_layer(
+            x, cos[:, 0], sin[:, 0], lp, k_pool, v_pool, tables, start_pos,
+            eps=cfg.rms_norm_eps, sm_scale=cfg.query_scale**-0.5,
+            batch_block=4, interpret=True,
+            window=jnp.asarray(win, jnp.int32),
+            act_fn=cfg.act_fn, unit_offset=cfg.rmsnorm_unit_offset,
+            softcap=0.0,
+        )
+    assert fused_decoder_layer._cache_size() - s0 == 1, (
+        "window VALUE changed the compiled-program count — it must ride "
+        "the operand, not the trace"
+    )
 
 
 def _count_eqns(jaxpr) -> int:
@@ -184,21 +318,41 @@ def test_transient_at_unproven_width_propagates(monkeypatch):
 def test_unproven_width_compile_error_demotes(monkeypatch):
     """A DETERMINISTIC lowering failure at a wider, never-proven bucket
     (e.g. the first long-context request tripping an SMEM/VMEM limit the
-    short-context program never hit) must still demote to the XLA path —
-    long-context serving degrades instead of erroring forever."""
+    short-context program never hit) must demote THAT (width, variant)
+    key to the XLA path — long-context serving degrades instead of
+    erroring forever — while every other bucket/variant (including the
+    already-proven base key) keeps dispatching fused."""
     from dynamo_tpu.ops.pallas import fused_layer
 
     r = _mk_runner()
     _raw_decode(r, nb=1)
     assert (1, False, False) in r._mk_proven_keys
+    fused_before = r.mk_fused_bursts
+
+    real = fused_layer.fused_decoder_layer
 
     def boom(*a, **k):
         raise RuntimeError("Mosaic lowering failed: scoped VMEM over budget")
 
     monkeypatch.setattr(fused_layer, "fused_decoder_layer", boom)
-    toks, _, _, _ = _raw_decode(r, nb=2)  # demotes, then serves via XLA
+    toks, _, _, _ = _raw_decode(r, nb=2)  # demotes the key, serves via XLA
     assert toks.shape[0] == 4
-    assert not r.use_megakernel, "compile failure at new width did not demote"
+    assert (2, False, False) in r._mk_demoted_keys
+    assert r.mk_fallback_bursts == 1
+    # Fallback ISOLATION: the megakernel stays armed and the proven base
+    # key still dispatches fused (restore the real kernel — the width-1
+    # program is already compiled, but a later engine may re-trace).
+    monkeypatch.setattr(fused_layer, "fused_decoder_layer", real)
+    assert r.use_megakernel, "per-key demotion must not disable the kernel"
+    toks, _, _, _ = _raw_decode(r, nb=1)
+    assert toks.shape[0] == 4
+    assert r.mk_fused_bursts == fused_before + 1, (
+        "proven key stopped dispatching fused after an unrelated demotion"
+    )
+    # ... and the demoted key keeps serving via XLA without re-raising.
+    toks, _, _, _ = _raw_decode(r, nb=2)
+    assert toks.shape[0] == 4
+    assert r.mk_fallback_bursts == 2
 
 
 async def test_engine_megakernel_past_old_table_ceiling():
