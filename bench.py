@@ -31,7 +31,10 @@ BENCH_SECONDARY=0 (skip the 8B-int8 leg), BENCH_DISAGG=0 / BENCH_OVERLOAD=0
 / BENCH_DRAIN=0 / BENCH_CRASH=0 (skip the disagg / overload-armor /
 SIGTERM-drain / kill-9-crash legs), BENCH_PROJECTION=0 (skip the modeled
 70B tp8 projection leg — it otherwise ALWAYS lands, measured per-layer
-inputs on TPU, roofline-modeled inputs elsewhere).
+inputs on TPU, roofline-modeled inputs elsewhere), BENCH_ELASTICITY=0
+(skip the sim-clocked elasticity leg: planner ramp convergence,
+scale-down re-prefill, select_worker cost at 10 vs 100 workers — pure
+CPU arithmetic, lands on any backend).
 """
 
 from __future__ import annotations
@@ -1297,6 +1300,128 @@ async def run_crash_leg(isl: int = 64, osl: int = 48, concurrency: int = 8,
         gc.collect()
 
 
+async def run_elasticity_leg(seed: int = 29):
+    """Elasticity-loop measurement (ISSUE 13), sim-clocked
+    (planner/simfleet.py — the REAL KvScheduler + LivenessTracker +
+    Planner + ElasticController around simulated workers, so the leg is
+    pure CPU arithmetic and lands on any backend):
+
+      * ramp 1× → 4× → 1× open-loop load: adjustment intervals from each
+        rate shift until desired == ready (convergence), both directions;
+      * scale-down cost: drain-attributed re-prefilled tokens (the
+        zero-re-prefill handoff contract — must be 0) + zero lost
+        streams token-exact over the whole ramp;
+      * per-request ``select_worker`` cost at 10 vs 100 workers, wall
+        time AND candidates actually scored (the pruned-candidate path's
+        sub-linear-growth contract).
+    """
+    from dynamo_tpu.planner import (
+        ElasticConfig,
+        ElasticController,
+        Planner,
+        PlannerConfig,
+        SimConfig,
+        SimFleet,
+        profile_interpolators,
+    )
+    from dynamo_tpu.router.protocols import LoadSnapshot
+    from dynamo_tpu.router.scheduler import KvScheduler
+    from dynamo_tpu.tokens.radix import OverlapScores
+
+    fault_activity0 = _fault_activity_start()
+    cfg = SimConfig(seed=seed, worker_max_conc=4, base_itl_s=0.02,
+                    base_ttft_s=0.1, isl=128, osl=32, launch_delay_s=0.6)
+    base_rate = 30.0  # ≈ 5 SLA-sized workers; 4× ≈ 19
+    shifts = (15.0, 35.0)
+
+    def rate(t):
+        if t < shifts[0]:
+            return base_rate
+        if t < shifts[1]:
+            return base_rate * 4
+        if t < 55.0:
+            return base_rate
+        return 0.0
+
+    fleet = SimFleet(cfg, n_workers=5, rate_fn=rate)
+    ctl = ElasticController(
+        fleet,
+        config=ElasticConfig(scale_up_after=1, scale_down_after=3,
+                             cooldown_intervals=1,
+                             actuation_deadline_s=20.0),
+    )
+    planner = Planner(
+        PlannerConfig(adjustment_interval_s=1.0,
+                      itl_target_s=cfg.base_itl_s * 2, ttft_target_s=2.0,
+                      min_replicas=2, max_replicas=64,
+                      total_chip_budget=128),
+        *profile_interpolators(cfg),
+        ctl, fleet.metrics_source, disagg=False, metrics=ctl.metrics,
+    )
+    timeline = []
+    for _ in range(58):
+        fleet.run(1.0)
+        plan = await planner.step()
+        timeline.append(
+            (fleet.now, plan.decode if plan else None,
+             fleet.ready_count("decode"))
+        )
+    fleet.settle(180.0)
+    problems = fleet.verify_streams()
+
+    def convergence_intervals(shift_t):
+        """Intervals from the rate shift until desired == ready and it
+        STAYS matched through the next 3 intervals (or the window end)."""
+        idxs = [i for i, (t, _w, _h) in enumerate(timeline) if t > shift_t]
+        for n, i in enumerate(idxs):
+            window = timeline[i:i + 3]
+            if all(w is not None and w == h for _t, w, h in window):
+                return n + 1
+        return None
+
+    def probe(n_workers, requests=2000):
+        sched = KvScheduler(seed=seed)
+        for wid in range(1, n_workers + 1):
+            sched.update_load(LoadSnapshot(
+                worker_id=wid, active_blocks=(wid % 37) * 5,
+                total_blocks=4096,
+            ))
+        cands = [(wid, 0) for wid in range(1, n_workers + 1)]
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            sched.select_worker(9, OverlapScores(), cands)
+        wall = time.perf_counter() - t0
+        return {
+            "workers": n_workers,
+            "us_per_request": round(wall / requests * 1e6, 2),
+            "candidates_scored_per_request": round(
+                sched.logit_evals / sched.selections, 2
+            ),
+        }
+
+    small, large = probe(10), probe(100)
+    return {
+        "sim_seed": seed,
+        "arrivals": fleet.arrivals,
+        "lost_streams": len(problems),
+        "convergence_intervals_up": convergence_intervals(shifts[0]),
+        "convergence_intervals_down": convergence_intervals(shifts[1]),
+        "peak_workers": max(h for _t, _w, h in timeline),
+        "scale_ups": ctl.scale_ups,
+        "scale_downs": ctl.scale_downs,
+        "holds": ctl.holds,
+        "workers_drained": len(ctl.drained_workers),
+        "handoff_streams": fleet.handoff_streams,
+        # THE elasticity contract: scaling down re-prefills NOTHING.
+        "scale_down_reprefill_tokens": fleet.drain_reprefill_tokens,
+        "reprefill_tokens_total": fleet.reprefill_tokens,
+        "liveness_false_positives": len(fleet.false_positive_deaths),
+        "correction_factor_itl": round(planner.feedback_itl.value, 3),
+        "select_worker_cost": {"small": small, "large": large},
+        "fault_plane": _fault_plane_record(fault_activity0),
+    }
+
+
 # v5e inter-chip ICI: public spec is 400 Gbps/chip each direction
 # (~50 GB/s); 45 GB/s effective grants the usual ~90% achieved link rate.
 # Used ONLY by the 70B tp8 projection's collective term (one chip cannot
@@ -1650,6 +1775,17 @@ async def run_bench():
             out["crash"] = await run_crash_leg()
         except Exception as exc:
             out["crash"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if os.environ.get("BENCH_ELASTICITY", "1") != "0":
+        # Elasticity leg (ISSUE 13): sim-clocked planner ramp (1×→4×→1×
+        # convergence intervals), zero-re-prefill scale-down, and
+        # select_worker per-request cost at 10 vs 100 workers. Pure CPU
+        # arithmetic driving the real control plane — lands on any
+        # backend; never kills the headline.
+        try:
+            out["elasticity"] = await run_elasticity_leg()
+        except Exception as exc:
+            out["elasticity"] = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps(out))
 
 

@@ -150,6 +150,10 @@ class RingWriterConfig:
             # + stale-incarnation drops; single writer: the consuming
             # frontend's event loop (worker_monitor pump + evaluate task).
             "liveness": ("runtime/liveness.py", "LivenessTracker"),
+            # Elasticity plane (PR 12): plan-state transitions, holds,
+            # scale actuations, drains; single writer: the planner's
+            # event loop.
+            "planner": ("planner/elastic.py", "ElasticController"),
         }
     )
 

@@ -26,17 +26,38 @@ from dynamo_tpu.planner.planner_core import (
     ReplicaPlan,
 )
 from dynamo_tpu.planner.connectors import VirtualConnector
+from dynamo_tpu.planner.elastic import ElasticConfig, ElasticController
+from dynamo_tpu.planner.feedback import (
+    CorrectionFactor,
+    FeedbackConfig,
+    PlannerMetrics,
+)
 from dynamo_tpu.planner.metrics_source import FrontendScrapeSource
 from dynamo_tpu.planner.process_connector import ProcessConnector, RoleSpec
+from dynamo_tpu.planner.simfleet import (
+    SimConfig,
+    SimFleet,
+    expected_tokens,
+    profile_interpolators,
+)
 
 __all__ = [
+    "CorrectionFactor",
+    "ElasticConfig",
+    "ElasticController",
+    "FeedbackConfig",
     "FrontendScrapeSource",
+    "PlannerMetrics",
     "ProcessConnector",
     "RoleSpec",
+    "SimConfig",
+    "SimFleet",
     "ConstantPredictor",
     "KalmanPredictor",
     "MovingAveragePredictor",
+    "expected_tokens",
     "make_predictor",
+    "profile_interpolators",
     "DecodeInterpolator",
     "PrefillInterpolator",
     "MetricsSnapshot",

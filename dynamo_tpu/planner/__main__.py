@@ -61,6 +61,11 @@ async def main() -> None:
     parser.add_argument("--predictor", default="moving-average")
     parser.add_argument("--no-disagg", action="store_true",
                         help="aggregated deployment: size only the decode pool")
+    parser.add_argument("--feedback-decay", type=float, default=0.4,
+                        help="correction-factor EWMA weight folding observed/"
+                        "predicted TTFT+ITL ratios into the profile table "
+                        "(docs/design_docs/elasticity.md); 0 disables "
+                        "feedback and trusts the table forever")
     parser.add_argument("--connector", choices=("virtual", "process"),
                         default="virtual")
     parser.add_argument("--decode-cmd", default=None,
@@ -89,6 +94,8 @@ async def main() -> None:
         runtime = DistributedRuntime.from_settings()
         connector = VirtualConnector(runtime.discovery, args.namespace)
 
+    from dynamo_tpu.planner.feedback import FeedbackConfig
+
     planner = Planner(
         PlannerConfig(
             adjustment_interval_s=args.adjustment_interval,
@@ -98,6 +105,7 @@ async def main() -> None:
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
             total_chip_budget=args.total_chip_budget,
+            feedback=FeedbackConfig(decay=args.feedback_decay),
         ),
         prefill_interp,
         decode_interp,

@@ -70,17 +70,33 @@ class ScalingAdapterConnector:
                 },
             },
         }
+        patch_body = {"spec": {"replicas": int(replicas)}}
         try:
             await self.client.patch(
                 GROUP, VERSION, self.k8s_namespace, SA_PLURAL, name,
-                {"spec": {"replicas": int(replicas)}},
+                patch_body,
             )
         except KubeApiError as exc:
             if exc.status != 404:
                 raise
-            await self.client.create(
-                GROUP, VERSION, self.k8s_namespace, SA_PLURAL, body
-            )
+            try:
+                await self.client.create(
+                    GROUP, VERSION, self.k8s_namespace, SA_PLURAL, body
+                )
+            except KubeApiError as cexc:
+                if cexc.status != 409:
+                    raise
+                # Lost the create race (another planner replica / operator
+                # reconcile landed between our 404 and the create): the
+                # adapter now exists, so 409 means "exists" — retry the
+                # patch once instead of killing the whole plan apply.
+                logger.info(
+                    "adapter %s created concurrently; retrying patch", name
+                )
+                await self.client.patch(
+                    GROUP, VERSION, self.k8s_namespace, SA_PLURAL, name,
+                    patch_body,
+                )
 
     async def apply(self, plan) -> None:
         if self.prefill_service == self.decode_service:

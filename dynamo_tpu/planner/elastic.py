@@ -1,0 +1,420 @@
+"""Elastic actuation: a plan-transition state machine that scales the
+fleet THROUGH the robustness planes instead of around them.
+
+The planner's sizing loop (planner_core.py) says how many replicas each
+pool should have; this module is the connector that makes it true without
+dropping or re-prefilling a single stream:
+
+  * **Scale-down is a drain, never a kill.** Victims are selected
+    (least-loaded first — the cheapest live handoffs) and retired through
+    the PR 9 drain plane: ``POST /drain`` / SIGTERM → live handoff of
+    every in-flight decode to a peer over the int8 wire → zero
+    re-prefilled tokens, bit-identical continuations. Spot preemption
+    (:meth:`ElasticController.preempt`) rides the exact same path — the
+    only difference is who picked the victim.
+  * **Scale-up counts nothing it can't route to.** A launched replica is
+    only counted once the fleet reports it ready — the worker main's
+    ``/readyz`` gate, which stays 503 through engine start AND the warm
+    KV-checkpoint restore — so a plan never "converges" onto replicas
+    that would 503 the router.
+  * **Hysteresis so oscillating load can't flap the fleet.** A scale-up
+    must persist ``scale_up_after`` consecutive intervals and a
+    scale-down ``scale_down_after`` (down is slower: killing warm caches
+    on a transient dip costs more than riding it out), and every
+    actuation is followed by ``cooldown_intervals`` of enforced holds —
+    suppressed changes are counted (``dynamo_tpu_planner_holds_total``),
+    not silently dropped.
+
+State machine (the ``dynamo_tpu_planner_state`` gauge)::
+
+    steady ──want>have for scale_up_after──▶ scaling_up ──all /readyz──▶ converged
+       ▲  └─want<have for scale_down_after─▶ scaling_down ──all drained──┘   │
+       └──────────────────── cooldown_intervals of holds ────────────────────┘
+
+The controller drives any fleet exposing the small :class:`Fleet`
+protocol; ``planner/simfleet.py`` implements it for the fleet-scale soak,
+and a process/k8s deployment maps it onto the PR 9 surfaces (SIGTERM or
+``POST /drain`` for ``drain``, ``GET /readyz`` polling for
+``wait_ready``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Protocol
+
+from dynamo_tpu.planner.feedback import PlannerMetrics
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Plan-transition states (also the dynamo_tpu_planner_state gauge).
+STEADY, SCALING_UP, SCALING_DOWN, CONVERGED = 0, 1, 2, 3
+_STATE_NAMES = {
+    STEADY: "steady",
+    SCALING_UP: "scaling_up",
+    SCALING_DOWN: "scaling_down",
+    CONVERGED: "converged",
+}
+
+
+class Fleet(Protocol):
+    """What the controller needs from the world. One instance may serve
+    several pools (``"prefill"`` / ``"decode"``)."""
+
+    def ready_count(self, pool: str) -> int:
+        """Replicas that are launched AND ready (``/readyz`` green —
+        engine up, warm restore done, not draining)."""
+
+    def load_view(self, pool: str) -> Dict[int, float]:
+        """worker id → load signal (active KV blocks / streams). Victim
+        selection retires the least-loaded first."""
+
+    async def launch(self, pool: str, n: int) -> None:
+        """Start ``n`` replicas; they become ready later (wait_ready)."""
+
+    async def wait_ready(self, pool: str, want: int, deadline_s: float) -> int:
+        """Block until ``ready_count(pool) >= want`` or the deadline;
+        returns the final ready count."""
+
+    async def drain(self, pool: str, worker_id: int) -> Dict[str, Any]:
+        """Retire one worker through the drain plane (live handoff of its
+        in-flight streams, then exit). Returns drain stats (at least
+        ``handoffs`` and ``reprefill_tokens``)."""
+
+
+@dataclass
+class ElasticConfig:
+    # Consecutive intervals a direction must persist before actuating.
+    # Scale-down is deliberately slower: a transient dip that kills warm
+    # caches costs more than riding it out.
+    scale_up_after: int = 1
+    scale_down_after: int = 3
+    # Enforced hold intervals after any completed actuation.
+    cooldown_intervals: int = 2
+    # Bound on one actuation (launch→ready or drain-all) — a stuck
+    # replica or a wedged drain must not freeze the control loop forever.
+    actuation_deadline_s: float = 120.0
+    # Largest single scale-down step (fraction of the pool, min 1): even a
+    # sustained plan collapse retires the fleet in bounded bites so the
+    # survivors absorb each wave of handoffs before the next.
+    max_down_fraction: float = 0.5
+    # Intervals a launched-but-never-ready replica blocks re-launching
+    # before the controller gives up on it (crashed pre-ready).
+    pending_stale_after: int = 5
+
+    def __post_init__(self) -> None:
+        if self.scale_up_after < 1 or self.scale_down_after < 1:
+            raise ValueError("hysteresis streaks must be >= 1")
+        if not 0.0 < self.max_down_fraction <= 1.0:
+            raise ValueError("max_down_fraction must be in (0, 1]")
+
+
+@dataclass
+class _PoolTrack:
+    up_streak: int = 0
+    down_streak: int = 0
+    cooldown: int = 0
+    # Per-pool plan state: the controller-global state (the gauge, the
+    # feedback gate) is derived from all pools — a steady prefill pool
+    # must never mask a decode pool's in-flight actuation.
+    state: int = STEADY
+    # Launched-but-not-yet-ready replicas from a previous actuation whose
+    # warm-up outlived the actuation deadline: still coming, so a new
+    # scale-up must not launch them AGAIN. Forgotten after
+    # ``pending_stale_after`` intervals without the ready count reaching
+    # the want (a launch that died pre-ready must not block re-launching
+    # forever).
+    pending: int = 0
+    pending_intervals: int = 0
+
+
+class ElasticController:
+    """Planner connector executing ReplicaPlans through the drain/crash
+    planes. Call-compatible with the other connectors (``await
+    apply(plan)``), so ``Planner`` needs no special wiring."""
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        *,
+        config: Optional[ElasticConfig] = None,
+        metrics: Optional[PlannerMetrics] = None,
+        disagg: bool = True,
+    ) -> None:
+        from dynamo_tpu.runtime.device_observe import FlightRecorder
+
+        self.fleet = fleet
+        self.config = config or ElasticConfig()
+        self.metrics = metrics if metrics is not None else PlannerMetrics()
+        self.disagg = disagg
+        self.state = STEADY
+        self.metrics.state.set(STEADY)
+        self._tracks: Dict[str, _PoolTrack] = {}
+        # Actuation history for post-mortems (DYN005 owner "planner";
+        # single writer: the planner's event loop).
+        self.flight = FlightRecorder("planner", capacity=256)
+        # Host-side mirrors (tests/bench read these without a scrape).
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.preemptions = 0
+        self.holds = 0
+        self.drained_workers: List[int] = []
+        self.reprefill_tokens_from_scaling = 0
+        self.applied: Optional[Dict[str, int]] = None
+
+    # -- surface -------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "state": _STATE_NAMES[self.state],
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "preemptions": self.preemptions,
+            "holds": self.holds,
+            "drained_workers": list(self.drained_workers),
+            "reprefill_tokens_from_scaling": self.reprefill_tokens_from_scaling,
+            "applied": self.applied,
+        }
+
+    def register_metrics(self, server: Any) -> None:
+        self.metrics.register(server)  # idempotent when shared with Planner
+        server.register_flight(self.flight.name, self.flight.snapshot)
+
+    def feedback_stable(self) -> bool:
+        """Gate for the planner's correction-factor folding: observations
+        made while an actuation is in flight — or completed within the
+        still-running cooldown — describe a DIFFERENT fleet size than the
+        one the planner would charge them against, and folding them
+        teaches phantom slowness. Only fully-steady intervals fold: every
+        pool back in STEADY, i.e. at least cooldown_intervals past its
+        last actuation (completions from the transition window have
+        drained by then)."""
+        return self.state == STEADY
+
+    def _set_pool_state(self, track: _PoolTrack, state: int) -> None:
+        """Per-pool transition; the global gauge is the most-active pool
+        (scaling_down > scaling_up > converged > steady), so one pool
+        going quiet can never mask another's in-flight actuation."""
+        track.state = state
+        tracks = self._tracks.values()
+        for derived in (SCALING_DOWN, SCALING_UP, CONVERGED):
+            if any(t.state == derived for t in tracks):
+                break
+        else:
+            derived = STEADY
+        if derived == self.state:
+            return
+        self.state = derived
+        self.metrics.state.set(derived)
+        self.metrics.transitions.inc(to=_STATE_NAMES[derived])
+        self.flight.record("state", to=_STATE_NAMES[derived])
+
+    # -- connector interface -------------------------------------------------
+
+    async def apply(self, plan: Any) -> None:
+        """One adjustment interval's actuation decision. Hysteresis and
+        cooldown are evaluated per pool; at most one pool direction
+        actuates per call (the next interval picks up the rest) so the
+        fleet changes in observable steps."""
+        targets = {"decode": int(plan.decode)}
+        if self.disagg and int(plan.prefill) > 0:
+            targets["prefill"] = int(plan.prefill)
+        for pool, want in targets.items():
+            await self._reconcile(pool, want)
+        self.applied = {
+            pool: self.fleet.ready_count(pool) for pool in targets
+        }
+
+    async def _reconcile(self, pool: str, want: int) -> bool:
+        cfg = self.config
+        track = self._tracks.setdefault(pool, _PoolTrack())
+        have = self.fleet.ready_count(pool)
+        if track.pending > 0:
+            # Replicas from a previous actuation still warming: don't let
+            # a new actuation double-launch them, but don't let a corpse
+            # block re-launching forever either.
+            track.pending = max(min(track.pending, want - have), 0)
+            track.pending_intervals += 1
+            if track.pending_intervals > cfg.pending_stale_after:
+                self.flight.record(
+                    "pending_forgotten", pool=pool, pending=track.pending
+                )
+                track.pending = 0
+            if track.pending == 0:
+                track.pending_intervals = 0
+                self.metrics.scale_up_pending.set(0, pool=pool)
+        if want > have:
+            track.up_streak += 1
+            track.down_streak = 0
+        elif want < have:
+            track.down_streak += 1
+            track.up_streak = 0
+        else:
+            track.up_streak = track.down_streak = 0
+            if track.state in (SCALING_UP, SCALING_DOWN):
+                # A partial actuation finished catching up (pending
+                # replicas went ready / stragglers drained) on its own.
+                self._set_pool_state(track, CONVERGED)
+            if track.cooldown > 0:
+                track.cooldown -= 1
+            if track.state == CONVERGED and track.cooldown == 0:
+                self._set_pool_state(track, STEADY)
+            return False
+        if track.cooldown > 0:
+            track.cooldown -= 1
+            self._hold(pool, want, have, "cooldown")
+            return False
+        if want > have:
+            if track.up_streak < cfg.scale_up_after:
+                self._hold(
+                    pool, want, have,
+                    f"streak {track.up_streak}/{cfg.scale_up_after}",
+                )
+                return False
+            await self._scale_up(pool, want, have, track)
+            return True
+        if track.down_streak < cfg.scale_down_after:
+            self._hold(
+                pool, want, have,
+                f"streak {track.down_streak}/{cfg.scale_down_after}",
+            )
+            return False
+        await self._scale_down(pool, want, have, track)
+        return True
+
+    def _hold(self, pool: str, want: int, have: int, why: str) -> None:
+        self.holds += 1
+        self.metrics.holds.inc()
+        self.flight.record("hold", pool=pool, want=want, have=have, why=why)
+
+    async def _scale_up(
+        self, pool: str, want: int, have: int, track: _PoolTrack
+    ) -> None:
+        cfg = self.config
+        # Previously-launched still-warming replicas count against the
+        # shortfall: launching them again would overshoot the fleet and
+        # feed the overshoot straight into a scale-down's drain churn.
+        n = max(want - have - track.pending, 0)
+        self._set_pool_state(track, SCALING_UP)
+        self.flight.record(
+            "scale_up", pool=pool, launching=n, have=have,
+            pending=track.pending,
+        )
+        self.metrics.scale_up_pending.set(n + track.pending, pool=pool)
+        launched = True
+        try:
+            if n > 0:
+                await self.fleet.launch(pool, n)
+        except Exception:
+            # A failed launch call left the replicas UNLAUNCHED: they
+            # must not be recorded as pending, or the next intervals
+            # would launch n=0 and stall the scale-up on phantoms.
+            logger.exception("launch of %d %s replicas failed", n, pool)
+            launched = False
+        try:
+            # A replica only counts once /readyz (warm restore included)
+            # goes green — never route a plan at a 503ing worker.
+            ready = await self.fleet.wait_ready(
+                pool, want, cfg.actuation_deadline_s
+            )
+        except Exception:
+            logger.exception("scale-up of %s to %d failed", pool, want)
+            ready = self.fleet.ready_count(pool)
+        still_pending = max(want - ready, 0) if launched else max(
+            want - ready - n, 0
+        )
+        if still_pending != track.pending:
+            track.pending = still_pending
+            track.pending_intervals = 0
+        self.metrics.scale_up_pending.set(still_pending, pool=pool)
+        self.scale_ups += 1
+        track.up_streak = 0
+        track.cooldown = cfg.cooldown_intervals
+        if ready >= want:
+            self._set_pool_state(track, CONVERGED)
+            self.flight.record("converged", pool=pool, ready=ready)
+        else:
+            # Partial: stay in scaling_up for the gauge; the next interval
+            # re-evaluates against the actual ready count.
+            self.flight.record(
+                "scale_up_partial", pool=pool, ready=ready, want=want
+            )
+
+    async def _scale_down(
+        self, pool: str, want: int, have: int, track: _PoolTrack
+    ) -> None:
+        cfg = self.config
+        step_cap = max(int(have * cfg.max_down_fraction), 1)
+        n = min(have - want, step_cap)
+        victims = self.select_victims(pool, n)
+        self._set_pool_state(track, SCALING_DOWN)
+        self.flight.record(
+            "scale_down", pool=pool, retiring=len(victims), have=have,
+            want=want,
+        )
+        drained = 0
+        for wid in victims:
+            ok = await self._drain_one(pool, wid, mode="planned")
+            drained += 1 if ok else 0
+        self.scale_downs += 1
+        track.down_streak = 0
+        track.cooldown = cfg.cooldown_intervals
+        if drained == len(victims) and self.fleet.ready_count(pool) <= want:
+            self._set_pool_state(track, CONVERGED)
+            self.flight.record(
+                "converged", pool=pool, ready=self.fleet.ready_count(pool)
+            )
+
+    async def _drain_one(self, pool: str, wid: int, *, mode: str) -> bool:
+        cfg = self.config
+        try:
+            stats = await asyncio.wait_for(
+                self.fleet.drain(pool, wid),
+                timeout=cfg.actuation_deadline_s,
+            )
+        except Exception as exc:
+            # The drain plane's own deadline ladder (handoff → re-prefill
+            # → requeue) bounds what a failed drain costs the streams; the
+            # controller only loses the capacity accounting for one
+            # interval.
+            logger.exception("drain of %s worker %#x failed", pool, wid)
+            self.flight.record(
+                "drain_error", pool=pool, worker=wid,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+        self.metrics.scale_down_drains.inc(mode=mode)
+        self.drained_workers.append(wid)
+        self.reprefill_tokens_from_scaling += int(
+            stats.get("reprefill_tokens", 0) or 0
+        )
+        self.flight.record(
+            "drained", pool=pool, worker=wid, mode=mode,
+            handoffs=stats.get("handoffs"),
+            reprefill_tokens=stats.get("reprefill_tokens"),
+        )
+        return True
+
+    # -- spot preemption -----------------------------------------------------
+
+    async def preempt(self, pool: str, worker_id: int) -> bool:
+        """Spot/maintenance reclaim of a NAMED worker: no hysteresis (the
+        machine is going away on the provider's clock, not ours), same
+        drain-with-handoff path, counted under mode=preemption. The next
+        planner interval re-sizes the pool around the loss."""
+        self.preemptions += 1
+        self.flight.record("preempt", pool=pool, worker=worker_id)
+        return await self._drain_one(pool, worker_id, mode="preemption")
+
+    # -- victim policy -------------------------------------------------------
+
+    def select_victims(self, pool: str, n: int) -> List[int]:
+        """Least-loaded first: fewer resident streams means fewer (and
+        cheaper) live handoffs per retirement. Ties break on the HIGHER
+        worker id — newest-ish first, deterministic — matching the
+        process connector's newest-first retirement instinct."""
+        view = self.fleet.load_view(pool)
+        ranked = sorted(view.items(), key=lambda kv: (kv[1], -kv[0]))
+        return [wid for wid, _load in ranked[:n]]

@@ -1,0 +1,185 @@
+"""Correction-factor feedback: the planner's self-healing loop over a
+mis-profiled interpolation table.
+
+The sizing math (planner_core.py) interpolates TTFT/ITL/throughput from a
+profiler sweep — a STATIC table. A table profiled on different silicon, a
+stale model revision, or an optimistic benchmark never heals: the planner
+keeps sizing for the fleet it was promised, not the fleet it has (VERDICT
+Missing #5). This module closes the loop:
+
+    factor = EWMA( observed_SLA_metric / table_predicted_SLA_metric )
+
+folded per adjustment interval with decay, one factor per stage:
+
+  * ``ttft`` — observed p50 TTFT vs the prefill table's TTFT at the
+    observed mean ISL. A factor of 2 means prefill is twice as slow as
+    profiled: the corrected table quotes 2× the TTFT and 1/2 the prefill
+    tokens/sec, so the prefill pool doubles.
+  * ``itl`` — observed p50 ITL vs the decode table's ITL at the estimated
+    per-worker concurrency (Little's law: rate × OSL × observed ITL gives
+    in-flight streams, divided by the applied decode replica count). A
+    factor of 2 halves the ITL-SLA concurrency crossing and the per-seq
+    decode throughput, so the decode pool doubles.
+
+Factors are clamped (default [1/8, 8]): queueing transients under overload
+inflate observed latency far past any honest hardware mis-profile, and an
+unclamped factor would let one bad interval command an 80× fleet. The
+fixed point is exact: when the real system is k× slower than the table,
+the ratio reads k at EVERY operating point of a proportionally-wrong
+table, the factor converges to k (geometrically, at the EWMA rate), and
+the corrected sizing equals what an honest table would produce — the
+convergence simulation in tests/test_planner.py drives a 2×-wrong table
+to the oracle plan in a bounded number of intervals.
+
+Factors are exposed as lint-pinned gauges
+(``dynamo_tpu_planner_correction_factor{stage}``, metric_names.py
+ALL_PLANNER) so a drifting profile is an alertable signal, not a silent
+capacity shortfall.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.runtime import metric_names as mn
+from dynamo_tpu.runtime.metrics_core import MetricsRegistry
+
+
+@dataclass(frozen=True)
+class FeedbackConfig:
+    """``decay``: EWMA weight of the newest ratio (0 disables feedback —
+    the factor never moves off 1.0). ``min_factor``/``max_factor``: clamp
+    on each folded ratio AND the factor itself."""
+
+    decay: float = 0.4
+    min_factor: float = 0.125
+    max_factor: float = 8.0
+    # Ratios within 1 ± deadband fold as exactly 1.0: measurement noise
+    # (median quirks, churn transients) must not walk the factor off an
+    # honest table — a genuine mis-profile smaller than the deadband
+    # stays uncorrected by design (it is also too small to mis-size by a
+    # whole replica at any realistic pool).
+    deadband: float = 0.05
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.decay <= 1.0:
+            raise ValueError("decay must be in [0, 1]")
+        if not 0.0 < self.min_factor <= 1.0 <= self.max_factor:
+            raise ValueError("need min_factor <= 1 <= max_factor")
+        if self.deadband < 0.0:
+            raise ValueError("deadband must be >= 0")
+
+
+class CorrectionFactor:
+    """One stage's decayed observed/predicted ratio, starting honest (1.0)."""
+
+    def __init__(self, config: FeedbackConfig) -> None:
+        self.config = config
+        self.value = 1.0
+        self.observations = 0
+
+    def observe(self, observed: Optional[float], predicted: float) -> None:
+        """Fold one interval's (observed, table-predicted) pair. Missing or
+        non-positive observations (no traffic this interval) are skipped —
+        an idle fleet is not evidence about the table."""
+        cfg = self.config
+        if cfg.decay <= 0.0:
+            return
+        if observed is None or observed <= 0.0 or predicted <= 0.0:
+            return
+        ratio = min(max(observed / predicted, cfg.min_factor), cfg.max_factor)
+        if abs(ratio - 1.0) <= cfg.deadband:
+            ratio = 1.0
+        self.value = cfg.decay * ratio + (1.0 - cfg.decay) * self.value
+        self.value = min(max(self.value, cfg.min_factor), cfg.max_factor)
+        self.observations += 1
+
+    def correct_up(self, predicted: float) -> float:
+        """Latency-shaped prediction (TTFT/ITL): slower fleet → larger."""
+        return predicted * self.value
+
+    def correct_down(self, predicted: float) -> float:
+        """Rate-shaped prediction (tokens/sec, concurrency): slower fleet
+        → smaller."""
+        return predicted / self.value
+
+
+class PlannerMetrics:
+    """Canonical planner families (runtime/metric_names.py ALL_PLANNER).
+
+    One registry shared by the sizing loop (correction factors, desired
+    replicas) and the elastic controller (state machine, holds, drains) —
+    the planner plane renders as one scrape source."""
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        # Servers this registry is already a scrape source on: the
+        # planner AND the elastic controller usually share one
+        # PlannerMetrics, and both expose register_metrics — without the
+        # guard, registering both renders every family twice per scrape.
+        self._registered_servers: set = set()
+        self.correction_factor = self.registry.gauge(
+            mn.PLANNER_CORRECTION_FACTOR,
+            "Decayed EWMA of observed/predicted SLA ratio folded into the "
+            "interpolator outputs, by stage (ttft | itl); 1.0 = the "
+            "profile table is honest",
+            ["stage"],
+        )
+        self.desired_replicas = self.registry.gauge(
+            mn.PLANNER_DESIRED_REPLICAS,
+            "Last computed plan per pool (prefill | decode)",
+            ["pool"],
+        )
+        self.state = self.registry.gauge(
+            mn.PLANNER_STATE,
+            "Plan-transition state machine: 0 steady, 1 scaling_up, "
+            "2 scaling_down, 3 converged (actuation done, cooldown)",
+        )
+        self.transitions = self.registry.counter(
+            mn.PLANNER_TRANSITIONS_TOTAL,
+            "Plan-state transitions, by destination state",
+            ["to"],
+        )
+        self.applies = self.registry.counter(
+            mn.PLANNER_APPLIES_TOTAL,
+            "Plans handed to the scaling connector",
+        )
+        self.holds = self.registry.counter(
+            mn.PLANNER_HOLDS_TOTAL,
+            "Plan changes suppressed by hysteresis streaks or the "
+            "post-actuation cooldown (oscillating load lands here instead "
+            "of flapping the fleet)",
+        )
+        self.scale_down_drains = self.registry.counter(
+            mn.PLANNER_SCALE_DOWN_DRAINS_TOTAL,
+            "Workers retired through drain-with-handoff, by mode "
+            "(planned = planner scale-down, preemption = spot reclaim)",
+            ["mode"],
+        )
+        self.scale_up_pending = self.registry.gauge(
+            mn.PLANNER_SCALE_UP_PENDING,
+            "Replicas launched but not yet ready, per pool: a scale-up "
+            "only counts once /readyz (warm restore included) goes green",
+            ["pool"],
+        )
+
+    def render(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
+
+    def register(self, server: Any) -> None:
+        """Idempotent per server: sharers may all call this."""
+        if id(server) in self._registered_servers:
+            return
+        self._registered_servers.add(id(server))
+        server.register_metrics(self.render)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Host-side mirror for tests/bench (no scrape parsing)."""
+        return {
+            "correction_ttft": self.correction_factor.value(stage="ttft"),
+            "correction_itl": self.correction_factor.value(stage="itl"),
+            "state": self.state.value(),
+            "applies": self.applies.value(),
+            "holds": self.holds.value(),
+        }

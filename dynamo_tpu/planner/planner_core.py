@@ -15,11 +15,18 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from dynamo_tpu.planner.feedback import (
+    CorrectionFactor,
+    FeedbackConfig,
+    PlannerMetrics,
+)
 from dynamo_tpu.planner.load_predictor import BasePredictor, make_predictor
 from dynamo_tpu.planner.perf_interpolation import (
     DecodeInterpolator,
     PrefillInterpolator,
 )
+from dynamo_tpu.runtime import fault_names
+from dynamo_tpu.runtime.faults import fault_point
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -38,6 +45,10 @@ class PlannerConfig:
     chips_per_decode_worker: int = 1
     total_chip_budget: int = 8
     osl_default: float = 128.0  # fallback when no OSL metric yet
+    # Correction-factor feedback (planner/feedback.py): observed/predicted
+    # SLA ratios folded into the interpolator outputs so a mis-profiled
+    # table heals instead of mis-sizing forever. decay=0 disables.
+    feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
 
 
 @dataclass
@@ -68,6 +79,7 @@ class Planner:
         metrics_source: Any,  # async () -> MetricsSnapshot
         *,
         disagg: bool = True,
+        metrics: Optional[PlannerMetrics] = None,
     ) -> None:
         self.config = config
         self.prefill_interp = prefill_interp
@@ -78,9 +90,21 @@ class Planner:
         self.rate_pred: BasePredictor = make_predictor(config.predictor)
         self.isl_pred: BasePredictor = make_predictor(config.predictor)
         self.osl_pred: BasePredictor = make_predictor(config.predictor)
+        # Correction-factor feedback: one decayed observed/predicted ratio
+        # per stage, folded each observation interval and applied to every
+        # interpolator read (planner/feedback.py has the math and the
+        # fixed-point argument). ``metrics`` may be shared with an
+        # ElasticController so the whole planner plane renders as one
+        # scrape source.
+        self.feedback_ttft = CorrectionFactor(config.feedback)
+        self.feedback_itl = CorrectionFactor(config.feedback)
+        self.metrics = metrics if metrics is not None else PlannerMetrics()
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self.last_plan: Optional[ReplicaPlan] = None
+        # Freshest observed p50 ITL (set every observation, gated or
+        # not): the scale-down SLA guard reads it in compute_plan.
+        self._last_itl: Optional[float] = None
 
     # -- sizing math (ref: _compute_replica_requirements) -------------------
 
@@ -93,10 +117,19 @@ class Planner:
         cfg = self.config
 
         # Prefill pool: needed prefill token throughput / per-worker
-        # throughput at the SLA'd ISL.
+        # throughput at the SLA'd ISL. The TTFT correction factor scales
+        # the table both ways: a 2×-slow fleet quotes 2× the TTFT and
+        # half the tokens/sec the sweep promised.
         prefill_tokens_per_s = rate * isl
-        per_worker_prefill = max(self.prefill_interp.interpolate_throughput(isl), 1e-6)
-        ttft = self.prefill_interp.interpolate_ttft(isl)
+        per_worker_prefill = max(
+            self.feedback_ttft.correct_down(
+                self.prefill_interp.interpolate_throughput(isl)
+            ),
+            1e-6,
+        )
+        ttft = self.feedback_ttft.correct_up(
+            self.prefill_interp.interpolate_ttft(isl)
+        )
         prefill_n = math.ceil(prefill_tokens_per_s / per_worker_prefill)
         if ttft > cfg.ttft_target_s:
             # A single prefill can't meet TTFT at this ISL — chunking across
@@ -107,12 +140,40 @@ class Planner:
             )
 
         # Decode pool: steady-state concurrency = rate × generation time;
-        # cap per-worker concurrency at the ITL SLA crossing.
-        max_conc = max(self.decode_interp.max_concurrency_for_itl(cfg.itl_target_s), 1.0)
-        per_seq_decode = self.decode_interp.interpolate_throughput(max_conc) / max_conc
+        # cap per-worker concurrency at the ITL SLA crossing. The ITL
+        # correction factor shifts the crossing: a fleet observed f× slower
+        # than the table meets the SLA only up to the concurrency where the
+        # TABLE reads itl_target/f (table ITL × f ≤ target ⟺ table ITL ≤
+        # target/f), and its per-seq throughput at that point is the
+        # table's divided by f.
+        max_conc = max(
+            self.decode_interp.max_concurrency_for_itl(
+                self.feedback_itl.correct_down(cfg.itl_target_s)
+            ),
+            1.0,
+        )
+        per_seq_decode = self.feedback_itl.correct_down(
+            self.decode_interp.interpolate_throughput(max_conc) / max_conc
+        )
         gen_time_s = osl / max(per_seq_decode, 1e-6)
         concurrency = rate * gen_time_s
         decode_n = math.ceil(concurrency / max_conc)
+
+        # SLA-breach scale-down guard: an arrivals-derived rate reads LOW
+        # the moment a burst ends, while the backlog it left keeps the
+        # fleet saturated — commanding down then drains workers into a
+        # fleet with no admission headroom (handoffs refused for
+        # capacity, streams to the re-prefill rung) and digs the breach
+        # deeper. While observed ITL exceeds the SLA, the decode pool
+        # may grow but never shrink below the last plan.
+        itl_hold = (
+            self._last_itl is not None
+            and self._last_itl > cfg.itl_target_s
+            and self.last_plan is not None
+            and decode_n < self.last_plan.decode
+        )
+        if itl_hold:
+            decode_n = self.last_plan.decode
 
         prefill_n = min(max(prefill_n, cfg.min_replicas), cfg.max_replicas)
         decode_n = min(max(decode_n, cfg.min_replicas), cfg.max_replicas)
@@ -137,18 +198,78 @@ class Planner:
             reason=(
                 f"rate={rate:.2f}req/s isl={isl:.0f} osl={osl:.0f} "
                 f"conc={concurrency:.1f}/{max_conc:.1f}per-worker"
+                + (" itl-breach-hold" if itl_hold else "")
             ),
+        )
+
+    # -- feedback ------------------------------------------------------------
+
+    def _fold_feedback(self, snap: MetricsSnapshot) -> None:
+        """Fold one interval's observed SLA metrics against the raw table
+        predictions at the OBSERVED operating point (planner/feedback.py).
+        Idle intervals (no completions) fold nothing.
+
+        Scaling transients fold nothing either: completions observed this
+        interval were generated by the PREVIOUS fleet size, and folding
+        their latency against the current replica count teaches the
+        factor phantom slowness (observed: an honest fleet learned a 2.3×
+        factor during a 4× down-ramp and briefly quadrupled itself). A
+        connector that actuates (ElasticController) exposes
+        ``feedback_stable()``; simple connectors don't, and fold always."""
+        cfg = self.config
+        gate = getattr(self.connector, "feedback_stable", None)
+        if gate is not None and not gate():
+            self.metrics.correction_factor.set(
+                self.feedback_ttft.value, stage="ttft"
+            )
+            self.metrics.correction_factor.set(
+                self.feedback_itl.value, stage="itl"
+            )
+            return
+        if snap.p50_ttft_s is not None and snap.mean_isl > 0:
+            self.feedback_ttft.observe(
+                snap.p50_ttft_s,
+                self.prefill_interp.interpolate_ttft(snap.mean_isl),
+            )
+        if snap.p50_itl_s is not None and snap.request_rate > 0:
+            # Little's law: in-flight streams = rate × stream duration
+            # (OSL × observed per-token latency), spread over the decode
+            # replicas the last plan asked for.
+            osl = snap.mean_osl or cfg.osl_default
+            replicas = max(
+                self.last_plan.decode if self.last_plan else cfg.min_replicas,
+                1,
+            )
+            conc_per_worker = (
+                snap.request_rate * osl * snap.p50_itl_s / replicas
+            )
+            self.feedback_itl.observe(
+                snap.p50_itl_s,
+                self.decode_interp.interpolate_itl(conc_per_worker),
+            )
+        self.metrics.correction_factor.set(
+            self.feedback_ttft.value, stage="ttft"
+        )
+        self.metrics.correction_factor.set(
+            self.feedback_itl.value, stage="itl"
         )
 
     # -- loop ---------------------------------------------------------------
 
     async def observe_once(self) -> MetricsSnapshot:
+        # Chaos seam: an injected failure here models the scrape (or the
+        # metrics pipeline) dying BEFORE anything is read — the control
+        # loop must skip the interval, never act on a half-read snapshot.
+        fault_point(fault_names.PLANNER_OBSERVE)
         snap: MetricsSnapshot = await self.metrics_source()
+        if snap.p50_itl_s is not None:
+            self._last_itl = snap.p50_itl_s
         self.rate_pred.add_data_point(snap.request_rate)
         if snap.mean_isl:
             self.isl_pred.add_data_point(snap.mean_isl)
         if snap.mean_osl:
             self.osl_pred.add_data_point(snap.mean_osl)
+        self._fold_feedback(snap)
         return snap
 
     async def step(self) -> Optional[ReplicaPlan]:
@@ -156,16 +277,31 @@ class Planner:
         plan = self.compute_plan()
         if plan is not None:
             self.last_plan = plan
+            self.metrics.desired_replicas.set(plan.prefill, pool="prefill")
+            self.metrics.desired_replicas.set(plan.decode, pool="decode")
             logger.info(
                 "plan: prefill=%d decode=%d (%s)", plan.prefill, plan.decode, plan.reason
             )
+            # Chaos seam: an injected failure models the actuation plane
+            # refusing the plan — the loop retries on its own cadence.
+            fault_point(fault_names.PLANNER_APPLY)
+            self.metrics.applies.inc()
             await self.connector.apply(plan)
         return plan
+
+    def register_metrics(self, server: Any) -> None:
+        """Expose the planner families on a SystemStatusServer (safe to
+        combine with an ElasticController sharing the same metrics)."""
+        self.metrics.register(server)
 
     def start(self) -> None:
         if self._task is None:
             self._stop.clear()
-            self._task = asyncio.get_event_loop().create_task(
+            # get_running_loop, NOT get_event_loop: starting outside a
+            # running loop must fail loudly — the deprecated form silently
+            # bound the task to a brand-new never-running loop, a planner
+            # that looked started and never planned.
+            self._task = asyncio.get_running_loop().create_task(
                 self._run(), name="planner"
             )
 

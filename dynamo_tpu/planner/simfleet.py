@@ -1,0 +1,688 @@
+"""Fleet-scale elasticity simulation: a deterministic mock fleet driving
+the REAL control plane.
+
+The chaos soak the elasticity loop is proven against needs 50–100 workers
+under bursty open-loop traffic with seeded kills, drains, and an overload
+wave — far past what subprocess clusters can do inside the tier-1 budget.
+This harness simulates only the parts that are honestly simulable (token
+generation cadence, prefill latency, wall time) and runs the REAL
+machinery for everything the soak actually asserts about:
+
+  * placement — the real :class:`KvScheduler` (load-aware cost model,
+    drain deflection, candidate pruning) routes every request;
+  * crash detection — the real :class:`LivenessTracker` (fake clock =
+    sim clock) declares silence-shaped deaths and fires the real
+    ``drop_worker`` reconciliation;
+  * sizing — the real :class:`Planner` + :class:`ElasticController`
+    observe the simulated SLA metrics and actuate scale-up/scale-down
+    through this fleet's ``launch``/``wait_ready``/``drain`` surface
+    (:class:`SimFleet` implements the elastic controller's Fleet
+    protocol).
+
+**Token-exactness is structural, not assumed.** Each stream's tokens come
+from a fold chain — ``state₀ = H(rid)``, ``tokenᵢ = f(stateᵢ)``,
+``stateᵢ₊₁ = fold(stateᵢ, tokenᵢ)`` — the same shape as the engine's
+``fold_in(seed, salt, pos)`` contract. A handoff carries the fold state
+verbatim (KV moved); a kill-9 migration RECONSTRUCTS it by re-folding the
+carried tokens (re-prefill). Any bookkeeping bug — a lost, duplicated, or
+reordered token across a migration/handoff — shifts the state and every
+subsequent token diverges from :func:`expected_tokens`, so "zero lost
+streams, token-exact" is a real claim about the churn machinery, not a
+tautology.
+
+Time is simulated (``SimFleet.now``); a 100-worker, minutes-of-sim-time
+soak runs in wall seconds and replays bit-identically from its seed.
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import MetricsSnapshot
+from dynamo_tpu.router.protocols import LoadSnapshot, WorkerKey
+from dynamo_tpu.router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.runtime.liveness import LivenessConfig, LivenessTracker
+from dynamo_tpu.tokens.radix import OverlapScores
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MASK = (1 << 64) - 1
+_VOCAB = 50257
+
+
+def _seed_state(rid: str) -> int:
+    state = 0xCBF29CE484222325
+    for ch in rid.encode():
+        state = ((state ^ ch) * 0x100000001B3) & _MASK
+    return state
+
+
+def _fold(state: int, token: int) -> int:
+    return (state * 6364136223846793005 + token + 1442695040888963407) & _MASK
+
+
+def _token_of(state: int) -> int:
+    return (state >> 33) % _VOCAB
+
+
+def expected_tokens(rid: str, osl: int) -> List[int]:
+    """The oracle: what a never-disturbed worker would generate."""
+    state = _seed_state(rid)
+    out = []
+    for _ in range(osl):
+        tok = _token_of(state)
+        out.append(tok)
+        state = _fold(state, tok)
+    return out
+
+
+@dataclass
+class SimConfig:
+    seed: int = 0
+    block_size: int = 16
+    blocks_per_worker: int = 4096
+    # ITL-SLA sweet spot: at/below this many concurrent streams a worker
+    # decodes at base_itl_s; above it, ITL degrades linearly (the shape of
+    # a batch-bound decode worker past its roofline).
+    worker_max_conc: int = 8
+    # Hard admission cap (engine max_num_seqs analog): the sim worker
+    # refuses past this; refused requests sit in the fleet backlog.
+    hard_cap_factor: int = 4
+    base_itl_s: float = 0.02
+    base_ttft_s: float = 0.2
+    # Truth multiplier on both latencies: the fleet the planner actually
+    # has. A profile built with ``profile_error=2`` while speed_factor=1
+    # claims workers 2× faster than they are — the mis-profile the
+    # correction-factor feedback must heal.
+    speed_factor: float = 1.0
+    report_interval_s: float = 0.25
+    substep_s: float = 0.05
+    liveness_suspect_after: int = 2
+    liveness_dead_after: int = 4
+    isl: int = 256
+    osl: int = 64
+    # Scale-up latency: launch → /readyz green (process start + engine +
+    # warm restore).
+    launch_delay_s: float = 1.0
+    # Handoff adoption pause on the receiving worker (ticket + KV install).
+    handoff_pause_s: float = 0.05
+    router: Optional[KvRouterConfig] = None
+
+    @property
+    def hard_cap(self) -> int:
+        return self.worker_max_conc * self.hard_cap_factor
+
+    def itl_of(self, concurrency: int) -> float:
+        return (
+            self.base_itl_s
+            * self.speed_factor
+            * max(1.0, concurrency / self.worker_max_conc)
+        )
+
+    def ttft_of(self, isl: int) -> float:
+        return self.base_ttft_s * self.speed_factor * (isl / max(self.isl, 1))
+
+
+def profile_interpolators(
+    cfg: SimConfig, *, error: float = 1.0
+) -> Tuple[PrefillInterpolator, DecodeInterpolator]:
+    """Build the planner's interpolation table from the sim's truth,
+    optionally mis-profiled: ``error=2`` claims the fleet 2× FASTER than
+    it is (half the TTFT/ITL, double the throughput) — the planner then
+    undersizes until correction-factor feedback folds the observed ratio
+    back in."""
+    isls = [cfg.isl // 4, cfg.isl, cfg.isl * 4]
+    ttfts = [cfg.ttft_of(i) / error for i in isls]
+    prefill = PrefillInterpolator(
+        isls, ttfts, [i / t for i, t in zip(isls, ttfts)]
+    )
+    concs = [1, cfg.worker_max_conc, cfg.worker_max_conc * 2,
+             cfg.worker_max_conc * 4]
+    itls = [cfg.itl_of(c) / error for c in concs]
+    decode = DecodeInterpolator(
+        concs, itls, [c / i for c, i in zip(concs, itls)]
+    )
+    return prefill, decode
+
+
+@dataclass
+class SimStream:
+    rid: str
+    isl: int
+    osl: int
+    arrived: float
+    state: int
+    tokens: List[int] = field(default_factory=list)
+    acc: float = 0.0  # fractional decode progress
+    worker: Optional[int] = None
+    prefill_until: float = 0.0  # prefill/adoption gate on current worker
+    first_token_at: Optional[float] = None
+    done_at: Optional[float] = None
+    migrations: int = 0
+    handoffs: int = 0
+    charged_worker: Optional[int] = None
+    charged_blocks: int = 0
+    report_gen: int = 0
+    block_size: int = 16
+
+    @property
+    def blocks(self) -> int:
+        return (self.isl + len(self.tokens)) // self.block_size + 1
+
+
+@dataclass
+class SimWorker:
+    wid: int
+    incarnation: int
+    ready_at: float
+    alive: bool = True
+    draining: bool = False
+    streams: Dict[str, SimStream] = field(default_factory=dict)
+
+    def ready(self, now: float) -> bool:
+        return self.alive and not self.draining and now >= self.ready_at
+
+
+class SimFleet:
+    """The simulated fleet + the real control plane around it. Implements
+    the ElasticController's Fleet protocol (``ready_count`` / ``load_view``
+    / ``launch`` / ``wait_ready`` / ``drain``)."""
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        *,
+        n_workers: int = 4,
+        rate_fn: Optional[Callable[[float], float]] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.now = 0.0
+        self.rate_fn = rate_fn or (lambda _t: 0.0)
+        self.scheduler = KvScheduler(
+            cfg.router or KvRouterConfig(), seed=cfg.seed
+        )
+        self.tracker = LivenessTracker(
+            LivenessConfig(
+                interval_s=cfg.report_interval_s,
+                suspect_after=cfg.liveness_suspect_after,
+                dead_after=cfg.liveness_dead_after,
+            ),
+            clock=lambda: self.now,
+            on_dead=self._on_dead,
+        )
+        self.rng = random.Random(cfg.seed)
+        self.workers: Dict[int, SimWorker] = {}
+        # Routable = registered in discovery: ready workers AND silent
+        # corpses the liveness plane hasn't evicted yet (routing to a
+        # corpse until detection is the behavior under test, not a bug).
+        self._routable: set = set()
+        self.backlog: Deque[SimStream] = deque()
+        self.completed: List[SimStream] = []
+        self._interval_done: List[SimStream] = []
+        self._interval_arrivals = 0
+        self._interval_started = 0.0
+        self._arrival_acc = 0.0
+        self._next_report = 0.0
+        self._next_wid = 1
+        self.arrivals = 0
+        # Chaos bookkeeping the soak asserts over.
+        self.killed: set = set()
+        self.retired: List[int] = []
+        self.false_positive_deaths: List[int] = []
+        self.detection_latencies: List[float] = []
+        self.reprefill_tokens = 0
+        # Re-prefill attributable to DRAIN fallbacks specifically: the
+        # zero-re-prefill elasticity claim is about this staying 0
+        # whenever peers exist (kill-9 migrations legitimately re-prefill).
+        self.drain_reprefill_tokens = 0
+        self.handoff_streams = 0
+        self.migrated_streams = 0
+        self.requeues = 0
+        self._last_killed: List[int] = []
+        self._chaos: List[Tuple[float, str, Any]] = []
+        self._chaos_fired = 0
+        self._overload_until = 0.0
+        self._overload_mult = 1.0
+        self.events: List[Tuple[float, str, Any]] = []
+        for _ in range(n_workers):
+            self._spawn(ready_in=0.0)
+
+    # -- fleet membership ----------------------------------------------------
+
+    def _spawn(self, ready_in: float, wid: Optional[int] = None,
+               incarnation: int = 1) -> SimWorker:
+        if wid is None:
+            wid = self._next_wid
+            self._next_wid += 1
+        w = SimWorker(
+            wid=wid, incarnation=incarnation, ready_at=self.now + ready_in
+        )
+        self.workers[wid] = w
+        return w
+
+    def schedule_chaos(self, events: List[Tuple[float, str, Any]]) -> None:
+        """``(t, kind, arg)`` with kind ∈ kill | restart | drain |
+        overload. ``arg=None`` picks a victim from the live fleet with the
+        seeded rng at fire time (restart revives the oldest unrestarted
+        kill); overload's arg is ``(duration_s, rate_multiplier)``."""
+        self._chaos = sorted(self._chaos + events, key=lambda e: e[0])
+
+    def kill(self, wid: int) -> None:
+        """kill -9: the worker goes SILENT. It stays routable (discovery
+        still lists it) until the liveness plane declares it dead — its
+        frozen streams stall exactly as a real corpse's would."""
+        w = self.workers[wid]
+        w.alive = False
+        self.killed.add(wid)
+        self._last_killed.append(wid)
+        self.events.append((self.now, "kill", wid))
+
+    def restart(self, wid: int) -> None:
+        """Respawn under the SAME id with a fresh incarnation (the crash
+        plane's rejoin shape). Streams still frozen on the corpse — a
+        restart racing detection — migrate now: the real system's rejoin
+        purge aborts them the same way."""
+        old = self.workers.get(wid)
+        inc = (old.incarnation if old else 0) + 1
+        leftovers = list(old.streams.values()) if old else []
+        if old is not None:
+            old.streams.clear()
+        self._routable.discard(wid)
+        self._spawn(ready_in=self.cfg.launch_delay_s, wid=wid,
+                    incarnation=inc)
+        for s in leftovers:
+            self._migrate(s)
+        self.events.append((self.now, "restart", wid))
+
+    # -- Fleet protocol (ElasticController) ----------------------------------
+
+    def ready_count(self, pool: str = "decode") -> int:
+        return sum(1 for w in self.workers.values() if w.ready(self.now))
+
+    def load_view(self, pool: str = "decode") -> Dict[int, float]:
+        return {
+            w.wid: float(sum(s.blocks for s in w.streams.values()))
+            for w in self.workers.values()
+            if w.ready(self.now)
+        }
+
+    async def launch(self, pool: str, n: int) -> None:
+        for _ in range(n):
+            self._spawn(ready_in=self.cfg.launch_delay_s)
+        self.events.append((self.now, "launch", n))
+
+    async def wait_ready(self, pool: str, want: int, deadline_s: float) -> int:
+        """The /readyz gate: the WORLD keeps moving (arrivals, decode,
+        reports, chaos) while the controller waits for replicas to warm."""
+        deadline = self.now + deadline_s
+        while self.now < deadline and self.ready_count(pool) < want:
+            self.step(self.cfg.substep_s)
+        return self.ready_count(pool)
+
+    async def drain(self, pool: str, wid: int) -> Dict[str, Any]:
+        return self._drain_sync(wid)
+
+    def _drain_sync(self, wid: int) -> Dict[str, Any]:
+        """Drain-with-handoff: flip the draining bit (force-published so
+        the scheduler deflects NOW), live-hand every resident stream to a
+        peer with its fold state carried VERBATIM (zero re-prefilled
+        tokens), then deregister. The ladder's re-prefill rung only fires
+        when no peer exists."""
+        w = self.workers[wid]
+        w.draining = True
+        self.scheduler.update_load(self._snapshot(w))
+        handoffs = 0
+        fell_back = 0
+        for s in list(w.streams.values()):
+            del w.streams[s.rid]
+            self._release_charge(s)
+            # Peer ranking, the drain controller's own (not the router's):
+            # least-loaded serving peer WITH admission capacity — the real
+            # plane's peer walk ends at peers that refuse on capacity, so
+            # the sim must enforce the same hard cap instead of piling a
+            # retiring worker's whole pool onto one saturated adopter.
+            peers = sorted(
+                (len(p.streams), p.wid)
+                for p in self.workers.values()
+                if p.wid != wid and p.ready(self.now)
+                and len(p.streams) < self.cfg.hard_cap
+            )
+            if not peers:
+                # Every peer refused (or none serving): the re-prefill
+                # migration rung. The tokens land at the re-dispatch; the
+                # attribution is charged here (the stream is frozen
+                # meanwhile, so the amount is exact).
+                fell_back += s.isl + len(s.tokens)
+                self._migrate(s)
+                continue
+            peer = self.workers[peers[0][1]]
+            peer.streams[s.rid] = s
+            s.worker = peer.wid
+            s.prefill_until = self.now + self.cfg.handoff_pause_s
+            s.handoffs += 1
+            handoffs += 1
+            self.handoff_streams += 1
+        # Deregister: lease released, discovery DELETE — the tracker
+        # forgets the worker (a drained exit must never read as a death).
+        self._routable.discard(wid)
+        self.tracker.drop(wid)
+        self.scheduler.drop_worker((wid, 0))
+        self.workers.pop(wid, None)
+        self.retired.append(wid)
+        self.events.append((self.now, "drain", wid))
+        self.drain_reprefill_tokens += fell_back
+        return {
+            "handoffs": handoffs,
+            "reprefill_tokens": fell_back,
+        }
+
+    # -- routing / migration -------------------------------------------------
+
+    def _request_blocks(self, s: SimStream) -> int:
+        return s.isl // self.cfg.block_size + 1
+
+    def _route(self, s: SimStream) -> Optional[int]:
+        candidates = [(wid, 0) for wid in sorted(self._routable)]
+        if not candidates:
+            return None
+        chosen = self.scheduler.select_worker(
+            self._request_blocks(s), OverlapScores(), candidates
+        )
+        if chosen is None:
+            return None
+        s.charged_worker = chosen[0]
+        s.charged_blocks = self._request_blocks(s)
+        s.report_gen = self.scheduler.report_generation(chosen)
+        return chosen[0]
+
+    def _release_charge(self, s: SimStream) -> None:
+        if s.charged_worker is not None and s.charged_blocks:
+            self.scheduler.complete_request(
+                (s.charged_worker, 0), s.charged_blocks, s.report_gen
+            )
+        s.charged_worker = None
+        s.charged_blocks = 0
+
+    def _admit(self, s: SimStream, wid: int, *, reprefill: bool) -> None:
+        w = self.workers[wid]
+        w.streams[s.rid] = s
+        s.worker = wid
+        s.prefill_until = self.now + self.cfg.ttft_of(
+            s.isl + (len(s.tokens) if reprefill else 0)
+        )
+        if reprefill:
+            # Re-prefill reconstructs the fold state from the carried
+            # tokens — a lost/duplicated token diverges every token after.
+            state = _seed_state(s.rid)
+            for tok in s.tokens:
+                state = _fold(state, tok)
+            s.state = state
+            self.reprefill_tokens += s.isl + len(s.tokens)
+
+    def _dispatch(self, s: SimStream) -> bool:
+        dest = self._route(s)
+        if dest is None:
+            return False
+        w = self.workers[dest]
+        if w.draining or not w.alive or len(w.streams) >= self.cfg.hard_cap:
+            # Typed refusal (draining/dead-but-undetected/saturated):
+            # the stream bounces back to the backlog — the requeue rung.
+            self._release_charge(s)
+            self.requeues += 1
+            return False
+        self._admit(s, dest, reprefill=s.migrations > 0)
+        return True
+
+    def _migrate(self, s: SimStream) -> None:
+        """Carried-token re-dispatch (the PR 7 migration shape): the
+        stream keeps its streamed tokens; the next worker re-prefills
+        prompt + carried and continues."""
+        s.migrations += 1
+        self.migrated_streams += 1
+        s.worker = None
+        self.backlog.appendleft(s)
+
+    def _on_dead(self, wid: int, _inc: int) -> None:
+        if wid not in self.killed:
+            self.false_positive_deaths.append(wid)
+            logger.error("liveness FALSE POSITIVE: worker %#x", wid)
+        w = self.workers.get(wid)
+        if w is not None and not w.alive:
+            self.detection_latencies.append(
+                self.now - max(
+                    (t for t, kind, a in self.events
+                     if kind == "kill" and a == wid),
+                    default=self.now,
+                )
+            )
+        # The single purge path + typed stream aborts → migration.
+        self.scheduler.drop_worker((wid, 0))
+        self._routable.discard(wid)
+        if w is not None and not w.alive:
+            for s in list(w.streams.values()):
+                del w.streams[s.rid]
+                self._release_charge(s)
+                self._migrate(s)
+        self.events.append((self.now, "dead", wid))
+
+    # -- the world tick ------------------------------------------------------
+
+    def step(self, dt: Optional[float] = None) -> None:
+        dt = self.cfg.substep_s if dt is None else dt
+        self.now += dt
+        self._fire_chaos()
+        self._registration_sweep()
+        self._generate_arrivals(dt)
+        self._drain_backlog()
+        self._decode(dt)
+        if self.now >= self._next_report:
+            self._next_report = self.now + self.cfg.report_interval_s
+            self._publish_reports()
+        self.tracker.evaluate()
+
+    def run(self, duration_s: float) -> None:
+        end = self.now + duration_s
+        while self.now < end:
+            self.step(self.cfg.substep_s)
+
+    def _fire_chaos(self) -> None:
+        while (
+            self._chaos_fired < len(self._chaos)
+            and self._chaos[self._chaos_fired][0] <= self.now
+        ):
+            _t, kind, arg = self._chaos[self._chaos_fired]
+            self._chaos_fired += 1
+            if kind == "kill":
+                wid = arg if arg is not None else self._pick_victim()
+                if wid is not None:
+                    self.kill(wid)
+            elif kind == "restart":
+                wid = arg
+                if wid is None and self._last_killed:
+                    wid = self._last_killed.pop(0)
+                if wid is not None and wid in self.killed:
+                    self.restart(wid)
+            elif kind == "drain":
+                wid = arg if arg is not None else self._pick_victim()
+                if wid is not None:
+                    self._drain_sync(wid)
+            elif kind == "overload":
+                duration, mult = arg
+                self._overload_until = self.now + duration
+                self._overload_mult = float(mult)
+                self.events.append((self.now, "overload", arg))
+            else:
+                raise ValueError(f"unknown chaos kind {kind!r}")
+
+    def _pick_victim(self) -> Optional[int]:
+        live = sorted(
+            w.wid for w in self.workers.values() if w.ready(self.now)
+        )
+        if len(live) <= 1:
+            return None  # never leave the fleet empty
+        return self.rng.choice(live)
+
+    def _registration_sweep(self) -> None:
+        for w in self.workers.values():
+            if w.ready(self.now):
+                self._routable.add(w.wid)
+
+    def _generate_arrivals(self, dt: float) -> None:
+        rate = self.rate_fn(self.now)
+        if self.now < self._overload_until:
+            rate *= self._overload_mult
+        self._arrival_acc += rate * dt
+        while self._arrival_acc >= 1.0:
+            self._arrival_acc -= 1.0
+            rid = f"r{self.arrivals}"
+            self.arrivals += 1
+            self._interval_arrivals += 1
+            self.backlog.append(
+                SimStream(
+                    rid=rid, isl=self.cfg.isl, osl=self.cfg.osl,
+                    arrived=self.now, state=_seed_state(rid),
+                    block_size=self.cfg.block_size,
+                )
+            )
+
+    def _drain_backlog(self) -> None:
+        # FIFO head-of-line admission: one refusal stalls the queue for a
+        # substep (a 429'd client honoring Retry-After) — a corpse
+        # attracting placement stalls arrivals for exactly the detection
+        # budget, then the purge unblocks the flood.
+        while self.backlog:
+            if not self._dispatch(self.backlog[0]):
+                break
+            self.backlog.popleft()
+
+    def _decode(self, dt: float) -> None:
+        for w in list(self.workers.values()):
+            if not w.alive or self.now < w.ready_at:
+                continue  # a corpse's streams freeze; a warming worker idles
+            active = [
+                s for s in w.streams.values() if self.now >= s.prefill_until
+            ]
+            if not active:
+                continue
+            itl = self.cfg.itl_of(len(w.streams))
+            for s in active:
+                if s.first_token_at is None:
+                    s.first_token_at = s.prefill_until
+                s.acc += dt / itl
+                while s.acc >= 1.0 and len(s.tokens) < s.osl:
+                    s.acc -= 1.0
+                    tok = _token_of(s.state)
+                    s.tokens.append(tok)
+                    s.state = _fold(s.state, tok)
+                if len(s.tokens) >= s.osl:
+                    s.done_at = self.now
+                    del w.streams[s.rid]
+                    self._release_charge(s)
+                    self.completed.append(s)
+                    self._interval_done.append(s)
+
+    def _snapshot(self, w: SimWorker) -> LoadSnapshot:
+        return LoadSnapshot(
+            worker_id=w.wid,
+            active_blocks=sum(s.blocks for s in w.streams.values()),
+            total_blocks=self.cfg.blocks_per_worker,
+            active_seqs=len(w.streams),
+            queue_depth=0,
+            draining=w.draining,
+            incarnation=w.incarnation,
+        )
+
+    def _publish_reports(self) -> None:
+        for w in self.workers.values():
+            if not w.alive or self.now < w.ready_at:
+                continue  # silence: exactly what the liveness plane reads
+            snap = self._snapshot(w)
+            self.scheduler.update_load(snap)
+            self.tracker.observe_report(w.wid, w.incarnation)
+
+    # -- planner inputs ------------------------------------------------------
+
+    def metrics_snapshot(self) -> MetricsSnapshot:
+        """One adjustment interval's observed metrics (call once per
+        planner step — the scrape-source role)."""
+        done = self._interval_done
+        self._interval_done = []
+        arrivals = self._interval_arrivals
+        self._interval_arrivals = 0
+        dt = max(self.now - self._interval_started, 1e-9)
+        self._interval_started = self.now
+        snap = MetricsSnapshot(
+            request_rate=arrivals / dt,
+            mean_isl=float(
+                statistics.fmean(s.isl for s in done) if done else 0.0
+            ),
+            mean_osl=float(
+                statistics.fmean(s.osl for s in done) if done else 0.0
+            ),
+            p50_ttft_s=(
+                statistics.median(s.first_token_at - s.arrived for s in done)
+                if done else None
+            ),
+            p50_itl_s=(
+                statistics.median(
+                    (s.done_at - s.first_token_at) / max(s.osl - 1, 1)
+                    for s in done
+                )
+                if done else None
+            ),
+        )
+        return snap
+
+    async def metrics_source(self) -> MetricsSnapshot:
+        return self.metrics_snapshot()
+
+    # -- soak assertions -----------------------------------------------------
+
+    def in_flight(self) -> int:
+        return len(self.backlog) + sum(
+            len(w.streams) for w in self.workers.values()
+        )
+
+    def settle(self, max_s: float = 120.0) -> None:
+        """Run with no new arrivals until every stream resolves."""
+        rate_fn = self.rate_fn
+        self.rate_fn = lambda _t: 0.0
+        deadline = self.now + max_s
+        try:
+            while self.in_flight() > 0 and self.now < deadline:
+                self.step(self.cfg.substep_s)
+        finally:
+            self.rate_fn = rate_fn
+
+    def verify_streams(self) -> List[str]:
+        """Token-exactness vs the never-disturbed oracle. Returns the
+        problems (empty = zero lost streams, every one exact)."""
+        problems = []
+        if self.in_flight() > 0:
+            problems.append(f"{self.in_flight()} streams never completed")
+        if len(self.completed) != self.arrivals:
+            problems.append(
+                f"{self.arrivals} arrivals but {len(self.completed)} "
+                "completions"
+            )
+        seen = set()
+        for s in self.completed:
+            if s.rid in seen:
+                problems.append(f"{s.rid} completed twice")
+            seen.add(s.rid)
+            want = expected_tokens(s.rid, s.osl)
+            if s.tokens != want:
+                problems.append(
+                    f"{s.rid} diverged from oracle after "
+                    f"{s.migrations} migrations/{s.handoffs} handoffs"
+                )
+        return problems

@@ -73,6 +73,21 @@ class KvRouterConfig:
     default_link_bandwidth: float = 1e9
     # EWMA weight for bandwidth observations folded in from load reports.
     link_ewma_alpha: float = 0.25
+    # -- candidate pruning (fleet-scale selection) -------------------------
+    # Above this many candidates (and at temperature 0) select_worker
+    # prunes instead of scoring every worker: overlap-carrying and
+    # link-differentiated candidates are always scored, then a
+    # branch-and-bound walk over a (static_cost, worker) rank cache —
+    # maintained on load reports — scores rank entries until the next
+    # entry's static lower bound can no longer beat the best scored logit
+    # (EXACT argmin, the common case) or ``prune_walk_limit`` entries
+    # have been scored (bounded best-of-K among the statically
+    # least-loaded, reached only when in-flight charges are dense across
+    # the whole fleet; suboptimality is then bounded by one report
+    # interval's worth of charges). Per-request cost: O(overlap + link +
+    # walk) instead of O(workers). 0 disables pruning entirely.
+    prune_threshold: int = 32
+    prune_walk_limit: int = 8
 
 
 class LinkCostModel:
@@ -99,6 +114,24 @@ class LinkCostModel:
         # from LoadSnapshot.link_faults, cleared when a report stops
         # carrying the src (breaker closed or half-open window reached).
         self._faults: set = set()
+        # src → dsts with a non-default quote (measured EWMA or fault):
+        # the pruned selection path reads this per request, so it must be
+        # a lookup, not a scan over every measured pair in the fleet.
+        self._by_src: Dict[int, set] = {}
+
+    def _index_add(self, src: int, dst: WorkerKey) -> None:
+        self._by_src.setdefault(src, set()).add(dst)
+
+    def _index_check(self, src: int, dst: WorkerKey) -> None:
+        """Drop (src, dst) from the src index when NEITHER a measurement
+        nor a fault keeps it special."""
+        if (src, dst) in self._bw or (src, dst) in self._faults:
+            return
+        dsts = self._by_src.get(src)
+        if dsts is not None:
+            dsts.discard(dst)
+            if not dsts:
+                del self._by_src[src]
 
     def observe(self, src: int, dst: WorkerKey, bytes_per_s: float) -> None:
         if bytes_per_s <= 0:
@@ -109,10 +142,12 @@ class LinkCostModel:
             bytes_per_s if prev is None
             else self.alpha * bytes_per_s + (1 - self.alpha) * prev
         )
+        self._index_add(src, dst)
 
     def set_bandwidth(self, src: int, dst: WorkerKey, bytes_per_s: float) -> None:
         """Pin a pair's bandwidth directly (operator override, tests)."""
         self._bw[(src, dst)] = float(bytes_per_s)
+        self._index_add(src, dst)
 
     def bandwidth(self, src: int, dst: WorkerKey) -> float:
         if (src, dst) in self._faults:
@@ -125,16 +160,24 @@ class LinkCostModel:
         survives, so a healed pair resumes at its last honest estimate."""
         if faulted:
             self._faults.add((src, dst))
+            self._index_add(src, dst)
         else:
             self._faults.discard((src, dst))
+            self._index_check(src, dst)
 
     def sync_faults(self, dst: WorkerKey, srcs) -> None:
         """Replace dst's faulted-src set with what its load report carries
         (the report is authoritative for its own breakers)."""
         want = {int(s) for s in srcs}
+        was = {(s, d) for (s, d) in self._faults if d == dst}
         self._faults = {
             (s, d) for (s, d) in self._faults if d != dst
         } | {(s, dst) for s in want}
+        for s in want:
+            self._index_add(s, dst)
+        for s, d in was:
+            if s not in want:
+                self._index_check(s, d)
 
     def faulted(self, src: int, dst: WorkerKey) -> bool:
         return (src, dst) in self._faults
@@ -150,6 +193,14 @@ class LinkCostModel:
         """Measured pairs (for the router's per-pair gauges)."""
         return dict(self._bw)
 
+    def special_dsts(self, src: int):
+        """Destinations whose (src, dst) pair quotes something OTHER than
+        the seed default (measured EWMA or an open breaker) — the only
+        workers the link term can differentiate, hence the only ones the
+        pruned candidate path must score individually. One dict lookup:
+        per-request cost must not scan the fleet's full pair set."""
+        return self._by_src.get(src, ())
+
     def drop_worker(self, worker: WorkerKey) -> None:
         self._bw = {
             k: v for k, v in self._bw.items()
@@ -159,6 +210,11 @@ class LinkCostModel:
             k for k in self._faults
             if k[1] != worker and k[0] != worker[0]
         }
+        self._by_src.pop(worker[0], None)
+        for src, dsts in list(self._by_src.items()):
+            dsts.discard(worker)
+            if not dsts:
+                del self._by_src[src]
 
 
 @dataclass
@@ -182,6 +238,15 @@ class WorkerState:
     # Bumped on every load report; stale in-flight releases (charged before
     # the report that already absorbed them) are dropped by comparing this.
     report_gen: int = 0
+    # Pruned-selection cache, refreshed per load report (update_load):
+    # ``eligible`` = not draining, below busy gating, below the advertised
+    # admission watermark — the workers the full scan's tier filters keep
+    # whenever any such worker exists; ``static_cost`` = the report-only
+    # part of the logit (active blocks + weighted queue depth), the total
+    # logit for any zero-overlap uncharged candidate up to a shared
+    # constant.
+    eligible: bool = True
+    static_cost: float = 0.0
 
     def decode_blocks(self, ttl: float) -> int:
         base = self.snapshot.active_blocks if self.snapshot else 0
@@ -233,6 +298,15 @@ class KvScheduler:
         # radix-indexer removal here, so scheduler.drop_worker stays THE
         # single reconciliation path for a vanished worker).
         self._on_drop: List = []
+        # Pruned-selection cache: a (static_cost, worker) rank over
+        # eligible workers, rebuilt lazily after load reports.
+        self._rank: List[Tuple[float, WorkerKey]] = []
+        self._rank_dirty = True
+        # Instrumentation: workers actually SCORED (logit computed) across
+        # all selections — the soak/bench read this to prove per-request
+        # scheduling cost stays bounded as the fleet grows.
+        self.logit_evals = 0
+        self.selections = 0
 
     # -- state maintenance -------------------------------------------------
 
@@ -263,6 +337,8 @@ class KvScheduler:
         state.snapshot = snapshot
         state.inflight_blocks = 0  # report supersedes the prediction
         state.report_gen += 1
+        self._refresh_state(state)
+        self._rank_dirty = True
         # Fold the worker's measured pull bandwidths (src → B/s, observed
         # at ITS end of each link) into the shared link-cost model.
         for src, bw in (snapshot.link_bandwidth or {}).items():
@@ -279,8 +355,40 @@ class KvScheduler:
         state = self._workers.get(worker)
         return state.report_gen if state is not None else 0
 
+    def _refresh_state(self, state: WorkerState) -> None:
+        """Recompute the pruned-selection cache for one worker from its
+        snapshot (runs once per load report, not per request)."""
+        snap = state.snapshot
+        if snap is None:
+            # Never-reported worker (a fresh scale-up instance): eligible
+            # at zero static cost — exactly how the full scan scores it.
+            state.eligible = True
+            state.static_cost = 0.0
+            return
+        usage = snap.kv_usage
+        wm = snap.kv_high_watermark
+        state.eligible = (
+            not snap.draining
+            and usage < self.config.busy_kv_usage
+            and not (wm < 1.0 and usage >= wm)
+        )
+        qw = self.config.queue_depth_weight
+        state.static_cost = snap.active_blocks + (
+            qw * snap.queue_depth if qw > 0 else 0.0
+        )
+
+    def _rebuild_rank(self) -> None:
+        self._rank = sorted(
+            (state.static_cost, w)
+            for w, state in self._workers.items()
+            if state.eligible
+        )
+        self._rank_dirty = False
+
     def add_worker(self, worker: WorkerKey) -> None:
-        self._workers.setdefault(worker, WorkerState())
+        if worker not in self._workers:
+            self._workers[worker] = WorkerState()
+            self._rank_dirty = True
 
     def drop_worker(self, worker: WorkerKey) -> None:
         """THE single reconciliation for a vanished worker (crash, lease
@@ -292,6 +400,7 @@ class KvScheduler:
         leak audit (tests/test_liveness.py) asserts zero residue after
         this one call."""
         self._workers.pop(worker, None)
+        self._rank_dirty = True
         self.link_costs.drop_worker(worker)
         self._fence.drop(worker)
         for fn in self._on_drop:
@@ -333,6 +442,26 @@ class KvScheduler:
         of pulling each candidate's overlap-miss blocks from the source
         worker, so a prefix-overlap win never beats a slow link blindly."""
         cfg = self.config
+        self.selections += 1
+        # Fleet-scale fast path: above the prune threshold (and at
+        # temperature 0, where selection is a pure argmin) score only the
+        # candidates that can actually win instead of every worker.
+        if (
+            cfg.prune_threshold > 0
+            and cfg.router_temperature <= 0.0
+            and (len(candidates) if candidates is not None else len(self._workers))
+            > cfg.prune_threshold
+        ):
+            chosen = self._select_pruned(
+                request_blocks, overlaps, candidates, transfer
+            )
+            if chosen is not None:
+                self._charge(chosen, request_blocks, overlaps)
+                return chosen
+            # No fully-eligible candidate (fleet-wide drain/saturation):
+            # fall through to the full tiered scan, whose fallback tiers
+            # still produce a best-effort placement.
+
         pool: List[WorkerKey] = list(candidates) if candidates is not None else self.workers()
         if not pool:
             return None
@@ -363,6 +492,20 @@ class KvScheduler:
         if unsaturated:
             pool = unsaturated
 
+        logits = self._logits(pool, request_blocks, overlaps, transfer)
+        chosen = self._sample(logits, cfg.router_temperature)
+        self._charge(chosen, request_blocks, overlaps)
+        return chosen
+
+    def _logits(
+        self,
+        pool: Sequence[WorkerKey],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        transfer: Optional[TransferContext],
+    ) -> List[Tuple[WorkerKey, float, int]]:
+        cfg = self.config
+        self.logit_evals += len(pool)
         logits: List[Tuple[WorkerKey, float, int]] = []
         for w in pool:
             overlap = overlaps.scores.get(w, 0)
@@ -383,15 +526,129 @@ class KvScheduler:
                     cfg.link_cost_weight * cfg.prefill_blocks_per_s * wire_s
                 )
             logits.append((w, logit, overlap))
+        return logits
 
-        chosen = self._sample(logits, cfg.router_temperature)
-        # Predict the routed request's load until the next report lands.
+    def _charge(
+        self, chosen: WorkerKey, request_blocks: int, overlaps: OverlapScores
+    ) -> None:
+        """Predict the routed request's load until the next report lands."""
         state = self._workers[chosen]
         state.inflight_blocks += max(
             request_blocks - overlaps.scores.get(chosen, 0), 0
         )
         state.inflight_at = time.monotonic()
-        return chosen
+
+    def _select_pruned(
+        self,
+        request_blocks: int,
+        overlaps: OverlapScores,
+        candidates: Optional[Sequence[WorkerKey]],
+        transfer: Optional[TransferContext],
+    ) -> Optional[WorkerKey]:
+        """Argmin over a pruned candidate set (temperature 0 only).
+
+        Whenever at least one FULLY-ELIGIBLE candidate exists (not
+        draining, below busy gating, below its watermark), the full scan's
+        tier filters reduce its pool to exactly the eligible candidates.
+        Within that pool, a worker with zero overlap and a seed-default
+        link quote has logit
+
+            overlap_weight × request_blocks + link_const
+            + static_cost + inflight_charge
+
+        where ``static_cost`` (active blocks + weighted queue depth, from
+        the last report) is a LOWER bound on the load part — in-flight
+        charges only add. So the argmin is found by (a) scoring every
+        overlap-carrying and link-differentiated candidate (measured EWMA
+        or open breaker for this src — the only workers whose link term
+        differs from the shared constant), then (b) walking the cached
+        (static_cost, worker) rank in order, scoring each entry exactly,
+        and STOPPING once the next entry's static lower bound exceeds the
+        best exact logit seen — every unwalked worker can only be worse.
+        Tie-breaks match the full scan: the rank is (cost, worker)-sorted
+        and _sample orders by (logit, -overlap, worker).
+
+        The walk is additionally capped at ``prune_walk_limit`` scored
+        entries: when in-flight charges are dense across the whole fleet
+        (every statically-cheap worker carries routed-but-unreported
+        work), the bound cannot fire early and exactness would cost
+        O(workers) again — the cap degrades selection to the best of the
+        K statically-least-loaded workers (plus all specials), whose
+        suboptimality is bounded by the charges one report interval can
+        accumulate. Equivalence under sparse charges is test-asserted
+        across randomized fleets.
+
+        Returns None when no eligible candidate exists — the caller runs
+        the full tiered scan with its all-draining/all-busy fallbacks."""
+        cfg = self.config
+        if self._rank_dirty:
+            self._rebuild_rank()
+        cand: Optional[set] = None
+        if candidates is not None:
+            cand = set(candidates)
+            unknown = cand - self._workers.keys()
+            if unknown:
+                for w in unknown:
+                    self.add_worker(w)
+                self._rebuild_rank()
+        special: set = set()
+        for w in overlaps.scores:
+            if w in self._workers and (cand is None or w in cand):
+                special.add(w)
+        if transfer is not None and cfg.link_cost_weight > 0:
+            for d in self.link_costs.special_dsts(transfer.src):
+                if d in self._workers and (cand is None or d in cand):
+                    special.add(d)
+        # The tier filters would drop ineligible specials whenever any
+        # eligible candidate exists — enforce the same here.
+        pool: List[WorkerKey] = sorted(
+            w for w in special if self._workers[w].eligible
+        )
+        logits = self._logits(pool, request_blocks, overlaps, transfer)
+        best = min(
+            ((l, -o, w) for w, l, o in logits), default=None
+        )
+        # The shared part of every zero-overlap default-link logit: the
+        # static rank key completes it to a lower bound.
+        base_const = cfg.overlap_score_weight * request_blocks
+        if transfer is not None and cfg.link_cost_weight > 0:
+            base_const += (
+                cfg.link_cost_weight * cfg.prefill_blocks_per_s
+                * (request_blocks * transfer.bytes_per_block)
+                / max(self.link_costs.default_bandwidth, 1e-9)
+            )
+        walked = 0
+        limit = max(cfg.prune_walk_limit, 1)
+        # Entries EXAMINED (scored or skipped) are bounded too: with a
+        # small candidate subset of a huge fleet, skip-scanning the whole
+        # rank for in-candidate workers would silently restore O(workers)
+        # wall cost even while scored logits stayed bounded. Hitting this
+        # cap without a scored candidate defers to the full scan.
+        examine_cap = max(limit * 8, 64)
+        examined = 0
+        found_eligible = bool(pool)
+        for cost, w in self._rank:
+            examined += 1
+            if examined > examine_cap:
+                break
+            if w in special or (cand is not None and w not in cand):
+                continue
+            state = self._workers.get(w)
+            if state is None or not state.eligible:
+                continue
+            found_eligible = True
+            if best is not None and base_const + cost > best[0]:
+                break  # exact: nothing later in the rank can win
+            entry = self._logits([w], request_blocks, overlaps, transfer)[0]
+            key = (entry[1], -entry[2], entry[0])
+            if best is None or key < best:
+                best = key
+            walked += 1
+            if walked >= limit:
+                break
+        if best is None or not found_eligible:
+            return None
+        return best[2]
 
     def complete_request(
         self,

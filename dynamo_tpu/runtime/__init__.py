@@ -23,6 +23,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_LIVENESS,
     ALL_MIGRATION,
     ALL_OVERLOAD,
+    ALL_PLANNER,
     ALL_ROUTER,
     ALL_RUNTIME,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "ALL_LIVENESS",
     "ALL_MIGRATION",
     "ALL_OVERLOAD",
+    "ALL_PLANNER",
     "ALL_ROUTER",
     "ALL_RUNTIME",
     "AsyncEngine",

@@ -67,6 +67,19 @@ LIVENESS_REPORT = "liveness.report"
 # crash loop.
 RESTORE_LOAD = "restore.load"
 
+# -- planner / elasticity plane (planner/planner_core.py) ---------------------
+# One hit per adjustment-interval observation, BEFORE the metrics source is
+# read: an injection models the scrape (or the metrics pipeline) dying —
+# the control loop must skip the interval and keep converging, never crash
+# or act on a half-read snapshot.
+PLANNER_OBSERVE = "planner.observe"
+# One hit per plan handed to the connector, BEFORE any actuation: an
+# injection models the actuation plane (k8s API, process supervisor,
+# drain endpoints) refusing the plan — the loop must retry on its own
+# cadence and the fleet must never be left half-actuated by the raise
+# (the elastic controller's per-action error handling owns partial fleets).
+PLANNER_APPLY = "planner.apply"
+
 # -- overload plane (runtime/overload.py) -------------------------------------
 # One hit per QUEUED admission attempt, before the EDF wait: an injected
 # timeout here expires exactly that request's queue budget — the
@@ -92,5 +105,7 @@ ALL_FAULT_POINTS = (
     DRAIN_HANDOFF_IMPORT,
     LIVENESS_REPORT,
     RESTORE_LOAD,
+    PLANNER_OBSERVE,
+    PLANNER_APPLY,
     OVERLOAD_ADMIT,
 )

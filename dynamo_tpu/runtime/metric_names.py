@@ -207,6 +207,33 @@ LIVENESS_STALE_DROPS_TOTAL = (
 LIVENESS_RESTORE_SECONDS = f"{LIVENESS_PREFIX}_restore_seconds"
 LIVENESS_RESTORE_OUTCOME_TOTAL = f"{LIVENESS_PREFIX}_restore_outcome_total"
 
+# -- planner / elasticity plane (planner/planner_core.py, planner/elastic.py) -
+PLANNER_PREFIX = "dynamo_tpu_planner"
+# Correction-factor feedback (docs/design_docs/elasticity.md): decayed EWMA
+# of observed/predicted SLA ratios folded into the interpolator outputs,
+# labeled by stage (ttft | itl). 1.0 = the profile is honest; 2.0 = the
+# fleet is twice as slow as profiled and sizing is being corrected up.
+PLANNER_CORRECTION_FACTOR = f"{PLANNER_PREFIX}_correction_factor"
+# The last computed plan, per pool (prefill | decode) — what the planner
+# WANTS; the elastic controller's state gauge says what it is DOING.
+PLANNER_DESIRED_REPLICAS = f"{PLANNER_PREFIX}_desired_replicas"
+# Plan-transition state machine: 0 steady, 1 scaling_up, 2 scaling_down,
+# 3 converged (an actuation just completed; cooldown running).
+PLANNER_STATE = f"{PLANNER_PREFIX}_state"
+PLANNER_TRANSITIONS_TOTAL = f"{PLANNER_PREFIX}_transitions_total"
+# Plans the planner handed the connector (one per adjustment interval once
+# predictors warm up).
+PLANNER_APPLIES_TOTAL = f"{PLANNER_PREFIX}_applies_total"
+# Plan changes suppressed by hysteresis/cooldown — oscillating load shows
+# up here instead of as fleet churn.
+PLANNER_HOLDS_TOTAL = f"{PLANNER_PREFIX}_holds_total"
+# Workers retired through the drain plane (zero-re-prefill live handoff),
+# by mode (planned = scale-down, preemption = spot reclaim).
+PLANNER_SCALE_DOWN_DRAINS_TOTAL = f"{PLANNER_PREFIX}_scale_down_drains_total"
+# Replicas launched but not yet counted: a scale-up replica only counts
+# once its /readyz (warm restore included) goes green.
+PLANNER_SCALE_UP_PENDING = f"{PLANNER_PREFIX}_scale_up_pending"
+
 # -- overload plane (runtime/overload.py OverloadController) -----------------
 OVERLOAD_PREFIX = "dynamo_tpu_overload"
 # Brownout state machine: 0 healthy, 1 brownout (max_tokens clamped,
@@ -299,6 +326,17 @@ ALL_LIVENESS = (
     LIVENESS_STALE_DROPS_TOTAL,
     LIVENESS_RESTORE_SECONDS,
     LIVENESS_RESTORE_OUTCOME_TOTAL,
+)
+
+ALL_PLANNER = (
+    PLANNER_CORRECTION_FACTOR,
+    PLANNER_DESIRED_REPLICAS,
+    PLANNER_STATE,
+    PLANNER_TRANSITIONS_TOTAL,
+    PLANNER_APPLIES_TOTAL,
+    PLANNER_HOLDS_TOTAL,
+    PLANNER_SCALE_DOWN_DRAINS_TOTAL,
+    PLANNER_SCALE_UP_PENDING,
 )
 
 ALL_OVERLOAD = (

@@ -339,3 +339,279 @@ async def test_planner_closes_loop_scrape_to_processes():
     finally:
         await conn.close()
         await runner.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Correction-factor feedback (ISSUE 13): a mis-profiled table heals
+# ---------------------------------------------------------------------------
+
+from dynamo_tpu.planner import CorrectionFactor, FeedbackConfig
+from dynamo_tpu.runtime import metric_names as mn
+
+
+class TestCorrectionFactor:
+    def test_folds_toward_ratio_with_decay(self):
+        f = CorrectionFactor(FeedbackConfig(decay=0.5, deadband=0.0))
+        for _ in range(8):
+            f.observe(observed=0.04, predicted=0.02)
+        assert 1.9 < f.value <= 2.0
+
+    def test_clamps_and_skips_idle(self):
+        f = CorrectionFactor(FeedbackConfig(decay=1.0))
+        f.observe(observed=100.0, predicted=0.001)  # queueing blowup
+        assert f.value == f.config.max_factor
+        v = f.value
+        f.observe(observed=None, predicted=0.02)  # idle interval
+        f.observe(observed=0.0, predicted=0.02)
+        assert f.value == v
+
+    def test_deadband_pins_honest_table(self):
+        f = CorrectionFactor(FeedbackConfig(decay=0.9, deadband=0.05))
+        for _ in range(20):
+            f.observe(observed=0.0204, predicted=0.02)  # 2% noise
+        assert f.value == 1.0
+
+    def test_decay_zero_disables(self):
+        f = CorrectionFactor(FeedbackConfig(decay=0.0))
+        f.observe(observed=0.08, predicted=0.02)
+        assert f.value == 1.0
+
+
+def _itl_tables(base, sweet):
+    concs = [1.0, sweet, sweet * 2, sweet * 4]
+    itls = [base * max(1.0, c / sweet) for c in concs]
+    return DecodeInterpolator(concs, itls, [c / i for c, i in zip(concs, itls)])
+
+
+async def test_misprofiled_table_converges_to_oracle_sizing():
+    """THE feedback acceptance: a 2×-wrong decode profile (claims workers
+    twice as fast as they are) converges to the honest table's pool
+    sizing within a bounded number of adjustment intervals, with the
+    factor visible on the ALL_PLANNER gauge."""
+    sweet = 8.0
+    true_base = 0.02
+    rate, osl, sla = 20.0, 64.0, 0.04
+
+    def true_itl(c):
+        return true_base * max(1.0, c / sweet)
+
+    def observed_for(replicas):
+        # Fixed point of c = rate×osl×itl_true(c)/replicas, capped at the
+        # engine's hard concurrency limit (a starved fleet queues, it
+        # doesn't run unbounded batch).
+        c = 1.0
+        for _ in range(200):
+            c = min(rate * osl * true_itl(c) / max(replicas, 1), 64.0)
+        return true_itl(c)
+
+    def build(decode_interp):
+        applied = {"decode": 1}
+
+        class Recorder:
+            async def apply(self, plan):
+                applied["decode"] = plan.decode
+
+        async def metrics():
+            return MetricsSnapshot(
+                request_rate=rate, mean_isl=256, mean_osl=osl,
+                p50_ttft_s=0.2,
+                p50_itl_s=observed_for(applied["decode"]),
+            )
+
+        planner = Planner(
+            PlannerConfig(
+                itl_target_s=sla, ttft_target_s=1.0, min_replicas=1,
+                max_replicas=64, total_chip_budget=128,
+            ),
+            PrefillInterpolator([64, 256, 1024], [0.05, 0.2, 0.8],
+                                [1280, 1280, 1280]),
+            decode_interp, Recorder(), metrics,
+        )
+        return planner, applied
+
+    # Oracle: the honest table (feedback stays pinned at 1 by deadband).
+    oracle, _ = build(_itl_tables(true_base, sweet))
+    for _ in range(6):
+        oracle_plan = await oracle.step()
+    assert abs(oracle.feedback_itl.value - 1.0) < 0.1
+
+    # The 2×-wrong table: claims base ITL of true/2. (The first step
+    # already folds one observation — the no-feedback control below is
+    # what shows the uncorrected mis-sizing.)
+    wrong, applied = build(_itl_tables(true_base / 2, sweet))
+    first_plan = await wrong.step()
+    converged_at = None
+    history = [first_plan.decode]
+    for i in range(2, 13):
+        plan = await wrong.step()
+        history.append(plan.decode)
+        if plan.decode == oracle_plan.decode and converged_at is None:
+            converged_at = i
+    # Bounded convergence: corrected within 8 intervals and STAYS there.
+    assert converged_at is not None and converged_at <= 8, history
+    assert all(d == oracle_plan.decode for d in history[converged_at - 1:]), history
+    # The factor learned the truth (≈2) and is on the lint-pinned gauge.
+    assert 1.6 < wrong.feedback_itl.value < 2.4
+    assert (
+        wrong.metrics.correction_factor.value(stage="itl")
+        == wrong.feedback_itl.value
+    )
+    assert mn.PLANNER_CORRECTION_FACTOR in wrong.metrics.render()
+
+    # Without feedback (decay=0) the same wrong table NEVER heals.
+    frozen, _ = build(_itl_tables(true_base / 2, sweet))
+    frozen.config.feedback = FeedbackConfig(decay=0.0)
+    frozen.feedback_itl = CorrectionFactor(frozen.config.feedback)
+    for _ in range(12):
+        frozen_plan = await frozen.step()
+    assert frozen_plan.decode < oracle_plan.decode
+
+
+async def test_ttft_factor_corrects_prefill_pool():
+    """A prefill table claiming 2× the real tokens/sec undersizes the
+    prefill pool until the TTFT ratio folds in."""
+    applied = {}
+
+    class Recorder:
+        async def apply(self, plan):
+            applied["prefill"] = plan.prefill
+
+    async def metrics():
+        return MetricsSnapshot(
+            request_rate=40.0, mean_isl=512, mean_osl=64,
+            p50_ttft_s=0.4,  # observed: the table predicted 0.2
+            p50_itl_s=0.02,
+        )
+
+    planner = Planner(
+        PlannerConfig(ttft_target_s=1.0, itl_target_s=0.04,
+                      max_replicas=64, total_chip_budget=128),
+        PrefillInterpolator([128, 512, 1024], [0.05, 0.2, 0.4],
+                            [10240, 10240, 10240]),
+        _itl_tables(0.02, 8.0), Recorder(), metrics,
+    )
+    for _ in range(7):
+        plan = await planner.step()
+    assert 1.8 < planner.feedback_ttft.value <= 2.1
+    # Raw table: ceil(40×512 / 10240) = 2 workers. Corrected throughput
+    # (halved) doubles the pool.
+    assert plan.prefill == 4
+
+
+def test_start_outside_running_loop_fails_loudly():
+    """Satellite: Planner.start() now binds get_running_loop — calling it
+    with no running loop raises instead of silently attaching the task
+    to a dead loop."""
+    planner = make_planner(None, None)
+    with pytest.raises(RuntimeError):
+        planner.start()
+
+
+async def test_start_inside_loop_runs_and_stops():
+    disco = MemoryDiscovery()
+    connector = VirtualConnector(disco, "ns")
+
+    async def metrics():
+        return MetricsSnapshot(request_rate=2.0, mean_isl=64, mean_osl=16)
+
+    planner = make_planner(connector, metrics)
+    planner.start()
+    await asyncio.sleep(0.15)
+    await planner.stop()
+    assert planner.last_plan is not None
+
+
+# ---------------------------------------------------------------------------
+# Connector satellites (ISSUE 13): 409 race + aggregated pool + round trip
+# ---------------------------------------------------------------------------
+
+from dynamo_tpu.planner.connectors import ScalingAdapterConnector, planner_key
+
+
+class FakeKube:
+    """Scripted KubeClient: per-adapter-name error queues, every call
+    recorded."""
+
+    def __init__(self):
+        self.calls = []
+        self.patch_errors = {}  # name -> [exceptions to raise, in order]
+        self.create_errors = {}
+
+    async def patch(self, group, version, ns, plural, name, body):
+        self.calls.append(("patch", name, body["spec"]["replicas"]))
+        errs = self.patch_errors.get(name)
+        if errs:
+            raise errs.pop(0)
+        return {}
+
+    async def create(self, group, version, ns, plural, body):
+        name = body["metadata"]["name"]
+        self.calls.append(("create", name, body["spec"]["replicas"]))
+        errs = self.create_errors.get(name)
+        if errs:
+            raise errs.pop(0)
+        return {}
+
+
+class TestScalingAdapterConnector:
+    def _conn(self, kube, **kw):
+        return ScalingAdapterConnector(kube, "graph", **kw)
+
+    async def test_patch_then_create_on_404(self):
+        from dynamo_tpu.deploy.k8s_client import KubeApiError
+
+        kube = FakeKube()
+        kube.patch_errors = {
+            "graph-prefill": [KubeApiError(404, "nope")],
+            "graph-decode": [KubeApiError(404, "nope")],
+        }
+        await self._conn(kube).apply(ReplicaPlan(prefill=2, decode=3))
+        assert ("create", "graph-prefill", 2) in kube.calls
+        assert ("create", "graph-decode", 3) in kube.calls
+
+    async def test_create_409_race_retries_patch_once(self):
+        """Satellite fix: a concurrent create between the 404 and our
+        create must read as 'exists' — retry the patch, don't kill the
+        whole plan apply."""
+        from dynamo_tpu.deploy.k8s_client import KubeApiError
+
+        kube = FakeKube()
+        kube.patch_errors = {"graph-decode": [KubeApiError(404, "nope")]}
+        kube.create_errors = {"graph-decode": [KubeApiError(409, "already exists")]}
+        await self._conn(kube).apply(ReplicaPlan(prefill=0, decode=5))
+        # patch (404) → create (409) → patch retry lands.
+        kinds = [c[0] for c in kube.calls if c[1] == "graph-decode"]
+        assert kinds == ["patch", "create", "patch"]
+
+    async def test_create_non_409_still_raises(self):
+        from dynamo_tpu.deploy.k8s_client import KubeApiError
+
+        kube = FakeKube()
+        kube.patch_errors = {"graph-decode": [KubeApiError(404, "nope")]}
+        kube.create_errors = {"graph-decode": [KubeApiError(500, "boom")]}
+        with pytest.raises(KubeApiError):
+            await self._conn(kube).apply(ReplicaPlan(prefill=0, decode=5))
+
+    async def test_aggregated_pool_sizes_to_max_single_write(self):
+        """prefill_service == decode_service: ONE adapter write sized to
+        max(prefill, decode) — the second pool's write must never clobber
+        the first."""
+        kube = FakeKube()
+        conn = self._conn(kube, prefill_service="all", decode_service="all")
+        await conn.apply(ReplicaPlan(prefill=5, decode=3))
+        writes = [c for c in kube.calls if c[1] == "graph-all"]
+        assert writes == [("patch", "graph-all", 5)]
+        assert conn.applied == {"prefill": 5, "decode": 3}
+
+
+async def test_virtual_connector_round_trip():
+    disco = MemoryDiscovery()
+    conn = VirtualConnector(disco, "nsx")
+    await conn.apply(ReplicaPlan(prefill=2, decode=7, reason="why"))
+    doc = await conn.read_desired()
+    assert doc["prefill"] == 2 and doc["decode"] == 7
+    assert doc["reason"] == "why"
+    assert await disco.get(planner_key("nsx")) == doc
+    # Second apply overwrites (latest plan wins).
+    await conn.apply(ReplicaPlan(prefill=1, decode=4))
+    assert (await conn.read_desired())["decode"] == 4

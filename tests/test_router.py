@@ -469,3 +469,129 @@ class TestResync:
             await pub.close()
             await eng.stop()
             await rt.shutdown(grace_period=1)
+
+
+class TestCandidatePruning:
+    """Fleet-scale select_worker (ISSUE 13): above prune_threshold the
+    scheduler scores a pruned candidate set (specials + a bounded
+    branch-and-bound walk over the static rank) instead of every worker.
+    Under sparse in-flight charges the choice is EXACTLY the full scan's;
+    the per-request scored-candidate count must not grow with the fleet."""
+
+    def _mk(self, n_workers, *, prune=True, seed=3):
+        cfg = KvRouterConfig() if prune else KvRouterConfig(prune_threshold=0)
+        sched = KvScheduler(cfg, seed=seed)
+        return sched
+
+    def _feed(self, sched, rng, n_workers):
+        """Randomized fleet state: loads, queue depths, some draining /
+        busy / saturated workers, a couple of measured links."""
+        for wid in range(1, n_workers + 1):
+            roll = rng.random()
+            sched.update_load(LoadSnapshot(
+                worker_id=wid,
+                active_blocks=rng.randrange(0, 180),
+                total_blocks=200,
+                queue_depth=rng.randrange(0, 4),
+                draining=roll < 0.05,
+                kv_high_watermark=0.9 if roll > 0.93 else 1.0,
+            ))
+        # A measured (slow) link + an open breaker on two random dsts.
+        sched.link_costs.observe(7, (rng.randrange(1, n_workers + 1), 0), 5e5)
+        sched.link_costs.set_fault(7, (rng.randrange(1, n_workers + 1), 0), True)
+
+    def test_pruned_matches_full_scan_randomized(self):
+        import random as _random
+
+        from dynamo_tpu.router.scheduler import TransferContext
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        for trial in range(30):
+            rng_a = _random.Random(1000 + trial)
+            rng_b = _random.Random(1000 + trial)
+            n = 80
+            pruned = self._mk(n)
+            full = self._mk(n, prune=False)
+            self._feed(pruned, rng_a, n)
+            self._feed(full, rng_b, n)
+            candidates = [(wid, 0) for wid in range(1, n + 1)]
+            rng = _random.Random(2000 + trial)
+            for step in range(12):
+                overlaps = OverlapScores(scores={
+                    (rng.randrange(1, n + 1), 0): rng.randrange(1, 12)
+                    for _ in range(rng.randrange(0, 5))
+                })
+                transfer = (
+                    TransferContext(src=7, bytes_per_block=65536)
+                    if rng.random() < 0.5 else None
+                )
+                blocks = rng.randrange(1, 24)
+                a = pruned.select_worker(
+                    blocks, overlaps, candidates, transfer=transfer
+                )
+                b = full.select_worker(
+                    blocks, overlaps, candidates, transfer=transfer
+                )
+                assert a == b, (trial, step, a, b)
+                # Keep charges SPARSE (the exactness regime): release
+                # most charges right away, as completed streams would.
+                if rng.random() < 0.8 and a is not None:
+                    pruned.complete_request(a, blocks)
+                    full.complete_request(b, blocks)
+            assert pruned.logit_evals < full.logit_evals
+
+    def test_pruned_cost_is_constant_in_fleet_size(self):
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        import random as _random
+
+        counts = {}
+        for n in (50, 200):
+            sched = self._mk(n)
+            rng = _random.Random(9)
+            self._feed(sched, rng, n)
+            candidates = [(wid, 0) for wid in range(1, n + 1)]
+            for _ in range(100):
+                sched.select_worker(10, OverlapScores(), candidates)
+            counts[n] = sched.logit_evals / sched.selections
+        # 4x the fleet, same per-request scoring work (walk cap + specials).
+        assert counts[200] <= counts[50] + 1, counts
+
+    def test_pruned_falls_back_when_no_eligible_candidate(self):
+        """All-draining fleet: the pruned path defers to the full scan's
+        fallback tiers (least-loaded draining worker still chosen)."""
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        n = 40
+        sched = self._mk(n)
+        for wid in range(1, n + 1):
+            sched.update_load(LoadSnapshot(
+                worker_id=wid, active_blocks=wid, total_blocks=200,
+                draining=True,
+            ))
+        w = sched.select_worker(
+            10, OverlapScores(), [(wid, 0) for wid in range(1, n + 1)]
+        )
+        assert w == (1, 0)  # least loaded despite everyone draining
+
+    def test_rank_tracks_reports_and_drops(self):
+        from dynamo_tpu.tokens.radix import OverlapScores
+
+        n = 40
+        sched = self._mk(n)
+        for wid in range(1, n + 1):
+            sched.update_load(LoadSnapshot(
+                worker_id=wid, active_blocks=wid * 2, total_blocks=400,
+            ))
+        candidates = [(wid, 0) for wid in range(1, n + 1)]
+        assert sched.select_worker(10, OverlapScores(), candidates) == (1, 0)
+        # Worker 1 reports heavy + worker 2 crashes (dropped AND evicted
+        # from the candidate list, as the liveness fan-out does): the
+        # rank cache must follow both.
+        sched.update_load(LoadSnapshot(
+            worker_id=1, active_blocks=399, total_blocks=400,
+        ))
+        sched.drop_worker((2, 0))
+        candidates = [c for c in candidates if c != (2, 0)]
+        w = sched.select_worker(10, OverlapScores(), candidates)
+        assert w == (3, 0)
