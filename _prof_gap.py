@@ -149,11 +149,37 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
         fr.record("dispatch", nb=8, occupancy=4, inflight=2)
     record_us = (_time.perf_counter() - t0) / N * 1e6
 
-    per_burst_us = watch_us + 4 * record_us + stats_publish_us
+    # Trajectory plane (runtime/trajectory.py): a traced request pays 3
+    # retrospective export_span calls at STREAM END (queue/prefill/decode
+    # — ring append + shipper enqueue via the tracer listener), never
+    # inside the tick. Charged per burst at the worst case of one request
+    # finishing every burst, so the <1% bar covers the trajectory delta.
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.utils.tracing import Tracer, export_span
+
+    tracer = Tracer(path="", otlp=False)  # never ship synthetic spans
+    listened = []
+    tracer.add_listener(lambda s: listened.append(1))  # shipper-shaped tap
+    ctx = Context(baggage={"traceparent": "00-" + "a" * 32 + "-" + "b" * 16 + "-01"})
+    t0 = _time.perf_counter()
+    M = N // 4
+    for i in range(M):
+        export_span(
+            "engine.decode", ctx, start_mono=0.0, end_mono=0.001,
+            tracer=tracer, generated=8,
+        )
+    span_us = (_time.perf_counter() - t0) / M * 1e6
+    trajectory_request_us = 3 * span_us
+
+    per_burst_us = (
+        watch_us + 4 * record_us + stats_publish_us + trajectory_request_us
+    )
     return {
         "watched_dispatch_us": round(watch_us, 3),
         "flight_record_us": round(record_us, 3),
         "stats_publish_us": round(stats_publish_us, 3),
+        "trajectory_span_us": round(span_us, 3),
+        "trajectory_request_us": round(trajectory_request_us, 3),
         "per_burst_us": round(per_burst_us, 3),
         "overhead_pct_of_burst": round(
             100 * per_burst_us / 1000 / max(wall_per_burst_ms, 1e-9), 4

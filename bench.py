@@ -164,6 +164,64 @@ def _fault_plane_record(activity_before: dict) -> dict:
     }
 
 
+def _trajectory_start() -> dict:
+    """Snapshot the trajectory plane's counters before a leg (the SLO
+    verdicts + span ingest deltas the zero-spurious record reads)."""
+    from dynamo_tpu.runtime.trajectory import global_store
+
+    store = global_store()
+    return {
+        "spans": store.spans_ingested,
+        "dropped": store.spans_dropped,
+        "good": store.slo.good_streams,
+        "breached": store.slo.breached_streams,
+    }
+
+
+def _trajectory_record(before: dict) -> dict:
+    """Trajectory/SLO record for one leg: goodput + multi-window burn rate
+    + per-phase p99 contribution from the process-global SloTracker, span
+    ingest/drop deltas (bench legs drive engines with traceless contexts,
+    so a nonzero span delta here is trajectory machinery activating
+    SPURIOUSLY on the hot path — same contract as fault_plane), and the
+    measured per-span export cost (the trajectory-overhead delta the <1%
+    observe bar covers, see _prof_gap.py)."""
+    import time as _time
+
+    from dynamo_tpu.runtime.context import Context as _Ctx
+    from dynamo_tpu.runtime.trajectory import global_store
+    from dynamo_tpu.utils.tracing import Tracer as _Tracer
+    from dynamo_tpu.utils.tracing import export_span as _export_span
+
+    store = global_store()
+    slo = store.slo.snapshot()
+    tracer = _Tracer(path="", otlp=False)  # never ship synthetic spans
+    ctx = _Ctx(
+        baggage={"traceparent": "00-" + "a" * 32 + "-" + "b" * 16 + "-01"}
+    )
+    n = 2000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        _export_span(
+            "engine.decode", ctx, start_mono=0.0, end_mono=0.001,
+            tracer=tracer, generated=8,
+        )
+    span_us = (_time.perf_counter() - t0) / n * 1e6
+    return {
+        "spans_ingested": store.spans_ingested - before["spans"],
+        "spans_dropped": store.spans_dropped - before["dropped"],
+        "good_streams": store.slo.good_streams - before["good"],
+        "breached_streams": store.slo.breached_streams - before["breached"],
+        "goodput": slo["goodput"],
+        "burn_rate": slo["burn_rate"],
+        "phase_p99_ms": slo["phase_p99_ms"],
+        "trajectory_span_us": round(span_us, 3),
+        # 3 retrospective phase spans per traced request, all at stream
+        # end — the whole trajectory delta a served request pays.
+        "trajectory_request_us": round(3 * span_us, 3),
+    }
+
+
 async def run_leg(model_name: str, quant: str | None, spec: str | None,
                   concurrency: int | None = None, requests: int | None = None,
                   kv_quant: str | None = None, isl: int | None = None,
@@ -190,6 +248,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     # BEFORE the leg's engine exists (its programs compile during warmup).
     compile_before = global_compile_watcher().totals()
     fault_activity0 = _fault_activity_start()
+    trajectory0 = _trajectory_start()
 
     cfg = {
         "qwen2.5-0.5b": qwen2_500m_config,
@@ -399,6 +458,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         "mfu": round(toks_per_sec * flops_per_tok / V5E_PEAK_BF16, 4),
         "hbm_util": round(toks_per_sec / roofline, 4),
         "fault_plane": _fault_plane_record(fault_activity0),
+        "trajectory": _trajectory_record(trajectory0),
         **(
             {
                 "spec_proposed": stats.get("spec_proposed", 0),

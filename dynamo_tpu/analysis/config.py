@@ -154,6 +154,10 @@ class RingWriterConfig:
             # scale actuations, drains; single writer: the planner's
             # event loop.
             "planner": ("planner/elastic.py", "ElasticController"),
+            # Trajectory plane (PR 13): span/event ingest + slow-capture
+            # history; single writer: the frontend's event loop
+            # (collector pump + local tracer listener).
+            "trajectory": ("runtime/trajectory.py", "TrajectoryStore"),
         }
     )
 

@@ -182,7 +182,14 @@ async def run_batch(pipeline, model: str, args, batch_path: str) -> None:
 async def run_http(pipeline, card: ModelDeploymentCard, args) -> None:
     """Single-process OpenAI server over the local pipeline (in=http)."""
     from dynamo_tpu.http import HttpService, ModelManager
+    from dynamo_tpu.runtime.trajectory import global_store
+    from dynamo_tpu.utils.tracing import set_service
 
+    # Trajectory plane, dev-mode wiring: attach the store to the tracer
+    # BEFORE the first request so /debug/trajectory sees every span (the
+    # worker/frontend mains do the same eagerly).
+    set_service("dev-http")
+    global_store()
     manager = ModelManager()
     manager.register(card.name, pipeline, card)
     service = HttpService(manager, host="0.0.0.0", port=args.http_port)
@@ -198,6 +205,16 @@ async def run_http(pipeline, card: ModelDeploymentCard, args) -> None:
 
 
 def add_observe_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "what", nargs="?", default=None, choices=[None, "trajectory"],
+        help="optional sub-view: 'trajectory' pretty-prints one stitched "
+        "request trajectory (GET /debug/trajectory/{trace_id})",
+    )
+    parser.add_argument(
+        "trace_id", nargs="?", default=None,
+        help="trace id for the trajectory sub-view (omit to list "
+        "recent + slow trajectories)",
+    )
     parser.add_argument("--host", default="127.0.0.1",
                         help="system-server host of the running worker")
     parser.add_argument("--port", type=int, default=None,
@@ -280,6 +297,105 @@ def _fmt_bytes(n) -> str:
     return f"{sign}{n:.1f} TiB"
 
 
+async def main_observe_trajectory(args) -> None:
+    """Pretty-print one stitched request trajectory (or the recent/slow
+    index): phases, per-hop spans across processes, retries, skew flags,
+    and the dominant phase — 'why was THIS request slow' in one command."""
+    import aiohttp
+
+    from dynamo_tpu import config
+
+    port = args.port if args.port is not None else config.SYSTEM_PORT.get()
+    base = f"http://{args.host}:{port}"
+    path = (
+        f"/debug/trajectory/{args.trace_id}"
+        if args.trace_id else "/debug/trajectory"
+    )
+    async with aiohttp.ClientSession() as session:
+        try:
+            async with session.get(base + path) as r:
+                if r.status != 200:
+                    raise SystemExit(
+                        f"GET {base}{path} -> {r.status}: {await r.text()}"
+                    )
+                doc = await r.json()
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"cannot reach system server at {base}: {exc}")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    if not args.trace_id:
+        print(f"== trajectories ({base}{path})")
+        for row in doc.get("traces") or []:
+            print(
+                f"  {row['trace_id']}  {row['total_ms']:>9.1f} ms  "
+                f"dominant={row['dominant_phase']:<13} "
+                f"procs={len(row['processes'])} spans={row['span_count']}"
+                f"{'  SKEW' if row.get('skew_flagged') else ''}"
+            )
+        slow = doc.get("slow") or []
+        if slow:
+            print("  -- slow/error ring --")
+            for row in slow:
+                print(
+                    f"  {row['trace_id']}  {row['total_ms']:>9.1f} ms  "
+                    f"dominant={row['dominant_phase']} "
+                    f"[{row.get('retained', 'slow')}]"
+                )
+        return
+    print(f"== trajectory {doc.get('trace_id')} ({base}{path})")
+    print(
+        f"  total {doc.get('total_ms', 0):.1f} ms across "
+        f"{len(doc.get('processes') or [])} processes "
+        f"({', '.join(doc.get('processes') or [])})"
+        f"{'  [residual clock skew flagged]' if doc.get('skew_flagged') else ''}"
+    )
+    phases = doc.get("phases") or {}
+    print("  phases:")
+    for phase, ms in phases.items():
+        marker = "  <- dominant" if phase == doc.get("dominant_phase") else ""
+        print(f"    {phase:<14} {ms:>9.1f} ms{marker}")
+    if doc.get("summary"):
+        # Slow-ring hit: the full span set aged out of the recent ring;
+        # the retained summary still names the bottleneck.
+        print(
+            f"  (summary only — {doc.get('span_count', 0)} spans aged out "
+            "of the recent ring)"
+        )
+        return
+    print("  spans:")
+    for s in doc.get("spans") or []:
+        attrs = s.get("attributes") or {}
+        detail = " ".join(
+            f"{k}={v}" for k, v in attrs.items()
+            if k in ("worker", "src", "peer", "attempts", "retries",
+                     "overlap_blocks", "candidates_scored", "queued_s",
+                     "outcome", "adopted", "model")
+        )
+        flags = []
+        if s.get("skew_flagged"):
+            flags.append(f"skew={s.get('skew_ms')}ms")
+        if str(s.get("status", "ok")) != "ok":
+            flags.append(str(s["status"]))
+        print(
+            f"    {s.get('offset_ms', 0):>9.1f} +{s.get('duration_ms', 0):>8.1f} ms"
+            f"  [{s.get('proc', '?'):<16}] {s.get('name', '?'):<22} "
+            f"{detail}{('  ' + ' '.join(flags)) if flags else ''}"
+        )
+    events = doc.get("events") or []
+    if events:
+        print("  events:")
+        for ev in events:
+            detail = " ".join(
+                f"{k}={v}" for k, v in ev.items()
+                if k not in ("trace_id", "ring", "kind", "t_wall", "offset_ms")
+            )
+            print(
+                f"    {ev.get('offset_ms', 0):>9.1f} ms  "
+                f"{ev.get('ring', '?')}/{ev.get('kind', '?')} {detail}"
+            )
+
+
 async def main_observe(args) -> None:
     """One-shot pretty snapshot of /debug/memory, /debug/compiles and
     /debug/flight from a running worker's system server — the operator's
@@ -287,6 +403,10 @@ async def main_observe(args) -> None:
     import aiohttp
 
     from dynamo_tpu import config
+
+    if getattr(args, "what", None) == "trajectory":
+        await main_observe_trajectory(args)
+        return
 
     port = args.port if args.port is not None else config.SYSTEM_PORT.get()
     base = f"http://{args.host}:{port}"

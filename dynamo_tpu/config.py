@@ -142,6 +142,31 @@ OVERLOAD_BROWNOUT_MAX_TOKENS = env_int(
     "DYN_TPU_OVERLOAD_BROWNOUT_MAX_TOKENS", 256,
     "max_tokens clamp applied while browned out",
 )
+# -- trajectory plane (runtime/trajectory.py; docs/design_docs/request_trajectory.md)
+TRAJECTORY_RECENT = env_int(
+    "DYN_TPU_TRAJECTORY_RECENT", 256,
+    "Recent request trajectories retained for GET /debug/trajectory",
+)
+TRAJECTORY_SLOW = env_int(
+    "DYN_TPU_TRAJECTORY_SLOW", 64,
+    "Slow/errored trajectory summaries retained past recent-ring eviction",
+)
+TRAJECTORY_SHIP_INTERVAL_S = env_float(
+    "DYN_TPU_TRAJECTORY_SHIP_S", 0.5,
+    "Worker-side finished-span batch flush cadence onto the event plane",
+)
+SLO_TTFT_MS = env_float(
+    "DYN_TPU_SLO_TTFT_MS", 0.0,
+    "TTFT SLA for the goodput/burn-rate gauges (0 = SLO tracking off)",
+)
+SLO_ITL_MS = env_float(
+    "DYN_TPU_SLO_ITL_MS", 0.0,
+    "Mean-ITL SLA for the goodput/burn-rate gauges (0 = SLO tracking off)",
+)
+SLO_TARGET = env_float(
+    "DYN_TPU_SLO_TARGET", 0.99,
+    "SLO target the burn-rate denominates against (error budget = 1 - target)",
+)
 # -- crash plane (runtime/liveness.py; docs/design_docs/fault_tolerance.md)
 LOAD_REPORT_INTERVAL_S = env_float(
     "DYN_TPU_LOAD_REPORT_INTERVAL_S", 1.0,

@@ -31,7 +31,7 @@ from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     PreprocessedRequest,
 )
-from dynamo_tpu.runtime import fault_names, lifecycle
+from dynamo_tpu.runtime import fault_names, lifecycle, trajectory
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.device_observe import FlightRecorder
 from dynamo_tpu.runtime.faults import fault_point, note_activity
@@ -42,6 +42,7 @@ from dynamo_tpu.runtime.liveness import (
 )
 from dynamo_tpu.tokens.blocks import compute_block_hashes
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tracing import export_span
 
 logger = get_logger(__name__)
 
@@ -734,6 +735,10 @@ class DecodeHandler:
         if not self.transfer_first_start:
             self.transfer_first_start = t0
         self.flight.record("pull_start", src=src, blocks=len(hashes))
+        # Trajectory span events: each retry/terminal failure is stamped
+        # onto the pull span so the stitched view shows WHERE the
+        # kv_transfer phase's time went (attempt boundaries, error kinds).
+        span_events: List[Dict[str, Any]] = []
         # Per-PULL progress, mutated inside _pull_once so a raising
         # attempt's partial imports survive, and isolated from concurrent
         # pulls (which share self.bytes_pulled).
@@ -799,6 +804,13 @@ class DecodeHandler:
                 self.pull_retries += 1
                 self.metrics.pull_retries.inc()
                 note_activity("pull_retries")
+                span_events.append({
+                    "name": f"retry:{kind}", "time_s": time.time(),
+                })
+                trajectory.note_event(
+                    trace_id, "disagg", "pull_retry",
+                    src=src, attempt=attempt, error_kind=kind,
+                )
                 delay = min(
                     self.backoff_base_s * 2 ** (attempt - 1),
                     PULL_BACKOFF_CAP_S,
@@ -823,11 +835,26 @@ class DecodeHandler:
         # Exemplar: a transfer-latency spike on a dashboard resolves to the
         # trace (and thus the /debug/requests timeline) that caused it.
         self.metrics.transfer_duration.observe(now - t0, trace_id=trace_id)
+        pull_ok = last_error is None or self._first_missing(hashes) is None
         self.flight.record(
             "pull_done", src=src, blocks=acct["blocks"],
-            bytes=acct["bytes"], attempts=attempt,
-            ok=last_error is None or self._first_missing(hashes) is None,
+            bytes=acct["bytes"], attempts=attempt, ok=pull_ok,
         )
+        if trace_id:
+            # Trajectory kv_transfer phase span: the whole pull — retries
+            # and backoff included — attributed in the stitched view.
+            export_span(
+                "disagg.pull", context,
+                start_mono=t0, end_mono=now,
+                proc=(
+                    f"worker-{self.worker_id:#x}"
+                    if isinstance(self.worker_id, int) else None
+                ),
+                status="ok" if pull_ok else "error: pull_failed",
+                events=span_events,
+                src=src, blocks=acct["blocks"], bytes=acct["bytes"],
+                attempts=attempt, retries=attempt - 1,
+            )
         if last_error is not None and self._first_missing(hashes) is not None:
             # Terminal failure: the chain is still incomplete.
             self.pull_fallbacks += 1

@@ -263,6 +263,7 @@ class Admitter:
         prompts = [seq.all_tokens for seq, _ in batch]
         pos = [prep.matched_tokens for _, prep in batch]
         for seq, prep in batch:
+            seq.t_prefill_start = time.monotonic()
             lifecycle.record(
                 seq.request.request_id, "prefill_start",
                 context=seq.context,

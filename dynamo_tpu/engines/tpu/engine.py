@@ -176,6 +176,15 @@ class _Sequence:
     # detached from its slot (= len(all_tokens) - 1 at the reconciled
     # boundary); also the resume position an adopted sequence installs at.
     detach_pos: int = -1
+    # Trajectory-plane phase boundaries (time.monotonic stamps; 0 = never
+    # reached). Stamped OUTSIDE the decode tick — at enqueue, admission,
+    # first streamed output, and detach — and folded into retrospective
+    # engine.queue/prefill/decode spans when the stream ends, so the hot
+    # loop itself never touches span machinery.
+    t_enqueue: float = 0.0
+    t_prefill_start: float = 0.0
+    t_first_out: float = 0.0
+    t_detached: float = 0.0
 
 
 @dataclass
@@ -385,6 +394,10 @@ class JaxEngine:
         # - hbm: structural byte ledger over live device state, sampled at
         #   scrape/snapshot time only (never on the tick path).
         self.flight = FlightRecorder("engine")
+        # Trajectory-plane clock-domain label for this engine's phase
+        # spans; None = the process service label (worker mains set it,
+        # multi-engine test harnesses give each engine its own).
+        self.trace_proc: Optional[str] = None
         runner = self.runner
         self.hbm = HbmLedger()
         self.hbm.register(
@@ -718,10 +731,56 @@ class JaxEngine:
             salt=self._next_salt,
         )
         self._next_salt = (self._next_salt + 1) & 0x7FFFFFFF
+        seq.t_enqueue = time.monotonic()
         self._waiting.append(seq)
         self._wake.set()
-        async for out in self._stream_outputs(seq):
-            yield out
+        try:
+            async for out in self._stream_outputs(seq):
+                if seq.t_first_out == 0.0 and out.token_ids:
+                    seq.t_first_out = time.monotonic()
+                yield out
+        finally:
+            self._export_phase_spans(seq)
+
+    def _export_phase_spans(self, seq: _Sequence) -> None:
+        """Retrospective engine.queue / engine.prefill / engine.decode
+        spans for one finished stream (trajectory plane). Built once per
+        request from the monotonic stamps the serving path already took —
+        nothing here runs inside the decode tick, and requests outside any
+        trace cost one dict lookup."""
+        if not seq.context.baggage.get("traceparent"):
+            return
+        try:
+            from dynamo_tpu.utils.tracing import export_span
+
+            proc = getattr(self, "trace_proc", None)
+            end = time.monotonic()
+            t_admit = seq.t_prefill_start or seq.t_first_out or end
+            export_span(
+                "engine.queue", seq.context,
+                start_mono=seq.t_enqueue or t_admit, end_mono=t_admit,
+                proc=proc,
+            )
+            if seq.t_prefill_start:
+                export_span(
+                    "engine.prefill", seq.context,
+                    start_mono=seq.t_prefill_start,
+                    end_mono=seq.t_first_out or end,
+                    proc=proc, prompt_tokens=len(seq.prompt),
+                )
+            if seq.t_first_out:
+                # A handed-off stream's decode ends at detach — the relay
+                # gap is the drain plane's handoff_stall, and the peer's
+                # own decode span covers the continuation.
+                export_span(
+                    "engine.decode", seq.context,
+                    start_mono=seq.t_first_out,
+                    end_mono=seq.t_detached or end,
+                    proc=proc, generated=len(seq.generated),
+                    handed_off=bool(seq.t_detached) or None,
+                )
+        except Exception:
+            logger.debug("phase-span export failed", exc_info=True)
 
     async def _stream_outputs(
         self, seq: _Sequence
@@ -1701,6 +1760,7 @@ class JaxEngine:
                 continue
             slot = seq.slot
             seq.detach_pos = int(self._pos[slot])
+            seq.t_detached = time.monotonic()
             self._slots[slot] = None
             self._pos[slot] = 0
             self._tok_mirror[slot] = 0
@@ -1936,11 +1996,32 @@ class JaxEngine:
             pos=seq.detach_pos,
         )
 
-    def stream_adopted(
+    async def stream_adopted(
         self, seq: _Sequence
     ) -> AsyncIterator[BackendOutput]:
-        """Continuation outputs of an adopted sequence (handoff handler)."""
-        return self._stream_outputs(seq)
+        """Continuation outputs of an adopted sequence (handoff handler).
+        The adopted portion gets its own engine.decode span (the peer's
+        share of the trajectory; the source's decode span ended at
+        detach)."""
+        t0 = time.monotonic()
+        try:
+            async for out in self._stream_outputs(seq):
+                yield out
+        finally:
+            if seq.context.baggage.get("traceparent"):
+                try:
+                    from dynamo_tpu.utils.tracing import export_span
+
+                    export_span(
+                        "engine.decode", seq.context, start_mono=t0,
+                        proc=getattr(self, "trace_proc", None),
+                        adopted=True, generated=len(seq.generated),
+                    )
+                except Exception:
+                    logger.debug(
+                        "adopted phase-span export failed", exc_info=True
+                    )
+
 
     # -- checkpoint / restore (the chrek/CRIU fast-cold-start role) --------
     # Logic lives in engines/tpu/kv_checkpoint.py; these stay as the

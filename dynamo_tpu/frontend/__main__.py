@@ -47,6 +47,17 @@ async def main() -> None:
 
     configure_logging()
     runtime = DistributedRuntime.from_settings()
+    # Trajectory plane: label this process's spans and collect the fleet's
+    # shipped spans into the process-global store (the store auto-attaches
+    # to the global tracer, so the frontend's own spans land there too).
+    from dynamo_tpu.runtime.trajectory import TrajectoryCollector
+    from dynamo_tpu.utils.tracing import set_service
+
+    set_service("frontend")
+    trajectory = TrajectoryCollector(
+        runtime.event_plane, config.NAMESPACE.get()
+    )
+    await trajectory.start()
     manager = ModelManager()
     mode = {
         "kv": RouterMode.KV,
@@ -83,6 +94,7 @@ async def main() -> None:
     finally:
         await service.stop(grace_period=config.GRACE_PERIOD.get())
         await watcher.stop()
+        await trajectory.stop()
         await runtime.shutdown(grace_period=config.GRACE_PERIOD.get())
 
 

@@ -110,6 +110,11 @@ class RequestTimer:
         self._done = False
         self._request_id: Optional[str] = None
         self._trace_id: Optional[str] = None
+        # SLO inputs (runtime/trajectory.py SloTracker): the stream's TTFT
+        # and summed ITL deltas, judged once at done().
+        self._ttft_s: Optional[float] = None
+        self._itl_sum = 0.0
+        self._itl_n = 0
         # Optional tap on the same deltas the ITL histogram observes —
         # the overload controller's brownout machine reads its p50 SLA
         # signal here (runtime/overload.py observe_itl).
@@ -135,6 +140,7 @@ class RequestTimer:
     def on_token(self, count: int = 1) -> None:
         now = time.monotonic()
         if self._last_token is None:
+            self._ttft_s = now - self._start
             self._m.ttft.labels(self._model).observe(
                 now - self._start, exemplar=self._exemplar()
             )
@@ -147,6 +153,8 @@ class RequestTimer:
                     ttft_ms=round((now - self._start) * 1000, 3),
                 )
         else:
+            self._itl_sum += now - self._last_token
+            self._itl_n += 1
             self._m.itl.labels(self._model).observe(now - self._last_token)
             if self._itl_observer is not None:
                 self._itl_observer(now - self._last_token)
@@ -176,4 +184,24 @@ class RequestTimer:
             lifecycle.record(
                 self._request_id, "done",
                 trace_id=self._trace_id, status=status,
+            )
+        if status != 499 and (self._ttft_s is not None or status >= 429):
+            # SLO verdict (no-op while no SLA is configured): one stream,
+            # did TTFT and mean ITL land inside the SLA. Token-less
+            # failures count too — sheds (429/503/504) and errors never
+            # met the SLA, and skipping them would leave goodput reading
+            # 1.0 through a total outage. Token-less 2xx (embeddings,
+            # unary helpers) stay out: they have no latency SLA. Client
+            # aborts (499) stay out entirely — a user walking away says
+            # nothing about the server's SLA, and counting them would
+            # burn error budget during perfectly healthy serving.
+            from dynamo_tpu.runtime.trajectory import global_slo
+
+            global_slo().note_stream(
+                self._trace_id,
+                ttft_s=self._ttft_s,
+                mean_itl_s=(
+                    self._itl_sum / self._itl_n if self._itl_n else None
+                ),
+                status=status,
             )

@@ -139,6 +139,10 @@ class HttpService:
         app.router.add_post("/v1/images/generations", self._images)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
         app.router.add_get("/debug/overload", self._debug_overload)
+        app.router.add_get("/debug/trajectory", self._debug_trajectories)
+        app.router.add_get(
+            "/debug/trajectory/{trace_id}", self._debug_trajectory
+        )
         app.router.add_get("/openapi.json", self._openapi)
         return app
 
@@ -177,18 +181,24 @@ class HttpService:
         openmetrics = "application/openmetrics-text" in request.headers.get(
             "Accept", ""
         )
+        from dynamo_tpu.runtime.trajectory import render_trajectory_metrics
+
         if openmetrics:
             # OpenMetrics exposition carries trace-id exemplars on the TTFT
             # and request-duration histograms (see http/metrics.py).
             body = self.metrics.render(openmetrics=True)
+            # Splice the overload + SLO families in BEFORE the # EOF
+            # terminator prometheus_client already appended.
+            extra = render_trajectory_metrics(openmetrics=True)
             if self.overload is not None:
-                # Splice the overload families in BEFORE the # EOF
-                # terminator prometheus_client already appended.
-                extra = self.overload.metrics.render(openmetrics=True)
-                stripped = body.rstrip()
-                if stripped.endswith(b"# EOF"):
-                    stripped = stripped[: -len(b"# EOF")].rstrip()
-                body = stripped + b"\n" + extra.encode() + b"\n# EOF\n"
+                extra = (
+                    self.overload.metrics.render(openmetrics=True)
+                    + "\n" + extra
+                )
+            stripped = body.rstrip()
+            if stripped.endswith(b"# EOF"):
+                stripped = stripped[: -len(b"# EOF")].rstrip()
+            body = stripped + b"\n" + extra.encode() + b"\n# EOF\n"
             return web.Response(
                 body=body, content_type="application/openmetrics-text",
             )
@@ -197,10 +207,31 @@ class HttpService:
             # The frontend's controller is the one that actually admits
             # and sheds — its families must be on THIS scrape surface.
             body = body + self.overload.metrics.render().encode() + b"\n"
+        # SLO plane (ALL_SLO): goodput/burn-rate/phase gauges are fed by
+        # THIS process's finished streams — they belong on this scrape.
+        body = body + render_trajectory_metrics().encode() + b"\n"
         return web.Response(body=body, content_type="text/plain")
 
     async def _models_route(self, request: web.Request) -> web.Response:
         return web.json_response(model_list(self.models.openai_model_list()))
+
+    async def _debug_trajectories(self, request: web.Request) -> web.Response:
+        """Fleet trajectory index (the frontend has no system server; same
+        body as runtime/system_server.py's route — one shared helper)."""
+        from dynamo_tpu.runtime.trajectory import trajectory_index
+
+        return web.json_response(trajectory_index())
+
+    async def _debug_trajectory(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.trajectory import trajectory_view
+
+        tid = request.match_info["trace_id"]
+        stitched = trajectory_view(tid)
+        if stitched is None:
+            return web.json_response(
+                {"error": f"no trajectory for trace {tid!r}"}, status=404
+            )
+        return web.json_response(stitched)
 
     async def _debug_overload(self, request: web.Request) -> web.Response:
         """Overload-plane snapshot + the 'overload' flight ring (the
@@ -590,6 +621,8 @@ class HttpService:
                 "post": op("Get/set one model's busy thresholds", body=True),
             },
             "/clear_kv_blocks": {"post": op("Flush worker KV prefix caches", body=True)},
+            "/debug/trajectory": {"get": op("Fleet trajectory index (recent + slow/error, SLO snapshot)")},
+            "/debug/trajectory/{trace_id}": {"get": op("One stitched cross-worker request trajectory")},
         }
         return web.json_response(
             {
@@ -755,28 +788,14 @@ class HttpService:
         if traceparent:
             baggage["traceparent"] = traceparent
         ctx = Context(baggage=baggage, deadline=deadline)
-        ticket: Optional[AdmissionTicket] = None
-        if self.overload is not None:
-            self.overload.apply_default_deadline(ctx)
-            try:
-                ticket = await self.overload.admit(ctx)
-            except OverloadShedError as exc:
-                timer.done(exc.status)
-                return _shed_response(exc)
         from dynamo_tpu.utils.tracing import span
 
+        ticket: Optional[AdmissionTicket] = None
         ok = False
         try:
-            if self.overload is not None:
-                # Brownout output clamp: under pressure nobody gets an
-                # unbounded completion (no-op while healthy). Inside the
-                # try so NOTHING between admit and release can leak the
-                # admission slot.
-                clamped = self.overload.clamp_max_tokens(
-                    body.get("max_tokens")
-                )
-                if clamped is not None and clamped != body.get("max_tokens"):
-                    body["max_tokens"] = clamped
+            # Root span opens BEFORE admission so the overload queue wait
+            # is a child span inside the trace (the trajectory plane's
+            # "queue" phase) instead of invisible pre-trace time.
             with self.tracker.guard(), span(
                 f"http.{endpoint}", ctx, model=model, stream=stream
             ):
@@ -784,6 +803,22 @@ class HttpService:
                 # baggage: binding here gives the timer (exemplars) and the
                 # lifecycle timeline the request's trace id.
                 timer.bind_context(ctx)
+                if self.overload is not None:
+                    self.overload.apply_default_deadline(ctx)
+                    with span("overload.queue", ctx) as qsp:
+                        ticket = await self.overload.admit(ctx)
+                        qsp.attributes["queued_s"] = round(
+                            ticket.queue_delay_s, 4
+                        )
+                    # Brownout output clamp: under pressure nobody gets an
+                    # unbounded completion (no-op while healthy). Inside
+                    # the try so NOTHING between admit and release can
+                    # leak the admission slot.
+                    clamped = self.overload.clamp_max_tokens(
+                        body.get("max_tokens")
+                    )
+                    if clamped is not None and clamped != body.get("max_tokens"):
+                        body["max_tokens"] = clamped
                 if stream:
                     resp = await self._stream_response(
                         request, body, entry, ctx, kind, timer
@@ -794,6 +829,9 @@ class HttpService:
                     )
                 ok = True
                 return resp
+        except OverloadShedError as exc:
+            timer.done(exc.status)
+            return _shed_response(exc)
         except OpenAIError as exc:
             timer.done(exc.status)
             return _error_response(exc)

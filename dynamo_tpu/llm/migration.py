@@ -179,11 +179,20 @@ class Migration:
         generated: List[int] = []
         migrations = 0
         reprefilled = 0  # total tokens re-prefilled by migrations so far
+        # Trajectory handoff_stall accounting: a re-dispatch's stall runs
+        # from the failure to the first item the NEW worker streams.
+        stall_from: Optional[float] = None
+        stall_reason = ""
 
         while True:
             finished = False
             try:
                 async for item in next.generate(_as_wire(request, req), context):
+                    if stall_from is not None:
+                        self._export_redispatch_span(
+                            context, stall_from, stall_reason, migrations
+                        )
+                        stall_from = None
                     tokens = _tokens_of(item)
                     if tokens:
                         generated.extend(tokens)
@@ -250,9 +259,31 @@ class Migration:
                     req.request_id, migrations, self.migration_limit,
                     reason, exc, len(generated),
                 )
+                if stall_from is None:
+                    import time as _time
+
+                    stall_from = _time.monotonic()
+                    stall_reason = reason
                 req = _carry_tokens(req, generated)
                 generated = []  # now embedded in the prompt; don't carry twice
                 request = req  # from now on send the rebuilt request
+
+    def _export_redispatch_span(
+        self, context: Context, start_mono: float, reason: str, attempt: int,
+    ) -> None:
+        """Trajectory handoff_stall span for one migration re-dispatch:
+        stream death → first token from the new worker."""
+        if not context.baggage.get("traceparent"):
+            return
+        try:
+            from dynamo_tpu.utils.tracing import export_span
+
+            export_span(
+                "migration.redispatch", context, start_mono=start_mono,
+                reason=reason, attempt=attempt,
+            )
+        except Exception:
+            logger.debug("migration span export failed", exc_info=True)
 
     # Streams that end without any finish reason (worker vanished without an
     # exception) are NOT retried here: the transport layer is responsible for

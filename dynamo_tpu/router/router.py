@@ -312,7 +312,7 @@ class KvRouter:
     def attach(self, client: Any) -> None:
         """Install this router as the Client's KV-mode instance picker."""
 
-        async def picker(request: Any, instances: Dict[int, Any]) -> Optional[int]:
+        async def _select(request: Any, instances: Dict[int, Any], sp) -> Optional[int]:
             # Gateway pin (the EPP's x-dynamo-worker header hint,
             # gateway/epp.py): the upstream picker already ran the KV
             # algorithm and charged its own bookkeeping — honor the pin
@@ -332,6 +332,8 @@ class KvRouter:
                     _request_id_of(request), "routed",
                     worker=int(pin), reason="pinned",
                 )
+                if sp is not None:
+                    sp.attributes.update({"worker": int(pin), "pinned": True})
                 return int(pin)
             token_ids = _token_ids_of(request)
             if token_ids is None:
@@ -347,6 +349,15 @@ class KvRouter:
                 token_ids, candidates, lora_name=lora,
                 transfer=_transfer_context_of(request),
             )
+            if sp is not None:
+                # Decision record: how many candidates were actually
+                # scored, the overlap/link terms — the "why this worker"
+                # answer inside the request's own trace.
+                sp.attributes.update({
+                    k: v
+                    for k, v in self.scheduler.last_decision.items()
+                    if v is not None
+                })
             if worker is None:
                 return None
             n_blocks = max(len(token_ids) // self.block_size, 1)
@@ -369,6 +380,19 @@ class KvRouter:
                 worker=worker[0], overlap_blocks=overlap,
             )
             return worker[0]
+
+        async def picker(
+            request: Any, instances: Dict[int, Any], context: Any = None,
+        ) -> Optional[int]:
+            if context is None:
+                return await _select(request, instances, None)
+            # Trajectory span: the routing decision is a hop that can
+            # dominate tail latency (lock contention, huge fleets) and
+            # its attributes answer "why THIS worker" post-hoc.
+            from dynamo_tpu.utils.tracing import span
+
+            with span("router.select_worker", context) as sp:
+                return await _select(request, instances, sp)
 
         def on_done(instance_id: Optional[int], request: Any) -> None:
             entries = self._inflight.get(id(request))

@@ -307,6 +307,9 @@ class KvScheduler:
         # scheduling cost stays bounded as the fleet grows.
         self.logit_evals = 0
         self.selections = 0
+        # Last select_worker decision (router span attributes): the picker
+        # reads this right after the call on the same event loop.
+        self.last_decision: Dict[str, object] = {}
 
     # -- state maintenance -------------------------------------------------
 
@@ -443,6 +446,38 @@ class KvScheduler:
         worker, so a prefix-overlap win never beats a slow link blindly."""
         cfg = self.config
         self.selections += 1
+        evals0 = self.logit_evals
+
+        def note(chosen_w, *, pruned: bool) -> None:
+            # O(1) decision record for the router's select span.
+            self.last_decision = {
+                "worker": chosen_w[0] if chosen_w is not None else None,
+                "candidates_scored": self.logit_evals - evals0,
+                "overlap_blocks": (
+                    overlaps.scores.get(chosen_w, 0)
+                    if chosen_w is not None else 0
+                ),
+                "request_blocks": request_blocks,
+                "pruned": pruned,
+                "transfer_src": transfer.src if transfer is not None else None,
+                "link_cost_s": (
+                    round(
+                        self.link_costs.seconds(
+                            transfer.src,
+                            chosen_w,
+                            max(
+                                request_blocks
+                                - overlaps.scores.get(chosen_w, 0),
+                                0,
+                            ) * transfer.bytes_per_block,
+                        ),
+                        6,
+                    )
+                    if transfer is not None and chosen_w is not None
+                    else None
+                ),
+            }
+
         # Fleet-scale fast path: above the prune threshold (and at
         # temperature 0, where selection is a pure argmin) score only the
         # candidates that can actually win instead of every worker.
@@ -457,6 +492,7 @@ class KvScheduler:
             )
             if chosen is not None:
                 self._charge(chosen, request_blocks, overlaps)
+                note(chosen, pruned=True)
                 return chosen
             # No fully-eligible candidate (fleet-wide drain/saturation):
             # fall through to the full tiered scan, whose fallback tiers
@@ -464,6 +500,7 @@ class KvScheduler:
 
         pool: List[WorkerKey] = list(candidates) if candidates is not None else self.workers()
         if not pool:
+            note(None, pruned=False)
             return None
         for w in pool:
             self.add_worker(w)
@@ -495,6 +532,7 @@ class KvScheduler:
         logits = self._logits(pool, request_blocks, overlaps, transfer)
         chosen = self._sample(logits, cfg.router_temperature)
         self._charge(chosen, request_blocks, overlaps)
+        note(chosen, pruned=False)
         return chosen
 
     def _logits(

@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_PLANNER,
     ALL_ROUTER,
     ALL_RUNTIME,
+    ALL_SLO,
 )
 from dynamo_tpu.runtime.pipeline import (
     MapRequestOperator,
@@ -49,6 +50,7 @@ __all__ = [
     "ALL_PLANNER",
     "ALL_ROUTER",
     "ALL_RUNTIME",
+    "ALL_SLO",
     "AsyncEngine",
     "Client",
     "Component",

@@ -196,7 +196,23 @@ class Client:
         return sorted(self._instances)
 
     def set_kv_picker(self, picker) -> None:
+        import inspect
+
         self._kv_picker = picker
+        # Trajectory plane: a context-aware picker ((request, instances,
+        # context)) gets the request Context so its selection span joins
+        # the request's trace; legacy 2-arg pickers keep working.
+        try:
+            params = inspect.signature(picker).parameters
+            self._picker_takes_context = (
+                "context" in params
+                or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()
+                )
+            )
+        except (TypeError, ValueError):
+            self._picker_takes_context = False
 
     def set_instance_filter(self, predicate) -> None:
         """``predicate(instance_id) -> bool``; False excludes the instance
@@ -305,7 +321,12 @@ class Client:
 
     # -- routing ----------------------------------------------------------
 
-    async def _pick(self, request: Any, instance_id: Optional[int]) -> Instance:
+    async def _pick(
+        self,
+        request: Any,
+        instance_id: Optional[int],
+        context: Optional[Context] = None,
+    ) -> Instance:
         if not self._instances:
             raise NoInstancesError(self.endpoint_path)
         if instance_id is not None:
@@ -330,7 +351,12 @@ class Client:
         if self.router_mode == RouterMode.RANDOM:
             return eligible[random.choice(ids)]
         if self.router_mode == RouterMode.KV and self._kv_picker is not None:
-            chosen = await self._kv_picker(request, dict(eligible))
+            if getattr(self, "_picker_takes_context", False):
+                chosen = await self._kv_picker(
+                    request, dict(eligible), context=context
+                )
+            else:
+                chosen = await self._kv_picker(request, dict(eligible))
             if chosen is not None and chosen in eligible:
                 return eligible[chosen]
         # Round-robin default (also KV fallback when picker abstains).
@@ -352,7 +378,7 @@ class Client:
     ) -> AsyncIterator[Any]:
         instance = None
         try:
-            instance = await self._pick(request, instance_id)
+            instance = await self._pick(request, instance_id, context)
             remote = self._runtime.request_plane_client(instance)
             if self._abortable:
                 async for item in self._abortable_iter(

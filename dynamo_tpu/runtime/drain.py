@@ -571,6 +571,41 @@ class DrainController:
         task.add_done_callback(self._relays.discard)
         return True
 
+    def _export_handoff_span(
+        self, seq: Any, peer: int, *, ok: bool, reason: str = "",
+    ) -> None:
+        """Trajectory handoff_stall span: detach → first relayed token (or
+        the fallback decision) — the gap the client actually felt. Never
+        raises; streams outside any trace cost one dict lookup."""
+        if not seq.context.baggage.get("traceparent"):
+            return
+        try:
+            from dynamo_tpu.runtime import trajectory
+            from dynamo_tpu.runtime.lifecycle import trace_id_of
+            from dynamo_tpu.utils.tracing import export_span
+
+            start = getattr(seq, "t_detached", 0.0) or self._clock()
+            proc = (
+                f"worker-{self.worker_id:#x}"
+                if isinstance(self.worker_id, int) else None
+            )
+            export_span(
+                "drain.handoff", seq.context,
+                start_mono=start,
+                proc=proc,
+                status="ok" if ok else f"error: {reason or 'fallback'}",
+                peer=peer if peer >= 0 else None,
+                outcome="handoff" if ok else "reprefill",
+            )
+            trajectory.note_event(
+                trace_id_of(seq.context), "drain",
+                "handoff" if ok else "fallback",
+                request_id=seq.request.request_id,
+                peer=peer if peer >= 0 else None, reason=reason or None,
+            )
+        except Exception:
+            logger.debug("handoff span export failed", exc_info=True)
+
     async def _relay(self, seq: Any, it: Any, peer: int) -> None:
         """Pipe the peer's continuation into the still-attached client
         stream. On relay failure, a MIGRATABLE error surfaces instead —
@@ -579,6 +614,7 @@ class DrainController:
         from dynamo_tpu.llm.protocols.common import BackendOutput
 
         rid = seq.request.request_id
+        first_relayed = False
         try:
             while True:
                 try:
@@ -592,6 +628,11 @@ class DrainController:
                     BackendOutput.from_dict(item)
                     if isinstance(item, dict) else item
                 )
+                if not first_relayed:
+                    # The stall the client felt ends HERE: tokens flow
+                    # again from the peer through the source's relay.
+                    first_relayed = True
+                    self._export_handoff_span(seq, peer, ok=True)
                 seq.queue.put_nowait(out)
                 if out.finish_reason is not None:
                     self.flight.record(
@@ -637,6 +678,7 @@ class DrainController:
 
         note_activity("drain_fallbacks")
         self.flight.record("fallback", request_id=rid, reason=reason)
+        self._export_handoff_span(seq, -1, ok=False, reason=reason)
         logger.warning(
             "handoff of %s fell back to re-prefill migration: %s",
             rid, reason,

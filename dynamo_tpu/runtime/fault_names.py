@@ -80,6 +80,13 @@ PLANNER_OBSERVE = "planner.observe"
 # (the elastic controller's per-action error handling owns partial fleets).
 PLANNER_APPLY = "planner.apply"
 
+# -- trajectory plane (runtime/trajectory.py) ---------------------------------
+# One hit per shipped span/event batch, BEFORE the event-plane publish: an
+# injection models the telemetry path dying — the batch is counted dropped
+# and serving continues untouched (observability must never take down the
+# data plane; the shipper tests replay this).
+TRAJECTORY_SHIP = "trajectory.ship"
+
 # -- overload plane (runtime/overload.py) -------------------------------------
 # One hit per QUEUED admission attempt, before the EDF wait: an injected
 # timeout here expires exactly that request's queue budget — the
@@ -107,5 +114,6 @@ ALL_FAULT_POINTS = (
     RESTORE_LOAD,
     PLANNER_OBSERVE,
     PLANNER_APPLY,
+    TRAJECTORY_SHIP,
     OVERLOAD_ADMIT,
 )

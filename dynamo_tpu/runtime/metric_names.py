@@ -254,6 +254,23 @@ OVERLOAD_QUEUE_DELAY = f"{OVERLOAD_PREFIX}_queue_delay_seconds"
 # expired mid-queue) — shed before any prefill work.
 OVERLOAD_DEADLINE_EXPIRED_TOTAL = f"{OVERLOAD_PREFIX}_deadline_expired_total"
 
+# -- SLO plane (runtime/trajectory.py SloTracker) -----------------------------
+SLO_PREFIX = "dynamo_tpu_slo"
+# Rolling-window fraction of finished streams that met BOTH the TTFT and
+# ITL SLAs, labeled by window (5m | 60m). 1.0 = every stream inside SLA.
+SLO_GOODPUT = f"{SLO_PREFIX}_goodput_ratio"
+# Finished streams by SLO verdict (good | breach) — the goodput ratio's
+# monotonic source of truth across scrapes.
+SLO_STREAMS_TOTAL = f"{SLO_PREFIX}_streams_total"
+# Error-budget burn rate per window: breach fraction ÷ (1 − slo_target).
+# 1.0 = burning exactly the budget; a multi-window alert fires when BOTH
+# the fast and slow windows burn hot (the SRE-workbook shape).
+SLO_BURN_RATE = f"{SLO_PREFIX}_burn_rate"
+# p99 of each phase's per-request duration over the trajectory window —
+# which phase (queue / prefill / kv_transfer / decode / handoff_stall /
+# overhead) dominates the tail, as a number a dashboard can rank.
+SLO_PHASE_P99_MS = f"{SLO_PREFIX}_phase_p99_contribution_ms"
+
 ALL_FRONTEND = (
     FRONTEND_REQUESTS_TOTAL,
     FRONTEND_INFLIGHT,
@@ -337,6 +354,13 @@ ALL_PLANNER = (
     PLANNER_HOLDS_TOTAL,
     PLANNER_SCALE_DOWN_DRAINS_TOTAL,
     PLANNER_SCALE_UP_PENDING,
+)
+
+ALL_SLO = (
+    SLO_GOODPUT,
+    SLO_STREAMS_TOTAL,
+    SLO_BURN_RATE,
+    SLO_PHASE_P99_MS,
 )
 
 ALL_OVERLOAD = (

@@ -114,11 +114,13 @@ class SystemStatusServer:
         port: int = 0,
         lifecycle: Any = None,  # RequestLifecycle; None = process-global
         tracer: Any = None,  # utils/tracing.Tracer; None = process-global
+        trajectory: Any = None,  # TrajectoryStore; None = process-global
     ) -> None:
         self.host = host
         self.port = port
         self._lifecycle = lifecycle
         self._tracer = tracer
+        self._trajectory = trajectory
         self._engine_routes: Dict[str, EngineRoute] = {}
         self._health_sources: Dict[str, Callable[[], Tuple[bool, Any]]] = {}
         # Readiness sources (crash plane): /readyz is 200 only when EVERY
@@ -208,12 +210,16 @@ class SystemStatusServer:
         if not self._runtime_metrics_registered:
             from dynamo_tpu.runtime.device_observe import render_runtime_metrics
             from dynamo_tpu.runtime.liveness import render_fence_metrics
+            from dynamo_tpu.runtime.trajectory import render_trajectory_metrics
 
             self.register_metrics(render_runtime_metrics)
             # Crash-plane process-global families (stale-incarnation drops
             # + restore duration/outcome): every process participates in
             # fencing, so every system server exposes them.
             self.register_metrics(render_fence_metrics)
+            # SLO plane (ALL_SLO goodput/burn-rate/phase gauges): the
+            # tracker is process-global like the lifecycle/tracer rings.
+            self.register_metrics(render_trajectory_metrics)
             self._runtime_metrics_registered = True
         app = web.Application()
         app.router.add_get("/health", self._health)
@@ -228,6 +234,10 @@ class SystemStatusServer:
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/requests/{id}", self._debug_request)
         app.router.add_get("/debug/traces", self._debug_traces)
+        app.router.add_get("/debug/trajectory", self._debug_trajectories)
+        app.router.add_get(
+            "/debug/trajectory/{trace_id}", self._debug_trajectory
+        )
         app.router.add_get("/debug/memory", self._debug_memory)
         app.router.add_get("/debug/compiles", self._debug_compiles)
         app.router.add_get("/debug/flight", self._debug_flight)
@@ -358,6 +368,31 @@ class SystemStatusServer:
         if want:
             spans = [s for s in spans if s.trace_id == want]
         return web.json_response({"spans": [s.to_dict() for s in spans]})
+
+    # -- trajectory plane (runtime/trajectory.py) --------------------------
+
+    def _trajectory_obj(self):
+        if self._trajectory is None:
+            from dynamo_tpu.runtime.trajectory import global_store
+
+            self._trajectory = global_store()
+        return self._trajectory
+
+    async def _debug_trajectories(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.trajectory import trajectory_index
+
+        return web.json_response(trajectory_index(self._trajectory_obj()))
+
+    async def _debug_trajectory(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.trajectory import trajectory_view
+
+        tid = request.match_info["trace_id"]
+        stitched = trajectory_view(tid, self._trajectory_obj())
+        if stitched is None:
+            return web.json_response(
+                {"error": f"no trajectory for trace {tid!r}"}, status=404
+            )
+        return web.json_response(stitched)
 
     # -- device-plane debug surface (runtime/device_observe.py) ------------
 

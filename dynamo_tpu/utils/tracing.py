@@ -24,7 +24,7 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from dynamo_tpu import config
 
@@ -74,6 +74,27 @@ def new_trace_context() -> TraceContext:
     return TraceContext(secrets.token_hex(16), secrets.token_hex(8))
 
 
+# -- process/service identity -------------------------------------------------
+# Every exported span is stamped with the emitting process's label so the
+# frontend's trajectory stitcher (runtime/trajectory.py) knows which spans
+# share a clock domain — durations from one proc are comparable, wall
+# clocks across procs are NOT (the liveness.py local-clock-only rule).
+# Worker/frontend mains set an explicit label; the pid default keeps
+# distinct processes distinguishable even unlabeled.
+
+_SERVICE: Optional[str] = None
+
+
+def set_service(name: str) -> None:
+    """Name this process for span attribution (e.g. ``worker-0x1a2b``)."""
+    global _SERVICE
+    _SERVICE = name
+
+
+def service_label() -> str:
+    return _SERVICE or f"proc-{os.getpid()}"
+
+
 @dataclass
 class Span:
     name: str
@@ -85,6 +106,11 @@ class Span:
     attributes: Dict[str, Any] = field(default_factory=dict)
     events: List[Dict[str, Any]] = field(default_factory=list)
     status: str = "ok"
+    # Clock-domain tag (service_label() at export) + local-monotonic start
+    # anchor: the trajectory stitcher uses proc to decide which spans share
+    # a clock and start_mono_s for exact same-process offsets.
+    proc: Optional[str] = None
+    start_mono_s: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -92,7 +118,12 @@ class Span:
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_span_id": self.parent_span_id,
+            "proc": self.proc,
             "start_unix_s": round(self.start_s, 6),
+            "start_mono_s": (
+                round(self.start_mono_s, 6)
+                if self.start_mono_s is not None else None
+            ),
             "duration_ms": round((self.end_s - self.start_s) * 1000, 3),
             "attributes": self.attributes,
             "events": self.events,
@@ -228,7 +259,6 @@ class OtlpHttpExporter:
         )
         with urllib.request.urlopen(req, timeout=5.0):
             pass
-        self.sent += len(batch)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -243,6 +273,9 @@ class OtlpHttpExporter:
                 return
             try:
                 self._post(batch)
+                # Accounted HERE (not inside _post) so success/drop
+                # bookkeeping is transport-independent.
+                self.sent += len(batch)
             except Exception:
                 self.dropped += len(batch)
                 return
@@ -256,11 +289,15 @@ class OtlpHttpExporter:
 
 class Tracer:
     """Process-wide span recorder: ring buffer + optional JSONL file +
-    optional OTLP/HTTP wire exporter (DYN_TPU_OTLP_ENDPOINT)."""
+    optional OTLP/HTTP wire exporter (DYN_TPU_OTLP_ENDPOINT).
+
+    ``otlp=False`` disables the wire exporter even when the env endpoint
+    is set — micro-benchmarks and tests that pump synthetic spans through
+    a private tracer must never ship them to a real collector."""
 
     def __init__(
         self, *, max_spans: int = 2048, path: Optional[str] = None,
-        otlp: Optional[OtlpHttpExporter] = None,
+        otlp: Any = None,
     ) -> None:
         self._ring: Deque[Span] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
@@ -269,9 +306,23 @@ class Tracer:
             otlp = OtlpHttpExporter(
                 OTLP_ENDPOINT.get(), service_name=OTLP_SERVICE.get()
             )
-        self.otlp = otlp
+        self.otlp = otlp or None
+        # Finished-span taps (the trajectory shipper/store subscribe here).
+        # A listener must never take down a span-producing path.
+        self._listeners: List[Callable[[Span], None]] = []
+
+    def add_listener(self, fn: Callable[[Span], None]) -> None:
+        """``fn(span)`` on every export — used by the trajectory plane to
+        ship finished spans frontend-ward (runtime/trajectory.py)."""
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[Span], None]) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def export(self, span: Span) -> None:
+        if span.proc is None:
+            span.proc = service_label()
         with self._lock:
             self._ring.append(span)
             if self._path:
@@ -282,6 +333,15 @@ class Tracer:
                     self._path = None  # disable after first failure
         if self.otlp is not None:
             self.otlp.offer(span)
+        for fn in self._listeners:
+            try:
+                fn(span)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "span listener failed", exc_info=True
+                )
 
     def finished_spans(self) -> List[Span]:
         with self._lock:
@@ -300,10 +360,16 @@ class Tracer:
     ):
         """Start a child span of the context's trace (creating a fresh trace
         when none is active) and advance the context's traceparent so
-        downstream hops parent under this span."""
+        downstream hops parent under this span. On exit the PREVIOUS
+        traceparent is restored: a closed span's later siblings must parent
+        under the same parent, not chain under the closed leaf (a remote
+        hop parented under a tiny finished decision span would be clamped
+        into its bounds by the trajectory stitcher)."""
         parent = None
+        prev_traceparent: Optional[str] = None
         if context is not None:
-            parent = parse_traceparent(context.baggage.get("traceparent"))
+            prev_traceparent = context.baggage.get("traceparent")
+            parent = parse_traceparent(prev_traceparent)
         if parent is None:
             parent = new_trace_context()
             parent_span_id: Optional[str] = None
@@ -321,7 +387,10 @@ class Tracer:
         # end must not produce negative (or inflated) span durations. The
         # wall-clock start_s stays as the export timestamp; end_s is derived
         # as start + monotonic elapsed so duration_ms is always honest.
-        start_mono = time.perf_counter()
+        # time.monotonic (not perf_counter) so start_mono_s is directly
+        # comparable with the engine/lifecycle monotonic stamps.
+        start_mono = time.monotonic()
+        span.start_mono_s = start_mono
         if context is not None:
             context.baggage["traceparent"] = TraceContext(
                 span.trace_id, span.span_id, parent.sampled
@@ -332,7 +401,12 @@ class Tracer:
             span.status = f"error: {type(exc).__name__}"
             raise
         finally:
-            span.end_s = span.start_s + (time.perf_counter() - start_mono)
+            span.end_s = span.start_s + (time.monotonic() - start_mono)
+            if context is not None:
+                if prev_traceparent is None:
+                    context.baggage.pop("traceparent", None)
+                else:
+                    context.baggage["traceparent"] = prev_traceparent
             self.export(span)
 
 
@@ -349,3 +423,55 @@ def global_tracer() -> Tracer:
 def span(name: str, context: Any = None, **attributes: Any):
     """Convenience: a span on the process-global tracer."""
     return global_tracer().span(name, context, **attributes)
+
+
+def export_span(
+    name: str,
+    context: Any = None,
+    *,
+    start_mono: float,
+    end_mono: Optional[float] = None,
+    tracer: Optional[Tracer] = None,
+    proc: Optional[str] = None,
+    status: str = "ok",
+    events: Optional[List[Dict[str, Any]]] = None,
+    **attributes: Any,
+) -> Span:
+    """Export a RETROSPECTIVE span from monotonic timestamps.
+
+    Hot paths (the engine's per-request phases, the drain handoff stall)
+    stamp ``time.monotonic()`` boundaries as they pass and build the span
+    object once, at stream end — a live context manager per phase would put
+    span bookkeeping inside the decode loop. Parents under the context's
+    CURRENT traceparent without advancing it (these are leaves), and
+    anchors the wall-clock start as ``now_wall - (now_mono - start_mono)``
+    so the duration stays monotonic-honest."""
+    parent = None
+    if context is not None:
+        baggage = getattr(context, "baggage", None)
+        if isinstance(baggage, dict):
+            parent = parse_traceparent(baggage.get("traceparent"))
+    if parent is None:
+        parent = new_trace_context()
+        parent_span_id: Optional[str] = None
+    else:
+        parent_span_id = parent.span_id
+    now_mono = time.monotonic()
+    if end_mono is None:
+        end_mono = now_mono
+    start_s = time.time() - (now_mono - start_mono)
+    sp = Span(
+        name=name,
+        trace_id=parent.trace_id,
+        span_id=secrets.token_hex(8),
+        parent_span_id=parent_span_id,
+        start_s=start_s,
+        end_s=start_s + max(end_mono - start_mono, 0.0),
+        attributes={k: v for k, v in attributes.items() if v is not None},
+        events=list(events or ()),
+        status=status,
+        proc=proc,
+        start_mono_s=start_mono,
+    )
+    (tracer or global_tracer()).export(sp)
+    return sp

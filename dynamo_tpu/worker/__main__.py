@@ -266,6 +266,26 @@ async def main() -> None:
     # rejoin purge and the fence line up; 0 keeps the old random-per-start
     # behavior for ad-hoc workers.
     instance_id = config.WORKER_ID.get() or random.getrandbits(63)
+    # Trajectory plane: label this process's spans (clock-domain tag for
+    # cross-worker stitching) and ship finished spans frontend-ward.
+    from dynamo_tpu.runtime.trajectory import (
+        TrajectoryShipper,
+        set_global_shipper,
+    )
+    from dynamo_tpu.utils.tracing import global_tracer, set_service
+
+    set_service(f"worker-{instance_id:#x}")
+    trajectory_shipper = TrajectoryShipper(
+        runtime.event_plane, args.namespace
+    )
+    trajectory_shipper.attach(global_tracer())
+    set_global_shipper(trajectory_shipper)
+    # Eagerly attach the local store too: the worker's own
+    # /debug/trajectory must show ITS slice from the first request, not
+    # from whenever the route is first scraped.
+    from dynamo_tpu.runtime.trajectory import global_store
+
+    global_store()
     kv_pub = KvEventPublisher(
         runtime.event_plane, args.namespace, args.component, instance_id
     )
@@ -449,6 +469,7 @@ async def main() -> None:
                 .client()
             )
     load_pub.start()
+    trajectory_shipper.start()
     # Worker-side overload plane: KV-pool-occupancy-driven brownout that
     # suspends speculative decode before admission backpressure turns
     # into a preemption storm (the engine's admit_kv_high_watermark does
@@ -562,6 +583,8 @@ async def main() -> None:
         await reap_task(overload_task, "overload eval loop", logger)
         if kvbm is not None:
             await kvbm.close()
+        set_global_shipper(None)
+        await trajectory_shipper.close()
         await load_pub.close()
         await kv_pub.close()
         await served.shutdown(grace_period=config.GRACE_PERIOD.get())

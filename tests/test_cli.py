@@ -118,6 +118,69 @@ async def test_observe_snapshot_against_live_worker(capsys):
         await engine.stop()
 
 
+async def test_observe_trajectory_against_live_worker(capsys):
+    """`dynamo-tpu observe trajectory <trace_id>` pretty-prints the
+    stitched view (phases, per-hop spans, dominant phase) from a live
+    in-process worker's /debug/trajectory endpoint."""
+    import argparse
+
+    from dynamo_tpu.cli.run import add_observe_args, main_observe
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.system_server import (
+        SystemStatusServer,
+        attach_engine,
+    )
+    from dynamo_tpu.runtime.trajectory import global_store
+    from dynamo_tpu.utils.tracing import span
+    from tests.test_jax_engine import make_engine, req
+
+    global_store()  # attach the store to the tracer BEFORE spans flow
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        from dynamo_tpu.runtime.engine import collect
+
+        ctx = Context(baggage={})
+        with span("http.chat_completions", ctx, model="tiny") as root:
+            await collect(
+                engine.generate(req(range(10, 20), max_tokens=3), ctx)
+            )
+
+        parser = argparse.ArgumentParser()
+        add_observe_args(parser)
+        args = parser.parse_args(
+            ["trajectory", root.trace_id, "--port", str(server.port)]
+        )
+        await main_observe(args)
+        out = capsys.readouterr().out
+        assert f"trajectory {root.trace_id}" in out
+        assert "phases:" in out and "dominant" in out
+        assert "http.chat_completions" in out
+
+        # Index view (no trace id) lists recent trajectories.
+        args = parser.parse_args(["trajectory", "--port", str(server.port)])
+        await main_observe(args)
+        out = capsys.readouterr().out
+        assert "trajectories" in out and root.trace_id in out
+
+        args = parser.parse_args(
+            ["trajectory", root.trace_id, "--port", str(server.port),
+             "--json"]
+        )
+        await main_observe(args)
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["trace_id"] == root.trace_id
+        assert set(doc["phases"]) == {
+            "queue", "prefill", "kv_transfer", "decode", "handoff_stall",
+            "overhead",
+        }
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
 # -- lint --------------------------------------------------------------------
 
 

@@ -283,12 +283,16 @@ async def test_every_debug_route_returns_json_against_mock_engine():
         )
         assert set(debug_paths) == {
             "/debug/requests", "/debug/traces", "/debug/memory",
-            "/debug/compiles", "/debug/flight",
+            "/debug/compiles", "/debug/flight", "/debug/trajectory",
         }
         for path in debug_paths:
             status, body = await _get(server.port, path)
             assert status == 200, (path, body)
             assert isinstance(body, dict), path
+        # The parametrized trajectory route answers a clean 404 for an
+        # unknown trace even on a partial/mock attach.
+        status, body = await _get(server.port, "/debug/trajectory/deadbeef")
+        assert status == 404 and "error" in body
         status, body = await _post(
             server.port, "/debug/profile", {"action": "status"}
         )

@@ -319,3 +319,103 @@ class TestOtlpExporterEdges:
             exporter._stop.set()
             exporter._wake.set()
             exporter._thread.join(timeout=2.0)
+
+    def test_close_flushes_queued_tail(self):
+        """close() must join the flush thread AND ship whatever is still
+        queued — spans produced just before shutdown (the stitched-batch
+        tail) can't be silently abandoned."""
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/nope", flush_interval_s=3600.0, max_batch=64,
+        )
+        batches = []
+        exporter._post = lambda batch: batches.append(list(batch))
+        exporter.offer(self._span("tail-a"))
+        exporter.offer(self._span("tail-b"))
+        exporter.close()
+        assert not exporter._thread.is_alive()
+        assert [s.name for b in batches for s in b] == ["tail-a", "tail-b"]
+        assert exporter.sent == 2 and exporter.dropped == 0
+        # Idempotent: a second close (shutdown paths race) is a no-op.
+        exporter.close()
+        assert exporter.sent == 2
+
+    def test_post_failure_then_recovery_accounting(self):
+        """A failed POST drops exactly its batch (counted); spans offered
+        AFTER the failure ship once the collector recovers — the failure
+        must not wedge the exporter or leak into later accounting."""
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/nope", flush_interval_s=3600.0, max_batch=64,
+        )
+        state = {"fail": True}
+        shipped = []
+
+        def flaky_post(batch):
+            if state["fail"]:
+                raise ConnectionError("collector down")
+            shipped.extend(batch)
+
+        exporter._post = flaky_post
+        try:
+            exporter.offer(self._span("lost-1"))
+            exporter.offer(self._span("lost-2"))
+            exporter.flush_once()
+            assert exporter.dropped == 2 and exporter.sent == 0
+            with exporter._lock:
+                assert not exporter._queue  # dropped, not retried forever
+            state["fail"] = False
+            exporter.offer(self._span("ok-1"))
+            exporter.flush_once()
+            assert exporter.sent == 1 and exporter.dropped == 2
+            assert [s.name for s in shipped] == ["ok-1"]
+        finally:
+            exporter._stop.set()
+            exporter._wake.set()
+            exporter._thread.join(timeout=2.0)
+
+    def test_batch_draining_under_concurrent_offer(self):
+        """Producers hammering offer() from several threads while the
+        flush thread drains: every span is either shipped or counted
+        dropped (no loss, no double-ship), and each shipped batch respects
+        max_batch."""
+        import threading
+
+        from dynamo_tpu.utils.tracing import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:9/nope", flush_interval_s=0.005,
+            max_batch=16, max_queue=10_000,
+        )
+        shipped = []
+        ship_lock = threading.Lock()
+
+        def capture_post(batch):
+            assert len(batch) <= 16
+            with ship_lock:
+                shipped.extend(s.name for s in batch)
+
+        exporter._post = capture_post
+        N, THREADS = 300, 4
+
+        def produce(tid):
+            for i in range(N):
+                exporter.offer(self._span(f"s{tid}-{i}"))
+
+        threads = [
+            threading.Thread(target=produce, args=(t,))
+            for t in range(THREADS)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            exporter.close()
+        assert exporter.sent + exporter.dropped == N * THREADS
+        assert len(shipped) == exporter.sent
+        assert len(set(shipped)) == len(shipped)  # nothing shipped twice
+        assert exporter.dropped == 0  # queue was sized for the load

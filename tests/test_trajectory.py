@@ -1,0 +1,697 @@
+"""Fleet-wide request trajectory plane (ISSUE 14): cross-worker span
+stitching, tail-latency phase attribution, and SLO goodput/burn-rate
+gauges.
+
+The shared claim: one GET answers "why was THIS request slow" — workers
+ship finished spans over the event plane, the frontend stitches them into a
+single causal timeline that never compares remote wall clocks (durations
+from each proc's own clock; cross-proc placement is re-anchored inside the
+parent span's bounds, residual skew FLAGGED), and per-request phase
+attribution rolls up into lint-pinned ALL_SLO goodput/burn-rate/phase-p99
+gauges.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime import fault_names as fn
+from dynamo_tpu.runtime import trajectory
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.trajectory import (
+    PHASE_DECODE,
+    PHASE_HANDOFF_STALL,
+    PHASE_KV_TRANSFER,
+    PHASE_OVERHEAD,
+    PHASE_PREFILL,
+    PHASE_QUEUE,
+    PHASES,
+    SloTracker,
+    TrajectoryCollector,
+    TrajectoryShipper,
+    TrajectoryStore,
+    attribute_phases,
+    stitch,
+)
+from dynamo_tpu.utils.tracing import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _span(
+    name, trace_id="t" * 32, span_id="s1", parent=None, proc="frontend",
+    start_wall=1000.0, start_mono=None, duration_ms=10.0, status="ok",
+    attrs=None,
+):
+    return {
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_span_id": parent,
+        "proc": proc,
+        "start_unix_s": start_wall,
+        "start_mono_s": start_mono,
+        "duration_ms": duration_ms,
+        "attributes": attrs or {},
+        "events": [],
+        "status": status,
+    }
+
+
+# ---------------------------------------------------------------------------
+# stitching
+# ---------------------------------------------------------------------------
+
+
+class TestStitch:
+    def test_same_proc_offsets_use_monotonic_deltas(self):
+        """Same clock domain: the child's offset comes from the monotonic
+        delta even when the wall clocks disagree (an NTP step mid-request
+        must not move spans around)."""
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, start_mono=50.0,
+                  duration_ms=100.0),
+            # Wall claims +90ms, mono says +20ms: mono wins (same proc).
+            _span("engine.decode", span_id="b", parent="a",
+                  start_wall=1000.09, start_mono=50.02, duration_ms=30.0),
+        ]
+        out = stitch(spans)
+        child = next(s for s in out["spans"] if s["span_id"] == "b")
+        assert child["offset_ms"] == pytest.approx(20.0)
+        assert not child.get("skew_flagged")
+        assert out["processes"] == ["frontend"]
+
+    def test_cross_proc_child_is_reanchored_inside_parent_bounds(self):
+        """A worker whose wall clock is 5 s ahead: its span lands INSIDE
+        the parent's bounds (local-clock-only rule — never believe a
+        remote wall clock), with the residual skew flagged, and its
+        duration (local monotonic) untouched."""
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, duration_ms=100.0),
+            _span("engine.prefill", span_id="b", parent="a", proc="worker-1",
+                  start_wall=1005.0, duration_ms=40.0),
+        ]
+        out = stitch(spans)
+        child = next(s for s in out["spans"] if s["span_id"] == "b")
+        # Clamped to parent_end - child_duration = 100 - 40 = 60ms.
+        assert child["offset_ms"] == pytest.approx(60.0)
+        assert child["skew_flagged"]
+        assert child["skew_ms"] == pytest.approx(5000.0 - 60.0)
+        assert child["duration_ms"] == 40.0
+        assert out["skew_flagged"]
+        assert set(out["processes"]) == {"frontend", "worker-1"}
+
+    def test_cross_proc_honest_clock_not_flagged(self):
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, duration_ms=100.0),
+            _span("engine.decode", span_id="b", parent="a", proc="w",
+                  start_wall=1000.03, duration_ms=50.0),
+        ]
+        child = next(
+            s for s in stitch(spans)["spans"] if s["span_id"] == "b"
+        )
+        assert child["offset_ms"] == pytest.approx(30.0)
+        assert not child.get("skew_flagged")
+
+    def test_orphan_span_placed_and_marked(self):
+        """A span whose parent never arrived (ring-evicted / late batch)
+        still lands on the timeline, flagged orphan."""
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, duration_ms=80.0),
+            _span("engine.decode", span_id="c", parent="missing", proc="w",
+                  start_wall=1000.02, duration_ms=10.0),
+        ]
+        out = stitch(spans)
+        orphan = next(s for s in out["spans"] if s["span_id"] == "c")
+        assert orphan["orphan"] and orphan["offset_ms"] == pytest.approx(20.0)
+
+    def test_events_placed_on_timeline(self):
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, duration_ms=100.0),
+        ]
+        events = [{"trace_id": "t" * 32, "ring": "disagg",
+                   "kind": "pull_retry", "t_wall": 1000.04}]
+        out = stitch(spans, events)
+        assert out["events"][0]["offset_ms"] == pytest.approx(40.0)
+
+    def test_empty(self):
+        out = stitch([])
+        assert out["spans"] == [] and out["dominant_phase"] == PHASE_OVERHEAD
+
+
+class TestPhases:
+    def test_attribution_and_dominant(self):
+        spans = [
+            _span("http.chat", span_id="a", duration_ms=100.0),
+            _span("overload.queue", span_id="q", parent="a", duration_ms=5.0),
+            _span("engine.prefill", span_id="p", parent="a", duration_ms=20.0),
+            _span("disagg.pull", span_id="k", parent="a", duration_ms=40.0),
+            _span("engine.decode", span_id="d", parent="a", duration_ms=25.0),
+        ]
+        out = stitch(spans)
+        ph = out["phases"]
+        assert ph[PHASE_QUEUE] == 5.0
+        assert ph[PHASE_PREFILL] == 20.0
+        assert ph[PHASE_KV_TRANSFER] == 40.0
+        assert ph[PHASE_DECODE] == 25.0
+        assert ph[PHASE_OVERHEAD] == pytest.approx(10.0)
+        assert out["dominant_phase"] == PHASE_KV_TRANSFER
+
+    def test_overhead_floored_at_zero(self):
+        # Worker phase spans outliving the root (deadline-cut relay) must
+        # not produce negative overhead.
+        phases, dominant = attribute_phases(
+            [_span("engine.decode", duration_ms=50.0)], total_ms=30.0
+        )
+        assert phases[PHASE_OVERHEAD] == 0.0
+        assert dominant == PHASE_DECODE
+
+    def test_handoff_stall_attributed(self):
+        spans = [
+            _span("root", span_id="a", duration_ms=100.0),
+            _span("drain.handoff", span_id="h", parent="a", duration_ms=70.0),
+        ]
+        out = stitch(spans)
+        assert out["phases"][PHASE_HANDOFF_STALL] == 70.0
+        assert out["dominant_phase"] == PHASE_HANDOFF_STALL
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+
+class TestSloTracker:
+    def _tracker(self, **kw):
+        clock = {"t": 1000.0}
+        kw.setdefault("ttft_sla_s", 0.5)
+        kw.setdefault("itl_sla_s", 0.05)
+        kw.setdefault("target", 0.9)
+        tracker = SloTracker(clock=lambda: clock["t"], **kw)
+        return tracker, clock
+
+    def test_goodput_and_burn_rate_windows(self):
+        tracker, clock = self._tracker()
+        for _ in range(8):
+            tracker.note_stream("x", ttft_s=0.1, mean_itl_s=0.01)
+        for _ in range(2):
+            tracker.note_stream("y", ttft_s=2.0, mean_itl_s=0.01)
+        tracker._refresh()
+        assert tracker.goodput.value(window="5m") == pytest.approx(0.8)
+        # budget = 1 - 0.9 = 0.1; breach frac 0.2 → burn 2x the budget.
+        assert tracker.burn_rate.value(window="5m") == pytest.approx(2.0)
+        # Old verdicts age out of the fast window but stay in the slow one.
+        clock["t"] += 400.0
+        tracker.note_stream("z", ttft_s=0.1, mean_itl_s=0.01)
+        tracker._refresh()
+        assert tracker.goodput.value(window="5m") == 1.0
+        assert tracker.goodput.value(window="60m") == pytest.approx(9 / 11)
+
+    def test_itl_breach_counts(self):
+        tracker, _ = self._tracker()
+        tracker.note_stream("a", ttft_s=0.1, mean_itl_s=0.2)
+        assert tracker.streams.value(verdict="breach") == 1
+        assert tracker.breached_streams == 1
+
+    def test_tokenless_failure_is_a_breach(self):
+        """A stream that died/shed before its first token never met the
+        SLA: goodput must fall during a total outage, not read 1.0."""
+        tracker, _ = self._tracker()
+        tracker.note_stream("dead", ttft_s=None, mean_itl_s=None, status=500)
+        tracker.note_stream("shed", ttft_s=None, mean_itl_s=None, status=429)
+        tracker._refresh()
+        assert tracker.breached_streams == 2
+        assert tracker.goodput.value(window="5m") == 0.0
+
+    def test_disabled_is_noop(self):
+        tracker = SloTracker(ttft_sla_s=None, itl_sla_s=None)
+        tracker.note_stream("a", ttft_s=99.0, mean_itl_s=99.0)
+        assert tracker.good_streams == 0 and tracker.breached_streams == 0
+
+    def test_phase_p99_replaced_not_doubled(self):
+        """A late worker batch refining a completed trajectory REPLACES
+        its phase row — otherwise every refinement inflates the window."""
+        tracker, _ = self._tracker()
+        tracker.note_phases("t1", {PHASE_DECODE: 10.0})
+        tracker.note_phases("t1", {PHASE_DECODE: 30.0})
+        tracker.note_phases("t2", {PHASE_DECODE: 20.0})
+        tracker._refresh()
+        assert len(tracker._phases) == 2
+        assert tracker.phase_p99.value(phase=PHASE_DECODE) == 30.0
+
+    def test_snapshot_shape(self):
+        tracker, _ = self._tracker()
+        snap = tracker.snapshot()
+        assert snap["enabled"]
+        assert set(snap["phase_p99_ms"]) == set(PHASES)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+class TestTrajectoryStore:
+    def _store(self, **kw):
+        kw.setdefault("max_recent", 4)
+        kw.setdefault("max_slow", 2)
+        kw.setdefault("slow_threshold_s", 0.05)
+        kw.setdefault("slo", SloTracker(ttft_sla_s=1.0, itl_sla_s=1.0))
+        return TrajectoryStore(**kw)
+
+    def test_get_stitches_on_demand(self):
+        store = self._store()
+        store.add_span(_span("root", trace_id="a" * 32, span_id="r",
+                             duration_ms=10.0))
+        store.add_span(_span("engine.decode", trace_id="a" * 32, span_id="d",
+                             parent="r", proc="w", duration_ms=5.0))
+        out = store.get("a" * 32)
+        assert out["complete"] and len(out["spans"]) == 2
+        assert store.get("missing" * 4) is None
+
+    def test_recent_ring_evicts_complete_first(self):
+        store = self._store()
+        # One in-flight (no root) trace, then churn past the cap with
+        # complete ones: the in-flight trace must survive.
+        store.add_span(_span("engine.decode", trace_id="inflight" + "0" * 24,
+                             span_id="x", parent="gone"))
+        for i in range(8):
+            tid = f"{i:032x}"
+            store.add_span(_span("root", trace_id=tid, span_id=f"r{i}",
+                                 duration_ms=1.0))
+        assert store.get("inflight" + "0" * 24) is not None
+        with store._lock:
+            assert len(store._recent) <= 4
+
+    def test_slow_ring_captures_dominant_phase(self):
+        store = self._store()
+        tid = "b" * 32
+        store.add_span(_span("disagg.pull", trace_id=tid, span_id="k",
+                             parent="r", proc="w", duration_ms=90.0))
+        store.add_span(_span("root", trace_id=tid, span_id="r",
+                             duration_ms=100.0))
+        slow = store.slow_summaries()
+        assert len(slow) == 1
+        assert slow[0]["dominant_phase"] == PHASE_KV_TRANSFER
+        assert slow[0]["retained"] == "slow"
+        # Slow summaries survive recent-ring churn.
+        for i in range(8):
+            store.add_span(_span("root", trace_id=f"{i:032x}",
+                                 span_id=f"r{i}", duration_ms=1.0))
+        assert store.get(tid)["dominant_phase"] == PHASE_KV_TRANSFER
+
+    def test_error_trace_captured(self):
+        store = self._store()
+        tid = "c" * 32
+        store.add_span(_span("disagg.pull", trace_id=tid, span_id="k",
+                             parent="r", proc="w", duration_ms=1.0,
+                             status="error: pull_failed"))
+        store.add_span(_span("root", trace_id=tid, span_id="r",
+                             duration_ms=2.0))
+        slow = [s for s in store.slow_summaries() if s["trace_id"] == tid]
+        assert slow and slow[0]["retained"] == "error"
+
+    def test_completion_feeds_phase_gauges(self):
+        store = self._store()
+        tid = "d" * 32
+        store.add_span(_span("engine.decode", trace_id=tid, span_id="d",
+                             parent="r", proc="w", duration_ms=80.0))
+        store.add_span(_span("root", trace_id=tid, span_id="r",
+                             duration_ms=100.0))
+        store.slo._refresh()
+        assert store.slo.phase_p99.value(phase=PHASE_DECODE) == 80.0
+
+    def test_ingest_batch_applies_proc_fallback(self):
+        store = self._store()
+        rec = _span("engine.decode", trace_id="e" * 32, span_id="d",
+                    parent="r", proc=None)
+        rec["proc"] = None
+        store.ingest({"proc": "worker-9", "spans": [rec], "events": []})
+        store.add_span(_span("root", trace_id="e" * 32, span_id="r"))
+        out = store.get("e" * 32)
+        assert "worker-9" in out["processes"]
+
+    def test_add_span_never_raises(self):
+        store = self._store()
+        store.add_span({"trace_id": "f" * 32, "garbage": object()})
+        store.add_span({})  # no trace id → ignored
+
+
+# ---------------------------------------------------------------------------
+# shipping over the event plane
+# ---------------------------------------------------------------------------
+
+
+async def test_shipper_to_collector_roundtrip():
+    """Worker tracer → shipper → (memory) event plane → collector →
+    store: the frontend sees the worker's spans under the worker's proc
+    label, keyed by trace id."""
+    from dynamo_tpu.runtime.events import MemoryEventPlane
+
+    plane = MemoryEventPlane()
+    store = TrajectoryStore(
+        max_recent=16, max_slow=4, slow_threshold_s=10.0,
+        slo=SloTracker(ttft_sla_s=None, itl_sla_s=None),
+    )
+    collector = TrajectoryCollector(plane, "tns", store=store)
+    await collector.start()
+    tracer = Tracer(path="")
+    shipper = TrajectoryShipper(
+        plane, "tns", proc="worker-42", flush_interval_s=0.05
+    )
+    shipper.attach(tracer)
+    shipper.start()
+    try:
+        ctx = Context(baggage={})
+        with tracer.span("endpoint.serve", ctx) as root:
+            with tracer.span("engine.decode", ctx):
+                pass
+        shipper.offer_event(root.trace_id, "disagg", "pull_retry", src=7)
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if store.get(root.trace_id) and len(
+                store.get(root.trace_id)["spans"]
+            ) == 2:
+                break
+        out = store.get(root.trace_id)
+        assert out is not None and len(out["spans"]) == 2
+        assert out["events"] and out["events"][0]["kind"] == "pull_retry"
+        assert shipper.shipped >= 3 and shipper.dropped == 0
+    finally:
+        await shipper.close()
+        await collector.stop()
+
+
+async def test_ship_fault_drops_batch_without_touching_serving():
+    """The trajectory.ship chaos seam: an injected failure costs exactly
+    the batch (counted dropped), never raises into the pump."""
+    from dynamo_tpu.runtime.events import MemoryEventPlane
+
+    plane = MemoryEventPlane()
+    tracer = Tracer(path="")
+    shipper = TrajectoryShipper(
+        plane, "tns", proc="w", flush_interval_s=3600.0
+    )
+    shipper.attach(tracer)
+    with tracer.span("engine.decode", Context(baggage={})):
+        pass
+    plan = faults.FaultPlan(rules=(
+        faults.FaultRule(point=fn.TRAJECTORY_SHIP, at=(1,)),
+    ))
+    with faults.armed(plan):
+        await shipper.flush_once()
+    assert shipper.dropped == 1 and shipper.shipped == 0
+    # Next batch (seam quiet) ships normally.
+    with tracer.span("engine.decode", Context(baggage={})):
+        pass
+    await shipper.flush_once()
+    assert shipper.shipped == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-plane trace propagation (satellite: parity across request planes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("plane_kind", ["tcp", "http"])
+async def test_one_trace_id_spans_frontend_to_worker(plane_kind):
+    """The traceparent baggage must survive every request plane the same
+    way the PR 8 deadline does: one trace id covers the frontend root span
+    AND the worker-side endpoint.serve span on both transports."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import collect
+    from dynamo_tpu.utils.tracing import global_tracer, parse_traceparent
+
+    if plane_kind == "tcp":
+        from dynamo_tpu.runtime.network.tcp import TcpRequestPlane
+
+        plane = TcpRequestPlane(host="127.0.0.1")
+    else:
+        from dynamo_tpu.runtime.network.http_plane import HttpRequestPlane
+
+        plane = HttpRequestPlane(host="127.0.0.1")
+    rt = DistributedRuntime.detached()
+    rt.request_plane = plane
+    seen = []
+
+    async def handler(request, context):
+        seen.append(context.baggage.get("traceparent"))
+        yield {"ok": True}
+
+    ep = rt.namespace("xplane").component("b").endpoint("generate")
+    served = await ep.serve_endpoint(handler)
+    client = await ep.client()
+    tracer = global_tracer()
+    try:
+        ctx = Context(baggage={})
+        with tracer.span(f"frontend.{plane_kind}", ctx) as root:
+            await collect(client.generate({"x": 1}, ctx))
+        # The worker handler saw the frontend's trace id...
+        assert seen and parse_traceparent(seen[0]).trace_id == root.trace_id
+        # ...and its endpoint.serve span joined the same trace, parented
+        # under the frontend span (remote planes only — the local plane
+        # shares the Context object without a serve wrapper).
+        serve_spans = [
+            s for s in tracer.finished_spans()
+            if s.name == "endpoint.serve" and s.trace_id == root.trace_id
+        ]
+        assert serve_spans, "worker-side span did not join the trace"
+        assert serve_spans[-1].parent_span_id == root.span_id
+    finally:
+        await served.shutdown(grace_period=1)
+        await rt.shutdown(grace_period=1)
+
+
+# ---------------------------------------------------------------------------
+# e2e: disagg prefill→decode + mid-stream drain handoff, one stitched view
+# ---------------------------------------------------------------------------
+
+
+async def test_e2e_disagg_drain_trajectory():
+    """The acceptance drive: one request flows frontend → prefill worker →
+    decode worker (with an injected pull retry) → mid-stream drain handoff
+    to a peer. GET /debug/trajectory/{trace_id} returns ONE stitched
+    trajectory covering >= 3 processes with monotonically consistent
+    phases, the retry and handoff time attributed to kv_transfer /
+    handoff_stall, and the ALL_SLO goodput/burn-rate gauges live on
+    /metrics."""
+    import aiohttp
+
+    from dynamo_tpu.disagg import (
+        DecodeHandler,
+        HandoffHandler,
+        KvTransferHandler,
+        PrefillHandler,
+    )
+    from dynamo_tpu.disagg.prefill_router import PrefillRouter
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.http.metrics import FrontendMetrics, RequestTimer
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import tiny_config
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.drain import DrainController
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+    from dynamo_tpu.runtime.system_server import SystemStatusServer
+    from dynamo_tpu.utils.tracing import span
+
+    def make_engine(wid):
+        e = JaxEngine(JaxEngineArgs(
+            config=tiny_config(), block_size=4, num_kv_blocks=64,
+            max_num_seqs=4, max_model_len=256, prefill_chunk=32,
+            decode_steps=4, seed=5,
+        ))
+        e.trace_proc = f"worker-{wid:#x}"
+        return e
+
+    prefill_engine = make_engine(1)
+    decode_engine = make_engine(2)
+    peer_engine = make_engine(3)
+    store = trajectory.global_store()
+    # Arm the SLO plane (generous SLAs: this stream should be GOOD).
+    store.slo.ttft_sla_s = 120.0
+    store.slo.itl_sla_s = 120.0
+
+    rt = DistributedRuntime.detached()
+    ns = rt.namespace("traj")
+    served = []
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        pc = ns.component("prefill")
+        served.append(await pc.endpoint("generate").serve_endpoint(
+            PrefillHandler(prefill_engine, worker_id=1).generate,
+            instance_id=1,
+        ))
+        served.append(await pc.endpoint("kv").serve_endpoint(
+            KvTransferHandler(prefill_engine).generate, instance_id=1,
+        ))
+
+        async def kv_client():
+            return await pc.endpoint("kv").client()
+
+        decode_handler = DecodeHandler(
+            decode_engine, kv_client_factory=kv_client, worker_id=2,
+            backoff_base_s=0.01,
+        )
+        dc = ns.component("backend")
+        served.append(await dc.endpoint("generate").serve_endpoint(
+            decode_handler.generate, instance_id=2,
+        ))
+        decode_client = await dc.endpoint("generate").client()
+
+        async def prefill_client():
+            return await pc.endpoint("generate").client()
+
+        pipeline = build_pipeline(
+            [PrefillRouter(prefill_client, threshold_tokens=8)],
+            decode_client,
+        )
+
+        class LocalHandoffClient:
+            def __init__(self, handlers):
+                self._handlers = dict(handlers)
+
+            @property
+            def instance_ids(self):
+                return sorted(self._handlers)
+
+            def direct(self, request, instance_id, context=None):
+                return self._handlers[instance_id].generate(
+                    request, context or Context()
+                )
+
+            async def close(self):
+                pass
+
+        handoff_client = LocalHandoffClient({3: HandoffHandler(peer_engine)})
+
+        async def handoff_factory():
+            return handoff_client
+
+        ctrl = DrainController(
+            decode_engine, worker_id=2,
+            handoff_client_factory=handoff_factory, deadline_s=30.0,
+        )
+
+        prompt = list(range(60, 78))  # 18 tokens through the disagg split
+        request = PreprocessedRequest(
+            token_ids=prompt, request_id="traj-e2e",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=40, ignore_eos=True),
+        )
+        # One injected wire death on the FIRST pulled chunk: the pull
+        # retries from its anchor, and the retry must show up attributed
+        # inside the kv_transfer phase.
+        plan = faults.FaultPlan(rules=(
+            faults.FaultRule(point=fn.DISAGG_PULL_CHUNK, at=(1,)),
+        ))
+        timer = RequestTimer(FrontendMetrics(), "tiny", "chat_completions")
+        got = []
+        got_some = asyncio.Event()
+        ctx = Context(baggage={})
+
+        async def consume():
+            async for out in pipeline.generate(request.to_dict(), ctx):
+                toks = (
+                    out.get("token_ids") if isinstance(out, dict)
+                    else getattr(out, "token_ids", None)
+                )
+                if toks:
+                    timer.on_token(len(toks))
+                    got.extend(toks)
+                if len(got) >= 3:
+                    got_some.set()
+
+        with faults.armed(plan):
+            with span("http.chat_completions", ctx, model="tiny") as root:
+                timer.bind_context(ctx)
+                task = asyncio.create_task(consume())
+                await got_some.wait()
+                # Mid-stream planned drain: the decode worker hands the
+                # live sequence to the peer and relays its continuation.
+                status = await ctrl.drain()
+                await task
+            timer.done(200)
+
+        assert len(got) == 40
+        assert status["handoffs"] == 1
+        assert decode_handler.pull_retries == 1
+
+        out = store.get(root.trace_id)
+        assert out is not None and out["complete"]
+        # >= 3 distinct processes stitched into ONE trajectory.
+        assert len(out["processes"]) >= 3, out["processes"]
+        assert "worker-0x1" in out["processes"]  # prefill engine
+        assert "worker-0x2" in out["processes"]  # decode engine + handler
+        assert "worker-0x3" in out["processes"]  # handoff peer
+        # Monotonically consistent placement: offsets ordered, every span
+        # inside the trajectory, every phase non-negative.
+        offsets = [s["offset_ms"] for s in out["spans"]]
+        assert offsets == sorted(offsets)
+        assert all(o >= 0 for o in offsets)
+        names = {s["name"] for s in out["spans"]}
+        assert {"http.chat_completions", "engine.prefill", "disagg.pull",
+                "engine.decode", "drain.handoff"} <= names
+        ph = out["phases"]
+        assert all(v >= 0 for v in ph.values())
+        # Retry time attributed to its phase: the pull span carries the
+        # attempt accounting and the kv_transfer phase absorbed the
+        # backoff.
+        pull = next(s for s in out["spans"] if s["name"] == "disagg.pull")
+        assert pull["attributes"]["retries"] == 1
+        assert pull["attributes"]["attempts"] == 2
+        assert ph[PHASE_KV_TRANSFER] >= pull["duration_ms"]
+        assert ph[PHASE_KV_TRANSFER] > 0
+        # Handoff stall attributed: detach -> first relayed token.
+        handoff = next(
+            s for s in out["spans"] if s["name"] == "drain.handoff"
+        )
+        assert handoff["attributes"]["outcome"] == "handoff"
+        assert ph[PHASE_HANDOFF_STALL] > 0
+        assert ph[PHASE_PREFILL] > 0 and ph[PHASE_DECODE] > 0
+        # The peer's share of decode is its own span in its own proc.
+        adopted = [
+            s for s in out["spans"]
+            if s["name"] == "engine.decode"
+            and (s.get("attributes") or {}).get("adopted")
+        ]
+        assert adopted and adopted[0]["proc"] == "worker-0x3"
+
+        # The same stitched view serves over GET /debug/trajectory/{id},
+        # and ALL_SLO goodput/burn-rate gauges are live on /metrics.
+        async with aiohttp.ClientSession() as session:
+            url = (
+                f"http://127.0.0.1:{server.port}"
+                f"/debug/trajectory/{root.trace_id}"
+            )
+            async with session.get(url) as r:
+                assert r.status == 200
+                doc = await r.json()
+                assert doc["trace_id"] == root.trace_id
+                assert len(doc["processes"]) >= 3
+                assert doc["dominant_phase"] in PHASES
+            async with session.get(
+                f"http://127.0.0.1:{server.port}/metrics"
+            ) as r:
+                text = await r.text()
+        assert 'dynamo_tpu_slo_goodput_ratio{window="5m"} 1' in text
+        assert 'dynamo_tpu_slo_burn_rate{window="5m"} 0' in text
+        assert 'dynamo_tpu_slo_streams_total{verdict="good"}' in text
+        assert "dynamo_tpu_slo_phase_p99_contribution_ms" in text
+    finally:
+        await server.stop()
+        for s in served:
+            await s.shutdown(grace_period=1)
+        for e in (prefill_engine, decode_engine, peer_engine):
+            await e.stop()
+        await rt.shutdown(grace_period=1)
