@@ -161,6 +161,11 @@ def _fault_plane_record(activity_before: dict) -> dict:
         "sheds": delta.get("sheds", 0),
         "brownout_transitions": delta.get("brownout_transitions", 0),
         "deadline_expired": delta.get("deadline_expired", 0),
+        # Parser plane (ISSUE 15): the degradation ladder / parse-error
+        # frames activating on a clean-corpus leg would be the jail
+        # mangling healthy traffic — same zero-spurious contract.
+        "parser_degraded": delta.get("parser_degraded", 0),
+        "parser_exceptions": delta.get("parser_exceptions", 0),
     }
 
 
@@ -1482,6 +1487,205 @@ async def run_elasticity_leg(seed: int = 29):
     }
 
 
+async def run_tool_call_leg(n_deltas: int = 48, delta_sleep_s: float = 0.002,
+                            seed: int = 17):
+    """Tool-call streaming leg (ISSUE 15), pure CPU — a scripted pipeline
+    behind the REAL HttpService + incremental jail, so the leg lands on
+    any backend:
+
+      * time-to-first-tool-call-byte: one hermes call whose arguments
+        span ``n_deltas`` paced deltas. Measured at the SSE wire: wall
+        time to the first chunk carrying tool_calls argument bytes
+        (incremental jail, O(delta)) vs wall time to stream end — the
+        EARLIEST the old buffer-to-flush jail could have emitted the
+        call (O(call length)). The ratio is the headline.
+      * malformed recovery: seeded truncated/broken calls across the
+        marker dialects — every stream must complete ([DONE] reached,
+        degraded content or sealed call), zero dropped; plus one
+        fault-armed stream proving the typed terminal error frame
+        (error_kind=tool_call_parse).
+
+    The clean sub-leg's fault_plane record extends the zero-spurious
+    contract: parser_degraded / parser_exceptions must be ZERO there.
+    """
+    import random
+
+    import aiohttp
+
+    from dynamo_tpu.http import HttpService, ModelManager
+    from dynamo_tpu.llm import ModelDeploymentCard
+    from dynamo_tpu.llm.protocols.common import (
+        FinishReason,
+        PostprocessedOutput,
+    )
+    from dynamo_tpu.parsers.observe import parser_plane
+    from dynamo_tpu.runtime import fault_names as fn
+    from dynamo_tpu.runtime.faults import FaultPlan, armed
+
+    fault_activity0 = _fault_activity_start()
+
+    class PacedPipeline:
+        def __init__(self, deltas, pace_s=0.0):
+            self.deltas, self.pace_s = deltas, pace_s
+
+        async def generate(self, request, context):
+            yield {"annotation": "_prompt_tokens", "value": 3}
+            for i, text in enumerate(self.deltas):
+                if self.pace_s:
+                    await asyncio.sleep(self.pace_s)
+                yield PostprocessedOutput(
+                    text=text, token_ids=[i], cumulative_tokens=i + 1,
+                    finish_reason=(
+                        FinishReason.EOS
+                        if i == len(self.deltas) - 1 else None
+                    ),
+                )
+
+    async def serve(deltas, pace_s=0.0):
+        manager = ModelManager()
+        manager.register(
+            "bench-tools", PacedPipeline(deltas, pace_s),
+            ModelDeploymentCard(name="bench-tools", context_length=512),
+        )
+        service = HttpService(manager, host="127.0.0.1", port=0)
+        port = await service.start()
+        return service, port
+
+    async def stream_once(port, collect_first_args=True):
+        t0 = time.perf_counter()
+        first_args_t = None
+        saw_done = False
+        error_frame = None
+        n_args_chunks = 0
+        content_chars = 0
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "bench-tools",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}],
+                    "stream": True,
+                },
+            )
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                if line == "data: [DONE]":
+                    saw_done = True
+                    continue
+                payload = json.loads(line[6:])
+                if "error" in payload:
+                    error_frame = payload["error"]
+                    continue
+                delta = payload["choices"][0]["delta"]
+                content_chars += len(delta.get("content", ""))
+                for entry in delta.get("tool_calls", []):
+                    if (entry.get("function") or {}).get("arguments"):
+                        n_args_chunks += 1
+                        if first_args_t is None:
+                            first_args_t = time.perf_counter() - t0
+        return {
+            "first_args_s": first_args_t,
+            "end_s": time.perf_counter() - t0,
+            "saw_done": saw_done,
+            "error_frame": error_frame,
+            "args_chunks": n_args_chunks,
+            "content_chars": content_chars,
+        }
+
+    # -- sub-leg 1: time-to-first-tool-call-byte ---------------------------
+    args_body = ", ".join(f'"k{i}": {i}' for i in range(n_deltas))
+    call_text = (
+        '<tool_call>{"name": "f", "arguments": {' + args_body
+        + '}}</tool_call>'
+    )
+    step = max(1, len(call_text) // n_deltas)
+    deltas = [call_text[i:i + step] for i in range(0, len(call_text), step)]
+    service, port = await serve(deltas, pace_s=delta_sleep_s)
+    try:
+        clean = await stream_once(port)
+    finally:
+        await service.stop(grace_period=1)
+    assert clean["saw_done"] and clean["error_frame"] is None
+    # The zero-spurious record is cut HERE: the clean sub-leg must show
+    # zero parser-plane activations.
+    clean_fault_record = _fault_plane_record(fault_activity0)
+
+    # -- sub-leg 2: malformed recovery -------------------------------------
+    malformed = [
+        '<tool_call>{"name": "f", "arguments": {"a": [1, 2',
+        '<tool_call>{"name": "f", "arguments": {"a": 1]]}',
+        '[TOOL_CALLS]{"name": "f", "argu',
+        '<｜DSML｜function_calls><｜DSML｜invoke name="x">'
+        '<｜DSML｜parameter name="k" string="true">v',
+        '<|channel|>commentary to=functions.f <|message|>{"a": ',
+        '<tool_call><function=f><parameter=k>v',
+    ]
+    rng = random.Random(seed)
+    completed = 0
+    degrades_before = sum(parser_plane().degrades.values())
+    for text in malformed:
+        n = rng.randint(1, min(6, len(text) - 1))
+        cuts = sorted(rng.sample(range(1, len(text)), n))
+        parts, last = [], 0
+        for c in cuts:
+            parts.append(text[last:c])
+            last = c
+        parts.append(text[last:])
+        service, port = await serve(parts)
+        try:
+            res = await stream_once(port)
+        finally:
+            await service.stop(grace_period=1)
+        if res["saw_done"] and res["error_frame"] is None:
+            completed += 1
+    # MEASURED ladder activations (the parser plane's counters), not an
+    # assumption — a regression that silently passed malformed text
+    # through would read degraded < streams here.
+    degraded = sum(parser_plane().degrades.values()) - degrades_before
+
+    # -- sub-leg 3: injected parser death → typed frame --------------------
+    service, port = await serve(["safe ", '<tool_call>{"name": "f"'])
+    plan = FaultPlan.from_dict({
+        "seed": seed,
+        "rules": [{"point": fn.PARSER_JAIL_FEED, "kind": "error",
+                   "at": [2]}],
+    })
+    try:
+        with armed(plan):
+            res = await stream_once(port)
+    finally:
+        await service.stop(grace_period=1)
+    typed_frame_ok = (
+        res["error_frame"] is not None
+        and res["error_frame"].get("error_kind") == "tool_call_parse"
+    )
+
+    plane = parser_plane()
+    return {
+        # O(delta) vs O(call length): first argument byte vs stream end.
+        "ttfcb_ms": round(clean["first_args_s"] * 1e3, 2),
+        "stream_end_ms": round(clean["end_s"] * 1e3, 2),
+        "ttfcb_speedup_vs_flush_jail": round(
+            clean["end_s"] / max(clean["first_args_s"], 1e-9), 2
+        ),
+        "args_chunks_streamed": clean["args_chunks"],
+        "call_deltas": len(deltas),
+        "malformed_streams": len(malformed),
+        "malformed_completed": completed,
+        "malformed_dropped": len(malformed) - completed,
+        "malformed_degraded": degraded,
+        "parse_error_frame_typed": typed_frame_ok,
+        "parser_plane": plane.snapshot(),
+        # Zero-spurious contract (clean sub-leg only): parser_degraded
+        # and parser_exceptions must both read 0 here.
+        "fault_plane": clean_fault_record,
+    }
+
+
 # v5e inter-chip ICI: public spec is 400 Gbps/chip each direction
 # (~50 GB/s); 45 GB/s effective grants the usual ~90% achieved link rate.
 # Used ONLY by the 70B tp8 projection's collective term (one chip cannot
@@ -1835,6 +2039,17 @@ async def run_bench():
             out["crash"] = await run_crash_leg()
         except Exception as exc:
             out["crash"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if os.environ.get("BENCH_TOOLCALL", "1") != "0":
+        # Tool-call streaming leg (ISSUE 15): time-to-first-tool-call-byte
+        # O(delta) vs the old O(call-length) flush jail, malformed-call
+        # recovery with zero dropped streams, and the typed parse-error
+        # frame — pure CPU through the real HttpService, lands on any
+        # backend; never kills the headline.
+        try:
+            out["tool_call"] = await run_tool_call_leg()
+        except Exception as exc:
+            out["tool_call"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     if os.environ.get("BENCH_ELASTICITY", "1") != "0":
         # Elasticity leg (ISSUE 13): sim-clocked planner ramp (1×→4×→1×

@@ -158,6 +158,11 @@ class RingWriterConfig:
             # history; single writer: the frontend's event loop
             # (collector pump + local tracer listener).
             "trajectory": ("runtime/trajectory.py", "TrajectoryStore"),
+            # Parser plane (PR 15): tool-call jail commits, completed
+            # calls, degradation-ladder activations, parser exceptions;
+            # single writer: the frontend's event loop (every jail lives
+            # inside an SSE handler there).
+            "parser": ("parsers/observe.py", "ParserPlane"),
         }
     )
 

@@ -51,12 +51,18 @@ def _error_kind_of(exc: BaseException) -> Optional[str]:
     transport, deadline); generic programming errors stay unlabeled
     rather than masquerading as ``decode``."""
     from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
+    from dynamo_tpu.parsers.incremental import ToolCallParseError
     from dynamo_tpu.runtime.component import NoInstancesError
 
     if isinstance(exc, DisaggTransferError):
         return "disagg"
     if isinstance(exc, NoInstancesError):
         return "no_instances"
+    if isinstance(exc, ToolCallParseError):
+        # Tool-call parser BUG (parsers/jail.py wraps anything escaping
+        # the dialect machines): terminal typed frame, never a dropped
+        # stream — and never disguised as an upstream failure.
+        return "tool_call_parse"
     if isinstance(exc, (ConnectionError, TimeoutError, asyncio.TimeoutError)):
         return classify_failure(exc)
     return None
@@ -139,6 +145,7 @@ class HttpService:
         app.router.add_post("/v1/images/generations", self._images)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
         app.router.add_get("/debug/overload", self._debug_overload)
+        app.router.add_get("/debug/parser", self._debug_parser)
         app.router.add_get("/debug/trajectory", self._debug_trajectories)
         app.router.add_get(
             "/debug/trajectory/{trace_id}", self._debug_trajectory
@@ -181,15 +188,19 @@ class HttpService:
         openmetrics = "application/openmetrics-text" in request.headers.get(
             "Accept", ""
         )
+        from dynamo_tpu.parsers.observe import parser_plane
         from dynamo_tpu.runtime.trajectory import render_trajectory_metrics
 
         if openmetrics:
             # OpenMetrics exposition carries trace-id exemplars on the TTFT
             # and request-duration histograms (see http/metrics.py).
             body = self.metrics.render(openmetrics=True)
-            # Splice the overload + SLO families in BEFORE the # EOF
-            # terminator prometheus_client already appended.
-            extra = render_trajectory_metrics(openmetrics=True)
+            # Splice the overload + parser + SLO families in BEFORE the
+            # # EOF terminator prometheus_client already appended.
+            extra = (
+                parser_plane().metrics.render(openmetrics=True)
+                + "\n" + render_trajectory_metrics(openmetrics=True)
+            )
             if self.overload is not None:
                 extra = (
                     self.overload.metrics.render(openmetrics=True)
@@ -207,6 +218,9 @@ class HttpService:
             # The frontend's controller is the one that actually admits
             # and sheds — its families must be on THIS scrape surface.
             body = body + self.overload.metrics.render().encode() + b"\n"
+        # Parser plane (ALL_PARSER): the jail runs inside THIS process's
+        # SSE handlers — tool-call streaming health scrapes here.
+        body = body + parser_plane().metrics.render().encode() + b"\n"
         # SLO plane (ALL_SLO): goodput/burn-rate/phase gauges are fed by
         # THIS process's finished streams — they belong on this scrape.
         body = body + render_trajectory_metrics().encode() + b"\n"
@@ -247,6 +261,24 @@ class HttpService:
                 "enabled": True,
                 **self.overload.snapshot(),
                 "events": self.overload.flight.snapshot(limit=limit),
+            }
+        )
+
+    async def _debug_parser(self, request: web.Request) -> web.Response:
+        """Parser-plane snapshot + the 'parser' flight ring (the frontend
+        has no system server; this is its /debug/flight slice — same
+        shape as /debug/overload)."""
+        from dynamo_tpu.parsers.observe import parser_plane
+
+        try:
+            limit = int(request.query.get("limit", 256))
+        except ValueError:
+            limit = 256
+        plane = parser_plane()
+        return web.json_response(
+            {
+                **plane.snapshot(),
+                "events": plane.flight.snapshot(limit=limit),
             }
         )
 
@@ -621,6 +653,7 @@ class HttpService:
                 "post": op("Get/set one model's busy thresholds", body=True),
             },
             "/clear_kv_blocks": {"post": op("Flush worker KV prefix caches", body=True)},
+            "/debug/parser": {"get": op("Tool-call parser plane: stream outcomes, degrades, parser flight ring")},
             "/debug/trajectory": {"get": op("Fleet trajectory index (recent + slow/error, SLO snapshot)")},
             "/debug/trajectory/{trace_id}": {"get": op("One stitched cross-worker request trajectory")},
         }
@@ -939,7 +972,10 @@ class HttpService:
         )
         message: Dict[str, Any] = {"role": "assistant", "content": content}
         if body.get("tools"):
-            calls, content = detect_and_parse_tool_calls(content)
+            # Same dialect pin as the streaming jail (unary/stream parity).
+            calls, content = detect_and_parse_tool_calls(
+                content, dialect=getattr(entry.card, "tool_call_dialect", None)
+            )
             message["content"] = content
             if calls:
                 message["tool_calls"] = [c.to_openai() for c in calls]
@@ -1084,14 +1120,18 @@ class HttpService:
         finish_seen: Optional[str] = None
         audit_parts: Optional[list] = [] if self.audit.enabled else None
         reasoning_parser = ReasoningParser(style=entry.card.reasoning_style)
-        # Streaming tool-call jail (ref: jail.rs): when the request declared
-        # tools, raw dialect text is held back and surfaces as tool_calls
-        # deltas instead of content.
+        # Incremental tool-call jail (parsers/jail.py): when the request
+        # declared tools, dialect text surfaces as tool_calls ARGUMENT
+        # DELTAS while the model is still generating the call; malformed
+        # calls degrade via the typed ladder, never a dropped stream.
         jail = None
+        parse_error = False
         if kind == "chat" and body.get("tools"):
             from dynamo_tpu.parsers.jail import ToolCallJail
 
-            jail = ToolCallJail()
+            jail = ToolCallJail(
+                dialect=getattr(entry.card, "tool_call_dialect", None)
+            )
         try:
             async for item in _prepend(first_item, stream):
                 if isinstance(item, dict) and "annotation" in item:
@@ -1127,9 +1167,9 @@ class HttpService:
                 if finish_str:
                     finish_seen = finish_str
                 if kind == "chat":
-                    delta: Dict[str, Any] = {}
+                    base: Dict[str, Any] = {}
                     if not sent_role:
-                        delta["role"] = "assistant"
+                        base["role"] = "assistant"
                         sent_role = True
                     text = out.text
                     if out.finish_reason is not None:
@@ -1145,50 +1185,44 @@ class HttpService:
                         # Streamed reasoning rides the nonstandard-but-common
                         # reasoning_content delta field (ref: jail.rs stream
                         # rewriting for <think> sections).
-                        delta["reasoning_content"] = reasoning
+                        base["reasoning_content"] = reasoning
                     if jail is not None:
-                        content = jail.feed(content)
+                        events = jail.feed(content) if content else []
                         if out.finish_reason is not None:
-                            tail, jailed = jail.flush()
-                            content += tail
-                            if jailed:
-                                from dynamo_tpu.parsers import (
-                                    detect_and_parse_tool_calls,
-                                )
-                                from dynamo_tpu.parsers.jail import (
-                                    tool_call_stream_deltas,
-                                )
-
-                                calls, remainder = detect_and_parse_tool_calls(
-                                    jailed
-                                )
-                                if calls:
-                                    delta["tool_calls"] = (
-                                        tool_call_stream_deltas(calls)
-                                    )
-                                    finish_str = "tool_calls"
-                                    finish_seen = finish_str
-                                    # Text around the call survives, as in
-                                    # the unary path.
-                                    content += remainder
-                                else:  # false alarm: it was plain content
-                                    content += remainder
-                    if content:
-                        delta["content"] = content
+                            events = events + jail.finish()
+                            if jail.calls_started:
+                                # ANY emitted call — including one the
+                                # ladder sealed — finishes as tool_calls
+                                # (the seal's structured error field says
+                                # which calls are suspect).
+                                finish_str = "tool_calls"
+                                finish_seen = finish_str
+                        deltas = _fold_jail_events(base, events)
+                    else:
+                        if content:
+                            base["content"] = content
+                        deltas = [base]
                     # OpenAI semantics: content logprobs correspond to emitted
                     # content. When the reasoning parser withheld this chunk's
                     # text (or routed it into reasoning_content), attaching the
                     # token logprobs would describe tokens absent from the
                     # delta — suppress them for those chunks.
-                    chunk = chat_chunk(
-                        rid, entry.name, delta=delta, finish_reason=finish_str,
-                        logprobs=(
-                            chat_logprobs_block(out.logprobs)
-                            if out.logprobs
-                            and (delta.get("content") or delta.get("tool_calls"))
-                            else None
-                        ),
-                    )
+                    last = len(deltas) - 1
+                    for di, delta in enumerate(deltas):
+                        await _sse_send(response, chat_chunk(
+                            rid, entry.name, delta=delta,
+                            finish_reason=(
+                                finish_str if di == last else None
+                            ),
+                            logprobs=(
+                                chat_logprobs_block(out.logprobs)
+                                if out.logprobs and di == 0
+                                and (delta.get("content")
+                                     or delta.get("tool_calls"))
+                                else None
+                            ),
+                        ))
+                    continue
                 else:
                     lp_block = None
                     if out.logprobs:
@@ -1208,34 +1242,34 @@ class HttpService:
                 # Stream ended without a finish chunk (the unary path
                 # defaults to EOS here): release anything the reasoning
                 # parser or the jail still holds — buffered text must not
-                # vanish.
-                delta = {}
+                # vanish, and a call mid-generation is sealed by the
+                # jail's finish (truncated, typed).
+                base = {}
                 r_tail, c_tail = reasoning_parser.flush()
                 if r_tail:
-                    delta["reasoning_content"] = r_tail
-                content = c_tail
+                    base["reasoning_content"] = r_tail
                 if jail is not None:
-                    content = jail.feed(content)
-                    tail, jailed = jail.flush()
-                    content += tail
-                    if jailed:
-                        from dynamo_tpu.parsers import detect_and_parse_tool_calls
-                        from dynamo_tpu.parsers.jail import tool_call_stream_deltas
-
-                        calls, remainder = detect_and_parse_tool_calls(jailed)
-                        content += remainder
-                        if calls:
-                            delta["tool_calls"] = tool_call_stream_deltas(calls)
-                            finish_seen = "tool_calls"
-                if content:
-                    delta["content"] = content
+                    events = jail.feed(c_tail) if c_tail else []
+                    events = events + jail.finish()
+                    deltas = _fold_jail_events(base, events)
+                    if jail.calls_started:
+                        finish_seen = "tool_calls"
+                else:
+                    if c_tail:
+                        base["content"] = c_tail
+                    deltas = [base]
                 finish_seen = finish_seen or FinishReason.EOS.to_openai()
-                await _sse_send(
-                    response,
-                    chat_chunk(
-                        rid, entry.name, delta=delta, finish_reason=finish_seen
-                    ),
-                )
+                last = len(deltas) - 1
+                for di, delta in enumerate(deltas):
+                    await _sse_send(
+                        response,
+                        chat_chunk(
+                            rid, entry.name, delta=delta,
+                            finish_reason=(
+                                finish_seen if di == last else None
+                            ),
+                        ),
+                    )
             if include_usage and status == 200:
                 usage = usage_block(prompt_tokens, completion_tokens)
                 if kind == "chat":
@@ -1257,6 +1291,7 @@ class HttpService:
             # DisaggTransferError (no Migration operator to absorb it) lands
             # here and must not read as a dropped stream or anonymous 500.
             error_kind = _error_kind_of(exc)
+            parse_error = error_kind == "tool_call_parse"
             logger.exception("engine failed mid-stream")
             status = _status_of_kind(error_kind)
             frame = {
@@ -1268,6 +1303,15 @@ class HttpService:
                 await _sse_send(response, {"error": frame})
         finally:
             timer.done(status)
+            if jail is not None:
+                # Per-stream outcome for ALL_PARSER: clean | degraded |
+                # error (a wrapped parser exception = error — the client
+                # saw the typed frame above, not a dropped stream).
+                from dynamo_tpu.parsers.observe import parser_plane
+
+                parser_plane().note_stream(
+                    "error" if parse_error else jail.outcome()
+                )
             if audit_parts is not None:
                 from dynamo_tpu.http.audit import AuditRecord
 
@@ -1282,6 +1326,66 @@ class HttpService:
         with _suppress_conn_errors():
             await response.write_eof()
         return response
+
+
+def _fold_jail_events(base: Dict[str, Any], events) -> list:
+    """Fold incremental-jail events (parsers/incremental.py) into an
+    ordered list of chat ``delta`` dicts for one engine item.
+
+    ``base`` seeds the first delta (role / reasoning_content). Content
+    may share a delta with tool_calls entries that FOLLOW it, but content
+    arriving AFTER a tool_calls entry opens a new delta — OpenAI clients
+    replay deltas in order, and reordering content around a call would
+    corrupt the transcript (two back-to-back calls with content between
+    them is a supported shape). Consecutive argument deltas for the same
+    call index merge into one wire entry."""
+    from dynamo_tpu.parsers.incremental import (
+        ArgsDelta,
+        CallEnd,
+        CallStart,
+        ContentDelta,
+    )
+
+    deltas: list = [dict(base)]
+    for ev in events:
+        cur = deltas[-1]
+        if isinstance(ev, ContentDelta):
+            if not ev.text:
+                continue
+            if "tool_calls" in cur:
+                deltas.append({"content": ev.text})
+            else:
+                cur["content"] = cur.get("content", "") + ev.text
+        elif isinstance(ev, CallStart):
+            cur.setdefault("tool_calls", []).append(
+                {
+                    "index": ev.index,
+                    "id": ev.call_id,
+                    "type": "function",
+                    "function": {"name": ev.name, "arguments": ""},
+                }
+            )
+        elif isinstance(ev, ArgsDelta):
+            tcs = cur.setdefault("tool_calls", [])
+            if tcs and tcs[-1]["index"] == ev.index and "function" in tcs[-1]:
+                tcs[-1]["function"]["arguments"] += ev.text
+            else:
+                tcs.append(
+                    {"index": ev.index, "function": {"arguments": ev.text}}
+                )
+        elif isinstance(ev, CallEnd):
+            if ev.error is None and not ev.degraded:
+                continue
+            # Sealed / lossy call: the structured error field rides the
+            # call's last tool_calls entry (clients that ignore unknown
+            # fields see a normal, possibly truncated-args call).
+            entry: Dict[str, Any] = {"index": ev.index}
+            if ev.error is not None:
+                entry["error"] = {"reason": ev.error}
+            if ev.degraded:
+                entry["degraded"] = True
+            cur.setdefault("tool_calls", []).append(entry)
+    return deltas
 
 
 def _error_response(exc: OpenAIError) -> web.Response:

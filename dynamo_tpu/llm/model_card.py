@@ -44,16 +44,30 @@ class ModelDeploymentCard:
     # Reasoning-content marker style (parsers/reasoning.py KNOWN_MARKERS):
     # think | reasoning | seed | granite.
     reasoning_style: str = "think"
+    # Tool-call dialect pin (parsers/incremental.py DIALECTS): json |
+    # hermes | mistral | pythonic | harmony | dsml | xml. None =
+    # auto-detect by opening marker — required for the marker-less
+    # dialects (json, pythonic) to stream incrementally.
+    tool_call_dialect: Optional[str] = None
     runtime_config: RuntimeConfig = field(default_factory=RuntimeConfig)
     user_data: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
+        from dynamo_tpu.parsers.incremental import DIALECTS
         from dynamo_tpu.parsers.reasoning import KNOWN_MARKERS
 
         if self.reasoning_style not in KNOWN_MARKERS:
             raise ValueError(
                 f"unknown reasoning_style {self.reasoning_style!r}; "
                 f"known: {sorted(KNOWN_MARKERS)}"
+            )
+        if (
+            self.tool_call_dialect is not None
+            and self.tool_call_dialect not in DIALECTS
+        ):
+            raise ValueError(
+                f"unknown tool_call_dialect {self.tool_call_dialect!r}; "
+                f"known: {sorted(DIALECTS)}"
             )
 
     @property

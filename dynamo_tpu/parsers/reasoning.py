@@ -12,6 +12,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Sequence, Tuple
 
+from dynamo_tpu.parsers.holdback import find_first as _find_first
+from dynamo_tpu.parsers.holdback import holdback_split
+
 # style → (open-tag variants, close-tag variants). The first variant is the
 # canonical spelling; all variants are recognized on input.
 KNOWN_MARKERS = {
@@ -30,16 +33,6 @@ KNOWN_TAGS = {
     style: (opens[0], closes[0])
     for style, (opens, closes) in KNOWN_MARKERS.items()
 }
-
-
-def _find_first(text: str, tags: Sequence[str], start: int = 0):
-    """Earliest occurrence of any tag variant → (index, tag) or (-1, '')."""
-    best, best_tag = -1, ""
-    for tag in tags:
-        i = text.find(tag, start)
-        if i != -1 and (best == -1 or i < best):
-            best, best_tag = i, tag
-    return best, best_tag
 
 
 def split_reasoning(text: str, style: str = "think") -> Tuple[str, str]:
@@ -98,14 +91,9 @@ class ReasoningParser:
                     self._s.mode = "reasoning"
                 continue
             # No full tag: hold back the longest suffix that is a prefix of
-            # any tag variant we're looking for.
-            hold = 0
-            max_n = min(max(len(t) for t in tags) - 1, len(text))
-            for n in range(max_n, 0, -1):
-                if any(t.startswith(text[-n:]) for t in tags):
-                    hold = n
-                    break
-            emit, self._s.buffer = (text[:-hold], text[-hold:]) if hold else (text, "")
+            # any tag variant we're looking for (parsers/holdback.py — the
+            # same scheme the tool-call jail uses).
+            emit, self._s.buffer = holdback_split(text, tags)
             (reasoning_out if self._s.mode == "reasoning" else content_out).append(emit)
             break
         return "".join(reasoning_out), "".join(content_out)

@@ -29,9 +29,14 @@ class ToolCall:
     name: str
     arguments: Dict[str, Any] = field(default_factory=dict)
     call_id: str = ""
+    # True when the argument string was unparseable and shipped as a
+    # lossy {"__raw__": ...} wrap — surfaced on the emitted call (and
+    # counted per dialect) so clients and the SLO plane can see lossy
+    # parses instead of silently acting on mangled arguments.
+    degraded: bool = False
 
     def to_openai(self) -> Dict[str, Any]:
-        return {
+        entry: Dict[str, Any] = {
             "id": self.call_id or f"call-{uuid.uuid4().hex[:24]}",
             "type": "function",
             "function": {
@@ -39,6 +44,9 @@ class ToolCall:
                 "arguments": json.dumps(self.arguments, separators=(",", ":")),
             },
         }
+        if self.degraded:
+            entry["degraded"] = True
+        return entry
 
 
 def _normalize(obj: Any) -> Optional[ToolCall]:
@@ -52,14 +60,16 @@ def _normalize(obj: Any) -> Optional[ToolCall]:
     if not isinstance(name, str) or not name:
         return None
     args = obj.get("arguments", obj.get("parameters", {}))
+    degraded = False
     if isinstance(args, str):
         try:
             args = json.loads(args)
         except json.JSONDecodeError:
             args = {"__raw__": args}
+            degraded = True
     if not isinstance(args, dict):
         args = {"value": args}
-    return ToolCall(name=name, arguments=args)
+    return ToolCall(name=name, arguments=args, degraded=degraded)
 
 
 def _parse_json_calls(text: str) -> List[ToolCall]:
@@ -144,13 +154,15 @@ def _parse_harmony(text: str) -> Tuple[List[ToolCall], str]:
     calls: List[ToolCall] = []
     for m in _HARMONY_CALL_RE.finditer(text):
         name, payload = m.group(1), m.group(2).strip()
+        degraded = False
         try:
             args = json.loads(payload)
         except json.JSONDecodeError:
             args = {"__raw__": payload}
+            degraded = True
         if not isinstance(args, dict):
             args = {"value": args}
-        calls.append(ToolCall(name=name, arguments=args))
+        calls.append(ToolCall(name=name, arguments=args, degraded=degraded))
     finals = _HARMONY_FINAL_RE.findall(text)
     remainder = "".join(f.strip() for f in finals)
     return calls, remainder
@@ -223,35 +235,63 @@ def _parse_xml(text: str) -> Tuple[List[ToolCall], str]:
     return calls, remainder
 
 
+def _count_degraded(calls: List[ToolCall], dialect: str) -> None:
+    """Lossy {"__raw__": ...} argument wraps are an SLO-visible event:
+    counted per dialect (parser_degraded_args_total) next to the
+    ``degraded: true`` marker already on the emitted call."""
+    n = sum(1 for c in calls if c.degraded)
+    if not n:
+        return
+    from dynamo_tpu.parsers.observe import parser_plane
+
+    plane = parser_plane()
+    for _ in range(n):
+        plane.note_degraded_args(dialect)
+
+
 def detect_and_parse_tool_calls(
     text: str, dialect: Optional[str] = None
 ) -> Tuple[List[ToolCall], str]:
     """Returns (tool_calls, remaining_content). ``dialect`` pins a format;
     None auto-detects (hermes → mistral → json → pythonic)."""
     if dialect == "hermes":
-        return _parse_hermes(text)
+        calls, remainder = _parse_hermes(text)
+        _count_degraded(calls, "hermes")
+        return calls, remainder
     if dialect == "mistral":
-        return _parse_mistral(text)
+        calls, remainder = _parse_mistral(text)
+        _count_degraded(calls, "mistral")
+        return calls, remainder
     if dialect == "json":
         calls = _parse_json_calls(text)
+        _count_degraded(calls, "json")
         return calls, "" if calls else text
     if dialect == "pythonic":
         calls = _parse_pythonic(text)
         return calls, "" if calls else text
     if dialect == "harmony":
-        return _parse_harmony(text)
+        calls, remainder = _parse_harmony(text)
+        _count_degraded(calls, "harmony")
+        return calls, remainder
     if dialect == "dsml":
-        return _parse_dsml(text)
+        calls, remainder = _parse_dsml(text)
+        _count_degraded(calls, "dsml")
+        return calls, remainder
     if dialect == "xml":
-        return _parse_xml(text)
+        calls, remainder = _parse_xml(text)
+        _count_degraded(calls, "xml")
+        return calls, remainder
 
-    for parser in (_parse_harmony, _parse_dsml, _parse_xml, _parse_hermes,
-                   _parse_mistral):
+    for name, parser in (("harmony", _parse_harmony), ("dsml", _parse_dsml),
+                         ("xml", _parse_xml), ("hermes", _parse_hermes),
+                         ("mistral", _parse_mistral)):
         calls, remainder = parser(text)
         if calls:
+            _count_degraded(calls, name)
             return calls, remainder
     calls = _parse_json_calls(text)
     if calls:
+        _count_degraded(calls, "json")
         return calls, ""
     calls = _parse_pythonic(text)
     if calls:

@@ -23,6 +23,7 @@ from dynamo_tpu.runtime.metric_names import (
     ALL_LIVENESS,
     ALL_MIGRATION,
     ALL_OVERLOAD,
+    ALL_PARSER,
     ALL_PLANNER,
     ALL_ROUTER,
     ALL_RUNTIME,
@@ -47,6 +48,7 @@ __all__ = [
     "ALL_LIVENESS",
     "ALL_MIGRATION",
     "ALL_OVERLOAD",
+    "ALL_PARSER",
     "ALL_PLANNER",
     "ALL_ROUTER",
     "ALL_RUNTIME",

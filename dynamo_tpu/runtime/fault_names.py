@@ -87,6 +87,14 @@ PLANNER_APPLY = "planner.apply"
 # data plane; the shipper tests replay this).
 TRAJECTORY_SHIP = "trajectory.ship"
 
+# -- parser plane (parsers/jail.py) -------------------------------------------
+# One hit per jail operation (each content delta fed, plus the finish at
+# stream end): an injection models the tool-call parser dying mid-stream
+# — which MUST surface as a terminal typed SSE error frame
+# (error_kind=tool_call_parse), never a dropped stream (the chunk-fuzz
+# chaos suite replays this bit-identically).
+PARSER_JAIL_FEED = "parser.jail.feed"
+
 # -- overload plane (runtime/overload.py) -------------------------------------
 # One hit per QUEUED admission attempt, before the EDF wait: an injected
 # timeout here expires exactly that request's queue budget — the
@@ -116,4 +124,5 @@ ALL_FAULT_POINTS = (
     PLANNER_APPLY,
     TRAJECTORY_SHIP,
     OVERLOAD_ADMIT,
+    PARSER_JAIL_FEED,
 )

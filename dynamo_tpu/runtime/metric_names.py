@@ -254,6 +254,34 @@ OVERLOAD_QUEUE_DELAY = f"{OVERLOAD_PREFIX}_queue_delay_seconds"
 # expired mid-queue) — shed before any prefill work.
 OVERLOAD_DEADLINE_EXPIRED_TOTAL = f"{OVERLOAD_PREFIX}_deadline_expired_total"
 
+# -- parser plane (parsers/observe.py ParserPlane) ----------------------------
+PARSER_PREFIX = "dynamo_tpu_parser"
+# Tool calls fully streamed through the incremental jail, by dialect.
+PARSER_TOOL_CALLS_TOTAL = f"{PARSER_PREFIX}_tool_calls_total"
+# Argument-delta characters emitted while the call was still being
+# generated — the incremental jail's reason to exist (the old jail held
+# every argument byte until stream end).
+PARSER_ARGS_DELTA_CHARS_TOTAL = f"{PARSER_PREFIX}_args_delta_chars_total"
+# Degradation-ladder activations by dialect and reason (truncated |
+# bad_nesting | drift | buffer_cap | ...) — a malformed call sealed or
+# returned to content, never a dropped stream.
+PARSER_DEGRADED_CALLS_TOTAL = f"{PARSER_PREFIX}_degraded_calls_total"
+# Calls whose argument string was unparseable and shipped as a lossy
+# {"__raw__": ...} wrap (tool_calling._normalize and its streaming twin);
+# the emitted call carries degraded=true so clients and the SLO plane can
+# see lossy parses.
+PARSER_DEGRADED_ARGS_TOTAL = f"{PARSER_PREFIX}_degraded_args_total"
+# Parser BUGS (not malformed model output): each surfaced as a terminal
+# typed SSE error frame (error_kind=tool_call_parse).
+PARSER_EXCEPTIONS_TOTAL = f"{PARSER_PREFIX}_exceptions_total"
+# Tool-enabled streams through the jail by outcome (clean | degraded |
+# error).
+PARSER_STREAMS_TOTAL = f"{PARSER_PREFIX}_streams_total"
+# Peak jailed-buffer size (chars) — bounded by the jail's buffer cap.
+PARSER_JAIL_BUFFERED_PEAK_CHARS = (
+    f"{PARSER_PREFIX}_jail_buffered_peak_chars"
+)
+
 # -- SLO plane (runtime/trajectory.py SloTracker) -----------------------------
 SLO_PREFIX = "dynamo_tpu_slo"
 # Rolling-window fraction of finished streams that met BOTH the TTFT and
@@ -361,6 +389,16 @@ ALL_SLO = (
     SLO_STREAMS_TOTAL,
     SLO_BURN_RATE,
     SLO_PHASE_P99_MS,
+)
+
+ALL_PARSER = (
+    PARSER_TOOL_CALLS_TOTAL,
+    PARSER_ARGS_DELTA_CHARS_TOTAL,
+    PARSER_DEGRADED_CALLS_TOTAL,
+    PARSER_DEGRADED_ARGS_TOTAL,
+    PARSER_EXCEPTIONS_TOTAL,
+    PARSER_STREAMS_TOTAL,
+    PARSER_JAIL_BUFFERED_PEAK_CHARS,
 )
 
 ALL_OVERLOAD = (

@@ -25,6 +25,12 @@ def err_kind(exc: BaseException) -> str:
         return "draining"
     if type(exc).__name__ == "NoInstancesError":
         return "no_instances"
+    if type(exc).__name__ == "ToolCallParseError":
+        # Tool-call parser BUG (parsers/jail.py): typed so an agent SDK
+        # can distinguish a parse failure (retryable with tools off /
+        # another dialect) from a transport death — and so the stream it
+        # ends reads as a terminal typed frame, never a drop.
+        return "tool_call_parse"
     if isinstance(exc, (TimeoutError, asyncio.TimeoutError)):
         return "timeout"
     if isinstance(exc, ConnectionError):
@@ -42,6 +48,10 @@ def err_exception(kind: str, message: str) -> BaseException:
         from dynamo_tpu.runtime.component import NoInstancesError
 
         return NoInstancesError(message)
+    if kind == "tool_call_parse":
+        from dynamo_tpu.parsers.incremental import ToolCallParseError
+
+        return ToolCallParseError(message)
     if kind == "timeout":
         return TimeoutError(message)
     if kind == "connection":

@@ -58,38 +58,174 @@ class TestReasoning:
         assert c2 == "<thi"  # not a real tag; returned verbatim
 
 
+class TestHoldback:
+    """The shared suffix-holdback helper (parsers/holdback.py) — one
+    implementation for the reasoning splitter, the jail's detector, and
+    the dialect machines (two hand-rolled copies used to drift)."""
+
+    def test_holds_longest_marker_prefix(self):
+        from dynamo_tpu.parsers.holdback import holdback_split
+
+        emit, hold = holdback_split("abc<tool", ("<tool_call>",))
+        assert (emit, hold) == ("abc", "<tool")
+
+    def test_prefers_longer_straddle_across_variants(self):
+        from dynamo_tpu.parsers.holdback import holdback_split
+
+        emit, hold = holdback_split(
+            "x<tool_c", ("<tool_call>", "<t>")
+        )
+        assert (emit, hold) == ("x", "<tool_c")
+
+    def test_no_prefix_no_hold(self):
+        from dynamo_tpu.parsers.holdback import holdback_split
+
+        assert holdback_split("plain text", ("<tool_call>",)) == (
+            "plain text", ""
+        )
+
+    def test_full_marker_not_this_functions_job(self):
+        # A COMPLETE marker is find_first's case; holdback only guards
+        # the straddle. Verify the pair composes at every split point.
+        from dynamo_tpu.parsers.holdback import find_first, holdback_split
+
+        marker = "<｜DSML｜"
+        text = "pre" + marker + "post"
+        for cut in range(1, len(text)):
+            a, b = text[:cut], text[cut:]
+            idx, _ = find_first(a, (marker,))
+            if idx == -1:
+                emit, hold = holdback_split(a, (marker,))
+                joined = hold + b
+                jdx, _ = find_first(joined, (marker,))
+                assert jdx != -1, f"marker lost at cut {cut}"
+                assert emit + joined == text
+
+    def test_empty_inputs(self):
+        from dynamo_tpu.parsers.holdback import find_first, holdback_split
+
+        assert holdback_split("", ("<x>",)) == ("", "")
+        assert holdback_split("abc", ()) == ("abc", "")
+        assert find_first("abc", ()) == (-1, "")
+
+
 class TestToolCallJail:
-    """Streaming tool-call holdback (parsers/jail.py; ref: jail.rs)."""
+    """Streaming tool-call jail (parsers/jail.py — the incremental
+    orchestrator; ref: jail.rs). Event-level semantics; the full
+    per-dialect streaming matrix lives in tests/test_tool_stream.py."""
 
-    def _run(self, deltas):
-        from dynamo_tpu.parsers.jail import ToolCallJail
+    def _run(self, deltas, dialect=None):
+        from dynamo_tpu.parsers import (
+            ArgsDelta,
+            CallEnd,
+            CallStart,
+            ContentDelta,
+            ToolCallJail,
+        )
 
-        jail = ToolCallJail()
-        released = "".join(jail.feed(d) for d in deltas)
-        tail, jailed = jail.flush()
-        return released + tail, jailed
+        jail = ToolCallJail(dialect)
+        events = []
+        for d in deltas:
+            events += jail.feed(d)
+        events += jail.finish()
+        content = "".join(
+            e.text for e in events if isinstance(e, ContentDelta)
+        )
+        calls = {}
+        for e in events:
+            if isinstance(e, CallStart):
+                calls[e.index] = {"name": e.name, "args": "", "error": None}
+            elif isinstance(e, ArgsDelta):
+                calls[e.index]["args"] += e.text
+            elif isinstance(e, CallEnd):
+                calls[e.index]["error"] = e.error
+        return content, calls, jail
 
-    def test_marker_spanning_deltas_jails_everything_after(self):
-        content, jailed = self._run(
+    def test_marker_spanning_deltas_streams_the_call(self):
+        content, calls, jail = self._run(
             ["before ", "<tool", "_call>", '{"name":"f"}', "</tool_call>"]
         )
         assert content == "before "
-        assert jailed == '<tool_call>{"name":"f"}</tool_call>'
+        assert calls[0]["name"] == "f"
+        assert json.loads(calls[0]["args"]) == {}
+        assert calls[0]["error"] is None
 
-    def test_mistral_and_dsml_markers(self):
-        for marker in ("[TOOL_CALLS]", "<｜DSML｜"):
-            content, jailed = self._run(["hi ", marker + "stuff"])
-            assert content == "hi "
-            assert jailed.startswith(marker)
+    def test_mistral_marker_without_payload_degrades_to_content(self):
+        content, calls, _ = self._run(["hi ", "[TOOL_CALLS]stuff"])
+        assert content.startswith("hi ")
+        # 'stuff' is not a call list: the ladder returns the jailed text.
+        assert "stuff" in content
+        assert calls == {}
 
-    def test_false_alarm_released_on_flush(self):
-        content, jailed = self._run(["half <too"])
+    def test_false_alarm_released_on_finish(self):
+        content, calls, _ = self._run(["half <too"])
         assert content == "half <too"
-        assert jailed == ""
+        assert calls == {}
 
     def test_plain_content_passthrough(self):
-        content, jailed = self._run(["just ", "text"])
-        assert content == "just text" and jailed == ""
+        content, calls, _ = self._run(["just ", "text"])
+        assert content == "just text" and calls == {}
+
+    def test_args_deltas_arrive_before_call_closes(self):
+        """The incremental property: argument deltas are emitted while
+        the call is still mid-generation (the old jail held everything
+        until flush)."""
+        from dynamo_tpu.parsers import ArgsDelta, ToolCallJail
+
+        jail = ToolCallJail()
+        evs = []
+        evs += jail.feed('<tool_call>{"name": "f", "arguments": {"a": ')
+        assert any(isinstance(e, ArgsDelta) for e in evs), (
+            "no argument delta before the call closed"
+        )
+        evs2 = jail.feed('1}}</tool_call>')
+        assert jail.calls_done == 1
+
+    def test_truncated_call_sealed_at_finish(self):
+        content, calls, jail = self._run(
+            ['<tool_call>{"name": "f", "arguments": {"a": 1']
+        )
+        assert calls[0]["error"] == "truncated"
+        assert jail.outcome() == "degraded"
+
+    def test_buffer_cap_degrades_not_grows(self):
+        from dynamo_tpu.parsers import CallEnd, ContentDelta, ToolCallJail
+
+        jail = ToolCallJail(buffer_cap=64)
+        evs = jail.feed("<tool_call>")
+        # A payload that never parses a name keeps buffering; the cap
+        # must degrade it to content instead of growing forever.
+        evs += jail.feed('{"nam' + "x" * 200)
+        assert any(isinstance(e, ContentDelta) for e in evs)
+        assert "buffer_cap" in jail.degrade_reasons
+        # Passthrough afterwards: no further jailing.
+        evs2 = jail.feed("<tool_call> more")
+        assert [e for e in evs2 if isinstance(e, ContentDelta)]
+
+    def test_parse_exception_is_typed(self):
+        from dynamo_tpu.parsers import ToolCallJail, ToolCallParseError
+
+        jail = ToolCallJail()
+
+        class Boom:
+            dialect = "boom"
+
+            def feed(self, text):
+                raise RuntimeError("internal bug")
+
+            def raw_len(self):
+                return 0
+
+        jail._machine = Boom()
+        jail._mode = 1  # _STREAM
+        with pytest.raises(ToolCallParseError):
+            jail.feed("x")
+
+    def test_unknown_dialect_rejected(self):
+        from dynamo_tpu.parsers import ToolCallJail
+
+        with pytest.raises(ValueError):
+            ToolCallJail("klingon")
 
 
 class TestGraniteReasoning:
@@ -196,6 +332,24 @@ class TestToolCalls:
             '{"name": "f", "arguments": "{\\"x\\": 2}"}'
         )
         assert calls[0].arguments == {"x": 2}
+        assert calls[0].degraded is False
+        assert "degraded" not in calls[0].to_openai()
+
+    def test_unparseable_string_arguments_marked_degraded(self):
+        """A lossy {"__raw__": ...} wrap is visible: degraded flag on the
+        call, degraded: true on the wire, and a per-dialect counter."""
+        from dynamo_tpu.parsers.observe import parser_plane
+
+        before = parser_plane().metrics.degraded_args.value(dialect="json")
+        calls, _ = detect_and_parse_tool_calls(
+            '{"name": "f", "arguments": "not json at all {"}',
+            dialect="json",
+        )
+        assert calls[0].arguments == {"__raw__": "not json at all {"}
+        assert calls[0].degraded is True
+        assert calls[0].to_openai()["degraded"] is True
+        after = parser_plane().metrics.degraded_args.value(dialect="json")
+        assert after == before + 1
 
 
 class TestHarmonyDialect:

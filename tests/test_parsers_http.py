@@ -183,6 +183,352 @@ async def test_streaming_jail_survives_missing_finish_chunk():
         await service.stop(grace_period=1)
 
 
+async def _read_stream(resp):
+    """Drain one SSE response → (chunks, saw_done, error_frame).
+    The connection reading to its natural end IS the never-dropped
+    property — a dropped stream raises here."""
+    chunks, saw_done, error_frame = [], False, None
+    async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        if line == "data: [DONE]":
+            saw_done = True
+            continue
+        payload = json.loads(line[6:])
+        if "error" in payload:
+            error_frame = payload["error"]
+            continue
+        chunks.append(payload)
+    return chunks, saw_done, error_frame
+
+
+def _merge_tool_calls(chunks):
+    """OpenAI client-side merge: tool_calls delta entries fold by index
+    (name/id from the opener, arguments concatenated in order)."""
+    calls = {}
+    for ch in chunks:
+        for entry in ch["choices"][0]["delta"].get("tool_calls", []):
+            c = calls.setdefault(
+                entry["index"],
+                {"name": None, "id": None, "arguments": "",
+                 "error": None, "degraded": False},
+            )
+            fn = entry.get("function") or {}
+            if fn.get("name"):
+                c["name"] = fn["name"]
+            if entry.get("id"):
+                c["id"] = entry["id"]
+            c["arguments"] += fn.get("arguments", "")
+            if entry.get("error"):
+                c["error"] = entry["error"]
+            if entry.get("degraded"):
+                c["degraded"] = True
+    return calls
+
+
+async def test_streaming_args_deltas_arrive_mid_generation():
+    """THE incremental property, measured at the SSE wire: the client
+    receives tool_calls argument deltas while the model is still
+    generating the call. The pipeline BLOCKS after emitting the first
+    argument fragment until the client confirms it saw an argument
+    delta — with the old buffering jail this deadlocks (timeout)."""
+    import asyncio
+
+    client_saw_args = asyncio.Event()
+
+    class GatedPipeline:
+        async def generate(self, request, context):
+            yield {"annotation": "_prompt_tokens", "value": 3}
+            yield PostprocessedOutput(
+                text='<tool_call>{"name": "get_weather", '
+                     '"arguments": {"city": "Par',
+                token_ids=[0], cumulative_tokens=1, finish_reason=None,
+            )
+            # The call is mid-generation HERE: its closing brace and
+            # </tool_call> do not exist yet. The stream only continues
+            # once the client has already consumed an argument delta.
+            await asyncio.wait_for(client_saw_args.wait(), timeout=10)
+            yield PostprocessedOutput(
+                text='is"}}</tool_call>', token_ids=[1],
+                cumulative_tokens=2, finish_reason=FinishReason.EOS,
+            )
+
+    manager = ModelManager()
+    manager.register(
+        "scripted", GatedPipeline(),
+        ModelDeploymentCard(name="scripted", context_length=512),
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    port = await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "weather?"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "get_weather"}}],
+                    "stream": True,
+                },
+            )
+            chunks = []
+            args_seen_early = ""
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                payload = json.loads(line[6:])
+                chunks.append(payload)
+                for entry in payload["choices"][0]["delta"].get(
+                    "tool_calls", []
+                ):
+                    fn = entry.get("function") or {}
+                    if fn.get("arguments"):
+                        if not client_saw_args.is_set():
+                            args_seen_early = fn["arguments"]
+                        client_saw_args.set()
+        assert client_saw_args.is_set(), "no args delta while mid-generation"
+        assert '"city"' in args_seen_early or "Par" in args_seen_early
+        merged = _merge_tool_calls(chunks)
+        assert merged[0]["name"] == "get_weather"
+        assert json.loads(merged[0]["arguments"]) == {"city": "Paris"}
+        finish = [
+            c["choices"][0]["finish_reason"] for c in chunks
+            if c["choices"][0]["finish_reason"]
+        ]
+        assert finish == ["tool_calls"]
+    finally:
+        await service.stop(grace_period=1)
+
+
+DIALECT_STREAMS = {
+    "hermes": 'ok <tool_call>{"name": "f", "arguments": {"a": 1}}'
+              '</tool_call>',
+    "mistral": '[TOOL_CALLS][{"name": "f", "arguments": {"a": 1}}]',
+    "xml": '<tool_call><function=f><parameter=a>1</parameter>'
+           '</function></tool_call>',
+    "harmony": '<|channel|>commentary to=functions.f '
+               '<|constrain|>json<|message|>{"a":1}<|call|>'
+               '<|channel|>final<|message|>done<|end|>',
+    "dsml": '<｜DSML｜function_calls><｜DSML｜invoke name="f">'
+            '<｜DSML｜parameter name="a" string="false">1</｜DSML｜parameter>'
+            '</｜DSML｜invoke></｜DSML｜function_calls>',
+}
+
+
+async def test_streaming_all_marker_dialects_e2e():
+    """Every auto-detected dialect streams to a well-formed tool_calls
+    SSE stream (name + arguments reassemble, finish=tool_calls)."""
+    import random
+
+    for dialect, text in DIALECT_STREAMS.items():
+        rng = random.Random(f"e2e:{dialect}")
+        cuts = sorted(rng.sample(range(1, len(text)), 6))
+        deltas, last = [], 0
+        for c in cuts:
+            deltas.append(text[last:c])
+            last = c
+        deltas.append(text[last:])
+        service, port = await start(deltas)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={
+                        "model": "scripted",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "tools": [{"type": "function",
+                                   "function": {"name": "f"}}],
+                        "stream": True,
+                    },
+                )
+                chunks, saw_done, error_frame = await _read_stream(r)
+            assert error_frame is None, (dialect, error_frame)
+            assert saw_done, dialect
+            merged = _merge_tool_calls(chunks)
+            assert merged and merged[0]["name"] == "f", dialect
+            assert json.loads(merged[0]["arguments"]) == {"a": 1}, dialect
+        finally:
+            await service.stop(grace_period=1)
+
+
+async def test_streaming_malformed_chaos_zero_dropped_streams():
+    """The never-dropped-stream guarantee at the wire: seeded malformed
+    corpora (truncations + structural breaks) across every dialect, each
+    re-split at randomized delta boundaries — EVERY stream reads to its
+    natural end with [DONE]; broken calls surface as degraded content or
+    a sealed call, never a connection drop."""
+    import random
+
+    malformed = [
+        '<tool_call>{"name": "f", "arguments": {"a": [1, 2',
+        '<tool_call>{"name": "f", "arguments": {"a": 1]]}',
+        '[TOOL_CALLS]{"name": "f", "argu',
+        '[TOOL_CALLS] prose, not a list',
+        '<｜DSML｜function_calls><｜DSML｜invoke name="x">'
+        '<｜DSML｜parameter name="k" string="true">v',
+        '<｜DSML｜oops>not the block',
+        '<|channel|>commentary to=functions.f <|message|>{"a": ',
+        '<|channel|>weird<|message|>body<|end|>',
+        '<tool_call><function=f><parameter=k>v',
+        '<tool_call><wrong=f>',
+        'text then <tool_call>{"nam',
+    ]
+    for ci, text in enumerate(malformed):
+        rng = random.Random(f"chaos:{ci}")
+        n = rng.randint(1, min(8, len(text) - 1))
+        cuts = sorted(rng.sample(range(1, len(text)), n))
+        deltas, last = [], 0
+        for c in cuts:
+            deltas.append(text[last:c])
+            last = c
+        deltas.append(text[last:])
+        service, port = await start(deltas)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={
+                        "model": "scripted",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "tools": [{"type": "function",
+                                   "function": {"name": "f"}}],
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200, ci
+                chunks, saw_done, error_frame = await _read_stream(r)
+            # Completion: [DONE] reached (malformed input is DEGRADED,
+            # not an error frame — error frames are for parser bugs).
+            assert saw_done, f"case {ci}: stream did not complete"
+            assert error_frame is None, f"case {ci}: {error_frame}"
+            merged = _merge_tool_calls(chunks)
+            content = "".join(
+                c["choices"][0]["delta"].get("content", "") for c in chunks
+            )
+            # Nothing silently vanished: either the jailed text came
+            # back as content or a (possibly sealed) call was emitted.
+            assert content or merged, f"case {ci}: output vanished"
+            finish = [
+                c["choices"][0]["finish_reason"] for c in chunks
+                if c["choices"][0]["finish_reason"]
+            ]
+            assert finish, f"case {ci}: no finish chunk"
+        finally:
+            await service.stop(grace_period=1)
+
+
+async def test_streaming_sealed_call_carries_structured_error():
+    """A truncated call whose deltas already reached the client is
+    sealed: finish_reason=tool_calls + a structured error field on the
+    sealing tool_calls entry."""
+    service, port = await start(
+        ['<tool_call>{"name": "f", "arguments": {"a": 1, "b": ']
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}],
+                    "stream": True,
+                },
+            )
+            chunks, saw_done, error_frame = await _read_stream(r)
+        assert saw_done and error_frame is None
+        merged = _merge_tool_calls(chunks)
+        assert merged[0]["name"] == "f"
+        assert merged[0]["error"] == {"reason": "truncated"}
+        finish = [
+            c["choices"][0]["finish_reason"] for c in chunks
+            if c["choices"][0]["finish_reason"]
+        ]
+        assert finish == ["tool_calls"]
+    finally:
+        await service.stop(grace_period=1)
+
+
+async def test_parser_death_is_terminal_typed_frame_not_a_drop():
+    """A parser exception mid-stream (injected deterministically at the
+    parser.jail.feed seam) surfaces as the PR 8 terminal SSE error frame
+    with error_kind=tool_call_parse — the connection still ends cleanly,
+    and already-delivered content was not lost."""
+    from dynamo_tpu.runtime import fault_names as fn
+    from dynamo_tpu.runtime.faults import FaultPlan, armed
+
+    service, port = await start(
+        ["safe text ", '<tool_call>{"name": "f", "arguments": {}}'
+         '</tool_call>']
+    )
+    plan = FaultPlan.from_dict({
+        "seed": 11,
+        "rules": [{"point": fn.PARSER_JAIL_FEED, "kind": "error",
+                   "at": [2]}],
+    })
+    try:
+        with armed(plan):
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={
+                        "model": "scripted",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "tools": [{"type": "function",
+                                   "function": {"name": "f"}}],
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200
+                chunks, _saw_done, error_frame = await _read_stream(r)
+        assert error_frame is not None, "no terminal error frame"
+        assert error_frame["error_kind"] == "tool_call_parse"
+        content = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert content == "safe text "
+    finally:
+        await service.stop(grace_period=1)
+
+
+async def test_streaming_two_calls_with_content_between_e2e():
+    """Two back-to-back calls with content between them: distinct
+    indices on the wire, content interleaved in order."""
+    service, port = await start([
+        'first <tool_call>{"name": "a", "arguments": {}}</tool_call>',
+        ' mid <tool_call>{"name": "b", "arguments": {"k": 1}}'
+        '</tool_call> end',
+    ])
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={
+                    "model": "scripted",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tools": [{"type": "function",
+                               "function": {"name": "a"}}],
+                    "stream": True,
+                },
+            )
+            chunks, saw_done, error_frame = await _read_stream(r)
+        assert saw_done and error_frame is None
+        merged = _merge_tool_calls(chunks)
+        assert sorted(merged) == [0, 1]
+        assert merged[0]["name"] == "a" and merged[1]["name"] == "b"
+        assert json.loads(merged[1]["arguments"]) == {"k": 1}
+        content = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert content == "first  mid  end"
+    finally:
+        await service.stop(grace_period=1)
+
+
 async def test_streaming_reasoning_deltas():
     service, port = await start(
         ["<th", "ink>deep ", "thought</think>", "the answer ", "is 4"]
