@@ -114,7 +114,10 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
       - ~4 flight-recorder appends per burst (engine dispatch + reap,
         runner decode + its transfer_log mirror),
       - 1 stats-snapshot publish per reap (``stats_publish_us``, measured
-        against the run's real engine in run_depth).
+        against the run's real engine in run_depth),
+      - 1 KV-reuse feed per admitted request (engine note_request +
+        router sketch touch + per-chunk prefill-cost EWMA), charged at
+        the worst case of one admission per burst.
 
     Everything else (HBM ledger, metric rendering, compile bookkeeping)
     runs at scrape/compile time, off the tick path. The acceptance bar is
@@ -171,8 +174,36 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
     span_us = (_time.perf_counter() - t0) / M * 1e6
     trajectory_request_us = 3 * span_us
 
+    # KV-reuse plane (runtime/kv_reuse_observe.py): an ADMITTED request
+    # pays one note_request (sketch touch + ROI counter bumps) on the
+    # engine side and one sketch touch on the router side; the per-chunk
+    # EWMA update (note_prefill_cost) rides the prefill path, charged
+    # here too. The ROI trajectory event is the same ring-append +
+    # shipper-enqueue shape the trajectory term above already prices.
+    # Charged per burst at the worst case of one admission every burst.
+    from dynamo_tpu.runtime.kv_reuse_observe import KvReusePlane
+
+    plane = KvReusePlane(capacity=4096)
+    t0 = _time.perf_counter()
+    for i in range(M):
+        plane.note_request(
+            anchor=i & 0xFFF, cached_tokens=96, recomputed_tokens=32,
+            tier="device", trace_id=None,
+        )
+    note_request_us = (_time.perf_counter() - t0) / M * 1e6
+    t0 = _time.perf_counter()
+    for i in range(M):
+        plane.note_router_match(i & 0xFFF, tokens=96, worker=(1, 0))
+    router_touch_us = (_time.perf_counter() - t0) / M * 1e6
+    t0 = _time.perf_counter()
+    for _ in range(M):
+        plane.note_prefill_cost(0.01, 128)
+    prefill_cost_us = (_time.perf_counter() - t0) / M * 1e6
+    kv_reuse_request_us = note_request_us + router_touch_us + prefill_cost_us
+
     per_burst_us = (
         watch_us + 4 * record_us + stats_publish_us + trajectory_request_us
+        + kv_reuse_request_us
     )
     return {
         "watched_dispatch_us": round(watch_us, 3),
@@ -180,6 +211,10 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
         "stats_publish_us": round(stats_publish_us, 3),
         "trajectory_span_us": round(span_us, 3),
         "trajectory_request_us": round(trajectory_request_us, 3),
+        "kv_note_request_us": round(note_request_us, 3),
+        "kv_router_touch_us": round(router_touch_us, 3),
+        "kv_prefill_cost_us": round(prefill_cost_us, 3),
+        "kv_reuse_request_us": round(kv_reuse_request_us, 3),
         "per_burst_us": round(per_burst_us, 3),
         "overhead_pct_of_burst": round(
             100 * per_burst_us / 1000 / max(wall_per_burst_ms, 1e-9), 4

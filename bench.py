@@ -34,7 +34,10 @@ SIGTERM-drain / kill-9-crash legs), BENCH_PROJECTION=0 (skip the modeled
 inputs on TPU, roofline-modeled inputs elsewhere), BENCH_ELASTICITY=0
 (skip the sim-clocked elasticity leg: planner ramp convergence,
 scale-down re-prefill, select_worker cost at 10 vs 100 workers — pure
-CPU arithmetic, lands on any backend).
+CPU arithmetic, lands on any backend), BENCH_KVREUSE=0 (skip the
+KV-reuse leg: shared-prefix mix through a tiny real engine — hit rate
+by tier, prefill tokens saved, TTFT delta vs cold-cache control; lands
+on any backend).
 """
 
 from __future__ import annotations
@@ -169,6 +172,47 @@ def _fault_plane_record(activity_before: dict) -> dict:
     }
 
 
+def _kv_reuse_start() -> dict:
+    """Snapshot the KV-reuse plane's counters before a leg."""
+    from dynamo_tpu.runtime.kv_reuse_observe import global_plane
+
+    m = global_plane().metrics
+    return {
+        "hits": {t: m.hits.value(tier=t) for t in sorted(m._known_tiers)},
+        "misses": m.misses.value(),
+        "reused": m.reused_tokens.value(),
+        "recomputed": m.recomputed_tokens.value(),
+        "saved_s": m.seconds_saved.value(),
+    }
+
+
+def _kv_reuse_record(before: dict) -> dict:
+    """KV-reuse deltas for one leg: hit rate by tier, reused vs recomputed
+    prefill tokens, and the plane's priced prefill-seconds-saved. On the
+    random-prompt decode legs hit_rate reads ~0 — the number exists so a
+    cache win (or an accounting regression) is visible NEXT TO the tok/s
+    headline, not in a separate tool."""
+    after = _kv_reuse_start()
+    hits = {
+        t: after["hits"].get(t, 0) - before["hits"].get(t, 0)
+        for t in after["hits"]
+    }
+    hits = {t: n for t, n in hits.items() if n > 0}
+    misses = after["misses"] - before["misses"]
+    lookups = sum(hits.values()) + misses
+    return {
+        "hit_rate": round(sum(hits.values()) / lookups, 4) if lookups else 0.0,
+        "hit_rate_by_tier": {
+            t: round(n / lookups, 4) for t, n in hits.items()
+        } if lookups else {},
+        "hits": {t: int(n) for t, n in hits.items()},
+        "misses": int(misses),
+        "tokens_saved": int(after["reused"] - before["reused"]),
+        "tokens_recomputed": int(after["recomputed"] - before["recomputed"]),
+        "prefill_seconds_saved": round(after["saved_s"] - before["saved_s"], 4),
+    }
+
+
 def _trajectory_start() -> dict:
     """Snapshot the trajectory plane's counters before a leg (the SLO
     verdicts + span ingest deltas the zero-spurious record reads)."""
@@ -254,6 +298,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
     compile_before = global_compile_watcher().totals()
     fault_activity0 = _fault_activity_start()
     trajectory0 = _trajectory_start()
+    kv_reuse0 = _kv_reuse_start()
 
     cfg = {
         "qwen2.5-0.5b": qwen2_500m_config,
@@ -464,6 +509,7 @@ async def run_leg(model_name: str, quant: str | None, spec: str | None,
         "hbm_util": round(toks_per_sec / roofline, 4),
         "fault_plane": _fault_plane_record(fault_activity0),
         "trajectory": _trajectory_record(trajectory0),
+        "kv_reuse": _kv_reuse_record(kv_reuse0),
         **(
             {
                 "spec_proposed": stats.get("spec_proposed", 0),
@@ -1686,6 +1732,123 @@ async def run_tool_call_leg(n_deltas: int = 48, delta_sleep_s: float = 0.002,
     }
 
 
+async def run_kv_reuse_leg(n_prefixes: int = 6, requests: int = 36,
+                           isl: int = 96, osl: int = 8, seed: int = 23):
+    """KV-reuse leg (ISSUE 16): a tiny REAL engine (prefix caching on)
+    under a shared-prefix traffic mix vs a cold-cache control — lands on
+    any backend:
+
+      * hit rate by tier + reused/recomputed prefill tokens + priced
+        prefill-seconds-saved, read from the KV-reuse plane's counters
+        (the same numbers /debug/kvcache serves);
+      * p50 TTFT delta: shared-prefix wave vs the control wave of
+        distinct random prompts (the cache's actual latency win);
+      * top-prefix coherence: the sketch's hot anchors must cover the
+        shared prefixes the leg just replayed.
+    """
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import tiny_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.kv_reuse_observe import global_plane
+
+    fault_activity0 = _fault_activity_start()
+    block_size = 8
+    rng = np.random.default_rng(seed)
+    # Prefix length is a whole number of blocks so the replayed prefix
+    # is fully matchable; the 2-block suffix keeps every request distinct.
+    prefix_len = (isl - 2 * block_size) // block_size * block_size
+    prefixes = [
+        rng.integers(10, 200, size=prefix_len).tolist()
+        for _ in range(n_prefixes)
+    ]
+
+    async def sub_leg(shared: bool) -> dict:
+        engine = JaxEngine(
+            JaxEngineArgs(
+                config=tiny_config(),
+                block_size=block_size,
+                num_kv_blocks=1024,
+                max_num_seqs=8,
+                max_model_len=isl + osl + 2 * block_size,
+                prefill_chunk=32,
+                enable_prefix_caching=True,
+                decode_steps=4,
+            )
+        )
+        before = _kv_reuse_start()
+        ttfts: list = []
+
+        async def run_one(i: int) -> None:
+            if shared:
+                toks = (
+                    prefixes[i % n_prefixes]
+                    + rng.integers(10, 200, size=isl - prefix_len).tolist()
+                )
+            else:
+                toks = rng.integers(10, 200, size=isl).tolist()
+            request = PreprocessedRequest(
+                token_ids=toks,
+                request_id=f"kvreuse-{'warm' if shared else 'cold'}-{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            )
+            t0 = time.monotonic()
+            ttft = None
+            async for out in engine.generate(request, Context()):
+                if out.token_ids and ttft is None:
+                    ttft = time.monotonic() - t0
+            if ttft is not None:
+                ttfts.append(ttft)
+
+        sem = asyncio.Semaphore(4)
+
+        async def limited(i: int) -> None:
+            async with sem:
+                await run_one(i)
+
+        if shared:
+            # Prime wave: first touch of each prefix is the unavoidable
+            # cold miss — measured TTFTs start after it.
+            await asyncio.gather(*(limited(i) for i in range(n_prefixes)))
+            ttfts.clear()
+        await asyncio.gather(
+            *(limited(n_prefixes + i) for i in range(requests))
+        )
+        await engine.stop()
+        record = _kv_reuse_record(before)
+        record["p50_ttft_ms"] = round(
+            1000 * sorted(ttfts)[len(ttfts) // 2], 2
+        )
+        return record
+
+    warm = await sub_leg(shared=True)
+    cold = await sub_leg(shared=False)
+    top = global_plane().sketch.top(n_prefixes)
+    return {
+        "n_prefixes": n_prefixes,
+        "requests_per_sub_leg": requests,
+        "isl": isl,
+        "osl": osl,
+        "hit_rate": warm["hit_rate"],
+        "hit_rate_by_tier": warm["hit_rate_by_tier"],
+        "prefill_tokens_saved": warm["tokens_saved"],
+        "prefill_seconds_saved": warm["prefill_seconds_saved"],
+        "p50_ttft_ms_warm": warm["p50_ttft_ms"],
+        "p50_ttft_ms_cold": cold["p50_ttft_ms"],
+        "ttft_delta_ms": round(
+            cold["p50_ttft_ms"] - warm["p50_ttft_ms"], 2
+        ),
+        "cold_control": cold,
+        "top_prefixes_tracked": len(top),
+        "fault_plane": _fault_plane_record(fault_activity0),
+    }
+
+
 # v5e inter-chip ICI: public spec is 400 Gbps/chip each direction
 # (~50 GB/s); 45 GB/s effective grants the usual ~90% achieved link rate.
 # Used ONLY by the 70B tp8 projection's collective term (one chip cannot
@@ -2050,6 +2213,16 @@ async def run_bench():
             out["tool_call"] = await run_tool_call_leg()
         except Exception as exc:
             out["tool_call"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if os.environ.get("BENCH_KVREUSE", "1") != "0":
+        # KV-reuse leg (ISSUE 16): shared-prefix traffic through a tiny
+        # real engine — hit rate by tier, prefill tokens/seconds saved,
+        # and the TTFT delta vs a cold-cache control. Lands on any
+        # backend; never kills the headline.
+        try:
+            out["kv_reuse_leg"] = await run_kv_reuse_leg()
+        except Exception as exc:
+            out["kv_reuse_leg"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     if os.environ.get("BENCH_ELASTICITY", "1") != "0":
         # Elasticity leg (ISSUE 13): sim-clocked planner ramp (1×→4×→1×

@@ -125,7 +125,7 @@ class RingWriterConfig:
     inside the owning class; anything else is a cross-thread write the
     single-writer ring contract cannot survive."""
 
-    ring_attrs: FrozenSet[str] = frozenset({"flight"})
+    ring_attrs: FrozenSet[str] = frozenset({"flight", "kv_flight"})
     recorder_class: str = "FlightRecorder"
     owners: Dict[str, Tuple[str, str]] = field(
         default_factory=lambda: {
@@ -146,6 +146,10 @@ class RingWriterConfig:
             # KVBM integrity events (tier corruption); single writer: the
             # manager's event loop (onboard + offload spill paths).
             "kvbm": ("kvbm/manager.py", "TieredKvManager"),
+            # KV-reuse plane (PR 16): offload bursts, onboards, tier
+            # evictions, sketch replacements; single writer: the manager's
+            # event loop (same loop as the kvbm ring).
+            "kvcache": ("kvbm/manager.py", "TieredKvManager"),
             # Crash plane (PR 10): worker suspect/dead/rejoin transitions
             # + stale-incarnation drops; single writer: the consuming
             # frontend's event loop (worker_monitor pump + evaluate task).

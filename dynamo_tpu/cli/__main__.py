@@ -69,7 +69,8 @@ def main(argv=None) -> None:
     observe_p = sub.add_parser(
         "observe",
         help="snapshot a running worker's device plane "
-        "(/debug/memory /debug/compiles /debug/flight)",
+        "(/debug/memory /debug/compiles /debug/flight); sub-views: "
+        "trajectory, kvcache",
     )
     add_observe_args(observe_p)
     drain_p = sub.add_parser(

@@ -206,15 +206,19 @@ async def run_http(pipeline, card: ModelDeploymentCard, args) -> None:
 
 def add_observe_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
-        "what", nargs="?", default=None, choices=[None, "trajectory"],
+        "what", nargs="?", default=None,
+        choices=[None, "trajectory", "kvcache"],
         help="optional sub-view: 'trajectory' pretty-prints one stitched "
-        "request trajectory (GET /debug/trajectory/{trace_id})",
+        "request trajectory (GET /debug/trajectory/{trace_id}); 'kvcache' "
+        "pretty-prints the KV-reuse plane (GET /debug/kvcache)",
     )
     parser.add_argument(
         "trace_id", nargs="?", default=None,
         help="trace id for the trajectory sub-view (omit to list "
         "recent + slow trajectories)",
     )
+    parser.add_argument("--top-k", type=int, default=15,
+                        help="ranked prefixes to show in the kvcache view")
     parser.add_argument("--host", default="127.0.0.1",
                         help="system-server host of the running worker")
     parser.add_argument("--port", type=int, default=None,
@@ -396,6 +400,93 @@ async def main_observe_trajectory(args) -> None:
             )
 
 
+async def main_observe_kvcache(args) -> None:
+    """Pretty-print the KV-reuse plane of a running worker: hit rate by
+    tier, cache ROI (reused vs recomputed prefill tokens, prefill seconds
+    saved), sketch health, and the ranked hot-prefix table — 'is the
+    prefix cache earning its memory' in one command."""
+    import aiohttp
+
+    from dynamo_tpu import config
+
+    port = args.port if args.port is not None else config.SYSTEM_PORT.get()
+    base = f"http://{args.host}:{port}"
+    top_k = max(int(getattr(args, "top_k", 15) or 15), 1)
+    async with aiohttp.ClientSession() as session:
+        async def get(path):
+            async with session.get(base + path) as r:
+                if r.status != 200:
+                    raise SystemExit(
+                        f"GET {base}{path} -> {r.status}: {await r.text()}"
+                    )
+                return await r.json()
+
+        try:
+            doc = await get(f"/debug/kvcache?top_k={top_k}")
+            prefixes = await get(f"/debug/kvcache/prefixes?k={top_k}")
+        except aiohttp.ClientError as exc:
+            raise SystemExit(f"cannot reach system server at {base}: {exc}")
+
+    if args.json:
+        print(json.dumps({"kvcache": doc, "prefixes": prefixes}, indent=2))
+        return
+
+    print(f"== kv reuse ({base}/debug/kvcache)")
+    hits = doc.get("hits") or {}
+    misses = doc.get("misses", 0)
+    total = sum(hits.values()) + misses
+    overall = (sum(hits.values()) / total) if total else 0.0
+    per_tier = " ".join(
+        f"{t}={r:.3f}" for t, r in (doc.get("hit_rate") or {}).items()
+    )
+    print(
+        f"  hit rate {overall:.3f}  "
+        f"(hits={sum(hits.values())} misses={misses}"
+        f"{'; by tier: ' + per_tier if per_tier else ''})"
+    )
+    print(
+        f"  prefill tokens  reused={doc.get('reused_prefill_tokens', 0)}  "
+        f"recomputed={doc.get('recomputed_prefill_tokens', 0)}"
+    )
+    print(
+        f"  prefill saved   {doc.get('prefill_seconds_saved', 0.0):.3f} s  "
+        f"(cost/token {doc.get('prefill_cost_per_token_s', 0.0):.2e} s)"
+    )
+    sketch = doc.get("sketch") or {}
+    print(
+        f"  sketch          {sketch.get('tracked', 0)}/"
+        f"{sketch.get('capacity', 0)} tracked  "
+        f"replacements={sketch.get('replacements', 0)}  "
+        f"half_life={sketch.get('half_life_s', 0.0):.0f}s"
+    )
+    tiers = doc.get("tiers") or {}
+    for label, view in tiers.items():
+        print(f"  [{label}]")
+        for tier, stats in (view or {}).items():
+            if not isinstance(stats, dict):
+                continue
+            detail = " ".join(
+                f"{k}={stats[k]}" for k in
+                ("blocks", "stored", "hits", "misses", "evicted")
+                if k in stats
+            )
+            print(f"    {tier:<8} {detail}")
+    rows = prefixes.get("prefixes") or []
+    print(f"\n== hot prefixes (top {top_k}; {base}/debug/kvcache/prefixes)")
+    if not rows:
+        print("  (no tracked prefixes)")
+    for row in rows:
+        tier_mix = ",".join(
+            f"{t}:{n}" for t, n in (row.get("tiers") or {}).items()
+        )
+        print(
+            f"  {row.get('anchor', '?')}  score={row.get('score', 0.0):>10.2f} "
+            f"(+/-{row.get('score_error', 0.0):.2f})  hits={row.get('hits', 0):>6} "
+            f"tokens={row.get('tokens_from_cache', 0):>9} "
+            f"age={row.get('age_s', 0.0):>7.1f}s  {tier_mix}"
+        )
+
+
 async def main_observe(args) -> None:
     """One-shot pretty snapshot of /debug/memory, /debug/compiles and
     /debug/flight from a running worker's system server — the operator's
@@ -406,6 +497,9 @@ async def main_observe(args) -> None:
 
     if getattr(args, "what", None) == "trajectory":
         await main_observe_trajectory(args)
+        return
+    if getattr(args, "what", None) == "kvcache":
+        await main_observe_kvcache(args)
         return
 
     port = args.port if args.port is not None else config.SYSTEM_PORT.get()

@@ -23,6 +23,7 @@ import numpy as np
 
 from dynamo_tpu.engines.tpu.runner import _next_pow2
 from dynamo_tpu.runtime import lifecycle
+from dynamo_tpu.runtime.kv_reuse_observe import global_plane as kv_reuse_plane
 from dynamo_tpu.tokens.blocks import adapter_salt, compute_block_hashes
 
 from dynamo_tpu.llm.protocols.common import (
@@ -213,7 +214,13 @@ class Admitter:
                 n_dev = e.pool.match_prefix(hashes)
                 if n_dev < len(hashes):
                     try:
-                        await e.kvbm.onboard(hashes)
+                        if await e.kvbm.onboard(hashes):
+                            # Hit attribution for the KV-reuse plane: the
+                            # match was extended from a lower tier.
+                            seq.kv_hit_tier = (
+                                getattr(e.kvbm, "last_onboard_source", None)
+                                or "host"
+                            )
                     except Exception:
                         logger.exception("KV onboard failed; prefilling locally")
             matched, ids = e.pool.pin_prefix(hashes)
@@ -269,6 +276,15 @@ class Admitter:
                 context=seq.context,
                 prompt_tokens=len(seq.all_tokens),
                 cached_tokens=prep.matched_tokens,
+            )
+            # Cache-ROI attribution: one feed per admitted request, on the
+            # engine side only (the router feeds popularity, not ROI).
+            seq.kv_roi = kv_reuse_plane().note_request(
+                anchor=prep.hashes[prep.matched - 1] if prep.matched else None,
+                cached_tokens=prep.matched_tokens,
+                recomputed_tokens=len(seq.all_tokens) - prep.matched_tokens,
+                tier=getattr(seq, "kv_hit_tier", "device"),
+                trace_id=lifecycle.trace_id_of(seq.context),
             )
         first: List[Optional[Tuple[int, float, Optional[list]]]] = [None] * rows
         # Any row asking for top-N logprobs routes the batch through the
@@ -351,14 +367,18 @@ class Admitter:
                 temp, topk, topp, adapter,
                 mm_embeds, mm_chunk, procs, want_top, first_chunk, salts,
             )
+            dt = time.monotonic() - t0
             e.step_metrics.observe_prefill(
                 # Occupancy counts rows still prefilling this round — short
                 # prompts finish earlier chunk rounds and ride along with
                 # lens == 0.
-                time.monotonic() - t0,
+                dt,
                 int(np.count_nonzero(lens[:rows])),
                 int(lens.sum()),
             )
+            # Per-token prefill cost EWMA — the basis for the plane's
+            # prefill-seconds-saved estimate.
+            kv_reuse_plane().note_prefill_cost(dt, int(lens.sum()))
             for r in range(rows):
                 n = int(lens[r])
                 if n == 0:

@@ -185,6 +185,11 @@ class _Sequence:
     t_prefill_start: float = 0.0
     t_first_out: float = 0.0
     t_detached: float = 0.0
+    # KV-reuse attribution (runtime/kv_reuse_observe.py): the tier this
+    # request's prefix hit resolved from and the ROI dict stamped at
+    # admission (cached/recomputed tokens, estimated seconds saved).
+    kv_hit_tier: str = "device"
+    kv_roi: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -762,11 +767,14 @@ class JaxEngine:
                 proc=proc,
             )
             if seq.t_prefill_start:
+                roi = seq.kv_roi or {}
                 export_span(
                     "engine.prefill", seq.context,
                     start_mono=seq.t_prefill_start,
                     end_mono=seq.t_first_out or end,
                     proc=proc, prompt_tokens=len(seq.prompt),
+                    cached_tokens=roi.get("cached_tokens"),
+                    prefill_seconds_saved=roi.get("seconds_saved"),
                 )
             if seq.t_first_out:
                 # A handed-off stream's decode ends at detach — the relay

@@ -10,6 +10,7 @@ content; onboarding extends the device prefix match at admission time.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -35,6 +36,17 @@ class KvbmMetrics:
         from dynamo_tpu.runtime.metrics_core import MetricsRegistry
 
         self.registry = MetricsRegistry()
+        self.offload_duration = self.registry.histogram(
+            mn.KVBM_OFFLOAD_DURATION,
+            "Wall time of one offload burst (device -> tiers)",
+            ["tier"],
+        )
+        self.onboard_duration = self.registry.histogram(
+            mn.KVBM_ONBOARD_DURATION,
+            "Wall time of one onboard walk (tiers -> device), labeled by "
+            "the deepest tier the run resolved from",
+            ["tier"],
+        )
         self.offload_blocks = self.registry.counter(
             mn.KVBM_OFFLOAD_BLOCKS_TOTAL, "KV blocks offloaded device->tiers"
         )
@@ -57,7 +69,10 @@ class KvbmMetrics:
             mn.KVBM_TIER_BLOCKS, "Blocks resident per tier", ["tier"]
         )
         self.tier_evictions = self.registry.counter(
-            mn.KVBM_TIER_EVICTIONS_TOTAL, "LRU evictions per tier", ["tier"]
+            mn.KVBM_TIER_EVICTIONS_TOTAL,
+            "Evictions per tier by reason (arena_full = straight spill "
+            "past a full pinned arena, capacity = LRU overflow)",
+            ["tier", "reason"],
         )
         self.pool_pressure_truncations = self.registry.counter(
             mn.KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL,
@@ -77,13 +92,29 @@ class KvbmMetrics:
         time under the given tier label."""
         self._tier_sources[name] = tier
 
+    def unwatch_tier(self, name: str) -> None:
+        """Departed-tier GC: stop sampling and drop the occupancy gauge
+        series (counters keep their monotonic history)."""
+        self._tier_sources.pop(name, None)
+        self.tier_blocks.remove(tier=name)
+
     def _sample_tiers(self) -> None:
         for name, tier in self._tier_sources.items():
             stats = getattr(tier, "stats", None)
             if stats is not None:
                 self.lookup_hits.set_total(stats.hits, tier=name)
                 self.lookup_misses.set_total(stats.misses, tier=name)
-                self.tier_evictions.set_total(stats.evicted, tier=name)
+                by_reason = getattr(stats, "evicted_by_reason", None) or {}
+                accounted = 0
+                for reason, n in by_reason.items():
+                    self.tier_evictions.set_total(n, tier=name, reason=reason)
+                    accounted += n
+                # Tier impls that bump .evicted without a reason (foreign
+                # TierStats ducks) still reconcile to the labeled total.
+                if stats.evicted > accounted:
+                    self.tier_evictions.set_total(
+                        stats.evicted - accounted, tier=name, reason="unknown"
+                    )
             try:
                 self.tier_blocks.set(len(tier), tier=name)
             except TypeError:
@@ -147,6 +178,21 @@ class TieredKvManager:
         from dynamo_tpu.runtime.device_observe import FlightRecorder
 
         self.flight = FlightRecorder("kvbm", capacity=128)
+        # Tier-flow ring for the KV-reuse plane (DYN005 owner "kvcache";
+        # single writer: this manager's event loop — offload bursts,
+        # onboard walks, and the eviction/sketch delta syncs below all run
+        # on it). Distinct from "kvbm" (integrity events) so reuse-flow
+        # archaeology is not interleaved with corruption forensics.
+        self.kv_flight = FlightRecorder("kvcache", capacity=256)
+        # KV-reuse plane feeds: evictions and sketch replacements are
+        # mirrored as DELTAS at the manager's sync points, so several
+        # managers in one process stay additive on the global counters.
+        from dynamo_tpu.runtime.kv_reuse_observe import global_plane
+
+        self.kv_plane = global_plane()
+        self._evict_seen: Dict[Tuple[str, str], int] = {}
+        self._sketch_replacements_seen = self.kv_plane.sketch.replacements
+        self.last_onboard_source: Optional[str] = None
         self.metrics.watch_tier(getattr(top_tier, "name", "host"), top_tier)
         if top_tier.next_tier is not None:
             self.metrics.watch_tier(
@@ -160,6 +206,14 @@ class TieredKvManager:
                 )
         if remote is not None:
             self.metrics.watch_tier("remote", remote)
+        # Live per-tier occupancy for GET /debug/kvcache (several managers
+        # per process each get a distinct source label).
+        self._plane_label = "kvbm"
+        if self._plane_label in self.kv_plane._tier_sources:
+            self._plane_label = f"kvbm@{id(self):x}"
+        self.kv_plane.register_tier_source(
+            self._plane_label, self.tier_occupancy
+        )
         # hash → chain depth, queued for offload
         self._pending: "asyncio.Queue[Tuple[int, int]]" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
@@ -183,6 +237,55 @@ class TieredKvManager:
             "tier_corrupt", tier=tier, block=f"{block_hash:016x}",
             detail=detail,
         )
+
+    def tier_occupancy(self) -> Dict[str, Any]:
+        """Per-tier blocks + TierStats for GET /debug/kvcache."""
+        out: Dict[str, Any] = {}
+        for name, tier in self.metrics._tier_sources.items():
+            entry: Dict[str, Any] = {}
+            try:
+                entry["blocks"] = len(tier)
+            except TypeError:
+                pass
+            stats = getattr(tier, "stats", None)
+            if stats is not None:
+                entry.update(stats.to_dict())
+                by_reason = getattr(stats, "evicted_by_reason", None)
+                if by_reason:
+                    entry["evicted_by_reason"] = dict(by_reason)
+            out[name] = entry
+        return out
+
+    def _sync_plane(self) -> None:
+        """Mirror eviction/corruption/sketch-churn deltas into the global
+        KV-reuse plane and the kvcache flight ring. Runs on the manager's
+        event loop after offload bursts and onboard walks (the only paths
+        that mutate the tiers), keeping the ring single-writer and several
+        managers additive on the process-global counters."""
+        for name, tier in self.metrics._tier_sources.items():
+            stats = getattr(tier, "stats", None)
+            if stats is None:
+                continue
+            reasons = dict(getattr(stats, "evicted_by_reason", None) or {})
+            corrupt = getattr(stats, "corrupt", 0)
+            if corrupt:
+                reasons["corrupt"] = corrupt
+            for reason, total in reasons.items():
+                seen = self._evict_seen.get((name, reason), 0)
+                if total > seen:
+                    self._evict_seen[(name, reason)] = total
+                    self.kv_plane.note_eviction(name, reason, total - seen)
+                    self.kv_flight.record(
+                        "evict", tier=name, reason=reason, n=total - seen
+                    )
+        replaced = self.kv_plane.sketch.replacements
+        if replaced > self._sketch_replacements_seen:
+            self.kv_flight.record(
+                "sketch_replace",
+                n=replaced - self._sketch_replacements_seen,
+                tracked=len(self.kv_plane.sketch),
+            )
+            self._sketch_replacements_seen = replaced
 
     def notify_commit(self, block_hash: int, chain_depth: int) -> None:
         if self.filter.admit(chain_depth, block_hash) and not self.tier.contains(block_hash):
@@ -216,6 +319,8 @@ class TieredKvManager:
         todo = [h for h in hashes if not self.tier.contains(h)]
         if not todo:
             return
+        t0 = time.monotonic()
+        moved = 0
         # Wire-form export (disagg/wire.py): quantized pools offload their
         # {q8, scales} form verbatim — G2/G3 hold half the dense footprint
         # and onboarding restores bit-exact pool content. The export stops
@@ -238,8 +343,18 @@ class TieredKvManager:
                 dk, dv = wire.to_dense()
                 self.remote.put(h, dk[0], dv[0])
             self.offloaded += 1
+            moved += 1
             self.metrics.offload_blocks.inc()
             self.metrics.offload_bytes.inc(int(wire.nbytes))
+        dt = time.monotonic() - t0
+        self.metrics.offload_duration.observe(
+            dt, tier=getattr(self.tier, "name", "host")
+        )
+        self.kv_flight.record(
+            "offload_burst", blocks=moved, queued=len(todo),
+            ms=round(dt * 1000.0, 3),
+        )
+        self._sync_plane()
 
     # -- onboard (G2/G3 → G1) ------------------------------------------------
 
@@ -260,9 +375,25 @@ class TieredKvManager:
         assert self._engine is not None
         from dynamo_tpu.disagg.wire import tier_block_wire
 
+        t0 = time.monotonic()
         run: List[int] = []
         blocks: List[tuple] = []
+        # Deepest tier the run resolved from (hit attribution for the
+        # KV-reuse plane; checked BEFORE get() because get() promotes).
+        tier_rank = {getattr(self.tier, "name", "host"): 0}
+        if self.tier.next_tier is not None:
+            tier_rank[getattr(self.tier.next_tier, "name", "disk")] = 1
+        deepest: Optional[str] = None
         for h in block_hashes:
+            if self.tier.contains(h):
+                src = getattr(self.tier, "name", "host")
+            elif (
+                self.tier.next_tier is not None
+                and self.tier.next_tier.contains(h)
+            ):
+                src = getattr(self.tier.next_tier, "name", "disk")
+            else:
+                src = "remote"
             blk = self.tier.get(h)
             if blk is None and self.remote is not None:
                 # G4 fallback: a shared-store hit extends the run (and lands
@@ -272,8 +403,11 @@ class TieredKvManager:
                     self.tier.put(h, *blk)
             if blk is None:
                 break
+            if deepest is None or tier_rank.get(src, 2) > tier_rank.get(deepest, 2):
+                deepest = src
             run.append(h)
             blocks.append(blk)
+        self.last_onboard_source = deepest
         if not run:
             return 0
 
@@ -302,12 +436,20 @@ class TieredKvManager:
             i = j
         self.onboarded += installed
         self.metrics.onboard_blocks.inc(installed)
+        dt = time.monotonic() - t0
+        self.metrics.onboard_duration.observe(dt, tier=deepest or "host")
+        self.kv_flight.record(
+            "onboard", blocks=installed, run=len(run),
+            tier=deepest or "host", ms=round(dt * 1000.0, 3),
+        )
+        self._sync_plane()
         return installed
 
     def register_metrics(self, server: Any) -> None:
         """Expose this manager's metric families on a SystemStatusServer."""
         server.register_metrics(self.metrics.render)
         server.register_flight(self.flight.name, self.flight.snapshot)
+        server.register_flight(self.kv_flight.name, self.kv_flight.snapshot)
 
     def stats(self) -> Dict[str, Any]:
         out = {
@@ -327,5 +469,12 @@ class TieredKvManager:
         if self._task is not None and not self._task.done():
             self._task.cancel()
             await reap_task(self._task, "kvbm consolidator", logger)
+        # Departed-tier GC: this manager's occupancy gauges and its live
+        # tier source leave the scrape with it (zero-residue audit — a
+        # long-lived SystemStatusServer must not keep advertising the
+        # occupancy of tiers that no longer exist).
+        for name in list(self.metrics._tier_sources):
+            self.metrics.unwatch_tier(name)
+        self.kv_plane.forget_tier_source(self._plane_label)
         if self.remote is not None:
             await self.remote.close()

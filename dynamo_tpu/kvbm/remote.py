@@ -55,7 +55,7 @@ class KvStoreHandler:
                 self.stats.stored += 1
                 while len(self._blocks) > self.capacity:
                     self._blocks.popitem(last=False)
-                    self.stats.evicted += 1
+                    self.stats.note_evicted("capacity")
             else:
                 self._blocks.move_to_end(h)
             yield {"ok": True}

@@ -21,7 +21,7 @@ import io
 import os
 import zipfile
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -45,6 +45,16 @@ class TierStats:
     evicted: int = 0
     # CRC-failed / unreadable persisted blocks, each ALSO counted a miss.
     corrupt: int = 0
+    # Eviction split by reason (arena_full = straight spill past a full
+    # pinned arena, capacity = LRU overflow); sum == evicted. Sampled into
+    # {tier, reason}-labeled counters by KvbmMetrics at scrape time.
+    evicted_by_reason: Dict[str, int] = field(default_factory=dict)
+
+    def note_evicted(self, reason: str) -> None:
+        self.evicted += 1
+        self.evicted_by_reason[reason] = (
+            self.evicted_by_reason.get(reason, 0) + 1
+        )
 
     def to_dict(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
@@ -96,7 +106,7 @@ class HostTier:
         if self._staging is not None:
             if not self._staging.put(block_hash, *blk):
                 # Arena full: skip G2, spill straight down.
-                self.stats.evicted += 1
+                self.stats.note_evicted("arena_full")
                 if self.next_tier is not None:
                     self.next_tier.put(block_hash, *blk)
                 return
@@ -113,7 +123,7 @@ class HostTier:
                 )
                 self._staging.pop(h)
                 blk = spill
-            self.stats.evicted += 1
+            self.stats.note_evicted("capacity")
             if self.next_tier is not None and blk is not None:
                 self.next_tier.put(h, *blk)  # G2 → G3 spill
 
@@ -235,7 +245,7 @@ class DiskTier:
         self.stats.stored += 1
         while len(self._lru) > self.capacity:
             h, p = self._lru.popitem(last=False)
-            self.stats.evicted += 1
+            self.stats.note_evicted("capacity")
             try:
                 os.unlink(p)
             except FileNotFoundError:

@@ -150,8 +150,14 @@ class KvRouter:
         # drop_worker is the single purge path: the scheduler fans the
         # radix-index removal out through this callback, so a crash-plane
         # drop (or a rejoin under a fresh incarnation) reconciles charges,
-        # link pairs, breaker faults AND radix entries in one call.
+        # link pairs, breaker faults AND radix entries in one call. The
+        # KV-reuse popularity sketch rides the same fan-out (zero-residue
+        # audit: a departed worker's hits must not keep a prefix hot).
         self.scheduler.add_drop_callback(self.indexer.remove_worker)
+        from dynamo_tpu.runtime.kv_reuse_observe import global_plane
+
+        self.kv_plane = global_plane()
+        self.scheduler.add_drop_callback(self.kv_plane.drop_worker)
         self.metrics = RouterMetrics(self.scheduler)
         self._tasks: list = []
         self._subs: list = []
@@ -297,6 +303,17 @@ class KvRouter:
                 reason="kv_overlap" if overlap > 0 else "load_only"
             )
             self.metrics.overlap_blocks.observe(overlap)
+            if overlap > 0:
+                # Popularity feed: the matched prefix is keyed by its
+                # block-hash-chain anchor (deepest matched block) and
+                # attributed to the chosen worker so drop_worker can purge
+                # it. Popularity only — the engine-side hit accounts the
+                # ROI counters (a router feed too would double-count).
+                self.kv_plane.note_router_match(
+                    hashes[overlap - 1],
+                    tokens=overlap * self.block_size,
+                    worker=worker,
+                )
         if not self.use_kv_events and worker is not None:
             # Approximate mode: assume the chosen worker will cache these
             # blocks (ref: kv_router.rs:937 routing-decision recording).

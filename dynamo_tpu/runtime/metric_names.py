@@ -105,6 +105,50 @@ KVBM_FAILED_LOADS_TOTAL = f"{KVBM_PREFIX}_failed_loads_total"
 # spills) whose CRC32 did not match on restore — counted as a miss, never
 # installed, never a crash. Labeled by source (checkpoint | disk).
 KVBM_RESTORE_CORRUPTION_TOTAL = f"{KVBM_PREFIX}_restore_corruption_total"
+# Tier-flow latency (kv_reuse_observability.md): one offload burst /
+# onboard walk, wall time. Direction is the family; the tier the blocks
+# landed in / came from rides the {tier} label.
+KVBM_OFFLOAD_DURATION = f"{KVBM_PREFIX}_offload_duration_seconds"
+KVBM_ONBOARD_DURATION = f"{KVBM_PREFIX}_onboard_duration_seconds"
+
+# -- KV-reuse plane (runtime/kv_reuse_observe.py KvReusePlane) ----------------
+KVCACHE_PREFIX = "dynamo_tpu_kvcache"
+# Prefix-cache hits by the tier the hit resolved from (device | host |
+# disk | remote) and requests that found no cached prefix at all. The
+# hit-rate gauge is the render-time ratio of these monotonic sources.
+KVCACHE_HITS_TOTAL = f"{KVCACHE_PREFIX}_hits_total"
+KVCACHE_MISSES_TOTAL = f"{KVCACHE_PREFIX}_misses_total"
+KVCACHE_HIT_RATE = f"{KVCACHE_PREFIX}_hit_rate"
+# Cache ROI: prefill tokens served from cache vs recomputed, and the
+# estimated prefill seconds the cache saved (cached tokens x EWMA
+# per-token prefill cost — the same number stamped per-request onto the
+# trajectory rollup).
+KVCACHE_REUSED_TOKENS_TOTAL = f"{KVCACHE_PREFIX}_reused_prefill_tokens_total"
+KVCACHE_RECOMPUTED_TOKENS_TOTAL = (
+    f"{KVCACHE_PREFIX}_recomputed_prefill_tokens_total"
+)
+KVCACHE_PREFILL_SECONDS_SAVED_TOTAL = (
+    f"{KVCACHE_PREFIX}_prefill_seconds_saved_total"
+)
+KVCACHE_PREFILL_COST_PER_TOKEN = (
+    f"{KVCACHE_PREFIX}_prefill_cost_per_token_seconds"
+)
+# Space-saving popularity sketch: live tracked-prefix count (bounded by
+# capacity by construction), min-replacements (sketch churn under a
+# heavy-tailed workload), and the p99 sketch lookup latency recorded by
+# the scale harness (tests/test_kv_reuse_scale.py).
+KVCACHE_SKETCH_TRACKED_PREFIXES = f"{KVCACHE_PREFIX}_sketch_tracked_prefixes"
+KVCACHE_SKETCH_REPLACEMENTS_TOTAL = (
+    f"{KVCACHE_PREFIX}_sketch_replacements_total"
+)
+KVCACHE_SKETCH_LOOKUP_P99_SECONDS = (
+    f"{KVCACHE_PREFIX}_sketch_lookup_p99_seconds"
+)
+# Tier evictions by (tier, reason): arena_full (straight spill past a
+# full pinned arena) | capacity (LRU overflow) | corrupt (CRC drop on
+# read-back). Mirrors kvbm_tier_evictions_total with the reason split the
+# popularity-eviction follow-on acts on.
+KVCACHE_EVICTIONS_TOTAL = f"{KVCACHE_PREFIX}_evictions_total"
 
 # -- device/runtime plane (runtime/device_observe.py) ------------------------
 RUNTIME_PREFIX = "dynamo_tpu_runtime"
@@ -330,6 +374,22 @@ ALL_KVBM = (
     KVBM_POOL_PRESSURE_TRUNCATIONS_TOTAL,
     KVBM_FAILED_LOADS_TOTAL,
     KVBM_RESTORE_CORRUPTION_TOTAL,
+    KVBM_OFFLOAD_DURATION,
+    KVBM_ONBOARD_DURATION,
+)
+
+ALL_KVCACHE = (
+    KVCACHE_HITS_TOTAL,
+    KVCACHE_MISSES_TOTAL,
+    KVCACHE_HIT_RATE,
+    KVCACHE_REUSED_TOKENS_TOTAL,
+    KVCACHE_RECOMPUTED_TOKENS_TOTAL,
+    KVCACHE_PREFILL_SECONDS_SAVED_TOTAL,
+    KVCACHE_PREFILL_COST_PER_TOKEN,
+    KVCACHE_SKETCH_TRACKED_PREFIXES,
+    KVCACHE_SKETCH_REPLACEMENTS_TOTAL,
+    KVCACHE_SKETCH_LOOKUP_P99_SECONDS,
+    KVCACHE_EVICTIONS_TOTAL,
 )
 
 ALL_DISAGG = (

@@ -15,6 +15,11 @@ Debug surface (serving-plane observability tentpole):
   GET  /debug/requests/{id}  one ordered lifecycle timeline
   GET  /debug/traces         the process tracer's finished-span ring
 
+KV-reuse plane (runtime/kv_reuse_observe.py):
+  GET  /debug/kvcache          hit-rate/ROI rollup + sketch stats + top
+                               prefixes (?top_k=)
+  GET  /debug/kvcache/prefixes ranked prefix popularity, full depth (?k=)
+
 Device-plane debug surface (runtime/device_observe.py):
   GET  /debug/memory         HBM ledger categories + pool byte split +
                              device.memory_stats() + host weight-cache tiers
@@ -209,6 +214,7 @@ class SystemStatusServer:
         # register the source twice.
         if not self._runtime_metrics_registered:
             from dynamo_tpu.runtime.device_observe import render_runtime_metrics
+            from dynamo_tpu.runtime.kv_reuse_observe import render_kv_reuse_metrics
             from dynamo_tpu.runtime.liveness import render_fence_metrics
             from dynamo_tpu.runtime.trajectory import render_trajectory_metrics
 
@@ -220,6 +226,9 @@ class SystemStatusServer:
             # SLO plane (ALL_SLO goodput/burn-rate/phase gauges): the
             # tracker is process-global like the lifecycle/tracer rings.
             self.register_metrics(render_trajectory_metrics)
+            # KV-reuse plane (ALL_KVCACHE hit-rate/ROI/sketch gauges):
+            # process-global, one sketch per process.
+            self.register_metrics(render_kv_reuse_metrics)
             self._runtime_metrics_registered = True
         app = web.Application()
         app.router.add_get("/health", self._health)
@@ -237,6 +246,10 @@ class SystemStatusServer:
         app.router.add_get("/debug/trajectory", self._debug_trajectories)
         app.router.add_get(
             "/debug/trajectory/{trace_id}", self._debug_trajectory
+        )
+        app.router.add_get("/debug/kvcache", self._debug_kvcache)
+        app.router.add_get(
+            "/debug/kvcache/prefixes", self._debug_kvcache_prefixes
         )
         app.router.add_get("/debug/memory", self._debug_memory)
         app.router.add_get("/debug/compiles", self._debug_compiles)
@@ -393,6 +406,28 @@ class SystemStatusServer:
                 {"error": f"no trajectory for trace {tid!r}"}, status=404
             )
         return web.json_response(stitched)
+
+    # -- KV-reuse plane (runtime/kv_reuse_observe.py) ----------------------
+
+    async def _debug_kvcache(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.kv_reuse_observe import kvcache_index
+
+        try:
+            top_k = int(request.query.get("top_k", "10"))
+        except ValueError:
+            top_k = 10
+        return web.json_response(kvcache_index(top_k=top_k))
+
+    async def _debug_kvcache_prefixes(
+        self, request: web.Request
+    ) -> web.Response:
+        from dynamo_tpu.runtime.kv_reuse_observe import kvcache_prefixes
+
+        try:
+            k = int(request.query.get("k", "50"))
+        except ValueError:
+            k = 50
+        return web.json_response(kvcache_prefixes(k=k))
 
     # -- device-plane debug surface (runtime/device_observe.py) ------------
 

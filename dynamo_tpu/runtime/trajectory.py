@@ -44,7 +44,7 @@ import collections
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from dynamo_tpu import config
 from dynamo_tpu.runtime import fault_names
@@ -168,6 +168,7 @@ def stitch(
             "total_ms": 0.0,
             "phases": {p: 0.0 for p in PHASES},
             "dominant_phase": PHASE_OVERHEAD,
+            "kv_reuse": _kv_reuse_rollup(events or ()),
             "skew_flagged": False,
             "complete": complete,
         }
@@ -256,9 +257,40 @@ def stitch(
         "root_ms": round(root_ms, 3),
         "phases": phases,
         "dominant_phase": dominant,
+        "kv_reuse": _kv_reuse_rollup(out_events),
         "skew_flagged": any_skew,
         "complete": complete,
     }
+
+
+def _kv_reuse_rollup(
+    events: Iterable[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Aggregate the KV-reuse plane's per-request ROI events (ring
+    ``kvcache``, kind ``roi``) into one cache-ROI line for the trajectory:
+    how much prefill this request skipped, and from which tiers. None when
+    the request carried no ROI event (engine predates the plane, or the
+    event ring evicted it) — consumers must treat absent and None alike."""
+    total: Optional[Dict[str, Any]] = None
+    for ev in events:
+        if ev.get("ring") != "kvcache" or ev.get("kind") != "roi":
+            continue
+        if total is None:
+            total = {
+                "cached_tokens": 0,
+                "recomputed_tokens": 0,
+                "seconds_saved": 0.0,
+                "tiers": [],
+            }
+        total["cached_tokens"] += int(ev.get("cached_tokens") or 0)
+        total["recomputed_tokens"] += int(ev.get("recomputed_tokens") or 0)
+        total["seconds_saved"] += float(ev.get("seconds_saved") or 0.0)
+        tier = ev.get("tier")
+        if tier and tier not in total["tiers"]:
+            total["tiers"].append(tier)
+    if total is not None:
+        total["seconds_saved"] = round(total["seconds_saved"], 6)
+    return total
 
 
 def attribute_phases(
@@ -685,6 +717,7 @@ class TrajectoryStore:
             # The one-GET bottleneck answer: a slow request names the
             # phase that dominated it.
             "dominant_phase": stitched["dominant_phase"],
+            "kv_reuse": stitched.get("kv_reuse"),
             "skew_flagged": stitched["skew_flagged"],
             "complete": stitched["complete"],
         }

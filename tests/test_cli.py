@@ -181,6 +181,81 @@ async def test_observe_trajectory_against_live_worker(capsys):
         await engine.stop()
 
 
+async def test_observe_kvcache_against_live_worker(capsys):
+    """`dynamo-tpu observe kvcache` pretty-prints the KV-reuse plane (hit
+    rate, cache ROI, sketch health, hot prefixes) from a live in-process
+    worker's /debug/kvcache endpoints."""
+    import argparse
+
+    from dynamo_tpu.cli.run import add_observe_args, main_observe
+    from dynamo_tpu.runtime.kv_reuse_observe import global_plane
+    from dynamo_tpu.runtime.system_server import (
+        SystemStatusServer,
+        attach_engine,
+    )
+    from tests.test_jax_engine import make_engine, req, run_one
+
+    reused0 = global_plane().metrics.reused_tokens.value()
+    engine, _ = make_engine()
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    attach_engine(server, engine)
+    await server.start()
+    try:
+        # Same 16-token prompt twice: the second admission prefix-hits.
+        await run_one(engine, req(range(10, 26), max_tokens=3))
+        await run_one(engine, req(range(10, 26), max_tokens=3))
+        parser = argparse.ArgumentParser()
+        add_observe_args(parser)
+        args = parser.parse_args(["kvcache", "--port", str(server.port)])
+        await main_observe(args)
+        out = capsys.readouterr().out
+        assert "kv reuse" in out and "hit rate" in out
+        assert "prefill tokens" in out and "sketch" in out
+        assert "hot prefixes" in out
+
+        args = parser.parse_args(
+            ["kvcache", "--port", str(server.port), "--json"]
+        )
+        await main_observe(args)
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"kvcache", "prefixes"}
+        # The replayed prompt's cached blocks show up as reused tokens
+        # (>= : the plane is process-global, other tests feed it too).
+        assert doc["kvcache"]["reused_prefill_tokens"] >= reused0 + 12
+        assert doc["kvcache"]["sketch"]["capacity"] > 0
+        assert doc["prefixes"]["prefixes"]  # sketch tracked the anchor
+    finally:
+        await server.stop()
+        await engine.stop()
+
+
+async def test_debug_kvcache_200_without_engine():
+    """/debug/kvcache serves 200 on a bare system server (mock attach /
+    partial engine): the plane is process-global, never engine-owned."""
+    import aiohttp
+
+    from dynamo_tpu.runtime.system_server import SystemStatusServer
+
+    server = SystemStatusServer(host="127.0.0.1", port=0)
+    await server.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            for path in ("/debug/kvcache", "/debug/kvcache/prefixes"):
+                url = f"http://127.0.0.1:{server.port}{path}"
+                async with session.get(url) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert "sketch" in doc
+            # The metrics surface carries the ALL_KVCACHE family too.
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            async with session.get(url) as r:
+                assert r.status == 200
+                body = await r.text()
+                assert "dynamo_tpu_kvcache_misses_total" in body
+    finally:
+        await server.stop()
+
+
 # -- lint --------------------------------------------------------------------
 
 

@@ -65,10 +65,10 @@ def test_all_family_tuples_are_canonical_and_exported():
         v for v in vars(mn).values()
         if isinstance(v, str) and v.startswith("dynamo_tpu_")
     }
-    families = ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_DISAGG",
-                "ALL_ENGINE", "ALL_RUNTIME", "ALL_MIGRATION", "ALL_FAULTS",
-                "ALL_OVERLOAD", "ALL_DRAIN", "ALL_LIVENESS", "ALL_PLANNER",
-                "ALL_SLO", "ALL_PARSER")
+    families = ("ALL_FRONTEND", "ALL_ROUTER", "ALL_KVBM", "ALL_KVCACHE",
+                "ALL_DISAGG", "ALL_ENGINE", "ALL_RUNTIME", "ALL_MIGRATION",
+                "ALL_FAULTS", "ALL_OVERLOAD", "ALL_DRAIN", "ALL_LIVENESS",
+                "ALL_PLANNER", "ALL_SLO", "ALL_PARSER")
     for family in families:
         tup = getattr(rt, family)
         assert tup and isinstance(tup, tuple)

@@ -284,6 +284,7 @@ async def test_every_debug_route_returns_json_against_mock_engine():
         assert set(debug_paths) == {
             "/debug/requests", "/debug/traces", "/debug/memory",
             "/debug/compiles", "/debug/flight", "/debug/trajectory",
+            "/debug/kvcache", "/debug/kvcache/prefixes",
         }
         for path in debug_paths:
             status, body = await _get(server.port, path)

@@ -144,6 +144,37 @@ class TestStitch:
         out = stitch([])
         assert out["spans"] == [] and out["dominant_phase"] == PHASE_OVERHEAD
 
+    def test_kv_reuse_rollup_from_roi_events(self):
+        """The KV-reuse plane's per-request ROI events (ring "kvcache",
+        kind "roi") aggregate into ONE kv_reuse line on the stitched view
+        (prefill tokens saved, seconds saved, tiers hit)."""
+        spans = [
+            _span("root", span_id="a", start_wall=1000.0, duration_ms=100.0),
+        ]
+        events = [
+            {"trace_id": "t" * 32, "ring": "kvcache", "kind": "roi",
+             "t_wall": 1000.01, "cached_tokens": 96, "recomputed_tokens": 32,
+             "seconds_saved": 0.5, "tier": "device"},
+            # A re-prefill after migration: a second ROI event sums in.
+            {"trace_id": "t" * 32, "ring": "kvcache", "kind": "roi",
+             "t_wall": 1000.05, "cached_tokens": 64, "recomputed_tokens": 0,
+             "seconds_saved": 0.25, "tier": "host"},
+            # Foreign rings must not contaminate the rollup.
+            {"trace_id": "t" * 32, "ring": "disagg", "kind": "pull_retry",
+             "t_wall": 1000.07},
+        ]
+        out = stitch(spans, events)
+        assert out["kv_reuse"] == {
+            "cached_tokens": 160,
+            "recomputed_tokens": 32,
+            "seconds_saved": 0.75,
+            "tiers": ["device", "host"],
+        }
+
+    def test_kv_reuse_absent_without_roi_events(self):
+        out = stitch([_span("root", span_id="a", duration_ms=10.0)])
+        assert out["kv_reuse"] is None
+
 
 class TestPhases:
     def test_attribution_and_dominant(self):
@@ -695,3 +726,38 @@ async def test_e2e_disagg_drain_trajectory():
         for e in (prefill_engine, decode_engine, peer_engine):
             await e.stop()
         await rt.shutdown(grace_period=1)
+
+
+async def test_engine_request_stamps_kv_reuse_into_trajectory():
+    """Acceptance (ISSUE 16): a traced request that prefix-hits shows its
+    prefill-tokens-saved in the stitched /debug/trajectory view — as the
+    kv_reuse rollup AND as cached_tokens on the engine.prefill span."""
+    from dynamo_tpu.runtime.engine import collect
+    from dynamo_tpu.runtime.trajectory import global_store
+    from dynamo_tpu.utils.tracing import span
+    from tests.test_jax_engine import make_engine, req
+
+    store = global_store()  # attach BEFORE spans/events flow
+    engine, _ = make_engine()
+    try:
+        # Prime the prefix cache, then replay the same prompt traced.
+        await collect(
+            engine.generate(req(range(30, 46), max_tokens=2), Context())
+        )
+        ctx = Context(baggage={})
+        with span("http.chat_completions", ctx, model="tiny") as root:
+            await collect(
+                engine.generate(req(range(30, 46), max_tokens=2), ctx)
+            )
+        out = store.get(root.trace_id)
+        assert out is not None
+        kv = out["kv_reuse"]
+        assert kv is not None and kv["cached_tokens"] >= 12
+        assert kv["recomputed_tokens"] >= 1
+        assert "device" in kv["tiers"]
+        prefill = next(
+            s for s in out["spans"] if s["name"] == "engine.prefill"
+        )
+        assert prefill["attributes"]["cached_tokens"] >= 12
+    finally:
+        await engine.stop()
