@@ -1826,8 +1826,177 @@ async def run_kv_reuse_leg(n_prefixes: int = 6, requests: int = 36,
         )
         return record
 
+    async def tier_sub_leg() -> dict:
+        """Speculative-vs-serialized onboard (ISSUE 17): prime the host
+        tier, drop the device cache so every warm request must walk
+        G2→G1, then replay the warm wave twice — once hintless (admission
+        onboards serially, the pre-17 critical path) and once with the
+        router hint stamped (the walk overlaps the queue wait). Columns:
+        the TTFT pair, prefetch_hits/prefetch_wasted, and the measured
+        onboard_overlap_ms the speculation bought. The serialized wave
+        doubles as the zero-spurious control: no hint, no prefetch.
+
+        max_num_seqs is deliberately small: the speculation's win IS the
+        queue wait it overlaps — with no queue, both waves pay the same
+        walk and the hint buys nothing."""
+        from dynamo_tpu.kvbm import HostTier, TieredKvManager
+
+        engine = JaxEngine(
+            JaxEngineArgs(
+                config=tiny_config(),
+                block_size=block_size,
+                num_kv_blocks=1024,
+                max_num_seqs=2,
+                max_model_len=isl + osl + 2 * block_size,
+                prefill_chunk=32,
+                enable_prefix_caching=True,
+                decode_steps=4,
+            )
+        )
+        kvbm = TieredKvManager(HostTier(4096))
+        kvbm.attach(engine)
+
+        def pv(outcome: str) -> int:
+            return int(kvbm.metrics.prefetches.value(outcome=outcome))
+
+        async def wave(tag: str, hint: bool) -> float:
+            ttfts: list = []
+
+            async def run_one(i: int) -> None:
+                toks = (
+                    prefixes[i % n_prefixes]
+                    + rng.integers(10, 200, size=isl - prefix_len).tolist()
+                )
+                request = PreprocessedRequest(
+                    token_ids=toks,
+                    request_id=f"kvtier-{tag}-{i}",
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                )
+                if hint:
+                    request.estimated_prefix_hit_blocks = (
+                        prefix_len // block_size
+                    )
+                t0 = time.monotonic()
+                ttft = None
+                async for out in engine.generate(request, Context()):
+                    if out.token_ids and ttft is None:
+                        ttft = time.monotonic() - t0
+                if ttft is not None:
+                    ttfts.append(ttft)
+
+            # More offered concurrency than engine slots: requests QUEUE,
+            # which is exactly the window speculation overlaps.
+            sem = asyncio.Semaphore(8)
+
+            async def limited(i: int) -> None:
+                async with sem:
+                    await run_one(i)
+
+            await asyncio.gather(*(limited(i) for i in range(requests)))
+            return round(1000 * sorted(ttfts)[len(ttfts) // 2], 2)
+
+        try:
+            # Prime: one pass commits every prefix; write-through offload
+            # lands the blocks in the host tier.
+            await wave("prime", hint=False)
+            await asyncio.sleep(0.3)
+            spurious = sum(
+                pv(o) for o in ("claimed", "revoked", "skipped", "error")
+            )
+            engine.pool.clear()  # blocks now live ONLY in the tier
+            serialized_ms = await wave("serial", hint=False)
+            spurious += sum(
+                pv(o) for o in ("claimed", "revoked", "skipped", "error")
+            )
+            engine.pool.clear()
+            speculative_ms = await wave("spec", hint=True)
+            n_overlap, overlap_s = kvbm.metrics.prefetch_overlap.snapshot_total()
+            return {
+                "tier_blocks": len(kvbm.tier),
+                "p50_ttft_ms_serialized": serialized_ms,
+                "p50_ttft_ms_speculative": speculative_ms,
+                "speculative_ttft_delta_ms": round(
+                    serialized_ms - speculative_ms, 2
+                ),
+                "prefetch_hits": pv("claimed"),
+                "prefetch_wasted": int(
+                    kvbm.metrics.prefetch_blocks.value(outcome="wasted")
+                ),
+                "onboard_overlap_ms": round(1000 * overlap_s, 2),
+                "onboard_overlap_count": int(n_overlap),
+                # Hintless traffic must never speculate: nonzero here is
+                # the prefetch plane activating spuriously.
+                "spurious_prefetches": int(spurious),
+            }
+        finally:
+            await kvbm.close()
+            await engine.stop()
+
+    def eviction_ab_sub_leg(capacity: int = 64, n_keys: int = 256,
+                            draws: int = 4000) -> dict:
+        """Popularity-vs-LRU eviction A/B at equal capacity: the same
+        zipf-skewed single-block stream against a plain-LRU host tier and
+        against one scored by the REAL manager bridge (sketch → protected
+        prefixes). The popularity side must hold the heavy hitters
+        through cold-key bursts LRU lets evict them."""
+        from dynamo_tpu.kvbm import HostTier, OffloadFilter, TieredKvManager
+        from dynamo_tpu.runtime.kv_reuse_observe import KvReusePlane
+
+        ab_rng = np.random.default_rng(seed + 1)
+        ranks = np.minimum(ab_rng.zipf(1.2, size=draws), n_keys) - 1
+        keys = (
+            (np.arange(1, n_keys + 1, dtype=np.uint64)
+             * np.uint64(0x9E3779B97F4A7C15))
+            & np.uint64(0x7FFFFFFFFFFFFFFF)
+        ).astype(np.int64)
+        payload = np.zeros(1, dtype=np.int8)
+
+        def run(policy: str) -> float:
+            host = HostTier(capacity)
+            plane = KvReusePlane(capacity=n_keys)
+            kvbm = None
+            if policy == "popularity":
+                kvbm = TieredKvManager(
+                    host, plane=plane,
+                    filter=OffloadFilter(min_frequency=10**9),
+                )
+            hits = 0
+            for j, r in enumerate(ranks):
+                h = int(keys[r])
+                if j == draws // 2:
+                    # Let the protected-map rebuild throttle expire so
+                    # the second half runs with a sketch-warmed scorer.
+                    time.sleep(0.55)
+                if host.contains(h):
+                    hits += 1
+                    host.get(h)
+                    plane.sketch.touch(h, tokens=block_size)
+                else:
+                    host.put(h, payload, payload)
+                    if kvbm is not None:
+                        kvbm.notify_commit(h, 1)
+            if kvbm is not None:
+                for name in list(kvbm.metrics._tier_sources):
+                    kvbm.metrics.unwatch_tier(name)
+                plane.forget_tier_source(kvbm._plane_label)
+            return hits / draws
+
+        lru_rate = run("lru")
+        pop_rate = run("popularity")
+        return {
+            "capacity_blocks": capacity,
+            "distinct_keys": n_keys,
+            "draws": draws,
+            "hit_rate_lru": round(lru_rate, 4),
+            "hit_rate_popularity": round(pop_rate, 4),
+            "popularity_wins": bool(pop_rate > lru_rate),
+        }
+
     warm = await sub_leg(shared=True)
     cold = await sub_leg(shared=False)
+    tier = await tier_sub_leg()
+    eviction_ab = eviction_ab_sub_leg()
     top = global_plane().sketch.top(n_prefixes)
     return {
         "n_prefixes": n_prefixes,
@@ -1844,6 +2013,8 @@ async def run_kv_reuse_leg(n_prefixes: int = 6, requests: int = 36,
             cold["p50_ttft_ms"] - warm["p50_ttft_ms"], 2
         ),
         "cold_control": cold,
+        "tier_onboard": tier,
+        "eviction_ab": eviction_ab,
         "top_prefixes_tracked": len(top),
         "fault_plane": _fault_plane_record(fault_activity0),
     }

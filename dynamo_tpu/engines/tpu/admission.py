@@ -208,9 +208,29 @@ class Admitter:
             hashes = compute_block_hashes(
                 prompt, args.block_size, salt=seq.hash_salt
             )
-            # Onboard from the lower tiers (G2/G3) anything that extends the
-            # device prefix match (ref: KVBM onboard-before-prefill, §3.4).
-            if e.kvbm is not None and hashes:
+            pf = getattr(seq, "kv_prefetch", None)
+            stall = 0.0
+            if e.kvbm is not None and hashes and pf is not None:
+                # Speculative lease (docs/design_docs/kv_prefetch.md): the
+                # onboard walk ran while this request sat in the queue, so
+                # joining here stalls only for the un-overlapped remainder
+                # — walk time minus this stall is the TTFT the speculation
+                # bought, recorded by claim() below.
+                t_wait = time.monotonic()
+                await pf.wait()
+                stall = time.monotonic() - t_wait
+                if pf.settled:
+                    # The walk died, was revoked, or found nothing — no
+                    # lease is held: take the serial path below exactly
+                    # like hintless traffic.
+                    seq.kv_prefetch = None
+                    pf = None
+                elif pf.source:
+                    seq.kv_hit_tier = pf.source
+            if e.kvbm is not None and hashes and pf is None:
+                # Serial fallback (unrouted/hintless traffic): onboard from
+                # the lower tiers (G2/G3) anything that extends the device
+                # prefix match (ref: KVBM onboard-before-prefill, §3.4).
                 n_dev = e.pool.match_prefix(hashes)
                 if n_dev < len(hashes):
                     try:
@@ -224,6 +244,12 @@ class Admitter:
                     except Exception:
                         logger.exception("KV onboard failed; prefilling locally")
             matched, ids = e.pool.pin_prefix(hashes)
+            if pf is not None:
+                # Claim AFTER our own pin: the lease's pins release with
+                # the blocks already re-held, so their refcounts never dip
+                # to zero (and the pool can never evict them) in between.
+                pf.claim(stall_s=stall)
+                seq.kv_prefetch = None
         matched_tokens = min(matched * args.block_size, len(prompt) - 1)
 
         # Watermark headroom so running decodes can still grow.
@@ -411,7 +437,7 @@ class Admitter:
                 e.pool.commit(prep.ids[i], prep.hashes[i], parent)
                 seq.block_hashes.append(prep.hashes[i])
                 if e.kvbm is not None:
-                    e.kvbm.notify_commit(prep.hashes[i], i + 1)
+                    e.kvbm.notify_commit(prep.hashes[i], i + 1, parent=parent)
         # Per-slot device state: ONE shared implementation with the
         # drain plane's _install_adopted (engine._set_slot_state) — any
         # new per-slot sampling field must land there, not here.

@@ -190,6 +190,10 @@ class _Sequence:
     # admission (cached/recomputed tokens, estimated seconds saved).
     kv_hit_tier: str = "device"
     kv_roi: Optional[Dict[str, Any]] = None
+    # Speculative onboard lease (kvbm/manager.py KvPrefetch), started at
+    # enqueue from the router's prefix hint. Admission joins and claims
+    # it; abort/shed revokes it (the pinned blocks fall back to cache).
+    kv_prefetch: Optional[Any] = None
 
 
 @dataclass
@@ -709,6 +713,21 @@ class JaxEngine:
                 finish_reason=FinishReason.ERROR,
             )
             return
+        # Paged prefill needs every prompt block plus one decode block
+        # resident at once: a prompt larger than the whole pool can never
+        # be admitted, and admission would requeue it forever (pool-dry
+        # looks transient from where it sits). Refuse it typed instead.
+        n_prompt_blocks = math.ceil(len(prompt) / self.args.block_size)
+        if n_prompt_blocks + 1 > self.args.num_kv_blocks:
+            yield BackendOutput(
+                error=(
+                    f"prompt needs {n_prompt_blocks} KV blocks + 1 for "
+                    f"decode, but the pool only has "
+                    f"{self.args.num_kv_blocks}"
+                ),
+                finish_reason=FinishReason.ERROR,
+            )
+            return
         if self._failure is not None:
             yield BackendOutput(
                 error=f"engine failed: {self._failure}",
@@ -738,6 +757,7 @@ class JaxEngine:
         self._next_salt = (self._next_salt + 1) & 0x7FFFFFFF
         seq.t_enqueue = time.monotonic()
         self._waiting.append(seq)
+        self._maybe_prefetch(seq)
         self._wake.set()
         try:
             async for out in self._stream_outputs(seq):
@@ -745,7 +765,46 @@ class JaxEngine:
                     seq.t_first_out = time.monotonic()
                 yield out
         finally:
+            # A stream that ends before admission claimed its lease
+            # (client abort, early error) must release the pinned blocks;
+            # after a claim this is a no-op.
+            self._revoke_prefetch(seq, "aborted")
             self._export_phase_spans(seq)
+
+    def _maybe_prefetch(self, seq: _Sequence) -> None:
+        """Speculative onboarding (docs/design_docs/kv_prefetch.md): the
+        router ships its radix-match prediction as
+        ``estimated_prefix_hit_blocks``; when the hint is positive, start
+        the G2/G3→G1 onboard walk NOW so it overlaps this request's queue
+        wait (and the batch ahead of it) instead of serializing inside
+        admission. No hint — cold traffic, no router, or a multimodal
+        salt we cannot compute before admission unpacks the embeds —
+        means no walk: unrouted traffic never pays a speculation tax."""
+        if self.kvbm is None or not self.args.enable_prefix_caching:
+            return
+        hint = int(getattr(seq.request, "estimated_prefix_hit_blocks", 0) or 0)
+        if hint <= 0:
+            return
+        if (seq.request.extra or {}).get("mm_embeds"):
+            return
+        try:
+            seq.hash_salt = adapter_salt(seq.request.lora_name)
+            hashes = compute_block_hashes(
+                seq.prompt, self.args.block_size, salt=seq.hash_salt
+            )
+            if not hashes or self.pool.match_prefix(hashes) >= len(hashes):
+                return  # fully device-resident already: nothing to onboard
+            seq.kv_prefetch = self.kvbm.prefetch(hashes)
+        except Exception:
+            # Speculation is optional: a prefetch-setup bug costs the
+            # overlap, never the request (admission onboards serially).
+            logger.debug("speculative prefetch setup failed", exc_info=True)
+
+    def _revoke_prefetch(self, seq: _Sequence, reason: str) -> None:
+        pf = seq.kv_prefetch
+        if pf is not None:
+            seq.kv_prefetch = None
+            pf.revoke(reason)
 
     def _export_phase_spans(self, seq: _Sequence) -> None:
         """Retrospective engine.queue / engine.prefill / engine.decode
@@ -1029,6 +1088,7 @@ class JaxEngine:
         deadline expiry is a typed, client-visible error (the request's
         budget is gone — admitting it would burn prefill on work nobody
         is waiting for); a plain cancellation stays a quiet CANCELLED."""
+        self._revoke_prefetch(seq, "shed")
         if seq.context.stop_reason == "deadline":
             self.deadline_sheds += 1
             note_activity("deadline_expired")
@@ -1491,7 +1551,7 @@ class JaxEngine:
             self.pool.commit(seq.block_ids[bi], h, parent)
             seq.block_hashes.append(h)
             if self.kvbm is not None:
-                self.kvbm.notify_commit(h, bi + 1)
+                self.kvbm.notify_commit(h, bi + 1, parent=parent)
 
     def _preempt(self, seq: _Sequence) -> None:
         """Release blocks and requeue for recompute (vLLM-style preemption).

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dynamo_tpu.kvbm.tiers import HostTier
 from dynamo_tpu.runtime.tasks import reap_task
@@ -84,6 +85,29 @@ class KvbmMetrics:
             "Instructed loads revoked because the block vanished from the "
             "tiers before transfer (engine must recompute)",
         )
+        self.offload_missed = self.registry.counter(
+            mn.KVBM_OFFLOAD_MISSED_TOTAL,
+            "Write-through losses: committed blocks gone from the device "
+            "pool before the offload worker could gather them",
+            ["reason"],
+        )
+        self.prefetches = self.registry.counter(
+            mn.KVBM_PREFETCHES_TOTAL,
+            "Speculative onboard leases by settlement (claimed | revoked "
+            "| skipped | error)",
+            ["outcome"],
+        )
+        self.prefetch_blocks = self.registry.counter(
+            mn.KVBM_PREFETCH_BLOCKS_TOTAL,
+            "Blocks moved under a speculative lease: used = claimed by "
+            "admission, wasted = onboarded then never claimed",
+            ["outcome"],
+        )
+        self.prefetch_overlap = self.registry.histogram(
+            mn.KVBM_PREFETCH_OVERLAP_SECONDS,
+            "Onboard wall time hidden behind queue wait + suffix prefill "
+            "(walk duration minus the stall admission observed)",
+        )
         self._tier_sources: Dict[str, Any] = {}
         self.registry.on_render(self._sample_tiers)
 
@@ -135,20 +159,36 @@ class OffloadFilter:
     earn host space, recurring prefixes do); ``max_per_burst`` bounds the
     per-wakeup device→host traffic. Frequency counts live in a bounded
     LRU so the filter itself can't grow without limit.
+
+    ``popular`` (wired by TieredKvManager to its sketch-backed protected
+    map) is a fast-path past the chain-depth gate: a hot-but-shallow
+    prefix the router keeps matching must never be filtered out of the
+    tiers. The frequency gate still applies — popularity proves reuse,
+    not that THIS commit is worth the wire yet.
     """
 
     min_chain_depth: int = 0
     min_frequency: int = 1
     max_per_burst: int = 32
     max_tracked_hashes: int = 65536
+    popular: Optional[Callable[[int], bool]] = None
 
     def __post_init__(self) -> None:
-        from collections import OrderedDict
-
         self._counts: "OrderedDict[int, int]" = OrderedDict()
 
+    def _is_popular(self, block_hash: Optional[int]) -> bool:
+        if self.popular is None or block_hash is None:
+            return False
+        try:
+            return bool(self.popular(block_hash))
+        except Exception:
+            # A popularity-source bug must cost the fast-path, never the
+            # commit notification that called us.
+            logger.debug("offload popularity probe failed", exc_info=True)
+            return False
+
     def admit(self, chain_depth: int, block_hash: Optional[int] = None) -> bool:
-        if chain_depth < self.min_chain_depth:
+        if chain_depth < self.min_chain_depth and not self._is_popular(block_hash):
             return False
         if self.min_frequency <= 1 or block_hash is None:
             return True
@@ -159,6 +199,92 @@ class OffloadFilter:
         return n >= self.min_frequency
 
 
+# Waste bound per speculative lease: a mispredicted hint can never drag
+# more than this many blocks through the tiers (docs/design_docs/
+# kv_prefetch.md "waste bounds").
+PREFETCH_MAX_BLOCKS = 256
+
+# Blocks per pipelined onboard batch: tier reads of batch i+1 overlap the
+# device scatter of batch i, so this is also the bounded in-flight window
+# that keeps speculative HBM pressure a small fraction of the pool (the
+# PR 8 admission watermark still governs total occupancy — imports stop
+# when the pool runs dry).
+ONBOARD_BATCH_BLOCKS = 8
+
+# Sketch anchors expanded into the protected-prefix map, and how deep a
+# parent chain the expansion walks (a protected anchor protects its whole
+# prefix: evicting an ancestor breaks the chain below it).
+PROTECT_TOP_K = 128
+PROTECT_WALK_DEPTH = 1024
+
+
+class KvPrefetch:
+    """Revocable lease over one speculative onboard walk.
+
+    Created by ``TieredKvManager.prefetch()`` when a routed request
+    arrives with a tier-resident hint; the walk runs concurrently with
+    the request's queue wait and is joined by admission via ``wait()`` +
+    ``claim()``. Revocation (abort/shed/close) is cooperative — the walk
+    checks ``revoked`` between batches — and the settlement is exactly
+    once: pins released, the lease counted claimed/revoked/skipped/error,
+    moved blocks counted used/wasted. The walk task never raises; errors
+    settle the lease as wasted and admission falls back to the serial
+    onboard path.
+    """
+
+    __slots__ = (
+        "manager", "hashes", "task", "walk_installed", "pinned_ids",
+        "pinned_hashes", "revoked", "revoke_reason", "claimed", "settled",
+        "walk_done", "error", "source", "t_start", "t_done",
+    )
+
+    def __init__(self, manager: "TieredKvManager", hashes: List[int]) -> None:
+        self.manager = manager
+        self.hashes = hashes
+        self.task: Optional[asyncio.Task] = None
+        self.walk_installed = 0  # blocks the walk moved tiers -> device
+        self.pinned_ids: List[int] = []
+        self.pinned_hashes: List[int] = []
+        self.revoked = False
+        self.revoke_reason: Optional[str] = None
+        self.claimed = False
+        self.settled = False
+        self.walk_done = False
+        self.error = False
+        self.source: Optional[str] = None  # deepest tier the walk hit
+        self.t_start = time.monotonic()
+        self.t_done: Optional[float] = None
+
+    @property
+    def matched(self) -> int:
+        """Leading device-resident blocks held under the lease."""
+        return len(self.pinned_hashes)
+
+    async def wait(self) -> int:
+        """Join the walk (admission's stall point). Never raises."""
+        if self.task is not None:
+            await self.task
+        return self.matched
+
+    def claim(self, stall_s: float = 0.0) -> None:
+        """Admission took over the blocks (after its OWN pin_prefix, so
+        refcounts never dip to zero in between). ``stall_s`` is how long
+        admission actually waited in ``wait()`` — the walk time minus the
+        stall is the overlap the speculation bought."""
+        self.manager._settle_prefetch(self, used=True, stall_s=stall_s)
+
+    def revoke(self, reason: str) -> None:
+        """Release the lease (abort/shed/close). Idempotent; a no-op once
+        claimed. Mid-walk, the walk sees the flag between batches and its
+        finally settles; after the walk, settle here and now."""
+        if self.claimed or self.revoked:
+            return
+        self.revoked = True
+        self.revoke_reason = reason
+        if self.walk_done:
+            self.manager._settle_prefetch(self, used=False)
+
+
 class TieredKvManager:
     def __init__(
         self,
@@ -167,6 +293,7 @@ class TieredKvManager:
         filter: Optional[OffloadFilter] = None,
         remote: Optional[Any] = None,  # G4 RemoteTier (kvbm/remote.py)
         metrics: Optional[KvbmMetrics] = None,
+        plane: Optional[Any] = None,  # KvReusePlane override (tests/bench)
     ) -> None:
         self.tier = top_tier
         self.remote = remote
@@ -189,7 +316,7 @@ class TieredKvManager:
         # managers in one process stay additive on the global counters.
         from dynamo_tpu.runtime.kv_reuse_observe import global_plane
 
-        self.kv_plane = global_plane()
+        self.kv_plane = plane if plane is not None else global_plane()
         self._evict_seen: Dict[Tuple[str, str], int] = {}
         self._sketch_replacements_seen = self.kv_plane.sketch.replacements
         self.last_onboard_source: Optional[str] = None
@@ -220,6 +347,27 @@ class TieredKvManager:
         self._engine: Optional[Any] = None
         self.offloaded = 0
         self.onboarded = 0
+        # Popularity-driven eviction (kv_prefetch.md): the sketch tracks
+        # chain ANCHORS, the tiers evict BLOCKS — the bridge is a bounded
+        # parent map (child hash -> parent hash, fed by notify_commit)
+        # that lets the scorer expand a hot anchor into its whole prefix
+        # chain. The derived "protected map" is rebuilt lazily when the
+        # sketch moves, never per eviction.
+        cap = getattr(top_tier, "capacity", 0) or 0
+        if top_tier.next_tier is not None:
+            cap += getattr(top_tier.next_tier, "capacity", 0) or 0
+        self._parents_cap = max(4096, 2 * cap)
+        self._parents: "OrderedDict[int, Optional[int]]" = OrderedDict()
+        self._protected: Dict[int, float] = {}
+        self._protected_stamp: Optional[Tuple[int, int]] = None
+        self._protected_next = 0.0
+        top_tier.scorer = self._popularity_score
+        if top_tier.next_tier is not None and hasattr(top_tier.next_tier, "scorer"):
+            top_tier.next_tier.scorer = self._popularity_score
+        if self.filter.popular is None:
+            self.filter.popular = self._is_protected
+        # Outstanding speculative leases, so close() can revoke them all.
+        self._prefetches: set = set()
 
     # -- wiring -------------------------------------------------------------
 
@@ -287,10 +435,57 @@ class TieredKvManager:
             )
             self._sketch_replacements_seen = replaced
 
-    def notify_commit(self, block_hash: int, chain_depth: int) -> None:
+    def notify_commit(
+        self,
+        block_hash: int,
+        chain_depth: int,
+        parent: Optional[int] = None,
+    ) -> None:
+        # Parent first, filter second: the eviction scorer must be able
+        # to expand anchors through blocks the offload filter rejected.
+        if parent is not None or block_hash not in self._parents:
+            self._parents[block_hash] = parent
+        self._parents.move_to_end(block_hash)
+        while len(self._parents) > self._parents_cap:
+            self._parents.popitem(last=False)
         if self.filter.admit(chain_depth, block_hash) and not self.tier.contains(block_hash):
             self._pending.put_nowait((block_hash, chain_depth))
             self._ensure_task()
+
+    # -- popularity scoring (tiers.Scorer; sketch-agnostic seam) -------------
+
+    def _maybe_rebuild_protected(self) -> None:
+        now = time.monotonic()
+        if now < self._protected_next:
+            return
+        # Throttle regardless of outcome: at most ~2 rebuilds/sec even
+        # under eviction storms.
+        self._protected_next = now + 0.5
+        stamp = self.kv_plane.sketch.stamp()
+        if stamp == self._protected_stamp:
+            return
+        protected: Dict[int, float] = {}
+        for anchor, score in self.kv_plane.sketch.top_scores(PROTECT_TOP_K).items():
+            h: Optional[int] = anchor
+            for _ in range(PROTECT_WALK_DEPTH):
+                if h is None:
+                    break
+                prev = protected.get(h)
+                if prev is None or score > prev:
+                    protected[h] = score
+                h = self._parents.get(h)
+        self._protected = protected
+        self._protected_stamp = stamp
+
+    def _popularity_score(self, block_hash: int) -> Optional[float]:
+        """tiers.Scorer: decayed popularity of the hottest prefix this
+        block is part of, or None when no tracked anchor covers it."""
+        self._maybe_rebuild_protected()
+        return self._protected.get(block_hash)
+
+    def _is_protected(self, block_hash: int) -> bool:
+        """OffloadFilter.popular: is the block under a top-K anchor?"""
+        return self._popularity_score(block_hash) is not None
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
@@ -329,7 +524,11 @@ class TieredKvManager:
         for h in todo:
             found, wire = await self._engine.export_blocks_wire_async([h])
             if not found:
-                continue  # evicted before we got to it; write-through missed
+                # Evicted before we got to it: the write-through promise
+                # silently lost a block — count it so filter/burst tuning
+                # has a loss signal to steer by.
+                self.metrics.offload_missed.inc(reason="device_evicted")
+                continue
             if wire.quantized:
                 self.tier.put(
                     h, wire.k[0], wire.v[0], wire.k_scale[0], wire.v_scale[0]
@@ -369,62 +568,26 @@ class TieredKvManager:
             n += 1
         return n
 
-    async def onboard(self, block_hashes: List[int]) -> int:
-        """Bring a leading run of blocks onto the device (before prefill).
-        Returns how many blocks were installed."""
-        assert self._engine is not None
+    async def _import_batch(
+        self, hashes: List[int], blocks: List[tuple], anchor: Optional[int]
+    ) -> int:
+        """Install one batch device-side. Splits into uniform-form
+        sub-runs (a tier can hold a mix of dense and quantized blocks
+        across engine-dtype generations); each sub-run after the first
+        anchors on its predecessor's tail so the chain stays
+        parent-linked. Returns blocks installed (< len(hashes) = pool
+        dry)."""
         from dynamo_tpu.disagg.wire import tier_block_wire
 
-        t0 = time.monotonic()
-        run: List[int] = []
-        blocks: List[tuple] = []
-        # Deepest tier the run resolved from (hit attribution for the
-        # KV-reuse plane; checked BEFORE get() because get() promotes).
-        tier_rank = {getattr(self.tier, "name", "host"): 0}
-        if self.tier.next_tier is not None:
-            tier_rank[getattr(self.tier.next_tier, "name", "disk")] = 1
-        deepest: Optional[str] = None
-        for h in block_hashes:
-            if self.tier.contains(h):
-                src = getattr(self.tier, "name", "host")
-            elif (
-                self.tier.next_tier is not None
-                and self.tier.next_tier.contains(h)
-            ):
-                src = getattr(self.tier.next_tier, "name", "disk")
-            else:
-                src = "remote"
-            blk = self.tier.get(h)
-            if blk is None and self.remote is not None:
-                # G4 fallback: a shared-store hit extends the run (and lands
-                # in the host tier for next time).
-                blk = await self.remote.get_async(h)
-                if blk is not None:
-                    self.tier.put(h, *blk)
-            if blk is None:
-                break
-            if deepest is None or tier_rank.get(src, 2) > tier_rank.get(deepest, 2):
-                deepest = src
-            run.append(h)
-            blocks.append(blk)
-        self.last_onboard_source = deepest
-        if not run:
-            return 0
-
-        # Install in uniform-form sub-runs (a tier can hold a mix of dense
-        # and quantized blocks across engine-dtype generations); each
-        # sub-run after the first anchors on its predecessor's tail so the
-        # chain stays parent-linked.
         installed = 0
-        anchor = None
         i = 0
-        while i < len(run):
+        while i < len(hashes):
             j = i + 1
-            while j < len(run) and len(blocks[j]) == len(blocks[i]):
+            while j < len(hashes) and len(blocks[j]) == len(blocks[i]):
                 j += 1
             wire = tier_block_wire(blocks[i:j])
             n = await self._engine.import_blocks_wire_async(
-                run[i:j], wire, anchor_parent=anchor
+                hashes[i:j], wire, anchor_parent=anchor
             )
             installed += n
             self.metrics.onboard_bytes.inc(
@@ -432,18 +595,228 @@ class TieredKvManager:
             )
             if n < j - i:
                 break  # pool dry mid-run
-            anchor = run[j - 1]
+            anchor = hashes[j - 1]
             i = j
-        self.onboarded += installed
-        self.metrics.onboard_blocks.inc(installed)
-        dt = time.monotonic() - t0
-        self.metrics.onboard_duration.observe(dt, tier=deepest or "host")
-        self.kv_flight.record(
-            "onboard", blocks=installed, run=len(run),
-            tier=deepest or "host", ms=round(dt * 1000.0, 3),
-        )
-        self._sync_plane()
         return installed
+
+    async def onboard(
+        self,
+        block_hashes: List[int],
+        *,
+        should_stop: Optional[Callable[[], bool]] = None,
+    ) -> int:
+        """Bring a leading run of blocks onto the device (before prefill).
+        Returns how many blocks were installed.
+
+        Pipelined: tier reads proceed in ONBOARD_BATCH_BLOCKS batches
+        with the previous batch's device scatter still in flight — host
+        page-ins and disk .npz reads run on the event-loop thread while
+        ``import_blocks_wire_async`` awaits the device executor, so the
+        two legs genuinely overlap. One batch in flight bounds the
+        speculative HBM footprint; the import path itself stops when the
+        pool runs dry. ``should_stop`` is the cooperative revocation
+        probe (speculative leases), checked between batches.
+        """
+        assert self._engine is not None
+
+        t0 = time.monotonic()
+        # Deepest tier the walk resolved from (hit attribution for the
+        # KV-reuse plane; checked BEFORE get() because get() promotes).
+        tier_rank = {getattr(self.tier, "name", "host"): 0}
+        if self.tier.next_tier is not None:
+            tier_rank[getattr(self.tier.next_tier, "name", "disk")] = 1
+        deepest: Optional[str] = None
+        installed = 0
+        walked = 0
+        anchor: Optional[int] = None
+        import_task: Optional[asyncio.Task] = None
+        in_flight: List[int] = []
+        idx = 0
+        dry = False
+        try:
+            while idx < len(block_hashes) and not dry:
+                if should_stop is not None and should_stop():
+                    break
+                batch_hashes: List[int] = []
+                batch_blocks: List[tuple] = []
+                while (
+                    idx < len(block_hashes)
+                    and len(batch_hashes) < ONBOARD_BATCH_BLOCKS
+                ):
+                    h = block_hashes[idx]
+                    if self.tier.contains(h):
+                        src = getattr(self.tier, "name", "host")
+                    elif (
+                        self.tier.next_tier is not None
+                        and self.tier.next_tier.contains(h)
+                    ):
+                        src = getattr(self.tier.next_tier, "name", "disk")
+                    else:
+                        src = "remote"
+                    blk = self.tier.get(h)
+                    if blk is None and self.remote is not None:
+                        # G4 fallback: a shared-store hit extends the run
+                        # (and lands in the host tier for next time).
+                        blk = await self.remote.get_async(h)
+                        if blk is not None:
+                            self.tier.put(h, *blk)
+                    if blk is None:
+                        dry = True
+                        break
+                    if deepest is None or tier_rank.get(src, 2) > tier_rank.get(deepest, 2):
+                        deepest = src
+                    batch_hashes.append(h)
+                    batch_blocks.append(blk)
+                    idx += 1
+                # Join the in-flight import before dispatching the next:
+                # the parent anchor of batch i+1 is only valid once batch
+                # i fully installed.
+                if import_task is not None:
+                    n = await import_task
+                    import_task = None
+                    installed += n
+                    if n < len(in_flight):
+                        break  # pool dry
+                    anchor = in_flight[-1]
+                if batch_hashes:
+                    walked += len(batch_hashes)
+                    in_flight = batch_hashes
+                    import_task = asyncio.ensure_future(
+                        self._import_batch(batch_hashes, batch_blocks, anchor)
+                    )
+            if import_task is not None:
+                installed += await import_task
+                import_task = None
+        except BaseException:
+            # A tier read blew up (injected fault, IO error) with a
+            # scatter still in flight: land the scatter before unwinding
+            # so the pool is never left with an orphan import task.
+            if import_task is not None:
+                try:
+                    installed += await import_task
+                except Exception:
+                    logger.debug(
+                        "onboard import failed during unwind", exc_info=True
+                    )
+            raise
+        finally:
+            self.last_onboard_source = deepest
+            if walked:
+                self.onboarded += installed
+                self.metrics.onboard_blocks.inc(installed)
+                dt = time.monotonic() - t0
+                self.metrics.onboard_duration.observe(dt, tier=deepest or "host")
+                self.kv_flight.record(
+                    "onboard", blocks=installed, run=walked,
+                    tier=deepest or "host", ms=round(dt * 1000.0, 3),
+                )
+                self._sync_plane()
+        return installed
+
+    # -- speculative onboarding (router hint → revocable lease) --------------
+
+    def prefetch(self, block_hashes: List[int]) -> Optional["KvPrefetch"]:
+        """Start a speculative onboard walk for a routed request's
+        predicted prefix, ahead of admission. Returns a revocable
+        ``KvPrefetch`` lease (or None when there is nothing to do). The
+        walk is capped at PREFETCH_MAX_BLOCKS — the waste bound when the
+        hint turns out wrong."""
+        if self._engine is None or not block_hashes:
+            return None
+        pf = KvPrefetch(self, list(block_hashes[:PREFETCH_MAX_BLOCKS]))
+        self._prefetches.add(pf)
+        pf.task = asyncio.get_event_loop().create_task(
+            self._run_prefetch(pf), name="kvbm-prefetch"
+        )
+        return pf
+
+    async def _run_prefetch(self, pf: "KvPrefetch") -> None:
+        from dynamo_tpu.runtime import fault_names
+        from dynamo_tpu.runtime.faults import fault_point
+
+        try:
+            # Chaos seam: ONE hit per speculative lease, before any tier
+            # read or scatter — an injection models the prefetch machinery
+            # dying outright (tests/test_kvbm.py replays this; the lease
+            # settles as error and admission falls back to serial onboard).
+            fault_point(fault_names.KVBM_PREFETCH)
+            n_dev = self._engine.pool.match_prefix(pf.hashes)
+            if n_dev < len(pf.hashes):
+                pf.walk_installed = await self.onboard(
+                    pf.hashes, should_stop=lambda: pf.revoked
+                )
+                pf.source = self.last_onboard_source
+            if not pf.revoked:
+                # Take the lease: pin the leading device-resident run so
+                # pool eviction cannot undo the speculative work before
+                # admission joins (admission re-pins, THEN claims — the
+                # refcount never dips to zero in between).
+                matched, ids = self._engine.pool.pin_prefix(pf.hashes)
+                pf.pinned_ids = list(ids)
+                pf.pinned_hashes = pf.hashes[:matched]
+        except Exception:
+            # The walk never raises into wait(): an error settles the
+            # lease as wasted and the request recomputes its prefix.
+            logger.debug("speculative prefetch walk failed", exc_info=True)
+            pf.error = True
+        finally:
+            pf.walk_done = True
+            pf.t_done = time.monotonic()
+            if pf.error or pf.revoked:
+                self._settle_prefetch(pf, used=False)
+            elif not pf.pinned_hashes and not pf.walk_installed:
+                # Nothing tier-resident after all: settle now as skipped
+                # (there is no lease to hold open).
+                self._settle_prefetch(pf, used=False)
+
+    def _settle_prefetch(
+        self, pf: "KvPrefetch", *, used: bool, stall_s: float = 0.0
+    ) -> None:
+        """Exactly-once lease settlement: release pins, count the
+        outcome, record the flight event. Single-writer on the manager's
+        event loop (DYN005: both rings stay owned here)."""
+        if pf.settled:
+            return
+        pf.settled = True
+        self._prefetches.discard(pf)
+        if pf.pinned_ids:
+            # Both paths release the lease's own pins: on claim the
+            # admission pin (taken first) keeps the blocks active; on
+            # revocation they fall back to reclaimable cached blocks.
+            self._engine.pool.release(pf.pinned_ids, pf.pinned_hashes)
+        walk_s = (pf.t_done or time.monotonic()) - pf.t_start
+        if used:
+            pf.claimed = True
+            outcome = "claimed"
+            if pf.pinned_hashes:
+                self.metrics.prefetch_blocks.inc(
+                    len(pf.pinned_hashes), outcome="used"
+                )
+            overlap = max(0.0, walk_s - max(0.0, stall_s))
+            self.metrics.prefetch_overlap.observe(overlap)
+        else:
+            outcome = (
+                "error" if pf.error
+                else "revoked" if pf.revoked
+                else "skipped"
+            )
+            overlap = 0.0
+            if pf.walk_installed:
+                # The bounded cost of speculation: blocks dragged through
+                # the tiers that no admission ever claimed.
+                self.metrics.prefetch_blocks.inc(
+                    pf.walk_installed, outcome="wasted"
+                )
+        self.metrics.prefetches.inc(outcome=outcome)
+        self.kv_flight.record(
+            "prefetch", outcome=outcome, hint=len(pf.hashes),
+            matched=len(pf.pinned_hashes), moved=pf.walk_installed,
+            tier=pf.source or "device", reason=pf.revoke_reason or "",
+            walk_ms=round(walk_s * 1000.0, 3),
+            overlap_ms=round(overlap * 1000.0, 3),
+        )
+        pf.pinned_ids = []
+        pf.pinned_hashes = []
 
     def register_metrics(self, server: Any) -> None:
         """Expose this manager's metric families on a SystemStatusServer."""
@@ -466,6 +839,16 @@ class TieredKvManager:
         return out
 
     async def close(self) -> None:
+        # Revoke outstanding speculative leases (their walks stop at the
+        # next batch boundary and settle as revoked/wasted).
+        for pf in list(self._prefetches):
+            pf.revoke("close")
+        tasks = [
+            pf.task for pf in list(self._prefetches)
+            if pf.task is not None and not pf.task.done()
+        ]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         if self._task is not None and not self._task.done():
             self._task.cancel()
             await reap_task(self._task, "kvbm consolidator", logger)

@@ -36,6 +36,55 @@ logger = get_logger(__name__)
 # (k, v) dense or (k_q8, v_q8, k_scale, v_scale) quantized
 Block = Tuple[np.ndarray, ...]
 
+# Popularity scorer: block_hash -> decayed score, or None when the signal
+# source has nothing on the block. Tiers stay sketch-agnostic — the
+# callable is wired by TieredKvManager (its protected-prefix map over the
+# PR 16 sketch); tiers only compare the floats it returns.
+Scorer = Callable[[int], Optional[float]]
+
+# How many LRU-oldest entries a scored eviction considers. Bounds both
+# the per-eviction cost (window scorer calls) and the worst-case
+# deviation from plain LRU (a hot block can sit at most window-1 slots
+# from the LRU head before recency alone saves it).
+EVICT_SCAN_WINDOW = 8
+
+
+def _pop_victim(lru: OrderedDict, scorer: Optional[Scorer]):
+    """Pop the eviction victim ``(key, value)`` from an LRU OrderedDict.
+
+    With no scorer this IS ``popitem(last=False)`` — plain LRU. With one,
+    scan the EVICT_SCAN_WINDOW oldest entries and evict the least popular:
+    unscored entries (scorer returned None) go first, then ascending
+    score, with LRU age as the tiebreak. A scorer failure costs ranking
+    quality for this pass, never the eviction itself.
+    """
+    if scorer is None:
+        return lru.popitem(last=False)
+    victim = None
+    best = None
+    for i, h in enumerate(lru):
+        if i >= EVICT_SCAN_WINDOW:
+            break
+        try:
+            s = scorer(h)
+        except Exception:
+            logger.debug("eviction scorer failed; falling back to LRU",
+                         exc_info=True)
+            victim = None
+            break
+        if s is None:
+            # Unscored beats any score, and no later unscored entry can
+            # be older than this one: done.
+            victim = h
+            break
+        key = (s, i)
+        if best is None or key < best:
+            best = key
+            victim = h
+    if victim is None:
+        return lru.popitem(last=False)
+    return victim, lru.pop(victim)
+
 
 @dataclass
 class TierStats:
@@ -88,6 +137,8 @@ class HostTier:
 
             self._staging = BlockStagingPool(arena_bytes)
         self.stats = TierStats()
+        # Optional popularity scorer (see _pop_victim); None = plain LRU.
+        self.scorer: Optional[Scorer] = None
 
     def __len__(self) -> int:
         return len(self._blocks)
@@ -115,7 +166,7 @@ class HostTier:
             self._blocks[block_hash] = blk
         self.stats.stored += 1
         while len(self._blocks) > self.capacity:
-            h, blk = self._blocks.popitem(last=False)
+            h, blk = _pop_victim(self._blocks, self.scorer)
             if self._staging is not None:
                 blk = self._staging.get(h)
                 spill = (
@@ -185,6 +236,8 @@ class DiskTier:
         os.makedirs(root, exist_ok=True)
         self._lru: "OrderedDict[int, str]" = OrderedDict()
         self.stats = TierStats()
+        # Optional popularity scorer (see _pop_victim); None = plain LRU.
+        self.scorer: Optional[Scorer] = None
         # (block_hash, detail) -> None; TieredKvManager wires this to its
         # flight ring so corruption shows up in /debug/flight.
         self.on_corruption: Optional[Callable[[int, str], None]] = None
@@ -244,7 +297,7 @@ class DiskTier:
         self._lru[block_hash] = path
         self.stats.stored += 1
         while len(self._lru) > self.capacity:
-            h, p = self._lru.popitem(last=False)
+            h, p = _pop_victim(self._lru, self.scorer)
             self.stats.note_evicted("capacity")
             try:
                 os.unlink(p)

@@ -385,6 +385,11 @@ class KvRouter:
                     self.scheduler.report_generation(worker),
                 )
             )
+            # The overlap prediction rides to the worker as its
+            # speculative-onboard hint (engines/tpu/engine.py
+            # _maybe_prefetch): positive means "start the tier walk at
+            # enqueue", zero means the engine never speculates — cold
+            # traffic stays prefetch-free by construction.
             if isinstance(request, dict):
                 request["estimated_prefix_hit_blocks"] = overlap
             else:
@@ -395,6 +400,7 @@ class KvRouter:
             lifecycle.record(
                 _request_id_of(request), "routed",
                 worker=worker[0], overlap_blocks=overlap,
+                prefetch_hint=overlap > 0,
             )
             return worker[0]
 

@@ -457,6 +457,14 @@ class KvScheduler:
                     overlaps.scores.get(chosen_w, 0)
                     if chosen_w is not None else 0
                 ),
+                # Dispatch metadata: a positive overlap ships with the
+                # request as its speculative-onboard hint (the engine
+                # starts the tier walk at enqueue — kv_prefetch.md), so
+                # the decision record says whether speculation was armed.
+                "prefetch_hint": bool(
+                    chosen_w is not None
+                    and overlaps.scores.get(chosen_w, 0) > 0
+                ),
                 "request_blocks": request_blocks,
                 "pruned": pruned,
                 "transfer_src": transfer.src if transfer is not None else None,

@@ -45,6 +45,14 @@ HEALTH_CANARY = "health.canary"
 KVBM_TIER_READ = "kvbm.tier.read"
 KVBM_TIER_WRITE = "kvbm.tier.write"
 
+# -- KVBM speculative prefetch (kvbm/manager.py) ------------------------------
+# One hit per speculative onboard walk, at the top of the prefetch task
+# BEFORE any tier read or device scatter: an injection models the prefetch
+# machinery dying outright — the lease must settle as wasted (outcome
+# "error"), the pool must stay balanced, and admission must fall back to
+# the serial onboard path untouched.
+KVBM_PREFETCH = "kvbm.prefetch"
+
 # -- drain plane (runtime/drain.py, engines/tpu/engine.py) --------------------
 # Export side of a live handoff: one hit per detached sequence, BEFORE the
 # device gather — an injection models the draining worker failing to read
@@ -116,6 +124,7 @@ ALL_FAULT_POINTS = (
     HEALTH_CANARY,
     KVBM_TIER_READ,
     KVBM_TIER_WRITE,
+    KVBM_PREFETCH,
     DRAIN_HANDOFF_EXPORT,
     DRAIN_HANDOFF_IMPORT,
     LIVENESS_REPORT,

@@ -242,6 +242,25 @@ class PrefixPopularitySketch:
                 for e in ranked
             ]
 
+    def top_scores(self, k: int = 20) -> Dict[int, float]:
+        """Ranked top-K as ``{anchor: decayed_score}`` — the narrow feed
+        the KVBM eviction scorer consumes (kvbm/manager.py): integer
+        anchors, no per-row formatting, one lock hold."""
+        with self._lock:
+            f = self._decay_factor(time.time())
+            ranked = heapq.nlargest(
+                max(0, int(k)), self._entries.values(),
+                key=lambda e: e.count,
+            )
+            return {e.anchor: e.count * f for e in ranked}
+
+    def stamp(self) -> Tuple[int, int]:
+        """Cheap change marker: ``(total_touches, replacements)``.
+        Consumers that cache a derived view (the KVBM protected-prefix
+        map) rebuild only when this moves."""
+        with self._lock:
+            return (self.total_touches, self.replacements)
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {

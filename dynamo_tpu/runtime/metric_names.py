@@ -110,6 +110,20 @@ KVBM_RESTORE_CORRUPTION_TOTAL = f"{KVBM_PREFIX}_restore_corruption_total"
 # landed in / came from rides the {tier} label.
 KVBM_OFFLOAD_DURATION = f"{KVBM_PREFIX}_offload_duration_seconds"
 KVBM_ONBOARD_DURATION = f"{KVBM_PREFIX}_onboard_duration_seconds"
+# Write-through losses: a committed block was evicted from the device pool
+# before the offload worker could gather it ({reason}: device_evicted).
+KVBM_OFFLOAD_MISSED_TOTAL = f"{KVBM_PREFIX}_offload_missed_total"
+# Speculative onboarding (kv_prefetch.md): one prefetch lease per routed
+# request with a tier-resident hint. {outcome}: claimed (admission joined
+# the lease), revoked (abort/shed released it), skipped (nothing tier-
+# resident / pool already warm), error (walk died). Blocks ride the same
+# split as {outcome}: used | wasted — wasted is the bounded cost of
+# speculation and the number the cold leg must hold at zero.
+KVBM_PREFETCHES_TOTAL = f"{KVBM_PREFIX}_prefetches_total"
+KVBM_PREFETCH_BLOCKS_TOTAL = f"{KVBM_PREFIX}_prefetch_blocks_total"
+# Onboard wall time hidden behind queue wait + suffix prefill: walk wall
+# time minus the stall admission actually observed joining the lease.
+KVBM_PREFETCH_OVERLAP_SECONDS = f"{KVBM_PREFIX}_prefetch_overlap_seconds"
 
 # -- KV-reuse plane (runtime/kv_reuse_observe.py KvReusePlane) ----------------
 KVCACHE_PREFIX = "dynamo_tpu_kvcache"
@@ -376,6 +390,10 @@ ALL_KVBM = (
     KVBM_RESTORE_CORRUPTION_TOTAL,
     KVBM_OFFLOAD_DURATION,
     KVBM_ONBOARD_DURATION,
+    KVBM_OFFLOAD_MISSED_TOTAL,
+    KVBM_PREFETCHES_TOTAL,
+    KVBM_PREFETCH_BLOCKS_TOTAL,
+    KVBM_PREFETCH_OVERLAP_SECONDS,
 )
 
 ALL_KVCACHE = (

@@ -138,6 +138,87 @@ def test_kv_reuse_scale_1m():
     _assert_scale_contracts(n_prefixes=1_000_000, n_touches=1_500_000)
 
 
+def _assert_tier_manager_scale(n_blocks: int) -> None:
+    """Drive the TIER MANAGER itself at scale (ISSUE 17 satellite): with
+    ~n distinct cached prefixes resident in the host tier,
+
+      * onboard-lookup latency (match_chain) stays bounded — it is on the
+        admission path for every hintless request;
+      * /debug/kvcache stays coherent: live occupancy equals what was
+        fed, capacity evictions mirrored exactly into the plane counters.
+    """
+    from dynamo_tpu.kvbm import HostTier, OffloadFilter, TieredKvManager
+
+    rng = np.random.default_rng(11)
+    hashes = rng.permutation(np.arange(1, n_blocks + 1, dtype=np.uint64))
+    hashes = (
+        (hashes * np.uint64(0x9E3779B97F4A7C15))
+        & np.uint64(0x7FFFFFFFFFFFFFFF)
+    ).astype(np.int64)
+
+    plane = KvReusePlane(capacity=4096)
+    host = HostTier(n_blocks)
+    # min_frequency=∞: notify_commit never enqueues offload work, so the
+    # manager runs engineless (no event loop in this test).
+    kvbm = TieredKvManager(
+        host, plane=plane, filter=OffloadFilter(min_frequency=10**9)
+    )
+    try:
+        # ONE shared 1-byte payload: tier entries hold references, so the
+        # footprint is the index, not n_blocks copies of KV data.
+        payload = np.zeros(1, dtype=np.int8)
+        for h in hashes:
+            host.put(int(h), payload, payload)
+        assert len(host) == n_blocks
+
+        # Overflow past capacity: the oldest entries spill (dropped — no
+        # next tier) and the deltas must mirror into the plane exactly.
+        extra = 1000
+        for h in range(n_blocks + 1, n_blocks + 1 + extra):
+            host.put(h, payload, payload)
+        kvbm._sync_plane()
+        assert len(host) == n_blocks
+        assert (
+            plane.metrics.evictions.value(tier="host", reason="capacity")
+            == extra
+        )
+
+        # Bounded onboard-lookup latency on a full tier: hits and misses.
+        timed = min(20_000, n_blocks)
+        lat = np.empty(timed, dtype=np.float64)
+        probe = rng.integers(0, n_blocks, size=timed)
+        for j in range(timed):
+            h = int(hashes[probe[j]])
+            t0 = time.perf_counter()
+            n = kvbm.match_chain([h])
+            lat[j] = time.perf_counter() - t0
+            assert n == (1 if host.contains(h) else 0)
+        p99 = float(np.percentile(lat, 99))
+        assert p99 < 5e-3, f"match_chain p99 {p99 * 1e6:.1f}us"
+        assert kvbm.match_chain([int(hashes[0]) ^ (1 << 60)]) == 0
+
+        # Coherent /debug/kvcache: the manager's live occupancy source.
+        view = kvcache_index(plane=plane, top_k=5)
+        tier_view = view["tiers"]["kvbm"]["host"]
+        assert tier_view["blocks"] == n_blocks
+        assert tier_view["stored"] == n_blocks + extra
+    finally:
+        # Engineless manager: close() is async but nothing is in flight —
+        # detach the plane sources directly (what close() would do).
+        for name in list(kvbm.metrics._tier_sources):
+            kvbm.metrics.unwatch_tier(name)
+        plane.forget_tier_source(kvbm._plane_label)
+
+
+def test_tier_manager_scale_100k():
+    _assert_tier_manager_scale(100_000)
+
+
+@pytest.mark.slow
+def test_tier_manager_scale_1m():
+    _assert_tier_manager_scale(1_000_000)
+
+
 def test_drop_worker_zero_residue_through_scheduler():
     """The router wires plane.drop_worker as a KvScheduler drop callback:
     a departed worker's sketch contributions vanish with its radix/load

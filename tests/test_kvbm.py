@@ -357,3 +357,33 @@ class TestFrequencyFilter:
         for h in range(10):
             f.admit(1, block_hash=h)
         assert len(f._counts) <= 4
+
+    def test_popular_fast_path_bypasses_depth_gate(self):
+        from dynamo_tpu.kvbm.manager import OffloadFilter
+
+        f = OffloadFilter(min_chain_depth=3)
+        f.popular = lambda h: h == 7
+        assert f.admit(1, block_hash=7)       # hot-but-shallow: fast path
+        assert not f.admit(1, block_hash=5)   # cold shallow: still gated
+        assert not f.admit(1)                 # no hash → no popularity probe
+        assert f.admit(3, block_hash=5)       # deep chains unaffected
+
+    def test_popular_fast_path_keeps_frequency_gate(self):
+        from dynamo_tpu.kvbm.manager import OffloadFilter
+
+        f = OffloadFilter(min_chain_depth=3, min_frequency=2)
+        f.popular = lambda h: True
+        assert not f.admit(1, block_hash=9)  # popular, but first sighting
+        assert f.admit(1, block_hash=9)      # second commit earns the wire
+
+    def test_popular_probe_failure_keeps_gate(self):
+        from dynamo_tpu.kvbm.manager import OffloadFilter
+
+        f = OffloadFilter(min_chain_depth=3)
+
+        def bad(_h):
+            raise RuntimeError("sketch gone")
+
+        f.popular = bad
+        assert not f.admit(1, block_hash=7)  # probe failure = not popular
+        assert f.admit(3, block_hash=7)      # depth path still works
