@@ -37,7 +37,9 @@ scale-down re-prefill, select_worker cost at 10 vs 100 workers — pure
 CPU arithmetic, lands on any backend), BENCH_KVREUSE=0 (skip the
 KV-reuse leg: shared-prefix mix through a tiny real engine — hit rate
 by tier, prefill tokens saved, TTFT delta vs cold-cache control; lands
-on any backend).
+on any backend), BENCH_TICKBUDGET=0 (skip the tick-budgeter leg:
+prefill-heavy wave over a steady decode population, budgeted vs
+aggregated p99 ITL + throughput; lands on any backend).
 """
 
 from __future__ import annotations
@@ -2020,6 +2022,258 @@ async def run_kv_reuse_leg(n_prefixes: int = 6, requests: int = 36,
     }
 
 
+async def run_tick_budget_leg(decode_streams: int = 4, decode_isl: int = 64,
+                              decode_osl: int = 512, wave_n: int = 3,
+                              wave_isl: int = 2048, wave_osl: int = 16,
+                              seed: int = 31):
+    """Tick-budgeter leg (ISSUE 18): a prefill-heavy wave (ISL-2048) lands
+    on a steady decode population (OSL-512) inside ONE tiny real engine —
+    lands on any backend:
+
+      * aggregated mode (budgeter off): each admission prefills to
+        COMPLETION inside its tick, so the wave stalls every decode
+        stream for the full multi-thousand-token prefill — p99 ITL blows
+        through the SLA band;
+      * budgeted mode (TickBudgeter on): per-tick prefill is capped at
+        the live budget, the parked remainder resumes next tick behind a
+        decode burst — p99 ITL holds inside the band at ≥0.9× aggregated
+        throughput (the wave finishes a few ticks later; no work is
+        dropped).
+
+    The SLA band is derived from the leg's own measurements — steady
+    p50 plus one prefill chunk-round stall amortized over a decode
+    burst, ×3 slack — so the contract is about interleaving, not host
+    speed: the band is the structural floor any intra-chip interleaver
+    pays (one possibly-overdrawn round per tick), which budgeted mode
+    holds and prefill-to-completion blows through by orders of
+    magnitude.
+    """
+    from dynamo_tpu.engines.tpu import JaxEngine, JaxEngineArgs
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import tiny_config
+    from dynamo_tpu.runtime.context import Context
+
+    fault_activity0 = _fault_activity_start()
+    cfg = tiny_config()
+    rng = np.random.default_rng(seed)
+    decode_prompts = [
+        rng.integers(10, 200, size=decode_isl).tolist()
+        for _ in range(decode_streams)
+    ]
+    wave_prompts = [
+        rng.integers(10, 200, size=wave_isl).tolist() for _ in range(wave_n)
+    ]
+    # Warmup-only long prompt: distinct tokens (the measured wave must not
+    # ride the prefix cache) but the same SHAPE class — decoding at wave
+    # context length compiles the wide block-table-bucket decode program
+    # outside the measured window.
+    warm_prompt = rng.integers(10, 200, size=wave_isl).tolist()
+
+    def mk_args(**over):
+        base = dict(
+            config=cfg,
+            block_size=16,
+            num_kv_blocks=1024,
+            max_num_seqs=decode_streams + wave_n,
+            max_model_len=wave_isl + decode_osl + 64,
+            prefill_chunk=64,
+            prefill_batch=2,
+            decode_steps=8,
+        )
+        base.update(over)
+        return JaxEngineArgs(**base)
+
+    # Prompts sized to a full prefill round (prefill_batch × chunk
+    # rows' worth of tokens) — timed on the warmed aggregated engine to
+    # calibrate the SLA band's chunk-round term. Distinct prompts per
+    # pass so the second can't ride the prefix cache.
+    calib_prompts = [
+        rng.integers(10, 200, size=2 * 64).tolist() for _ in range(2)
+    ]
+
+    async def sub_leg(args, sla_s=None, calibrate=False):
+        """One mixed-traffic pass → (itl samples, stats, wall, tokens).
+
+        ITL samples are (t, seconds/token) reap-gap measurements taken
+        client-side on the DECODE population only; the wave's streams
+        contribute load, not samples."""
+        engine = JaxEngine(args)
+        samples: list = []  # (monotonic t, per-token gap s)
+        total_tokens = [0]
+
+        async def decode_one(i):
+            req = PreprocessedRequest(
+                token_ids=decode_prompts[i],
+                request_id=f"tb-decode-{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=decode_osl, ignore_eos=True),
+            )
+            last = None
+            async for out in engine.generate(req, Context()):
+                n = len(out.token_ids or [])
+                now = time.monotonic()
+                if n and last is not None:
+                    samples.append((now, (now - last) / n))
+                if n:
+                    last = now
+                    total_tokens[0] += n
+
+        async def wave_one(i):
+            req = PreprocessedRequest(
+                token_ids=wave_prompts[i],
+                request_id=f"tb-wave-{i}",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=wave_osl, ignore_eos=True),
+            )
+            async for out in engine.generate(req, Context()):
+                total_tokens[0] += len(out.token_ids or [])
+
+        try:
+            # Warmup: trigger the compiles outside the measured window —
+            # the decode-population shapes AND a wave-length stream (its
+            # 2048-token context decodes in a wider block-table bucket;
+            # without this the first wave join pays that compile inside
+            # the measured wave, in both modes).
+            warm_req = PreprocessedRequest(
+                token_ids=warm_prompt,
+                request_id="tb-warm-wave",
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=8, ignore_eos=True),
+            )
+
+            async def warm_wave():
+                async for _ in engine.generate(warm_req, Context()):
+                    pass
+
+            await asyncio.gather(decode_one(0), warm_wave())
+            samples.clear()
+            total_tokens[0] = 0
+            t0 = time.monotonic()
+            decoders = [
+                asyncio.ensure_future(decode_one(i))
+                for i in range(decode_streams)
+            ]
+            # Let the population reach steady state, then land the wave.
+            await asyncio.sleep(0.0)
+            while not samples:
+                await asyncio.sleep(0.01)
+            steady_until = time.monotonic() + 0.25
+            while time.monotonic() < steady_until:
+                await asyncio.sleep(0.01)
+            wave_at = time.monotonic()
+            await asyncio.gather(
+                *(wave_one(i) for i in range(wave_n)), *decoders
+            )
+            wall = time.monotonic() - t0
+            round_s = 0.0
+            if calibrate:
+                # Time one round-sized prefill on the warmed, now-idle
+                # engine: the per-tick stall an interleaver cannot avoid.
+                # Two passes — the first absorbs any compile this exact
+                # ragged shape still owes; the second is the number.
+                for attempt in range(2):
+                    creq = PreprocessedRequest(
+                        token_ids=calib_prompts[attempt],
+                        request_id=f"tb-calib-{attempt}",
+                        sampling=SamplingOptions(temperature=0.0),
+                        stop=StopConditions(max_tokens=1, ignore_eos=True),
+                    )
+                    c0 = time.monotonic()
+                    async for _ in engine.generate(creq, Context()):
+                        pass
+                    round_s = time.monotonic() - c0
+            stats = engine.stats()
+            return {
+                "round_s": round_s,
+                "steady": [s for t, s in samples if t < wave_at],
+                "wave": [s for t, s in samples if t >= wave_at],
+                "wall_s": wall,
+                "tokens": total_tokens[0],
+                "prefill_budget_tokens": stats.get(
+                    "prefill_budget_tokens", 0
+                ),
+                "budget_state": stats.get("budget_state", 0),
+                "budget_rollovers": stats.get("budget_rollovers", 0),
+            }
+        finally:
+            await engine.stop()
+
+    def pct(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+    # Aggregated control first: its pre-wave steady phase + a calibrated
+    # chunk-round cost define the SLA band both modes are judged against.
+    # Band = 3 × (steady p99 + one chunk-round stall). Steady p99 (not
+    # p50) folds the host's scheduling-noise floor into the baseline — a
+    # p99-vs-p99 contract. The round term is the structural ITL floor of
+    # ANY intra-chip interleaver (the budget check runs before each
+    # round, so one round may overdraw); it enters un-amortized as grace
+    # for the under-load overheads an idle-engine calibration can't see,
+    # and is still ~wave_isl/round ≈ 50× below the prefill-to-completion
+    # stall, so the aggregated breach stays structural. Host-speed
+    # independent: a slower host inflates both terms and the measured
+    # gaps together.
+    agg = await sub_leg(mk_args(), calibrate=True)
+    sla_s = 3.0 * (pct(agg["steady"], 0.99) + agg["round_s"])
+    bud = await sub_leg(
+        mk_args(
+            tick_budget_enabled=True,
+            # Strict-ITL posture: start at the floor and let proven
+            # headroom earn budget back, with the ceiling sized so even a
+            # fully-grown budget admits at most ONE [prefill_batch, chunk]
+            # round per tick — the budgeted run sits inside the band by
+            # construction, not by racing the control loop. The AIMD
+            # shrink path itself is proven by tests/test_tick_budget.py;
+            # this leg's contract is the interleave.
+            tick_budget_floor_tokens=64,
+            tick_budget_ceiling_tokens=128,
+            tick_budget_policy=0.0,
+            tick_budget_itl_slo_s=sla_s,
+        ),
+        sla_s=sla_s,
+    )
+    agg_p99 = pct(agg["wave"], 0.99)
+    bud_p99 = pct(bud["wave"], 0.99)
+    agg_tps = agg["tokens"] / agg["wall_s"]
+    bud_tps = bud["tokens"] / bud["wall_s"]
+    ratio = bud_tps / agg_tps if agg_tps > 0 else 0.0
+    return {
+        "decode_streams": decode_streams,
+        "decode_osl": decode_osl,
+        "wave_n": wave_n,
+        "wave_isl": wave_isl,
+        "sla_itl_ms": round(1000 * sla_s, 3),
+        "calib_round_ms": round(1000 * agg["round_s"], 3),
+        "aggregated": {
+            "p99_itl_ms": round(1000 * agg_p99, 3),
+            "toks_per_s": round(agg_tps, 1),
+            "itl_samples": len(agg["wave"]),
+        },
+        "budgeted": {
+            "p99_itl_ms": round(1000 * bud_p99, 3),
+            "toks_per_s": round(bud_tps, 1),
+            "itl_samples": len(bud["wave"]),
+            "prefill_budget_tokens": bud["prefill_budget_tokens"],
+            "budget_state": bud["budget_state"],
+            "budget_rollovers": bud["budget_rollovers"],
+        },
+        # THE contract: the budgeter holds the band the aggregated mode
+        # blows through, at ≥0.9× the aggregated throughput.
+        "sla_held": bool(bud_p99 <= sla_s),
+        "aggregated_breached": bool(agg_p99 > sla_s),
+        "throughput_ratio": round(ratio, 3),
+        "throughput_ratio_ok": bool(ratio >= 0.9),
+        "fault_plane": _fault_plane_record(fault_activity0),
+    }
+
+
 # v5e inter-chip ICI: public spec is 400 Gbps/chip each direction
 # (~50 GB/s); 45 GB/s effective grants the usual ~90% achieved link rate.
 # Used ONLY by the 70B tp8 projection's collective term (one chip cannot
@@ -2394,6 +2648,17 @@ async def run_bench():
             out["kv_reuse_leg"] = await run_kv_reuse_leg()
         except Exception as exc:
             out["kv_reuse_leg"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    if os.environ.get("BENCH_TICKBUDGET", "1") != "0":
+        # Tick-budgeter leg (ISSUE 18): ISL-2048 prefill wave over a
+        # steady OSL-512 decode population — budgeted mode holds p99 ITL
+        # inside the SLA band the aggregated mode blows through, at
+        # ≥0.9× aggregated throughput. Tiny real engine; lands on any
+        # backend; never kills the headline.
+        try:
+            out["tick_budget"] = await run_tick_budget_leg()
+        except Exception as exc:
+            out["tick_budget"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     if os.environ.get("BENCH_ELASTICITY", "1") != "0":
         # Elasticity leg (ISSUE 13): sim-clocked planner ramp (1×→4×→1×

@@ -58,6 +58,11 @@ class HotPathConfig:
             # bare flag check, and this scope entry makes the linter walk
             # through faults.py to prove it.
             "runtime/faults.py",
+            # Tick budgeter (PR 18): observe_decode runs at every reap —
+            # this scope entry makes the linter prove it stays deque-and-
+            # arithmetic only. The control law itself is fenced behind the
+            # TickBudgeter.evaluate boundary below.
+            "engines/tpu/tick_budget.py",
         }
     )
     boundaries: FrozenSet[Tuple[str, str]] = frozenset(
@@ -69,6 +74,11 @@ class HotPathConfig:
             # under a double-checked creation lock, never on a steady
             # dispatch (WatchedJit.__call__ is lock-free).
             ("runtime/device_observe.py", "watched_jit"),
+            # AIMD control law: time-gated to eval_interval_s (admission
+            # side of the tick, never per-reap); may log and emit flight
+            # events, so traversal stops here rather than whitelisting
+            # those in the decode plane.
+            ("engines/tpu/tick_budget.py", "TickBudgeter.evaluate"),
         }
     )
     device_roots: FrozenSet[str] = frozenset(

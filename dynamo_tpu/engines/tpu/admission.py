@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import math
 import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -34,6 +35,35 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+@dataclass
+class PendingPrefill:
+    """A joint chunked prefill's full loop state: the loop-invariant
+    arrays built once per batch (_begin_prefill) plus per-row progress.
+    The budgeted tick (engine._admit_tick_budgeted) parks one of these
+    when the prefill token grant runs out mid-batch — a chunk boundary is
+    a clean resume point (positions, tables and sampling arrays are
+    exactly what the next round needs, and the position-keyed sampling
+    RNG draws the identical first tokens on resume), which is what keeps
+    budgeter-on and budgeter-off token streams bit-identical."""
+
+    batch: List[Tuple[Any, Any]]
+    prompts: List[List[int]]
+    pos: List[int]
+    first: List[Optional[Tuple[int, float, Optional[list]]]]
+    want_top: bool
+    tables: np.ndarray
+    temp: np.ndarray
+    topk: np.ndarray
+    topp: np.ndarray
+    adapter: np.ndarray
+    salts: np.ndarray
+    procs: Optional[tuple]
+    mm_embeds: Optional[np.ndarray]
+    mm_slot_of: Optional[np.ndarray]
+    rows: int
+    Bp: int
 
 
 class Admitter:
@@ -118,26 +148,47 @@ class Admitter:
             e._admitting = 0
 
     async def _finish_admission(self, batch: "List[Tuple[Any, Any]]") -> int:
+        return await self._run_prefill(self._begin_prefill(batch))
+
+    async def _run_prefill(self, pending: "PendingPrefill") -> int:
+        """Run (or resume) a prefill's chunk rounds to completion or to
+        budget exhaustion, then install. Returns rows installed; 0 covers
+        both containment (batch ejected/requeued) and a budget park (the
+        pending state is stashed on the engine, blocks still pinned)."""
         e = self.e
         try:
-            firsts = await e._prefill_batch(batch)
+            done = await self._prefill_rounds(pending)
         except asyncio.CancelledError:
-            for seq, prep in batch:
+            for seq, prep in pending.batch:
                 e.pool.release(prep.ids, prep.hashes[: prep.matched])
                 e._requeue(seq)
             raise
         except Exception as exc:
-            for seq, prep in batch:
+            for seq, prep in pending.batch:
                 e.pool.release(prep.ids, prep.hashes[: prep.matched])
                 seq.block_ids = []
                 seq.block_hashes = []
-            e._contain_admission_failure([s for s, _ in batch], exc)
+            e._contain_admission_failure([s for s, _ in pending.batch], exc)
+            return 0
+        if not done:
+            # Tick budget exhausted at a chunk boundary: park. Blocks stay
+            # pinned and per-row positions are kept — the engine resumes
+            # this exact state with the next tick's grant, ahead of any
+            # new admission (FIFO order is preserved).
+            e._pending_prefill = pending
+            e._record_budget_event(
+                "prefill_pause",
+                rows=pending.rows,
+                done=sum(pending.pos),
+                total=sum(len(p) for p in pending.prompts),
+            )
             return 0
         e._admission_failure_streak = 0
         free_iter = (i for i, s in enumerate(e._slots) if s is None)
-        for (seq, prep), (tok, logp, top) in zip(batch, firsts):
+        for (seq, prep), f in zip(pending.batch, pending.first):
+            tok, logp, top = f
             e._install(seq, prep, next(free_iter), tok, logp, top)
-        return len(batch)
+        return len(pending.batch)
 
     def _contain_admission_failure(self, seqs: "List[Any]", exc: Exception) -> None:
         """Per-request retry-once-then-eject; streak detects systemic failure."""
@@ -287,9 +338,23 @@ class Admitter:
     async def _prefill_batch(
         self, batch: "List[Tuple[Any, Any]]"
     ) -> List[Tuple[int, float]]:
-        """Joint chunked prefill: one [Bp, C] dispatch per chunk round with
-        per-row start/len (forward_paged supports ragged rows natively).
-        Returns each row's (first_token, logprob)."""
+        """Joint chunked prefill to COMPLETION — the tick budget does not
+        apply (callers outside the budgeted admission path want the whole
+        batch: tests, checkpoint warmup). Returns each row's
+        (first_token, logprob, top)."""
+        e = self.e
+        pending = self._begin_prefill(batch)
+        saved, e._tick_budget_left = e._tick_budget_left, None
+        try:
+            await self._prefill_rounds(pending)
+        finally:
+            e._tick_budget_left = saved
+        return pending.first  # type: ignore[return-value]
+
+    def _begin_prefill(self, batch: "List[Tuple[Any, Any]]") -> PendingPrefill:
+        """Per-batch prefill preamble: lifecycle/ROI stamps plus every
+        loop-invariant device array, captured as a PendingPrefill so the
+        chunk rounds can pause and resume across ticks."""
         e = self.e
         args = e.args
         rows = len(batch)
@@ -362,8 +427,37 @@ class Admitter:
         # Multimodal rows run solo (rows == 1), so row 0's arrays suffice.
         mm_embeds = batch[0][1].mm_embeds if rows == 1 else None
         mm_slot_of = batch[0][1].mm_slot_of if rows == 1 else None
+        return PendingPrefill(
+            batch=batch, prompts=prompts, pos=pos, first=first,
+            want_top=want_top, tables=tables, temp=temp, topk=topk,
+            topp=topp, adapter=adapter, salts=salts, procs=procs,
+            mm_embeds=mm_embeds, mm_slot_of=mm_slot_of, rows=rows, Bp=Bp,
+        )
+
+    async def _prefill_rounds(self, pending: PendingPrefill) -> bool:
+        """Chunk rounds for a (possibly resumed) joint prefill: one
+        [Bp, C] dispatch per round with per-row start/len (forward_paged
+        supports ragged rows natively). A round is the atomic budget
+        unit: the tick-grant check happens BEFORE each round — so one
+        round may overdraw, settled as debt by the budgeter — and a pause
+        always lands on a chunk boundary. Returns True when every row has
+        sampled its first token, False on a budget pause."""
+        e = self.e
+        args = e.args
+        rows = pending.rows
+        prompts = pending.prompts
+        pos = pending.pos
+        first = pending.first
+        want_top = pending.want_top
+        tables = pending.tables
+        temp, topk, topp = pending.temp, pending.topk, pending.topp
+        adapter, salts, procs = pending.adapter, pending.salts, pending.procs
+        mm_embeds, mm_slot_of = pending.mm_embeds, pending.mm_slot_of
+        Bp = pending.Bp
 
         while any(pos[r] < len(prompts[r]) for r in range(rows)):
+            if e._tick_budget_left is not None and e._tick_budget_left <= 0:
+                return False
             chunks = [
                 prompts[r][pos[r] : pos[r] + args.prefill_chunk] for r in range(rows)
             ]
@@ -405,6 +499,8 @@ class Admitter:
             # Per-token prefill cost EWMA — the basis for the plane's
             # prefill-seconds-saved estimate.
             kv_reuse_plane().note_prefill_cost(dt, int(lens.sum()))
+            if e._tick_budget_left is not None:
+                e._tick_budget_left -= int(lens.sum())
             for r in range(rows):
                 n = int(lens[r])
                 if n == 0:
@@ -420,7 +516,7 @@ class Admitter:
                         ]
                     first[r] = (int(toks[r]), float(logps[r]), top)
         assert all(f is not None for f in first)
-        return first  # type: ignore[return-value]
+        return True
 
     def _install(
         self, seq: Any, prep: "Any", slot: int, first_token: int,

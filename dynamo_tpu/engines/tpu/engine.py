@@ -36,6 +36,11 @@ import numpy as np
 from dynamo_tpu.engines.mock.kv_manager import KvEvent
 from dynamo_tpu.engines.tpu.block_pool import BlockPool
 from dynamo_tpu.engines.tpu.runner import DeviceRunner, _next_pow2
+from dynamo_tpu.engines.tpu.tick_budget import (
+    BUDGET_STATE_OFF,
+    TickBudgetConfig,
+    TickBudgeter,
+)
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     FinishReason,
@@ -143,6 +148,26 @@ class JaxEngineArgs:
     # spec_mode caps the effective depth at 1 (prompt-lookup proposals
     # need reconciled host tokens at every burst boundary).
     pipeline_depth: int = 2
+    # SLA-driven intra-chip prefill/decode split (tick_budget.py): when
+    # enabled, the static admit_batches_per_tick cap is replaced by a
+    # closed-loop per-tick prefill TOKEN budget that shrinks when decode
+    # ITL burns the SLO error budget and grows back when it has headroom
+    # (docs/design_docs/disagg_serving.md, "intra-chip middle mode").
+    # Off by default: aggregated mode, today's behavior byte-for-byte.
+    tick_budget_enabled: bool = False
+    # Starvation floor / ceiling in prefill tokens per tick. None derives
+    # floor = prefill_chunk (one chunk round always lands, bounding TTFT)
+    # and ceiling = admit_batches_per_tick × prefill_chunk (the static
+    # cap's worst-case single-tick prefill spend).
+    tick_budget_floor_tokens: Optional[int] = None
+    tick_budget_ceiling_tokens: Optional[int] = None
+    # Policy knob: where between floor (0.0, strict ITL) and ceiling
+    # (1.0, max throughput) the budget starts.
+    tick_budget_policy: float = 0.5
+    # Decode-phase ITL SLO driving the budgeter's internal burn estimate;
+    # None = the budget only moves via an external burn source or the
+    # overload ladder's squeeze.
+    tick_budget_itl_slo_s: Optional[float] = None
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -320,6 +345,33 @@ class JaxEngine:
         self._admitting = 0
         self.handoffs_exported = 0
         self.handoffs_adopted = 0
+        # SLA-driven prefill/decode tick split (engines/tpu/tick_budget.py).
+        # _pending_prefill: a budget-paused joint prefill parked at a chunk
+        # boundary (blocks pinned, rows keep progress) — it resumes ahead
+        # of any new admission. _tick_budget_left: this tick's remaining
+        # prefill token grant (None = unbudgeted), decremented by the
+        # admitter's chunk rounds.
+        self._budgeter: Optional[TickBudgeter] = None
+        if args.tick_budget_enabled:
+            floor = args.tick_budget_floor_tokens
+            if floor is None:
+                floor = args.prefill_chunk
+            ceiling = args.tick_budget_ceiling_tokens
+            if ceiling is None:
+                ceiling = max(
+                    floor, args.admit_batches_per_tick * args.prefill_chunk
+                )
+            self._budgeter = TickBudgeter(
+                TickBudgetConfig(
+                    floor_tokens=int(floor),
+                    ceiling_tokens=int(ceiling),
+                    policy=args.tick_budget_policy,
+                    itl_slo_s=args.tick_budget_itl_slo_s,
+                ),
+                on_event=self._record_budget_event,
+            )
+        self._pending_prefill: Optional[Any] = None
+        self._tick_budget_left: Optional[int] = None
 
         S = args.max_num_seqs
         self._slots: List[Optional[_Sequence]] = [None] * S
@@ -583,6 +635,25 @@ class JaxEngine:
             "queue_depth": len(self._waiting),
             "kv_high_watermark": self.args.admit_kv_high_watermark,
             "deadline_sheds": self.deadline_sheds,
+            # Tick-budget plane (engines/tpu/tick_budget.py): the
+            # EFFECTIVE per-tick prefill budget and the chunk size ride
+            # stats() into LoadSnapshot and the engine gauge family, so a
+            # silent budget collapse shows as its own signal instead of
+            # masquerading as an unexplained TTFT regression. Budgeter
+            # off (aggregated mode) reports 0 / state OFF.
+            "prefill_budget_tokens": (
+                self._budgeter.budget_tokens
+                if self._budgeter is not None else 0
+            ),
+            "budget_state": (
+                self._budgeter.state
+                if self._budgeter is not None else BUDGET_STATE_OFF
+            ),
+            "prefill_chunk_tokens": self.args.prefill_chunk,
+            "budget_rollovers": (
+                self._budgeter.rollovers
+                if self._budgeter is not None else 0
+            ),
             # Megakernel coverage: decode bursts on the fused path vs the
             # XLA fallback (per-variant split nested — flattens into
             # per-variant gauges on the metrics surface), plus per-key
@@ -887,20 +958,28 @@ class JaxEngine:
                 # under saturation (queue deep, every slot busy) the
                 # admission attempt is doomed and the pipeline keeps
                 # flowing instead of degrading to depth 1.
-                if (
-                    self._waiting
-                    and self._inflight
-                    and any(s is None for s in self._slots)
+                if self._inflight and (
+                    self._pending_prefill is not None
+                    or (
+                        self._waiting
+                        and any(s is None for s in self._slots)
+                    )
                 ):
                     await self._drain_inflight()
                 admitted = False
-                # Admit in batched prefill dispatches; a per-tick batch cap
-                # bounds how long running decodes stall behind prefill
-                # (chunked-prefill fairness, like the reference schedulers).
-                for _ in range(self.args.admit_batches_per_tick):
-                    if await self._admit_batch() == 0:
-                        break
-                    admitted = True
+                if self._budgeter is not None:
+                    # Budgeted admission (tick_budget.py): the closed-loop
+                    # prefill token grant replaces the static batch cap.
+                    admitted = await self._admit_tick_budgeted()
+                else:
+                    # Admit in batched prefill dispatches; a per-tick batch
+                    # cap bounds how long running decodes stall behind
+                    # prefill (chunked-prefill fairness, like the
+                    # reference schedulers).
+                    for _ in range(self.args.admit_batches_per_tick):
+                        if await self._admit_batch() == 0:
+                            break
+                        admitted = True
                 if admitted:
                     # Prefill just ran on the device: the wait before the
                     # next decode dispatch is device-busy time, not
@@ -920,6 +999,10 @@ class JaxEngine:
                 elif not admitted:
                     # Idle: request inter-arrival time is not host gap.
                     self._t_last_ready = None
+                    if self._budgeter is not None:
+                        # The next reap's inter-reap gap would span the
+                        # idle period — don't let it testify as ITL.
+                        self._budgeter.note_idle()
                     self._publish_stats()
                     self._wake.clear()
                     try:
@@ -973,6 +1056,10 @@ class JaxEngine:
             FinishReason.ERROR if self._failure is not None else FinishReason.CANCELLED
         )
         err = f"engine failed: {self._failure}" if self._failure else None
+        # A budget-parked prefill never installed: release its pinned
+        # blocks and route its rows through the same shutdown path as the
+        # waiting queue below.
+        self._unpark_pending()
         for seq in self._slots:
             if seq is not None:
                 if err:
@@ -1035,7 +1122,104 @@ class JaxEngine:
             # requeue errors; admitting one more prefill would just create
             # another live stream to hand off.
             return 0
+        if self._pending_prefill is not None:
+            # A budget-paused batch holds the admission pipeline: it must
+            # resume (in FIFO order, at its chunk boundary) before
+            # anything new dequeues.
+            return 0
         return await self._admitter._admit_batch()
+
+    async def _admit_tick_budgeted(self) -> bool:
+        """Budgeted admission phase (engines/tpu/tick_budget.py): resume
+        a parked prefill first, then admit new batches until this tick's
+        prefill token grant is spent. Replaces the static
+        admit_batches_per_tick cap; a tick with no decode work to protect
+        gets an unbounded grant. Returns True when prefill ran."""
+        budgeter = self._budgeter
+        decode_active = (
+            any(s is not None for s in self._slots) or bool(self._inflight)
+        )
+        self._tick_budget_left = budgeter.tick_grant(decode_active)
+        admitted = False
+        try:
+            if self._pending_prefill is not None:
+                if self._draining:
+                    # The drain plane owns the queue now: the parked batch
+                    # returns whole (typed-requeue rung, nothing half-
+                    # installed).
+                    self._unpark_pending()
+                    return False
+                await self._continue_pending()
+                admitted = True  # the resume ran chunk rounds on-device
+                if self._pending_prefill is not None:
+                    return True  # grant spent; still parked
+            while (
+                self._tick_budget_left is None or self._tick_budget_left > 0
+            ):
+                n = await self._admit_batch()
+                if n:
+                    admitted = True
+                if self._pending_prefill is not None or n == 0:
+                    break
+        finally:
+            left = self._tick_budget_left
+            self._tick_budget_left = None
+            if left is not None:
+                if left < 0:
+                    # The last chunk round overdrew the grant (rounds are
+                    # atomic): pay it back from the next tick's budget.
+                    budgeter.add_debt(-left)
+                elif (
+                    left > 0
+                    and decode_active
+                    and self._waiting
+                    and self._pending_prefill is None
+                    and not self._draining
+                ):
+                    # Admission held with budget unspent (KV watermark,
+                    # pool dry, slots full): the grant rolls into decode —
+                    # the tick proceeds at full cadence instead of idling
+                    # (the PR 8 + budgeter double-stall hazard).
+                    budgeter.note_rollover(left)
+        return admitted
+
+    async def _continue_pending(self) -> int:
+        """Resume the parked prefill's chunk rounds under the current
+        grant; Admitter._run_prefill re-parks, installs, or containment-
+        ejects. Returns rows installed."""
+        pending = self._pending_prefill
+        self._pending_prefill = None
+        return await self._admitter._run_prefill(pending)
+
+    def _unpark_pending(self) -> None:
+        """Return a parked prefill batch to the waiting queue whole:
+        release its pinned blocks, requeue rows in arrival order. Used by
+        drain begin and engine shutdown — already-prefilled chunks are
+        recomputed on re-admission (the same recompute contract as
+        preemption, so streams stay bit-identical)."""
+        pending = self._pending_prefill
+        if pending is None:
+            return
+        self._pending_prefill = None
+        for seq, prep in reversed(pending.batch):
+            self.pool.release(prep.ids, prep.hashes[: prep.matched])
+            self._requeue(seq)
+        self.flight.record("prefill_unpark", rows=len(pending.batch))
+
+    def _record_budget_event(self, kind: str, **fields) -> None:
+        """Flight-ring seam for the tick budgeter and the admission pause
+        path: the engine stays the ring's single writer (DYN005)."""
+        self.flight.record(kind, **fields)
+
+    def set_budget_pressure(self, on: bool) -> None:
+        """Overload-ladder first rung (runtime/overload.py): squeeze the
+        per-tick prefill budget to the starvation floor / release it.
+        Cheaper than clamping max_tokens or shedding, so the ladder fires
+        it first and releases it last. No-op without a budgeter."""
+        if self._budgeter is None:
+            return
+        self._budgeter.set_pressure(bool(on))
+        self._wake.set()
 
     async def _finish_admission(self, batch) -> int:
         return await self._admitter._finish_admission(batch)
@@ -1124,6 +1308,15 @@ class JaxEngine:
         # (no new admissions), then release device memory.
         if any(s is not None for s in self._slots):
             await self._decode_tick()
+            return True
+        if self._pending_prefill is not None:
+            # A budget-parked prefill must resolve before sleeping —
+            # pool.clear() below would free its pinned blocks in place.
+            # Finish it unbudgeted (_tick_budget_left is None between
+            # ticks); its sequences then drain via the decode branch
+            # above on subsequent passes.
+            await self._drain_inflight()
+            await self._continue_pending()
             return True
         level = self._sleep_requested
         if level is None:  # wake() cancelled the request mid-drain
@@ -1382,6 +1575,14 @@ class JaxEngine:
             time.monotonic() - rec.t_dispatch, rec.occupancy,
             self.generated_tokens - gen0,
         )
+        if self._budgeter is not None:
+            # ITL signal for the tick budgeter: same burst accounting the
+            # step metrics use, with the reap's ready stamp as "now" so
+            # the inter-reap gap is measured between readbacks.
+            self._budgeter.observe_decode(
+                self._t_last_ready - rec.t_dispatch, rec.occupancy,
+                self.generated_tokens - gen0, now=self._t_last_ready,
+            )
         self.flight.record(
             "reap", occupancy=rec.occupancy,
             tokens=self.generated_tokens - gen0,
@@ -1761,6 +1962,10 @@ class JaxEngine:
         ``draining`` so the router deflects placement immediately."""
         if not self._draining:
             self._draining = True
+            # A budget-parked prefill returns to the queue whole, so the
+            # controller's shed pass sees it immediately (it runs on this
+            # same loop thread; the park state only exists between ticks).
+            self._unpark_pending()
             self.flight.record("drain_begin")
             self._publish_stats()
             self._wake.set()
@@ -1921,6 +2126,10 @@ class JaxEngine:
             raise HandoffRefused(f"peer engine failed: {self._failure}")
         live = sum(1 for s in self._slots if s is not None)
         earmarked = len(self._adoptions) + self._admitting
+        if self._pending_prefill is not None:
+            # A budget-parked prefill batch holds slots-to-be exactly
+            # like an in-flight admission does.
+            earmarked += len(self._pending_prefill.batch)
         if live + earmarked >= self.args.max_num_seqs:
             raise HandoffRefused(
                 f"no free slot ({live} live + {len(self._adoptions)} "

@@ -1,0 +1,409 @@
+"""SLA-driven intra-chip prefill/decode disaggregation: the tick budgeter.
+
+Full disaggregation buys ITL isolation at the price of a KV transfer tax
+— and the bench record shows naive one-chip timeshared disagg is a
+measured 6× regression. Nexus (PAPERS.md) demonstrates that most of
+disagg's interference isolation is recoverable *inside* one accelerator
+by proactively partitioning prefill and decode work; FlowKV shows
+load-aware phase scheduling is what keeps that split honest under
+shifting traffic. This module is that middle mode: instead of the static
+``admit_batches_per_tick`` cap, each scheduler tick gets a closed-loop
+**prefill token budget** that shrinks when decode-phase latency burns the
+SLO error budget and grows back when ITL has headroom.
+
+The control law is AIMD with hysteresis, evaluated on the engine's
+injectable clock (the fake-clock state-machine tests drive it):
+
+  * **Signal.** ``observe_decode`` turns the reap cadence into per-token
+    inter-token-latency samples (inter-reap gap ÷ tokens emitted per
+    sequence). The burn rate over a sliding window is the SRE-workbook
+    shape: ``breach_fraction ÷ (1 − slo_target)`` against ``itl_slo_s``.
+    An external ``burn_source`` (the PR 13 SLO plane's decode-phase
+    ``slo_burn_rate``) overrides the internal estimate when wired.
+  * **Shrink.** ``burn ≥ burn_shrink`` for ``shrink_after`` spaced
+    evaluations → multiplicative decrease (× ``shrink_factor``), floored
+    at ``floor_tokens`` — the starvation floor that keeps TTFT bounded no
+    matter how hot decode runs.
+  * **Grow.** ``burn ≤ burn_grow`` for ``grow_after`` spaced evaluations
+    → additive increase (+ ``grow_tokens``), capped at
+    ``ceiling_tokens``. The dead band between the two thresholds is the
+    hysteresis: oscillating load parks the budget instead of flapping it.
+  * **Brownout rung.** ``set_pressure(True)`` (wired from the PR 8
+    overload ladder) slams the effective budget to the floor BEFORE the
+    controller ever clamps ``max_tokens`` or sheds — shrinking prefill is
+    the cheapest lever on the ladder, so it fires first and releases
+    last.
+
+Per tick, ``tick_grant`` hands the scheduler the number of prefill chunk
+tokens it may spend this tick. A tick with no decode work gets an
+unbounded grant — the budget exists to protect decode ITL, and with
+nothing to protect, throttling prefill would only burn TTFT (and an
+idle-tick token budget would busy-spin the loop). Overdraft within one
+chunk round is settled as debt against the next tick, so the chunk
+boundary stays the clean resume point the determinism suite pins.
+
+Every adjustment passes the ``engine.budget.apply`` fault seam
+(runtime/fault_names.py, DYN006): an injected fault skips that
+adjustment — counted, evented, budget untouched — and can never corrupt
+the budget or take the tick loop down. Events reach the engine's flight
+ring through the ``on_event`` callback (an engine-bound method, so the
+DYN005 single-writer discipline holds); this module never owns a ring.
+
+``observe_decode`` is on the DYN002 decode hot path (called from
+``_reap_burst``): deque appends and arithmetic only — no logging, no
+locks, no device access. ``TickBudgeter.evaluate`` is a blessed DYN002
+boundary (analysis/config.py) so the control law can log its decisions
+without dragging the whole module into the ban list.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from dynamo_tpu.runtime import fault_names
+from dynamo_tpu.runtime.faults import fault_point
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Budget states, ordered by how hard prefill is being squeezed. Gauge
+# values ARE the wire form (dashboards alert on state >= 3).
+BUDGET_STATE_OFF = 0  # budgeter disabled: aggregated mode, no bound
+BUDGET_STATE_THROUGHPUT = 1  # at the ceiling: ITL has headroom
+BUDGET_STATE_ADAPTIVE = 2  # mid-band: the control law is working
+BUDGET_STATE_FLOOR = 3  # starvation floor / brownout squeeze
+
+BUDGET_STATE_NAMES = {
+    BUDGET_STATE_OFF: "off",
+    BUDGET_STATE_THROUGHPUT: "throughput",
+    BUDGET_STATE_ADAPTIVE: "adaptive",
+    BUDGET_STATE_FLOOR: "floor",
+}
+
+
+@dataclass(frozen=True)
+class TickBudgetConfig:
+    """Budgeter knobs (docs/design_docs/disagg_serving.md has the full
+    table). The policy knob is ``policy``: 0.0 parks the initial budget
+    at the starvation floor (strict ITL), 1.0 at the ceiling (max
+    throughput); the control law moves it from there."""
+
+    # Starvation floor: the prefill tokens a tick may ALWAYS spend, no
+    # matter how hot decode burns — bounds TTFT under sustained squeeze.
+    floor_tokens: int = 512
+    # Ceiling: past this, more prefill per tick no longer hides behind
+    # the decode readback the PR 3 pipeline overlaps.
+    ceiling_tokens: int = 8192
+    # Where between floor and ceiling the budget starts (and what the
+    # gauge reports until the first adjustment).
+    policy: float = 0.5
+    # Decode-phase ITL SLO the internal burn estimate breaches against;
+    # None = the budgeter only moves on an external burn_source.
+    itl_slo_s: Optional[float] = None
+    # SLO target for the burn denominator: burn = breach_fraction /
+    # (1 - slo_target). 0.9 → 10% error budget.
+    slo_target: float = 0.9
+    # Burn thresholds. >= burn_shrink shrinks, <= burn_grow grows; the
+    # band between them is the hysteresis dead zone (no flapping on
+    # oscillating load).
+    burn_shrink: float = 1.0
+    burn_grow: float = 0.5
+    # AIMD: multiplicative decrease, additive increase.
+    shrink_factor: float = 0.5
+    grow_tokens: int = 512
+    # Evaluations closer together than this don't advance the streaks —
+    # a hysteresis step denominates TIME, not tick rate (same contract
+    # as OverloadConfig.min_eval_interval_s).
+    eval_interval_s: float = 0.25
+    # Spaced evaluations over threshold before acting. shrink_after=1 →
+    # a burn spike shrinks the budget within ONE evaluation window;
+    # growth is deliberately slower.
+    shrink_after: int = 1
+    grow_after: int = 4
+    # Sliding ITL sample window for the internal burn estimate, how many
+    # samples it needs before it is trusted, and the staleness horizon
+    # (an idle engine must decay to "unknown", not testify forever).
+    itl_window: int = 64
+    min_itl_samples: int = 4
+    itl_sample_ttl_s: float = 60.0
+
+
+class TickBudgeter:
+    """Closed-loop per-tick prefill token budget.
+
+    Threading contract: every method runs on the engine's event loop
+    (the same single-writer discipline as the engine flight ring).
+    ``clock`` is injectable so the state-machine tests drive hysteresis
+    with a fake clock. ``burn_source`` () -> Optional[float] overrides
+    the internal burn estimate when it returns a number. ``on_event``
+    (kind, **fields) is the engine's flight-ring append seam.
+    """
+
+    def __init__(
+        self,
+        config: Optional[TickBudgetConfig] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        burn_source: Optional[Callable[[], Optional[float]]] = None,
+        on_event: Optional[Callable[..., None]] = None,
+    ) -> None:
+        self.config = config or TickBudgetConfig()
+        cfg = self.config
+        if cfg.floor_tokens > cfg.ceiling_tokens:
+            raise ValueError(
+                f"floor_tokens {cfg.floor_tokens} > ceiling_tokens "
+                f"{cfg.ceiling_tokens}"
+            )
+        self._clock = clock
+        self._burn_source = burn_source
+        self._on_event = on_event
+        span = cfg.ceiling_tokens - cfg.floor_tokens
+        policy = min(1.0, max(0.0, cfg.policy))
+        self._budget = cfg.floor_tokens + int(policy * span)
+        self._pressure = False  # brownout squeeze active
+        self._debt = 0  # overdraft carried into the next tick
+        self._shrink_streak = 0
+        self._grow_streak = 0
+        self._last_eval_at: Optional[float] = None
+        # (observed-at, itl_s) pairs; maxlen bounds memory, the TTL
+        # prune in _burn bounds staleness.
+        self._itl_samples: "collections.deque" = collections.deque(
+            maxlen=cfg.itl_window
+        )
+        self._last_ready_at: Optional[float] = None
+        # Lifetime counters (stats()/bench surfaces).
+        self.shrinks = 0
+        self.grows = 0
+        self.skipped_applies = 0
+        self.rollovers = 0
+        self.rolled_tokens = 0
+        self.squeezes = 0
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def budget_tokens(self) -> int:
+        """The EFFECTIVE per-tick budget: the brownout squeeze pins it
+        at the floor regardless of what the control law last chose."""
+        if self._pressure:
+            return self.config.floor_tokens
+        return self._budget
+
+    @property
+    def pressure(self) -> bool:
+        return self._pressure
+
+    @property
+    def state(self) -> int:
+        cfg = self.config
+        eff = self.budget_tokens
+        if eff <= cfg.floor_tokens:
+            return BUDGET_STATE_FLOOR
+        if eff >= cfg.ceiling_tokens:
+            return BUDGET_STATE_THROUGHPUT
+        return BUDGET_STATE_ADAPTIVE
+
+    def snapshot(self) -> dict:
+        return {
+            "budget_tokens": self.budget_tokens,
+            "state": BUDGET_STATE_NAMES[self.state],
+            "pressure": self._pressure,
+            "debt": self._debt,
+            "shrinks": self.shrinks,
+            "grows": self.grows,
+            "skipped_applies": self.skipped_applies,
+            "rollovers": self.rollovers,
+            "rolled_tokens": self.rolled_tokens,
+            "squeezes": self.squeezes,
+            "burn": self._burn(),
+        }
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            self._on_event(kind, **fields)
+
+    # -- signal (DYN002 hot path: arithmetic + deque only) -------------------
+
+    def observe_decode(
+        self,
+        dur_s: float,
+        occupancy: int,
+        tokens: int,
+        *,
+        now: Optional[float] = None,
+    ) -> None:
+        """One reaped decode burst → per-token ITL samples. Preferred
+        signal is the inter-reap gap (what a stream actually waits
+        between tokens, prefill stalls included); the burst's own
+        duration is the fallback when the reap cadence has a hole."""
+        if tokens <= 0:
+            return
+        t = self._clock() if now is None else now
+        per_seq = tokens / max(occupancy, 1)
+        if self._last_ready_at is not None and t > self._last_ready_at:
+            itl = (t - self._last_ready_at) / max(per_seq, 1.0)
+        else:
+            itl = dur_s / max(per_seq, 1.0)
+        self._last_ready_at = t
+        self._itl_samples.append((t, itl))
+
+    def note_idle(self) -> None:
+        """The engine went idle: the next reap's inter-reap gap would
+        span the idle period — reset the cadence clock instead."""
+        self._last_ready_at = None
+
+    def _burn(self) -> Optional[float]:
+        """Error-budget burn rate: external source wins; else breach
+        fraction over the sample window ÷ (1 − slo_target)."""
+        if self._burn_source is not None:
+            try:
+                ext = self._burn_source()
+            except Exception:
+                logger.exception("tick budget burn source failed")
+                ext = None
+            if ext is not None:
+                return float(ext)
+        cfg = self.config
+        if cfg.itl_slo_s is None:
+            return None
+        horizon = self._clock() - cfg.itl_sample_ttl_s
+        while self._itl_samples and self._itl_samples[0][0] < horizon:
+            self._itl_samples.popleft()
+        if len(self._itl_samples) < cfg.min_itl_samples:
+            return None
+        breaches = sum(
+            1 for _, v in self._itl_samples if v > cfg.itl_slo_s
+        )
+        frac = breaches / len(self._itl_samples)
+        return frac / max(1.0 - cfg.slo_target, 1e-6)
+
+    # -- control law ---------------------------------------------------------
+
+    def evaluate(self) -> int:
+        """Run one control-law evaluation; returns the effective budget.
+        Calls closer together than eval_interval_s are no-ops (streaks
+        untouched) — hysteresis denominates time, not tick rate."""
+        cfg = self.config
+        now = self._clock()
+        if (
+            self._last_eval_at is not None
+            and now - self._last_eval_at < cfg.eval_interval_s
+        ):
+            return self.budget_tokens
+        self._last_eval_at = now
+        burn = self._burn()
+        if burn is None:
+            # No evidence either way: park the streaks (a cold window
+            # must neither shrink nor grow the budget).
+            self._shrink_streak = 0
+            self._grow_streak = 0
+            return self.budget_tokens
+        if burn >= cfg.burn_shrink:
+            self._shrink_streak += 1
+            self._grow_streak = 0
+            if self._shrink_streak >= cfg.shrink_after:
+                self._shrink_streak = 0
+                self._apply(
+                    max(
+                        cfg.floor_tokens,
+                        int(self._budget * cfg.shrink_factor),
+                    ),
+                    "shrink",
+                    burn,
+                )
+        elif burn <= cfg.burn_grow:
+            self._grow_streak += 1
+            self._shrink_streak = 0
+            if self._grow_streak >= cfg.grow_after:
+                self._grow_streak = 0
+                self._apply(
+                    min(
+                        cfg.ceiling_tokens,
+                        self._budget + cfg.grow_tokens,
+                    ),
+                    "grow",
+                    burn,
+                )
+        else:
+            # Dead band: hold. Streaks reset so oscillation around the
+            # band cannot accumulate into a flap.
+            self._shrink_streak = 0
+            self._grow_streak = 0
+        return self.budget_tokens
+
+    def _apply(self, new_budget: int, kind: str, burn: float) -> None:
+        if new_budget == self._budget:
+            return
+        try:
+            # Chaos seam (DYN006): an injected fault models the control
+            # law dying — skip THIS adjustment, never corrupt the budget.
+            fault_point(fault_names.ENGINE_BUDGET_APPLY, kind=kind)
+        except Exception:
+            self.skipped_applies += 1
+            self._emit(
+                "budget_skip", op=kind, frm=self._budget, to=new_budget
+            )
+            return
+        old, self._budget = self._budget, new_budget
+        if kind == "shrink":
+            self.shrinks += 1
+        else:
+            self.grows += 1
+        self._emit(
+            f"budget_{kind}", frm=old, to=new_budget, burn=round(burn, 3)
+        )
+        logger.debug(
+            "tick budget %s %d -> %d (burn %.2f)", kind, old, new_budget, burn
+        )
+
+    # -- brownout rung -------------------------------------------------------
+
+    def set_pressure(self, on: bool) -> None:
+        """Overload-ladder lever: squeeze the effective budget to the
+        starvation floor (before the ladder clamps max_tokens or sheds)
+        / release it. Idempotent; a release re-enters the control law
+        from the floor, not from the pre-squeeze budget — growth has to
+        be re-earned with clean evaluations."""
+        if on == self._pressure:
+            return
+        self._pressure = on
+        self._shrink_streak = 0
+        self._grow_streak = 0
+        if on:
+            self.squeezes += 1
+            self._budget = self.config.floor_tokens
+            self._emit("budget_squeeze", to=self.config.floor_tokens)
+        else:
+            self._emit("budget_release", frm=self.config.floor_tokens)
+
+    # -- per-tick grant ------------------------------------------------------
+
+    def tick_grant(self, decode_active: bool) -> Optional[int]:
+        """Prefill chunk tokens this tick may spend. None = unbounded
+        (no decode work to protect — throttling would only burn TTFT and
+        busy-spin the idle loop). Overdraft from the previous tick is
+        settled here before anything is granted."""
+        self.evaluate()
+        if not decode_active:
+            return None
+        budget = self.budget_tokens
+        grant = max(0, budget - self._debt)
+        self._debt = max(0, self._debt - budget)
+        return grant
+
+    def add_debt(self, tokens: int) -> None:
+        """Overdraft: the last chunk round of a tick may overshoot the
+        grant (the round is atomic); the excess is paid off next tick."""
+        if tokens > 0:
+            self._debt += tokens
+
+    def note_rollover(self, unspent: int) -> None:
+        """A watermark hold left budget unspent and the tick went to
+        decode instead of idling — counted so the double-stall
+        regression stays visible."""
+        if unspent > 0:
+            self.rollovers += 1
+            self.rolled_tokens += unspent

@@ -17,6 +17,7 @@ from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from dynamo_tpu.planner.planner_core import MetricsSnapshot
 from dynamo_tpu.runtime.metric_names import (
+    ENGINE_BUDGET_STATE,
     FRONTEND_INPUT_TOKENS_TOTAL,
     FRONTEND_ITL,
     FRONTEND_OUTPUT_TOKENS_TOTAL,
@@ -124,6 +125,25 @@ def _histogram_quantile(deltas: List[Tuple[float, float]], q: float) -> Optional
     return lo_bound
 
 
+# Budget-state gauge value → prefill-budget headroom. OFF (0) is absent:
+# an unbudgeted worker contributes no signal (a mixed fleet's mean speaks
+# only for the budgeted workers the planner could rebalance).
+_BUDGET_HEADROOM = {1: 1.0, 2: 0.5, 3: 0.0}
+
+
+def _budget_headroom(sample: Sample) -> Optional[float]:
+    """Mean tick-budgeter headroom across scraped workers (None when no
+    worker advertises a running budgeter) — compute_plan's rebalance-
+    before-launch signal. Gauges, not counters: the CURRENT scrape is the
+    state; no delta against the baseline."""
+    vals = [
+        _BUDGET_HEADROOM[int(v)]
+        for (name, _labels), v in sample.items()
+        if name == ENGINE_BUDGET_STATE and int(v) in _BUDGET_HEADROOM
+    ]
+    return sum(vals) / len(vals) if vals else None
+
+
 @dataclass
 class _Scrape:
     ts: float
@@ -187,6 +207,7 @@ class FrontendScrapeSource:
             mean_osl=out_delta / req_delta if req_delta > 0 else 0.0,
             p50_ttft_s=ttft,
             p50_itl_s=itl,
+            prefill_budget_frac=_budget_headroom(cur),
         )
 
     async def __call__(self) -> MetricsSnapshot:

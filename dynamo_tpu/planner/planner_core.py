@@ -49,6 +49,15 @@ class PlannerConfig:
     # SLA ratios folded into the interpolator outputs so a mis-profiled
     # table heals instead of mis-sizing forever. decay=0 disables.
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
+    # Intra-chip rebalance before scale-out (tick budgeter): while the
+    # fleet's mean prefill-budget headroom (MetricsSnapshot
+    # .prefill_budget_frac, 1.0 = budgets at ceiling, 0.0 = all at the
+    # starvation floor) is at/above this, an ITL breach holds decode
+    # scale-OUT for the interval — the budgeters still have prefill to
+    # squeeze on-chip, which is cheaper than a launch. Below it the
+    # budgets are spent and the breach sizes the fleet as before.
+    # ≥ 1.0 disables the hold (pre-budgeter behavior).
+    budget_rebalance_fraction: float = 0.75
 
 
 @dataclass
@@ -60,6 +69,12 @@ class MetricsSnapshot:
     mean_osl: float = 0.0  # output tokens/request
     p50_ttft_s: Optional[float] = None
     p50_itl_s: Optional[float] = None
+    # Tick-budgeter headroom, mean over BUDGETED workers: 1.0 = budgets
+    # at ceiling (throughput mode), 0.5 = adapting, 0.0 = every budgeter
+    # at its starvation floor. None = no budgeted workers observed this
+    # interval (budgeter off / pre-budgeter fleet) — the rebalance hold
+    # never fires on None.
+    prefill_budget_frac: Optional[float] = None
 
 
 @dataclass
@@ -105,6 +120,9 @@ class Planner:
         # Freshest observed p50 ITL (set every observation, gated or
         # not): the scale-down SLA guard reads it in compute_plan.
         self._last_itl: Optional[float] = None
+        # Freshest budgeter headroom (None = no budgeted workers): the
+        # rebalance-before-launch hold reads it in compute_plan.
+        self._last_budget_frac: Optional[float] = None
 
     # -- sizing math (ref: _compute_replica_requirements) -------------------
 
@@ -175,6 +193,24 @@ class Planner:
         if itl_hold:
             decode_n = self.last_plan.decode
 
+        # Rebalance-before-launch (tick budgeter): an ITL breach with FAT
+        # prefill budgets fleet-wide is an intra-chip imbalance — the
+        # budgeters will squeeze prefill within an evaluation window,
+        # which is free and instant next to launching a worker. Hold the
+        # decode scale-OUT for this interval; if the budgets spend down
+        # to the floor (headroom < budget_rebalance_fraction) and ITL
+        # still breaches, the next interval scales out for real.
+        budget_hold = (
+            self._last_itl is not None
+            and self._last_itl > cfg.itl_target_s
+            and self._last_budget_frac is not None
+            and self._last_budget_frac >= cfg.budget_rebalance_fraction
+            and self.last_plan is not None
+            and decode_n > self.last_plan.decode
+        )
+        if budget_hold:
+            decode_n = self.last_plan.decode
+
         prefill_n = min(max(prefill_n, cfg.min_replicas), cfg.max_replicas)
         decode_n = min(max(decode_n, cfg.min_replicas), cfg.max_replicas)
         if not self.disagg:
@@ -199,6 +235,7 @@ class Planner:
                 f"rate={rate:.2f}req/s isl={isl:.0f} osl={osl:.0f} "
                 f"conc={concurrency:.1f}/{max_conc:.1f}per-worker"
                 + (" itl-breach-hold" if itl_hold else "")
+                + (" budget-rebalance" if budget_hold else "")
             ),
         )
 
@@ -264,6 +301,10 @@ class Planner:
         snap: MetricsSnapshot = await self.metrics_source()
         if snap.p50_itl_s is not None:
             self._last_itl = snap.p50_itl_s
+        # None means "no budgeted workers this interval" and must CLEAR
+        # the hold signal (a fleet whose budgeters turned off can't keep
+        # deferring launches on a stale headroom reading).
+        self._last_budget_frac = snap.prefill_budget_frac
         self.rate_pred.add_data_point(snap.request_rate)
         if snap.mean_isl:
             self.isl_pred.add_data_point(snap.mean_isl)

@@ -100,6 +100,15 @@ class LoadSnapshot:
     # plane) publisher; consumers fence only stamped reports, so mixed
     # fleets interoperate.
     incarnation: int = 0
+    # Tick-budgeter advertisement (engines/tpu/tick_budget.py): the
+    # worker's effective per-tick prefill token budget. 0 = unbudgeted
+    # (budgeter off or a pre-budgeter publisher) — the scheduler treats
+    # that as unconstrained, so mixed fleets interoperate.
+    prefill_budget_tokens: int = 0
+    # Budgeter state (BUDGET_STATE_*): 0 off, 1 throughput (at ceiling),
+    # 2 adaptive, 3 floor. FLOOR/ADAPTIVE mean the worker is ITL-
+    # constrained and prefill-heavy placements should deflect elsewhere.
+    budget_state: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

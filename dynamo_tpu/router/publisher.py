@@ -232,6 +232,11 @@ class LoadPublisher:
             # also force-publishes so routers see it within one RTT).
             draining=bool(s.get("draining", 0)),
             incarnation=self.incarnation,
+            # Tick-budgeter advertisement: effective per-tick prefill
+            # budget + controller state, straight from engine stats
+            # (0/0 when the budgeter is off — scheduler ignores it).
+            prefill_budget_tokens=int(s.get("prefill_budget_tokens", 0)),
+            budget_state=int(s.get("budget_state", 0)),
         )
 
     async def publish_once(self) -> None:

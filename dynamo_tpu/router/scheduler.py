@@ -59,6 +59,15 @@ class KvRouterConfig:
     # deeper queue, later start, regardless of current KV usage. 0
     # disables (pre-overload-plane behavior).
     queue_depth_weight: float = 4.0
+    # Budget deflection (tick budgeter): extra weight on the prefill-
+    # blocks term for workers whose budgeter advertises ITL pressure
+    # (LoadSnapshot.budget_state ADAPTIVE/FLOOR) — their per-tick prefill
+    # budget is squeezed, so new prefill queues behind the budget instead
+    # of starting. The term is NON-NEGATIVE by construction (the pruned
+    # path's static lower bound stays valid: actual logit ≥ bound). 0
+    # disables (pre-budgeter behavior; unbudgeted workers report state 0
+    # and are never charged).
+    budget_pressure_weight: float = 2.0
     # -- link-cost term (disagg decode placement) --------------------------
     # Multiplier on the transfer-cost block-equivalents; 0 disables the
     # term entirely (pure overlap+load cost, the pre-link behavior).
@@ -265,6 +274,23 @@ class WorkerState:
         new admission with a typed migratable error, so placing work
         there just costs the stream a bounce."""
         return bool(self.snapshot is not None and self.snapshot.draining)
+
+    def budget_pressure(self) -> float:
+        """How hard the worker's tick budgeter is squeezing prefill:
+        1.0 at the starvation floor (BUDGET_STATE_FLOOR=3), 0.5 while
+        adapting (ADAPTIVE=2), 0 otherwise (off/throughput — literals
+        mirror engines/tpu/tick_budget.py BUDGET_STATE_*; the router
+        stays engine-import-free). Scales the prefill term: an ITL-
+        constrained worker trickles prefill at its floor, so sending a
+        big prefill there means queueing behind the budget."""
+        if self.snapshot is None:
+            return 0.0
+        state = self.snapshot.budget_state
+        if state == 3:
+            return 1.0
+        if state == 2:
+            return 0.5
+        return 0.0
 
     def saturated(self) -> bool:
         """At/above the worker's advertised admission high watermark:
@@ -562,6 +588,15 @@ class KvScheduler:
                 # Accepted-but-unstarted work delays this placement the
                 # same way resident decode blocks do.
                 logit += cfg.queue_depth_weight * self._workers[w].queue_depth()
+            if cfg.budget_pressure_weight > 0 and prefill:
+                # Budget deflection: an ITL-constrained budgeter trickles
+                # prefill at its squeezed per-tick budget, so every
+                # overlap-miss block routed there waits for budget grants.
+                # Non-negative, so the pruned path's static lower bound
+                # (which omits it) stays a valid lower bound.
+                bp = self._workers[w].budget_pressure()
+                if bp > 0.0:
+                    logit += cfg.budget_pressure_weight * bp * prefill
             if transfer is not None and cfg.link_cost_weight > 0:
                 # Overlap-miss blocks must also CROSS the (src → w) link:
                 # estimated seconds × prefill-rate = block-equivalents.

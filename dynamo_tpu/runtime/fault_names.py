@@ -36,6 +36,12 @@ DISAGG_KV_IMPORT = "disagg.kv.import"
 ENGINE_TICK_DISPATCH = "engine.tick.dispatch"
 # Reap: before the oldest in-flight burst's readback.
 ENGINE_TICK_REAP = "engine.tick.reap"
+# Tick budgeter (engines/tpu/tick_budget.py): one hit per budget
+# ADJUSTMENT the AIMD controller is about to commit (shrink or grow), not
+# per evaluation — an injection models the control law dying and MUST skip
+# that adjustment cleanly (budget unchanged, streaks reset, skip counted),
+# never corrupt the budget or take the tick loop down with it.
+ENGINE_BUDGET_APPLY = "engine.budget.apply"
 
 # -- discovery / health (runtime/distributed.py, runtime/health.py) -----------
 DISCOVERY_LEASE_RENEW = "discovery.lease.renew"
@@ -120,6 +126,7 @@ ALL_FAULT_POINTS = (
     DISAGG_KV_IMPORT,
     ENGINE_TICK_DISPATCH,
     ENGINE_TICK_REAP,
+    ENGINE_BUDGET_APPLY,
     DISCOVERY_LEASE_RENEW,
     HEALTH_CANARY,
     KVBM_TIER_READ,

@@ -63,6 +63,17 @@ ENGINE_DRAINING = engine_gauge("draining")
 ENGINE_MK_FUSED_BURSTS = engine_gauge("mk_fused_bursts")
 ENGINE_MK_FALLBACK_BURSTS = engine_gauge("mk_fallback_bursts")
 ENGINE_MK_DEMOTED_VARIANTS = engine_gauge("mk_demoted_variants")
+# Tick budgeter (engines/tpu/tick_budget.py): the EFFECTIVE per-tick
+# prefill token budget (0 = budgeter off, unbounded admission), the
+# budgeter state (0 off, 1 throughput/ceiling, 2 adaptive, 3 floor /
+# brownout-squeezed), the compile-time chunk size the budget is consumed
+# in, and watermark-hold rollovers (budget returned to decode, not
+# idled). A silent budget collapse shows up HERE, not as a mystery TTFT
+# regression.
+ENGINE_PREFILL_BUDGET_TOKENS = engine_gauge("prefill_budget_tokens")
+ENGINE_BUDGET_STATE = engine_gauge("budget_state")
+ENGINE_PREFILL_CHUNK_TOKENS = engine_gauge("prefill_chunk_tokens")
+ENGINE_BUDGET_ROLLOVERS = engine_gauge("budget_rollovers")
 
 # -- engine step loop (engines/metrics.py EngineStepMetrics) -----------------
 ENGINE_STEP_DURATION = f"{ENGINE_PREFIX}_step_duration_seconds"
@@ -522,6 +533,10 @@ ALL_ENGINE = (
     ENGINE_MK_FUSED_BURSTS,
     ENGINE_MK_FALLBACK_BURSTS,
     ENGINE_MK_DEMOTED_VARIANTS,
+    ENGINE_PREFILL_BUDGET_TOKENS,
+    ENGINE_BUDGET_STATE,
+    ENGINE_PREFILL_CHUNK_TOKENS,
+    ENGINE_BUDGET_ROLLOVERS,
     ENGINE_STEP_DURATION,
     ENGINE_BATCH_OCCUPANCY,
     ENGINE_STEP_PREFILL_TOKENS,

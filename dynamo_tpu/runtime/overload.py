@@ -291,6 +291,16 @@ class OverloadController:
         self._seq = 0
         self._svc_ewma: Optional[float] = None  # observed service seconds
         self._transition_cbs: List[Callable[[int, int], None]] = []
+        # Budget-squeeze rung (engines/tpu/tick_budget.py): levers
+        # registered by worker wiring (JaxEngine.set_budget_pressure).
+        # With levers present, the FIRST filled breach streak squeezes the
+        # prefill budget instead of transitioning — brownout (and its
+        # max_tokens clamp) needs a fresh filled streak on top of the
+        # squeeze, so the cheapest lever always fires first. No levers =
+        # the pre-budgeter ladder, unchanged.
+        self._budget_levers: List[Callable[[bool], None]] = []
+        self._budget_squeezed = False
+        self.budget_squeezes = 0
         # Lifetime counters (bench + /debug snapshots; the metric
         # families are their scrapeable form).
         self.sheds: Dict[str, int] = {}
@@ -322,6 +332,8 @@ class OverloadController:
             "sheds": dict(self.sheds),
             "deadline_expired": self.sheds.get("deadline_expired", 0),
             "transitions": dict(self.transitions),
+            "budget_squeezed": self._budget_squeezed,
+            "budget_squeezes": self.budget_squeezes,
             "itl_p50_ms": (
                 round(1000 * p50, 3)
                 if (p50 := self._itl_p50()) is not None
@@ -344,6 +356,16 @@ class OverloadController:
         """``cb(old_state, new_state)`` on every transition — the seam
         worker wiring uses to suspend speculative decode on brownout."""
         self._transition_cbs.append(cb)
+
+    def on_budget_pressure(self, cb: Callable[[bool], None]) -> None:
+        """Register a budget-squeeze lever: ``cb(True)`` pins the
+        engine's per-tick prefill budget at its starvation floor,
+        ``cb(False)`` releases it back to the control law. Registering a
+        lever INSERTS the rung below brownout: the squeeze fires one
+        filled breach streak before any max_tokens clamp, and releases
+        one filled recovery streak after every state stepped down —
+        first lever pulled, last lever released."""
+        self._budget_levers.append(cb)
 
     def observe_itl(self, itl_s: float) -> None:
         """One inter-token latency observation (the frontend's
@@ -408,7 +430,15 @@ class OverloadController:
             self._breach_streak = 0
             self._critical_streak = 0
         if self._state == HEALTHY and self._breach_streak >= cfg.brownout_after:
-            self._transition(BROWNOUT, p50, occ)
+            if self._budget_levers and not self._budget_squeezed:
+                # First rung: shrink the prefill budget BEFORE clamping
+                # max_tokens or shedding. Brownout needs a FRESH filled
+                # streak on top of the squeeze — the flight ring's event
+                # order (budget_squeeze, then state healthy→brownout)
+                # proves the lever ordering.
+                self._squeeze_budget(True, p50, occ)
+            else:
+                self._transition(BROWNOUT, p50, occ)
             self._breach_streak = 0
             self._critical_streak = 0
         elif (
@@ -418,13 +448,43 @@ class OverloadController:
             self._transition(SHED, p50, occ)
             self._breach_streak = 0
             self._critical_streak = 0
-        elif self._ok_streak >= cfg.recover_after and self._state != HEALTHY:
+        elif self._ok_streak >= cfg.recover_after and (
+            self._state != HEALTHY or self._budget_squeezed
+        ):
             # Step DOWN one state per filled recovery streak: shed →
             # brownout → healthy needs two clean streaks, so recovery
             # re-arms gradually instead of slamming the floodgates open.
-            self._transition(self._state - 1, p50, occ)
+            # The budget squeeze outlives every state step-down — it was
+            # the first lever pulled, so it is the LAST one released.
+            if self._state != HEALTHY:
+                self._transition(self._state - 1, p50, occ)
+            else:
+                self._squeeze_budget(False, p50, occ)
             self._ok_streak = 0
         return self._state
+
+    def _squeeze_budget(
+        self, on: bool, p50: Optional[float], occ: Optional[float]
+    ) -> None:
+        self._budget_squeezed = on
+        if on:
+            self.budget_squeezes += 1
+        self.flight.record(
+            "budget_squeeze" if on else "budget_release",
+            itl_p50_ms=round(1000 * p50, 3) if p50 is not None else None,
+            occupancy=round(occ, 4) if occ is not None else None,
+        )
+        logger.warning(
+            "overload budget %s (p50 ITL %s, occupancy %s)",
+            "squeeze" if on else "release",
+            f"{1000 * p50:.1f}ms" if p50 is not None else "n/a",
+            f"{occ:.3f}" if occ is not None else "n/a",
+        )
+        for cb in self._budget_levers:
+            try:
+                cb(on)
+            except Exception:
+                logger.exception("overload budget lever failed")
 
     def _transition(self, new_state: int, p50: Optional[float], occ: Optional[float]) -> None:
         old, self._state = self._state, new_state

@@ -98,6 +98,25 @@ def build_parser() -> argparse.ArgumentParser:
                         help="decode bursts in flight on the device (2 = "
                         "double-buffered dispatch/reap, 1 = synchronous; "
                         "docs/design_docs/decode_pipelining.md)")
+    parser.add_argument("--tick-budget", action="store_true",
+                        help="intra-chip prefill/decode disaggregation: cap "
+                        "per-tick prefill chunk tokens with the closed-loop "
+                        "TickBudgeter (docs/design_docs/disagg_serving.md, "
+                        "intra-chip middle mode)")
+    parser.add_argument("--tick-budget-floor", type=int, default=None,
+                        help="starvation floor in prefill tokens per tick "
+                        "(default: one prefill chunk)")
+    parser.add_argument("--tick-budget-ceiling", type=int, default=None,
+                        help="budget ceiling in prefill tokens per tick "
+                        "(default: admit_batches_per_tick x prefill_chunk — "
+                        "the unbudgeted per-tick admission cap)")
+    parser.add_argument("--tick-budget-policy", type=float, default=0.5,
+                        help="0 = strict-ITL (start at the floor), 1 = "
+                        "max-throughput (start at the ceiling)")
+    parser.add_argument("--tick-budget-itl-slo-ms", type=float, default=None,
+                        help="per-token ITL SLO driving the budget's "
+                        "shrink/grow control law (off: budget only moves "
+                        "via the overload ladder's squeeze rung)")
     parser.add_argument("--lora-dir", default=None,
                         help="directory of PEFT LoRA adapters to serve "
                         "(ref: lib/llm/src/lora.rs)")
@@ -238,6 +257,15 @@ async def main() -> None:
         spec_ngram=args.spec_ngram,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,
+        tick_budget_enabled=args.tick_budget,
+        tick_budget_floor_tokens=args.tick_budget_floor,
+        tick_budget_ceiling_tokens=args.tick_budget_ceiling,
+        tick_budget_policy=args.tick_budget_policy,
+        tick_budget_itl_slo_s=(
+            args.tick_budget_itl_slo_ms / 1000.0
+            if args.tick_budget_itl_slo_ms
+            else None
+        ),
     )
 
     if topo.is_multihost:
@@ -484,6 +512,13 @@ async def main() -> None:
     overload.on_transition(
         lambda _old, new: engine.set_spec_suspended(new > 0)
     )
+    if getattr(engine, "_budgeter", None) is not None:
+        # Budget-squeeze rung: registering the lever makes the ladder
+        # shrink the per-tick prefill budget one filled breach streak
+        # BEFORE the max_tokens clamp, and release it last on recovery.
+        # Unregistered (budgeter off), the ladder behaves exactly as
+        # before.
+        overload.on_budget_pressure(engine.set_budget_pressure)
 
     async def overload_eval_loop() -> None:
         while True:

@@ -408,3 +408,162 @@ async def test_runner_abort_resync_regenerates_identical_tokens():
         assert stream_sig(out2) == base
     finally:
         await engine.stop()
+
+
+# -- tick budgeter (ISSUE 18): budgeted streams stay bit-identical ------------
+
+BUDGET_ARGS = dict(
+    tick_budget_enabled=True,
+    tick_budget_floor_tokens=16,
+    tick_budget_ceiling_tokens=64,
+    tick_budget_policy=0.0,
+)
+
+
+async def _run_budgeted_admission(depth, **over):
+    """Stream a decodes while long-prompt b (80 tokens = 3 chunk rounds)
+    is admitted mid-stream; a 16-token budget parks b's prefill at a
+    chunk boundary and resumes it across later ticks. Returns both
+    stream signatures plus how many times the prefill parked."""
+    engine = make_engine(depth, max_num_seqs=2, **over)
+    try:
+        a_outs = []
+
+        async def consume_a():
+            async for o in engine.generate(
+                req(
+                    range(10, 20), max_tokens=30, rid="a",
+                    sampling=SamplingOptions(temperature=0.8),
+                ),
+                Context(),
+            ):
+                a_outs.append(o)
+
+        async def submit_b_after_two():
+            while len([o for o in a_outs if o.token_ids]) < 2:
+                await asyncio.sleep(0.005)
+            return await collect(
+                engine.generate(
+                    req(
+                        range(100, 180), max_tokens=10, rid="b",
+                        sampling=SamplingOptions(temperature=0.9),
+                    ),
+                    Context(),
+                )
+            )
+
+        _, b_out = await asyncio.gather(consume_a(), submit_b_after_two())
+        parks = sum(
+            1 for e in engine.flight.snapshot()
+            if e["kind"] == "prefill_pause"
+        )
+        return (stream_sig(a_outs), stream_sig(b_out)), parks
+    finally:
+        await engine.stop()
+
+
+async def test_budgeter_on_vs_off_bitwise_identical_across_depths():
+    """The tentpole determinism contract: budgeter on vs off, at depth 1
+    vs 2, across a mid-stream admission whose prefill parks at a chunk
+    boundary — every stream bit-identical, and the budgeted runs REALLY
+    parked (the scenario exercises the resume path, not a no-op)."""
+    base, _ = await _run_budgeted_admission(1)
+    for depth in (1, 2):
+        sig_off, _ = await _run_budgeted_admission(depth)
+        assert sig_off == base
+        sig_on, parks = await _run_budgeted_admission(depth, **BUDGET_ARGS)
+        assert sig_on == base
+        assert parks > 0, "budget never parked the prefill; scenario dead"
+
+
+async def test_budgeted_preemption_bitwise_identical():
+    """Preemption-by-recompute under a tick budget: the preempted row's
+    re-prefill is budgeted too (parked/resumed like any admission), and
+    the recomputed stream stays bit-identical to the unbudgeted run."""
+
+    async def run(depth, **over):
+        engine = make_engine(
+            depth, max_num_seqs=2, num_kv_blocks=8, max_model_len=64, **over
+        )
+        try:
+            reqs = [
+                req(range(10, 18), max_tokens=14, rid="a"),
+                req(
+                    range(20, 28), max_tokens=18, rid="b",
+                    sampling=SamplingOptions(temperature=0.8),
+                ),
+            ]
+            outs = await asyncio.gather(
+                *(collect(engine.generate(r, Context())) for r in reqs)
+            )
+            return [stream_sig(o) for o in outs], engine.preemptions
+        finally:
+            await engine.stop()
+
+    base, pre0 = await run(1)
+    assert pre0 > 0, "scenario no longer triggers preemption"
+    for depth in (1, 2):
+        sigs, pre = await run(depth, **BUDGET_ARGS)
+        assert pre > 0
+        assert sigs == base
+
+
+async def test_budget_squeeze_mid_prefill_is_a_clean_resume():
+    """A brownout squeeze landing while a prefill is parked shrinks the
+    next tick's grant mid-prompt; the chunk boundary must be a clean
+    resume point — the stream is bit-identical to the unsqueezed and
+    unbudgeted runs."""
+
+    async def run(depth, squeeze):
+        engine = make_engine(
+            depth, max_num_seqs=2,
+            tick_budget_enabled=True,
+            tick_budget_floor_tokens=16,
+            tick_budget_ceiling_tokens=64,
+            tick_budget_policy=1.0,  # 2 rounds/tick: parks at round 3
+        )
+        try:
+            a_outs = []
+
+            async def consume_a():
+                async for o in engine.generate(
+                    req(
+                        range(10, 20), max_tokens=30, rid="a",
+                        sampling=SamplingOptions(temperature=0.8),
+                    ),
+                    Context(),
+                ):
+                    a_outs.append(o)
+
+            async def submit_b_after_two():
+                while len([o for o in a_outs if o.token_ids]) < 2:
+                    await asyncio.sleep(0.005)
+                return await collect(
+                    engine.generate(
+                        req(
+                            range(100, 180), max_tokens=10, rid="b",
+                            sampling=SamplingOptions(temperature=0.9),
+                        ),
+                        Context(),
+                    )
+                )
+
+            async def squeeze_when_parked():
+                if not squeeze:
+                    return
+                for _ in range(2000):
+                    if engine._pending_prefill is not None:
+                        engine.set_budget_pressure(True)
+                        return
+                    await asyncio.sleep(0.001)
+
+            _, b_out, _ = await asyncio.gather(
+                consume_a(), submit_b_after_two(), squeeze_when_parked()
+            )
+            return (stream_sig(a_outs), stream_sig(b_out))
+        finally:
+            await engine.stop()
+
+    base = await run(1, squeeze=False)
+    assert await run(1, squeeze=True) == base
+    assert await run(2, squeeze=True) == base
