@@ -201,9 +201,31 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
     prefill_cost_us = (_time.perf_counter() - t0) / M * 1e6
     kv_reuse_request_us = note_request_us + router_touch_us + prefill_cost_us
 
+    # Perf ledger (runtime/perf_ledger.py): every reap pays one
+    # observe_decode (dict get + deque appends) plus the time-gate check
+    # at the top of evaluate (the quantile/verdict work behind it runs at
+    # most once per eval_interval_s, off the per-burst path). Priced on a
+    # PRIVATE ledger with a fake clock so the probe never pollutes the
+    # process-global fingerprint state.
+    from dynamo_tpu.runtime.perf_ledger import PerfLedger, PerfLedgerConfig
+
+    _t = [0.0]
+    ledger = PerfLedger(
+        PerfLedgerConfig(fingerprint_path=""), clock=lambda: _t[0]
+    )
+    ledger.configure(preset="prof", backend="cpu", host="prof")
+    t0 = _time.perf_counter()
+    for i in range(M):
+        _t[0] += 0.001
+        ledger.observe_decode(
+            8, "w8", "fused", 0.001, 8, 4.0, 64.0, 0.0001, 0.0002, 0.0001
+        )
+        ledger.evaluate()
+    perf_ledger_us = (_time.perf_counter() - t0) / M * 1e6
+
     per_burst_us = (
         watch_us + 4 * record_us + stats_publish_us + trajectory_request_us
-        + kv_reuse_request_us
+        + kv_reuse_request_us + perf_ledger_us
     )
     return {
         "watched_dispatch_us": round(watch_us, 3),
@@ -215,6 +237,7 @@ def observe_overhead(wall_per_burst_ms: float, stats_publish_us: float) -> dict:
         "kv_router_touch_us": round(router_touch_us, 3),
         "kv_prefill_cost_us": round(prefill_cost_us, 3),
         "kv_reuse_request_us": round(kv_reuse_request_us, 3),
+        "perf_ledger_us": round(perf_ledger_us, 3),
         "per_burst_us": round(per_burst_us, 3),
         "overhead_pct_of_burst": round(
             100 * per_burst_us / 1000 / max(wall_per_burst_ms, 1e-9), 4

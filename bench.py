@@ -64,10 +64,11 @@ CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", 256))
 REQUESTS = int(os.environ.get("BENCH_REQUESTS", 512))
 VERBOSE = os.environ.get("BENCH_VERBOSE") == "1"
 
-# Public hardware specs the roofline anchor/metrics derive from.
+# Public hardware specs the roofline anchor/metrics derive from. The
+# v5e decode roofline itself lives in runtime/roofline.py — ONE formula
+# shared with the always-on perf ledger's achieved-fraction gauge — and
+# is imported below; only the A100 anchor model stays bench-local.
 A100_80G_BW = 2039e9  # B/s (SXM)
-V5E_BW = 819e9  # B/s HBM
-V5E_PEAK_BF16 = 197e12  # FLOP/s
 # Achieved-bandwidth fraction granted to the A100+vLLM anchor. Optimistic
 # for the anchor (generous to the baseline): well-tuned decode sustains
 # ~40-60% of peak HBM bandwidth end-to-end; we grant 60%.
@@ -85,44 +86,89 @@ A100_80G_USD_HR = 3.67
 V5E_USD_HR = 1.20
 
 
-def _param_count(cfg) -> int:
-    """Matmul-weight parameter count from the config (analytic)."""
-    d, L, hd = cfg.d_model, cfg.n_layers, cfg.head_dim_
-    H, KH, ff = cfg.n_heads, cfg.n_kv_heads, cfg.d_ff
-    per_layer = d * H * hd + 2 * d * KH * hd + H * hd * d  # wq wk wv wo
-    if cfg.is_moe:
-        eff = cfg.moe_d_ff_
-        per_layer += cfg.n_experts * 3 * d * eff + d * cfg.n_experts
-    else:
-        per_layer += 3 * d * ff
-    total = L * per_layer + cfg.vocab_size * d
-    if not cfg.tie_word_embeddings:
-        total += d * cfg.vocab_size
-    return total
+# Shared pure-arithmetic roofline model (runtime/roofline.py): param
+# counts, decode step bytes, and the v5e constants — the perf ledger
+# grades live windows against the same math these legs report.
+from dynamo_tpu.runtime.roofline import (  # noqa: E402
+    V5E_BW,
+    V5E_PEAK_BF16,
+    active_param_count as _active_param_count,
+    decode_step_bytes as _decode_step_bytes,
+    param_count as _param_count,
+)
 
 
-def _active_param_count(cfg) -> int:
-    """Params touched per token (MoE reads only top-k experts)."""
-    if not cfg.is_moe:
-        return _param_count(cfg)
-    d, L, hd = cfg.d_model, cfg.n_layers, cfg.head_dim_
-    H, KH, eff = cfg.n_heads, cfg.n_kv_heads, cfg.moe_d_ff_
-    per_layer = (
-        d * H * hd + 2 * d * KH * hd + H * hd * d
-        + cfg.n_experts_per_tok * 3 * d * eff + d * cfg.n_experts
-    )
-    total = L * per_layer + cfg.vocab_size * d
-    if not cfg.tie_word_embeddings:
-        total += d * cfg.vocab_size
-    return total
+def _record_stamp(preset: str | None, quant: str | None) -> dict:
+    """Provenance stamp for every emitted record (ISSUE 19): schema
+    version, backend/host/preset fingerprint, git rev — cross-round
+    comparison (`dynamo-tpu bench compare`) is only sound when both
+    records prove they measured the same thing."""
+    import socket
+    import subprocess
+
+    from dynamo_tpu.bench.compare import BENCH_SCHEMA_VERSION
+
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:
+        rev = None
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = None
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "git_rev": rev,
+        "fingerprint": {
+            "backend": backend,
+            "host": socket.gethostname(),
+            "preset": preset,
+            "quant": quant,
+        },
+    }
 
 
-def _decode_step_bytes(cfg, batch: int, avg_ctx: float, quant: str | None) -> float:
-    """HBM bytes one fused decode step must move: the full (active) weight
-    stream plus every sequence's KV history."""
-    wbytes = _active_param_count(cfg) * (1 if quant == "int8" else 2)
-    kv_per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim_ * 2
-    return wbytes + batch * avg_ctx * kv_per_tok
+def _sentinel_epilogue(out: dict) -> None:
+    """Run the regression sentinel against the newest usable previous
+    round's BENCH_*.json (when present): attach the typed report to the
+    record and print the human table to stderr (stdout stays ONE JSON
+    line). Never raises — a broken epilogue must not cost the round its
+    perf record."""
+    import glob
+    import sys as _sys
+
+    try:
+        from dynamo_tpu.bench.compare import (
+            compare_records,
+            format_report,
+            unwrap_record,
+        )
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        ref = ref_path = None
+        for p in sorted(glob.glob(os.path.join(here, "BENCH_*.json")),
+                        reverse=True):
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    doc = unwrap_record(json.load(f))
+            except (OSError, ValueError):
+                doc = None
+            if doc is not None:
+                ref, ref_path = doc, os.path.basename(p)
+                break
+        if ref is None:
+            return
+        report = compare_records(ref, out)
+        report["reference_path"] = ref_path
+        report["candidate_path"] = "(this run)"
+        out["sentinel"] = report
+        print(format_report(report), file=_sys.stderr)
+    except Exception as exc:
+        out["sentinel"] = {"error": f"{type(exc).__name__}: {exc}"}
 
 
 def _anchor_toks_per_sec(cfg, batch: int, avg_ctx: float, quant: str | None) -> float:
@@ -2500,6 +2546,7 @@ async def run_bench():
         "hbm_util": primary["hbm_util"],
         "n_chips": jax.device_count(),
         "backend": jax.default_backend(),
+        **_record_stamp(model_name, quant),
         **{
             k: primary[k]
             for k in ("spec_proposed", "spec_accepted")
@@ -2670,6 +2717,11 @@ async def run_bench():
             out["elasticity"] = await run_elasticity_leg()
         except Exception as exc:
             out["elasticity"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    # Sentinel epilogue (ISSUE 19): judge this round against the previous
+    # usable BENCH_*.json when one exists. Table to stderr, report into
+    # the record; stdout stays one JSON line and rc stays the round's.
+    _sentinel_epilogue(out)
     print(json.dumps(out))
 
 
@@ -2720,6 +2772,11 @@ def _init_backend_or_skip() -> bool:
                 else "backend init failed; set BENCH_ALLOW_CPU=1 "
                 "to run the CPU leg instead"
             ),
+            # Same provenance stamp as a real record so the driver's
+            # archive stays schema-uniform (compare still skips it via
+            # the "skipped" key).
+            **_record_stamp(os.environ.get("BENCH_MODEL", "qwen2.5-0.5b"),
+                            os.environ.get("BENCH_QUANT") or None),
         }
         if not ceiling and os.environ.get("BENCH_PROJECTION", "1") != "0":
             # The 70B tp8 projection's modeled path is pure arithmetic —

@@ -1,0 +1,280 @@
+"""`dynamo-tpu bench compare` — the offline half of the perf sentinel.
+
+Ingests two or more bench records (either a bench.py JSON line or the
+driver wrapper ``{n, cmd, rc, tail, parsed}`` the repo's BENCH_r*.json
+files use), plus optionally BASELINE.json for provenance, and emits
+per-leg typed verdicts with noise bands: the newest record (the
+candidate) is judged against the most recent usable record before it
+(the reference). Nonzero exit on regression, so CI and the bench
+driver's epilogue both get a machine-readable go/no-go instead of a
+human eyeballing two JSON blobs.
+
+Judged metrics are direction-typed (higher-is-better throughput and
+coverage vs lower-is-better latency percentiles) and matched by PATH in
+the nested record — ``secondary.p50_itl_ms`` only ever compares against
+``secondary.p50_itl_ms``. A leg present in one record but not the other
+is reported as ``no_baseline``/``leg_vanished``, never silently skipped:
+a leg that stopped producing numbers is itself a regression signal.
+
+Verdict taxonomy (shared with runtime/perf_ledger.py's live sentinel):
+``ok`` | ``regression`` | ``improved`` | ``no_baseline`` |
+``insufficient`` (non-numeric / missing values).
+
+Dependency-free by design (stdlib only, no jax): the comparison must run
+on boxes where the serving deps don't load — that is the point of a
+regression sentinel for a TPU repo developed off-TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+# Stamped into every bench.py record (and checked here): bump when the
+# meaning of a judged metric changes, so cross-round comparison never
+# silently mixes incompatible semantics.
+BENCH_SCHEMA_VERSION = 1
+
+DEFAULT_NOISE_BAND = 0.10
+
+# Judged metric leaf names -> direction ("up" = higher is better).
+# Matched at any depth; the full dotted path labels the verdict.
+METRIC_DIRECTIONS: Dict[str, str] = {
+    "value": "up",
+    "toks_per_sec_per_chip": "up",
+    "toks_per_sec": "up",
+    "p50_ttft_ms": "down",
+    "p99_ttft_ms": "down",
+    "p50_itl_ms": "down",
+    "p99_itl_ms": "down",
+    "fused_coverage": "up",
+    "hit_rate": "up",
+}
+
+
+def unwrap_record(doc: Any) -> Optional[Dict[str, Any]]:
+    """Accept either a raw bench.py record or the driver wrapper
+    ``{n, cmd, rc, tail, parsed}``; None when unusable (failed round,
+    skipped backend, or not a bench record at all)."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc and "cmd" in doc:
+        doc = doc.get("parsed")
+        if not isinstance(doc, dict):
+            return None
+    if "metric" not in doc:
+        return None
+    if doc.get("skipped"):
+        return None
+    return doc
+
+
+def load_record(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return unwrap_record(json.load(f))
+    except (OSError, ValueError):
+        return None
+
+
+def _walk_metrics(
+    doc: Dict[str, Any], prefix: str = ""
+) -> Dict[str, float]:
+    """Flatten every judged numeric metric to ``dotted.path -> value``.
+    Error legs (``{"error": ...}``) contribute nothing — their absence
+    from the flat map is what surfaces them as vanished."""
+    out: Dict[str, float] = {}
+    if "error" in doc:
+        return out
+    for key, val in doc.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(val, dict):
+            out.update(_walk_metrics(val, path))
+        elif (
+            key in METRIC_DIRECTIONS
+            and isinstance(val, (int, float))
+            and not isinstance(val, bool)
+        ):
+            out[path] = float(val)
+    return out
+
+
+def _leg_of(path: str) -> str:
+    return path.rsplit(".", 1)[0] if "." in path else "primary"
+
+
+def compare_records(
+    reference: Dict[str, Any],
+    candidate: Dict[str, Any],
+    band: float = DEFAULT_NOISE_BAND,
+) -> Dict[str, Any]:
+    """Per-metric typed verdicts for candidate vs reference."""
+    ref = _walk_metrics(reference)
+    cand = _walk_metrics(candidate)
+    verdicts: List[Dict[str, Any]] = []
+    regressions = 0
+    for path in sorted(set(ref) | set(cand)):
+        direction = METRIC_DIRECTIONS[path.rsplit(".", 1)[-1]]
+        row: Dict[str, Any] = {
+            "path": path,
+            "leg": _leg_of(path),
+            "direction": direction,
+            "reference": ref.get(path),
+            "candidate": cand.get(path),
+            "band": band,
+        }
+        if path not in cand:
+            # The candidate stopped producing this number — a vanished
+            # leg/metric is a signal, not a skip.
+            row["verdict"] = "leg_vanished"
+            regressions += 1
+        elif path not in ref:
+            row["verdict"] = "no_baseline"
+        elif ref[path] == 0.0:
+            row["verdict"] = "insufficient"
+        else:
+            ratio = cand[path] / ref[path]
+            row["ratio"] = round(ratio, 4)
+            good = ratio > 1.0 + band
+            bad = ratio < 1.0 - band
+            if direction == "down":
+                good, bad = bad, good
+            if bad:
+                row["verdict"] = "regression"
+                regressions += 1
+            elif good:
+                row["verdict"] = "improved"
+            else:
+                row["verdict"] = "ok"
+        verdicts.append(row)
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "band": band,
+        "reference_schema": reference.get("schema_version"),
+        "candidate_schema": candidate.get("schema_version"),
+        "reference_fingerprint": reference.get("fingerprint"),
+        "candidate_fingerprint": candidate.get("fingerprint"),
+        "verdicts": verdicts,
+        "regressions": regressions,
+        "verdict": "regression" if regressions else "ok",
+    }
+
+
+def compare_paths(
+    paths: List[str],
+    baseline_path: Optional[str] = None,
+    band: float = DEFAULT_NOISE_BAND,
+) -> Tuple[Dict[str, Any], int]:
+    """CLI/epilogue entrypoint: ``paths`` oldest→newest; the last is the
+    candidate, the most recent usable among the rest is the reference.
+    Returns (report, exit_code): 0 ok, 1 regression, 2 unusable inputs."""
+    if len(paths) < 2:
+        return (
+            {"error": "need at least two records (reference... candidate)"},
+            2,
+        )
+    candidate = load_record(paths[-1])
+    if candidate is None:
+        return (
+            {"error": f"candidate record {paths[-1]!r} is unusable "
+                      "(failed round, skip record, or not bench JSON)"},
+            2,
+        )
+    reference = None
+    reference_path = None
+    for p in reversed(paths[:-1]):
+        reference = load_record(p)
+        if reference is not None:
+            reference_path = p
+            break
+    if reference is None:
+        return (
+            {"error": "no usable reference record among "
+                      f"{paths[:-1]!r}"},
+            2,
+        )
+    report = compare_records(reference, candidate, band=band)
+    report["reference_path"] = reference_path
+    report["candidate_path"] = paths[-1]
+    skipped = [
+        p for p in paths[:-1] if p != reference_path and load_record(p) is None
+    ]
+    if skipped:
+        report["unusable_records"] = skipped
+    if baseline_path:
+        try:
+            with open(baseline_path, "r", encoding="utf-8") as f:
+                base = json.load(f)
+            report["baseline"] = {
+                "metric": base.get("metric"),
+                "north_star": base.get("north_star"),
+                "published": base.get("published"),
+            }
+        except (OSError, ValueError) as e:
+            report["baseline"] = {"error": f"{type(e).__name__}: {e}"}
+    return report, (1 if report["regressions"] else 0)
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable verdict table (the CLI's default rendering)."""
+    if "error" in report:
+        return f"bench compare: {report['error']}"
+    lines = [
+        f"bench compare: {report['candidate_path']} "
+        f"vs {report['reference_path']} (band ±{report['band']:.0%})"
+    ]
+    marks = {
+        "ok": " ", "improved": "+", "regression": "!",
+        "leg_vanished": "!", "no_baseline": "?", "insufficient": "?",
+    }
+    for row in report["verdicts"]:
+        mark = marks.get(row["verdict"], "?")
+        ref, cand = row["reference"], row["candidate"]
+        ratio = row.get("ratio")
+        lines.append(
+            f"  [{mark}] {row['path']:<42} "
+            f"{'-' if ref is None else f'{ref:g}':>12} -> "
+            f"{'-' if cand is None else f'{cand:g}':>12}"
+            + (f"  x{ratio:g}" if ratio is not None else "")
+            + f"  {row['verdict']}"
+        )
+    lines.append(
+        f"verdict: {report['verdict'].upper()} "
+        f"({report['regressions']} regression(s), "
+        f"{len(report['verdicts'])} metrics judged)"
+    )
+    return "\n".join(lines)
+
+
+def add_compare_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "records", nargs="+",
+        help="bench records oldest->newest (raw bench.py JSON or the "
+        "driver's BENCH_r*.json wrappers); the last is the candidate",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="BASELINE.json for provenance (metric/north-star context "
+        "attached to the report; not a verdict source)",
+    )
+    parser.add_argument(
+        "--band", type=float, default=DEFAULT_NOISE_BAND,
+        help="fractional noise band before a drift is a verdict "
+        "(default %(default)s)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw report JSON instead of the table",
+    )
+
+
+def main_compare(args: argparse.Namespace) -> int:
+    report, rc = compare_paths(
+        list(args.records), baseline_path=args.baseline, band=args.band
+    )
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_report(report))
+    return rc

@@ -24,6 +24,7 @@ import asyncio
 import collections
 import functools
 import math
+import socket
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -234,6 +235,16 @@ class _InflightBurst:
     seqs: List[Tuple[int, _Sequence]]
     t_dispatch: float
     occupancy: int
+    # Perf-ledger attribution stamps (runtime/perf_ledger.py), taken at
+    # dispatch so the reap can feed the ledger without recomputing shape:
+    # width bucket + program variant key the fingerprint sentinel judges
+    # on; dispatch host cost, mean context, and the host gap this burst
+    # paid before its dispatch.
+    nb_bucket: int = 0
+    variant: str = ""
+    dispatch_s: float = 0.0
+    avg_ctx: float = 0.0
+    gap_s: float = 0.0
 
 
 # Block-table lookahead reserved by every decode dispatch, in bursts of
@@ -446,6 +457,26 @@ class JaxEngine:
         from dynamo_tpu.engines.metrics import EngineStepMetrics
 
         self.step_metrics = EngineStepMetrics()
+        # Perf ledger (runtime/perf_ledger.py): always-on per-shape decode
+        # attribution + the live regression sentinel. Process-global — the
+        # status server renders/serves the same instance — with this
+        # engine's identity (fingerprint key) and a roofline closure over
+        # its model config installed here. configure() also loads any
+        # persisted fingerprints (corrupt file → counted cold start).
+        from dynamo_tpu.runtime.perf_ledger import global_perf_ledger
+        from dynamo_tpu.runtime.roofline import make_roofline_fn
+
+        self._perf = global_perf_ledger()
+        try:
+            perf_backend = jax.default_backend()
+        except Exception:
+            perf_backend = "unknown"
+        self._perf.configure(
+            preset=self.config.name,
+            backend=perf_backend,
+            host=socket.gethostname(),
+            roofline_fn=make_roofline_fn(self.config, args.quantization),
+        )
 
         # Device-plane observability (runtime/device_observe.py):
         # - flight: the tick loop's single-writer event ring (admit,
@@ -595,6 +626,11 @@ class JaxEngine:
             self._loop_task = None
         self._executor.shutdown(wait=False)
         self._transfer_executor.shutdown(wait=False)
+        # Clean shutdown persists the perf fingerprints this run earned;
+        # after a terminal tick failure the windows describe a degraded
+        # engine, and a degraded baseline is worse than none.
+        if self._failure is None:
+            self._perf.store_fingerprints()
 
     def stats(self) -> Dict[str, Any]:
         """Engine stats for /engine/stats and metric scrapes. While the
@@ -1462,11 +1498,12 @@ class JaxEngine:
         # computes for the same burst index.
         inflight_off = K * len(self._inflight)
         max_blocks = 1
+        sum_ctx = 0
         for seq in active:
+            ctx = int(self._pos[seq.slot]) + inflight_off + K
+            sum_ctx += ctx
             max_blocks = max(
-                max_blocks,
-                (int(self._pos[seq.slot]) + inflight_off + K - 1)
-                // args.block_size + 1,
+                max_blocks, (ctx - 1) // args.block_size + 1
             )
         nb_bucket = table_width_bucket(max_blocks, args.max_blocks_per_seq)
         want_logprobs = any(
@@ -1484,9 +1521,11 @@ class JaxEngine:
             self._dispatch_on_device, nb_bucket, want_logprobs, want_procs,
             state_sync, table_sync,
         )
+        t_dispatched = time.monotonic()
         # Host-gap: how long the device sat idle on host work between the
         # previous burst's readback and this dispatch. When another burst
         # was already in flight the device never waited — observe 0.
+        gap = 0.0
         if self._t_last_ready is not None:
             gap = 0.0 if had_inflight else max(
                 0.0, t0 - self._t_last_ready
@@ -1499,6 +1538,13 @@ class JaxEngine:
                 seqs=[(s.slot, s) for s in active],
                 t_dispatch=t0,
                 occupancy=len(active),
+                nb_bucket=nb_bucket,
+                variant=self.runner._variant_label(
+                    nb_bucket, want_logprobs, want_procs
+                ),
+                dispatch_s=t_dispatched - t0,
+                avg_ctx=sum_ctx / len(active),
+                gap_s=gap,
             )
         )
         self.flight.record(
@@ -1588,6 +1634,24 @@ class JaxEngine:
             tokens=self.generated_tokens - gen0,
             dur_ms=round(1000 * (self._t_last_ready - rec.t_dispatch), 3),
         )
+        # Perf ledger: the same burst accounting, decomposed per shape
+        # (width bucket, program variant, fused/fallback path) with the
+        # dispatch/reap host split the stamps above already paid for. The
+        # sentinel comparison itself is time-gated inside evaluate().
+        self._perf.observe_decode(
+            rec.nb_bucket,
+            rec.variant,
+            "fused" if rec.handles.mk_key is not None else "fallback",
+            self._t_last_ready - rec.t_dispatch,
+            self.generated_tokens - gen0,
+            rec.occupancy,
+            rec.avg_ctx,
+            rec.gap_s,
+            rec.dispatch_s,
+            time.monotonic() - self._t_last_ready,
+            now=self._t_last_ready,
+        )
+        self._perf.evaluate(now=self._t_last_ready)
         self._publish_stats()
 
     async def _drain_inflight(self) -> None:

@@ -1,0 +1,181 @@
+"""`dynamo-tpu bench compare` (bench/compare.py): the offline half of
+the perf sentinel. Same headline contract as the live path — a 20%
+throughput regression exits nonzero while ±5% noise stays silent — plus
+the record-hygiene rules: driver wrappers unwrap, failed/skip rounds are
+never a reference, vanished legs are regressions, latency metrics judge
+in the DOWN direction."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.bench.compare import (
+    BENCH_SCHEMA_VERSION,
+    compare_paths,
+    compare_records,
+    format_report,
+    main_compare,
+    unwrap_record,
+)
+
+
+def record(value=1000.0, p50_itl=10.0, **extra):
+    return {
+        "metric": "aggregated decode throughput",
+        "value": value,
+        "unit": "tokens/sec/chip",
+        "p50_ttft_ms": 120.0,
+        "p50_itl_ms": p50_itl,
+        "fused_coverage": 1.0,
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "fingerprint": {"backend": "cpu", "host": "a", "preset": "tiny"},
+        "secondary": {
+            "toks_per_sec_per_chip": 2000.0,
+            "p99_itl_ms": 30.0,
+        },
+        **extra,
+    }
+
+
+def write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_unwrap_accepts_raw_and_driver_wrapper():
+    raw = record()
+    assert unwrap_record(raw) is raw
+    wrapped = {"n": 4, "cmd": "python bench.py", "rc": 0, "parsed": raw}
+    assert unwrap_record(wrapped) is raw
+    # Failed round (rc=124, parsed null), skip record, and non-records
+    # are all unusable — never a comparison reference.
+    assert unwrap_record({"n": 1, "cmd": "x", "rc": 124, "parsed": None}) is None
+    assert unwrap_record(record(skipped="tpu-unavailable")) is None
+    assert unwrap_record({"hello": 1}) is None
+    assert unwrap_record(["not", "a", "dict"]) is None
+
+
+def test_twenty_pct_regression_exits_nonzero(tmp_path):
+    ref = write(tmp_path, "r1.json", record(value=1000.0))
+    cand = write(tmp_path, "r2.json", record(value=800.0))
+    report, rc = compare_paths([ref, cand])
+    assert rc == 1 and report["verdict"] == "regression"
+    by_path = {v["path"]: v for v in report["verdicts"]}
+    assert by_path["value"]["verdict"] == "regression"
+    assert by_path["value"]["ratio"] == pytest.approx(0.8)
+    # The other metrics were unchanged — flagged nothing.
+    assert by_path["secondary.toks_per_sec_per_chip"]["verdict"] == "ok"
+
+
+def test_five_pct_noise_is_silent(tmp_path):
+    ref = write(tmp_path, "r1.json", record(value=1000.0, p50_itl=10.0))
+    cand = write(tmp_path, "r2.json", record(value=1050.0, p50_itl=9.6))
+    report, rc = compare_paths([ref, cand])
+    assert rc == 0 and report["verdict"] == "ok"
+    assert all(v["verdict"] == "ok" for v in report["verdicts"])
+
+
+def test_latency_judges_down(tmp_path):
+    """p50_itl_ms DOUBLING is a regression even though the number went
+    up; halving is an improvement."""
+    ref = write(tmp_path, "r1.json", record(p50_itl=10.0))
+    worse = write(tmp_path, "r2.json", record(p50_itl=20.0))
+    report, rc = compare_paths([ref, worse])
+    assert rc == 1
+    v = {r["path"]: r for r in report["verdicts"]}["p50_itl_ms"]
+    assert v["verdict"] == "regression" and v["direction"] == "down"
+    better = write(tmp_path, "r3.json", record(p50_itl=5.0))
+    report, rc = compare_paths([ref, better])
+    assert rc == 0
+    v = {r["path"]: r for r in report["verdicts"]}["p50_itl_ms"]
+    assert v["verdict"] == "improved"
+
+
+def test_vanished_leg_is_regression(tmp_path):
+    """A leg that stopped producing numbers (error dict or gone) counts
+    against the candidate — silence is not a pass."""
+    ref_doc = record()
+    cand_doc = record()
+    cand_doc["secondary"] = {"error": "TimeoutError: ..."}
+    ref = write(tmp_path, "r1.json", ref_doc)
+    cand = write(tmp_path, "r2.json", cand_doc)
+    report, rc = compare_paths([ref, cand])
+    assert rc == 1
+    by_path = {v["path"]: v for v in report["verdicts"]}
+    assert by_path["secondary.toks_per_sec_per_chip"]["verdict"] == "leg_vanished"
+    assert by_path["secondary.p99_itl_ms"]["verdict"] == "leg_vanished"
+    # New legs in the candidate are no_baseline, not regressions.
+    report2 = compare_records(cand_doc, ref_doc)
+    by_path = {v["path"]: v for v in report2["verdicts"]}
+    assert by_path["secondary.p99_itl_ms"]["verdict"] == "no_baseline"
+
+
+def test_reference_skips_unusable_rounds(tmp_path):
+    """The reference is the most recent USABLE record before the
+    candidate: rc=124 wrecks and skip records are stepped over."""
+    good = write(tmp_path, "r1.json", record(value=1000.0))
+    dead = write(
+        tmp_path, "r2.json", {"n": 2, "cmd": "x", "rc": 124, "parsed": None}
+    )
+    skip = write(tmp_path, "r3.json", record(skipped="tpu-unavailable"))
+    cand = write(tmp_path, "r4.json", record(value=990.0))
+    report, rc = compare_paths([good, dead, skip, cand])
+    assert rc == 0
+    assert report["reference_path"] == good
+    assert sorted(report["unusable_records"]) == sorted([dead, skip])
+
+
+def test_unusable_inputs_exit_two(tmp_path):
+    dead = write(tmp_path, "dead.json", {"rc": 1, "cmd": "x", "parsed": None})
+    good = write(tmp_path, "good.json", record())
+    # Candidate unusable.
+    report, rc = compare_paths([good, dead])
+    assert rc == 2 and "error" in report
+    # No usable reference.
+    report, rc = compare_paths([dead, good])
+    assert rc == 2 and "error" in report
+    # Fewer than two records.
+    report, rc = compare_paths([good])
+    assert rc == 2
+    # Missing file is unusable, not a crash.
+    report, rc = compare_paths([str(tmp_path / "absent.json"), good])
+    assert rc == 2
+
+
+def test_baseline_provenance_and_schema_stamps(tmp_path):
+    base = write(tmp_path, "BASELINE.json", {
+        "metric": "tokens/sec/chip", "north_star": 42.0,
+        "published": "paper table 3",
+    })
+    ref = write(tmp_path, "r1.json", record())
+    cand = write(tmp_path, "r2.json", record())
+    report, rc = compare_paths([ref, cand], baseline_path=base)
+    assert rc == 0
+    assert report["baseline"]["north_star"] == 42.0
+    assert report["reference_schema"] == BENCH_SCHEMA_VERSION
+    assert report["candidate_fingerprint"]["host"] == "a"
+
+
+def test_format_report_and_cli_shape(tmp_path, capsys):
+    ref = write(tmp_path, "r1.json", record(value=1000.0))
+    cand = write(tmp_path, "r2.json", record(value=700.0))
+    report, rc = compare_paths([ref, cand])
+    text = format_report(report)
+    assert "[!] value" in text and "regression" in text
+    assert "verdict: REGRESSION" in text
+
+    # argparse namespace shape used by `dynamo-tpu bench compare`.
+    import argparse
+
+    from dynamo_tpu.bench.compare import add_compare_args
+
+    parser = argparse.ArgumentParser()
+    add_compare_args(parser)
+    args = parser.parse_args([ref, cand, "--json"])
+    assert main_compare(args) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "regression"
+    # A wider band forgives the same drift.
+    args = parser.parse_args([ref, cand, "--band", "0.5"])
+    assert main_compare(args) == 0

@@ -1,0 +1,359 @@
+"""Perf ledger (runtime/perf_ledger.py): rolling-window attribution
+math under a fake clock, the fingerprint persistence round trip, and the
+live regression sentinel's core promise — a 20% slowdown is flagged
+after the streak matures while ±5% run-to-run noise stays silent — plus
+the DYN006 contract on the fingerprint load/store seams (corrupt or
+fault-injected file -> counted cold start, never a crash)."""
+
+import json
+import threading
+
+import pytest
+
+from dynamo_tpu.runtime import fault_names as fn
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.perf_ledger import (
+    FINGERPRINT_SCHEMA_VERSION,
+    PerfLedger,
+    PerfLedgerConfig,
+    RollingWindow,
+    global_perf_ledger,
+    perf_index,
+    render_perf_metrics,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_ledger(clock, path="", **cfg):
+    cfg.setdefault("eval_interval_s", 1.0)
+    cfg.setdefault("min_samples", 8)
+    led = PerfLedger(
+        PerfLedgerConfig(fingerprint_path=path, **cfg), clock=clock
+    )
+    led.configure(preset="tiny", backend="cpu", host="testbox")
+    return led
+
+
+def feed(led, clock, n, step_s, width=8, tokens=8, dt=0.05, **kw):
+    """n decode bursts at a fixed step time, advancing the fake clock."""
+    for _ in range(n):
+        clock.tick(dt)
+        led.observe_decode(
+            width, kw.get("variant", f"w{width}"), kw.get("path", "fused"),
+            step_s, tokens, kw.get("occupancy", 4), kw.get("avg_ctx", 64.0),
+            0.0005, 0.001, 0.0005,
+        )
+
+
+# -- rolling window ----------------------------------------------------------
+
+
+def test_rolling_window_quantiles_and_ttl():
+    """Quantiles interpolate over the live samples; samples older than
+    the TTL age out on write AND are excluded from TTL-aware reads."""
+    win = RollingWindow(maxlen=100, ttl_s=10.0)
+    for i in range(11):
+        win.add(float(i), float(i))  # values 0..10 at t=0..10
+    assert win.quantile(0.50) == 5.0
+    assert win.quantile(0.0) == 0.0
+    assert win.quantile(1.0) == 10.0
+    assert win.quantile(0.95) == pytest.approx(9.5)
+    # TTL-aware read at t=15: samples older than t=5 are dead.
+    assert win.values(now=15.0) == [5.0, 6.0, 7.0, 8.0, 9.0, 10.0]
+    assert win.quantile(0.50, now=15.0) == 7.5
+    # Appending at t=25 prunes everything older than t=15 in place.
+    win.add(25.0, 99.0)
+    assert win.values() == [99.0]
+    # Empty window renders 0.0, not NaN / raise.
+    assert RollingWindow(4, 1.0).quantile(0.5) == 0.0
+
+
+def test_rolling_window_maxlen_bounds_memory():
+    win = RollingWindow(maxlen=4, ttl_s=1e9)
+    for i in range(100):
+        win.add(float(i), float(i))
+    assert len(win) == 4 and win.values() == [96.0, 97.0, 98.0, 99.0]
+
+
+# -- attribution + snapshot --------------------------------------------------
+
+
+def test_decode_attribution_snapshot_and_roofline():
+    """Per-(width, variant, path) rows carry the step/gap/dispatch/reap
+    decomposition and tok/s; the roofline gauge divides measured tok/s
+    by the injected arithmetic ceiling at the window's own medians."""
+    clock = FakeClock()
+    led = make_ledger(clock)
+    led.configure(
+        preset="tiny", backend="cpu", host="testbox",
+        roofline_fn=lambda batch, avg_ctx: 4000.0,
+    )
+    feed(led, clock, 20, 0.010, width=8, tokens=8, path="fused")
+    feed(led, clock, 5, 0.020, width=16, tokens=16, path="fallback",
+         variant="w16_logprobs")
+    snap = led.snapshot()
+    assert snap["identity"]["preset"] == "tiny"
+    rows = {(r["width"], r["variant"], r["path"]): r for r in snap["decode"]}
+    fused = rows[(8, "w8", "fused")]
+    assert fused["samples"] == 20
+    assert fused["step_p50_s"] == pytest.approx(0.010)
+    assert fused["toks_per_sec"] == pytest.approx(800.0)
+    assert fused["host_gap_p50_s"] == pytest.approx(0.0005)
+    assert fused["dispatch_p50_s"] == pytest.approx(0.001)
+    assert fused["roofline_fraction"] == pytest.approx(800.0 / 4000.0)
+    fb = rows[(16, "w16_logprobs", "fallback")]
+    assert fb["toks_per_sec"] == pytest.approx(800.0)
+
+    led.observe_prefill(128, 0.016, 128, now=clock.t)
+    led.observe_prefill(128, 0.016, 128, now=clock.t)
+    snap = led.snapshot()
+    assert snap["prefill"]["128"]["samples"] == 2
+    assert snap["prefill"]["128"]["toks_per_sec_p50"] == pytest.approx(8000.0)
+
+
+def test_concurrent_ticks_never_corrupt_windows():
+    """FlightRecorder threading contract: concurrent feeders + readers
+    (snapshot / evaluate / render) never raise and every sample lands."""
+    clock = FakeClock()
+    led = make_ledger(clock, window=10_000, eval_interval_s=0.0)
+    errors = []
+
+    def feeder(width):
+        try:
+            for i in range(500):
+                led.observe_decode(
+                    width, f"w{width}", "fused", 0.01, 8, 4, 64.0,
+                    0.0, 0.0, 0.0, now=1000.0 + i,
+                )
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                led.snapshot()
+                led.evaluate(now=clock.tick(0.01))
+                led.render()
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=feeder, args=(w,)) for w in (8, 16)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    snap = led.snapshot()
+    assert sum(r["samples_total"] for r in snap["decode"]) == 1000
+
+
+# -- fingerprints ------------------------------------------------------------
+
+
+def test_fingerprint_round_trip(tmp_path):
+    """store at clean shutdown -> load at next start: the second ledger
+    sees the first one's steady state as its baseline."""
+    path = str(tmp_path / "fp.json")
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 20, 0.010)
+    assert led.store_fingerprints() == 1
+    doc = json.loads(open(path).read())
+    assert doc["schema_version"] == FINGERPRINT_SCHEMA_VERSION
+    key = "tiny|w8|cpu|testbox"
+    assert doc["fingerprints"][key]["step_p50_s"] == pytest.approx(0.010)
+
+    led2 = make_ledger(FakeClock(), path=path)
+    assert led2._fingerprints_loaded == 1
+    assert led2._fingerprints[key]["samples"] == 20
+    # Another identity's fingerprints are not our baseline.
+    led3 = PerfLedger(PerfLedgerConfig(fingerprint_path=path))
+    led3.configure(preset="other-model", backend="cpu", host="testbox")
+    assert led3._fingerprints_loaded == 0
+
+
+def test_fingerprint_needs_min_samples(tmp_path):
+    path = str(tmp_path / "fp.json")
+    clock = FakeClock()
+    led = make_ledger(clock, path=path, min_samples=16)
+    feed(led, clock, 10, 0.010)  # below min_samples
+    assert led.store_fingerprints() == 0
+
+
+def test_corrupt_fingerprint_is_cold_start_not_crash(tmp_path):
+    """DYN006 promise on the load seam: corrupt JSON, wrong schema, and
+    non-mapping payloads all degrade to a counted cold start."""
+    path = tmp_path / "fp.json"
+    for payload in (
+        "{not json",
+        json.dumps({"schema_version": 999, "fingerprints": {}}),
+        json.dumps({"schema_version": 1, "fingerprints": "nope"}),
+    ):
+        path.write_text(payload)
+        led = make_ledger(FakeClock(), path=str(path))
+        assert led._fingerprints_loaded == 0
+        assert led.metrics.fp_failures.value(op="load") == 1
+        kinds = [e["kind"] for e in led.flight.snapshot()]
+        assert "fingerprint_load_failed" in kinds
+    # Vanished file is the EXPECTED first-run state: no failure counted.
+    led = make_ledger(FakeClock(), path=str(tmp_path / "absent.json"))
+    assert led._fingerprints_loaded == 0
+    assert led.metrics.fp_failures.value(op="load") == 0
+
+
+def test_fault_injection_on_load_and_store_seams(tmp_path):
+    """faultline can target both persistence seams; the ledger absorbs
+    the injected failure on each (cold start / store skipped), counts
+    it, and never lets it escape."""
+    path = str(tmp_path / "fp.json")
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 20, 0.010)
+    plan = faults.FaultPlan(seed=7, rules=(
+        faults.FaultRule(point=fn.PERF_FINGERPRINT_STORE, at=(1,)),
+    ))
+    with faults.armed(plan):
+        assert led.store_fingerprints() == 0  # injected, absorbed
+    assert led.metrics.fp_failures.value(op="store") == 1
+    assert led.store_fingerprints() == 1  # next clean shutdown persists
+
+    plan = faults.FaultPlan(seed=7, rules=(
+        faults.FaultRule(point=fn.PERF_FINGERPRINT_LOAD, at=(1,)),
+    ))
+    with faults.armed(plan):
+        led2 = make_ledger(FakeClock(), path=path)
+    assert led2._fingerprints_loaded == 0
+    assert led2.metrics.fp_failures.value(op="load") == 1
+
+
+# -- sentinel ----------------------------------------------------------------
+
+
+def baseline_ledger(tmp_path, step_s=0.010):
+    """A ledger whose identity has a persisted fingerprint at step_s."""
+    path = str(tmp_path / "fp.json")
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 30, step_s)
+    assert led.store_fingerprints() == 1
+    return path
+
+
+def test_twenty_pct_slowdown_flagged_five_pct_noise_silent(tmp_path):
+    """The headline sentinel contract on the LIVE path."""
+    path = baseline_ledger(tmp_path)
+
+    # ±5% drift: inside the band, verdict ok, nothing paged.
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 30, 0.0105)
+    for _ in range(4):
+        clock.tick(2.0)
+        assert led.evaluate()
+    verdict = led._verdicts["tiny|w8|cpu|testbox"]
+    assert verdict["verdict"] == "ok"
+    assert led._anomalies_total == 0
+
+    # 20% slowdown: flagged once the streak matures — and paged exactly
+    # once (edge-triggered), not on every 5s evaluation thereafter.
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 30, 0.012)
+    clock.tick(2.0)
+    assert led.evaluate()
+    v = led._verdicts["tiny|w8|cpu|testbox"]
+    assert v["verdict"] == "ok" and "step_regression" in v["pending"]
+    assert led._anomalies_total == 0  # streak immature: hold the page
+    for _ in range(3):
+        feed(led, clock, 5, 0.012)
+        clock.tick(2.0)
+        assert led.evaluate()
+    v = led._verdicts["tiny|w8|cpu|testbox"]
+    assert v["verdict"] == "regression"
+    kinds = {a["kind"] for a in v["anomalies"]}
+    assert kinds == {"step_regression", "toks_regression"}
+    assert led._anomalies_total == 2  # one page per kind, ever
+    ring = [e for e in led.flight.snapshot() if e["kind"] == "anomaly"]
+    assert len(ring) == 2
+    assert {e["anomaly"] for e in ring} == kinds
+
+
+def test_improvement_and_insufficient_verdicts(tmp_path):
+    path = baseline_ledger(tmp_path)
+    clock = FakeClock()
+    led = make_ledger(clock, path=path)
+    feed(led, clock, 4, 0.008)  # fast, but too few samples
+    clock.tick(2.0)
+    led.evaluate()
+    assert led._verdicts["tiny|w8|cpu|testbox"]["verdict"] == "insufficient"
+    feed(led, clock, 30, 0.008)  # 20% faster
+    clock.tick(2.0)
+    led.evaluate()
+    assert led._verdicts["tiny|w8|cpu|testbox"]["verdict"] == "improved"
+    assert led._anomalies_total == 0
+    # A width with no persisted fingerprint gets no_baseline, not noise.
+    feed(led, clock, 30, 0.010, width=32, variant="w32")
+    clock.tick(2.0)
+    led.evaluate()
+    assert led._verdicts["tiny|w32|cpu|testbox"]["verdict"] == "no_baseline"
+
+
+def test_recovery_clears_streaks(tmp_path):
+    """A breach that heals before the streak matures never pages; the
+    streak resets rather than accumulating across separate blips."""
+    path = baseline_ledger(tmp_path)
+    clock = FakeClock()
+    led = make_ledger(clock, path=path, sample_ttl_s=3.0)
+    feed(led, clock, 30, 0.012)
+    clock.tick(2.0)
+    led.evaluate()
+    assert led._anomalies_total == 0
+    # Regime heals: TTL ages the slow samples out, fast ones replace them.
+    clock.tick(5.0)
+    feed(led, clock, 30, 0.010, dt=0.01)
+    clock.tick(2.0)
+    led.evaluate()
+    assert led._verdicts["tiny|w8|cpu|testbox"]["verdict"] == "ok"
+    assert led._streaks == {}
+    assert led._anomalies_total == 0
+
+
+# -- metrics / module surface ------------------------------------------------
+
+
+def test_metrics_render_and_global_surface():
+    """ALL_PERF gauges render from the ledger's windows via the
+    on_render hook; the process-global surface (singleton, perf_index,
+    render_perf_metrics incl. the perf flight ring) is one object."""
+    clock = FakeClock()
+    led = make_ledger(clock)
+    feed(led, clock, 20, 0.010)
+    text = led.render()
+    assert 'dynamo_tpu_perf_step_p50_seconds{width="8"' in text
+    assert "dynamo_tpu_perf_tokens_per_sec" in text
+    assert "dynamo_tpu_perf_anomalies_total" in text
+
+    assert global_perf_ledger() is global_perf_ledger()
+    assert perf_index(led)["decode"][0]["samples"] == 20
+    body = render_perf_metrics()
+    assert "dynamo_tpu_perf_window_samples" in body
+    assert 'ring="perf"' in body  # the perf flight ring rides along
