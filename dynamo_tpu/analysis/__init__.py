@@ -12,7 +12,7 @@ real serving stacks make once invariants outnumber reviewers (the
 reference Dynamo gates its Rust core on clippy; JAX ships its own
 leak-checker / debug tooling).
 
-Six passes (docs/design_docs/static_analysis.md has the catalog):
+Nine passes (docs/design_docs/static_analysis.md has the catalog):
 
   DYN001  jit-discipline     every jax.jit construction is wrapped in
                              watched_jit and not rebuilt per call/loop
@@ -27,6 +27,14 @@ Six passes (docs/design_docs/static_analysis.md has the catalog):
           rings              ring's one owning class
   DYN006  fault-point        fault_point() names <-> fault_names
           closure            ALL_FAULT_POINTS, both directions
+  DYN007  async lifecycle    get_running_loop over get_event_loop,
+                             retained create_task handles, no blocking
+                             calls inside async bodies
+  DYN008  config-knob        DYN_TPU_* env reads <-> config.py ALL_KNOBS
+          closure            registry, both directions
+  DYN009  import layering    module-level imports respect the declared
+                             layer DAG; cycles and broken lazy-import
+                             obligations reported
 
 Ships three ways: ``dynamo-tpu lint`` (analysis/cli.py), the tier-1 gate
 (tests/test_dynlint.py, zero non-baselined findings over dynamo_tpu/),
@@ -53,7 +61,7 @@ from dynamo_tpu.analysis.core import (
 )
 from dynamo_tpu.analysis.config import LintConfig, repo_config
 
-# Importing the rules package registers the six passes.
+# Importing the rules package registers the nine passes.
 from dynamo_tpu.analysis import rules as _rules  # noqa: F401
 
 __all__ = [

@@ -53,9 +53,40 @@ def add_lint_args(parser: argparse.ArgumentParser) -> None:
         help="rewrite the baseline file from the current findings and "
         "exit 0 (review the diff!)",
     )
+    parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print the catalog entry for a rule id (title + the rule "
+        "module's documentation) and exit",
+    )
+
+
+def explain_rule(rule_id: str) -> Optional[str]:
+    """Catalog entry for a rule id: its title plus the rule module's
+    docstring (the module doc IS the catalog text — one source for the
+    CLI, the tests, and docs/design_docs/static_analysis.md to agree
+    on). None for an unknown id."""
+    import sys as _sys
+
+    rule_cls = all_rules().get(rule_id)
+    if rule_cls is None:
+        return None
+    doc = (_sys.modules[rule_cls.__module__].__doc__ or "").strip()
+    return f"{rule_cls.id} — {rule_cls.title}\n\n{doc}"
 
 
 def main_lint(args) -> int:
+    if getattr(args, "explain", None):
+        text = explain_rule(args.explain)
+        if text is None:
+            print(
+                f"unknown rule id {args.explain!r} "
+                f"(have: {', '.join(sorted(all_rules()))})",
+                file=sys.stderr,
+            )
+            return 2
+        print(text)
+        return 0
+
     rule_ids: Optional[List[str]] = None
     if args.rules:
         rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -76,7 +107,8 @@ def main_lint(args) -> int:
         from dynamo_tpu.analysis.config import portable_config
 
         config = portable_config()
-        disabled = {"DYN002", "DYN004", "DYN005"}
+        disabled = {"DYN002", "DYN004", "DYN005", "DYN006", "DYN008",
+                    "DYN009"}
         asked_disabled = sorted(set(rule_ids or ()) & disabled)
         if asked_disabled:
             # Explicitly requested rules must not silently no-op into a

@@ -197,6 +197,157 @@ class RingWriterConfig:
 
 
 @dataclass(frozen=True)
+class AsyncLifecycleConfig:
+    """DYN007. The three async-plane bug classes the last ten PRs kept
+    re-fixing, as config:
+
+    ``get_event_loop`` is banned outright — outside a running loop it
+    binds (or on 3.12+ raises about) a dead loop that never runs the
+    task; ``asyncio.get_running_loop()`` fails loudly at the call site
+    instead (the PR 12 Planner lesson, now machine-checked).
+
+    ``create_task`` results must be retained: a bare expression-statement
+    discards the only strong reference, so the task is garbage-collected
+    mid-flight and its failure is silently dropped. Store it, await it,
+    gather it, or route it through ``runtime/tasks.py::reap_task``.
+
+    ``blocking_calls`` / ``blocking_prefixes``: synchronous calls that
+    stall the event loop when they appear lexically inside an ``async
+    def`` body (nearest enclosing function is async — a nested sync def
+    or a lambda handed to ``run_in_executor`` is its own boundary and
+    exempt). ``blocking_allowlist`` holds the blessed boundaries as
+    (module rel path, enclosing async qualname): every entry is a
+    reviewed decision that the call is small, local, and cheaper than an
+    executor hop."""
+
+    blocking_calls: FrozenSet[str] = frozenset(
+        {
+            "time.sleep",
+            "subprocess.run",
+            "subprocess.call",
+            "subprocess.check_call",
+            "subprocess.check_output",
+            "subprocess.Popen",
+            "socket.create_connection",
+            "open",
+            "io.open",
+        }
+    )
+    blocking_prefixes: Tuple[str, ...] = ("requests.", "urllib.request.")
+    blocking_allowlist: FrozenSet[Tuple[str, str]] = frozenset(
+        {
+            # File-backend discovery: a local-fs dev/test backend by
+            # design (discovery/file.py docstring); writes are one small
+            # JSON document, atomic-rename, on a control-plane cadence.
+            ("runtime/discovery/file.py", "FileDiscovery.put"),
+            ("runtime/discovery/file.py", "FileDiscovery.create_lease"),
+            ("runtime/discovery/file.py", "FileDiscovery.keep_alive"),
+            ("runtime/discovery/file.py", "FileDiscovery.revoke_lease"),
+            # Event-plane replay serving: seeks a local append-only log at
+            # an indexed offset on the (rare) late-subscriber resync path,
+            # never on the publish hot path.
+            ("runtime/events/zmq_plane.py", "EventBroker._serve_replay"),
+            # Checkpoint manifest commit: a <1 KB JSON + atomic rename;
+            # the heavy block data rides gather_and_write under the
+            # engine's device executor, not this open().
+            ("engines/tpu/kv_checkpoint.py", "save_checkpoint"),
+            # Stream recorder: small JSONL lines appended under the
+            # recorder lock; documented at the call site as
+            # interleaving-safe and failure-disabling.
+            ("llm/recorder.py", "StreamRecorder._write"),
+            # CLI batch driver: single-user tool, file I/O IS the job.
+            ("cli/run.py", "run_batch"),
+        }
+    )
+
+
+@dataclass(frozen=True)
+class KnobClosureConfig:
+    """DYN008. The DYN004/DYN006 mirror for configuration: every
+    ``DYN_TPU_*`` environment read resolves through the knob registry
+    (``config.py`` ``ALL_KNOBS``: name, default, parser), every declared
+    knob has at least one reader, and a literal env-name string at a call
+    site is a finding — a renamed or dead knob can never silently diverge
+    from the docs. The knobs module is loaded BY FILE PATH (no package
+    import — it is dependency-free by design and the linter must run
+    without jax installed)."""
+
+    knobs_rel: str = "config.py"
+    prefix: str = "DYN_TPU_"
+    # Call shapes that read the environment: <...>.get / getenv calls and
+    # environ[...] subscripts are matched against these terminal names.
+    env_callables: FrozenSet[str] = frozenset({"getenv"})
+    environ_names: FrozenSet[str] = frozenset({"environ"})
+
+
+@dataclass(frozen=True)
+class ImportLayeringConfig:
+    """DYN009. The declared layer DAG, bottom-up: a module may import
+    (at module level) only from its own or a LOWER layer. ``layers`` maps
+    layer name -> path prefixes (a trailing '/' matches a directory; an
+    exact file name matches a root module); every module must map to
+    exactly one layer. ``lazy_obligations`` are known import-cycle
+    seams that must stay function-local imports — the PR 7 faults.py /
+    metrics_core rule, previously enforced only by a comment. Imports
+    under ``if TYPE_CHECKING:`` are annotations-only and exempt."""
+
+    package: str = "dynamo_tpu"
+    layers: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+        ("foundation", ("utils/", "config.py", "_version.py", "__init__.py")),
+        ("runtime", ("runtime/",)),
+        (
+            "planes",
+            (
+                "bench/",
+                "disagg/",
+                "discd/",
+                "engines/",
+                "frontend/",
+                "gateway/",
+                "global_router/",
+                "grpc/",
+                "http/",
+                "kvbm/",
+                "llm/",
+                "lora/",
+                "mocker/",
+                "models/",
+                "multimodal/",
+                "native/",
+                "ops/",
+                "parallel/",
+                "parsers/",
+                "planner/",
+                "profiler/",
+                "router/",
+                "tokens/",
+                "worker/",
+            ),
+        ),
+        ("surface", ("analysis/", "cli/", "deploy/")),
+    )
+    lazy_obligations: Tuple[Tuple[str, str, str], ...] = (
+        (
+            "runtime/faults.py",
+            "runtime/metrics_core.py",
+            "distributed.py imports faults for fault_point and "
+            "metrics_core imports utils.logging — a module-level import "
+            "here closes the cycle when utils.logging is the first entry "
+            "into the runtime package (PR 7); FaultPlane.__init__ imports "
+            "it lazily",
+        ),
+        (
+            "utils/logging.py",
+            "runtime/context.py",
+            "the formatter needs current_context() per record, but "
+            "utils.logging is the first import of half the tree — a "
+            "module-level import would drag the runtime package into "
+            "every foundation import (and the DAG bans the direction)",
+        ),
+    )
+
+
+@dataclass(frozen=True)
 class FaultPointConfig:
     """DYN006. ``fault_names_rel``: the single module allowed to declare
     fault-point names (loaded by file path — no package import, the
@@ -219,6 +370,15 @@ class LintConfig:
     faults: Optional[FaultPointConfig] = field(
         default_factory=FaultPointConfig
     )
+    async_lifecycle: Optional[AsyncLifecycleConfig] = field(
+        default_factory=AsyncLifecycleConfig
+    )
+    knobs: Optional[KnobClosureConfig] = field(
+        default_factory=KnobClosureConfig
+    )
+    layering: Optional[ImportLayeringConfig] = field(
+        default_factory=ImportLayeringConfig
+    )
 
 
 def repo_config() -> LintConfig:
@@ -228,9 +388,19 @@ def repo_config() -> LintConfig:
 
 
 def portable_config() -> LintConfig:
-    """Rules meaningful on ANY tree: DYN001 (jit discipline) and DYN003
-    (silent swallow). The repo-specific passes — hot-path roots, the
-    metric-name registry, ring ownership, the fault-point registry — are
-    tied to dynamo_tpu's layout and would only emit config-mismatch noise
-    on a foreign ``--root``; they are disabled here."""
-    return LintConfig(hot_path=None, metrics=None, rings=None, faults=None)
+    """Rules meaningful on ANY tree: DYN001 (jit discipline), DYN003
+    (silent swallow), and DYN007 (async lifecycle — asyncio semantics are
+    universal; the repo's blessed-boundary paths simply won't match a
+    foreign tree). The repo-specific passes — hot-path roots, the
+    metric-name registry, ring ownership, the fault-point registry, the
+    knob registry, the layer DAG — are tied to dynamo_tpu's layout and
+    would only emit config-mismatch noise on a foreign ``--root``; they
+    are disabled here."""
+    return LintConfig(
+        hot_path=None,
+        metrics=None,
+        rings=None,
+        faults=None,
+        knobs=None,
+        layering=None,
+    )

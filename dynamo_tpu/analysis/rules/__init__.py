@@ -1,9 +1,12 @@
-"""The six dynlint passes. Importing this package registers them."""
+"""The nine dynlint passes. Importing this package registers them."""
 
 from dynamo_tpu.analysis.rules import (  # noqa: F401
+    async_lifecycle,
     fault_points,
     hot_path,
+    import_layering,
     jit_discipline,
+    knob_closure,
     metric_closure,
     ring_writers,
     silent_swallow,

@@ -1,0 +1,128 @@
+"""DYN007 async-lifecycle discipline: the three asyncio bug classes the
+last ten PRs kept re-fixing, as one machine-checked pass.
+
+``asyncio.get_event_loop()`` is banned in favor of
+``asyncio.get_running_loop()``. Called before any loop runs (the common
+``start()``-from-``__init__``-time mistake) it binds a loop that will
+never run the task — the canary sweep, the operator watch, the offload
+pump all sat dead until someone noticed the plane was silent. The same
+bug was found and fixed twice (PR 12, PR 13) and still existed at 8+
+sites when this rule landed. ``get_running_loop()`` raises at the call
+site instead.
+
+``create_task()`` results must be retained. The event loop holds only a
+weak reference to tasks: a bare fire-and-forget expression-statement
+discards the last strong reference, so the task can be garbage-collected
+mid-flight and its exception silently dropped. Store it on an attribute,
+await it, gather it, or route it through ``runtime/tasks.py::reap_task``
+— anything that keeps (and eventually reaps) the handle.
+
+Blocking calls (``time.sleep``, ``subprocess.run``, sync file/socket
+I/O, ``requests.*``) lexically inside ``async def`` bodies stall the
+event loop for every request it is serving. "Lexically" means the
+nearest enclosing function is the async one: a nested sync ``def`` or a
+lambda handed to ``run_in_executor`` is its own execution boundary and
+exempt. The configured allowlist (AsyncLifecycleConfig) holds the
+blessed boundaries — each entry is a reviewed small-local-I/O decision,
+not an escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    dotted_name,
+    register_rule,
+)
+
+
+def _nearest_function(
+    module: ModuleInfo, node: ast.AST
+) -> Optional[ast.AST]:
+    """Nearest enclosing function-ish scope (sync def, async def, or
+    lambda) — the execution boundary the blocking-call check keys on."""
+    for anc in module.ancestors(node):
+        if isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return anc
+    return None
+
+
+@register_rule
+class AsyncLifecycleRule(Rule):
+    id = "DYN007"
+    title = "async lifecycle: running loop, retained tasks, no blocking"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.async_lifecycle
+        if cfg is None:
+            return
+        for module in project.modules:
+            yield from self._check_module(module, cfg)
+
+    def _check_module(
+        self, module: ModuleInfo, cfg
+    ) -> Iterator[Finding]:
+        for node in module.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+
+            # -- get_event_loop ban ----------------------------------------
+            if dotted in ("asyncio.get_event_loop", "get_event_loop"):
+                yield Finding.at(
+                    module, node, self.id,
+                    f"asyncio.get_event_loop() in {module.qualname(node)} "
+                    "— outside a running loop this binds a dead loop that "
+                    "never runs the task; use asyncio.get_running_loop() "
+                    "so the failure is loud at the call site",
+                )
+
+            # -- fire-and-forget create_task -------------------------------
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "create_task"
+            ) or (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "create_task"
+            ):
+                parent = module.parent(node)
+                if isinstance(parent, ast.Expr):
+                    yield Finding.at(
+                        module, node, self.id,
+                        f"fire-and-forget create_task() in "
+                        f"{module.qualname(node)} — the loop keeps only a "
+                        "weak reference, so the task can be GC'd mid-"
+                        "flight and its failure dropped; retain the "
+                        "handle (attribute, await, gather, or "
+                        "runtime/tasks.py::reap_task)",
+                    )
+
+            # -- blocking calls inside async def ---------------------------
+            if dotted is None:
+                continue
+            blocking = dotted in cfg.blocking_calls or any(
+                dotted.startswith(p) for p in cfg.blocking_prefixes
+            )
+            if not blocking:
+                continue
+            fn = _nearest_function(module, node)
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            qualname = module.qualname(fn)
+            if (module.rel, qualname) in cfg.blocking_allowlist:
+                continue
+            yield Finding.at(
+                module, node, self.id,
+                f"blocking {dotted}() inside async def {qualname} — "
+                "stalls the event loop for every request it serves; "
+                "wrap it in run_in_executor or bless the boundary in "
+                "AsyncLifecycleConfig.blocking_allowlist with a reason",
+            )

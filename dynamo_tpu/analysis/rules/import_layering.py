@@ -1,0 +1,296 @@
+"""DYN009 import-layering: the declared layer DAG checked against the
+module-level import graph.
+
+The package layers bottom-up (``foundation`` = utils + the knob
+registry, ``runtime`` core, the serving ``planes``, and the ``surface``
+of deploy/cli/analysis). A module may import — at module level — only
+from its own or a lower layer: an up-edge is how import cycles start
+(the PR 7 incident: utils.logging pulling the runtime package in at
+import time closed a cycle through metrics_core the moment utils was the
+first entry into the tree).
+
+Three checks:
+
+* **Direction.** Every module-level intra-package import resolves to a
+  target module; importing from a HIGHER layer is a finding. Imports
+  under ``if TYPE_CHECKING:`` are annotations-only and exempt; imports
+  inside function bodies are the sanctioned lazy pattern and exempt.
+* **Cycles.** Strongly-connected components of the module-level import
+  graph (same-layer edges are legal, so the DAG check alone cannot see
+  them) — every genuine cycle is reported once, anchored at its
+  lexicographically-first module.
+* **Lazy obligations.** Known cycle seams that must STAY function-local
+  imports, as config entries (importer, banned target, why). This turns
+  the faults.py/metrics_core comment into a machine-checked invariant.
+
+Resolution is static and conservative: ``from pkg.a.b import c`` tries
+``a/b/c`` then ``a/b`` then ``a`` against the linted tree; names that
+resolve to nothing in the tree (stdlib, third-party) create no edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register_rule,
+)
+
+
+def _is_type_checking_test(test: ast.AST) -> bool:
+    if isinstance(test, ast.Name):
+        return test.id == "TYPE_CHECKING"
+    if isinstance(test, ast.Attribute):
+        return test.attr == "TYPE_CHECKING"
+    return False
+
+
+def _dotted_of_rel(rel: str) -> str:
+    """'runtime/discovery/file.py' -> 'runtime.discovery.file';
+    package __init__ maps to the package path itself."""
+    rel = rel[: -len(".py")]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    if rel == "__init__":
+        rel = ""
+    return rel.replace("/", ".")
+
+
+class _Tree:
+    """Dotted-name resolution over the linted tree."""
+
+    def __init__(self, project: Project) -> None:
+        self.by_dotted: Dict[str, str] = {
+            _dotted_of_rel(m.rel): m.rel for m in project.modules
+        }
+
+    def resolve(self, dotted: str) -> Optional[str]:
+        """Longest prefix of ``dotted`` that names a tree module."""
+        while dotted:
+            rel = self.by_dotted.get(dotted)
+            if rel is not None:
+                return rel
+            if "." not in dotted:
+                return None
+            dotted = dotted.rsplit(".", 1)[0]
+        return None
+
+
+def _module_level_imports(
+    module: ModuleInfo, tree: _Tree, package: str
+) -> List[Tuple[str, ast.stmt]]:
+    """(target rel path, import statement) for every module-level
+    intra-package import — excluding function bodies (lazy imports) and
+    ``if TYPE_CHECKING:`` blocks (annotations only)."""
+    out: List[Tuple[str, ast.stmt]] = []
+    pkg_prefix = package + "."
+    for node in module.nodes:
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        skip = False
+        for anc in module.ancestors(node):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                skip = True  # lazy import: the sanctioned pattern
+                break
+            if isinstance(anc, ast.If) and _is_type_checking_test(anc.test):
+                skip = True
+                break
+        if skip:
+            continue
+        targets: Set[str] = set()
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.name
+                # Absolute imports (py3): only names under the package
+                # are intra-package — a bare ``import grpc`` is the
+                # third-party library even if a ``grpc/`` subpackage
+                # exists in the tree.
+                if not name.startswith(pkg_prefix):
+                    continue
+                rel = tree.resolve(name[len(pkg_prefix):])
+                if rel is not None:
+                    targets.add(rel)
+        else:
+            if node.level > 0:
+                # Relative import: resolve against the importer's package.
+                base_parts = module.rel.split("/")[:-1]
+                up = node.level - 1
+                if up:
+                    base_parts = base_parts[: len(base_parts) - up]
+                base = ".".join(base_parts)
+            else:
+                base = node.module or ""
+                if base == package:
+                    base = ""
+                elif base.startswith(pkg_prefix):
+                    base = base[len(pkg_prefix):]
+                else:
+                    continue  # absolute import of an external package
+            if node.level > 0 and node.module:
+                mod_dotted = (
+                    f"{base}.{node.module}" if base else node.module
+                )
+            else:
+                mod_dotted = base
+            for alias in node.names:
+                cand = (
+                    f"{mod_dotted}.{alias.name}" if mod_dotted
+                    else alias.name
+                )
+                rel = tree.resolve(cand)
+                if rel is not None:
+                    targets.add(rel)
+        for rel in sorted(targets):
+            if rel != module.rel:
+                out.append((rel, node))
+    return out
+
+
+@register_rule
+class ImportLayeringRule(Rule):
+    id = "DYN009"
+    title = "module-level imports respect the declared layer DAG"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.layering
+        if cfg is None:
+            return
+        tree = _Tree(project)
+
+        layer_of: Dict[str, Tuple[int, str]] = {}
+        unmapped: List[ModuleInfo] = []
+        for module in project.modules:
+            assigned = None
+            for idx, (name, prefixes) in enumerate(cfg.layers):
+                for p in prefixes:
+                    if (p.endswith("/") and module.rel.startswith(p)) or (
+                        module.rel == p
+                    ):
+                        assigned = (idx, name)
+                        break
+                if assigned:
+                    break
+            if assigned is None:
+                unmapped.append(module)
+            else:
+                layer_of[module.rel] = assigned
+        for module in unmapped:
+            yield Finding(
+                rule=self.id,
+                path=module.rel,
+                line=1,
+                message=(
+                    "module mapped to no layer — extend "
+                    "ImportLayeringConfig.layers so the DAG stays total"
+                ),
+            )
+
+        obligations = {
+            (imp, banned): why for imp, banned, why in cfg.lazy_obligations
+        }
+        edges: Dict[str, Set[str]] = {}
+        first_stmt: Dict[Tuple[str, str], Tuple[ModuleInfo, ast.stmt]] = {}
+        for module in project.modules:
+            imports = _module_level_imports(module, tree, cfg.package)
+            edges[module.rel] = {rel for rel, _ in imports}
+            for rel, stmt in imports:
+                first_stmt.setdefault((module.rel, rel), (module, stmt))
+
+            for rel, stmt in imports:
+                why = obligations.get((module.rel, rel))
+                if why is not None:
+                    yield Finding.at(
+                        module, stmt, self.id,
+                        f"module-level import of {rel} violates a lazy-"
+                        f"import obligation — {why}. Import it inside the "
+                        "function that needs it",
+                    )
+                src = layer_of.get(module.rel)
+                dst = layer_of.get(rel)
+                if src is None or dst is None:
+                    continue
+                if dst[0] > src[0]:
+                    yield Finding.at(
+                        module, stmt, self.id,
+                        f"layer violation: {src[1]} module imports "
+                        f"{dst[1]} module {rel} at module level — the "
+                        f"DAG is {self._dag_str(cfg)}; invert the "
+                        "dependency or make the import lazy",
+                    )
+
+        for cycle in self._cycles(edges):
+            anchor = cycle[0]
+            module = project.module(anchor)
+            nxt = next(r for r in sorted(edges[anchor]) if r in set(cycle))
+            _, stmt = first_stmt[(anchor, nxt)]
+            yield Finding.at(
+                module, stmt, self.id,
+                "import cycle: " + " -> ".join(cycle + [anchor])
+                + " — break it by inverting an edge or making one "
+                "import lazy (and declaring the obligation in "
+                "ImportLayeringConfig)",
+            )
+
+    @staticmethod
+    def _dag_str(cfg) -> str:
+        return " -> ".join(name for name, _ in cfg.layers)
+
+    @staticmethod
+    def _cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+        """SCCs with more than one member (iterative Tarjan), each
+        rotated to start at its lexicographically-first module."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        for root in sorted(edges):
+            if root in index:
+                continue
+            work: List[Tuple[str, Optional[str], List[str]]] = [
+                (root, None, sorted(edges.get(root, ())))
+            ]
+            while work:
+                v, parent, children = work[-1]
+                if v not in index:
+                    index[v] = low[v] = counter[0]
+                    counter[0] += 1
+                    stack.append(v)
+                    on_stack.add(v)
+                advanced = False
+                while children:
+                    w = children.pop(0)
+                    if w not in edges:
+                        continue
+                    if w not in index:
+                        work.append((w, v, sorted(edges.get(w, ()))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if parent is not None:
+                    low[parent] = min(low[parent], low[v])
+                if low[v] == index[v]:
+                    comp: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    if len(comp) > 1:
+                        comp.sort()
+                        sccs.append(comp)
+        return sorted(sccs)

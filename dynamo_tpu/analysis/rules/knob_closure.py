@@ -1,0 +1,216 @@
+"""DYN008 config-knob closure: the DYN004/DYN006 mirror for
+configuration.
+
+Forward: every ``os.environ`` / ``os.getenv`` read of a ``DYN_TPU_*``
+name outside the knob registry (``config.py``) is a finding — a literal
+env-name string at a call site is a name the registry (and the generated
+knob reference table in docs/design_docs/) can silently drift from. Read
+through the registry constant's ``.get()`` instead: the default, the
+parser, and the documentation then live in exactly one place.
+
+Reverse: every knob declared in ``config.py::ALL_KNOBS`` must have at
+least one reader — a reference to its registry constant somewhere else
+in the package. A dead knob is documentation for behavior that quietly
+stopped existing: operators set it and nothing changes.
+
+Mirror of DYN004/DYN006 mechanics: the knobs module is loaded BY FILE
+PATH (no package import) — it is dependency-free by design and the
+linter must run without jax installed. Declared knobs are the entries of
+``ALL_KNOBS`` (each carrying ``name`` / ``default`` / ``parser``);
+module-level constants bound to those entries are the reader handles the
+reverse check scans for.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, Iterator, Optional, Set
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register_rule,
+)
+
+
+def _load_knobs_module(path: str):
+    import sys
+
+    spec = importlib.util.spec_from_file_location("_dynlint_knobs", path)
+    mod = importlib.util.module_from_spec(spec)
+    # The registry module defines dataclasses, whose machinery resolves
+    # annotations through sys.modules[cls.__module__] — register for the
+    # duration of exec, then drop (nothing should import "_dynlint_knobs").
+    sys.modules["_dynlint_knobs"] = mod
+    try:
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    finally:
+        sys.modules.pop("_dynlint_knobs", None)
+    return mod
+
+
+def _env_read_name(node: ast.AST, cfg) -> Optional[str]:
+    """The literal env-var name read by this node, if it is an
+    environment read with a literal argument: ``os.environ.get("X")``,
+    ``os.getenv("X")``, ``environ["X"]``. None otherwise."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else None
+        name = fn.id if isinstance(fn, ast.Name) else None
+        is_environ_get = (
+            attr == "get"
+            and isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, (ast.Attribute, ast.Name))
+            and (
+                (
+                    isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr in cfg.environ_names
+                )
+                or (
+                    isinstance(fn.value, ast.Name)
+                    and fn.value.id in cfg.environ_names
+                )
+            )
+        )
+        is_getenv = (attr in cfg.env_callables) or (name in cfg.env_callables)
+        if (is_environ_get or is_getenv) and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+        return None
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        is_environ = (
+            isinstance(base, ast.Attribute) and base.attr in cfg.environ_names
+        ) or (isinstance(base, ast.Name) and base.id in cfg.environ_names)
+        if is_environ:
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return sl.value
+    return None
+
+
+@register_rule
+class KnobClosureRule(Rule):
+    id = "DYN008"
+    title = "DYN_TPU_* env reads close over the config.py knob registry"
+
+    def check(self, project: Project, config) -> Iterator[Finding]:
+        cfg = config.knobs
+        if cfg is None:
+            return
+        knobs_module = project.module(cfg.knobs_rel)
+        if knobs_module is None:
+            yield Finding(
+                rule=self.id,
+                path=cfg.knobs_rel,
+                line=1,
+                message="knob-registry module missing from the linted tree",
+            )
+            return
+        try:
+            knobs_mod = _load_knobs_module(
+                os.path.join(project.root, cfg.knobs_rel)
+            )
+        except Exception as exc:
+            yield Finding(
+                rule=self.id,
+                path=cfg.knobs_rel,
+                line=1,
+                message=(
+                    f"knob-registry module failed to load ({exc!r}) — it "
+                    "is executed by file path and must stay dependency-free"
+                ),
+            )
+            return
+
+        all_knobs = getattr(knobs_mod, "ALL_KNOBS", None)
+        if not isinstance(all_knobs, tuple):
+            yield Finding(
+                rule=self.id,
+                path=cfg.knobs_rel,
+                line=1,
+                message=(
+                    "knob registry declares no ALL_KNOBS tuple — the "
+                    "closure check needs the (name, default, parser) "
+                    "entries pinned in one place"
+                ),
+            )
+            return
+        declared: Set[str] = {
+            k.name
+            for k in all_knobs
+            if hasattr(k, "name") and isinstance(k.name, str)
+        }
+        # Registry constant name -> knob env name (reader handles).
+        consts: Dict[str, str] = {
+            attr: v.name
+            for attr, v in vars(knobs_mod).items()
+            if not attr.startswith("_")
+            and hasattr(v, "name")
+            and hasattr(v, "parser")
+            and isinstance(getattr(v, "name"), str)
+        }
+        unbound = declared - set(consts.values())
+        for env_name in sorted(unbound):
+            yield Finding(
+                rule=self.id,
+                path=cfg.knobs_rel,
+                line=1,
+                message=(
+                    f"knob {env_name!r} is in ALL_KNOBS but bound to no "
+                    "module-level registry constant — readers have no "
+                    "handle to reference"
+                ),
+            )
+
+        read: Set[str] = set()
+        for module in project.modules:
+            if module.rel == cfg.knobs_rel:
+                continue
+            for node in module.nodes:
+                env_name = _env_read_name(node, cfg)
+                if env_name is not None and env_name.startswith(cfg.prefix):
+                    yield Finding.at(
+                        module, node, self.id,
+                        f"ad-hoc environment read of {env_name!r} in "
+                        f"{module.qualname(node)} — read through the "
+                        "config.py knob registry (declare it there and "
+                        "call <KNOB>.get()) so the name, default, and "
+                        "parser cannot drift from the docs",
+                    )
+                # Reader tracking: any reference to a registry constant.
+                if isinstance(node, ast.Name) and node.id in consts:
+                    read.add(consts[node.id])
+                elif isinstance(node, ast.Attribute) and node.attr in consts:
+                    read.add(consts[node.attr])
+
+        for env_name in sorted(declared - read - unbound):
+            yield Finding(
+                rule=self.id,
+                path=cfg.knobs_rel,
+                line=self._def_line(knobs_module, env_name),
+                message=(
+                    f"dead knob {env_name!r} — declared in the registry "
+                    "but read nowhere; operators setting it change "
+                    "nothing. Wire a reader or delete the declaration"
+                ),
+            )
+
+    @staticmethod
+    def _def_line(knobs_module: ModuleInfo, env_name: str) -> int:
+        """Line of the declaration whose first call argument is the env
+        name (``X = env_int("DYN_TPU_X", ...)``)."""
+        for node in knobs_module.nodes:
+            if (
+                isinstance(node, ast.Call)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == env_name
+            ):
+                return node.lineno
+        return 1

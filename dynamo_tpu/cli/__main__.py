@@ -32,10 +32,15 @@ _SERVICES = {
 }
 
 
-def cmd_env() -> None:
+def cmd_env(markdown: bool = False) -> None:
     """Print the DYN_* registry (config.py advertises this command)."""
     import os
 
+    if markdown:
+        # The docs/design_docs/config_knobs.md body; a tier-1 test pins
+        # the checked-in file to this output.
+        print(config.render_markdown())
+        return
     rows = sorted(config.registry().items())
     width = max(len(n) for n, _ in rows)
     for name, var in rows:
@@ -106,11 +111,17 @@ def main(argv=None) -> None:
         "(exit 1 on non-baselined findings)",
     )
     add_lint_args(lint_p)
-    sub.add_parser("env", help="print the environment-variable registry")
+    env_p = sub.add_parser(
+        "env", help="print the environment-variable registry"
+    )
+    env_p.add_argument(
+        "--markdown", action="store_true",
+        help="emit the docs/design_docs/config_knobs.md reference table",
+    )
     args = parser.parse_args(argv)
 
     if args.command == "env":
-        cmd_env()
+        cmd_env(markdown=args.markdown)
     elif args.command == "run":
         asyncio.run(main_run(args))
     elif args.command == "observe":

@@ -24,6 +24,8 @@ logger = get_logger(__name__)
 
 
 def add_run_args(parser: argparse.ArgumentParser) -> None:
+    from dynamo_tpu import config
+
     parser.add_argument(
         "--input", default="text",
         help="text (REPL) | stdin | batch:FILE.jsonl | http",
@@ -37,7 +39,9 @@ def add_run_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--max-tokens", type=int, default=64)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--http-port", type=int, default=8080)
-    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument(
+        "--block-size", type=int, default=config.KV_BLOCK_SIZE.get()
+    )
     parser.add_argument("--num-kv-blocks", type=int, default=512)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--out", default=None,

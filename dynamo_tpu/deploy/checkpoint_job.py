@@ -61,6 +61,6 @@ async def run_checkpoint_job(
     """Ingest the identity's checkpoint through the worker loader path,
     populating the shm + disk weight tiers under the loader's own
     fingerprint key. Returns the warm-tier path (CR status.location)."""
-    return await asyncio.get_event_loop().run_in_executor(
+    return await asyncio.get_running_loop().run_in_executor(
         None, _warm, identity, shm_dir, cache_dir
     )

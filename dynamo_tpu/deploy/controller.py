@@ -119,7 +119,7 @@ class GraphController:
     def start(self) -> None:
         if self._task is None:
             self._stop.clear()
-            self._task = asyncio.get_event_loop().create_task(
+            self._task = asyncio.get_running_loop().create_task(
                 self._run(), name=f"graph-controller:{self.deployment.name}"
             )
 

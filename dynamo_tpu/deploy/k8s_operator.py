@@ -458,7 +458,7 @@ class K8sGraphOperator:
             await self._patch_ckpt_status(
                 name, {"phase": "Creating", "identityHash": ih}
             )
-            self._ckpt_tasks[name] = asyncio.get_event_loop().create_task(
+            self._ckpt_tasks[name] = asyncio.get_running_loop().create_task(
                 self._run_checkpoint(name, identity, ih),
                 name=f"ckpt-{name}",
             )
@@ -563,7 +563,7 @@ class K8sGraphOperator:
     def start(self) -> None:
         self._stop.clear()
         self._tasks = [
-            asyncio.get_event_loop().create_task(self.run(), name="k8s-operator")
+            asyncio.get_running_loop().create_task(self.run(), name="k8s-operator")
         ]
 
     async def stop(self, *, teardown: bool = True) -> None:

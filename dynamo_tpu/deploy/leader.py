@@ -205,7 +205,7 @@ class LeaderElector:
 
     def start(self) -> None:
         self._stop.clear()
-        self._task = asyncio.get_event_loop().create_task(
+        self._task = asyncio.get_running_loop().create_task(
             self._run(), name=f"leader-{self.name}"
         )
 

@@ -8,12 +8,12 @@ content-addressed KV blocks instead of NIXL descriptors.
 from __future__ import annotations
 
 import asyncio
-import os
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamo_tpu import config
 from dynamo_tpu.disagg.errors import DisaggTransferError, classify_failure
 from dynamo_tpu.disagg.wire import (
     WIRE_VERSION,
@@ -64,19 +64,19 @@ LINK_BW_TTL_S = 600.0
 
 # -- self-healing pull knobs (env-overridable; ctor args win) ----------------
 # Bounded retry: attempts per pull (1 = the old single-shot behavior).
-PULL_MAX_ATTEMPTS = int(os.environ.get("DYN_TPU_PULL_ATTEMPTS", 3))
+PULL_MAX_ATTEMPTS = config.PULL_ATTEMPTS.get()
 # Exponential backoff between attempts: base × 2^(attempt-1), capped.
-PULL_BACKOFF_BASE_S = float(os.environ.get("DYN_TPU_PULL_BACKOFF_S", 0.05))
+PULL_BACKOFF_BASE_S = config.PULL_BACKOFF_S.get()
 PULL_BACKOFF_CAP_S = 2.0
 # Per-ATTEMPT timeout when the request carries no deadline; with a
 # deadline, each attempt gets min(this, time remaining) so a dead wire
 # can never eat the whole request budget.
-PULL_DEFAULT_TIMEOUT_S = float(os.environ.get("DYN_TPU_PULL_TIMEOUT_S", 30.0))
+PULL_DEFAULT_TIMEOUT_S = config.PULL_TIMEOUT_S.get()
 # Circuit breaker: consecutive pull failures from one src before the
 # (src → this worker) pair opens, and how long it stays priced out of
 # placement before the next pull is admitted as the half-open probe.
-BREAKER_OPEN_AFTER = int(os.environ.get("DYN_TPU_BREAKER_OPEN_AFTER", 3))
-BREAKER_COOLDOWN_S = float(os.environ.get("DYN_TPU_BREAKER_COOLDOWN_S", 30.0))
+BREAKER_OPEN_AFTER = config.BREAKER_OPEN_AFTER.get()
+BREAKER_COOLDOWN_S = config.BREAKER_COOLDOWN_S.get()
 
 
 class CircuitBreaker:
@@ -363,7 +363,7 @@ class PrefillHandler:
 # N-1, and the importer's engine keeps serving decode ticks between
 # chunks). Ref: the reference streams device-direct chunked/overlapped
 # (lib/llm/src/block_manager/block/transfer/cuda.rs:1, lib/memory/src/nixl/).
-KV_CHUNK_BYTES = int(os.environ.get("DYN_TPU_KV_CHUNK_BYTES", 8 << 20))
+KV_CHUNK_BYTES = config.KV_CHUNK_BYTES.get()
 
 
 class KvTransferHandler:

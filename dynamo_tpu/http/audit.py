@@ -20,10 +20,8 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-AUDIT_POLICY = config.env_str(
-    "DYN_TPU_AUDIT", "off",
-    "Request auditing: off | stderr | file:<path> (JSONL records)",
-)
+# Declared in the canonical registry (config.py).
+AUDIT_POLICY = config.AUDIT_POLICY
 
 SCHEMA_VERSION = 1
 

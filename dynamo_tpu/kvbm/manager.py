@@ -489,7 +489,7 @@ class TieredKvManager:
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.get_event_loop().create_task(
+            self._task = asyncio.get_running_loop().create_task(
                 self._offload_loop(), name="kvbm-offload"
             )
 
@@ -725,7 +725,7 @@ class TieredKvManager:
             return None
         pf = KvPrefetch(self, list(block_hashes[:PREFETCH_MAX_BLOCKS]))
         self._prefetches.add(pf)
-        pf.task = asyncio.get_event_loop().create_task(
+        pf.task = asyncio.get_running_loop().create_task(
             self._run_prefetch(pf), name="kvbm-prefetch"
         )
         return pf

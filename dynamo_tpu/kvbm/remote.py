@@ -122,7 +122,7 @@ class RemoteTier:
             except Exception:
                 logger.exception("remote tier put failed")
 
-        task = asyncio.get_event_loop().create_task(_put())
+        task = asyncio.get_running_loop().create_task(_put())
         self._pending.add(task)
         task.add_done_callback(self._pending.discard)
 

@@ -31,9 +31,9 @@ Every migration emits a flight-recorder event and a
 from __future__ import annotations
 
 import asyncio
-import os
 from typing import Any, AsyncIterator, List, Optional, Union
 
+from dynamo_tpu import config
 from dynamo_tpu.llm.protocols.common import (
     BackendOutput,
     FinishReason,
@@ -94,9 +94,7 @@ MIGRATABLE = (
 )
 
 # Default total re-prefill budget across all migrations of one stream.
-DEFAULT_REPREFILL_CAP = int(
-    os.environ.get("DYN_TPU_MIGRATION_REPREFILL_CAP", 131072)
-)
+DEFAULT_REPREFILL_CAP = config.MIGRATION_REPREFILL_CAP.get()
 
 
 def _failure_reason(exc: BaseException) -> str:

@@ -107,7 +107,9 @@ def main() -> None:
                         "--num-workers > 1; 0 = random). A restarted "
                         "mocker under the same id rejoins as the same "
                         "worker with a fresh incarnation (crash plane)")
-    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument(
+        "--block-size", type=int, default=config.KV_BLOCK_SIZE.get()
+    )
     parser.add_argument("--num-kv-blocks", type=int, default=1024)
     parser.add_argument("--max-num-seqs", type=int, default=32)
     parser.add_argument("--max-model-len", type=int, default=4096)

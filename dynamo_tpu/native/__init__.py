@@ -24,10 +24,8 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-NATIVE = config.env_bool(
-    "DYN_TPU_NATIVE", True,
-    "Use C++ native components when buildable (0 = pure-Python fallbacks)",
-)
+# Declared in the canonical registry (config.py).
+NATIVE = config.NATIVE
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")

@@ -315,11 +315,11 @@ def _paged_attention_decode_kernel_impl(
     G = n_heads // n_kv_heads
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
     if batch_block is None:
-        import os
+        from dynamo_tpu import config
 
-        env_bq = os.environ.get("DYN_TPU_DECODE_BQ")
-        if env_bq:
-            batch_block = int(env_bq)
+        env_bq = config.DECODE_BQ.get()
+        if env_bq > 0:
+            batch_block = env_bq
         else:
             # Measured on v5e: BQ bounded by the ~16 MB scoped VMEM the
             # per-j double-buffered page pairs occupy; int8 pages are half

@@ -21,12 +21,12 @@ Environment contract (mirrors the usual TPU pod env):
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Optional
 
 import jax
 
+from dynamo_tpu import config
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -51,13 +51,13 @@ class HostTopology:
 
 def multihost_config_from_env() -> Optional[dict]:
     """Read the multihost env contract; None when not configured."""
-    coord = os.environ.get("DYN_TPU_COORDINATOR")
+    coord = config.COORDINATOR.get()
     if not coord:
         return None
     return {
         "coordinator_address": coord,
-        "num_processes": int(os.environ.get("DYN_TPU_NUM_PROCESSES", "1")),
-        "process_id": int(os.environ.get("DYN_TPU_PROCESS_ID", "0")),
+        "num_processes": config.NUM_PROCESSES.get(),
+        "process_id": config.PROCESS_ID.get(),
     }
 
 

@@ -34,9 +34,9 @@ streaming machines in parsers/incremental.py:
 
 from __future__ import annotations
 
-import os
 from typing import Callable, List, Optional
 
+from dynamo_tpu import config
 from dynamo_tpu.parsers.holdback import find_first, holdback_split
 from dynamo_tpu.parsers.incremental import (
     AUTO_MARKERS,
@@ -55,9 +55,7 @@ from dynamo_tpu.runtime.faults import fault_point
 # Default unresolved-buffer cap (chars). Generous for real calls (most
 # argument payloads stream out incrementally and never sit in the
 # buffer) yet small enough that a marker bomb cannot balloon host RSS.
-DEFAULT_BUFFER_CAP = int(
-    os.environ.get("DYN_TPU_TOOL_JAIL_CAP_CHARS", 262144)
-)
+DEFAULT_BUFFER_CAP = config.TOOL_JAIL_CAP_CHARS.get()
 
 _DETECT, _STREAM, _PASSTHROUGH = 0, 1, 2
 

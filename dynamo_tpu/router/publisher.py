@@ -104,7 +104,7 @@ class KvEventPublisher:
         """Subscribe to the sync topic and answer requests with snapshots."""
         if self._snapshot_fn is None or self._sync_task is not None:
             return
-        self._sync_task = asyncio.get_event_loop().create_task(
+        self._sync_task = asyncio.get_running_loop().create_task(
             self._sync_pump(), name=f"kv-sync:{self.worker_id:#x}"
         )
 
@@ -127,7 +127,7 @@ class KvEventPublisher:
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.get_event_loop().create_task(
+            self._task = asyncio.get_running_loop().create_task(
                 self._pump(), name=f"kv-event-pub:{self.worker_id:#x}"
             )
 
@@ -245,7 +245,7 @@ class LoadPublisher:
     def start(self) -> None:
         if self._task is None:
             self._stop.clear()
-            self._task = asyncio.get_event_loop().create_task(
+            self._task = asyncio.get_running_loop().create_task(
                 self._run(), name=f"load-pub:{self.worker_id:#x}"
             )
 

@@ -285,6 +285,9 @@ class DiscdDiscovery:
         self._reqids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watches: Dict[int, asyncio.Queue] = {}
+        # Strong refs to watch bootstrap/unwatch tasks: the loop keeps
+        # only weak ones, so an unretained handle can be GC'd mid-flight.
+        self._bg_tasks: Set[asyncio.Task] = set()
         self._lock = asyncio.Lock()
         self._closed = False
         # _closed doubles as "connection needs re-establishing" (the pump
@@ -329,6 +332,12 @@ class DiscdDiscovery:
                     q.put_nowait(_WATCH_CLOSED)
 
         self._pump = asyncio.get_running_loop().create_task(pump(), name="discd-client-pump")
+
+    def _spawn_bg(self, coro, *, name: str) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     async def _call(self, header: Dict[str, Any], payload: Any = None) -> Tuple[Dict[str, Any], Any]:
         async with self._lock:
@@ -397,14 +406,15 @@ class DiscdDiscovery:
                     await asyncio.sleep(delay)
             queue.put_nowait(_WATCH_CLOSED)
 
-        asyncio.get_running_loop().create_task(bootstrap(), name="discd-watch-bootstrap")
+        self._spawn_bg(bootstrap(), name="discd-watch-bootstrap")
 
         def _close(w: Watch) -> None:
             if watch_id_box:
                 wid = watch_id_box[0]
                 self._watches.pop(wid, None)
-                asyncio.get_running_loop().create_task(
-                    self._call({"op": "unwatch", "watch_id": wid})
+                self._spawn_bg(
+                    self._call({"op": "unwatch", "watch_id": wid}),
+                    name="discd-unwatch",
                 )
             queue.put_nowait(_WATCH_CLOSED)
 
@@ -426,6 +436,9 @@ class DiscdDiscovery:
         if self._pump is not None:
             self._pump.cancel()
             await reap_task(self._pump, "discd event pump", logger)
+        for task in list(self._bg_tasks):
+            task.cancel()
+            await reap_task(task, "discd background task", logger)
         if self._fw is not None:
             self._fw.close()
             self._fw = None

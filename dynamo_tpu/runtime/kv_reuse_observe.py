@@ -38,16 +38,9 @@ from dynamo_tpu import config
 
 logger = logging.getLogger(__name__)
 
-SKETCH_CAPACITY = config.env_int(
-    "DYN_TPU_KV_SKETCH_CAPACITY", 4096,
-    "Prefix-popularity sketch capacity (tracked prefixes; space-saving "
-    "min-replacement keeps memory bounded regardless of distinct prefixes)",
-)
-SKETCH_HALF_LIFE_S = config.env_float(
-    "DYN_TPU_KV_SKETCH_HALF_LIFE_S", 600.0,
-    "Popularity decay half-life in seconds (recency weighting of the "
-    "prefix sketch; 0 disables decay)",
-)
+# Declared in the canonical registry (config.py).
+SKETCH_CAPACITY = config.KV_SKETCH_CAPACITY
+SKETCH_HALF_LIFE_S = config.KV_SKETCH_HALF_LIFE_S
 
 
 class _SketchEntry:

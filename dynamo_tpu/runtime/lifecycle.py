@@ -33,19 +33,10 @@ from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-SLOW_REQUEST_S = config.env_float(
-    "DYN_TPU_SLOW_REQUEST_S", 30.0,
-    "Requests slower than this (seconds, received→done) are retained in the "
-    "slow-request capture ring",
-)
-LIFECYCLE_RECENT = config.env_int(
-    "DYN_TPU_LIFECYCLE_RECENT", 256,
-    "Recent-request timelines retained for GET /debug/requests",
-)
-LIFECYCLE_SLOW = config.env_int(
-    "DYN_TPU_LIFECYCLE_SLOW", 64,
-    "Slow-request timelines retained past recent-ring eviction",
-)
+# Declared in the canonical registry (config.py).
+SLOW_REQUEST_S = config.SLOW_REQUEST_S
+LIFECYCLE_RECENT = config.LIFECYCLE_RECENT
+LIFECYCLE_SLOW = config.LIFECYCLE_SLOW
 
 
 @dataclass

@@ -66,36 +66,14 @@ logger = logging.getLogger(__name__)
 
 FINGERPRINT_SCHEMA_VERSION = 1
 
-PERF_WINDOW = config.env_int(
-    "DYN_TPU_PERF_WINDOW", 256,
-    "Perf-ledger rolling window (samples per decode shape; bounds both "
-    "memory and quantile cost)",
-)
-PERF_SAMPLE_TTL_S = config.env_float(
-    "DYN_TPU_PERF_SAMPLE_TTL_S", 120.0,
-    "Perf-ledger sample TTL in seconds (stale samples age out so the "
-    "windows describe the CURRENT regime, not history)",
-)
-PERF_EVAL_INTERVAL_S = config.env_float(
-    "DYN_TPU_PERF_EVAL_INTERVAL_S", 5.0,
-    "Seconds between perf-sentinel evaluations (the fingerprint "
-    "comparison runs at this cadence, not per tick)",
-)
-PERF_NOISE_BAND = config.env_float(
-    "DYN_TPU_PERF_NOISE_BAND", 0.10,
-    "Fractional noise band around a fingerprint before the sentinel "
-    "calls regression (0.10 = ±5%% run-to-run noise stays silent, a "
-    "20%% slowdown is flagged)",
-)
-PERF_MIN_SAMPLES = config.env_int(
-    "DYN_TPU_PERF_MIN_SAMPLES", 16,
-    "Samples a window needs before the sentinel issues a verdict for it",
-)
-PERF_FINGERPRINT_PATH = config.env_str(
-    "DYN_TPU_PERF_FINGERPRINT_PATH", "",
-    "Where steady-state perf fingerprints persist across restarts "
-    "(JSON; empty = in-memory only, every start is a cold start)",
-)
+# Declared in the canonical registry (config.py); aliased here so the
+# ledger's call sites keep their local names.
+PERF_WINDOW = config.PERF_WINDOW
+PERF_SAMPLE_TTL_S = config.PERF_SAMPLE_TTL_S
+PERF_EVAL_INTERVAL_S = config.PERF_EVAL_INTERVAL_S
+PERF_NOISE_BAND = config.PERF_NOISE_BAND
+PERF_MIN_SAMPLES = config.PERF_MIN_SAMPLES
+PERF_FINGERPRINT_PATH = config.PERF_FINGERPRINT_PATH
 
 
 class PerfLedgerConfig:

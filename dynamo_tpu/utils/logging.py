@@ -15,7 +15,6 @@ import time
 from typing import Optional
 
 from dynamo_tpu import config
-from dynamo_tpu.runtime.context import current_context
 
 _LEVELS = {
     "trace": logging.DEBUG,
@@ -37,6 +36,11 @@ class JsonFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        # Lazy: utils.logging is the first import of half the tree, and
+        # the layer DAG bans foundation -> runtime at module level
+        # (ImportLayeringConfig.lazy_obligations pins this seam).
+        from dynamo_tpu.runtime.context import current_context
+
         ctx = current_context()
         if ctx is not None:
             entry["request_id"] = ctx.id

@@ -32,19 +32,10 @@ TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
 )
 
-TRACE_FILE = config.env_str(
-    "DYN_TPU_TRACE_FILE", "",
-    "Append finished spans as JSONL to this path ('' disables file export)",
-)
-OTLP_ENDPOINT = config.env_str(
-    "DYN_TPU_OTLP_ENDPOINT", "",
-    "OTLP/HTTP traces endpoint (e.g. http://collector:4318/v1/traces); "
-    "'' disables the wire exporter",
-)
-OTLP_SERVICE = config.env_str(
-    "DYN_TPU_OTLP_SERVICE", "dynamo-tpu",
-    "service.name resource attribute on exported spans",
-)
+# Declared in the canonical registry (config.py).
+TRACE_FILE = config.TRACE_FILE
+OTLP_ENDPOINT = config.OTLP_ENDPOINT
+OTLP_SERVICE = config.OTLP_SERVICE
 
 
 @dataclass

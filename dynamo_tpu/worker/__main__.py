@@ -59,7 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--namespace", default=config.NAMESPACE.get())
     parser.add_argument("--component", default="backend")
     parser.add_argument("--endpoint", default="generate")
-    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument(
+        "--block-size", type=int, default=config.KV_BLOCK_SIZE.get()
+    )
     parser.add_argument("--num-kv-blocks", type=int, default=2048)
     parser.add_argument("--max-num-seqs", type=int, default=16)
     parser.add_argument("--max-model-len", type=int, default=2048)

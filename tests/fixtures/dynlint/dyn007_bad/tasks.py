@@ -1,0 +1,36 @@
+"""Known-bad async lifecycle: every DYN007 trigger class."""
+
+import asyncio
+import time
+
+
+async def work():
+    return 1
+
+
+def starter():
+    # get_event_loop outside a running loop binds a dead loop.
+    loop = asyncio.get_event_loop()
+    return loop
+
+
+async def fire_and_forget():
+    # Bare expression statement: the only strong ref is discarded.
+    asyncio.create_task(work())
+
+
+async def fire_and_forget_bare_name():
+    from asyncio import create_task
+
+    create_task(work())
+
+
+async def blocker():
+    # Synchronous sleep stalls the whole event loop.
+    time.sleep(0.1)
+
+
+async def reader(path):
+    # Sync file I/O lexically inside an async body.
+    with open(path) as f:
+        return f.read()

@@ -1,0 +1,48 @@
+"""Known-good async lifecycle: the sanctioned shapes of each trigger."""
+
+import asyncio
+import time
+
+
+async def work():
+    return 1
+
+
+class Runner:
+    def __init__(self):
+        self._task = None
+
+    async def start(self):
+        # get_running_loop fails loudly outside a loop; handle retained.
+        loop = asyncio.get_running_loop()
+        self._task = loop.create_task(work())
+
+    async def read(self, path):
+        # Blocking I/O pushed off the loop: the lambda is its own
+        # function boundary, so the open() inside it is exempt.
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: open(path).read())
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+
+
+def sync_helper(path):
+    # Blocking calls in sync functions are fine.
+    time.sleep(0.01)
+    with open(path) as f:
+        return f.read()
+
+
+async def nested(path):
+    # A nested sync def is its own boundary (executor-thunk pattern).
+    def _blocking():
+        return open(path).read()
+
+    return _blocking
+
+
+async def awaited():
+    # Awaiting the task IS retaining it.
+    await asyncio.create_task(work())

@@ -1,0 +1,23 @@
+"""Fixture knob registry (loaded by file path — stdlib only)."""
+
+import os
+
+
+class EnvVar:
+    def __init__(self, name, default, parser, doc=""):
+        self.name = name
+        self.default = default
+        self.parser = parser
+        self.doc = doc
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        return self.default if raw is None else self.parser(raw)
+
+
+GOOD = EnvVar("DYN_TPU_FIX_GOOD", 1, int)
+DEAD = EnvVar("DYN_TPU_FIX_DEAD", 0, int)
+
+# The third entry is in ALL_KNOBS but bound to no module constant, so
+# readers have no handle to reference it through.
+ALL_KNOBS = (GOOD, DEAD, EnvVar("DYN_TPU_FIX_UNBOUND", 1, int))

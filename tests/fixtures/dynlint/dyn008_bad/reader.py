@@ -1,0 +1,22 @@
+"""Known-bad knob readers: ad-hoc env reads in every supported shape,
+plus GOOD read properly so only DEAD shows up as dead."""
+
+import os
+
+import knobs
+
+
+def read_through_registry():
+    return knobs.GOOD.get()
+
+
+def read_adhoc_environ_get():
+    return os.environ.get("DYN_TPU_FIX_ADHOC", "0")
+
+
+def read_adhoc_subscript():
+    return os.environ["DYN_TPU_FIX_GOOD"]
+
+
+def read_adhoc_getenv():
+    return os.getenv("DYN_TPU_FIX_GOOD")

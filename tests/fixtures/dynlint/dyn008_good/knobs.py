@@ -1,0 +1,21 @@
+"""Fixture knob registry (loaded by file path — stdlib only)."""
+
+import os
+
+
+class EnvVar:
+    def __init__(self, name, default, parser, doc=""):
+        self.name = name
+        self.default = default
+        self.parser = parser
+        self.doc = doc
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        return self.default if raw is None else self.parser(raw)
+
+
+GOOD = EnvVar("DYN_TPU_FIX_GOOD", 1, int)
+OTHER = EnvVar("DYN_TPU_FIX_OTHER", "x", str)
+
+ALL_KNOBS = (GOOD, OTHER)

@@ -1,0 +1,20 @@
+"""Known-good knob readers: every declared knob read through its
+registry constant; env reads outside the DYN_TPU_ prefix are not ours
+to police."""
+
+import os
+
+import knobs
+
+
+def read_good():
+    return knobs.GOOD.get()
+
+
+def read_other():
+    return knobs.OTHER.get()
+
+
+def read_foreign_tool():
+    # Not in the DYN_TPU_ namespace: out of scope for the closure.
+    return os.environ.get("SOME_OTHER_TOOL_VAR")

@@ -1,0 +1,7 @@
+"""Low-layer module importing UP — a layer violation."""
+
+from fixpkg.high.b import thing
+
+
+def use():
+    return thing

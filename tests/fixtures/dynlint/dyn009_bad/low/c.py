@@ -1,0 +1,7 @@
+"""Half of a same-layer import cycle (c <-> d)."""
+
+import fixpkg.low.d
+
+
+def ping():
+    return fixpkg.low.d.pong

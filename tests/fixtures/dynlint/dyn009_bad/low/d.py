@@ -1,0 +1,7 @@
+"""Other half of the c <-> d cycle."""
+
+import fixpkg.low.c
+
+
+def pong():
+    return fixpkg.low.c.ping

@@ -1,0 +1,7 @@
+"""Module-level import across a declared lazy-import obligation."""
+
+from fixpkg.low.f import helper
+
+
+def use():
+    return helper

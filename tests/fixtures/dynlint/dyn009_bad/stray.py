@@ -1,0 +1,3 @@
+"""Mapped to no layer: the DAG must stay total."""
+
+VALUE = 1

@@ -1,0 +1,5 @@
+"""High layer importing DOWN: always legal."""
+
+from fixpkg.low.f import helper
+
+thing = helper

@@ -1,0 +1,13 @@
+"""Low-layer module: the sanctioned ways to touch a higher layer."""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from fixpkg.high.b import thing  # annotations only: exempt
+
+
+def use_lazily():
+    # Function-local import: the sanctioned lazy pattern.
+    from fixpkg.high.b import thing
+
+    return thing

@@ -1,0 +1,7 @@
+"""The declared lazy obligation honored: e imports f inside a function."""
+
+
+def use():
+    from fixpkg.low.f import helper
+
+    return helper

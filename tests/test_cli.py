@@ -362,6 +362,26 @@ def test_lint_rejects_unknown_rule():
     assert "unknown rule" in res.stderr
 
 
+def test_lint_explain_prints_catalog_entry():
+    res = run_cli(["lint", "--explain", "DYN007"])
+    assert res.returncode == 0
+    assert "DYN007" in res.stdout
+    assert "get_running_loop" in res.stdout
+
+
+def test_lint_explain_unknown_rule():
+    res = run_cli(["lint", "--explain", "DYN999"])
+    assert res.returncode == 2
+    assert "unknown rule" in res.stderr
+
+
+def test_env_markdown_emits_reference_table():
+    res = run_cli(["env", "--markdown"])
+    assert res.returncode == 0
+    assert "# Configuration knob reference" in res.stdout
+    assert "DYN_TPU_KV_CHUNK_BYTES" in res.stdout
+
+
 def test_lint_foreign_root_runs_portable_rules_only():
     """A --root outside the package must not drown in repo-config
     mismatch noise (hot-path roots, metric registry, ring owners): a

@@ -36,6 +36,19 @@ def make_worker(hung: asyncio.Event):
 
 
 class TestCanary:
+    def test_start_outside_loop_fails_loudly(self):
+        """The DYN007 contract: start() outside a running loop raises at
+        the call site (get_running_loop), instead of get_event_loop
+        silently binding a dead loop that never runs the canary task."""
+        class StubClient:
+            def set_instance_filter(self, fn):
+                pass
+
+        checker = CanaryHealthChecker(StubClient())
+        with pytest.raises(RuntimeError):
+            checker.start()
+        assert checker._task is None
+
     async def test_hung_worker_evicted_and_recovers(self):
         drt = DistributedRuntime.detached()
         ep = drt.namespace("health").component("backend").endpoint("generate")
